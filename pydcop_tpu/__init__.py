@@ -1,0 +1,36 @@
+"""pydcop_tpu — a TPU-native DCOP (Distributed Constraint Optimization) framework.
+
+Re-designed from scratch for TPU hardware (JAX/XLA/pjit/shard_map/pallas)
+with the capabilities of the reference library pyDcop (PierreRust/pyDcop).
+
+Layer map (mirrors the reference's public seams, replaces the internals):
+
+- ``pydcop_tpu.utils``      — serialization (SimpleRepr), expression functions.
+- ``pydcop_tpu.dcop``       — problem model: Domain/Variable/Constraint/DCOP,
+  YAML format (reference: ``pydcop/dcop/``).
+- ``pydcop_tpu.graphs``     — computation-graph builders: constraints
+  hypergraph, factor graph, pseudo-tree, ordered graph
+  (reference: ``pydcop/computations_graph/``).
+- ``pydcop_tpu.ops``        — the TPU compute path: the problem compiler
+  (DCOP → static pytree of index arrays + cost tables) and the jitted
+  array kernels (segment min-plus marginalization, local-gain evaluation,
+  UTIL join/project).  This replaces the reference's numpy
+  ``NAryMatrixRelation`` hot path.
+- ``pydcop_tpu.algorithms`` — the plugin registry + one module per
+  algorithm (dsa, mgm, mgm2, maxsum, dpop, ...) with the same contract as
+  the reference (``GRAPH_TYPE``, ``build_computation``,
+  ``computation_memory``, ``communication_load``, ``algo_params``).
+- ``pydcop_tpu.distribution`` — computation→agent placement strategies.
+- ``pydcop_tpu.engine``     — the synchronous-batched TPU engine: one
+  jitted step = one DCOP round for every agent simultaneously; replaces
+  the reference's thread-per-agent runtime for the solve path.
+- ``pydcop_tpu.parallel``   — mesh/sharding helpers (shard_map over a
+  ``jax.sharding.Mesh``, psum-combined neighbor exchange over ICI).
+- ``pydcop_tpu.infrastructure`` — host-side message-passing runtime
+  (agents, messaging, discovery, orchestrator) for capability parity
+  with the reference's dynamic/resilient runs, plus the embedding API
+  ``solve()``.
+- ``pydcop_tpu.commands``   — the CLI (``pydcop-tpu solve|run|graph|...``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+from pydcop_tpu.utils.simple_repr import (
+    SimpleRepr,
+    SimpleReprException,
+    simple_repr,
+    from_repr,
+)
+from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+
+__all__ = [
+    "SimpleRepr",
+    "SimpleReprException",
+    "simple_repr",
+    "from_repr",
+    "ExpressionFunction",
+]

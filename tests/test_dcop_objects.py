@@ -1,0 +1,153 @@
+import pytest
+
+from pydcop_tpu.dcop.objects import (
+    AgentDef,
+    BinaryVariable,
+    Domain,
+    ExternalVariable,
+    Variable,
+    VariableNoisyCostFunc,
+    VariableWithCostDict,
+    VariableWithCostFunc,
+    create_agents,
+    create_binary_variables,
+    create_variables,
+)
+from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+
+def test_domain_basics():
+    d = Domain("colors", "color", ["R", "G", "B"])
+    assert len(d) == 3
+    assert d.index("G") == 1
+    assert d[2] == "B"
+    assert "R" in d
+    assert list(d) == ["R", "G", "B"]
+    with pytest.raises(ValueError):
+        d.index("X")
+
+
+def test_domain_to_domain_value_handles_str():
+    d = Domain("nums", "", [1, 2, 3])
+    assert d.to_domain_value("2") == 2
+    assert d.to_domain_value(3) == 3
+
+
+def test_domain_round_trip():
+    d = Domain("colors", "color", ["R", "G"])
+    assert from_repr(simple_repr(d)) == d
+
+
+def test_variable_basics():
+    d = Domain("d", "", [0, 1, 2])
+    v = Variable("x", d, initial_value=1)
+    assert v.name == "x"
+    assert v.initial_value == 1
+    assert v.cost_for_val(0) == 0
+    with pytest.raises(ValueError):
+        Variable("y", d, initial_value=9)
+
+
+def test_variable_accepts_raw_list_domain():
+    v = Variable("x", [0, 1])
+    assert len(v.domain) == 2
+
+
+def test_variable_round_trip():
+    d = Domain("d", "", [0, 1])
+    v = Variable("x", d, 1)
+    v2 = from_repr(simple_repr(v))
+    assert v2 == v and v2.initial_value == 1
+
+
+def test_variable_with_cost_func():
+    d = Domain("d", "", [0, 1, 2])
+    v = VariableWithCostFunc("x", d, ExpressionFunction("x * 2"))
+    assert v.cost_for_val(2) == 4
+    assert v.has_cost
+    v2 = from_repr(simple_repr(v))
+    assert v2.cost_for_val(2) == 4
+
+
+def test_variable_with_cost_dict():
+    d = Domain("d", "", ["a", "b"])
+    v = VariableWithCostDict("x", d, {"a": 1.0, "b": 0.5})
+    assert v.cost_for_val("b") == 0.5
+
+
+def test_noisy_cost_func_deterministic():
+    d = Domain("d", "", [0, 1])
+    f = ExpressionFunction("x * 1.0")
+    v1 = VariableNoisyCostFunc("x", d, f, noise_level=0.1)
+    v2 = VariableNoisyCostFunc("x", d, f, noise_level=0.1)
+    assert v1.cost_for_val(1) == v2.cost_for_val(1)
+    assert 1.0 <= v1.cost_for_val(1) <= 1.1
+
+
+def test_binary_variable():
+    b = BinaryVariable("b1")
+    assert list(b.domain) == [0, 1]
+    assert from_repr(simple_repr(b)) == b
+
+
+def test_external_variable_subscription():
+    d = Domain("d", "", ["on", "off"])
+    e = ExternalVariable("sensor", d, "off")
+    seen = []
+    e.subscribe(seen.append)
+    e.value = "on"
+    assert e.value == "on"
+    assert seen == ["on"]
+    with pytest.raises(ValueError):
+        e.value = "broken"
+
+
+def test_agentdef_costs_and_routes():
+    a = AgentDef(
+        "a1",
+        capacity=50,
+        default_hosting_cost=2,
+        hosting_costs={"v1": 5},
+        default_route=1.5,
+        routes={"a2": 0.5},
+    )
+    assert a.hosting_cost("v1") == 5
+    assert a.hosting_cost("v9") == 2
+    assert a.route("a2") == 0.5
+    assert a.route("a3") == 1.5
+    assert a.route("a1") == 0
+    assert from_repr(simple_repr(a)) == a
+
+
+def test_agentdef_extra_attrs():
+    a = AgentDef("a1", foo="bar")
+    assert a.foo == "bar"
+    with pytest.raises(AttributeError):
+        _ = a.nope
+
+
+def test_create_variables_range_and_product():
+    d = Domain("d", "", [0, 1])
+    vs = create_variables("v", range(3), d)
+    assert sorted(vs) == ["v0", "v1", "v2"]
+    ms = create_variables("m", [[0, 1], [0, 1]], d)
+    assert ("0", "1") in ms
+    assert ms[("0", "1")].name == "m0_1"
+
+
+def test_create_binary_variables():
+    bs = create_binary_variables("b", range(2))
+    assert all(list(b.domain) == [0, 1] for b in bs.values())
+
+
+def test_create_agents():
+    ags = create_agents("a", range(4), capacity=10)
+    assert len(ags) == 4
+    assert ags["a2"].capacity == 10
+
+
+def test_agentdef_extra_attrs_round_trip():
+    a = AgentDef("a1", foo="bar", num=3)
+    a2 = from_repr(simple_repr(a))
+    assert a2.foo == "bar" and a2.num == 3

@@ -1,0 +1,191 @@
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import (
+    NAryFunctionRelation,
+    NAryMatrixRelation,
+    UnaryFunctionRelation,
+    add_var_to_rel,
+    assignment_cost,
+    constraint_from_str,
+    filter_assignment_dict,
+    find_dependent_relations,
+    optimal_cost_value,
+)
+from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+D2 = Domain("d2", "", [0, 1])
+D3 = Domain("d3", "", ["R", "G", "B"])
+
+
+def test_matrix_relation_basics():
+    x, y = Variable("x", D2), Variable("y", D2)
+    r = NAryMatrixRelation([x, y], [[0, 1], [1, 0]], name="neq")
+    assert r.arity == 2
+    assert r.shape == (2, 2)
+    assert r(0, 1) == 1.0
+    assert r(x=1, y=1) == 0.0
+    assert r({"x": 1, "y": 0}) == 1.0
+
+
+def test_matrix_relation_shape_mismatch():
+    x, y = Variable("x", D2), Variable("y", D3)
+    with pytest.raises(ValueError):
+        NAryMatrixRelation([x, y], [[0, 1], [1, 0]])
+
+
+def test_matrix_relation_set_value_immutable():
+    x = Variable("x", D2)
+    r = NAryMatrixRelation([x], [0, 0], name="u")
+    r2 = r.set_value_for_assignment({"x": 1}, 5)
+    assert r(x=1) == 0
+    assert r2(x=1) == 5
+
+
+def test_matrix_slice():
+    x, y = Variable("x", D3), Variable("y", D3)
+    m = np.arange(9).reshape(3, 3)
+    r = NAryMatrixRelation([x, y], m, name="r")
+    s = r.slice({"x": "G"})
+    assert s.arity == 1
+    assert s.scope_names == ["y"]
+    assert s(y="R") == 3.0
+    assert s(y="B") == 5.0
+
+
+def test_matrix_join_shared_var():
+    x, y, z = Variable("x", D2), Variable("y", D2), Variable("z", D2)
+    r1 = NAryMatrixRelation([x, y], [[0, 1], [2, 3]], name="r1")
+    r2 = NAryMatrixRelation([y, z], [[10, 20], [30, 40]], name="r2")
+    j = r1.join(r2)
+    assert set(j.scope_names) == {"x", "y", "z"}
+    # cost(x, y, z) = r1(x, y) + r2(y, z)
+    for xv in (0, 1):
+        for yv in (0, 1):
+            for zv in (0, 1):
+                assert j(x=xv, y=yv, z=zv) == r1(xv, yv) + r2(yv, zv)
+
+
+def test_matrix_join_axis_order_mismatch():
+    # join where the shared variable sits at different axis positions
+    x, y = Variable("x", D2), Variable("y", D3)
+    r1 = NAryMatrixRelation([x, y], np.arange(6).reshape(2, 3), name="r1")
+    r2 = NAryMatrixRelation([y, x], np.arange(6).reshape(3, 2) * 10, name="r2")
+    j = r1.join(r2)
+    for xv in (0, 1):
+        for yv in ("R", "G", "B"):
+            assert j(x=xv, y=yv) == r1(x=xv, y=yv) + r2(y=yv, x=xv)
+
+
+def test_matrix_project_out():
+    x, y = Variable("x", D2), Variable("y", D2)
+    r = NAryMatrixRelation([x, y], [[5, 1], [2, 7]], name="r")
+    p = r.project_out("y", mode="min")
+    assert p.scope_names == ["x"]
+    assert p(x=0) == 1 and p(x=1) == 2
+    pmax = r.project_out("x", mode="max")
+    assert pmax(y=0) == 5 and pmax(y=1) == 7
+
+
+def test_argbest():
+    x = Variable("x", D3)
+    r = NAryMatrixRelation([x], [3, 1, 2], name="u")
+    val, cost = r.argbest_for("x")
+    assert val == "G" and cost == 1.0
+
+
+def test_function_relation():
+    x, y = Variable("x", D2), Variable("y", D2)
+    r = NAryFunctionRelation(lambda a, b: a * 10 + b, [x, y], name="f")
+    assert r(1, 0) == 10
+
+
+def test_function_relation_slice():
+    x, y = Variable("x", D2), Variable("y", D2)
+    f = ExpressionFunction("x * 10 + y")
+    r = NAryFunctionRelation(f, [x, y], name="f")
+    s = r.slice({"x": 1})
+    assert s.scope_names == ["y"]
+    assert s(y=1) == 11
+
+
+def test_as_matrix_tabulation():
+    x, y = Variable("x", D3), Variable("y", D3)
+    r = constraint_from_str("c", "10 if x == y else 0", [x, y])
+    m = r.as_matrix()
+    assert m.shape == (3, 3)
+    for xv in D3:
+        for yv in D3:
+            assert m(x=xv, y=yv) == r(x=xv, y=yv)
+
+
+def test_unary_function_relation():
+    x = Variable("x", D2)
+    r = UnaryFunctionRelation("u", x, lambda v: v * 3)
+    assert r(1) == 3
+    assert r(x=0) == 0
+
+
+def test_constraint_from_str_scope():
+    x, y, z = Variable("x", D2), Variable("y", D2), Variable("z", D2)
+    r = constraint_from_str("c", "x + y", [x, y, z])
+    assert set(r.scope_names) == {"x", "y"}
+    with pytest.raises(ValueError):
+        constraint_from_str("c", "x + unknown_var", [x, y])
+
+
+def test_assignment_cost_and_filter():
+    x, y = Variable("x", D2), Variable("y", D2)
+    r1 = constraint_from_str("c1", "x + y", [x, y])
+    r2 = constraint_from_str("c2", "10 * x", [x, y])
+    a = {"x": 1, "y": 1, "zz": 5}
+    assert assignment_cost({"x": 1, "y": 1}, [r1, r2]) == 12
+    assert filter_assignment_dict(a, [x, y]) == {"x": 1, "y": 1}
+
+
+def test_optimal_cost_value():
+    from pydcop_tpu.dcop.objects import VariableWithCostFunc
+
+    d = Domain("d", "", [0, 1, 2])
+    v = VariableWithCostFunc("x", d, ExpressionFunction("(x - 1) ** 2"))
+    val, cost = optimal_cost_value(v)
+    assert val == 1 and cost == 0
+
+
+def test_find_dependent_relations():
+    x, y, z = Variable("x", D2), Variable("y", D2), Variable("z", D2)
+    r1 = constraint_from_str("c1", "x + y", [x, y, z])
+    r2 = constraint_from_str("c2", "y + z", [x, y, z])
+    assert find_dependent_relations(x, [r1, r2]) == [r1]
+    assert find_dependent_relations(y, [r1, r2]) == [r1, r2]
+
+
+def test_add_var_to_rel():
+    x, y = Variable("x", D2), Variable("y", D2)
+    base = NAryMatrixRelation([x], [1, 2], name="b")
+    ext = add_var_to_rel("e", base, y, lambda cost, v: cost + 100 * v)
+    assert ext(x=1, y=1) == 102
+    assert ext(x=0, y=0) == 1
+
+
+def test_matrix_round_trip():
+    x, y = Variable("x", D2), Variable("y", D2)
+    r = NAryMatrixRelation([x, y], [[0, 1], [1, 0]], name="neq")
+    r2 = from_repr(simple_repr(r))
+    assert r2 == r
+
+
+def test_unary_relation_round_trip():
+    x = Variable("x", D2)
+    r = UnaryFunctionRelation("u", x, ExpressionFunction("x * 2"))
+    r2 = from_repr(simple_repr(r))
+    assert r2(x=1) == 2 and r2.name == "u"
+
+
+def test_matrix_hash_eq_contract():
+    x, y = Variable("x", D2), Variable("y", D2)
+    r1 = NAryMatrixRelation([x, y], [[0, 1], [1, 0]], name="a")
+    r2 = NAryMatrixRelation([x, y], [[0, 1], [1, 0]], name="b")
+    assert r1 == r2 and hash(r1) == hash(r2)

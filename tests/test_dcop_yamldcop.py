@@ -1,0 +1,266 @@
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.yamldcop import (
+    DcopInvalidFormatError,
+    dcop_yaml,
+    load_dcop,
+    load_scenario,
+)
+
+GRAPH_COLORING_YAML = """
+name: graph coloring
+objective: min
+description: a small graph coloring problem
+
+domains:
+  colors:
+    values: [R, G, B]
+    type: color
+
+variables:
+  v1:
+    domain: colors
+    initial_value: R
+  v2:
+    domain: colors
+  v3:
+    domain: colors
+    cost_function: 0.1 if v3 == 'R' else 0
+
+constraints:
+  diff_12:
+    type: intention
+    function: 10 if v1 == v2 else 0
+  diff_23:
+    type: extensional
+    variables: [v2, v3]
+    default: 0
+    values:
+      10: R R | G G | B B
+
+agents:
+  a1:
+    capacity: 100
+  a2:
+    capacity: 100
+    hosting:
+      default: 1
+      computations: {v1: 3}
+    routes:
+      default: 2
+      a1: 0.5
+"""
+
+
+def test_load_graph_coloring():
+    dcop = load_dcop(GRAPH_COLORING_YAML)
+    assert dcop.name == "graph coloring"
+    assert dcop.objective == "min"
+    assert set(dcop.variables) == {"v1", "v2", "v3"}
+    assert dcop.variables["v1"].initial_value == "R"
+    assert set(dcop.constraints) == {"diff_12", "diff_23"}
+    assert set(dcop.agents) == {"a1", "a2"}
+    assert dcop.agents["a2"].hosting_cost("v1") == 3
+    assert dcop.agents["a2"].hosting_cost("zz") == 1
+    assert dcop.agents["a2"].route("a1") == 0.5
+
+
+def test_constraint_semantics():
+    dcop = load_dcop(GRAPH_COLORING_YAML)
+    c12 = dcop.constraints["diff_12"]
+    assert c12(v1="R", v2="R") == 10
+    assert c12(v1="R", v2="G") == 0
+    c23 = dcop.constraints["diff_23"]
+    assert c23(v2="G", v3="G") == 10
+    assert c23(v2="G", v3="B") == 0
+
+
+def test_variable_cost_function():
+    dcop = load_dcop(GRAPH_COLORING_YAML)
+    v3 = dcop.variables["v3"]
+    assert v3.has_cost
+    assert v3.cost_for_val("R") == pytest.approx(0.1)
+    assert v3.cost_for_val("G") == 0
+
+
+def test_solution_cost():
+    dcop = load_dcop(GRAPH_COLORING_YAML)
+    cost = dcop.solution_cost({"v1": "R", "v2": "R", "v3": "R"})
+    assert cost == pytest.approx(10 + 10 + 0.1)
+    cost2 = dcop.solution_cost({"v1": "R", "v2": "G", "v3": "B"})
+    assert cost2 == pytest.approx(0)
+
+
+def test_range_domain():
+    y = """
+name: t
+objective: min
+domains:
+  ten:
+    values: [1 .. 5]
+variables:
+  x: {domain: ten}
+constraints:
+  u:
+    type: intention
+    function: x * 2
+agents: [a1]
+"""
+    dcop = load_dcop(y)
+    assert list(dcop.domains["ten"].values) == [1, 2, 3, 4, 5]
+    assert dcop.constraints["u"](x=4) == 8
+
+
+def test_agents_as_list():
+    y = """
+name: t
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  x: {domain: d}
+constraints:
+  u: {type: intention, function: x}
+agents: [a1, a2, a3]
+"""
+    dcop = load_dcop(y)
+    assert set(dcop.agents) == {"a1", "a2", "a3"}
+    assert dcop.agents["a1"].capacity == 100.0
+
+
+def test_external_variables():
+    y = """
+name: t
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  x: {domain: d}
+external_variables:
+  e: {domain: d, initial_value: 1}
+constraints:
+  c: {type: intention, function: x * e}
+agents: [a1]
+"""
+    dcop = load_dcop(y)
+    assert "e" in dcop.external_variables
+    assert dcop.external_variables["e"].value == 1
+    assert dcop.constraints["c"].arity == 2
+
+
+def test_distribution_hints():
+    y = """
+name: t
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  x: {domain: d}
+constraints:
+  u: {type: intention, function: x}
+agents: [a1, a2]
+distribution_hints:
+  must_host:
+    a1: [x]
+"""
+    dcop = load_dcop(y)
+    assert dcop.dist_hints is not None
+    assert dcop.dist_hints.must_host("a1") == ["x"]
+
+
+def test_invalid_yaml_raises():
+    with pytest.raises(DcopInvalidFormatError):
+        load_dcop("name: t\ndomains:\n  d: {novalues: 1}\nvariables: {}\n")
+    with pytest.raises(DcopInvalidFormatError):
+        load_dcop(
+            "name: t\ndomains:\n  d: {values: [0]}\n"
+            "variables:\n  x: {domain: nope}\n"
+        )
+
+
+def test_yaml_round_trip():
+    dcop = load_dcop(GRAPH_COLORING_YAML)
+    dumped = dcop_yaml(dcop)
+    dcop2 = load_dcop(dumped)
+    assert set(dcop2.variables) == set(dcop.variables)
+    assert set(dcop2.constraints) == set(dcop.constraints)
+    assert set(dcop2.agents) == set(dcop.agents)
+    # semantics preserved
+    for a in (
+        {"v1": "R", "v2": "R", "v3": "R"},
+        {"v1": "R", "v2": "G", "v3": "B"},
+        {"v1": "B", "v2": "G", "v3": "G"},
+    ):
+        assert dcop2.solution_cost(a) == pytest.approx(dcop.solution_cost(a))
+
+
+def test_load_scenario():
+    y = """
+events:
+  - delay: 10
+  - id: e1
+    actions:
+      - type: remove_agent
+        agent: a2
+  - id: e2
+    actions:
+      - type: set_value
+        variable: e
+        value: 1
+"""
+    s = load_scenario(y)
+    assert len(s) == 3
+    assert s.events[0].is_delay and s.events[0].delay == 10
+    assert s.events[1].actions[0].type == "remove_agent"
+    assert s.events[1].actions[0].args["agent"] == "a2"
+
+
+def test_external_variable_and_hints_simple_repr_round_trip():
+    from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+    dcop = load_dcop("""
+name: t
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  x: {domain: d}
+external_variables:
+  e: {domain: d, initial_value: 1}
+constraints:
+  u: {type: intention, function: x}
+agents: [a1]
+distribution_hints:
+  must_host:
+    a1: [x]
+""")
+    dcop2 = from_repr(simple_repr(dcop))
+    assert "e" in dcop2.external_variables
+    assert dcop2.dist_hints.must_host("a1") == ["x"]
+
+
+def test_agent_extra_attrs_yaml_round_trip():
+    y = """
+name: t
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  x: {domain: d}
+constraints:
+  u: {type: intention, function: x}
+agents:
+  a1: {capacity: 10, color_pref: blue}
+"""
+    dcop = load_dcop(y)
+    assert dcop.agents["a1"].color_pref == "blue"
+    dcop2 = load_dcop(dcop_yaml(dcop))
+    assert dcop2.agents["a1"].color_pref == "blue"
+
+
+def test_empty_actions_event_rejected():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        load_scenario("events:\n  - id: e1\n    actions: []\n")

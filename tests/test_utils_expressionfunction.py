@@ -1,0 +1,83 @@
+import pytest
+
+from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+
+def test_simple_expression():
+    f = ExpressionFunction("a + b")
+    assert set(f.variable_names) == {"a", "b"}
+    assert f(a=1, b=2) == 3
+
+
+def test_conditional_expression():
+    f = ExpressionFunction("10 if v1 == v2 else 0")
+    assert f(v1="R", v2="R") == 10
+    assert f(v1="R", v2="G") == 0
+
+
+def test_assignment_dict_call():
+    f = ExpressionFunction("x * y")
+    assert f({"x": 3, "y": 4}) == 12
+
+
+def test_math_and_builtins_available():
+    f = ExpressionFunction("abs(x) + min(y, 2)")
+    assert f(x=-1, y=5) == 3
+    g = ExpressionFunction("round(math.sqrt(x))")
+    assert g(x=9) == 3
+
+
+def test_multiline_with_return():
+    src = "if a > 0:\n    return a * 2\nreturn -a"
+    f = ExpressionFunction(src)
+    assert set(f.variable_names) == {"a"}
+    assert f(a=3) == 6
+    assert f(a=-3) == 3
+
+
+def test_partial_application():
+    f = ExpressionFunction("a + b + c")
+    g = f.partial(a=10)
+    assert set(g.variable_names) == {"b", "c"}
+    assert g(b=1, c=2) == 13
+
+
+def test_fixed_vars_in_ctor():
+    f = ExpressionFunction("a + b", b=5)
+    assert set(f.variable_names) == {"a"}
+    assert f(a=1) == 6
+
+
+def test_unknown_fixed_var_raises():
+    with pytest.raises(ValueError):
+        ExpressionFunction("a + b", z=1)
+
+
+def test_missing_variable_raises():
+    f = ExpressionFunction("a + b")
+    with pytest.raises(TypeError):
+        f(a=1)
+
+
+def test_round_trip_simple_repr():
+    f = ExpressionFunction("a + b", b=2)
+    f2 = from_repr(simple_repr(f))
+    assert f2(a=1) == 3
+    assert f == f2
+
+
+def test_comprehension_targets_not_free():
+    f = ExpressionFunction("sum(i * x for i in [1, 2, 3])")
+    assert set(f.variable_names) == {"x"}
+    assert f(x=2) == 12
+
+
+def test_name_containing_return_not_statement_form():
+    f = ExpressionFunction("return_delay + 1")
+    assert f(return_delay=1) == 2
+
+
+def test_string_literal_containing_return():
+    f = ExpressionFunction("1 if x == 'return' else 0")
+    assert f(x="return") == 1

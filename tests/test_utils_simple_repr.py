@@ -1,0 +1,65 @@
+"""Round-trip tests for the simple_repr serialization layer."""
+
+import json
+
+import pytest
+
+from pydcop_tpu.utils.simple_repr import (
+    SimpleRepr,
+    SimpleReprException,
+    from_repr,
+    simple_repr,
+)
+
+
+class Point(SimpleRepr):
+    def __init__(self, x, y):
+        self._x = x
+        self._y = y
+
+
+class Named(SimpleRepr):
+    def __init__(self, name, tags=None):
+        self._name = name
+        self._tags = tags or []
+
+
+def test_primitives_pass_through():
+    for v in (None, True, 3, 2.5, "abc"):
+        assert simple_repr(v) == v
+        assert from_repr(simple_repr(v)) == v
+
+
+def test_object_round_trip():
+    p = Point(1, 2.5)
+    r = simple_repr(p)
+    p2 = from_repr(r)
+    assert isinstance(p2, Point)
+    assert p2._x == 1 and p2._y == 2.5
+
+
+def test_nested_containers_round_trip():
+    n = Named("a", tags=["x", "y"])
+    obj = {"k": [n, (1, 2)], 3: {4, 5}}
+    r = simple_repr(obj)
+    # must be JSON-serializable (the wire format requirement)
+    json.dumps(r)
+    obj2 = from_repr(r)
+    assert obj2["k"][0]._name == "a"
+    assert obj2["k"][0]._tags == ["x", "y"]
+    assert obj2["k"][1] == (1, 2)
+    assert obj2[3] == {4, 5}
+
+
+def test_missing_attribute_raises():
+    class Bad(SimpleRepr):
+        def __init__(self, a):
+            self.b = a
+
+    with pytest.raises(SimpleReprException):
+        simple_repr(Bad(1))
+
+
+def test_unserializable_raises():
+    with pytest.raises(SimpleReprException):
+        simple_repr(object())
