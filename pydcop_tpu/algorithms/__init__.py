@@ -1,0 +1,261 @@
+"""Algorithm plugin registry (reference: ``pydcop/algorithms/__init__.py``).
+
+The registry contract every algorithm module satisfies (same seams as
+the reference, extended with the TPU batched-engine entry points):
+
+Host-side (reference-parity):
+- ``GRAPH_TYPE: str`` — which computation-graph model the algorithm runs on.
+- ``algo_params: List[AlgoParameterDef]`` — typed, defaulted parameters.
+- ``computation_memory(node) -> float`` — footprint estimate for the
+  distribution layer.
+- ``communication_load(node, neighbor_name) -> float`` — per-link load
+  estimate for the distribution layer.
+
+TPU batched engine (the new execution core — replaces the reference's
+``build_computation`` thread-per-agent path for solving):
+- ``init_state(problem, key, params) -> state`` — initial state pytree;
+  must contain key ``"values"`` (i32[n_vars] domain indices).
+- ``step(problem, state, key, params) -> state`` — ONE synchronous round
+  for every agent simultaneously; pure and jittable.
+- ``messages_per_round(problem) -> int`` — logical directed messages one
+  round represents (the auditable msgs/sec accounting, see BASELINE.md).
+
+Algorithms with inherently sequential host-side phases (DPOP, SyncBB)
+instead export ``solve_host(problem_or_dcop, ...)``; the engine detects
+which contract a module implements.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import pkgutil
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+from pydcop_tpu.utils.simple_repr import SimpleRepr
+
+_ALGO_PACKAGE = "pydcop_tpu.algorithms"
+
+
+class AlgorithmDefError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgoParameterDef:
+    """Typed algorithm-parameter declaration.
+
+    type: 'str' | 'int' | 'float' | 'bool'
+    values: allowed values (for enumerated str params), or None
+    """
+
+    name: str
+    type: str = "str"
+    values: Optional[Sequence[Any]] = None
+    default: Any = None
+
+    def check_value(self, value: Any) -> Any:
+        try:
+            if self.type == "int":
+                value = int(value)
+            elif self.type == "float":
+                value = float(value)
+            elif self.type == "bool":
+                if isinstance(value, str):
+                    if value.lower() in ("true", "1", "yes"):
+                        value = True
+                    elif value.lower() in ("false", "0", "no"):
+                        value = False
+                    else:
+                        raise ValueError(value)
+                value = bool(value)
+            else:
+                value = str(value)
+        except (TypeError, ValueError):
+            raise AlgorithmDefError(
+                f"Parameter {self.name}: cannot convert {value!r} to "
+                f"{self.type}"
+            )
+        if self.values is not None and value not in self.values:
+            raise AlgorithmDefError(
+                f"Parameter {self.name}: {value!r} not in allowed values "
+                f"{list(self.values)}"
+            )
+        return value
+
+
+def check_param_value(value: Any, param_def: AlgoParameterDef) -> Any:
+    return param_def.check_value(value)
+
+
+def prepare_algo_params(
+    params: Optional[Mapping[str, Any]],
+    param_defs: List[AlgoParameterDef],
+) -> Dict[str, Any]:
+    """Validate user params against the definitions; fill defaults;
+    reject unknown names."""
+    params = dict(params or {})
+    out: Dict[str, Any] = {}
+    by_name = {p.name: p for p in param_defs}
+    unknown = set(params) - set(by_name)
+    if unknown:
+        raise AlgorithmDefError(
+            f"Unknown algorithm parameter(s) {sorted(unknown)}; "
+            f"accepted: {sorted(by_name)}"
+        )
+    for name, pdef in by_name.items():
+        if name in params:
+            out[name] = pdef.check_value(params[name])
+        else:
+            out[name] = pdef.default
+    return out
+
+
+class AlgorithmDef(SimpleRepr):
+    """Serializable algorithm selection: name + validated params + mode.
+
+    ``mode`` is 'min' or 'max' (the optimization direction the algorithm
+    should apply — normally taken from the DCOP objective).
+    """
+
+    def __init__(
+        self,
+        algo: str,
+        params: Optional[Mapping[str, Any]] = None,
+        mode: str = "min",
+    ):
+        self._algo = algo
+        self._params = dict(params or {})
+        self._mode = mode
+
+    @classmethod
+    def build_with_default_param(
+        cls,
+        algo: str,
+        params: Optional[Mapping[str, Any]] = None,
+        mode: str = "min",
+    ) -> "AlgorithmDef":
+        module = load_algorithm_module(algo)
+        validated = prepare_algo_params(params, module.algo_params)
+        return cls(algo, validated, mode)
+
+    @property
+    def algo(self) -> str:
+        return self._algo
+
+    @property
+    def name(self) -> str:
+        return self._algo
+
+    @property
+    def params(self) -> Dict[str, Any]:
+        return dict(self._params)
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    def param_value(self, name: str) -> Any:
+        return self._params[name]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, AlgorithmDef)
+            and other._algo == self._algo
+            and other._params == self._params
+            and other._mode == self._mode
+        )
+
+    def __repr__(self) -> str:
+        return f"AlgorithmDef({self._algo!r}, {self._params}, {self._mode!r})"
+
+    def _simple_repr(self) -> dict:
+        from pydcop_tpu.utils.simple_repr import _CLASS_KEY, _MODULE_KEY, simple_repr
+
+        return {
+            _CLASS_KEY: type(self).__qualname__,
+            _MODULE_KEY: type(self).__module__,
+            "algo": self._algo,
+            "params": simple_repr(self._params),
+            "mode": self._mode,
+        }
+
+    @classmethod
+    def _from_repr(cls, r: dict):
+        from pydcop_tpu.utils.simple_repr import from_repr
+
+        return cls(r["algo"], from_repr(r["params"]) or {}, r.get("mode", "min"))
+
+
+class ComputationDef(SimpleRepr):
+    """Deployment unit: one computation-graph node + the algorithm that
+    runs it (reference: ``ComputationDef``).  Used by the host runtime's
+    deploy protocol; the TPU engine deploys whole problems instead."""
+
+    def __init__(self, node, algo: AlgorithmDef):
+        self._node = node
+        self._algo = algo
+
+    @property
+    def node(self):
+        return self._node
+
+    @property
+    def algo(self) -> AlgorithmDef:
+        return self._algo
+
+    @property
+    def name(self) -> str:
+        return self._node.name
+
+    def __repr__(self) -> str:
+        return f"ComputationDef({self.name!r}, {self._algo.name})"
+
+    def _simple_repr(self) -> dict:
+        from pydcop_tpu.utils.simple_repr import _CLASS_KEY, _MODULE_KEY, simple_repr
+
+        return {
+            _CLASS_KEY: type(self).__qualname__,
+            _MODULE_KEY: type(self).__module__,
+            "node": simple_repr(self._node)
+            if isinstance(self._node, SimpleRepr)
+            else {"name": self._node.name},
+            "algo": simple_repr(self._algo),
+        }
+
+    @classmethod
+    def _from_repr(cls, r: dict):
+        from pydcop_tpu.utils.simple_repr import from_repr
+
+        return cls(from_repr(r["node"]), from_repr(r["algo"]))
+
+
+# ---------------------------------------------------------------------------
+# Module loading
+# ---------------------------------------------------------------------------
+
+
+def load_algorithm_module(name: str):
+    """Import an algorithm plugin module by name."""
+    try:
+        return importlib.import_module(f"{_ALGO_PACKAGE}.{name}")
+    except ImportError as e:
+        raise AlgorithmDefError(
+            f"Could not load algorithm {name!r}: {e}; available: "
+            f"{list_available_algorithms()}"
+        )
+
+
+def list_available_algorithms() -> List[str]:
+    """All algorithm plugin modules in this package (any module defining
+    GRAPH_TYPE or solve_host)."""
+    import pydcop_tpu.algorithms as pkg
+
+    names = []
+    for info in pkgutil.iter_modules(pkg.__path__):
+        if info.name.startswith("_"):
+            continue
+        mod = importlib.import_module(f"{_ALGO_PACKAGE}.{info.name}")
+        if hasattr(mod, "GRAPH_TYPE"):
+            names.append(info.name)
+    return sorted(names)

@@ -1,0 +1,88 @@
+"""Embedding API (reference: ``pydcop/infrastructure/run.py:solve``).
+
+``solve()`` is the one-call in-process entry point: build / compile the
+problem, run the selected algorithm on the TPU batched engine (or its
+host path for DPOP/SyncBB-style algorithms), and return the result dict
+with the same keys the reference's CLI/JSON surface exposes:
+``{assignment, cost, cycle, msg_count, msg_size, status, time}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Mapping, Optional, Union
+
+from pydcop_tpu.algorithms import (
+    AlgorithmDef,
+    load_algorithm_module,
+    prepare_algo_params,
+)
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+from pydcop_tpu.ops.compile import compile_dcop
+
+
+def solve(
+    dcop: Union[DCOP, str],
+    algo: Union[str, AlgorithmDef],
+    algo_params: Optional[Mapping[str, Any]] = None,
+    rounds: int = 200,
+    timeout: Optional[float] = None,
+    seed: int = 0,
+    convergence_chunks: int = 0,
+    chunk_size: int = 64,
+) -> Dict[str, Any]:
+    """Solve a DCOP and return the result dict.
+
+    Parameters mirror the reference ``solve()``: the dcop (object or
+    yaml path), the algorithm name (or AlgorithmDef carrying params),
+    algorithm parameters, and stop conditions (round budget and/or
+    wall-clock timeout).
+
+    >>> result = solve(my_dcop, "dsa", {"variant": "B"}, rounds=100)
+    >>> result["assignment"], result["cost"]
+    """
+    if isinstance(dcop, (str, list, tuple)):
+        dcop = load_dcop_from_file(dcop)
+
+    if isinstance(algo, AlgorithmDef):
+        algo_name = algo.algo
+        params_in = dict(algo.params)
+        if algo_params:
+            params_in.update(algo_params)
+    else:
+        algo_name = algo
+        params_in = dict(algo_params or {})
+
+    module = load_algorithm_module(algo_name)
+    params = prepare_algo_params(params_in, module.algo_params)
+
+    if hasattr(module, "solve_host"):
+        # exact / sequential algorithms (DPOP, SyncBB)
+        return module.solve_host(dcop, params, timeout=timeout)
+
+    problem = compile_dcop(dcop)
+    from pydcop_tpu.engine.batched import run_batched
+
+    result = run_batched(
+        problem,
+        module,
+        params,
+        rounds=rounds,
+        seed=seed,
+        timeout=timeout,
+        chunk_size=chunk_size,
+        convergence_chunks=convergence_chunks,
+    )
+    return {
+        "assignment": result.best_assignment,
+        "cost": result.best_cost,
+        "final_assignment": result.assignment,
+        "final_cost": result.cost,
+        "cycle": result.cycles,
+        "msg_count": result.messages,
+        "msg_size": result.messages,  # 1 unit per logical message
+        "status": result.status,
+        "time": result.time,
+        "cost_trace": result.cost_trace.tolist(),
+    }

@@ -1,0 +1,112 @@
+"""Command-line interface (reference: ``pydcop/pydcop.py``).
+
+``python -m pydcop_tpu <command> ...`` with one module per subcommand
+under ``pydcop_tpu/commands/`` — the same layout as the reference CLI:
+solve, run, graph, distribute, generate, batch, consolidate,
+replica_dist, orchestrator, agent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import logging
+import logging.config
+import os
+import sys
+
+COMMANDS = [
+    "solve",
+    "run",
+    "graph",
+    "distribute",
+    "generate",
+    "batch",
+    "consolidate",
+    "replica_dist",
+]
+
+
+def _add_global_args(parser: argparse.ArgumentParser, suppress: bool) -> None:
+    """Global options accepted both before and after the subcommand.
+
+    At the sub level defaults are SUPPRESSed so a flag given before the
+    subcommand is not clobbered by the subparser's default.
+    """
+
+    def d(value):
+        return argparse.SUPPRESS if suppress else value
+
+    parser.add_argument(
+        "-v", "--verbosity", type=int, default=d(0), help="0..3"
+    )
+    parser.add_argument(
+        "--log", type=str, default=d(None), help="logging config file"
+    )
+    parser.add_argument(
+        "-t", "--timeout", type=float, default=d(None),
+        help="wall-clock timeout (seconds)",
+    )
+    parser.add_argument(
+        "--output", type=str, default=d(None),
+        help="write the result JSON to this file as well as stdout",
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pydcop_tpu",
+        description="TPU-native DCOP solving (pyDcop-capability CLI)",
+    )
+    _add_global_args(parser, suppress=False)
+    parser.add_argument("--version", action="version", version="0.1.0")
+    global_parent = argparse.ArgumentParser(add_help=False)
+    _add_global_args(global_parent, suppress=True)
+    sub = parser.add_subparsers(dest="command", required=True)
+    for name in COMMANDS:
+        mod = importlib.import_module(f"pydcop_tpu.commands.{name}")
+        mod.set_parser(_SubparsersProxy(sub, [global_parent]))
+    return parser
+
+
+class _SubparsersProxy:
+    """Injects the global-options parent into every add_parser call."""
+
+    def __init__(self, sub, parents):
+        self._sub = sub
+        self._parents = parents
+
+    def add_parser(self, *args, **kwargs):
+        parents = list(kwargs.pop("parents", [])) + self._parents
+        return self._sub.add_parser(*args, parents=parents, **kwargs)
+
+
+def _apply_platform_override() -> None:
+    """Honor PYDCOP_TPU_PLATFORM (cpu|axon|tpu|...).
+
+    The axon TPU plugin on this image overrides ``JAX_PLATFORMS``, so
+    the pin must go through ``jax.config`` before any backend init.
+    """
+    plat = os.environ.get("PYDCOP_TPU_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
+def main(argv=None) -> int:
+    _apply_platform_override()
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    levels = [logging.ERROR, logging.WARNING, logging.INFO, logging.DEBUG]
+    logging.basicConfig(
+        level=levels[min(args.verbosity, 3)],
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    if args.log:
+        logging.config.fileConfig(args.log, disable_existing_loggers=False)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""Shared CLI helpers: algo-param parsing, metrics/result output."""
+
+from __future__ import annotations
+
+import csv
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def parse_algo_params(items: List[str]) -> Dict[str, str]:
+    """Parse repeated ``name:value`` CLI parameters."""
+    out: Dict[str, str] = {}
+    for item in items:
+        if ":" not in item:
+            raise SystemExit(
+                f"--algo_params expects name:value, got {item!r}"
+            )
+        name, value = item.split(":", 1)
+        out[name.strip()] = value.strip()
+    return out
+
+
+def add_collect_arguments(parser) -> None:
+    parser.add_argument(
+        "--collect_on",
+        choices=["cycle_change", "value_change", "period"],
+        default="cycle_change",
+        help="metric collection mode",
+    )
+    parser.add_argument(
+        "--period", type=float, default=None,
+        help="collection period (seconds), for --collect_on period",
+    )
+    parser.add_argument(
+        "--run_metrics", type=str, default=None,
+        help="write per-cycle metrics to this CSV file",
+    )
+    parser.add_argument(
+        "--end_metrics", type=str, default=None,
+        help="append end-of-run metrics to this CSV file",
+    )
+
+
+def write_metrics(args, result: Dict[str, Any]) -> None:
+    trace = result.get("cost_trace") or []
+    if getattr(args, "run_metrics", None):
+        with open(args.run_metrics, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["cycle", "cost"])
+            for i, c in enumerate(trace):
+                w.writerow([i + 1, c])
+    if getattr(args, "end_metrics", None):
+        import os
+
+        exists = os.path.exists(args.end_metrics)
+        with open(args.end_metrics, "a", newline="") as f:
+            w = csv.writer(f)
+            if not exists:
+                w.writerow(
+                    ["status", "cost", "cycle", "msg_count", "time"]
+                )
+            w.writerow(
+                [
+                    result.get("status"),
+                    result.get("cost"),
+                    result.get("cycle"),
+                    result.get("msg_count"),
+                    result.get("time"),
+                ]
+            )
+
+
+def write_result(args, result: Dict[str, Any]) -> None:
+    out = json.dumps(result, indent=2, default=str)
+    print(out)
+    if getattr(args, "output", None):
+        with open(args.output, "w") as f:
+            f.write(out)

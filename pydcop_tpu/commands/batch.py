@@ -1,0 +1,11 @@
+"""``pydcop_tpu batch`` — placeholder, implemented in a later milestone
+(reference: ``pydcop/commands/batch.py``)."""
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser("batch", help="(not yet implemented)")
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    raise SystemExit("batch: not yet implemented in this build")

@@ -1,0 +1,11 @@
+"""``pydcop_tpu consolidate`` — placeholder, implemented in a later milestone
+(reference: ``pydcop/commands/consolidate.py``)."""
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser("consolidate", help="(not yet implemented)")
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    raise SystemExit("consolidate: not yet implemented in this build")

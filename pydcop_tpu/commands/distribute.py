@@ -1,0 +1,11 @@
+"""``pydcop_tpu distribute`` — placeholder, implemented in a later milestone
+(reference: ``pydcop/commands/distribute.py``)."""
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser("distribute", help="(not yet implemented)")
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    raise SystemExit("distribute: not yet implemented in this build")

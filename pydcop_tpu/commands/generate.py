@@ -1,0 +1,11 @@
+"""``pydcop_tpu generate`` — placeholder, implemented in a later milestone
+(reference: ``pydcop/commands/generate.py``)."""
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser("generate", help="(not yet implemented)")
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    raise SystemExit("generate: not yet implemented in this build")

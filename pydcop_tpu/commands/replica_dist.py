@@ -1,0 +1,11 @@
+"""``pydcop_tpu replica_dist`` — placeholder, implemented in a later milestone
+(reference: ``pydcop/commands/replica_dist.py``)."""
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser("replica_dist", help="(not yet implemented)")
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    raise SystemExit("replica_dist: not yet implemented in this build")

@@ -1,0 +1,11 @@
+"""``pydcop_tpu run`` — placeholder, implemented in a later milestone
+(reference: ``pydcop/commands/run.py``)."""
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser("run", help="(not yet implemented)")
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    raise SystemExit("run: not yet implemented in this build")

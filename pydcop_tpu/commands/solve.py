@@ -1,0 +1,65 @@
+"""``pydcop_tpu solve`` (reference: ``pydcop/commands/solve.py``).
+
+One-shot solve of a DCOP yaml; prints the result as JSON:
+``{assignment, cost, cycle, msg_count, msg_size, status, time}``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from pydcop_tpu.commands._common import (
+    add_collect_arguments,
+    parse_algo_params,
+    write_metrics,
+    write_result,
+)
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "solve", help="solve a static DCOP on the batched TPU engine"
+    )
+    p.add_argument("dcop_files", nargs="+", help="dcop yaml file(s)")
+    p.add_argument("-a", "--algo", required=True, help="algorithm name")
+    p.add_argument(
+        "-p", "--algo_params", action="append", default=[],
+        metavar="NAME:VALUE", help="algorithm parameter (repeatable)",
+    )
+    p.add_argument(
+        "-d", "--distribution", default="oneagent",
+        help="distribution algorithm or yaml file (capability parity; "
+        "the batched engine solves regardless of placement)",
+    )
+    p.add_argument(
+        "-m", "--mode", choices=["thread", "process", "tpu"],
+        default="tpu", help="execution mode (tpu = batched engine)",
+    )
+    p.add_argument("--rounds", type=int, default=200, help="round budget")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--convergence_chunks", type=int, default=0,
+        help="stop after N unchanged chunks (0 = run all rounds)",
+    )
+    add_collect_arguments(p)
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    from pydcop_tpu.api import solve
+
+    params = parse_algo_params(args.algo_params)
+    result = solve(
+        args.dcop_files if len(args.dcop_files) > 1 else args.dcop_files[0],
+        args.algo,
+        params,
+        rounds=args.rounds,
+        timeout=args.timeout,
+        seed=args.seed,
+        convergence_chunks=args.convergence_chunks,
+    )
+    write_metrics(args, result)
+    result.pop("cost_trace", None)  # keep the printed JSON compact
+    write_result(args, result)
+    return 0

@@ -128,6 +128,13 @@ class DCOP(SimpleRepr):
         missing = set(self.variables) - set(assignment)
         if missing:
             raise ValueError(f"Assignment misses variable(s) {sorted(missing)}")
+        if self.external_variables:
+            full = {
+                name: ev.value
+                for name, ev in self.external_variables.items()
+            }
+            full.update(assignment)
+            assignment = full
         cost = assignment_cost(assignment, self._constraints.values())
         for v in self.variables.values():
             if v.has_cost:

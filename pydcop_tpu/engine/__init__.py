@@ -1,0 +1,1 @@
+from pydcop_tpu.engine.batched import RunResult, run_batched

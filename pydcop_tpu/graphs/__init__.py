@@ -1,0 +1,29 @@
+"""Computation-graph builders (reference: ``pydcop/computations_graph/``).
+
+Each graph model module exports ``GRAPH_NODE_TYPE`` and
+``build_computation_graph(dcop=None, variables=None, constraints=None)``.
+Graph models are loaded by name through :func:`load_graph_module`, the
+same extension seam the reference exposes.
+"""
+
+import importlib
+
+_GRAPH_MODULES = {
+    "constraints_hypergraph",
+    "factor_graph",
+    "pseudotree",
+    "ordered_graph",
+}
+
+
+def load_graph_module(name: str):
+    """Load a computation-graph module by name."""
+    if name not in _GRAPH_MODULES:
+        raise ValueError(
+            f"Unknown graph model {name!r}; available: {sorted(_GRAPH_MODULES)}"
+        )
+    return importlib.import_module(f"pydcop_tpu.graphs.{name}")
+
+
+def list_available_graph_models():
+    return sorted(_GRAPH_MODULES)

@@ -1,0 +1,25 @@
+from pydcop_tpu.ops.compile import (
+    BIG,
+    ArityBucket,
+    CompiledProblem,
+    compile_dcop,
+    decode_assignment,
+    encode_assignment,
+)
+from pydcop_tpu.ops.costs import (
+    local_cost_sweep,
+    neighbor_gather,
+    total_cost,
+)
+
+__all__ = [
+    "BIG",
+    "ArityBucket",
+    "CompiledProblem",
+    "compile_dcop",
+    "decode_assignment",
+    "encode_assignment",
+    "local_cost_sweep",
+    "neighbor_gather",
+    "total_cost",
+]

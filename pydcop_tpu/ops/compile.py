@@ -1,0 +1,364 @@
+"""The problem compiler: DCOP model → static device arrays.
+
+This module is the TPU build's replacement for the reference's
+``NAryMatrixRelation``-as-hot-path design (reference:
+``pydcop/dcop/relations.py`` + per-algorithm numpy loops): the *whole
+problem* is tabulated once, at setup time, into a pytree of index arrays
+and dense cost tables with fully static shapes.  Every algorithm then
+runs as pure jitted functions over this pytree — no Python per message,
+no object dispatch, no dynamic shapes.
+
+Representation
+--------------
+
+All domains are padded to ``d_max``; invalid values carry a ``BIG``
+unary cost so no argmin ever selects them.
+
+Constraints are tabulated over the *padded* domain grid and stored twice:
+
+1. **Flat form** (drives local search + cost evaluation): all tables
+   concatenated into one ``tables_flat: f32[total_cells]``, each
+   constraint addressed by ``offset + Σ_j value_j · stride_j`` with
+   strides in d_max radix.  One directed **edge** per (constraint,
+   scope position); for each edge we precompute its own-position stride
+   and its co-variables' indices/strides, so the per-variable cost sweep
+
+       base_e  = offset_e + Σ_j values[covar_e,j] · costride_e,j
+       sweep_e = tables_flat[base_e + arange(d_max) · stride_e]     # [d]
+       local_cost = segment_sum(sweep_e by edge_var) + unary        # [n, d]
+
+   is two gathers + one segment-sum — a single fused XLA kernel that
+   evaluates *every* variable's full candidate-value cost row
+   simultaneously, for any mix of constraint arities.
+
+2. **Arity-bucketed dense form** (drives Max-Sum marginalization):
+   ``tables: f32[m, d_max, ..., d_max]`` per arity, where the factor
+   min-marginal is computed by broadcast-add of the incoming messages
+   followed by min-reductions (see ``algorithms/maxsum.py``).
+
+Unary constraints and variable value costs are folded into
+``unary: f32[n_vars, d_max]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Variable
+from pydcop_tpu.dcop.relations import RelationProtocol
+
+# Cost assigned to padded (invalid) domain values; large enough to never
+# be selected, small enough to leave f32 headroom when summed.
+BIG = 1e9
+
+# Guard: dense tabulation over padded domains is d_max**arity cells.
+MAX_ARITY = 6
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ArityBucket:
+    """Dense tables for all constraints of one arity.
+
+    tables: f32[m, d_max^k] reshaped to [m, d_max, ..., d_max]
+    scopes: i32[m, k] — variable index per scope position
+    edge_slot: i32[m, k] — global edge index of (constraint, position)
+    """
+
+    tables: jax.Array
+    scopes: jax.Array
+    edge_slot: jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class CompiledProblem:
+    """A DCOP compiled to device arrays.  See module docstring.
+
+    Static (hashable, hashed per jit-cache lookup) metadata lives in
+    ``meta`` fields marked static; array leaves are jit-traceable.
+    """
+
+    # -- per variable ---------------------------------------------------
+    domain_sizes: jax.Array  # i32[n_vars]
+    unary: jax.Array  # f32[n_vars, d_max]; BIG on padded values
+    init_idx: jax.Array  # i32[n_vars]
+    # -- flat constraint form ------------------------------------------
+    tables_flat: jax.Array  # f32[total_cells]
+    con_offset: jax.Array  # i32[n_con]
+    con_scopes: jax.Array  # i32[n_con, k_max] (0-padded)
+    con_strides: jax.Array  # i32[n_con, k_max] (0-padded)
+    # -- directed edges (constraint, position) -------------------------
+    edge_var: jax.Array  # i32[n_edges]
+    edge_con: jax.Array  # i32[n_edges]
+    edge_offset: jax.Array  # i32[n_edges]
+    edge_stride: jax.Array  # i32[n_edges]
+    edge_covars: jax.Array  # i32[n_edges, k_max-1] (0-padded)
+    edge_costrides: jax.Array  # i32[n_edges, k_max-1] (0-padded)
+    # -- primal-graph neighbor structure -------------------------------
+    neighbors: jax.Array  # i32[n_vars, max_deg] (0-padded)
+    neighbor_mask: jax.Array  # bool[n_vars, max_deg]
+    # -- arity buckets for message-passing ------------------------------
+    buckets: Dict[int, ArityBucket]
+    # -- static metadata ------------------------------------------------
+    var_names: Tuple[str, ...] = dataclasses.field(
+        metadata={"static": True}
+    )
+    domain_labels: Tuple[Tuple[Any, ...], ...] = dataclasses.field(
+        metadata={"static": True}
+    )
+    con_names: Tuple[str, ...] = dataclasses.field(
+        metadata={"static": True}
+    )
+    maximize: bool = dataclasses.field(metadata={"static": True})
+
+    # -- derived sizes (host-side helpers, not traced) ------------------
+
+    @property
+    def n_vars(self) -> int:
+        return self.unary.shape[0]
+
+    @property
+    def d_max(self) -> int:
+        return self.unary.shape[1]
+
+    @property
+    def n_cons(self) -> int:
+        return self.con_offset.shape[0]
+
+    @property
+    def n_edges(self) -> int:
+        return self.edge_var.shape[0]
+
+    @property
+    def max_degree(self) -> int:
+        return self.neighbors.shape[1]
+
+    def var_index(self, name: str) -> int:
+        return self.var_names.index(name)
+
+
+def compile_dcop(dcop: DCOP, dtype=jnp.float32) -> CompiledProblem:
+    """Tabulate and pack a DCOP into a :class:`CompiledProblem`.
+
+    ``max`` objectives are compiled by negating all costs (solvers always
+    minimize); decode/report paths re-negate (see ``total_cost``'s
+    ``sign`` handling in callers).
+    """
+    variables: List[Variable] = list(dcop.variables.values())
+    if not variables:
+        raise ValueError("Cannot compile a DCOP with no variables")
+    var_names = tuple(v.name for v in variables)
+    var_idx = {n: i for i, n in enumerate(var_names)}
+    n_vars = len(variables)
+    d_max = max(len(v.domain) for v in variables)
+    sign = -1.0 if dcop.objective == "max" else 1.0
+
+    ext_values: Dict[str, Any] = {
+        name: ev.value for name, ev in dcop.external_variables.items()
+    }
+
+    domain_sizes = np.array(
+        [len(v.domain) for v in variables], dtype=np.int32
+    )
+    domain_labels = tuple(tuple(v.domain.values) for v in variables)
+
+    # unary: variable value costs + BIG padding
+    unary = np.zeros((n_vars, d_max), dtype=np.float32)
+    for i, v in enumerate(variables):
+        dlen = len(v.domain)
+        if v.has_cost:
+            for k in range(dlen):
+                unary[i, k] = sign * v.cost_for_val(v.domain[k])
+        unary[i, dlen:] = BIG
+
+    # initial values: declared initial_value, else 0
+    init_idx = np.zeros(n_vars, dtype=np.int32)
+    for i, v in enumerate(variables):
+        if v.initial_value is not None:
+            init_idx[i] = v.domain.index(v.initial_value)
+
+    # -- tabulate constraints ------------------------------------------
+    # External variables are fixed at their current value (sliced out);
+    # unary results fold into `unary`.
+    multi_cons: List[Tuple[str, List[int], np.ndarray]] = []
+    for c in dcop.constraints.values():
+        scope_ext = [n for n in c.scope_names if n in ext_values]
+        if scope_ext:
+            c = c.slice({n: ext_values[n] for n in scope_ext})
+        scope = [n for n in c.scope_names]
+        if len(scope) == 0:
+            continue  # fully external constraint: constant, ignore
+        if len(scope) > MAX_ARITY:
+            raise ValueError(
+                f"Constraint {c.name} has arity {len(scope)} > "
+                f"MAX_ARITY={MAX_ARITY}; dense tabulation would need "
+                f"{d_max}^{len(scope)} cells"
+            )
+        table = _tabulate_padded(c, d_max) * sign
+        if len(scope) == 1:
+            i = var_idx[scope[0]]
+            dlen = int(domain_sizes[i])
+            unary[i, :dlen] += table[:dlen]
+        else:
+            multi_cons.append(
+                (c.name, [var_idx[n] for n in scope], table)
+            )
+
+    con_names = tuple(name for name, _, _ in multi_cons)
+    n_cons = len(multi_cons)
+    k_max = max((len(s) for _, s, _ in multi_cons), default=2)
+    k_max = max(k_max, 2)
+
+    # flat form + edges
+    offsets = np.zeros(n_cons, dtype=np.int32)
+    con_scopes = np.zeros((n_cons, k_max), dtype=np.int32)
+    con_strides = np.zeros((n_cons, k_max), dtype=np.int32)
+    flat_parts: List[np.ndarray] = []
+    total = 0
+    edge_rows: List[Tuple[int, int, int, int, List[int], List[int]]] = []
+    # edge_rows: (var, con, offset, stride, covars, costrides)
+    edge_slot_per_con: List[List[int]] = []
+    n_edges = 0
+    for ci, (name, scope, table) in enumerate(multi_cons):
+        k = len(scope)
+        offsets[ci] = total
+        strides = [d_max ** (k - 1 - j) for j in range(k)]
+        con_scopes[ci, :k] = scope
+        con_strides[ci, :k] = strides
+        flat_parts.append(table.reshape(-1))
+        slots = []
+        for p in range(k):
+            covars = [scope[q] for q in range(k) if q != p]
+            costr = [strides[q] for q in range(k) if q != p]
+            edge_rows.append(
+                (scope[p], ci, total, strides[p], covars, costr)
+            )
+            slots.append(n_edges)
+            n_edges += 1
+        edge_slot_per_con.append(slots)
+        total += table.size
+    tables_flat = (
+        np.concatenate(flat_parts)
+        if flat_parts
+        else np.zeros(1, dtype=np.float32)
+    )
+
+    edge_var = np.zeros(max(n_edges, 1), dtype=np.int32)
+    edge_con = np.zeros(max(n_edges, 1), dtype=np.int32)
+    edge_offset = np.zeros(max(n_edges, 1), dtype=np.int32)
+    edge_stride = np.zeros(max(n_edges, 1), dtype=np.int32)
+    edge_covars = np.zeros((max(n_edges, 1), k_max - 1), dtype=np.int32)
+    edge_costrides = np.zeros((max(n_edges, 1), k_max - 1), dtype=np.int32)
+    for e, (v, ci, off, st, covars, costr) in enumerate(edge_rows):
+        edge_var[e] = v
+        edge_con[e] = ci
+        edge_offset[e] = off
+        edge_stride[e] = st
+        edge_covars[e, : len(covars)] = covars
+        edge_costrides[e, : len(costr)] = costr
+
+    # primal neighbors (padded)
+    neigh_sets: List[set] = [set() for _ in range(n_vars)]
+    for _, scope, _ in multi_cons:
+        for a in scope:
+            for b in scope:
+                if a != b:
+                    neigh_sets[a].add(b)
+    max_deg = max((len(s) for s in neigh_sets), default=1)
+    max_deg = max(max_deg, 1)
+    neighbors = np.zeros((n_vars, max_deg), dtype=np.int32)
+    neighbor_mask = np.zeros((n_vars, max_deg), dtype=bool)
+    for i, s in enumerate(neigh_sets):
+        lst = sorted(s)
+        neighbors[i, : len(lst)] = lst
+        neighbor_mask[i, : len(lst)] = True
+
+    # arity buckets
+    by_arity: Dict[int, List[int]] = {}
+    for ci, (_, scope, _) in enumerate(multi_cons):
+        by_arity.setdefault(len(scope), []).append(ci)
+    buckets: Dict[int, ArityBucket] = {}
+    for k, cons in sorted(by_arity.items()):
+        m = len(cons)
+        btables = np.zeros((m,) + (d_max,) * k, dtype=np.float32)
+        bscopes = np.zeros((m, k), dtype=np.int32)
+        bslots = np.zeros((m, k), dtype=np.int32)
+        for bi, ci in enumerate(cons):
+            btables[bi] = multi_cons[ci][2]
+            bscopes[bi] = multi_cons[ci][1]
+            bslots[bi] = edge_slot_per_con[ci]
+        buckets[k] = ArityBucket(
+            tables=jnp.asarray(btables, dtype=dtype),
+            scopes=jnp.asarray(bscopes),
+            edge_slot=jnp.asarray(bslots),
+        )
+
+    return CompiledProblem(
+        domain_sizes=jnp.asarray(domain_sizes),
+        unary=jnp.asarray(unary, dtype=dtype),
+        init_idx=jnp.asarray(init_idx),
+        tables_flat=jnp.asarray(tables_flat, dtype=dtype),
+        con_offset=jnp.asarray(offsets),
+        con_scopes=jnp.asarray(con_scopes),
+        con_strides=jnp.asarray(con_strides),
+        edge_var=jnp.asarray(edge_var),
+        edge_con=jnp.asarray(edge_con),
+        edge_offset=jnp.asarray(edge_offset),
+        edge_stride=jnp.asarray(edge_stride),
+        edge_covars=jnp.asarray(edge_covars),
+        edge_costrides=jnp.asarray(edge_costrides),
+        neighbors=jnp.asarray(neighbors),
+        neighbor_mask=jnp.asarray(neighbor_mask),
+        buckets=buckets,
+        var_names=var_names,
+        domain_labels=domain_labels,
+        con_names=con_names,
+        maximize=dcop.objective == "max",
+    )
+
+
+def _tabulate_padded(c: RelationProtocol, d_max: int) -> np.ndarray:
+    """Dense table of a constraint over the padded domain grid.
+
+    Cells involving padded values are 0 — they are unreachable as long
+    as values stay in-domain (guaranteed by the BIG unary padding).
+    """
+    m = c.as_matrix()
+    k = m.arity
+    padded = np.zeros((d_max,) * k, dtype=np.float32)
+    padded[tuple(slice(0, s) for s in m.shape)] = m.matrix
+    return padded
+
+
+def encode_assignment(
+    problem: CompiledProblem, assignment: Mapping[str, Any]
+) -> jnp.ndarray:
+    """Assignment dict → i32[n_vars] of domain indices."""
+    idx = np.zeros(problem.n_vars, dtype=np.int32)
+    for i, name in enumerate(problem.var_names):
+        labels = problem.domain_labels[i]
+        val = assignment[name]
+        try:
+            idx[i] = labels.index(val)
+        except ValueError:
+            # tolerate str-typed values (e.g. parsed CLI input)
+            idx[i] = [str(l) for l in labels].index(str(val))
+    return jnp.asarray(idx)
+
+
+def decode_assignment(
+    problem: CompiledProblem, values: jax.Array
+) -> Dict[str, Any]:
+    """i32[n_vars] of domain indices → assignment dict."""
+    vals = np.asarray(values)
+    return {
+        name: problem.domain_labels[i][int(vals[i])]
+        for i, name in enumerate(problem.var_names)
+    }

@@ -3,14 +3,20 @@
 Multi-chip sharding logic is validated without TPU hardware via
 ``xla_force_host_platform_device_count`` (the driver separately dry-runs
 the multi-chip path through ``__graft_entry__.dryrun_multichip``).
+
+Note: on this image the ``axon`` TPU plugin overrides the
+``JAX_PLATFORMS`` env var, so the CPU pin must go through
+``jax.config.update`` before any backend is initialized.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

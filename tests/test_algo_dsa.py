@@ -1,0 +1,123 @@
+"""DSA on the batched engine: functional tests on known-optimum problems."""
+
+import jax
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import (
+    AlgorithmDefError,
+    load_algorithm_module,
+    prepare_algo_params,
+)
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.ops.compile import compile_dcop
+
+
+def coloring_ring(n=10, colors=3):
+    d = Domain("colors", "", list(range(colors)))
+    dcop = DCOP(f"ring{n}")
+    vs = [Variable(f"v{i}", d) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    return dcop
+
+
+def test_param_validation():
+    mod = load_algorithm_module("dsa")
+    params = prepare_algo_params({"variant": "A"}, mod.algo_params)
+    assert params["variant"] == "A"
+    assert params["probability"] == 0.7
+    with pytest.raises(AlgorithmDefError):
+        prepare_algo_params({"variant": "Z"}, mod.algo_params)
+    with pytest.raises(AlgorithmDefError):
+        prepare_algo_params({"nope": 1}, mod.algo_params)
+
+
+def test_dsa_solves_ring_coloring():
+    result = solve(coloring_ring(10, 3), "dsa", rounds=150, seed=3)
+    assert result["cost"] == 0.0
+    # proper coloring
+    a = result["assignment"]
+    for i in range(10):
+        assert a[f"v{i}"] != a[f"v{(i + 1) % 10}"]
+    assert result["cycle"] == 150
+    assert result["msg_count"] == 150 * 2 * 10  # each var has 2 neighbors
+    assert result["status"] == "finished"
+
+
+@pytest.mark.parametrize("variant", ["A", "B", "C"])
+def test_dsa_variants_reduce_cost(variant):
+    dcop = coloring_ring(12, 3)
+    result = solve(
+        dcop, "dsa", {"variant": variant, "probability": 0.5},
+        rounds=120, seed=1,
+    )
+    trace = np.asarray(result["cost_trace"])
+    assert result["best_cost" if False else "cost"] <= trace[0]
+    assert result["cost"] <= 1.0  # near-optimal on an easy ring
+
+
+def test_dsa_deterministic_given_seed():
+    dcop = coloring_ring(8, 3)
+    r1 = solve(dcop, "dsa", rounds=50, seed=7)
+    r2 = solve(dcop, "dsa", rounds=50, seed=7)
+    assert r1["assignment"] == r2["assignment"]
+    assert r1["cost"] == r2["cost"]
+
+
+def test_dsa_convergence_stop():
+    # 2-coloring a path converges quickly and then never changes
+    d = Domain("c", "", [0, 1])
+    dcop = DCOP("path")
+    vs = [Variable(f"v{i}", d) for i in range(4)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(3):
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}", f"1 if v{i} == v{i+1} else 0", vs)
+        )
+    result = solve(
+        dcop, "dsa", {"variant": "B"}, rounds=5000,
+        chunk_size=16, convergence_chunks=2, seed=0,
+    )
+    assert result["cost"] == 0.0
+    assert result["status"] == "converged"
+    assert result["cycle"] < 5000
+
+
+def test_dsa_max_mode():
+    # maximize disagreement: optimum = all neighbors different
+    d = Domain("c", "", [0, 1, 2])
+    dcop = DCOP("max", objective="max")
+    vs = [Variable(f"v{i}", d) for i in range(6)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(5):
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}", f"1 if v{i} != v{i+1} else 0", vs)
+        )
+    result = solve(dcop, "dsa", rounds=100, seed=0)
+    assert result["cost"] == 5.0  # max objective reported in native sign
+
+
+def test_declared_initial_values():
+    d = Domain("c", "", [0, 1, 2])
+    dcop = DCOP("init")
+    vs = [Variable(f"v{i}", d, initial_value=2) for i in range(4)]
+    for v in vs:
+        dcop.add_variable(v)
+    dcop.add_constraint(constraint_from_str("c", "v0 + v1 + v2 + v3", vs))
+    problem = compile_dcop(dcop)
+    mod = load_algorithm_module("dsa")
+    state = mod.init_state(
+        problem, jax.random.PRNGKey(0), {"initial": "declared"}
+    )
+    assert np.asarray(state["values"]).tolist() == [2, 2, 2, 2]

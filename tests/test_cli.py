@@ -1,0 +1,112 @@
+"""CLI end-to-end tests: spawn the real CLI as a subprocess and parse
+its JSON output (the reference's ``tests/dcop_cli`` strategy)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+INSTANCES = Path(__file__).resolve().parent / "instances"
+
+CLI_ENV = {
+    **os.environ,
+    # keep any pre-existing entries (e.g. the TPU plugin's site dir)
+    "PYTHONPATH": str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    # CLI tests run on CPU: pin through the conftest-documented override
+    "PYDCOP_TPU_PLATFORM": "cpu",
+}
+
+
+def run_cli(*args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=CLI_ENV,
+        cwd=str(REPO),
+    )
+
+
+@pytest.fixture(scope="module")
+def ring_yaml(tmp_path_factory):
+    p = tmp_path_factory.mktemp("instances") / "ring6.yaml"
+    lines = [
+        "name: ring6",
+        "objective: min",
+        "domains:",
+        "  colors: {values: [R, G, B]}",
+        "variables:",
+    ]
+    for i in range(6):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for i in range(6):
+        j = (i + 1) % 6
+        lines.append(f"  c{i}:")
+        lines.append("    type: intention")
+        lines.append(f"    function: 1 if v{i} == v{j} else 0")
+    lines.append("agents: [a0, a1, a2, a3, a4, a5]")
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def test_solve_command(ring_yaml):
+    r = run_cli(
+        "solve", "--algo", "dsa", "--rounds", "100",
+        "--seed", "2", ring_yaml,
+    )
+    assert r.returncode == 0, r.stderr
+    result = json.loads(r.stdout)
+    assert result["cost"] == 0.0
+    assert result["status"] == "finished"
+    assert set(result["assignment"]) == {f"v{i}" for i in range(6)}
+
+
+def test_solve_algo_params_and_output(ring_yaml, tmp_path):
+    out = tmp_path / "result.json"
+    metrics = tmp_path / "run.csv"
+    r = run_cli(
+        "solve", "--algo", "dsa",
+        "-p", "variant:A", "-p", "probability:0.9",
+        "--rounds", "50", "--output", str(out),
+        "--run_metrics", str(metrics),
+        ring_yaml,
+    )
+    assert r.returncode == 0, r.stderr
+    saved = json.loads(out.read_text())
+    assert saved["cycle"] == 50
+    lines = metrics.read_text().strip().splitlines()
+    assert lines[0] == "cycle,cost"
+    assert len(lines) == 51
+
+
+def test_solve_bad_param(ring_yaml):
+    r = run_cli("solve", "--algo", "dsa", "-p", "variant:Z", ring_yaml)
+    assert r.returncode != 0
+    assert "variant" in r.stderr
+
+
+def test_graph_command(ring_yaml):
+    r = run_cli("graph", "--algo", "dsa", ring_yaml)
+    assert r.returncode == 0, r.stderr
+    result = json.loads(r.stdout)
+    assert result["graph"] == "constraints_hypergraph"
+    assert result["nodes"] == 6
+    assert result["links"] == 6
+
+
+def test_solve_multiple_files(ring_yaml, tmp_path):
+    # agents in a separate file, merged with the problem file
+    extra = tmp_path / "extra_agents.yaml"
+    extra.write_text("agents: [b1, b2]\n")
+    r = run_cli(
+        "solve", "--algo", "dsa", "--rounds", "30", ring_yaml, str(extra)
+    )
+    assert r.returncode == 0, r.stderr
+    result = json.loads(r.stdout)
+    assert result["status"] == "finished"

@@ -264,3 +264,22 @@ def test_empty_actions_event_rejected():
 
     with _pytest.raises(ValueError):
         load_scenario("events:\n  - id: e1\n    actions: []\n")
+
+
+def test_solution_cost_with_external_variables():
+    dcop = load_dcop("""
+name: t
+objective: min
+domains:
+  d: {values: [0, 1]}
+variables:
+  x: {domain: d}
+external_variables:
+  e: {domain: d, initial_value: 1}
+constraints:
+  c: {type: intention, function: 10 * x * e}
+agents: [a1]
+""")
+    assert dcop.solution_cost({"x": 1}) == 10
+    dcop.external_variables["e"].value = 0
+    assert dcop.solution_cost({"x": 1}) == 0

@@ -1,0 +1,195 @@
+"""Tests for the computation-graph builders (L2)."""
+
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.graphs import (
+    list_available_graph_models,
+    load_graph_module,
+)
+from pydcop_tpu.graphs import (
+    constraints_hypergraph,
+    factor_graph,
+    ordered_graph,
+    pseudotree,
+)
+
+D = Domain("d", "", [0, 1, 2])
+
+
+def ring_dcop(n=4):
+    """Ring of n variables: v0-v1-...-v(n-1)-v0."""
+    dcop = DCOP("ring")
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}_{j}", f"1 if v{i} == v{j} else 0", vs
+            )
+        )
+    return dcop
+
+
+def test_load_graph_module():
+    assert set(list_available_graph_models()) == {
+        "constraints_hypergraph",
+        "factor_graph",
+        "pseudotree",
+        "ordered_graph",
+    }
+    mod = load_graph_module("factor_graph")
+    assert hasattr(mod, "build_computation_graph")
+    with pytest.raises(ValueError):
+        load_graph_module("nope")
+
+
+def test_constraints_hypergraph():
+    dcop = ring_dcop(4)
+    g = constraints_hypergraph.build_computation_graph(dcop)
+    assert len(g.nodes) == 4
+    n0 = g.node("v0")
+    assert set(n0.neighbors) == {"v1", "v3"}
+    assert {c.name for c in n0.constraints} == {"c0_1", "c3_0"}
+    assert len(g.links) == 4
+
+
+def test_hypergraph_ternary_constraint():
+    dcop = DCOP("t")
+    vs = [Variable(f"v{i}", D) for i in range(3)]
+    for v in vs:
+        dcop.add_variable(v)
+    dcop.add_constraint(constraint_from_str("c", "v0 + v1 + v2", vs))
+    g = constraints_hypergraph.build_computation_graph(dcop)
+    assert set(g.node("v0").neighbors) == {"v1", "v2"}
+    assert len(g.links) == 1
+    assert set(g.links[0].nodes) == {"v0", "v1", "v2"}
+
+
+def test_factor_graph():
+    dcop = ring_dcop(3)
+    g = factor_graph.build_computation_graph(dcop)
+    # 3 variable nodes + 3 factor nodes
+    assert len(g.nodes) == 6
+    var_nodes = [n for n in g.nodes if n.type == "VariableComputationNode"]
+    factor_nodes = [n for n in g.nodes if n.type == "FactorComputationNode"]
+    assert len(var_nodes) == 3 and len(factor_nodes) == 3
+    f = g.node("c0_1")
+    assert set(f.neighbors) == {"v0", "v1"}
+    v = g.node("v0")
+    assert set(v.neighbors) == {"c0_1", "c2_0"}
+    # edges = sum of arities
+    assert len(g.links) == 6
+
+
+def test_pseudotree_ring():
+    dcop = ring_dcop(4)
+    g = pseudotree.build_computation_graph(dcop)
+    assert len(g.roots) == 1
+    root = g.roots[0]
+    assert g.node(root).is_root
+    # every non-root has a parent; tree has n-1 tree edges + 1 back edge
+    tree_edges = [l for l in g.links if l.type == "tree"]
+    back_edges = [l for l in g.links if l.type == "back"]
+    assert len(tree_edges) == 3
+    assert len(back_edges) == 1
+    # pseudo relation is symmetric
+    for l in back_edges:
+        src, tgt = l.source, l.target
+        assert tgt in g.node(src).pseudo_parents
+        assert src in g.node(tgt).pseudo_children
+
+
+def test_pseudotree_branch_property():
+    """Every constraint's scope must lie on one root-to-leaf branch."""
+    import itertools
+    import random
+
+    rnd = random.Random(0)
+    dcop = DCOP("rand")
+    vs = [Variable(f"v{i}", D) for i in range(12)]
+    for v in vs:
+        dcop.add_variable(v)
+    pairs = rnd.sample(list(itertools.combinations(range(12), 2)), 18)
+    for a, b in pairs:
+        dcop.add_constraint(
+            constraint_from_str(f"c{a}_{b}", f"v{a} * v{b}", vs)
+        )
+    g = pseudotree.build_computation_graph(dcop)
+
+    def ancestors(name):
+        out = set()
+        n = g.node(name)
+        while n.parent is not None:
+            out.add(n.parent)
+            n = g.node(n.parent)
+        return out
+
+    for c in dcop.constraints.values():
+        for a, b in itertools.combinations(c.scope_names, 2):
+            assert (
+                a in ancestors(b) or b in ancestors(a)
+            ), f"constraint {c.name}: {a} and {b} not on one branch"
+
+
+def test_pseudotree_explicit_root_and_forest():
+    dcop = ring_dcop(3)
+    # add a disconnected variable pair
+    x, y = Variable("x", D), Variable("y", D)
+    dcop.add_variable(x)
+    dcop.add_variable(y)
+    dcop.add_constraint(constraint_from_str("cxy", "x + y", [x, y]))
+    g = pseudotree.build_computation_graph(dcop, root="v1")
+    assert g.roots[0] == "v1"
+    assert len(g.roots) == 2  # forest: ring component + xy component
+    # separator of a ring leaf contains parent (+ pseudo-parent)
+    for name in ("v0", "v2"):
+        n = g.node(name)
+        if n.is_leaf:
+            assert len(g.separator(name)) == 2
+
+
+def test_pseudotree_dfs_order():
+    dcop = ring_dcop(4)
+    g = pseudotree.build_computation_graph(dcop)
+    order = g.depth_first_order(g.roots[0])
+    assert len(order) == 4
+    assert order[0] == g.roots[0]
+    # parents always appear before children
+    pos = {n: i for i, n in enumerate(order)}
+    for n in order:
+        p = g.node(n).parent
+        if p is not None:
+            assert pos[p] < pos[n]
+
+
+def test_ordered_graph():
+    dcop = ring_dcop(3)
+    g = ordered_graph.build_computation_graph(dcop)
+    assert g.ordering == ["v0", "v1", "v2"]
+    assert g.next_node("v0") == "v1"
+    assert g.next_node("v2") is None
+    assert g.previous_node("v0") is None
+    n1 = g.node("v1")
+    assert n1.position == 1
+    assert set(n1.neighbors) == {"v0", "v2"}
+
+
+def test_ordered_graph_custom_ordering():
+    dcop = ring_dcop(3)
+    g = ordered_graph.build_computation_graph(
+        dcop, ordering=["v2", "v0", "v1"]
+    )
+    assert g.ordering == ["v2", "v0", "v1"]
+    with pytest.raises(ValueError):
+        ordered_graph.build_computation_graph(dcop, ordering=["v0"])
+
+
+def test_density():
+    dcop = ring_dcop(4)
+    g = constraints_hypergraph.build_computation_graph(dcop)
+    assert g.density() == pytest.approx(2 * 4 / (4 * 3))

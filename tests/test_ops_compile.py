@@ -1,0 +1,216 @@
+"""Compiler + cost-kernel tests: device results must match the host
+(model-layer) evaluator exactly — the cost-parity acceptance gate of
+SURVEY.md §7 item 2."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import (
+    Domain,
+    ExternalVariable,
+    Variable,
+    VariableWithCostFunc,
+)
+from pydcop_tpu.dcop.relations import (
+    NAryMatrixRelation,
+    constraint_from_str,
+)
+from pydcop_tpu.ops import (
+    BIG,
+    compile_dcop,
+    decode_assignment,
+    encode_assignment,
+    local_cost_sweep,
+    neighbor_gather,
+    total_cost,
+)
+from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+
+
+def random_dcop(seed, n_vars=8, n_bin=10, n_tern=2, mixed_domains=True):
+    rnd = random.Random(seed)
+    dcop = DCOP(f"rand{seed}")
+    domains = [
+        Domain("d2", "", [0, 1]),
+        Domain("d3", "", ["a", "b", "c"]),
+        Domain("d4", "", [10, 20, 30, 40]),
+    ]
+    vs = []
+    for i in range(n_vars):
+        d = rnd.choice(domains) if mixed_domains else domains[1]
+        if rnd.random() < 0.3:
+            v = VariableWithCostFunc(
+                f"v{i}", d, ExpressionFunction(f"0.5 if v{i} == {d[0]!r} else 0.1")
+            )
+        else:
+            v = Variable(f"v{i}", d)
+        vs.append(v)
+        dcop.add_variable(v)
+    cid = 0
+    for _ in range(n_bin):
+        a, b = rnd.sample(range(n_vars), 2)
+        m = np.round(
+            np.random.RandomState(seed * 100 + cid)
+            .uniform(0, 10, (len(vs[a].domain), len(vs[b].domain))),
+            2,
+        )
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[a], vs[b]], m, name=f"c{cid}")
+        )
+        cid += 1
+    for _ in range(n_tern):
+        a, b, c = rnd.sample(range(n_vars), 3)
+        m = np.round(
+            np.random.RandomState(seed * 100 + cid).uniform(
+                0, 10,
+                (len(vs[a].domain), len(vs[b].domain), len(vs[c].domain)),
+            ),
+            2,
+        )
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[a], vs[b], vs[c]], m, name=f"c{cid}")
+        )
+        cid += 1
+    # a unary constraint too (folds into the unary array)
+    dcop.add_constraint(
+        constraint_from_str("u0", "1.5 if v0 == v0 else 0", vs)
+    )
+    return dcop
+
+
+def rand_assignment(dcop, rnd):
+    return {
+        name: rnd.choice(list(v.domain.values))
+        for name, v in dcop.variables.items()
+    }
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_total_cost_parity_fuzz(seed):
+    dcop = random_dcop(seed)
+    problem = compile_dcop(dcop)
+    rnd = random.Random(seed + 1000)
+    for _ in range(20):
+        a = rand_assignment(dcop, rnd)
+        host = dcop.solution_cost(a)
+        dev = float(total_cost(problem, encode_assignment(problem, a)))
+        assert dev == pytest.approx(host, rel=1e-5), a
+
+
+def test_encode_decode_round_trip():
+    dcop = random_dcop(7)
+    problem = compile_dcop(dcop)
+    rnd = random.Random(42)
+    a = rand_assignment(dcop, rnd)
+    assert decode_assignment(problem, encode_assignment(problem, a)) == a
+
+
+def test_local_cost_sweep_matches_bruteforce():
+    dcop = random_dcop(5)
+    problem = compile_dcop(dcop)
+    rnd = random.Random(5)
+    a = rand_assignment(dcop, rnd)
+    values = encode_assignment(problem, a)
+    sweep = np.asarray(local_cost_sweep(problem, values))
+    for i, name in enumerate(problem.var_names):
+        v = dcop.variables[name]
+        for k, val in enumerate(v.domain.values):
+            mod = dict(a)
+            mod[name] = val
+            # host "local cost": all constraints involving name + v's own cost
+            cost = v.cost_for_val(val) if v.has_cost else 0.0
+            for c in dcop.constraints.values():
+                if name in c.scope_names:
+                    cost += c.get_value_for_assignment(
+                        {n: mod[n] for n in c.scope_names}
+                    )
+            assert sweep[i, k] == pytest.approx(cost, rel=1e-5), (name, val)
+        # padded cells are BIG-ish
+        for k in range(len(v.domain), problem.d_max):
+            assert sweep[i, k] >= BIG / 2
+
+
+def test_max_objective_negates():
+    d = Domain("d", "", [0, 1])
+    dcop = DCOP("m", objective="max")
+    x, y = Variable("x", d), Variable("y", d)
+    dcop.add_variable(x)
+    dcop.add_variable(y)
+    dcop.add_constraint(
+        NAryMatrixRelation([x, y], [[0, 5], [5, 0]], name="c")
+    )
+    problem = compile_dcop(dcop)
+    assert problem.maximize
+    # compiled cost is negated: best (max) assignment has lowest cost
+    best = float(total_cost(problem, encode_assignment(problem, {"x": 0, "y": 1})))
+    worst = float(total_cost(problem, encode_assignment(problem, {"x": 0, "y": 0})))
+    assert best == -5 and worst == 0
+
+
+def test_external_variable_sliced():
+    d = Domain("d", "", [0, 1])
+    dcop = DCOP("e")
+    x = Variable("x", d)
+    e = ExternalVariable("e", d, 1)
+    dcop.add_variable(x)
+    dcop.add_variable(e)
+    dcop.add_constraint(
+        constraint_from_str("c", "10 * x * e", [x, e])
+    )
+    problem = compile_dcop(dcop)
+    assert problem.var_names == ("x",)
+    # with e=1, cost(x=1) = 10 (folded as unary on x)
+    assert float(
+        total_cost(problem, encode_assignment(problem, {"x": 1}))
+    ) == pytest.approx(10)
+
+
+def test_neighbor_gather():
+    dcop = DCOP("n")
+    d = Domain("d", "", [0, 1])
+    vs = [Variable(f"v{i}", d) for i in range(3)]
+    for v in vs:
+        dcop.add_variable(v)
+    dcop.add_constraint(constraint_from_str("c01", "v0 * v1", vs))
+    dcop.add_constraint(constraint_from_str("c12", "v1 * v2", vs))
+    problem = compile_dcop(dcop)
+    q = jnp.asarray([10.0, 20.0, 30.0])
+    g = np.asarray(neighbor_gather(problem, q, fill=-1.0))
+    i0 = problem.var_index("v0")
+    i1 = problem.var_index("v1")
+    row1 = sorted(g[i1].tolist())
+    assert row1 == [10.0, 30.0]
+    assert sorted(g[i0].tolist())[-1] == 20.0  # v0 sees only v1 (+fill)
+
+
+def test_jit_and_pytree():
+    """CompiledProblem must be a valid pytree usable as a jit arg."""
+    dcop = random_dcop(9)
+    problem = compile_dcop(dcop)
+    f = jax.jit(total_cost)
+    rnd = random.Random(0)
+    a = rand_assignment(dcop, rnd)
+    v = encode_assignment(problem, a)
+    assert float(f(problem, v)) == pytest.approx(
+        float(total_cost(problem, v)), rel=1e-6
+    )
+    leaves = jax.tree_util.tree_leaves(problem)
+    assert all(hasattr(l, "shape") for l in leaves)
+
+
+def test_arity_guard():
+    d = Domain("d", "", [0, 1])
+    dcop = DCOP("big")
+    vs = [Variable(f"v{i}", d) for i in range(8)]
+    for v in vs:
+        dcop.add_variable(v)
+    dcop.add_constraint(
+        constraint_from_str("huge", " + ".join(f"v{i}" for i in range(8)), vs)
+    )
+    with pytest.raises(ValueError, match="MAX_ARITY"):
+        compile_dcop(dcop)
