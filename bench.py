@@ -1,0 +1,66 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Workload (north star, BASELINE.md): 10k-variable random graph-coloring
+Max-Sum on the factor graph; metric = logical messages/sec (1 message =
+1 directed-edge update per round, both q and r directions counted).
+
+``vs_baseline`` compares against the single-host CPU baseline recorded
+in BASELINE.md.  The reference (pyDcop) publishes no numbers and cannot
+be installed in this zero-egress image, so the baseline is OUR OWN
+engine pinned to the CPU backend — a far stronger baseline than the
+reference's pure-Python thread runtime (~1e4–1e5 msgs/sec on one host;
+see BASELINE.md for the provenance discussion).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+# Single-host CPU msgs/sec of this same engine/workload, measured on
+# this image (see BASELINE.md "CPU baseline" row; jax CPU backend,
+# 10k vars / 59 980 edges, damping 0.5, steady-state chunks of 256).
+CPU_BASELINE_MSGS_PER_SEC = 3.1e7
+
+
+def main() -> None:
+    import __graft_entry__ as g
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_batched
+    from pydcop_tpu.ops import compile_dcop
+
+    dcop = g._make_coloring_dcop(10000, degree=3, seed=1)
+    problem = compile_dcop(dcop)
+    module = load_algorithm_module("maxsum")
+    params = prepare_algo_params({"damping": 0.5}, module.algo_params)
+
+    # warmup: XLA compile + cache the chunk runner
+    run_batched(problem, module, params, rounds=256, seed=0, chunk_size=256)
+
+    t0 = time.perf_counter()
+    result = run_batched(
+        problem, module, params, rounds=1024, seed=0, chunk_size=256
+    )
+    dt = time.perf_counter() - t0
+    msgs_per_round = module.messages_per_round(problem)
+    msgs_per_sec = msgs_per_round * result.cycles / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "maxsum_msgs_per_sec_10k_coloring",
+                "value": round(msgs_per_sec),
+                "unit": "msgs/sec",
+                "vs_baseline": round(
+                    msgs_per_sec / CPU_BASELINE_MSGS_PER_SEC, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

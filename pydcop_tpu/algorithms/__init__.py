@@ -15,8 +15,12 @@ TPU batched engine (the new execution core — replaces the reference's
 ``build_computation`` thread-per-agent path for solving):
 - ``init_state(problem, key, params) -> state`` — initial state pytree;
   must contain key ``"values"`` (i32[n_vars] domain indices).
-- ``step(problem, state, key, params) -> state`` — ONE synchronous round
-  for every agent simultaneously; pure and jittable.
+- ``step(problem, state, key, params, axis_name=None) -> state`` — ONE
+  synchronous round for every agent simultaneously; pure and jittable.
+  ``axis_name`` is set when running under ``shard_map`` over a mesh —
+  pass it through to the ``pydcop_tpu.ops`` kernels (they psum over it).
+- ``state_specs(problem) -> pytree of PartitionSpec`` (optional) — how
+  the state shards over the mesh; defaults to fully replicated.
 - ``messages_per_round(problem) -> int`` — logical directed messages one
   round represents (the auditable msgs/sec accounting, see BASELINE.md).
 

@@ -68,9 +68,10 @@ def step(
     state: Dict[str, jax.Array],
     key: jax.Array,
     params: Dict[str, Any],
+    axis_name: str = None,
 ) -> Dict[str, jax.Array]:
     values = state["values"]
-    local = local_cost_sweep(problem, values)  # [n, d]
+    local = local_cost_sweep(problem, values, axis_name)  # [n, d]
     n = problem.n_vars
 
     current = jnp.take_along_axis(local, values[:, None], axis=1)[:, 0]

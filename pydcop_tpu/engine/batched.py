@@ -22,6 +22,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
 
 from pydcop_tpu.ops.compile import CompiledProblem, decode_assignment
 from pydcop_tpu.ops.costs import total_cost
@@ -42,10 +44,16 @@ class RunResult:
     cost_trace: np.ndarray  # per-round cost (native sign)
 
 
+# Compiled chunk runners, reused across run_batched calls so repeated
+# runs (warmup/measure, parameter sweeps, chunked loops) don't re-trace.
+# Key: (algo module, chunk len, axis_name, static params, mesh id).
+_RUNNER_CACHE: Dict[Tuple, Callable] = {}
+
+
 def _chunk_runner(
-    algo_step: Callable, n_rounds: int
+    algo_step: Callable, n_rounds: int, axis_name: Optional[str] = None
 ) -> Callable:
-    """Build the jitted scan over ``n_rounds`` rounds.
+    """Build the scan over ``n_rounds`` rounds.
 
     Carry: (state, best_cost, best_values).  Output: per-round cost.
     """
@@ -56,7 +64,7 @@ def _chunk_runner(
             k = jax.random.fold_in(key, i)
             state = algo_step(problem, state, k, params)
             values = state["values"]
-            cost = total_cost(problem, values)
+            cost = total_cost(problem, values, axis_name)
             better = cost < best_cost
             best_cost = jnp.where(better, cost, best_cost)
             best_values = jnp.where(better, values, best_values)
@@ -81,6 +89,7 @@ def run_batched(
     timeout: Optional[float] = None,
     chunk_size: int = 64,
     convergence_chunks: int = 0,
+    mesh=None,
 ) -> RunResult:
     """Run a batched algorithm for up to ``rounds`` rounds.
 
@@ -93,6 +102,12 @@ def run_batched(
     Non-numeric params (e.g. DSA's ``variant``) are baked into the
     compiled step — they must be hashable.  Numeric params are passed as
     arrays so parameter sweeps don't recompile.
+
+    With ``mesh`` set (a 1-D ``jax.sharding.Mesh``), the whole chunk
+    runs under ``shard_map``: constraint/edge arrays and message state
+    are sharded over the mesh, variables replicated, neighbor exchange
+    via ``psum`` (see ``pydcop_tpu.parallel``).  The problem must have
+    been compiled with ``n_shards == mesh size``.
     """
     t0 = time.perf_counter()
     sign = -1.0 if problem.maximize else 1.0
@@ -106,8 +121,28 @@ def run_batched(
         if not isinstance(v, (str, bool)) and v is not None
     }
 
+    axis_name = None
+    if mesh is not None:
+        from pydcop_tpu.parallel.mesh import SHARD_AXIS, shard_problem
+
+        axis_name = SHARD_AXIS
+        problem = shard_problem(problem, mesh)
+
     def algo_step(problem, state, key, dyn):
-        return algo_module.step(problem, state, key, {**static_params, **dyn})
+        return algo_module.step(
+            problem, state, key, {**static_params, **dyn},
+            axis_name=axis_name,
+        )
+
+    cache_key_base = (
+        algo_module.__name__,
+        axis_name,
+        tuple(sorted(static_params.items())),
+        tuple(sorted(dyn_params)),
+        id(mesh) if mesh is not None else None,
+        tuple(sorted(problem.buckets)),  # pspecs structure
+        problem.n_shards,
+    )
 
     key = jax.random.PRNGKey(seed)
     k_init, k_run = jax.random.split(key)
@@ -117,7 +152,41 @@ def run_batched(
     best_values = state["values"]
     best_cost = total_cost(problem, best_values)
 
-    runner = jax.jit(_chunk_runner(algo_step, min(chunk_size, rounds)))
+    def make_runner(n: int):
+        cache_key = cache_key_base + (n,)
+        if cache_key in _RUNNER_CACHE:
+            return _RUNNER_CACHE[cache_key]
+        fn = _chunk_runner(algo_step, n, axis_name)
+        if mesh is None:
+            runner = jax.jit(fn)
+        else:
+            from pydcop_tpu.parallel.mesh import problem_pspecs, state_pspecs
+
+            pspecs = problem_pspecs(problem)
+            sspecs = state_pspecs(algo_module, problem)
+            dyn_specs = {k: P() for k in dyn_params}
+            sharded = jax.shard_map(
+                fn,
+                mesh=mesh,
+                in_specs=(pspecs, sspecs, P(), dyn_specs, P(), P()),
+                out_specs=(sspecs, P(), P(), P()),
+                check_vma=False,
+            )
+            runner = jax.jit(sharded)
+        _RUNNER_CACHE[cache_key] = runner
+        return runner
+
+    if mesh is not None:
+        from pydcop_tpu.parallel.mesh import state_pspecs
+
+        sspecs = state_pspecs(algo_module, problem)
+        state = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            state,
+            sspecs,
+        )
+
+    runner = make_runner(min(chunk_size, rounds))
     small_runner = None  # for the tail chunk, compiled lazily
 
     traces = []
@@ -132,10 +201,7 @@ def run_batched(
             r = runner
         else:
             if small_runner is None or small_runner[0] != this_chunk:
-                small_runner = (
-                    this_chunk,
-                    jax.jit(_chunk_runner(algo_step, this_chunk)),
-                )
+                small_runner = (this_chunk, make_runner(this_chunk))
             r = small_runner[1]
         k_chunk = jax.random.fold_in(k_run, done)
         state, best_cost, best_values, costs = r(

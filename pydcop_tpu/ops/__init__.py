@@ -9,6 +9,7 @@ from pydcop_tpu.ops.compile import (
 from pydcop_tpu.ops.costs import (
     local_cost_sweep,
     neighbor_gather,
+    segment_sum_edges,
     total_cost,
 )
 
@@ -21,5 +22,6 @@ __all__ = [
     "encode_assignment",
     "local_cost_sweep",
     "neighbor_gather",
+    "segment_sum_edges",
     "total_cost",
 ]

@@ -117,6 +117,12 @@ class CompiledProblem:
         metadata={"static": True}
     )
     maximize: bool = dataclasses.field(metadata={"static": True})
+    # shard-major layout: constraint/edge/bucket arrays are contiguous
+    # per shard with equal sizes, so axis 0 shards evenly over a mesh
+    n_shards: int = dataclasses.field(metadata={"static": True})
+    # directed edges belonging to real (non-ghost-padding) constraints —
+    # the auditable message count (BASELINE.md accounting rule)
+    n_real_edges: int = dataclasses.field(metadata={"static": True})
 
     # -- derived sizes (host-side helpers, not traced) ------------------
 
@@ -144,12 +150,22 @@ class CompiledProblem:
         return self.var_names.index(name)
 
 
-def compile_dcop(dcop: DCOP, dtype=jnp.float32) -> CompiledProblem:
+def compile_dcop(
+    dcop: DCOP, dtype=jnp.float32, n_shards: int = 1
+) -> CompiledProblem:
     """Tabulate and pack a DCOP into a :class:`CompiledProblem`.
 
     ``max`` objectives are compiled by negating all costs (solvers always
     minimize); decode/report paths re-negate (see ``total_cost``'s
     ``sign`` handling in callers).
+
+    With ``n_shards > 1`` the constraint list is laid out shard-major:
+    constraints are balanced round-robin per arity across shards and
+    each shard's per-arity bucket is padded to equal size with zero
+    "ghost" constraints (scope = variable 0, all-zero table — they
+    contribute nothing to costs or messages).  Axis 0 of every
+    constraint/edge/bucket array then splits evenly over a mesh axis,
+    which is what ``engine.run_batched(mesh=...)`` shards.
     """
     variables: List[Variable] = list(dcop.variables.values())
     if not variables:
@@ -210,6 +226,10 @@ def compile_dcop(dcop: DCOP, dtype=jnp.float32) -> CompiledProblem:
             multi_cons.append(
                 (c.name, [var_idx[n] for n in scope], table)
             )
+
+    n_real_edges = sum(len(scope) for _, scope, _ in multi_cons)
+    if n_shards > 1:
+        multi_cons = _shard_major_layout(multi_cons, n_shards, d_max)
 
     con_names = tuple(name for name, _, _ in multi_cons)
     n_cons = len(multi_cons)
@@ -321,7 +341,46 @@ def compile_dcop(dcop: DCOP, dtype=jnp.float32) -> CompiledProblem:
         domain_labels=domain_labels,
         con_names=con_names,
         maximize=dcop.objective == "max",
+        n_shards=n_shards,
+        n_real_edges=n_real_edges,
     )
+
+
+def _shard_major_layout(multi_cons, n_shards: int, d_max: int):
+    """Reorder constraints shard-major with equal per-shard, per-arity
+    bucket sizes (padding with zero ghost constraints).
+
+    Guarantees after reordering: for every arity k, shard s owns bucket
+    rows [s·m_k, (s+1)·m_k); edges (emitted in constraint order) are
+    contiguous per shard with equal counts.
+    """
+    import math
+
+    by_arity: Dict[int, List[Tuple[str, List[int], np.ndarray]]] = {}
+    for item in multi_cons:
+        by_arity.setdefault(len(item[1]), []).append(item)
+
+    shards: List[List[Tuple[str, List[int], np.ndarray]]] = [
+        [] for _ in range(n_shards)
+    ]
+    for k in sorted(by_arity):
+        items = by_arity[k]
+        per_shard = math.ceil(len(items) / n_shards)
+        target = per_shard * n_shards
+        for i in range(target - len(items)):
+            ghost_table = np.zeros((d_max,) * k, dtype=np.float32)
+            items.append((f"__ghost_{k}_{i}", [0] * k, ghost_table))
+        # round-robin keeps real constraints balanced across shards
+        for i, item in enumerate(items):
+            shards[i % n_shards].append(item)
+
+    # shard-major order; within a shard keep arity grouping stable
+    # (items were appended arity-by-arity, so each shard's list is
+    # already arity-sorted)
+    out: List[Tuple[str, List[int], np.ndarray]] = []
+    for s in shards:
+        out.extend(s)
+    return out
 
 
 def _tabulate_padded(c: RelationProtocol, d_max: int) -> np.ndarray:

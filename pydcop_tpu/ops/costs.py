@@ -1,8 +1,11 @@
 """Core jitted cost kernels over a :class:`CompiledProblem`.
 
-These three functions are the hot path shared by the whole local-search
-family (DSA/A-DSA, MGM/MGM-2, DBA/GDBA) and by cost reporting:
+These functions are the hot path shared by the whole local-search
+family (DSA/A-DSA, MGM/MGM-2, DBA/GDBA), Max-Sum's variable-side
+aggregation, and cost reporting:
 
+- :func:`segment_sum_edges` — sum a per-edge quantity into per-variable
+  rows (+ ``psum`` across the mesh when sharded).
 - :func:`local_cost_sweep` — every variable's full candidate-value cost
   row under the current assignment (the batched equivalent of the
   reference's per-agent ``compute_cost`` loops).
@@ -11,11 +14,15 @@ family (DSA/A-DSA, MGM/MGM-2, DBA/GDBA) and by cost reporting:
   primal-graph neighbor (the batched equivalent of neighbor messages).
 
 All are pure, shape-static, and fuse into a handful of XLA kernels
-(gathers + segment-sum).  No pallas needed here: the ops are
-bandwidth-bound gathers XLA already handles well on TPU.
+(gathers + segment-sum).  When ``axis_name`` is given they are running
+inside ``shard_map`` with the problem's edge/constraint arrays sharded
+over that mesh axis; the only collective is a ``psum`` of the
+[n_vars, d] (or scalar) accumulator, which rides ICI.
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -23,8 +30,24 @@ import jax.numpy as jnp
 from pydcop_tpu.ops.compile import CompiledProblem
 
 
+def segment_sum_edges(
+    problem: CompiledProblem,
+    per_edge: jax.Array,
+    axis_name: Optional[str] = None,
+) -> jax.Array:
+    """Sum per-edge rows into per-variable rows: [E, ...] → [n_vars, ...]."""
+    out = jax.ops.segment_sum(
+        per_edge, problem.edge_var, num_segments=problem.n_vars
+    )
+    if axis_name is not None:
+        out = jax.lax.psum(out, axis_name)
+    return out
+
+
 def local_cost_sweep(
-    problem: CompiledProblem, values: jax.Array
+    problem: CompiledProblem,
+    values: jax.Array,
+    axis_name: Optional[str] = None,
 ) -> jax.Array:
     """f32[n_vars, d_max]: cost of each candidate value for each
     variable, holding all other variables at ``values``.
@@ -42,13 +65,15 @@ def local_cost_sweep(
     d = problem.d_max
     cells = base[:, None] + jnp.arange(d)[None, :] * problem.edge_stride[:, None]
     sweeps = problem.tables_flat[cells]  # [E, d]
-    summed = jax.ops.segment_sum(
-        sweeps, problem.edge_var, num_segments=problem.n_vars
-    )
+    summed = segment_sum_edges(problem, sweeps, axis_name)
     return summed + problem.unary
 
 
-def total_cost(problem: CompiledProblem, values: jax.Array) -> jax.Array:
+def total_cost(
+    problem: CompiledProblem,
+    values: jax.Array,
+    axis_name: Optional[str] = None,
+) -> jax.Array:
     """Scalar cost of a full assignment (compiled sign: always a
     minimization cost; callers re-negate for max problems)."""
     scope_vals = values[problem.con_scopes]  # [C, k_max]
@@ -56,6 +81,8 @@ def total_cost(problem: CompiledProblem, values: jax.Array) -> jax.Array:
         scope_vals * problem.con_strides, axis=1
     )
     con_cost = jnp.sum(problem.tables_flat[cell]) if problem.n_cons else 0.0
+    if axis_name is not None:
+        con_cost = jax.lax.psum(con_cost, axis_name)
     var_cost = jnp.sum(
         jnp.take_along_axis(
             problem.unary, values[:, None], axis=1
@@ -71,7 +98,9 @@ def neighbor_gather(
     neighbor, with ``fill`` on padding slots.
 
     ``quantity`` is [n_vars] or [n_vars, ...]; the gather broadcasts
-    over trailing dims.
+    over trailing dims.  Only valid when the neighbor arrays are
+    replicated (they are: neighbor structure is per-variable, and
+    variables are replicated across the mesh).
     """
     g = quantity[problem.neighbors]  # [n, max_deg, ...]
     mask = problem.neighbor_mask

@@ -1,0 +1,136 @@
+"""Max-Sum functional tests: exactness on trees, quality on loopy
+graphs, parity of the message math against brute force."""
+
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable, VariableNoisyCostFunc
+from pydcop_tpu.dcop.relations import NAryMatrixRelation, constraint_from_str
+from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+
+
+def brute_force_optimum(dcop):
+    best, best_cost = None, float("inf")
+    names = list(dcop.variables)
+    domains = [list(dcop.variables[n].domain.values) for n in names]
+    for combo in itertools.product(*domains):
+        a = dict(zip(names, combo))
+        c = dcop.solution_cost(a)
+        if c < best_cost:
+            best, best_cost = a, c
+    return best, best_cost
+
+
+def random_tree_dcop(seed, n=7, d=3):
+    rnd = random.Random(seed)
+    dom = Domain("d", "", list(range(d)))
+    dcop = DCOP(f"tree{seed}")
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(1, n):
+        parent = rnd.randrange(i)
+        m = np.round(
+            np.random.RandomState(seed * 50 + i).uniform(0, 10, (d, d)), 1
+        )
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[parent], vs[i]], m, name=f"c{i}")
+        )
+    return dcop
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_maxsum_exact_on_trees(seed):
+    """On acyclic factor graphs Max-Sum is exact."""
+    dcop = random_tree_dcop(seed)
+    _, opt_cost = brute_force_optimum(dcop)
+    result = solve(dcop, "maxsum", {"damping": 0.0, "noise": 0.0}, rounds=30, seed=0)
+    assert result["cost"] == pytest.approx(opt_cost, rel=1e-5)
+
+
+def test_maxsum_ring_coloring():
+    dom = Domain("c", "", [0, 1, 2])
+    dcop = DCOP("ring")
+    n = 10
+    vs = []
+    for i in range(n):
+        # tiny noisy unary costs break the ring's symmetry, as the
+        # reference does with VariableNoisyCostFunc
+        v = VariableNoisyCostFunc(
+            f"v{i}", dom, ExpressionFunction(f"0 * v{i}"), noise_level=0.01
+        )
+        vs.append(v)
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    result = solve(dcop, "maxsum", {"damping": 0.5}, rounds=60, seed=0)
+    # proper coloring found (cost < 1 means no violated edge)
+    assert result["cost"] < 1.0
+
+
+def test_maxsum_ternary_constraint():
+    dom = Domain("d", "", [0, 1])
+    dcop = DCOP("tern")
+    vs = [Variable(f"v{i}", dom) for i in range(3)]
+    for v in vs:
+        dcop.add_variable(v)
+    # asymmetric ternary factor with a unique optimum (1, 0, 1)
+    dcop.add_constraint(
+        constraint_from_str(
+            "c", "v0 * 1 + v1 * 7 + (1 - v2) * 3 + (1 - v0) * 2", vs
+        )
+    )
+    dcop.add_constraint(constraint_from_str("u1", "2 * v1", vs))
+    _, opt = brute_force_optimum(dcop)
+    result = solve(dcop, "maxsum", {"damping": 0.0, "noise": 0.0}, rounds=20, seed=0)
+    assert result["cost"] == pytest.approx(opt)
+    assert result["assignment"] == {"v0": 1, "v1": 0, "v2": 1}
+
+
+def test_maxsum_max_mode_tree():
+    dom = Domain("d", "", [0, 1, 2])
+    dcop = DCOP("maxtree", objective="max")
+    vs = [Variable(f"v{i}", dom) for i in range(4)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(1, 4):
+        m = np.random.RandomState(i).uniform(0, 10, (3, 3)).round(1)
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[0], vs[i]], m, name=f"c{i}")
+        )
+    # brute force max
+    best = max(
+        sum(
+            float(dcop.constraints[f"c{i}"](v0, vi))
+            for i, vi in zip(range(1, 4), combo[1:])
+        )
+        for combo in itertools.product(range(3), repeat=4)
+        for v0 in [combo[0]]
+    )
+    result = solve(dcop, "maxsum", {"damping": 0.0, "noise": 0.0}, rounds=20, seed=0)
+    assert result["cost"] == pytest.approx(best)
+
+
+def test_maxsum_message_count():
+    dcop = random_tree_dcop(1, n=5)
+    result = solve(dcop, "maxsum", rounds=10, seed=0)
+    # 4 binary constraints → 8 directed edges → 16 messages/round
+    assert result["msg_count"] == 10 * 16
+
+
+def test_maxsum_convergence_on_tree():
+    dcop = random_tree_dcop(2)
+    result = solve(
+        dcop, "maxsum", {"damping": 0.0}, rounds=500,
+        chunk_size=16, convergence_chunks=2,
+    )
+    assert result["status"] == "converged"
+    assert result["cycle"] < 500
