@@ -146,7 +146,10 @@ def state_specs(problem: CompiledProblem) -> Dict[str, Any]:
     sharded with their edges, values replicated."""
     from jax.sharding import PartitionSpec as P
 
-    return {"q": P("shard"), "r": P("shard"), "values": P(), "noise": P()}
+    from pydcop_tpu.parallel.mesh import SHARD_AXIS
+
+    sh = P(SHARD_AXIS)
+    return {"q": sh, "r": sh, "values": P(), "noise": P()}
 
 
 def messages_per_round(problem: CompiledProblem) -> int:

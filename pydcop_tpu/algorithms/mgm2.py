@@ -1,0 +1,362 @@
+"""MGM-2 — coordinated 2-opt local search (synchronous, 5-phase).
+
+Capability-parity with the reference's ``pydcop/algorithms/mgm2.py``
+(constraints hypergraph; offerer/receiver roles; offer / accept / gain
+/ go message phases; pairwise coordinated moves), redesigned for the
+TPU batched engine: the whole 5-phase round is ONE jitted step.
+
+Phases, batched:
+
+1. *value* (implicit): the shared assignment array.
+2. *offer*: a Bernoulli(``probability``) draw splits variables into
+   offerers and receivers; each offerer picks one uniformly random
+   neighbor and (implicitly) offers every joint value pair — the offer
+   "message" is materialized on the receiver side as a dense
+   [d, d] joint-gain matrix per (receiver, offering neighbor).
+3. *accept*: each receiver scans its incoming offers' joint-gain
+   tensors and accepts the single best pair move if its gain > 0; the
+   acceptance is scattered back to the chosen offerer (each offerer
+   made exactly one offer, so acceptances never collide).
+4. *gain*: committed pairs broadcast their joint gain, everyone else
+   their best unilateral (MGM) gain; one ``neighbor_gather`` is the
+   batched gain exchange.
+5. *go*: a committed pair moves iff BOTH partners strictly beat all
+   their other neighbors (deterministic index tie-break); uncommitted
+   variables fall back to plain MGM moves.
+
+Joint gains decompose as
+
+  gain(a, b) = base − [ local_v(a) − shared(a, cur_r)
+                      + local_r(b) − shared(cur_v, b) + shared(a, b) ]
+
+where ``shared`` sums every constraint containing both partners,
+other scope variables held at current values.  The per-pair ``shared``
+[d, d] tables are rebuilt each round (they depend on current values
+for arity ≥ 3) from a static (edge, co-position) → (variable,
+neighbor-slot) index built once in ``init_state`` — two gathers + one
+segment-sum, the same kernel shape as ``local_cost_sweep``.
+
+Memory note: the pair accumulator is ``f32[n_vars·max_degree, d, d]``
+— fine for the benchmark families (grids, colorings, meetings), heavy
+for dense hubs; cap with distribution or use MGM there.
+
+Message accounting: value + gain per directed link, plus offer /
+accept / go (≤ 1 each per variable) → ``2·Σ_v degree(v) + 3·n_vars``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pydcop_tpu.algorithms import AlgoParameterDef
+from pydcop_tpu.graphs import constraints_hypergraph as _graph
+from pydcop_tpu.ops.compile import CompiledProblem
+from pydcop_tpu.ops.costs import local_cost_sweep, neighbor_gather
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    # probability of taking the offerer role each round
+    AlgoParameterDef("probability", "float", None, 0.5),
+    AlgoParameterDef("initial", "str", ["declared", "random"], "random"),
+]
+
+_EPS = 1e-6
+
+
+def init_state(
+    problem: CompiledProblem, key: jax.Array, params: Dict[str, Any]
+) -> Dict[str, jax.Array]:
+    if params.get("initial", "random") == "random":
+        values = jax.random.randint(
+            key,
+            (problem.n_vars,),
+            0,
+            problem.domain_sizes,
+            dtype=problem.init_idx.dtype,
+        )
+    else:
+        values = problem.init_idx
+    pe_e, pe_p, pe_q, pe_valid = _pair_index(problem)
+    return {
+        "values": values,
+        "pe_edge": jnp.asarray(pe_e),
+        "pe_copos": jnp.asarray(pe_p),
+        "pe_pair": jnp.asarray(pe_q),
+        "pe_valid": jnp.asarray(pe_valid),
+    }
+
+
+# Pair-index cache: the index is pure problem structure (O(n_edges)
+# Python to build), so build it once per CompiledProblem, not per run.
+# Keyed by id() with a weakref guard against id reuse after gc.
+_PAIR_CACHE: Dict[int, Any] = {}
+
+
+def _pair_index(problem: CompiledProblem):
+    import weakref
+
+    hit = _PAIR_CACHE.get(id(problem))
+    if hit is not None and hit[0]() is problem:
+        return hit[1]
+
+    # static (edge, co-position) pair index: one entry per directed
+    # variable pair occurrence inside a constraint scope, mapping to the
+    # owner's slot in its padded neighbor list.  Built shard-major with
+    # equal per-shard lengths (invalid-padded) so the arrays shard
+    # evenly over a mesh alongside the edge arrays.
+    edge_var = np.asarray(problem.edge_var)
+    edge_covars = np.asarray(problem.edge_covars)
+    edge_costrides = np.asarray(problem.edge_costrides)
+    neighbors = np.asarray(problem.neighbors)
+    nbr_mask = np.asarray(problem.neighbor_mask)
+    max_deg = problem.max_degree
+    n_shards = max(problem.n_shards, 1)
+    eps_per_shard = edge_var.shape[0] // n_shards
+    per_shard: list = []
+    for s in range(n_shards):
+        entries = []  # (edge, copos, pair_id)
+        for e in range(s * eps_per_shard, (s + 1) * eps_per_shard):
+            v = edge_var[e]
+            row = neighbors[v][nbr_mask[v]]  # real (sorted) neighbors
+            for p in range(edge_covars.shape[1]):
+                if edge_costrides[e, p] <= 0:
+                    continue  # padding position
+                u = edge_covars[e, p]
+                if u == v:
+                    continue  # ghost constraints self-reference var 0
+                slot = int(np.searchsorted(row, u))
+                entries.append((e, p, int(v) * max_deg + slot))
+        per_shard.append(entries)
+    pe_len = max(max(len(x) for x in per_shard), 1)
+    n_pe = pe_len * n_shards
+    pe_e = np.zeros(n_pe, dtype=np.int32)
+    pe_p = np.zeros(n_pe, dtype=np.int32)
+    pe_q = np.zeros(n_pe, dtype=np.int32)
+    pe_valid = np.zeros(n_pe, dtype=bool)
+    for s, entries in enumerate(per_shard):
+        base_i = s * pe_len
+        # padding entries point at this shard's first edge so the
+        # (localized) gather stays in range; pe_valid zeroes them out
+        pe_e[base_i : base_i + pe_len] = s * eps_per_shard
+        for i, (e, p, q) in enumerate(entries):
+            pe_e[base_i + i] = e
+            pe_p[base_i + i] = p
+            pe_q[base_i + i] = q
+            pe_valid[base_i + i] = True
+    out = (pe_e, pe_p, pe_q, pe_valid)
+    _PAIR_CACHE[id(problem)] = (weakref.ref(problem), out)
+    return out
+
+
+def _pair_shared(
+    problem: CompiledProblem,
+    state: Dict[str, jax.Array],
+    values: jax.Array,
+    axis_name: Optional[str],
+) -> jax.Array:
+    """f32[n_vars, max_deg, d, d]: summed shared-constraint tables per
+    (variable, neighbor-slot), axes (own value, neighbor value), other
+    scope variables fixed at ``values``."""
+    e = state["pe_edge"]
+    if axis_name is not None:
+        # localize global edge ids to this shard's slice (edge arrays
+        # inside shard_map are the local block)
+        e = e - jax.lax.axis_index(axis_name) * problem.edge_var.shape[0]
+    p = state["pe_copos"]
+    covals = values[problem.edge_covars[e]]  # [P, k-1]
+    costr = problem.edge_costrides[e]  # [P, k-1]
+    sel = jnp.arange(costr.shape[1])[None, :] == p[:, None]
+    base = problem.edge_offset[e] + jnp.sum(
+        jnp.where(sel, 0, covals * costr), axis=1
+    )  # [P]
+    d = problem.d_max
+    ar = jnp.arange(d)
+    stride_own = problem.edge_stride[e]
+    stride_nbr = jnp.take_along_axis(costr, p[:, None], axis=1)[:, 0]
+    cells = (
+        base[:, None, None]
+        + ar[None, :, None] * stride_own[:, None, None]
+        + ar[None, None, :] * stride_nbr[:, None, None]
+    )
+    sweeps = problem.tables_flat[cells]  # [P, d, d]
+    sweeps = jnp.where(state["pe_valid"][:, None, None], sweeps, 0.0)
+    acc = jax.ops.segment_sum(
+        sweeps,
+        state["pe_pair"],
+        num_segments=problem.n_vars * problem.max_degree,
+    )
+    if axis_name is not None:
+        acc = jax.lax.psum(acc, axis_name)
+    return acc.reshape(problem.n_vars, problem.max_degree, d, d)
+
+
+def step(
+    problem: CompiledProblem,
+    state: Dict[str, jax.Array],
+    key: jax.Array,
+    params: Dict[str, Any],
+    axis_name: Optional[str] = None,
+) -> Dict[str, jax.Array]:
+    values = state["values"]
+    n, deg, d = problem.n_vars, problem.max_degree, problem.d_max
+    mask = problem.neighbor_mask
+    has_nbr = jnp.any(mask, axis=1)
+    degree = jnp.sum(mask, axis=1)
+
+    local = local_cost_sweep(problem, values, axis_name)  # [n, d]
+    current = jnp.take_along_axis(local, values[:, None], axis=1)[:, 0]
+    uni_best = jnp.min(local, axis=1)
+    uni_candidate = jnp.argmin(local, axis=1).astype(values.dtype)
+    uni_gain = current - uni_best
+
+    # -- phase 2: roles + offers --------------------------------------
+    k_role, k_partner = jax.random.split(key)
+    is_off = (
+        jax.random.uniform(k_role, (n,)) < params["probability"]
+    ) & has_nbr
+    ps = jax.random.randint(
+        k_partner, (n,), 0, jnp.maximum(degree, 1)
+    )  # offerer's partner slot
+    partner_off = jnp.take_along_axis(
+        problem.neighbors, ps[:, None], axis=1
+    )[:, 0]
+    nbr_idx = problem.neighbors  # [n, deg]
+    offered = (
+        mask
+        & is_off[nbr_idx]
+        & (partner_off[nbr_idx] == jnp.arange(n)[:, None])
+        & ~is_off[:, None]
+    )  # [n(receiver), deg]
+
+    # -- phase 3: accept — dense joint-gain scan ----------------------
+    shared = _pair_shared(problem, state, values, axis_name)
+    # axes: shared[r, j, own_val(b), nbr_val(a)]
+    cur_v = values[nbr_idx]  # [n, deg] neighbor's current value
+    nb_local = local[nbr_idx]  # [n, deg, d] (a axis)
+    s_cur_own = jnp.take_along_axis(
+        shared, values[:, None, None, None].astype(jnp.int32), axis=2
+    )[:, :, 0, :]  # [n, deg, d]  shared(cur_r, a)
+    s_cur_nbr = jnp.take_along_axis(
+        shared, cur_v[:, :, None, None].astype(jnp.int32), axis=3
+    )[:, :, :, 0]  # [n, deg, d]  shared(b, cur_v)
+    base_shared = jnp.take_along_axis(
+        s_cur_own, cur_v[:, :, None], axis=2
+    )[:, :, 0]  # [n, deg]  shared(cur_r, cur_v)
+    nb_current = jnp.take_along_axis(nb_local, cur_v[:, :, None], axis=2)[
+        :, :, 0
+    ]  # [n, deg] neighbor's current local cost
+    base = current[:, None] + nb_current - base_shared  # [n, deg]
+    joint = (
+        (nb_local - s_cur_own)[:, :, None, :]  # a terms
+        + (local[:, None, :] - s_cur_nbr)[:, :, :, None]  # b terms
+        + shared
+    )  # [n, deg, b, a]
+    gain2 = base[:, :, None, None] - joint
+    gain2 = jnp.where(offered[:, :, None, None], gain2, -jnp.inf)
+    flat = gain2.reshape(n, deg * d * d)
+    best_flat = jnp.argmax(flat, axis=1)
+    best_gain2 = jnp.take_along_axis(flat, best_flat[:, None], axis=1)[:, 0]
+    j_star = (best_flat // (d * d)).astype(jnp.int32)
+    b_star = ((best_flat // d) % d).astype(values.dtype)
+    a_star = (best_flat % d).astype(values.dtype)
+    accept = best_gain2 > _EPS  # receivers only (offered masks roles)
+    partner_recv = jnp.take_along_axis(nbr_idx, j_star[:, None], axis=1)[
+        :, 0
+    ]
+
+    # scatter acceptance back to the chosen offerer (collision-free:
+    # each offerer made exactly one offer)
+    tgt = jnp.where(accept, partner_recv, n)  # n → dropped
+    off_committed = jnp.zeros(n, dtype=bool).at[tgt].set(
+        True, mode="drop"
+    )
+    off_planned = jnp.zeros(n, dtype=values.dtype).at[tgt].set(
+        a_star, mode="drop"
+    )
+    off_gain = jnp.zeros(n, dtype=best_gain2.dtype).at[tgt].set(
+        best_gain2, mode="drop"
+    )
+
+    committed = off_committed | accept
+    planned = jnp.where(
+        off_committed,
+        off_planned,
+        jnp.where(accept, b_star, uni_candidate),
+    )
+    gain_msg = jnp.where(
+        off_committed, off_gain, jnp.where(accept, best_gain2, uni_gain)
+    )
+    partner_idx = jnp.where(off_committed, partner_off, partner_recv)
+    partner_slot = jnp.where(off_committed, ps, j_star)
+
+    # -- phases 4–5: gain exchange + go -------------------------------
+    prio = -jnp.arange(n, dtype=jnp.float32)  # lower index wins ties
+    nbr_gain = neighbor_gather(problem, gain_msg, fill=-jnp.inf)
+    nbr_prio = neighbor_gather(problem, prio, fill=-jnp.inf)
+    beats = (gain_msg[:, None] > nbr_gain + _EPS) | (
+        (jnp.abs(gain_msg[:, None] - nbr_gain) <= _EPS)
+        & (prio[:, None] > nbr_prio)
+    )
+    beats = jnp.where(mask, beats, True)
+    # a committed pair does not compete with its partner
+    slot_is_partner = (
+        jnp.arange(deg)[None, :] == partner_slot[:, None]
+    ) & committed[:, None]
+    beats = jnp.where(slot_is_partner, True, beats)
+    win = jnp.all(beats, axis=1) & (gain_msg > _EPS)
+
+    partner_win = win[jnp.clip(partner_idx, 0, n - 1)]
+    move = jnp.where(committed, win & partner_win, win)
+    new_values = jnp.where(move, planned, values)
+    return {**state, "values": new_values}
+
+
+def values_from_state(state: Dict[str, jax.Array]) -> jax.Array:
+    return state["values"]
+
+
+def state_specs(problem: CompiledProblem) -> Dict[str, Any]:
+    """Pair-index arrays shard with the edges; values replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from pydcop_tpu.parallel.mesh import SHARD_AXIS
+
+    sh = P(SHARD_AXIS)
+    return {
+        "values": P(),
+        "pe_edge": sh,
+        "pe_copos": sh,
+        "pe_pair": sh,
+        "pe_valid": sh,
+    }
+
+
+def messages_per_round(problem: CompiledProblem) -> int:
+    """Value + gain per directed link, plus offer/accept/go per var."""
+    return (
+        2 * int(np.asarray(problem.neighbor_mask).sum())
+        + 3 * problem.n_vars
+    )
+
+
+# -- distribution-layer footprint callbacks (reference-parity) ----------
+
+HEADER_SIZE = 0
+UNIT_SIZE = 1
+
+
+def computation_memory(node: _graph.VariableComputationNode) -> float:
+    """Neighbor values, gains, and one pending offer matrix."""
+    return 3 * len(node.neighbors) * UNIT_SIZE
+
+
+def communication_load(
+    node: _graph.VariableComputationNode, neighbor_name: str
+) -> float:
+    """Value + gain + (amortized) offer/accept/go per round."""
+    return HEADER_SIZE + 5 * UNIT_SIZE

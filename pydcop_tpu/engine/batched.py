@@ -46,7 +46,11 @@ class RunResult:
 
 # Compiled chunk runners, reused across run_batched calls so repeated
 # runs (warmup/measure, parameter sweeps, chunked loops) don't re-trace.
-# Key: (algo module, chunk len, axis_name, static params, mesh id).
+# Key: (algo module, axis_name, static params, dyn-param names, mesh id,
+# bucket arities, n_shards, chunk len).  Unbounded by design: entries
+# pin their executable + mesh for the process lifetime, which is the
+# desired behavior for benchmark loops; call _RUNNER_CACHE.clear() to
+# release.
 _RUNNER_CACHE: Dict[Tuple, Callable] = {}
 
 

@@ -1,0 +1,144 @@
+"""MGM and MGM-2 on the batched engine: functional + property tests."""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import (
+    AlgorithmDefError,
+    load_algorithm_module,
+    prepare_algo_params,
+)
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import (
+    NAryMatrixRelation,
+    constraint_from_str,
+)
+
+
+def coloring_ring(n=10, colors=3):
+    d = Domain("colors", "", list(range(colors)))
+    dcop = DCOP(f"ring{n}")
+    vs = [Variable(f"v{i}", d) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    return dcop
+
+
+def coordination_trap():
+    """Two binary variables where every unilateral move increases cost:
+    cost(0,0)=1 is a strict 1-opt local minimum, cost(1,1)=0 is the
+    optimum.  MGM can never leave (0,0); MGM-2's pair moves can."""
+    d = Domain("b", "", [0, 1])
+    dcop = DCOP("trap")
+    v0 = Variable("v0", d, initial_value=0)
+    v1 = Variable("v1", d, initial_value=0)
+    dcop.add_variable(v0)
+    dcop.add_variable(v1)
+    m = NAryMatrixRelation(
+        [v0, v1], np.array([[1.0, 2.0], [2.0, 0.0]]), name="c"
+    )
+    dcop.add_constraint(m)
+    return dcop
+
+
+def test_param_validation():
+    mod = load_algorithm_module("mgm")
+    params = prepare_algo_params({}, mod.algo_params)
+    assert params["break_mode"] == "lexic"
+    with pytest.raises(AlgorithmDefError):
+        prepare_algo_params({"break_mode": "zz"}, mod.algo_params)
+    mod2 = load_algorithm_module("mgm2")
+    params2 = prepare_algo_params({"probability": 0.3}, mod2.algo_params)
+    assert params2["probability"] == 0.3
+
+
+def test_mgm_solves_ring_coloring():
+    result = solve(coloring_ring(10, 3), "mgm", rounds=100, seed=2)
+    assert result["cost"] == 0.0
+    a = result["assignment"]
+    for i in range(10):
+        assert a[f"v{i}"] != a[f"v{(i + 1) % 10}"]
+    assert result["msg_count"] == 100 * 2 * 2 * 10  # 2·Σdeg per round
+
+
+def test_mgm_monotone_anytime():
+    """The classic MGM guarantee: global cost never increases."""
+    dcop = coloring_ring(20, 3)
+    for seed in range(3):
+        trace = np.asarray(
+            solve(dcop, "mgm", rounds=60, seed=seed)["cost_trace"]
+        )
+        assert np.all(np.diff(trace) <= 1e-6)
+
+
+def test_mgm_stuck_in_coordination_trap():
+    result = solve(
+        coordination_trap(), "mgm", {"initial": "declared"},
+        rounds=50, seed=0,
+    )
+    assert result["cost"] == 1.0  # provably cannot move
+
+
+def test_mgm2_escapes_coordination_trap():
+    result = solve(
+        coordination_trap(), "mgm2", {"initial": "declared"},
+        rounds=50, seed=0,
+    )
+    assert result["cost"] == 0.0
+    assert result["assignment"] == {"v0": 1, "v1": 1}
+
+
+def test_mgm2_solves_ring_coloring():
+    result = solve(coloring_ring(10, 3), "mgm2", rounds=150, seed=1)
+    assert result["cost"] == 0.0
+    a = result["assignment"]
+    for i in range(10):
+        assert a[f"v{i}"] != a[f"v{(i + 1) % 10}"]
+
+
+def test_mgm2_monotone_anytime():
+    """MGM-2 keeps MGM's monotonicity: movers beat all non-partner
+    neighbors, and pair moves are jointly improving."""
+    dcop = coloring_ring(16, 3)
+    for seed in range(3):
+        trace = np.asarray(
+            solve(dcop, "mgm2", rounds=80, seed=seed)["cost_trace"]
+        )
+        assert np.all(np.diff(trace) <= 1e-6)
+
+
+def test_mgm2_ternary_constraints():
+    """Pair-shared tables must track current values of third parties."""
+    d = Domain("t", "", [0, 1, 2])
+    dcop = DCOP("tern")
+    vs = [Variable(f"v{i}", d) for i in range(5)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(3):
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}",
+                f"abs(v{i} + v{i+1} - v{i+2})",
+                vs,
+            )
+        )
+    result = solve(dcop, "mgm2", rounds=100, seed=4)
+    # optimum is 0 (e.g. all zeros); local search should find ≤ 1
+    assert result["cost"] <= 1.0
+    trace = np.asarray(result["cost_trace"])
+    assert np.all(np.diff(trace) <= 1e-6)
+
+
+@pytest.mark.parametrize("algo", ["mgm", "mgm2"])
+def test_deterministic_given_seed(algo):
+    dcop = coloring_ring(8, 3)
+    r1 = solve(dcop, algo, rounds=40, seed=7)
+    r2 = solve(dcop, algo, rounds=40, seed=7)
+    assert r1["assignment"] == r2["assignment"]

@@ -66,7 +66,7 @@ def test_shard_problem_mismatch_raises():
         shard_problem(p, mesh)
 
 
-@pytest.mark.parametrize("algo_name", ["dsa", "maxsum"])
+@pytest.mark.parametrize("algo_name", ["dsa", "maxsum", "mgm", "mgm2"])
 def test_sharded_matches_unsharded(algo_name):
     """Same compiled problem, same seed: the mesh run must reproduce the
     single-device run (up to float reassociation)."""
