@@ -1,0 +1,59 @@
+"""Shared helpers for the local-search algorithm family.
+
+One home for the pieces DSA / MGM / MGM-2 / DBA-style modules would
+otherwise copy: initial-value policy and the strict-winner rule of the
+gain-exchange phase, so a change to either applies to every algorithm.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.ops.compile import CompiledProblem
+from pydcop_tpu.ops.costs import neighbor_gather
+
+EPS = 1e-6
+
+
+def init_values(
+    problem: CompiledProblem, key: jax.Array, params: Dict[str, Any]
+) -> jax.Array:
+    """i32[n_vars] starting assignment per the ``initial`` param:
+    'random' (uniform in-domain, default) or 'declared' (the variables'
+    declared initial values, zeros when absent)."""
+    if params.get("initial", "random") == "random":
+        return jax.random.randint(
+            key,
+            (problem.n_vars,),
+            0,
+            problem.domain_sizes,
+            dtype=problem.init_idx.dtype,
+        )
+    return problem.init_idx
+
+
+def strict_winner(
+    problem: CompiledProblem,
+    gain: jax.Array,
+    prio: jax.Array,
+    extra_skip: Optional[jax.Array] = None,
+) -> jax.Array:
+    """bool[n_vars]: v wins iff its (gain, prio) pair lexicographically
+    beats every real neighbor's — the MGM-family rule guaranteeing no
+    two adjacent movers and hence monotone cost.  ``extra_skip``
+    (bool[n_vars, max_deg]) marks slots excluded from the comparison
+    (e.g. a committed MGM-2 partner).  Positive gain is NOT checked
+    here; callers and their eligibility rules own that."""
+    nbr_gain = neighbor_gather(problem, gain, fill=-jnp.inf)
+    nbr_prio = neighbor_gather(problem, prio, fill=-jnp.inf)
+    beats = (gain[:, None] > nbr_gain + EPS) | (
+        (jnp.abs(gain[:, None] - nbr_gain) <= EPS)
+        & (prio[:, None] > nbr_prio)
+    )
+    beats = jnp.where(problem.neighbor_mask, beats, True)
+    if extra_skip is not None:
+        beats = jnp.where(extra_skip, True, beats)
+    return jnp.all(beats, axis=1)

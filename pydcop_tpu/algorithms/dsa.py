@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from pydcop_tpu.algorithms import AlgoParameterDef
+from pydcop_tpu.algorithms._common import init_values
 from pydcop_tpu.graphs import constraints_hypergraph as _graph
 from pydcop_tpu.ops.compile import BIG, CompiledProblem
 from pydcop_tpu.ops.costs import local_cost_sweep
@@ -50,17 +51,7 @@ algo_params = [
 def init_state(
     problem: CompiledProblem, key: jax.Array, params: Dict[str, Any]
 ) -> Dict[str, jax.Array]:
-    if params.get("initial", "random") == "random":
-        values = jax.random.randint(
-            key,
-            (problem.n_vars,),
-            0,
-            problem.domain_sizes,
-            dtype=problem.init_idx.dtype,
-        )
-    else:
-        values = problem.init_idx
-    return {"values": values}
+    return {"values": init_values(problem, key, params)}
 
 
 def step(

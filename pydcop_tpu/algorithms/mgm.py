@@ -30,9 +30,10 @@ import jax
 import jax.numpy as jnp
 
 from pydcop_tpu.algorithms import AlgoParameterDef
+from pydcop_tpu.algorithms._common import EPS, init_values, strict_winner
 from pydcop_tpu.graphs import constraints_hypergraph as _graph
 from pydcop_tpu.ops.compile import CompiledProblem
-from pydcop_tpu.ops.costs import local_cost_sweep, neighbor_gather
+from pydcop_tpu.ops.costs import local_cost_sweep
 
 GRAPH_TYPE = "constraints_hypergraph"
 
@@ -43,23 +44,11 @@ algo_params = [
     AlgoParameterDef("break_mode", "str", ["lexic", "random"], "lexic"),
 ]
 
-_EPS = 1e-6
-
 
 def init_state(
     problem: CompiledProblem, key: jax.Array, params: Dict[str, Any]
 ) -> Dict[str, jax.Array]:
-    if params.get("initial", "random") == "random":
-        values = jax.random.randint(
-            key,
-            (problem.n_vars,),
-            0,
-            problem.domain_sizes,
-            dtype=problem.init_idx.dtype,
-        )
-    else:
-        values = problem.init_idx
-    return {"values": values}
+    return {"values": init_values(problem, key, params)}
 
 
 def step(
@@ -83,14 +72,7 @@ def step(
         prio = jax.random.uniform(key, (n,))
     else:
         prio = -jnp.arange(n, dtype=jnp.float32)  # lower index wins
-    nbr_gain = neighbor_gather(problem, gain, fill=-jnp.inf)  # [n, deg]
-    nbr_prio = neighbor_gather(problem, prio, fill=-jnp.inf)
-    beats = (gain[:, None] > nbr_gain + _EPS) | (
-        (jnp.abs(gain[:, None] - nbr_gain) <= _EPS)
-        & (prio[:, None] > nbr_prio)
-    )
-    beats = jnp.where(problem.neighbor_mask, beats, True)
-    win = jnp.all(beats, axis=1) & (gain > _EPS)
+    win = strict_winner(problem, gain, prio) & (gain > EPS)
 
     new_values = jnp.where(win, candidate, values)
     return {"values": new_values}

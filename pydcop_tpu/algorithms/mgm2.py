@@ -53,9 +53,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from pydcop_tpu.algorithms import AlgoParameterDef
+from pydcop_tpu.algorithms._common import EPS, init_values, strict_winner
 from pydcop_tpu.graphs import constraints_hypergraph as _graph
 from pydcop_tpu.ops.compile import CompiledProblem
-from pydcop_tpu.ops.costs import local_cost_sweep, neighbor_gather
+from pydcop_tpu.ops.costs import local_cost_sweep
 
 GRAPH_TYPE = "constraints_hypergraph"
 
@@ -65,22 +66,11 @@ algo_params = [
     AlgoParameterDef("initial", "str", ["declared", "random"], "random"),
 ]
 
-_EPS = 1e-6
-
 
 def init_state(
     problem: CompiledProblem, key: jax.Array, params: Dict[str, Any]
 ) -> Dict[str, jax.Array]:
-    if params.get("initial", "random") == "random":
-        values = jax.random.randint(
-            key,
-            (problem.n_vars,),
-            0,
-            problem.domain_sizes,
-            dtype=problem.init_idx.dtype,
-        )
-    else:
-        values = problem.init_idx
+    values = init_values(problem, key, params)
     pe_e, pe_p, pe_q, pe_valid = _pair_index(problem)
     return {
         "values": values,
@@ -93,7 +83,8 @@ def init_state(
 
 # Pair-index cache: the index is pure problem structure (O(n_edges)
 # Python to build), so build it once per CompiledProblem, not per run.
-# Keyed by id() with a weakref guard against id reuse after gc.
+# Keyed by id() with a weakref guard against id reuse; entries evict
+# themselves when their problem is garbage-collected.
 _PAIR_CACHE: Dict[int, Any] = {}
 
 
@@ -149,7 +140,9 @@ def _pair_index(problem: CompiledProblem):
             pe_q[base_i + i] = q
             pe_valid[base_i + i] = True
     out = (pe_e, pe_p, pe_q, pe_valid)
-    _PAIR_CACHE[id(problem)] = (weakref.ref(problem), out)
+    key = id(problem)
+    ref = weakref.ref(problem, lambda _: _PAIR_CACHE.pop(key, None))
+    _PAIR_CACHE[key] = (ref, out)
     return out
 
 
@@ -264,7 +257,7 @@ def step(
     j_star = (best_flat // (d * d)).astype(jnp.int32)
     b_star = ((best_flat // d) % d).astype(values.dtype)
     a_star = (best_flat % d).astype(values.dtype)
-    accept = best_gain2 > _EPS  # receivers only (offered masks roles)
+    accept = best_gain2 > EPS  # receivers only (offered masks roles)
     partner_recv = jnp.take_along_axis(nbr_idx, j_star[:, None], axis=1)[
         :, 0
     ]
@@ -296,19 +289,13 @@ def step(
 
     # -- phases 4–5: gain exchange + go -------------------------------
     prio = -jnp.arange(n, dtype=jnp.float32)  # lower index wins ties
-    nbr_gain = neighbor_gather(problem, gain_msg, fill=-jnp.inf)
-    nbr_prio = neighbor_gather(problem, prio, fill=-jnp.inf)
-    beats = (gain_msg[:, None] > nbr_gain + _EPS) | (
-        (jnp.abs(gain_msg[:, None] - nbr_gain) <= _EPS)
-        & (prio[:, None] > nbr_prio)
-    )
-    beats = jnp.where(mask, beats, True)
     # a committed pair does not compete with its partner
     slot_is_partner = (
         jnp.arange(deg)[None, :] == partner_slot[:, None]
     ) & committed[:, None]
-    beats = jnp.where(slot_is_partner, True, beats)
-    win = jnp.all(beats, axis=1) & (gain_msg > _EPS)
+    win = strict_winner(problem, gain_msg, prio, slot_is_partner) & (
+        gain_msg > EPS
+    )
 
     partner_win = win[jnp.clip(partner_idx, 0, n - 1)]
     move = jnp.where(committed, win & partner_win, win)
