@@ -45,7 +45,7 @@ def main() -> None:
         problem, module, params, rounds=1024, seed=0, chunk_size=256
     )
     dt = time.perf_counter() - t0
-    msgs_per_round = module.messages_per_round(problem)
+    msgs_per_round = module.messages_per_round(problem, params)
     msgs_per_sec = msgs_per_round * result.cycles / dt
 
     print(

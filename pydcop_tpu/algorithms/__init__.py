@@ -21,8 +21,10 @@ TPU batched engine (the new execution core — replaces the reference's
   pass it through to the ``pydcop_tpu.ops`` kernels (they psum over it).
 - ``state_specs(problem) -> pytree of PartitionSpec`` (optional) — how
   the state shards over the mesh; defaults to fully replicated.
-- ``messages_per_round(problem) -> int`` — logical directed messages one
-  round represents (the auditable msgs/sec accounting, see BASELINE.md).
+- ``messages_per_round(problem, params=None) -> int`` — logical directed
+  messages one round represents (the auditable msgs/sec accounting, see
+  BASELINE.md); schedule-variant modules (adsa, amaxsum) scale it by
+  their activation probability from ``params``.
 
 Algorithms with inherently sequential host-side phases (DPOP, SyncBB)
 instead export ``solve_host(problem_or_dcop, ...)``; the engine detects

@@ -35,6 +35,39 @@ def init_values(
     return problem.init_idx
 
 
+def dsa_candidate_eligibility(
+    local: jax.Array,
+    values: jax.Array,
+    key: jax.Array,
+    variant: str,
+) -> Tuple[jax.Array, jax.Array]:
+    """The DSA decision rule shared by dsa / adsa / dsatuto.
+
+    Given the candidate-cost sweep ``local`` ([n, d]) and the current
+    ``values``, returns ``(candidate, eligible)``: the uniformly-random
+    best value per variable (ties broken by ``key``) and the variant
+    rule's move-eligibility mask —
+    A: strict improvement exists; B: improvement exists OR tied while in
+    conflict (positive local cost); C: always.
+    """
+    current = jnp.take_along_axis(local, values[:, None], axis=1)[:, 0]
+    best = jnp.min(local, axis=1)
+    delta = current - best  # >= 0
+
+    tie = jax.random.uniform(key, local.shape)
+    candidate = jnp.argmin(
+        jnp.where(local <= best[:, None] + EPS, tie, jnp.inf), axis=1
+    ).astype(values.dtype)
+
+    if variant == "A":
+        eligible = delta > EPS
+    elif variant == "B":
+        eligible = (delta > EPS) | ((delta <= EPS) & (current > EPS))
+    else:  # C
+        eligible = jnp.ones_like(delta, dtype=bool)
+    return candidate, eligible
+
+
 def strict_winner(
     problem: CompiledProblem,
     gain: jax.Array,

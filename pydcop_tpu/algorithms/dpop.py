@@ -10,9 +10,11 @@ XLA tiles well.
 
 Execution model: the pseudo-tree walk is host-side (it is inherently
 sequential in tree depth and runs once), while each join/projection is
-a pure array op.  Small tables run in numpy (dispatch cost dominates);
-tables above ``_DEVICE_CELLS`` cells are pushed through jit to the
-accelerator, where the broadcast-add + min-reduce fuse into one kernel.
+a pure array op in float64 numpy — DPOP is an *exact* algorithm, and
+the accelerator's float32 would silently round large UTIL tables, so
+the hot tensor work stays on host where exact dtype is native.  The
+VALUE phase only needs each node's argmin over its own axis, so the
+UTIL phase retains just that (int) table per node, not the full joint.
 UTIL width is exponential in the induced width — ``max_util_size``
 guards against accidental blowups with a clear error (the reference
 fails with MemoryError instead).
@@ -32,8 +34,6 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from pydcop_tpu.dcop.dcop import DCOP
@@ -42,15 +42,6 @@ from pydcop_tpu.graphs import pseudotree as _pt
 GRAPH_TYPE = "pseudotree"
 
 algo_params: list = []
-
-# tables with at least this many cells are joined/projected on device
-_DEVICE_CELLS = 1 << 16
-
-
-@jax.jit
-def _device_join_project(joint: jax.Array) -> jax.Array:
-    """min over the LAST axis (the node's own variable)."""
-    return jnp.min(joint, axis=-1)
 
 
 def _align(
@@ -115,7 +106,9 @@ def solve_host(
 
     # -- UTIL phase: post-order over each tree -------------------------
     util: Dict[str, Tuple[List[str], np.ndarray]] = {}
-    joint: Dict[str, Tuple[List[str], np.ndarray]] = {}
+    # per node: (separator order, argmin over own axis) — all the VALUE
+    # phase needs, at 1/d the cells and int dtype vs the full joint
+    best_choice: Dict[str, Tuple[List[str], np.ndarray]] = {}
     util_cells = 0
     for root in graph.roots:
         for name in reversed(graph.depth_first_order(root)):
@@ -151,13 +144,9 @@ def solve_host(
             )
             for dims, table in parts:
                 j = j + _align(table, dims, target)
-            if j.size >= _DEVICE_CELLS:
-                u = np.asarray(
-                    _device_join_project(jnp.asarray(j)), dtype=np.float64
-                )
-            else:
-                u = j.min(axis=-1)
-            joint[name] = (target, j)
+            u = j.min(axis=-1)
+            best_choice[name] = (sep, np.argmin(j, axis=-1))
+            del j
             util[name] = (sep, u)
             util_cells += u.size if node.parent is not None else 0
 
@@ -166,9 +155,8 @@ def solve_host(
     idx: Dict[str, int] = {}
     for root in graph.roots:
         for name in graph.depth_first_order(root):
-            target, j = joint[name]
-            sel = j[tuple(idx[d] for d in target[:-1])]
-            best = int(np.argmin(sel))
+            sep, amin = best_choice[name]
+            best = int(amin[tuple(idx[d] for d in sep)])
             idx[name] = best
             assignment[name] = domains[name][best]
 

@@ -27,13 +27,13 @@ reference's ``Messaging`` counter would record for the same schedule).
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 
 from pydcop_tpu.algorithms import AlgoParameterDef
-from pydcop_tpu.algorithms._common import init_values
+from pydcop_tpu.algorithms._common import dsa_candidate_eligibility, init_values
 from pydcop_tpu.graphs import constraints_hypergraph as _graph
 from pydcop_tpu.ops.compile import BIG, CompiledProblem
 from pydcop_tpu.ops.costs import local_cost_sweep
@@ -65,28 +65,10 @@ def step(
     local = local_cost_sweep(problem, values, axis_name)  # [n, d]
     n = problem.n_vars
 
-    current = jnp.take_along_axis(local, values[:, None], axis=1)[:, 0]
-    best = jnp.min(local, axis=1)
-    delta = current - best  # >= 0
-
     k_tie, k_move = jax.random.split(key)
-    # uniform choice among argmin ties
-    tie = jax.random.uniform(k_tie, local.shape)
-    candidate = jnp.argmin(
-        jnp.where(local <= best[:, None] + 1e-6, tie, jnp.inf), axis=1
-    ).astype(values.dtype)
-
-    variant = params["variant"]
-    eps = 1e-6
-    if variant == "A":
-        eligible = delta > eps
-    elif variant == "B":
-        # conflict: current local cost is positive (some constraint
-        # violated / nonzero cost), the classic DSA-B condition
-        eligible = (delta > eps) | ((delta <= eps) & (current > eps))
-    else:  # C
-        eligible = jnp.ones_like(delta, dtype=bool)
-
+    candidate, eligible = dsa_candidate_eligibility(
+        local, values, k_tie, params["variant"]
+    )
     move = eligible & (
         jax.random.uniform(k_move, (n,)) < params["probability"]
     )
@@ -98,7 +80,9 @@ def values_from_state(state: Dict[str, jax.Array]) -> jax.Array:
     return state["values"]
 
 
-def messages_per_round(problem: CompiledProblem) -> int:
+def messages_per_round(
+    problem: CompiledProblem, params: Optional[Dict[str, Any]] = None
+) -> int:
     """Directed value messages per round = Σ_v degree(v)."""
     import numpy as np
 

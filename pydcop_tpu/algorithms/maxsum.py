@@ -152,7 +152,9 @@ def state_specs(problem: CompiledProblem) -> Dict[str, Any]:
     return {"q": sh, "r": sh, "values": P(), "noise": P()}
 
 
-def messages_per_round(problem: CompiledProblem) -> int:
+def messages_per_round(
+    problem: CompiledProblem, params: Optional[Dict[str, Any]] = None
+) -> int:
     """q and r per REAL directed edge per round (ghost-padding edges
     from the shard-major layout are excluded from the auditable count)."""
     return 2 * problem.n_real_edges

@@ -82,7 +82,9 @@ def values_from_state(state: Dict[str, jax.Array]) -> jax.Array:
     return state["values"]
 
 
-def messages_per_round(problem: CompiledProblem) -> int:
+def messages_per_round(
+    problem: CompiledProblem, params: Optional[Dict[str, Any]] = None
+) -> int:
     """One value + one gain message per directed link = 2·Σ degree."""
     import numpy as np
 

@@ -323,7 +323,9 @@ def state_specs(problem: CompiledProblem) -> Dict[str, Any]:
     }
 
 
-def messages_per_round(problem: CompiledProblem) -> int:
+def messages_per_round(
+    problem: CompiledProblem, params: Optional[Dict[str, Any]] = None
+) -> int:
     """Value + gain per directed link, plus offer/accept/go per var."""
     return (
         2 * int(np.asarray(problem.neighbor_mask).sum())

@@ -234,7 +234,7 @@ def run_batched(
     final_values = state["values"]
     final_cost = float(total_cost(problem, final_values))
     elapsed = time.perf_counter() - t0
-    msgs = algo_module.messages_per_round(problem) * done
+    msgs = algo_module.messages_per_round(problem, params) * done
     trace = np.concatenate(traces) if traces else np.zeros(0)
     return RunResult(
         assignment=decode_assignment(problem, final_values),

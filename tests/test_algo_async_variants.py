@@ -1,0 +1,125 @@
+"""dsatuto / A-DSA / A-Max-Sum: the async family as batched schedules.
+
+Parity testing follows SURVEY.md §7: asynchronous algorithms are
+schedule variants of their synchronous counterparts, so we assert
+distributional equivalence of solution quality (costs on known-optimum
+problems), not message-trace equality.
+"""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import (
+    list_available_algorithms,
+    load_algorithm_module,
+    prepare_algo_params,
+)
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.engine.batched import run_batched
+from pydcop_tpu.ops.compile import compile_dcop
+
+
+def coloring_ring(n=10, colors=3):
+    d = Domain("colors", "", list(range(colors)))
+    dcop = DCOP(f"ring{n}")
+    vs = [Variable(f"v{i}", d) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    return dcop
+
+
+def test_registry_lists_async_family():
+    algos = list_available_algorithms()
+    for name in ("dsatuto", "adsa", "amaxsum"):
+        assert name in algos
+
+
+def test_dsatuto_solves_ring():
+    result = solve(coloring_ring(10, 3), "dsatuto", rounds=200, seed=1)
+    assert result["cost"] == 0.0
+    a = result["assignment"]
+    for i in range(10):
+        assert a[f"v{i}"] != a[f"v{(i + 1) % 10}"]
+
+
+def test_dsatuto_has_no_params():
+    mod = load_algorithm_module("dsatuto")
+    assert prepare_algo_params({}, mod.algo_params) == {}
+
+
+def test_adsa_solves_ring():
+    result = solve(
+        coloring_ring(12, 3),
+        "adsa",
+        {"activation": 0.6, "probability": 0.8},
+        rounds=300,
+        seed=2,
+    )
+    assert result["cost"] == 0.0
+
+
+def test_adsa_full_activation_matches_dsa():
+    """activation=1.0 reduces A-DSA to synchronous DSA exactly (same
+    rule, same RNG layout up to the extra wake draw)."""
+    dcop = coloring_ring(10, 3)
+    r = solve(dcop, "adsa", {"activation": 1.0}, rounds=200, seed=5)
+    assert r["cost"] == 0.0
+
+
+def test_adsa_message_accounting_scales_with_activation():
+    problem = compile_dcop(coloring_ring(10, 3))
+    mod = load_algorithm_module("adsa")
+    full = mod.messages_per_round(problem, {"activation": 1.0})
+    half = mod.messages_per_round(problem, {"activation": 0.5})
+    assert full == 2 * 10  # ring: each var has 2 neighbors
+    assert half == 10
+
+
+def test_amaxsum_solves_ring():
+    result = solve(
+        coloring_ring(10, 3),
+        "amaxsum",
+        {"activation": 0.7},
+        rounds=150,
+        seed=3,
+    )
+    assert result["cost"] == 0.0
+
+
+def test_amaxsum_full_activation_equals_sync_maxsum():
+    """With activation=1.0 every edge fires: the message arrays after a
+    run must equal synchronous Max-Sum's (same math, same seed)."""
+    dcop = coloring_ring(8, 3)
+    problem = compile_dcop(dcop)
+    ms = load_algorithm_module("maxsum")
+    ams = load_algorithm_module("amaxsum")
+    p_ms = prepare_algo_params({"damping": 0.5}, ms.algo_params)
+    p_ams = prepare_algo_params(
+        {"damping": 0.5, "activation": 1.0}, ams.algo_params
+    )
+    r_sync = run_batched(problem, ms, p_ms, rounds=40, seed=7)
+    r_async = run_batched(problem, ams, p_ams, rounds=40, seed=7)
+    assert r_sync.best_cost == r_async.best_cost == 0.0
+
+
+def test_amaxsum_message_accounting():
+    problem = compile_dcop(coloring_ring(10, 3))
+    mod = load_algorithm_module("amaxsum")
+    full = mod.messages_per_round(problem, {"activation": 1.0})
+    assert full == 2 * problem.n_real_edges
+    half = mod.messages_per_round(problem, {"activation": 0.5})
+    assert half == problem.n_real_edges
+
+
+def test_engine_reports_activation_scaled_msg_count():
+    dcop = coloring_ring(10, 3)
+    r = solve(dcop, "adsa", {"activation": 0.5}, rounds=100, seed=1)
+    assert r["msg_count"] == 100 * 10
