@@ -80,6 +80,11 @@ def values_from_state(state: Dict[str, jax.Array]) -> jax.Array:
     return state["values"]
 
 
+_DEFAULT_ACTIVATION = next(
+    p.default for p in algo_params if p.name == "activation"
+)
+
+
 def messages_per_round(
     problem: CompiledProblem, params: Optional[Dict[str, Any]] = None
 ) -> int:
@@ -87,7 +92,9 @@ def messages_per_round(
     import numpy as np
 
     total = int(np.asarray(problem.neighbor_mask).sum())
-    activation = 0.5 if params is None else float(params.get("activation", 0.5))
+    activation = float(
+        (params or {}).get("activation", _DEFAULT_ACTIVATION)
+    )
     return max(1, round(activation * total))
 
 

@@ -79,9 +79,16 @@ def step(
     return {"q": q, "r": r, "values": values, "noise": state["noise"]}
 
 
+_DEFAULT_ACTIVATION = next(
+    p.default for p in algo_params if p.name == "activation"
+)
+
+
 def messages_per_round(
     problem: CompiledProblem, params: Optional[Dict[str, Any]] = None
 ) -> int:
     """Expected directed messages per round: activation · 2 · n_edges."""
-    activation = 0.5 if params is None else float(params.get("activation", 0.5))
+    activation = float(
+        (params or {}).get("activation", _DEFAULT_ACTIVATION)
+    )
     return max(1, round(activation * 2 * problem.n_real_edges))

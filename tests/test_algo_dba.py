@@ -1,0 +1,152 @@
+"""DBA / GDBA breakout tests: solving, QLM weight dynamics, modes,
+and sharded (multi-chip emulated) parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import load_algorithm_module, prepare_algo_params
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.engine.batched import run_batched
+from pydcop_tpu.ops.compile import compile_dcop
+from pydcop_tpu.parallel import make_mesh
+
+
+def coloring_ring(n=10, colors=3):
+    d = Domain("colors", "", list(range(colors)))
+    dcop = DCOP(f"ring{n}")
+    vs = [Variable(f"v{i}", d) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    return dcop
+
+
+def two_color_triangle():
+    """3-clique with 2 colors: unsatisfiable, optimum cost 1 — a
+    guaranteed quasi-local-minimum generator."""
+    d = Domain("c", "", [0, 1])
+    dcop = DCOP("tri")
+    vs = [Variable(f"v{i}", d) for i in range(3)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(3):
+        for j in range(i + 1, 3):
+            dcop.add_constraint(
+                constraint_from_str(
+                    f"c{i}{j}", f"1 if v{i} == v{j} else 0", vs
+                )
+            )
+    return dcop
+
+
+def test_dba_solves_ring():
+    r = solve(coloring_ring(12, 3), "dba", rounds=200, seed=1)
+    assert r["cost"] == 0.0
+    a = r["assignment"]
+    for i in range(12):
+        assert a[f"v{i}"] != a[f"v{(i + 1) % 12}"]
+
+
+def test_dba_msg_accounting():
+    r = solve(coloring_ring(10, 3), "dba", rounds=50, seed=0)
+    assert r["msg_count"] == 50 * 2 * 2 * 10  # 2 msgs × Σdeg (=2·10)
+
+
+def test_dba_weights_grow_at_qlm():
+    """On the unsatisfiable triangle the search must hit a QLM and
+    increase some constraint weight above its initial 1.0."""
+    dcop = two_color_triangle()
+    problem = compile_dcop(dcop)
+    mod = load_algorithm_module("dba")
+    params = prepare_algo_params({}, mod.algo_params)
+    key = jax.random.PRNGKey(0)
+    state = mod.init_state(problem, key, params)
+    for i in range(30):
+        state = mod.step(problem, state, jax.random.fold_in(key, i), params)
+    assert float(jnp.max(state["weights"])) > 1.0
+    # best achievable on the triangle is exactly 1 violated edge
+    r = solve(dcop, "dba", rounds=50, seed=0)
+    assert r["cost"] == 1.0
+
+
+def test_dba_sharded_runs():
+    dcop = coloring_ring(24, 3)
+    mesh = make_mesh(8)
+    problem = compile_dcop(dcop, n_shards=8)
+    mod = load_algorithm_module("dba")
+    params = prepare_algo_params({}, mod.algo_params)
+    r = run_batched(problem, mod, params, rounds=120, seed=3, mesh=mesh)
+    assert r.best_cost == 0.0
+
+
+@pytest.mark.parametrize("modifier", ["A", "M"])
+@pytest.mark.parametrize("violation", ["NZ", "NM", "MX"])
+def test_gdba_modes_solve_ring(modifier, violation):
+    r = solve(
+        coloring_ring(10, 3),
+        "gdba",
+        {"modifier": modifier, "violation": violation},
+        rounds=150,
+        seed=2,
+    )
+    assert r["cost"] == 0.0
+
+
+@pytest.mark.parametrize("imode", ["E", "R", "C", "T"])
+def test_gdba_increase_modes_run(imode):
+    r = solve(
+        two_color_triangle(),
+        "gdba",
+        {"increase_mode": imode},
+        rounds=60,
+        seed=1,
+    )
+    assert r["cost"] == 1.0  # triangle optimum
+
+
+def test_gdba_weight_regions():
+    """increase_mode E touches exactly one cell; T the whole matrix."""
+    dcop = two_color_triangle()
+    problem = compile_dcop(dcop)
+    mod = load_algorithm_module("gdba")
+    key = jax.random.PRNGKey(4)
+
+    def run(imode, rounds=25):
+        params = prepare_algo_params(
+            {"increase_mode": imode, "initial": "declared"}, mod.algo_params
+        )
+        state = mod.init_state(problem, key, params)
+        for i in range(rounds):
+            state = mod.step(
+                problem, state, jax.random.fold_in(key, i), params
+            )
+        return np.asarray(state["w2"])
+
+    w_e = run("E")
+    w_t = run("T")
+    # E only ever grows cells that were the current (violated) cell
+    assert (w_e > 0).sum() < w_e.size
+    # T grows whole matrices: any touched matrix is uniformly increased
+    touched = w_t.sum(axis=1) > 0
+    assert touched.any()
+    for row in w_t[touched]:
+        assert np.allclose(row, row[0])
+
+
+def test_gdba_sharded_runs():
+    dcop = coloring_ring(24, 3)
+    mesh = make_mesh(8)
+    problem = compile_dcop(dcop, n_shards=8)
+    mod = load_algorithm_module("gdba")
+    params = prepare_algo_params({}, mod.algo_params)
+    r = run_batched(problem, mod, params, rounds=120, seed=5, mesh=mesh)
+    assert r.best_cost == 0.0

@@ -1,0 +1,99 @@
+"""SyncBB: exactness (vs DPOP), ordering, accounting."""
+
+import random
+
+import pytest
+
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import (
+    NAryMatrixRelation,
+    constraint_from_str,
+)
+from pydcop_tpu.graphs import ordered_graph
+
+
+def coloring_ring(n=8, colors=3):
+    d = Domain("colors", "", list(range(colors)))
+    dcop = DCOP(f"ring{n}")
+    vs = [Variable(f"v{i}", d) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    return dcop
+
+
+def random_dcop(n=7, d_size=3, n_cons=10, seed=0, objective="min"):
+    rnd = random.Random(seed)
+    d = Domain("d", "", list(range(d_size)))
+    dcop = DCOP("rand", objective=objective)
+    vs = [Variable(f"x{i}", d) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    seen = set()
+    for c in range(n_cons):
+        i, j = rnd.sample(range(n), 2)
+        if (min(i, j), max(i, j)) in seen:
+            continue
+        seen.add((min(i, j), max(i, j)))
+        m = NAryMatrixRelation(
+            [vs[i], vs[j]],
+            [[rnd.randint(0, 9) for _ in range(d_size)] for _ in range(d_size)],
+            name=f"c{c}",
+        )
+        dcop.add_constraint(m)
+    return dcop
+
+
+def test_syncbb_solves_ring_optimally():
+    r = solve(coloring_ring(8, 3), "syncbb")
+    assert r["status"] == "finished"
+    assert r["cost"] == 0.0
+    assert r["msg_count"] > 0
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_syncbb_matches_dpop_on_random_problems(seed):
+    dcop = random_dcop(seed=seed)
+    r_bb = solve(dcop, "syncbb")
+    r_dpop = solve(random_dcop(seed=seed), "dpop")
+    assert r_bb["cost"] == pytest.approx(r_dpop["cost"])
+
+
+def test_syncbb_maximize():
+    dcop = random_dcop(seed=5, objective="max")
+    r_bb = solve(dcop, "syncbb")
+    r_dpop = solve(random_dcop(seed=5, objective="max"), "dpop")
+    assert r_bb["cost"] == pytest.approx(r_dpop["cost"])
+    # max-mode must not just return the min solution
+    r_min = solve(random_dcop(seed=5, objective="min"), "dpop")
+    assert r_bb["cost"] >= r_min["cost"]
+
+
+def test_ordered_graph_explicit_ordering():
+    dcop = coloring_ring(5, 3)
+    names = [f"v{i}" for i in range(5)]
+    g = ordered_graph.build_computation_graph(
+        dcop, ordering=list(reversed(names))
+    )
+    assert g.ordering == list(reversed(names))
+    assert g.next_node("v1") == "v0"
+    assert g.previous_node("v0") == "v1"
+    with pytest.raises(ValueError):
+        ordered_graph.build_computation_graph(dcop, ordering=names[:-1])
+
+
+def test_syncbb_footprints():
+    from pydcop_tpu.algorithms import load_algorithm_module
+
+    mod = load_algorithm_module("syncbb")
+    g = ordered_graph.build_computation_graph(coloring_ring(5, 3))
+    n0 = g.node("v0")
+    n4 = g.node("v4")
+    assert mod.computation_memory(n4) > mod.computation_memory(n0)
+    assert mod.communication_load(n4, "v3") > mod.communication_load(n0, "v1")
