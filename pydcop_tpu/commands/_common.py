@@ -8,6 +8,29 @@ import sys
 from typing import Any, Dict, List
 
 
+def load_dcop_and_graph(args):
+    """Shared --graph/--algo resolution + dcop loading for the graph
+    and distribute commands.  Returns (dcop, graph, algo_module)."""
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.graphs import load_graph_module
+
+    if not args.graph and not args.algo:
+        raise SystemExit(f"{args.command}: provide --graph or --algo")
+    algo_module = None
+    graph_model = args.graph
+    if args.algo:
+        from pydcop_tpu.algorithms import load_algorithm_module
+
+        algo_module = load_algorithm_module(args.algo)
+        if graph_model is None:
+            graph_model = algo_module.GRAPH_TYPE
+    dcop = load_dcop_from_file(
+        args.dcop_files if len(args.dcop_files) > 1 else args.dcop_files[0]
+    )
+    graph = load_graph_module(graph_model).build_computation_graph(dcop)
+    return dcop, graph, graph_model, algo_module
+
+
 def parse_algo_params(items: List[str]) -> Dict[str, str]:
     """Parse repeated ``name:value`` CLI parameters."""
     out: Dict[str, str] = {}

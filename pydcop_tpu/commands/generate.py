@@ -1,11 +1,32 @@
-"""``pydcop_tpu generate`` — placeholder, implemented in a later milestone
-(reference: ``pydcop/commands/generate.py``)."""
+"""``pydcop_tpu generate`` (reference: ``pydcop/commands/generate.py``).
+
+Benchmark-problem generators, one sub-subcommand per family:
+``graph_coloring``, ``ising``, ``meeting_scheduling``, ``secp``,
+``agents``.  Each writes a dcop (or agents) yaml to stdout/--output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+
+from pydcop_tpu.commands.generators import GENERATORS
 
 
 def set_parser(subparsers) -> None:
-    p = subparsers.add_parser("generate", help="(not yet implemented)")
-    p.set_defaults(func=run_cmd)
+    p = subparsers.add_parser(
+        "generate", help="generate benchmark DCOP instances"
+    )
+    sub = p.add_subparsers(dest="generator", required=True)
+    # accept the global flags (--output, -t, ...) after the generator
+    # name as well, mirroring the top-level CLI wiring
+    from pydcop_tpu.cli import _SubparsersProxy, _add_global_args
 
-
-def run_cmd(args) -> int:
-    raise SystemExit("generate: not yet implemented in this build")
+    parent = argparse.ArgumentParser(add_help=False)
+    _add_global_args(parent, suppress=True)
+    proxy = _SubparsersProxy(sub, [parent])
+    for name in GENERATORS:
+        mod = importlib.import_module(
+            f"pydcop_tpu.commands.generators.{name}"
+        )
+        mod.set_parser(proxy)

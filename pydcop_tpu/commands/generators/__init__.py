@@ -1,0 +1,15 @@
+"""Benchmark-problem generators (reference: ``pydcop/commands/generators/``).
+
+Each module exports ``set_parser(subparsers)`` registering one
+``pydcop_tpu generate <kind>`` sub-subcommand whose handler builds a
+:class:`~pydcop_tpu.dcop.dcop.DCOP` (or an agents yaml) and writes it
+to stdout / ``--output``.
+"""
+
+GENERATORS = [
+    "graphcoloring",
+    "ising",
+    "meetingscheduling",
+    "secp",
+    "agents",
+]

@@ -1,0 +1,111 @@
+"""SECP (Smart Environment Configuration Problem) generator.
+
+Role-equivalent to the reference's ``generators/secp.py`` /
+``generators/iot.py``: the smart-lighting scenario from the SECP papers.
+Lights are dimmable actuators (variables, levels 0..k); *models* are
+target light levels for zones, expressed as n-ary constraints over the
+lights reaching the zone (cost = |weighted level sum − target|); *rules*
+are scene preferences pinning a light near a level (unary); each light
+also pays an efficiency cost proportional to its level.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+
+import numpy as np
+
+from pydcop_tpu.commands.generators._common import write_dcop
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "secp", help="generate a smart-lighting SECP DCOP"
+    )
+    p.add_argument("--nb_lights", "-l", type=int, required=True)
+    p.add_argument("--nb_models", "-m", type=int, required=True)
+    p.add_argument("--nb_rules", "-r", type=int, required=True)
+    p.add_argument(
+        "--light_levels", type=int, default=5,
+        help="dimmer resolution (domain size)",
+    )
+    p.add_argument(
+        "--model_arity", type=int, default=3,
+        help="max lights per model zone",
+    )
+    p.add_argument(
+        "--efficiency_weight", type=float, default=0.1,
+        help="unary cost per emitted light level",
+    )
+    p.add_argument("--capacity", type=float, default=100.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    return write_dcop(args, generate(args))
+
+
+def generate(args):
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rnd = random.Random(args.seed)
+    levels = args.light_levels
+
+    dcop = DCOP(
+        f"secp_{args.nb_lights}l_{args.nb_models}m_{args.nb_rules}r",
+        objective="min",
+        description="SECP smart lighting, seed %d" % args.seed,
+    )
+    lum = Domain("lum", "luminosity", list(range(levels)))
+
+    lights = []
+    for i in range(args.nb_lights):
+        v = Variable(f"l{i:04d}", lum)
+        lights.append(v)
+        dcop.add_variable(v)
+        # efficiency: cost grows with emitted level
+        cost = np.arange(levels, dtype=np.float32) * args.efficiency_weight
+        dcop.add_constraint(
+            NAryMatrixRelation([v], cost, name=f"eff_{v.name}")
+        )
+
+    max_level = levels - 1
+    for m in range(args.nb_models):
+        arity = rnd.randint(1, min(args.model_arity, args.nb_lights))
+        scope = rnd.sample(lights, arity)
+        target = rnd.uniform(0.3, 1.0) * arity * max_level
+        shape = (levels,) * arity
+        matrix = np.zeros(shape, dtype=np.float32)
+        for idx in itertools.product(range(levels), repeat=arity):
+            matrix[idx] = abs(sum(idx) - target)
+        dcop.add_constraint(
+            NAryMatrixRelation(scope, matrix, name=f"mod{m:03d}")
+        )
+
+    for r in range(args.nb_rules):
+        light = rnd.choice(lights)
+        wanted = rnd.randrange(levels)
+        cost = np.abs(
+            np.arange(levels, dtype=np.float32) - wanted
+        )
+        dcop.add_constraint(
+            NAryMatrixRelation([light], cost, name=f"rule{r:03d}")
+        )
+
+    # one agent per light, as in the IoT deployment story
+    dcop.add_agents(
+        [
+            AgentDef(
+                f"a{i:04d}",
+                capacity=args.capacity,
+                default_hosting_cost=10.0,
+                hosting_costs={lights[i].name: 0.0},
+            )
+            for i in range(args.nb_lights)
+        ]
+    )
+    return dcop

@@ -33,21 +33,9 @@ def set_parser(subparsers) -> None:
 
 
 def run_cmd(args) -> int:
-    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
-    from pydcop_tpu.graphs import load_graph_module
+    from pydcop_tpu.commands._common import load_dcop_and_graph
 
-    if not args.graph and not args.algo:
-        raise SystemExit("graph: provide --graph or --algo")
-    graph_model = args.graph
-    if graph_model is None:
-        from pydcop_tpu.algorithms import load_algorithm_module
-
-        graph_model = load_algorithm_module(args.algo).GRAPH_TYPE
-
-    dcop = load_dcop_from_file(
-        args.dcop_files if len(args.dcop_files) > 1 else args.dcop_files[0]
-    )
-    g = load_graph_module(graph_model).build_computation_graph(dcop)
+    _dcop, g, graph_model, _algo = load_dcop_and_graph(args)
     result = {
         "graph": graph_model,
         "nodes": len(g.nodes),

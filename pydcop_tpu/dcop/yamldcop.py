@@ -430,6 +430,15 @@ def dcop_yaml(dcop: DCOP) -> str:
         agents[a.name] = ad
     data["agents"] = agents
 
+    if dcop.dist_hints is not None:
+        hints: Dict[str, Any] = {}
+        if dcop.dist_hints.must_host_map:
+            hints["must_host"] = dcop.dist_hints.must_host_map
+        if dcop.dist_hints.host_with_map:
+            hints["host_with"] = dcop.dist_hints.host_with_map
+        if hints:
+            data["distribution_hints"] = hints
+
     return yaml.safe_dump(data, sort_keys=False, default_flow_style=None)
 
 

@@ -1,0 +1,53 @@
+"""Distribution (computation → agent placement) strategies.
+
+Role-equivalent to ``pydcop/distribution/``: each strategy module
+exports ``distribute(computation_graph, agentsdef, hints,
+computation_memory, communication_load) -> Distribution`` and
+``distribution_cost(...) -> (total, comm, hosting)``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import pkgutil
+from typing import List
+
+from pydcop_tpu.distribution.objects import (  # noqa: F401
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+)
+
+_PACKAGE = "pydcop_tpu.distribution"
+
+
+def load_distribution_module(name: str):
+    """Import a distribution strategy module by name."""
+    if name.startswith("_") or name == "objects":
+        raise ValueError(f"Unknown distribution method {name!r}")
+    try:
+        mod = importlib.import_module(f"{_PACKAGE}.{name}")
+    except ImportError as e:
+        raise ValueError(
+            f"Could not load distribution {name!r}: {e}; available: "
+            f"{list_available_distributions()}"
+        ) from e
+    if not hasattr(mod, "distribute"):
+        raise ValueError(f"{name!r} is not a distribution method")
+    return mod
+
+
+def list_available_distributions() -> List[str]:
+    import pydcop_tpu.distribution as pkg
+
+    names = []
+    for info in pkgutil.iter_modules(pkg.__path__):
+        if info.name.startswith("_") or info.name == "objects":
+            continue
+        try:
+            mod = importlib.import_module(f"{_PACKAGE}.{info.name}")
+        except ImportError:
+            continue  # an unimportable strategy must not hide the rest
+        if hasattr(mod, "distribute"):
+            names.append(info.name)
+    return sorted(names)

@@ -51,6 +51,10 @@ class DistributionHints(SimpleRepr):
     def must_host_map(self) -> Dict[str, List[str]]:
         return {k: list(v) for k, v in self._must_host.items()}
 
+    @property
+    def host_with_map(self) -> Dict[str, List[str]]:
+        return {k: list(v) for k, v in self._host_with.items()}
+
     def _simple_repr(self) -> dict:
         from pydcop_tpu.utils.simple_repr import _CLASS_KEY, _MODULE_KEY, simple_repr
 

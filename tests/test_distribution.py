@@ -1,0 +1,196 @@
+"""Tests for the distribution (placement) strategies (L4)."""
+
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.distribution import (
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+    list_available_distributions,
+    load_distribution_module,
+)
+from pydcop_tpu.graphs import constraints_hypergraph, factor_graph
+
+D = Domain("d", "", [0, 1, 2])
+
+
+def ring_dcop(n=4):
+    dcop = DCOP("ring")
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}_{j}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    return dcop
+
+
+def agents(n, **kwargs):
+    return [AgentDef(f"a{i}", **kwargs) for i in range(n)]
+
+
+def mem_one(node):
+    return 1.0
+
+
+def load_one(node, neighbor):
+    return 1.0
+
+
+def test_registry():
+    avail = list_available_distributions()
+    for name in ("oneagent", "adhoc", "heur_comhost", "ilp_fgdp", "ilp_compref"):
+        assert name in avail
+    with pytest.raises(ValueError):
+        load_distribution_module("objects")
+    with pytest.raises(ValueError):
+        load_distribution_module("nope")
+
+
+def test_oneagent_basic():
+    g = constraints_hypergraph.build_computation_graph(ring_dcop(4))
+    mod = load_distribution_module("oneagent")
+    dist = mod.distribute(g, agents(4))
+    assert sorted(dist.computations) == ["v0", "v1", "v2", "v3"]
+    # one computation per agent
+    for a in dist.agents:
+        assert len(dist.computations_hosted(a)) <= 1
+
+
+def test_oneagent_not_enough_agents():
+    g = constraints_hypergraph.build_computation_graph(ring_dcop(4))
+    mod = load_distribution_module("oneagent")
+    with pytest.raises(ImpossibleDistributionException):
+        mod.distribute(g, agents(3))
+
+
+def test_adhoc_respects_capacity():
+    g = constraints_hypergraph.build_computation_graph(ring_dcop(4))
+    mod = load_distribution_module("adhoc")
+    dist = mod.distribute(
+        g, agents(2, capacity=2.0), computation_memory=mem_one
+    )
+    assert sorted(dist.computations) == ["v0", "v1", "v2", "v3"]
+    for a in dist.agents:
+        assert len(dist.computations_hosted(a)) == 2
+    with pytest.raises(ImpossibleDistributionException):
+        mod.distribute(g, agents(1, capacity=2.0), computation_memory=mem_one)
+
+
+def test_adhoc_hints():
+    g = constraints_hypergraph.build_computation_graph(ring_dcop(4))
+    mod = load_distribution_module("adhoc")
+    hints = DistributionHints(
+        must_host={"a0": ["v2"]}, host_with={"v2": ["v3"]}
+    )
+    dist = mod.distribute(g, agents(2), hints=hints)
+    assert dist.agent_for("v2") == "a0"
+    assert dist.agent_for("v3") == "a0"
+
+
+def test_heur_comhost_prefers_cheap_hosting():
+    g = constraints_hypergraph.build_computation_graph(ring_dcop(3))
+    mod = load_distribution_module("heur_comhost")
+    ags = [
+        AgentDef("cheap", default_hosting_cost=0.0, default_route=0.0),
+        AgentDef("dear", default_hosting_cost=10.0, default_route=0.0),
+    ]
+    dist = mod.distribute(
+        g, ags, computation_memory=mem_one, communication_load=load_one
+    )
+    # with free routes, everything lands on the cheap-host agent
+    assert dist.computations_hosted("cheap") and not dist.computations_hosted(
+        "dear"
+    )
+
+
+def test_heur_comhost_groups_neighbors():
+    g = constraints_hypergraph.build_computation_graph(ring_dcop(4))
+    mod = load_distribution_module("heur_comhost")
+    # routes are expensive, hosting free: placement should co-locate
+    dist = mod.distribute(
+        g,
+        agents(4, default_route=100.0),
+        computation_memory=mem_one,
+        communication_load=load_one,
+    )
+    # all computations on a single agent minimizes the greedy objective
+    hosting = [a for a in dist.agents if dist.computations_hosted(a)]
+    assert len(hosting) == 1
+
+
+@pytest.mark.parametrize("name", ["ilp_fgdp", "ilp_compref"])
+def test_ilp_colocates_under_expensive_routes(name):
+    g = factor_graph.build_computation_graph(ring_dcop(3))
+    mod = load_distribution_module(name)
+    dist = mod.distribute(
+        g,
+        agents(2, capacity=100.0, default_route=10.0),
+        computation_memory=mem_one,
+        communication_load=load_one,
+    )
+    hosting = [a for a in dist.agents if dist.computations_hosted(a)]
+    assert len(hosting) == 1  # optimal: zero cut edges
+    assert len(dist.computations) == 6  # 3 variables + 3 factors
+
+
+def test_ilp_capacity_forces_split():
+    g = constraints_hypergraph.build_computation_graph(ring_dcop(4))
+    mod = load_distribution_module("ilp_compref")
+    dist = mod.distribute(
+        g,
+        agents(2, capacity=2.0),
+        computation_memory=mem_one,
+        communication_load=load_one,
+    )
+    for a in dist.agents:
+        assert len(dist.computations_hosted(a)) == 2
+    # optimal split of a 4-ring in halves cuts exactly 2 edges
+    total, comm, hosting = mod.distribution_cost(
+        dist, g, agents(2, capacity=2.0), mem_one, load_one
+    )
+    assert comm == pytest.approx(2.0)
+
+
+def test_ilp_must_host_pin():
+    g = constraints_hypergraph.build_computation_graph(ring_dcop(3))
+    mod = load_distribution_module("ilp_compref")
+    hints = DistributionHints(must_host={"a1": ["v0"]})
+    dist = mod.distribute(
+        g,
+        agents(2, default_route=10.0),
+        hints=hints,
+        communication_load=load_one,
+    )
+    assert dist.agent_for("v0") == "a1"
+    # colocated with pin: everything follows v0 to a1
+    assert dist.agent_for("v1") == "a1"
+
+
+def test_ilp_infeasible():
+    g = constraints_hypergraph.build_computation_graph(ring_dcop(4))
+    mod = load_distribution_module("ilp_fgdp")
+    with pytest.raises(ImpossibleDistributionException):
+        mod.distribute(
+            g, agents(1, capacity=3.0), computation_memory=mem_one
+        )
+
+
+def test_distribution_cost_breakdown():
+    g = constraints_hypergraph.build_computation_graph(ring_dcop(4))
+    from pydcop_tpu.distribution._cost import RATIO_HOST_COMM, distribution_cost
+
+    dist = Distribution({"a0": ["v0", "v1"], "a1": ["v2", "v3"]})
+    ags = agents(2, default_hosting_cost=1.0, default_route=2.0)
+    total, comm, hosting = distribution_cost(
+        dist, g, ags, mem_one, load_one
+    )
+    # ring v0-v1-v2-v3-v0 split in halves cuts c1_2 and c3_0: 2 links × route 2
+    assert comm == pytest.approx(4.0)
+    assert hosting == pytest.approx(4.0)
+    assert total == pytest.approx(comm + RATIO_HOST_COMM * hosting)

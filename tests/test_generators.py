@@ -1,0 +1,133 @@
+"""Tests for the benchmark-problem generators and the generate /
+distribute CLI commands (reference: ``pydcop/commands/generators``)."""
+
+import json
+
+from tests.test_cli import run_cli
+
+from pydcop_tpu.dcop.yamldcop import load_dcop
+
+
+def gen(tmp_path, *args):
+    out = tmp_path / "out.yaml"
+    r = run_cli("generate", *args, "--output", str(out))
+    assert r.returncode == 0, r.stderr
+    return load_dcop(out.read_text()), out
+
+
+def test_graph_coloring_grid(tmp_path):
+    dcop, _ = gen(
+        tmp_path,
+        "graph_coloring", "-n", "9", "-c", "3", "--graph", "grid",
+    )
+    assert len(dcop.variables) == 9
+    # 3x3 grid: 12 edges
+    assert len(dcop.constraints) == 12
+    assert len(dcop.agents) == 9
+    for c in dcop.constraints.values():
+        assert c.arity == 2
+
+
+def test_graph_coloring_soft_noise_roundtrip(tmp_path):
+    dcop, out = gen(
+        tmp_path,
+        "graph_coloring", "-n", "6", "-c", "3", "--soft",
+        "--noise", "0.05", "--seed", "7",
+    )
+    # noisy cost variables survive the yaml round-trip
+    again = load_dcop(out.read_text())
+    v = next(iter(again.variables.values()))
+    assert v.cost_for_val(again.domains["colors"].values[0]) > 0
+
+
+def test_graph_coloring_deterministic(tmp_path):
+    _, out1 = gen(tmp_path, "graph_coloring", "-n", "8", "--seed", "3")
+    text1 = out1.read_text()
+    _, out2 = gen(tmp_path, "graph_coloring", "-n", "8", "--seed", "3")
+    assert out2.read_text() == text1
+
+
+def test_graph_coloring_scalefree(tmp_path):
+    dcop, _ = gen(
+        tmp_path,
+        "graph_coloring", "-n", "12", "--graph", "scalefree", "-m", "2",
+    )
+    assert len(dcop.variables) == 12
+    assert len(dcop.constraints) >= 12
+
+
+def test_ising(tmp_path):
+    dcop, _ = gen(tmp_path, "ising", "--row_count", "4")
+    assert len(dcop.variables) == 16
+    # 4x4 torus: 32 couplings + 16 fields
+    binary = [c for c in dcop.constraints.values() if c.arity == 2]
+    unary = [c for c in dcop.constraints.values() if c.arity == 1]
+    assert len(binary) == 32
+    assert len(unary) == 16
+
+
+def test_meeting_scheduling(tmp_path):
+    dcop, _ = gen(
+        tmp_path,
+        "meeting_scheduling", "-s", "4", "-e", "3", "-r", "3",
+        "--max_resources_event", "2",
+    )
+    # PEAV: one variable per (event, resource) attendance
+    assert len(dcop.variables) == 6
+    assert dcop.dist_hints is not None
+    pinned = [
+        c for cs in dcop.dist_hints.must_host_map.values() for c in cs
+    ]
+    assert sorted(pinned) == sorted(dcop.variables)
+
+
+def test_secp(tmp_path):
+    dcop, _ = gen(
+        tmp_path, "secp", "-l", "5", "-m", "3", "-r", "2",
+    )
+    assert len(dcop.variables) == 5
+    names = set(dcop.constraints)
+    assert sum(n.startswith("eff_") for n in names) == 5
+    assert sum(n.startswith("mod") for n in names) == 3
+    assert sum(n.startswith("rule") for n in names) == 2
+
+
+def test_agents_generator(tmp_path):
+    out = tmp_path / "agents.yaml"
+    r = run_cli(
+        "generate", "agents", "-n", "4", "--capacity", "42",
+        "--output", str(out),
+    )
+    assert r.returncode == 0, r.stderr
+    import yaml
+
+    data = yaml.safe_load(out.read_text())
+    assert len(data["agents"]) == 4
+    assert all(a["capacity"] == 42 for a in data["agents"].values())
+
+
+def test_generate_then_solve(tmp_path):
+    _, out = gen(
+        tmp_path, "graph_coloring", "-n", "6", "-c", "3", "--soft",
+    )
+    r = run_cli("solve", str(out), "-a", "dsa", "--rounds", "30")
+    assert r.returncode == 0, r.stderr
+    result = json.loads(r.stdout)
+    assert result["status"] == "finished"
+
+
+def test_distribute_command(tmp_path):
+    _, out = gen(tmp_path, "graph_coloring", "-n", "6", "-c", "3")
+    mapping_file = tmp_path / "dist.yaml"
+    r = run_cli(
+        "distribute", str(out), "-d", "heur_comhost", "-a", "dsa",
+        "--output", str(mapping_file),
+    )
+    assert r.returncode == 0, r.stderr
+    result = json.loads(r.stdout)
+    assert "cost" in result and "distribution" in result
+    import yaml
+
+    mapping = yaml.safe_load(mapping_file.read_text())["distribution"]
+    hosted = sorted(c for comps in mapping.values() for c in comps)
+    assert hosted == [f"v{i:05d}" for i in range(6)]
