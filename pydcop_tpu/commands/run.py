@@ -1,11 +1,89 @@
-"""``pydcop_tpu run`` — placeholder, implemented in a later milestone
-(reference: ``pydcop/commands/run.py``)."""
+"""``pydcop_tpu run`` (reference: ``pydcop/commands/run.py``).
+
+Solve a DCOP while playing a scenario of dynamic events (agent
+departures/arrivals, external-variable changes), with optional
+k-resilient replication + repair.  Prints the result JSON including the
+event log.
+"""
+
+from __future__ import annotations
+
+from pydcop_tpu.commands._common import (
+    add_collect_arguments,
+    parse_algo_params,
+    write_metrics,
+    write_result,
+)
 
 
 def set_parser(subparsers) -> None:
-    p = subparsers.add_parser("run", help="(not yet implemented)")
+    p = subparsers.add_parser(
+        "run", help="solve a DCOP while playing a dynamic scenario"
+    )
+    p.add_argument("dcop_files", nargs="+", help="dcop yaml file(s)")
+    p.add_argument("-a", "--algo", required=True, help="algorithm name")
+    p.add_argument(
+        "-p", "--algo_params", action="append", default=[],
+        metavar="NAME:VALUE", help="algorithm parameter (repeatable)",
+    )
+    p.add_argument(
+        "-s", "--scenario", required=True, help="scenario yaml file"
+    )
+    p.add_argument(
+        "-d", "--distribution", default="oneagent",
+        help="distribution strategy for the initial placement",
+    )
+    p.add_argument(
+        "-k", "--ktarget", type=int, default=1,
+        help="replicas per computation (0 disables replication)",
+    )
+    p.add_argument(
+        "--rounds_per_second", type=float, default=20.0,
+        help="scenario delay seconds → engine rounds scale",
+    )
+    p.add_argument(
+        "--final_rounds", type=int, default=100,
+        help="rounds before the first and after the last event",
+    )
+    p.add_argument(
+        "--repair_algo", default="mgm",
+        help="algorithm solving the reparation DCOP",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    add_collect_arguments(p)
     p.set_defaults(func=run_cmd)
 
 
 def run_cmd(args) -> int:
-    raise SystemExit("run: not yet implemented in this build")
+    from pydcop_tpu.dcop.yamldcop import (
+        load_dcop_from_file,
+        load_scenario_from_file,
+    )
+    from pydcop_tpu.distribution import ImpossibleDistributionException
+    from pydcop_tpu.engine.dynamic import run_dynamic
+
+    dcop = load_dcop_from_file(
+        args.dcop_files if len(args.dcop_files) > 1 else args.dcop_files[0]
+    )
+    scenario = load_scenario_from_file(args.scenario)
+    params = parse_algo_params(args.algo_params)
+    try:
+        result = run_dynamic(
+            dcop,
+            args.algo,
+            params,
+            scenario=scenario,
+            distribution=args.distribution,
+            k_target=args.ktarget,
+            rounds_per_second=args.rounds_per_second,
+            final_rounds=args.final_rounds,
+            seed=args.seed,
+            timeout=args.timeout,
+            repair_algo=args.repair_algo,
+        )
+    except (ValueError, ImpossibleDistributionException) as e:
+        raise SystemExit(f"run: {e}")
+    write_metrics(args, result)
+    result.pop("cost_trace", None)
+    write_result(args, result)
+    return 0

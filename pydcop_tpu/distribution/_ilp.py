@@ -64,19 +64,21 @@ def solve_ilp_placement(
 
     n_x = n_c * n_a
 
-    # pairwise communication terms (only pairs with nonzero load)
-    pairs: List[Tuple[int, int, float]] = []
+    # pairwise communication terms; a pair connected by several links
+    # accumulates each link's load, matching distribution_cost's
+    # per-link summation (pydcop_tpu/distribution/_cost.py)
+    pair_load: Dict[Tuple[int, int], float] = {}
     if communication_load is not None and comm_w != 0.0:
-        seen = set()
         for link in computation_graph.links:
             members = [m for m in link.nodes if m in nodes]
             for c1, c2 in combinations(sorted(members), 2):
-                if (c1, c2) in seen:
-                    continue
-                seen.add((c1, c2))
                 load = float(communication_load(nodes[c1], c2))
                 if load:
-                    pairs.append((cidx[c1], cidx[c2], load))
+                    key = (cidx[c1], cidx[c2])
+                    pair_load[key] = pair_load.get(key, 0.0) + load
+    pairs: List[Tuple[int, int, float]] = [
+        (c1, c2, load) for (c1, c2), load in sorted(pair_load.items())
+    ]
 
     # z variables: one per (pair, a, b) with a != b and route > 0
     z_entries: List[Tuple[int, int, int, int, float]] = []

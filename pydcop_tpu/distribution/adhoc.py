@@ -95,7 +95,12 @@ def distribute(
         group_foot = foot + sum(
             _footprint(nodes[c], computation_memory) for c in group
         )
-        best = max(remaining_cap, key=lambda a: remaining_cap[a])
+        # most remaining capacity, then fewest hosted computations (so
+        # zero-footprint problems still spread), then name for determinism
+        best = max(
+            remaining_cap,
+            key=lambda a: (remaining_cap[a], -len(mapping[a]), a),
+        )
         if remaining_cap[best] < group_foot:
             raise ImpossibleDistributionException(
                 f"No agent has capacity {group_foot:.1f} for {comp} "

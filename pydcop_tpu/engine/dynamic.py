@@ -1,0 +1,320 @@
+"""Dynamic (scenario-driven) runs with replication and repair.
+
+Role-equivalent to the reference's ``pydcop run`` path (SURVEY §3.5:
+``commands/run.py`` → orchestrator playing ``Scenario`` events against
+``ResilientAgent``s).  The TPU engine's state is a pytree of arrays, so
+dynamics become:
+
+- **delay event** — solve for a deterministic round budget
+  (``delay × rounds_per_second``; the batched engine is synchronous, so
+  wall-clock delays map to round budgets for reproducibility).
+- **remove_agent** — the agent's computations are orphaned; agents
+  holding their replicas decide new hosts by solving a *reparation
+  DCOP* on this same engine (``replication.repair``); computations with
+  no live replica are **lost**: their variable freezes at its last
+  value (it becomes an external variable) and the remaining problem is
+  recompiled and resumed from the carried state.
+- **add_agent** — joins the live pool (hosts future replicas/repairs).
+- **set_value** — an external variable changes; constraints are
+  re-sliced at the new value (recompile) and solving resumes.
+
+Between events the solve state carries over: current values re-enter
+the recompiled problem as declared initial values.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, ExternalVariable
+from pydcop_tpu.dcop.scenario import Scenario
+
+
+def run_dynamic(
+    dcop: DCOP,
+    algo: str,
+    algo_params: Optional[Dict[str, Any]] = None,
+    scenario: Optional[Scenario] = None,
+    distribution: Union[str, "Distribution"] = "oneagent",
+    k_target: int = 0,
+    rounds_per_second: float = 20.0,
+    final_rounds: int = 100,
+    seed: int = 0,
+    timeout: Optional[float] = None,
+    repair_algo: str = "mgm",
+) -> Dict[str, Any]:
+    """Play a scenario against a DCOP and return the result dict
+    (reference ``pydcop run`` JSON shape + ``events`` log)."""
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.distribution import load_distribution_module
+    from pydcop_tpu.distribution.objects import Distribution
+    from pydcop_tpu.graphs import load_graph_module
+    from pydcop_tpu.replication import (
+        repair_placement,
+        replica_distribution,
+    )
+
+    t0 = time.perf_counter()
+    module = load_algorithm_module(algo)
+    if not hasattr(module, "step"):
+        raise ValueError(
+            f"Dynamic runs need a batched algorithm; {algo!r} is "
+            "host-side (exact) — use a local-search or max-sum algorithm"
+        )
+    param_names = {p.name for p in module.algo_params}
+    if "initial" not in param_names:
+        raise ValueError(
+            f"Algorithm {algo!r} does not support value carry-over "
+            "(no 'initial' parameter); pick one that does"
+        )
+    params = prepare_algo_params(algo_params, module.algo_params)
+
+    graph_module = load_graph_module(module.GRAPH_TYPE)
+    graph = graph_module.build_computation_graph(dcop)
+    computation_memory = getattr(module, "computation_memory", None)
+    nodes = {n.name: n for n in graph.nodes}
+
+    def footprint(comp: str) -> float:
+        if computation_memory is None or comp not in nodes:
+            return 1.0
+        return float(computation_memory(nodes[comp]))
+
+    live_agents: Dict[str, AgentDef] = dict(dcop.agents)
+    if isinstance(distribution, Distribution):
+        dist = distribution
+    else:
+        dist_module = load_distribution_module(distribution)
+        dist = dist_module.distribute(
+            graph,
+            live_agents.values(),
+            hints=dcop.dist_hints,
+            computation_memory=computation_memory,
+            communication_load=getattr(module, "communication_load", None),
+        )
+
+    replicas = (
+        replica_distribution(
+            dist, live_agents.values(), k_target, footprint=footprint
+        )
+        if k_target > 0
+        else None
+    )
+
+    # mutable run state
+    frozen: Dict[str, Any] = {}  # lost variable → frozen value
+    ext_overrides: Dict[str, Any] = {}
+    current_values: Dict[str, Any] = {}
+    events_log: List[Dict[str, Any]] = []
+    traces: List[np.ndarray] = []
+    cycles = 0
+    messages = 0
+    status = "finished"
+
+    def active_dcop() -> DCOP:
+        """The current solvable problem: frozen variables become
+        external (constant at their last value), external overrides
+        applied, only live agents."""
+        d = DCOP(dcop.name, objective=dcop.objective)
+        for v in dcop.variables.values():
+            if v.name in frozen:
+                d.add_variable(
+                    ExternalVariable(v.name, v.domain, frozen[v.name])
+                )
+            else:
+                d.add_variable(v)
+        for ev in dcop.external_variables.values():
+            d.add_variable(
+                ExternalVariable(
+                    ev.name, ev.domain, ext_overrides.get(ev.name, ev.value)
+                )
+            )
+        for c in dcop.constraints.values():
+            d.add_constraint(c)
+        d.add_agents(live_agents.values())
+        return d
+
+    def run_segment(n_rounds: int, seg_seed: int) -> None:
+        nonlocal cycles, messages, current_values, status
+        import dataclasses as dc
+
+        from pydcop_tpu.engine.batched import run_batched
+        from pydcop_tpu.ops.compile import compile_dcop, encode_assignment
+
+        ad = active_dcop()
+        if not ad.variables:
+            return  # everything frozen/lost
+        problem = compile_dcop(ad)
+        seg_params = dict(params)
+        if current_values:
+            known = {
+                name: current_values[name]
+                for name in problem.var_names
+                if name in current_values
+            }
+            if len(known) == len(problem.var_names):
+                problem = dc.replace(
+                    problem, init_idx=encode_assignment(problem, known)
+                )
+                seg_params["initial"] = "declared"
+        remaining = (
+            None if timeout is None else timeout - (time.perf_counter() - t0)
+        )
+        result = run_batched(
+            problem,
+            module,
+            seg_params,
+            rounds=n_rounds,
+            seed=seg_seed,
+            timeout=remaining,
+        )
+        cycles += result.cycles
+        messages += result.messages
+        traces.append(np.asarray(result.cost_trace))
+        current_values.update(result.assignment)
+        if result.status == "timeout":
+            status = "timeout"
+
+    def remove_agent(name: str) -> Dict[str, Any]:
+        nonlocal replicas, dist
+        if name not in live_agents:
+            return {"action": "remove_agent", "agent": name, "error": "unknown"}
+        live_agents.pop(name)
+        orphans = (
+            dist.computations_hosted(name) if name in dist.agents else []
+        )
+        for comp in orphans:
+            dist.remove_computation(comp)
+        candidates = {
+            comp: [
+                a
+                for a in (replicas.replicas(comp) if replicas else [])
+                if a in live_agents
+            ]
+            for comp in orphans
+        }
+        remaining_cap = {
+            a: live_agents[a].capacity
+            - sum(footprint(c) for c in dist.computations_hosted(a))
+            for a in live_agents
+        }
+        placed = repair_placement(
+            candidates,
+            live_agents.values(),
+            remaining_capacity=remaining_cap,
+            footprint=footprint,
+            algo=repair_algo,
+            seed=seed,
+        )
+        lost = []
+        for comp in orphans:
+            if comp in placed:
+                dist.host_on_agent(placed[comp], [comp])
+            else:
+                lost.append(comp)
+                if comp in dcop.variables:
+                    frozen[comp] = current_values.get(
+                        comp, dcop.variables[comp].domain[0]
+                    )
+        # re-establish k-resilience over the survivors
+        if replicas is not None and live_agents:
+            replicas = replica_distribution(
+                dist, live_agents.values(), k_target, footprint=footprint
+            )
+        return {
+            "action": "remove_agent",
+            "agent": name,
+            "orphaned": sorted(orphans),
+            "migrated": placed,
+            "lost": sorted(lost),
+        }
+
+    # initial settle: run one segment before the first event, as the
+    # reference deploys + runs before playing the scenario
+    rng_seq = seed
+    run_segment(final_rounds, rng_seq)
+
+    for event in scenario or Scenario():
+        if timeout is not None and time.perf_counter() - t0 > timeout:
+            status = "timeout"
+            break
+        if event.is_delay:
+            n = max(1, int(round(event.delay * rounds_per_second)))
+            rng_seq += 1
+            run_segment(n, rng_seq)
+            events_log.append({"type": "delay", "rounds": n})
+            continue
+        for action in event.actions or []:
+            args = action.args
+            if action.type == "remove_agent":
+                entry = remove_agent(args["agent"])
+            elif action.type == "add_agent":
+                name = args["agent"]
+                live_agents[name] = AgentDef(
+                    name, capacity=float(args.get("capacity", 100.0))
+                )
+                if replicas is not None:
+                    replicas = replica_distribution(
+                        dist,
+                        live_agents.values(),
+                        k_target,
+                        footprint=footprint,
+                    )
+                entry = {"action": "add_agent", "agent": name}
+            elif action.type == "set_value":
+                vname = args["variable"]
+                if vname not in dcop.external_variables:
+                    entry = {
+                        "action": "set_value",
+                        "variable": vname,
+                        "error": "not an external variable",
+                    }
+                else:
+                    ev = dcop.external_variables[vname]
+                    value = ev.domain.to_domain_value(args["value"])
+                    ext_overrides[vname] = value
+                    entry = {
+                        "action": "set_value",
+                        "variable": vname,
+                        "value": value,
+                    }
+            else:
+                entry = {"action": action.type, "error": "unknown action"}
+            events_log.append({"type": "event", "id": event.id, **entry})
+
+    # final settle after the last event
+    rng_seq += 1
+    run_segment(final_rounds, rng_seq)
+
+    assignment = {
+        name: current_values.get(name, frozen.get(name))
+        for name in dcop.variables
+    }
+    ext_vals = {
+        name: ext_overrides.get(name, ev.value)
+        for name, ev in dcop.external_variables.items()
+    }
+    cost = dcop.solution_cost({**assignment, **ext_vals})
+    trace = (
+        np.concatenate(traces) if traces else np.zeros(0, dtype=np.float32)
+    )
+    return {
+        "assignment": assignment,
+        "cost": cost,
+        "cycle": cycles,
+        "msg_count": messages,
+        "msg_size": messages,
+        "status": status,
+        "time": time.perf_counter() - t0,
+        "events": events_log,
+        "lost_computations": sorted(frozen),
+        "agents_final": sorted(live_agents),
+        "replicas": replicas.mapping if replicas is not None else None,
+        "cost_trace": trace.tolist(),
+    }

@@ -1,0 +1,16 @@
+"""k-resilient computation replication (reference: ``pydcop/replication/``).
+
+``ucs_hostingcosts`` places k replicas of every active computation on
+other agents, minimizing hosting + route costs (the reference's DRPM
+distributed-UCS semantics, computed as a host-side control-plane step —
+see the module docstring for the equivalence argument).  ``repair``
+re-hosts orphaned computations after an agent departure by building a
+small *reparation DCOP* and solving it with this framework's own
+batched engine.
+"""
+
+from pydcop_tpu.replication.ucs_hostingcosts import (  # noqa: F401
+    ReplicaDistribution,
+    replica_distribution,
+)
+from pydcop_tpu.replication.repair import repair_placement  # noqa: F401

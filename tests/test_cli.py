@@ -110,3 +110,35 @@ def test_solve_multiple_files(ring_yaml, tmp_path):
     assert r.returncode == 0, r.stderr
     result = json.loads(r.stdout)
     assert result["status"] == "finished"
+
+
+def test_run_command_with_scenario(ring_yaml, tmp_path):
+    scenario = tmp_path / "scenario.yaml"
+    scenario.write_text(
+        "events:\n"
+        "  - id: e1\n"
+        "    actions:\n"
+        "      - type: remove_agent\n"
+        "        agent: a0\n"
+        "  - delay: 0.2\n"
+    )
+    r = run_cli(
+        "run", ring_yaml, "-a", "dsa", "-s", str(scenario),
+        "-k", "1", "--final_rounds", "30",
+    )
+    assert r.returncode == 0, r.stderr
+    result = json.loads(r.stdout)
+    assert result["lost_computations"] == []
+    assert "a0" not in result["agents_final"]
+    assert any(
+        e.get("action") == "remove_agent" for e in result["events"]
+    )
+
+
+def test_replica_dist_command(ring_yaml):
+    r = run_cli("replica_dist", ring_yaml, "-k", "2", "-a", "dsa")
+    assert r.returncode == 0, r.stderr
+    result = json.loads(r.stdout)
+    assert result["ktarget"] == 2
+    for comp, reps in result["replica_distribution"].items():
+        assert len(reps) == 2

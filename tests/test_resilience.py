@@ -1,0 +1,281 @@
+"""Tests for replication (UCS replica placement), repair (reparation
+DCOP), and dynamic scenario runs (reference: ``pydcop/replication/`` +
+``pydcop run``)."""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import (
+    AgentDef,
+    Domain,
+    ExternalVariable,
+    Variable,
+)
+from pydcop_tpu.dcop.relations import NAryMatrixRelation, constraint_from_str
+from pydcop_tpu.dcop.scenario import EventAction, Scenario, ScenarioEvent
+from pydcop_tpu.distribution.objects import Distribution
+from pydcop_tpu.engine.dynamic import run_dynamic
+from pydcop_tpu.replication import (
+    ReplicaDistribution,
+    repair_placement,
+    replica_distribution,
+)
+from pydcop_tpu.replication.repair import build_reparation_dcop
+
+D = Domain("d", "", [0, 1, 2])
+
+
+def ring_dcop(n=4):
+    dcop = DCOP("ring")
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}_{j}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n)])
+    return dcop
+
+
+# -- replica placement -------------------------------------------------
+
+
+def test_replicas_prefer_cheap_hosting():
+    agents = [
+        AgentDef("h", default_hosting_cost=0.0),
+        AgentDef("cheap", default_hosting_cost=1.0),
+        AgentDef("mid", default_hosting_cost=5.0),
+        AgentDef("dear", default_hosting_cost=50.0),
+    ]
+    dist = Distribution({"h": ["c1"], "cheap": [], "mid": [], "dear": []})
+    rep = replica_distribution(dist, agents, k=2)
+    # host excluded; two cheapest (route 1 everywhere) win
+    assert rep.replicas("c1") == ["cheap", "mid"]
+
+
+def test_replicas_respect_capacity():
+    agents = [
+        AgentDef("h", capacity=10),
+        AgentDef("small", capacity=1.0, default_hosting_cost=0.0),
+        AgentDef("big", capacity=10.0, default_hosting_cost=2.0),
+    ]
+    dist = Distribution({"h": ["c1", "c2"], "small": [], "big": []})
+    rep = replica_distribution(
+        dist, agents, k=2, footprint=lambda c: 1.0
+    )
+    # small takes one replica then is full; big takes the rest
+    assert rep.replicas("c1") == ["small", "big"]
+    assert rep.replicas("c2") == ["big"]
+
+
+def test_replicas_multi_hop_route():
+    # direct route h->far is 10, but h->relay->far is 1+1
+    agents = [
+        AgentDef("h", routes={"far": 10.0, "relay": 1.0}),
+        AgentDef("relay", routes={"h": 1.0, "far": 1.0}),
+        AgentDef(
+            "far",
+            routes={"h": 10.0, "relay": 1.0},
+            default_hosting_cost=0.0,
+        ),
+    ]
+    dist = Distribution({"h": ["c1"], "relay": [], "far": []})
+    rep = replica_distribution(dist, agents, k=2)
+    assert set(rep.replicas("c1")) == {"relay", "far"}
+    # ordering by cost: relay at path 1 + hosting 0 = 1, far at 2 + 0 = 2
+    assert rep.replicas("c1") == ["relay", "far"]
+
+
+def test_replica_distribution_repr_roundtrip():
+    from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+    rep = ReplicaDistribution({"c1": ["a1", "a2"], "c2": []})
+    assert from_repr(simple_repr(rep)) == rep
+
+
+# -- repair ------------------------------------------------------------
+
+
+def test_reparation_dcop_shape():
+    agents = {
+        "a1": AgentDef("a1", default_hosting_cost=1.0),
+        "a2": AgentDef("a2", default_hosting_cost=2.0),
+    }
+    dcop = build_reparation_dcop(
+        {"c1": ["a1", "a2"], "c2": ["a1", "a2"]}, agents
+    )
+    assert sorted(dcop.variables) == ["c1", "c2"]
+    # unary hosting costs + one concentration constraint
+    assert "host_c1" in dcop.constraints
+    assert "conc_c1_c2" in dcop.constraints
+
+
+def test_repair_spreads_on_capacity():
+    agents = [
+        AgentDef("a1", default_hosting_cost=0.0),
+        AgentDef("a2", default_hosting_cost=0.1),
+    ]
+    placed = repair_placement(
+        {"c1": ["a1", "a2"], "c2": ["a1", "a2"]},
+        agents,
+        remaining_capacity={"a1": 1.0, "a2": 1.0},
+        footprint=lambda c: 1.0,
+        seed=1,
+    )
+    # both hosted, on different agents (capacity 1 each)
+    assert sorted(placed) == ["c1", "c2"]
+    assert placed["c1"] != placed["c2"]
+
+
+def test_repair_lost_computation():
+    placed = repair_placement(
+        {"c1": ["a1"], "c2": []}, [AgentDef("a1")]
+    )
+    assert placed == {"c1": "a1"}
+
+
+def test_repair_single_candidate_no_engine():
+    # all-singleton candidate lists take the fast path (no solve)
+    placed = repair_placement(
+        {"c1": ["a2"], "c2": ["a3"]},
+        [AgentDef("a2"), AgentDef("a3")],
+    )
+    assert placed == {"c1": "a2", "c2": "a3"}
+
+
+# -- dynamic runs ------------------------------------------------------
+
+
+def test_dynamic_no_scenario():
+    result = run_dynamic(
+        ring_dcop(), "dsa", {"variant": "B"}, final_rounds=40, seed=2
+    )
+    assert result["status"] == "finished"
+    assert sorted(result["assignment"]) == ["v0", "v1", "v2", "v3"]
+    assert result["lost_computations"] == []
+
+
+def test_dynamic_remove_agent_with_replica():
+    scenario = Scenario(
+        [
+            ScenarioEvent("e1", actions=[EventAction("remove_agent", agent="a0")]),
+            ScenarioEvent(delay=0.5),
+        ]
+    )
+    result = run_dynamic(
+        ring_dcop(),
+        "dsa",
+        {"variant": "B"},
+        scenario=scenario,
+        k_target=1,
+        final_rounds=40,
+        seed=3,
+    )
+    # v0's computation migrated to a replica holder: nothing lost
+    assert result["lost_computations"] == []
+    assert "a0" not in result["agents_final"]
+    removal = [
+        e for e in result["events"] if e.get("action") == "remove_agent"
+    ][0]
+    assert removal["orphaned"] == ["v0"]
+    assert removal["migrated"]["v0"] in {"a1", "a2", "a3"}
+    # the full assignment (ring is 3-colorable → cost 0 reachable)
+    assert len(result["assignment"]) == 4
+    assert result["cost"] == 0.0
+
+
+def test_dynamic_remove_agent_without_replica_freezes():
+    scenario = Scenario(
+        [ScenarioEvent("e1", actions=[EventAction("remove_agent", agent="a0")])]
+    )
+    result = run_dynamic(
+        ring_dcop(),
+        "dsa",
+        {"variant": "B"},
+        scenario=scenario,
+        k_target=0,
+        final_rounds=40,
+        seed=4,
+    )
+    assert result["lost_computations"] == ["v0"]
+    # frozen variable still reported in the assignment
+    assert "v0" in result["assignment"]
+    # the others keep optimizing around the frozen value
+    assert result["cost"] <= 1.0
+
+
+def test_dynamic_cascading_removals():
+    scenario = Scenario(
+        [
+            ScenarioEvent("e1", actions=[EventAction("remove_agent", agent="a0")]),
+            ScenarioEvent(delay=0.2),
+            ScenarioEvent("e2", actions=[EventAction("remove_agent", agent="a1")]),
+            ScenarioEvent(delay=0.2),
+        ]
+    )
+    result = run_dynamic(
+        ring_dcop(),
+        "dsa",
+        {"variant": "B"},
+        scenario=scenario,
+        k_target=2,
+        final_rounds=30,
+        seed=5,
+    )
+    # k=2 replication survives two departures
+    assert result["lost_computations"] == []
+    assert sorted(result["agents_final"]) == ["a2", "a3"]
+    assert result["cost"] == 0.0
+
+
+def test_dynamic_add_agent_hosts_future_repairs():
+    scenario = Scenario(
+        [
+            ScenarioEvent("e1", actions=[EventAction("add_agent", agent="fresh")]),
+            ScenarioEvent(delay=0.2),
+            ScenarioEvent("e2", actions=[EventAction("remove_agent", agent="a0")]),
+        ]
+    )
+    result = run_dynamic(
+        ring_dcop(),
+        "dsa",
+        {"variant": "B"},
+        scenario=scenario,
+        k_target=1,
+        final_rounds=30,
+        seed=6,
+    )
+    assert "fresh" in result["agents_final"]
+    assert result["lost_computations"] == []
+
+
+def test_dynamic_set_external_value():
+    dcop = DCOP("ext")
+    v = Variable("v", D)
+    e = ExternalVariable("sensor", D, value=0)
+    dcop.add_variable(v)
+    dcop.add_variable(e)
+    # v must track the sensor: cost 0 iff equal
+    dcop.add_constraint(
+        constraint_from_str("track", "0 if v == sensor else 1", [v, e])
+    )
+    dcop.add_agents([AgentDef("a0")])
+    scenario = Scenario(
+        [
+            ScenarioEvent(
+                "e1",
+                actions=[
+                    EventAction("set_value", variable="sensor", value=2)
+                ],
+            ),
+        ]
+    )
+    result = run_dynamic(
+        dcop, "dsa", {"variant": "B"}, scenario=scenario, final_rounds=30,
+        seed=7,
+    )
+    assert result["assignment"]["v"] == 2
+    assert result["cost"] == 0.0
