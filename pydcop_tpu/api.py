@@ -31,6 +31,9 @@ def solve(
     seed: int = 0,
     convergence_chunks: int = 0,
     chunk_size: int = 64,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 1,
+    resume: bool = False,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -73,6 +76,9 @@ def solve(
         timeout=timeout,
         chunk_size=chunk_size,
         convergence_chunks=convergence_chunks,
+        checkpoint_path=checkpoint_path,
+        checkpoint_every=checkpoint_every,
+        resume=resume,
     )
     return {
         "assignment": result.best_assignment,

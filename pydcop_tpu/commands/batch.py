@@ -1,11 +1,233 @@
-"""``pydcop_tpu batch`` — placeholder, implemented in a later milestone
-(reference: ``pydcop/commands/batch.py``)."""
+"""``pydcop_tpu batch`` (reference: ``pydcop/commands/batch.py``).
+
+Parameter-sweep experiment runner: a yaml spec defines problem *sets*
+(file globs + iteration counts) and *batches* (command options, with
+list-valued parameters expanded as a cross product).  Every (batch,
+problem, parameter-combination, iteration) tuple is solved in-process
+on the batched engine and appended as one CSV row — the reference's
+reproducibility harness.
+
+Finished runs are skipped when the output CSV already contains their
+key, giving crude experiment-level resume (same behavior the reference
+gets by skipping existing output files).
+
+Spec format::
+
+    sets:
+      coloring:
+        path: "instances/coloring_*.yaml"   # glob or list of files
+        iterations: 3                        # seeds 0..2
+    batches:
+      dsa_sweep:
+        algo: dsa
+        algo_params:
+          variant: [A, B, C]                 # lists are swept
+          probability: 0.7
+        rounds: 200
+        timeout: 10
+"""
+
+from __future__ import annotations
+
+import csv
+import glob as globmod
+import itertools
+import json
+import os
+from typing import Any, Dict, Iterator, List, Tuple
+
+CSV_FIELDS = [
+    "batch",
+    "set",
+    "problem",
+    "iteration",
+    "algo",
+    "params",
+    "status",
+    "cost",
+    "cycle",
+    "msg_count",
+    "time",
+]
 
 
 def set_parser(subparsers) -> None:
-    p = subparsers.add_parser("batch", help="(not yet implemented)")
+    p = subparsers.add_parser(
+        "batch", help="run a parameter-sweep experiment from a yaml spec"
+    )
+    p.add_argument("spec", help="batch spec yaml file")
+    p.add_argument(
+        "--result_file", default="batch_results.csv",
+        help="CSV to append per-run rows to (existing rows are skipped)",
+    )
+    p.add_argument(
+        "--simulate", action="store_true",
+        help="list the runs without executing them",
+    )
     p.set_defaults(func=run_cmd)
 
 
+def _expand_params(algo_params: Dict[str, Any]) -> Iterator[Dict[str, Any]]:
+    """Cross product over list-valued parameters."""
+    if not algo_params:
+        yield {}
+        return
+    keys = sorted(algo_params)
+    pools = [
+        v if isinstance(v, list) else [v]
+        for v in (algo_params[k] for k in keys)
+    ]
+    for combo in itertools.product(*pools):
+        yield dict(zip(keys, combo))
+
+
+def _set_files(set_def: Dict[str, Any], base_dir: str) -> List[str]:
+    path = set_def.get("path")
+    if isinstance(path, list):
+        files: List[str] = []
+        for p in path:
+            files.extend(_resolve(p, base_dir))
+        return files
+    return _resolve(path, base_dir)
+
+
+def _resolve(pattern: str, base_dir: str) -> List[str]:
+    if not os.path.isabs(pattern):
+        pattern = os.path.join(base_dir, pattern)
+    matches = sorted(globmod.glob(pattern))
+    return matches if matches else [pattern]
+
+
+def iter_runs(
+    spec: Dict[str, Any], base_dir: str
+) -> Iterator[Tuple[str, str, str, int, str, Dict[str, Any], Dict[str, Any]]]:
+    """Yield (batch, set, problem, iteration, algo, params, options)."""
+    sets = spec.get("sets", {}) or {}
+    batches = spec.get("batches", {}) or {}
+    for bname, bdef in sorted(batches.items()):
+        algo = bdef.get("algo")
+        if not algo:
+            raise SystemExit(f"batch {bname!r}: missing 'algo'")
+        options = {
+            k: v
+            for k, v in bdef.items()
+            if k not in ("algo", "algo_params")
+        }
+        for sname, sdef in sorted(sets.items()):
+            iterations = int(sdef.get("iterations", 1))
+            for problem in _set_files(sdef, base_dir):
+                for params in _expand_params(bdef.get("algo_params")):
+                    for it in range(iterations):
+                        yield (
+                            bname, sname, problem, it, algo, params, options
+                        )
+
+
+def _run_key(batch, set_, problem, iteration, algo, params, base_dir) -> Tuple:
+    # path relative to the spec dir: distinguishes same-named files in
+    # different directories, stays stable if the tree moves
+    try:
+        pkey = os.path.relpath(problem, base_dir)
+    except ValueError:  # different drive (windows)
+        pkey = problem
+    return (
+        batch,
+        set_,
+        pkey,
+        str(iteration),
+        algo,
+        json.dumps(params, sort_keys=True),
+    )
+
+
 def run_cmd(args) -> int:
-    raise SystemExit("batch: not yet implemented in this build")
+    import yaml
+
+    with open(args.spec) as f:
+        spec = yaml.safe_load(f)
+    base_dir = os.path.dirname(os.path.abspath(args.spec))
+
+    done = set()
+    exists = os.path.exists(args.result_file)
+    if exists:
+        with open(args.result_file, newline="") as f:
+            for row in csv.DictReader(f):
+                if row.get("status", "").startswith("error"):
+                    continue  # failed runs are retried on resume
+                done.add(
+                    (
+                        row["batch"],
+                        row["set"],
+                        row["problem"],
+                        row["iteration"],
+                        row["algo"],
+                        row["params"],
+                    )
+                )
+
+    runs = list(iter_runs(spec, base_dir))
+    if args.simulate:
+        for batch, set_, problem, it, algo, params, options in runs:
+            key = _run_key(batch, set_, problem, it, algo, params, base_dir)
+            state = "skip" if key in done else "run"
+            print(
+                f"{state}: [{batch}/{set_}] {os.path.basename(problem)} "
+                f"algo={algo} params={params} iteration={it}"
+            )
+        print(f"{len(runs)} runs total, {len(done)} already done")
+        return 0
+
+    from pydcop_tpu.api import solve
+
+    executed = skipped = failed = 0
+    with open(args.result_file, "a", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=CSV_FIELDS)
+        if not exists:
+            writer.writeheader()
+        for batch, set_, problem, it, algo, params, options in runs:
+            key = _run_key(batch, set_, problem, it, algo, params, base_dir)
+            if key in done:
+                skipped += 1
+                continue
+            try:
+                result = solve(
+                    problem,
+                    algo,
+                    params,
+                    rounds=int(options.get("rounds", 200)),
+                    timeout=options.get("timeout"),
+                    seed=it,
+                )
+            except Exception as e:  # record the failure, keep sweeping
+                failed += 1
+                result = {"status": f"error: {e}", "cost": "", "cycle": "",
+                          "msg_count": "", "time": ""}
+            writer.writerow(
+                {
+                    "batch": key[0],
+                    "set": key[1],
+                    "problem": key[2],
+                    "iteration": key[3],
+                    "algo": key[4],
+                    "params": key[5],
+                    "status": result["status"],
+                    "cost": result["cost"],
+                    "cycle": result["cycle"],
+                    "msg_count": result["msg_count"],
+                    "time": result["time"],
+                }
+            )
+            f.flush()
+            executed += 1
+    print(
+        json.dumps(
+            {
+                "runs": len(runs),
+                "executed": executed,
+                "skipped": skipped,
+                "failed": failed,
+                "result_file": args.result_file,
+            }
+        )
+    )
+    return 0 if failed == 0 else 1

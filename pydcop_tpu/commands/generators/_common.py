@@ -29,6 +29,8 @@ def random_graph_edges(
         for j in range(i + 1, n):
             if rnd.random() < p:
                 edges.add((i, j))
+    if n < 2:
+        return sorted(edges)  # a single vertex has no edges to force
     degree = [0] * n
     for i, j in edges:
         degree[i] += 1

@@ -42,6 +42,18 @@ def set_parser(subparsers) -> None:
         "--convergence_chunks", type=int, default=0,
         help="stop after N unchanged chunks (0 = run all rounds)",
     )
+    p.add_argument(
+        "--checkpoint", default=None,
+        help="write the run state to this .npz file as the run proceeds",
+    )
+    p.add_argument(
+        "--checkpoint_every", type=int, default=1,
+        help="chunks between checkpoint writes",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="restore --checkpoint (if present) and continue the run",
+    )
     add_collect_arguments(p)
     p.set_defaults(func=run_cmd)
 
@@ -58,6 +70,9 @@ def run_cmd(args) -> int:
         timeout=args.timeout,
         seed=args.seed,
         convergence_chunks=args.convergence_chunks,
+        checkpoint_path=args.checkpoint,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
     )
     write_metrics(args, result)
     result.pop("cost_trace", None)  # keep the printed JSON compact

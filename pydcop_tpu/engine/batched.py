@@ -94,6 +94,9 @@ def run_batched(
     chunk_size: int = 64,
     convergence_chunks: int = 0,
     mesh=None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 1,
+    resume: bool = False,
 ) -> RunResult:
     """Run a batched algorithm for up to ``rounds`` rounds.
 
@@ -112,6 +115,11 @@ def run_batched(
     are sharded over the mesh, variables replicated, neighbor exchange
     via ``psum`` (see ``pydcop_tpu.parallel``).  The problem must have
     been compiled with ``n_shards == mesh size``.
+
+    With ``checkpoint_path`` set, the run state is written every
+    ``checkpoint_every`` chunks (atomic .npz, see
+    ``engine.checkpoint``); ``resume=True`` restores it and continues
+    from the recorded round counter.
     """
     t0 = time.perf_counter()
     sign = -1.0 if problem.maximize else 1.0
@@ -156,6 +164,31 @@ def run_batched(
     best_values = state["values"]
     best_cost = total_cost(problem, best_values)
 
+    resumed_rounds = 0
+    if resume and checkpoint_path is not None:
+        import os
+
+        from pydcop_tpu.engine.checkpoint import load_checkpoint
+
+        if os.path.exists(checkpoint_path):
+            state, bc, bv, resumed_rounds, meta = load_checkpoint(
+                checkpoint_path, state
+            )
+            if meta.get("algo") != algo_module.__name__:
+                raise ValueError(
+                    f"Checkpoint {checkpoint_path} was written by "
+                    f"{meta.get('algo')}, not {algo_module.__name__}"
+                )
+            if meta.get("seed") != seed:
+                raise ValueError(
+                    f"Checkpoint {checkpoint_path} was written with "
+                    f"seed {meta.get('seed')}, not {seed} — the RNG "
+                    "stream would diverge"
+                )
+            state = jax.tree_util.tree_map(jnp.asarray, state)
+            best_cost = jnp.asarray(bc, dtype=best_cost.dtype)
+            best_values = jnp.asarray(bv, dtype=best_values.dtype)
+
     def make_runner(n: int):
         cache_key = cache_key_base + (n,)
         if cache_key in _RUNNER_CACHE:
@@ -194,9 +227,10 @@ def run_batched(
     small_runner = None  # for the tail chunk, compiled lazily
 
     traces = []
-    done = 0
+    done = resumed_rounds
     status = "finished"
     stall = 0
+    chunks_since_save = 0
     prev_best = float(best_cost)
     prev_values = np.asarray(best_values)
     while done < rounds:
@@ -213,6 +247,16 @@ def run_batched(
         )
         traces.append(np.asarray(costs))
         done += this_chunk
+        if checkpoint_path is not None:
+            chunks_since_save += 1
+            if chunks_since_save >= max(1, checkpoint_every):
+                from pydcop_tpu.engine.checkpoint import save_checkpoint
+
+                save_checkpoint(
+                    checkpoint_path, state, float(best_cost), best_values,
+                    done, {"algo": algo_module.__name__, "seed": seed},
+                )
+                chunks_since_save = 0
         if timeout is not None and time.perf_counter() - t0 > timeout:
             status = "timeout"
             break
@@ -230,6 +274,14 @@ def run_batched(
                 stall = 0
             prev_best = float(best_cost)
             prev_values = cur_values
+
+    if checkpoint_path is not None and chunks_since_save:
+        from pydcop_tpu.engine.checkpoint import save_checkpoint
+
+        save_checkpoint(
+            checkpoint_path, state, float(best_cost), best_values,
+            done, {"algo": algo_module.__name__, "seed": seed},
+        )
 
     final_values = state["values"]
     final_cost = float(total_cost(problem, final_values))

@@ -1,0 +1,100 @@
+"""Tests for the batch experiment runner and consolidate commands."""
+
+import csv
+import json
+
+from tests.test_cli import run_cli
+
+
+def _write_instances(tmp_path, n_files=2):
+    inst = tmp_path / "instances"
+    inst.mkdir()
+    for f in range(n_files):
+        lines = [
+            f"name: p{f}",
+            "objective: min",
+            "domains:",
+            "  colors: {values: [0, 1, 2]}",
+            "variables:",
+        ]
+        for i in range(4):
+            lines.append(f"  v{i}: {{domain: colors}}")
+        lines.append("constraints:")
+        for i in range(4):
+            j = (i + 1) % 4
+            lines.append(f"  c{i}:")
+            lines.append("    type: intention")
+            lines.append(f"    function: 1 if v{i} == v{j} else 0")
+        lines.append("agents: [a0, a1, a2, a3]")
+        (inst / f"coloring_{f}.yaml").write_text("\n".join(lines) + "\n")
+    return inst
+
+
+def _write_spec(tmp_path):
+    spec = tmp_path / "spec.yaml"
+    spec.write_text(
+        "sets:\n"
+        "  coloring:\n"
+        '    path: "instances/coloring_*.yaml"\n'
+        "    iterations: 2\n"
+        "batches:\n"
+        "  dsa_sweep:\n"
+        "    algo: dsa\n"
+        "    algo_params:\n"
+        "      variant: [A, B]\n"
+        "    rounds: 20\n"
+    )
+    return spec
+
+
+def test_batch_simulate(tmp_path):
+    _write_instances(tmp_path)
+    spec = _write_spec(tmp_path)
+    r = run_cli("batch", str(spec), "--simulate")
+    assert r.returncode == 0, r.stderr
+    # 2 files × 2 variants × 2 iterations
+    assert "8 runs total" in r.stdout
+
+
+def test_batch_run_and_resume(tmp_path):
+    _write_instances(tmp_path)
+    spec = _write_spec(tmp_path)
+    out = tmp_path / "results.csv"
+    r = run_cli("batch", str(spec), "--result_file", str(out))
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["executed"] == 8
+    with open(out, newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 8
+    assert {row["status"] for row in rows} == {"finished"}
+    variants = {json.loads(row["params"])["variant"] for row in rows}
+    assert variants == {"A", "B"}
+
+    # resume: nothing re-executed
+    r2 = run_cli("batch", str(spec), "--result_file", str(out))
+    assert r2.returncode == 0, r2.stderr
+    summary2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert summary2["executed"] == 0
+    assert summary2["skipped"] == 8
+
+
+def test_consolidate_merge_and_aggregate(tmp_path):
+    _write_instances(tmp_path)
+    spec = _write_spec(tmp_path)
+    out = tmp_path / "results.csv"
+    r = run_cli("batch", str(spec), "--result_file", str(out))
+    assert r.returncode == 0, r.stderr
+
+    merged = tmp_path / "merged.csv"
+    r = run_cli(
+        "consolidate", str(out), "--result_file", str(merged),
+        "--group_by", "problem", "algo",
+    )
+    assert r.returncode == 0, r.stderr
+    with open(merged, newline="") as f:
+        rows = list(csv.DictReader(f))
+    # 2 problems × 1 algo
+    assert len(rows) == 2
+    assert all(row["n_runs"] == "4" for row in rows)
+    assert all(float(row["cost"]) >= 0 for row in rows)

@@ -1,0 +1,115 @@
+"""Tests for run-state checkpoint/resume (engine.checkpoint)."""
+
+import jax
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import load_algorithm_module, prepare_algo_params
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.engine.batched import run_batched
+from pydcop_tpu.engine.checkpoint import load_checkpoint, save_checkpoint
+from pydcop_tpu.ops.compile import compile_dcop
+
+D = Domain("d", "", [0, 1, 2])
+
+
+def ring_problem(n=6):
+    dcop = DCOP("ring")
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}_{j}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    return compile_dcop(dcop)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    state = {
+        "values": np.arange(4, dtype=np.int32),
+        "nested": {"msgs": np.ones((3, 2), dtype=np.float32)},
+    }
+    save_checkpoint(path, state, 1.5, np.zeros(4, np.int32), 42, {"x": "y"})
+    template = jax.tree_util.tree_map(np.zeros_like, state)
+    got, best_cost, best_values, rounds, meta = load_checkpoint(path, template)
+    assert best_cost == 1.5
+    assert rounds == 42
+    assert meta["x"] == "y"
+    np.testing.assert_array_equal(got["values"], state["values"])
+    np.testing.assert_array_equal(got["nested"]["msgs"], state["nested"]["msgs"])
+
+
+def test_checkpoint_rejects_wrong_shape(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    save_checkpoint(path, {"values": np.zeros(4)}, 0.0, np.zeros(4), 1)
+    with pytest.raises(ValueError, match="shape"):
+        load_checkpoint(path, {"values": np.zeros(5)})
+    with pytest.raises(ValueError, match="misses"):
+        load_checkpoint(path, {"other": np.zeros(4)})
+
+
+@pytest.mark.parametrize("algo", ["dsa", "maxsum"])
+def test_resume_matches_uninterrupted_run(tmp_path, algo):
+    """checkpoint at round 32, resume → same result as a straight
+    64-round run (same RNG stream — fold_in by absolute round index)."""
+    problem = ring_problem()
+    module = load_algorithm_module(algo)
+    params = prepare_algo_params({}, module.algo_params)
+    path = str(tmp_path / "ck.npz")
+
+    full = run_batched(problem, module, params, rounds=64, seed=9,
+                       chunk_size=32)
+    part1 = run_batched(
+        problem, module, params, rounds=32, seed=9, chunk_size=32,
+        checkpoint_path=path,
+    )
+    assert part1.cycles == 32
+    resumed = run_batched(
+        problem, module, params, rounds=64, seed=9, chunk_size=32,
+        checkpoint_path=path, resume=True,
+    )
+    assert resumed.cycles == 64
+    assert resumed.assignment == full.assignment
+    assert resumed.best_cost == full.best_cost
+
+
+def test_solve_cli_checkpoint_resume(tmp_path):
+    from tests.test_cli import run_cli
+
+    yaml_file = tmp_path / "ring.yaml"
+    lines = [
+        "name: ring", "objective: min",
+        "domains:", "  colors: {values: [0, 1, 2]}", "variables:",
+    ]
+    for i in range(5):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for i in range(5):
+        j = (i + 1) % 5
+        lines.append(f"  c{i}:")
+        lines.append("    type: intention")
+        lines.append(f"    function: 1 if v{i} == v{j} else 0")
+    lines.append("agents: [a0, a1, a2, a3, a4]")
+    yaml_file.write_text("\n".join(lines) + "\n")
+
+    import json
+
+    ck = tmp_path / "state.npz"
+    r1 = run_cli(
+        "solve", str(yaml_file), "-a", "dsa", "--rounds", "20",
+        "--checkpoint", str(ck),
+    )
+    assert r1.returncode == 0, r1.stderr
+    assert ck.exists()
+    r2 = run_cli(
+        "solve", str(yaml_file), "-a", "dsa", "--rounds", "40",
+        "--checkpoint", str(ck), "--resume",
+    )
+    assert r2.returncode == 0, r2.stderr
+    result = json.loads(r2.stdout)
+    assert result["cycle"] == 40  # 20 restored + 20 new
