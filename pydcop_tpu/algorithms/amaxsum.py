@@ -28,7 +28,6 @@ import jax.numpy as jnp
 from pydcop_tpu.algorithms import AlgoParameterDef
 from pydcop_tpu.algorithms import maxsum as _maxsum
 from pydcop_tpu.ops.compile import CompiledProblem
-from pydcop_tpu.ops.costs import segment_sum_edges
 
 GRAPH_TYPE = "factor_graph"
 
@@ -65,17 +64,17 @@ def step(
         k_r = jax.random.fold_in(k_r, shard)
     sync = _maxsum.step(problem, state, k_sync, params, axis_name)
 
-    E = state["q"].shape[0]
+    E = state["q"].shape[1]  # messages are [d, E]
     act = params["activation"]
-    fire_q = jax.random.uniform(k_q, (E, 1)) < act
-    fire_r = jax.random.uniform(k_r, (E, 1)) < act
+    fire_q = jax.random.uniform(k_q, (1, E)) < act
+    fire_r = jax.random.uniform(k_r, (1, E)) < act
     q = jnp.where(fire_q, sync["q"], state["q"])
     r = jnp.where(fire_r, sync["r"], state["r"])
 
     # re-select values from the actually-updated messages
-    unary = problem.unary + state["noise"]
-    belief = segment_sum_edges(problem, r, axis_name) + unary
-    values = jnp.argmin(belief, axis=1).astype(state["values"].dtype)
+    unary_t = problem.unary.T + state["noise"]
+    belief = _maxsum.belief_from_r(problem, r, unary_t, axis_name)
+    values = jnp.argmin(belief, axis=0).astype(state["values"].dtype)
     return {"q": q, "r": r, "values": values, "noise": state["noise"]}
 
 

@@ -6,15 +6,25 @@ TPU batched engine.  The whole factor graph's messages live in two
 dense arrays over the *directed edge* list the compiler builds (one
 edge per (constraint, scope-position)):
 
-- ``q: f32[n_edges, d]`` — variable→factor messages
-- ``r: f32[n_edges, d]`` — factor→variable messages
+- ``q: f32[d, n_edges]`` — variable→factor messages
+- ``r: f32[d, n_edges]`` — factor→variable messages
+
+Layout note (BASELINE.md round-1 perf backlog): the domain axis d is
+tiny (3 for coloring), so ``[E, d]`` arrays waste a full 128-lane tile
+per row (~42× memory inflation at d=3).  Messages therefore live
+**transposed**, ``[d, E]`` — edges ride the lane axis, d rides
+sublanes (≤2.7× padding) — and the compiler lays edges out
+position-major per arity bucket (ops/compile.py ``edge_order``) so the
+factor phase reads its q inputs as contiguous slices and writes r as
+concatenated blocks: the whole round is gathers/slices + elementwise,
+no scatter.
 
 One round (all messages simultaneously — this IS the north-star hot
 path, see BASELINE.md):
 
 1. variable→factor:  q_e = unary[v_e] + Σ_{e'∋v_e, e'≠e} r_{e'} − norm,
-   computed as ``segment_sum(r by var) gathered back − r_e`` (no
-   per-neighbor loop), with optional damping against the previous q.
+   computed as per-variable incoming-edge gather-sums, with optional
+   damping against the previous q.
 2. factor→variable, per arity bucket, via the standard sum-then-
    subtract trick: S = table ⊕ Σ_p q_p (broadcast-add over the
    bucket's axes), M_p = min over all axes but p, r_p = M_p − q_p.
@@ -31,7 +41,7 @@ counter records for a full synchronous cycle.
 
 When ``axis_name`` is set, the step runs inside ``shard_map`` with
 edges sharded across the mesh: the only cross-device exchange is one
-``psum`` of the [n_vars, d] belief accumulator per round (riding ICI).
+``psum`` of the [d, n_vars] belief accumulator per round (riding ICI).
 """
 
 from __future__ import annotations
@@ -44,7 +54,6 @@ import jax.numpy as jnp
 from pydcop_tpu.algorithms import AlgoParameterDef
 from pydcop_tpu.graphs import factor_graph as _graph
 from pydcop_tpu.ops.compile import CompiledProblem
-from pydcop_tpu.ops.costs import segment_sum_edges
 
 GRAPH_TYPE = "factor_graph"
 
@@ -76,14 +85,40 @@ def init_state(
     else:  # "zero"
         values = jnp.zeros_like(problem.init_idx)
     noise = params.get("noise", 0.0) * jax.random.uniform(
-        k_noise, (problem.n_vars, d), dtype=problem.unary.dtype
+        k_noise, (d, problem.n_vars), dtype=problem.unary.dtype
     )
     return {
-        "q": jnp.zeros((E, d), dtype=problem.unary.dtype),
-        "r": jnp.zeros((E, d), dtype=problem.unary.dtype),
+        "q": jnp.zeros((d, E), dtype=problem.unary.dtype),
+        "r": jnp.zeros((d, E), dtype=problem.unary.dtype),
         "values": values,
         "noise": noise,
     }
+
+
+def belief_from_r(
+    problem: CompiledProblem,
+    r: jax.Array,
+    unary_t: jax.Array,
+    axis_name: Optional[str] = None,
+) -> jax.Array:
+    """[d, n_vars] belief: unary + Σ incoming r per variable.
+
+    Single-shard: per-variable incoming-edge gathers over the padded
+    edge lists (one [d, n_vars] gather per degree slot — all lanes
+    useful).  Sharded: edges are mesh-local, so sum locally by
+    segment-sum and ``psum`` the [d, n] accumulator across the mesh.
+    """
+    if axis_name is None:
+        pad = jnp.zeros((r.shape[0], 1), dtype=r.dtype)
+        r_pad = jnp.concatenate([r, pad], axis=1)  # sentinel column
+        acc = unary_t
+        for p in range(problem.var_edges.shape[1]):
+            acc = acc + r_pad[:, problem.var_edges[:, p]]
+        return acc
+    local = jax.ops.segment_sum(
+        r.T, problem.edge_var, num_segments=problem.n_vars
+    )  # [n, d]
+    return jax.lax.psum(local.T, axis_name) + unary_t
 
 
 def step(
@@ -95,40 +130,56 @@ def step(
 ) -> Dict[str, jax.Array]:
     q, r = state["q"], state["r"]
     damping = params["damping"]
-    unary = problem.unary + state["noise"]
+    unary_t = problem.unary.T + state["noise"]  # [d, n]
+    d = problem.d_max
 
-    # -- 1. variable -> factor ----------------------------------------
-    r_sum = segment_sum_edges(problem, r, axis_name)  # [n, d]
-    belief = r_sum + unary
-    q_new = belief[problem.edge_var] - r  # exclude own incoming r
-    q_new = q_new - jnp.min(q_new, axis=1, keepdims=True)
+    # The round is phased factor-first so ONE belief computation (the
+    # expensive per-variable aggregation) serves both the q update and
+    # value selection: r_new = F(q); belief = B(r_new); q_new, values
+    # from belief.  Same fixed point and message counts as the
+    # variable-first phasing — messages just carry a half-round-older
+    # q, which is a legal BP schedule.
+
+    # -- 1. factor -> variable, per arity bucket ----------------------
+    # Edges are position-major per (shard segment, arity) run
+    # (compile.py edge_order), so every bucket position's q is one
+    # contiguous [d, m] slice and r comes back as concatenated blocks.
+    n_segments = problem.n_shards if axis_name is None else 1
+    r_blocks = []
+    off = 0
+    for seg in range(n_segments):
+        for k, bucket in sorted(problem.buckets.items()):
+            m = bucket.tables_t.shape[-1] // n_segments
+            tab = bucket.tables_t[..., seg * m : (seg + 1) * m]
+            q_pos = [
+                q[:, off + p * m : off + (p + 1) * m]  # [d, m]
+                for p in range(k)
+            ]
+            s = tab  # [d, ..., d, m]
+            for p in range(k):
+                shape = (1,) * p + (d,) + (1,) * (k - 1 - p) + (m,)
+                s = s + q_pos[p].reshape(shape)
+            outs = []
+            for p in range(k):
+                axes = tuple(a for a in range(k) if a != p)
+                mp = jnp.min(s, axis=axes)  # [d, m]
+                rp = mp - q_pos[p]
+                rp = rp - jnp.min(rp, axis=0, keepdims=True)
+                outs.append(rp)
+            r_blocks.append(jnp.concatenate(outs, axis=1))  # [d, m·k]
+            off += m * k
+    r_new = (
+        jnp.concatenate(r_blocks, axis=1)
+        if len(r_blocks) > 1
+        else r_blocks[0]
+    )
+
+    # -- 2. variable -> factor + value selection ----------------------
+    belief = belief_from_r(problem, r_new, unary_t, axis_name)  # [d, n]
+    q_new = belief[:, problem.edge_var] - r_new  # exclude own incoming r
+    q_new = q_new - jnp.min(q_new, axis=0, keepdims=True)
     q_new = damping * q + (1.0 - damping) * q_new
-
-    # -- 2. factor -> variable, per arity bucket ----------------------
-    r_new = r
-    local_off = 0
-    if axis_name is not None:
-        # edge_slot is global within the shard-major layout; localize
-        local_off = jax.lax.axis_index(axis_name) * problem.edge_var.shape[0]
-    for k, bucket in sorted(problem.buckets.items()):
-        slots = bucket.edge_slot - local_off  # [m, k] local edge ids
-        s = bucket.tables  # [m, d, ..., d]
-        m = s.shape[0]
-        d = problem.d_max
-        for p in range(k):
-            qp = q_new[slots[:, p]]  # [m, d]
-            shape = (m,) + (1,) * p + (d,) + (1,) * (k - 1 - p)
-            s = s + qp.reshape(shape)
-        for p in range(k):
-            axes = tuple(1 + a for a in range(k) if a != p)
-            mp = jnp.min(s, axis=axes)  # [m, d]
-            rp = mp - q_new[slots[:, p]]
-            rp = rp - jnp.min(rp, axis=1, keepdims=True)
-            r_new = r_new.at[slots[:, p]].set(rp)
-
-    # -- 3. value selection -------------------------------------------
-    belief_new = segment_sum_edges(problem, r_new, axis_name) + unary
-    values = jnp.argmin(belief_new, axis=1).astype(state["values"].dtype)
+    values = jnp.argmin(belief, axis=0).astype(state["values"].dtype)
     return {
         "q": q_new,
         "r": r_new,
@@ -143,12 +194,12 @@ def values_from_state(state: Dict[str, jax.Array]) -> jax.Array:
 
 def state_specs(problem: CompiledProblem) -> Dict[str, Any]:
     """Sharding of the state pytree when run over a mesh: messages are
-    sharded with their edges, values replicated."""
+    sharded with their edges (lane axis), values replicated."""
     from jax.sharding import PartitionSpec as P
 
     from pydcop_tpu.parallel.mesh import SHARD_AXIS
 
-    sh = P(SHARD_AXIS)
+    sh = P(None, SHARD_AXIS)
     return {"q": sh, "r": sh, "values": P(), "noise": P()}
 
 

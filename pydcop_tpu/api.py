@@ -62,6 +62,11 @@ def solve(
 
     if hasattr(module, "solve_host"):
         # exact / sequential algorithms (DPOP, SyncBB)
+        if checkpoint_path is not None or resume:
+            raise ValueError(
+                f"{algo_name}: checkpoint/resume is only supported on "
+                "the batched engine, not host-path (exact) algorithms"
+            )
         return module.solve_host(dcop, params, timeout=timeout)
 
     problem = compile_dcop(dcop)

@@ -66,13 +66,59 @@ def add_collect_arguments(parser) -> None:
 
 
 def write_metrics(args, result: Dict[str, Any]) -> None:
+    """Write the run/end metric CSVs (reference ``--collect_on`` modes).
+
+    - ``cycle_change``: one row per engine round (cycle).
+    - ``value_change``: only rounds whose cost differs from the
+      previous one (the anytime-improvement stream).
+    - ``period``: rows sampled every ``--period`` seconds; the batched
+      engine fuses rounds into chunks, so per-round timestamps are
+      interpolated uniformly over the measured wall-clock time.
+    """
     trace = result.get("cost_trace") or []
     if getattr(args, "run_metrics", None):
+        n = len(trace)
+        total_time = float(result.get("time", 0.0) or 0.0)
+        msgs_total = int(result.get("msg_count", 0) or 0)
+        cycles_total = int(result.get("cycle", n) or n)
+        # on --resume, the trace covers only the new rounds: label
+        # cycles from where the checkpoint left off and keep msg_count
+        # cumulative over the WHOLE run (cycle and msg_count in the
+        # printed JSON are whole-run too)
+        first_cycle = cycles_total - n
+        per_round_msgs = msgs_total / cycles_total if cycles_total else 0
+
+        def row(i):
+            cyc = first_cycle + i + 1
+            return [
+                round(total_time * (i + 1) / n, 6) if n else 0.0,
+                cyc,
+                trace[i],
+                int(per_round_msgs * cyc),
+            ]
+
+        mode = getattr(args, "collect_on", "cycle_change")
+        rows = []
+        if mode == "value_change":
+            prev = None
+            for i, c in enumerate(trace):
+                if prev is None or c != prev:
+                    rows.append(row(i))
+                prev = c
+        elif mode == "period":
+            period = getattr(args, "period", None) or 1.0
+            next_t = period
+            for i in range(n):
+                t = total_time * (i + 1) / n
+                if t >= next_t or i == n - 1:
+                    rows.append(row(i))
+                    next_t += period
+        else:  # cycle_change
+            rows = [row(i) for i in range(n)]
         with open(args.run_metrics, "w", newline="") as f:
             w = csv.writer(f)
-            w.writerow(["cycle", "cost"])
-            for i, c in enumerate(trace):
-                w.writerow([i + 1, c])
+            w.writerow(["time", "cycle", "cost", "msg_count"])
+            w.writerows(rows)
     if getattr(args, "end_metrics", None):
         import os
 

@@ -36,6 +36,14 @@ import json
 import os
 from typing import Any, Dict, Iterator, List, Tuple
 
+# batch options forwarded to api.solve (anything else is a spec error)
+_SOLVE_OPTIONS = {
+    "rounds",
+    "timeout",
+    "chunk_size",
+    "convergence_chunks",
+}
+
 CSV_FIELDS = [
     "batch",
     "set",
@@ -113,6 +121,12 @@ def iter_runs(
             for k, v in bdef.items()
             if k not in ("algo", "algo_params")
         }
+        unknown = set(options) - _SOLVE_OPTIONS
+        if unknown:
+            raise SystemExit(
+                f"batch {bname!r}: unknown option(s) {sorted(unknown)}; "
+                f"accepted: {sorted(_SOLVE_OPTIONS)}"
+            )
         for sname, sdef in sorted(sets.items()):
             iterations = int(sdef.get("iterations", 1))
             for problem in _set_files(sdef, base_dir):
@@ -197,6 +211,10 @@ def run_cmd(args) -> int:
                     rounds=int(options.get("rounds", 200)),
                     timeout=options.get("timeout"),
                     seed=it,
+                    chunk_size=int(options.get("chunk_size", 64)),
+                    convergence_chunks=int(
+                        options.get("convergence_chunks", 0)
+                    ),
                 )
             except Exception as e:  # record the failure, keep sweeping
                 failed += 1

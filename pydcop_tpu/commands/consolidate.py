@@ -68,18 +68,26 @@ def run_cmd(args) -> int:
             groups.setdefault(
                 tuple(row.get(c, "") for c in args.group_by), []
             ).append(row)
-        out_fields = list(args.group_by) + numeric + ["n_runs"]
+        out_fields = list(args.group_by) + numeric + ["n_runs", "n_errors"]
         out_rows = []
         for gkey, grows in sorted(groups.items()):
             out = dict(zip(args.group_by, gkey))
+            # error rows are excluded from the aggregates and surfaced
+            # in n_errors, so a mean never silently hides failed runs
+            ok_rows = [
+                r
+                for r in grows
+                if not r.get("status", "").startswith("error")
+            ]
             for c in numeric:
                 vals = [
                     float(r[c])
-                    for r in grows
+                    for r in ok_rows
                     if r.get(c) not in (None, "")
                 ]
                 out[c] = agg_fn(vals) if vals else ""
-            out["n_runs"] = len(grows)
+            out["n_runs"] = len(ok_rows)
+            out["n_errors"] = len(grows) - len(ok_rows)
             out_rows.append(out)
         fields, rows = out_fields, out_rows
 

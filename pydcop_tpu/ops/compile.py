@@ -67,11 +67,19 @@ class ArityBucket:
     """Dense tables for all constraints of one arity.
 
     tables: f32[m, d_max^k] reshaped to [m, d_max, ..., d_max]
+    tables_t: the same tables transposed to [d_max, ..., d_max, m] —
+        the Max-Sum layout: m rides the 128-lane axis, so the d×…×d
+        minor dims don't get padded to a full (8, 128) tile each.
+        Kept alongside ``tables`` (local search indexes constraint-
+        major) — a deliberate memory/simplicity trade: both are
+        m·d^k floats, small next to the per-edge message state, and a
+        uniform static pytree avoids per-algorithm recompiles
     scopes: i32[m, k] — variable index per scope position
     edge_slot: i32[m, k] — global edge index of (constraint, position)
     """
 
     tables: jax.Array
+    tables_t: jax.Array
     scopes: jax.Array
     edge_slot: jax.Array
 
@@ -104,6 +112,10 @@ class CompiledProblem:
     # -- primal-graph neighbor structure -------------------------------
     neighbors: jax.Array  # i32[n_vars, max_deg] (0-padded)
     neighbor_mask: jax.Array  # bool[n_vars, max_deg]
+    # -- per-variable incoming-edge lists ------------------------------
+    # padded with sentinel n_edges (callers append a zero row before
+    # gathering); single-shard only — sharded runs segment-sum instead
+    var_edges: jax.Array  # i32[n_vars, max_var_deg]
     # -- arity buckets for message-passing ------------------------------
     buckets: Dict[int, ArityBucket]
     # -- static metadata ------------------------------------------------
@@ -230,40 +242,74 @@ def compile_dcop(
     n_real_edges = sum(len(scope) for _, scope, _ in multi_cons)
     if n_shards > 1:
         multi_cons = _shard_major_layout(multi_cons, n_shards, d_max)
+    else:
+        # arity-major (stable) order: every arity bucket's constraints —
+        # and therefore its edges (emitted constraint-major below) —
+        # occupy one contiguous range of the edge array.  Max-Sum's
+        # factor phase exploits this to read its q inputs as static
+        # slices and write r as stacked blocks (no scatter/gather).
+        # The shard-major branch already guarantees it per shard.
+        multi_cons = sorted(multi_cons, key=lambda it: len(it[1]))
 
     con_names = tuple(name for name, _, _ in multi_cons)
     n_cons = len(multi_cons)
     k_max = max((len(s) for _, s, _ in multi_cons), default=2)
     k_max = max(k_max, 2)
 
-    # flat form + edges
+    # flat form (constraint-major)
     offsets = np.zeros(n_cons, dtype=np.int32)
     con_scopes = np.zeros((n_cons, k_max), dtype=np.int32)
     con_strides = np.zeros((n_cons, k_max), dtype=np.int32)
+    con_stride_list: List[List[int]] = []
     flat_parts: List[np.ndarray] = []
     total = 0
-    edge_rows: List[Tuple[int, int, int, int, List[int], List[int]]] = []
-    # edge_rows: (var, con, offset, stride, covars, costrides)
-    edge_slot_per_con: List[List[int]] = []
-    n_edges = 0
     for ci, (name, scope, table) in enumerate(multi_cons):
         k = len(scope)
         offsets[ci] = total
         strides = [d_max ** (k - 1 - j) for j in range(k)]
+        con_stride_list.append(strides)
         con_scopes[ci, :k] = scope
         con_strides[ci, :k] = strides
         flat_parts.append(table.reshape(-1))
-        slots = []
-        for p in range(k):
-            covars = [scope[q] for q in range(k) if q != p]
-            costr = [strides[q] for q in range(k) if q != p]
-            edge_rows.append(
-                (scope[p], ci, total, strides[p], covars, costr)
-            )
-            slots.append(n_edges)
-            n_edges += 1
-        edge_slot_per_con.append(slots)
         total += table.size
+
+    # Edge ids are POSITION-MAJOR within each (shard segment, arity)
+    # run: all position-0 edges of the run's constraints, then all
+    # position-1, …  Max-Sum then reads each bucket position's q as one
+    # contiguous slice and writes r as concatenated blocks — zero
+    # scatters/gathers on the factor side (n_shards=1: whole list is
+    # one segment; shard-major: each shard's sublist is arity-sorted).
+    per_seg = n_cons // max(n_shards, 1) if n_cons else 0
+    edge_order: List[Tuple[int, int]] = []  # (ci, position)
+    for s in range(max(n_shards, 1)):
+        c0, c1 = s * per_seg, (s + 1) * per_seg
+        i = c0
+        while i < c1:
+            k = len(multi_cons[i][1])
+            j = i
+            while j < c1 and len(multi_cons[j][1]) == k:
+                j += 1
+            for p in range(k):
+                for ci in range(i, j):
+                    edge_order.append((ci, p))
+            i = j
+
+    edge_rows: List[Tuple[int, int, int, int, List[int], List[int]]] = []
+    # edge_rows: (var, con, offset, stride, covars, costrides)
+    edge_slot_per_con: List[List[int]] = [
+        [0] * len(scope) for _, scope, _ in multi_cons
+    ]
+    for e, (ci, p) in enumerate(edge_order):
+        _, scope, _ = multi_cons[ci]
+        k = len(scope)
+        strides = con_stride_list[ci]
+        covars = [scope[q] for q in range(k) if q != p]
+        costr = [strides[q] for q in range(k) if q != p]
+        edge_rows.append(
+            (scope[p], ci, int(offsets[ci]), strides[p], covars, costr)
+        )
+        edge_slot_per_con[ci][p] = e
+    n_edges = len(edge_rows)
     tables_flat = (
         np.concatenate(flat_parts)
         if flat_parts
@@ -283,6 +329,16 @@ def compile_dcop(
         edge_stride[e] = st
         edge_covars[e, : len(covars)] = covars
         edge_costrides[e, : len(costr)] = costr
+
+    # per-variable incoming edge lists (sentinel-padded with n_edges)
+    var_edge_lists: List[List[int]] = [[] for _ in range(n_vars)]
+    for e in range(n_edges):
+        var_edge_lists[int(edge_var[e])].append(e)
+    max_var_deg = max((len(l) for l in var_edge_lists), default=1)
+    max_var_deg = max(max_var_deg, 1)
+    var_edges = np.full((n_vars, max_var_deg), n_edges, dtype=np.int32)
+    for i, lst in enumerate(var_edge_lists):
+        var_edges[i, : len(lst)] = lst
 
     # primal neighbors (padded)
     neigh_sets: List[set] = [set() for _ in range(n_vars)]
@@ -316,6 +372,9 @@ def compile_dcop(
             bslots[bi] = edge_slot_per_con[ci]
         buckets[k] = ArityBucket(
             tables=jnp.asarray(btables, dtype=dtype),
+            tables_t=jnp.asarray(
+                np.moveaxis(btables, 0, -1), dtype=dtype
+            ),
             scopes=jnp.asarray(bscopes),
             edge_slot=jnp.asarray(bslots),
         )
@@ -336,6 +395,7 @@ def compile_dcop(
         edge_costrides=jnp.asarray(edge_costrides),
         neighbors=jnp.asarray(neighbors),
         neighbor_mask=jnp.asarray(neighbor_mask),
+        var_edges=jnp.asarray(var_edges),
         buckets=buckets,
         var_names=var_names,
         domain_labels=domain_labels,

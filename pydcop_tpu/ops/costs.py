@@ -35,13 +35,24 @@ def segment_sum_edges(
     per_edge: jax.Array,
     axis_name: Optional[str] = None,
 ) -> jax.Array:
-    """Sum per-edge rows into per-variable rows: [E, ...] → [n_vars, ...]."""
+    """Sum per-edge rows into per-variable rows: [E, ...] → [n_vars, ...].
+
+    Single-shard path: gather via the compiler's padded per-variable
+    incoming-edge lists and reduce — XLA scatters (``segment_sum``)
+    cost ~6× a same-size gather on TPU (BASELINE.md round-1 notes).
+    Sharded path: edges are mesh-local so the replicated global edge
+    lists don't apply; keep segment-sum + ``psum``.
+    """
+    if axis_name is None:
+        pad = jnp.zeros(
+            (1,) + per_edge.shape[1:], dtype=per_edge.dtype
+        )
+        padded = jnp.concatenate([per_edge, pad], axis=0)
+        return jnp.sum(padded[problem.var_edges], axis=1)
     out = jax.ops.segment_sum(
         per_edge, problem.edge_var, num_segments=problem.n_vars
     )
-    if axis_name is not None:
-        out = jax.lax.psum(out, axis_name)
-    return out
+    return jax.lax.psum(out, axis_name)
 
 
 def local_cost_sweep(

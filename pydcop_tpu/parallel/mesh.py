@@ -62,8 +62,17 @@ def problem_pspecs(problem: CompiledProblem) -> CompiledProblem:
         edge_costrides=sh,
         neighbors=rp,
         neighbor_mask=rp,
+        # global edge ids — only meaningful on the single-shard path,
+        # replicated here so the pytree structure matches
+        var_edges=rp,
         buckets={
-            k: ArityBucket(tables=sh, scopes=sh, edge_slot=sh)
+            k: ArityBucket(
+                tables=sh,
+                # transposed layout: constraints ride the LAST axis
+                tables_t=P(*([None] * k + [SHARD_AXIS])),
+                scopes=sh,
+                edge_slot=sh,
+            )
             for k in problem.buckets
         },
         var_names=problem.var_names,

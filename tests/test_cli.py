@@ -81,7 +81,7 @@ def test_solve_algo_params_and_output(ring_yaml, tmp_path):
     saved = json.loads(out.read_text())
     assert saved["cycle"] == 50
     lines = metrics.read_text().strip().splitlines()
-    assert lines[0] == "cycle,cost"
+    assert lines[0] == "time,cycle,cost,msg_count"
     assert len(lines) == 51
 
 
@@ -142,3 +142,33 @@ def test_replica_dist_command(ring_yaml):
     assert result["ktarget"] == 2
     for comp, reps in result["replica_distribution"].items():
         assert len(reps) == 2
+
+
+def test_solve_metrics_value_change_and_period(ring_yaml, tmp_path):
+    import csv as csvmod
+
+    vc = tmp_path / "vc.csv"
+    r = run_cli(
+        "solve", "--algo", "dsa", "--rounds", "60", ring_yaml,
+        "--collect_on", "value_change", "--run_metrics", str(vc),
+    )
+    assert r.returncode == 0, r.stderr
+    with open(vc, newline="") as f:
+        rows = list(csvmod.DictReader(f))
+    # only improvement/deterioration rounds are logged
+    assert 0 < len(rows) < 60
+    costs = [row["cost"] for row in rows]
+    assert all(costs[i] != costs[i + 1] for i in range(len(costs) - 1))
+
+    per = tmp_path / "per.csv"
+    r = run_cli(
+        "solve", "--algo", "dsa", "--rounds", "60", ring_yaml,
+        "--collect_on", "period", "--period", "0.001",
+        "--run_metrics", str(per),
+    )
+    assert r.returncode == 0, r.stderr
+    with open(per, newline="") as f:
+        rows = list(csvmod.DictReader(f))
+    assert rows, "period sampling produced no rows"
+    times = [float(row["time"]) for row in rows]
+    assert times == sorted(times)
