@@ -4,26 +4,48 @@ Workload (north star, BASELINE.md): 10k-variable random graph-coloring
 Max-Sum on the factor graph; metric = logical messages/sec (1 message =
 1 directed-edge update per round, both q and r directions counted).
 
-``vs_baseline`` compares against the single-host CPU baseline recorded
-in BASELINE.md.  The reference (pyDcop) publishes no numbers and cannot
-be installed in this zero-egress image, so the baseline is OUR OWN
-engine pinned to the CPU backend — a far stronger baseline than the
-reference's pure-Python thread runtime (~1e4–1e5 msgs/sec on one host;
-see BASELINE.md for the provenance discussion).
+Robustness contract (VERDICT.md round 1, item 1b): the driver must get a
+parseable JSON line NO MATTER WHAT.  TPU backend init on this image can
+hang or fail, so every measurement runs in a bounded-time subprocess:
+
+- the TPU attempt (default backend) doubles as the init probe and gets
+  one retry;
+- the CPU baseline is measured IN-RUN in a subprocess pinned to the CPU
+  backend (``JAX_PLATFORMS=cpu``) — not hardcoded;
+- on any failure the line still prints, with an ``"error"`` field.
+
+``vs_baseline`` = msgs/sec on the default backend divided by the
+measured single-host CPU msgs/sec of this same engine/workload.  The
+reference (pyDcop) publishes no numbers and cannot be installed in this
+zero-egress image; our CPU backend is a far stronger baseline than its
+pure-Python thread runtime (~1e4-1e5 msgs/sec/host — see BASELINE.md).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-# Single-host CPU msgs/sec of this same engine/workload, measured on
-# this image (see BASELINE.md "CPU baseline" row; jax CPU backend,
-# 10k vars / 59 980 edges, damping 0.5, steady-state chunks of 256).
-CPU_BASELINE_MSGS_PER_SEC = 3.1e7
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+# Last-resort constant (BASELINE.md CPU row) used ONLY if the in-run CPU
+# measurement itself fails; flagged via the "error" field when used.
+FALLBACK_CPU_BASELINE = 3.1e7
+
+N_VARS = 10_000
+ROUNDS = 1024
+CHUNK = 256
+DEGREE = 3
 
 
-def main() -> None:
+def _measure(n_vars: int, rounds: int, chunk: int) -> dict:
+    """Run the workload on whatever backend JAX picks; return metrics."""
+    import jax
+
     import __graft_entry__ as g
     from pydcop_tpu.algorithms import (
         load_algorithm_module,
@@ -32,35 +54,148 @@ def main() -> None:
     from pydcop_tpu.engine.batched import run_batched
     from pydcop_tpu.ops import compile_dcop
 
-    dcop = g._make_coloring_dcop(10000, degree=3, seed=1)
+    dcop = g._make_coloring_dcop(n_vars, degree=DEGREE, seed=1)
     problem = compile_dcop(dcop)
     module = load_algorithm_module("maxsum")
     params = prepare_algo_params({"damping": 0.5}, module.algo_params)
 
     # warmup: XLA compile + cache the chunk runner
-    run_batched(problem, module, params, rounds=256, seed=0, chunk_size=256)
+    run_batched(problem, module, params, rounds=chunk, seed=0, chunk_size=chunk)
 
     t0 = time.perf_counter()
     result = run_batched(
-        problem, module, params, rounds=1024, seed=0, chunk_size=256
+        problem, module, params, rounds=rounds, seed=0, chunk_size=chunk
     )
     dt = time.perf_counter() - t0
-    msgs_per_round = module.messages_per_round(problem, params)
-    msgs_per_sec = msgs_per_round * result.cycles / dt
+    msgs = module.messages_per_round(problem, params) * result.cycles
+    return {
+        "msgs_per_sec": msgs / dt,
+        "platform": jax.devices()[0].platform,
+        "best_cost": result.best_cost,
+        "n_edges": int(problem.n_edges),
+        "rounds": int(result.cycles),
+    }
 
-    print(
-        json.dumps(
-            {
-                "metric": "maxsum_msgs_per_sec_10k_coloring",
-                "value": round(msgs_per_sec),
-                "unit": "msgs/sec",
-                "vs_baseline": round(
-                    msgs_per_sec / CPU_BASELINE_MSGS_PER_SEC, 3
-                ),
-            }
+
+def _inner_main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--inner", action="store_true")
+    p.add_argument("--vars", type=int, default=N_VARS)
+    p.add_argument("--rounds", type=int, default=ROUNDS)
+    p.add_argument("--chunk", type=int, default=CHUNK)
+    a = p.parse_args()
+    if os.environ.get("BENCH_PIN_CPU"):
+        # the axon TPU plugin overrides the JAX_PLATFORMS env var, so
+        # the CPU pin must go through jax.config BEFORE backend init
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    print("BENCH_JSON:" + json.dumps(_measure(a.vars, a.rounds, a.chunk)))
+
+
+def _run_sub(pin_cpu: bool, timeout: float) -> dict:
+    """Run ``bench.py --inner`` in a subprocess; parse its JSON line.
+
+    Returns the metrics dict, or {"error": ...} on failure/timeout.
+    """
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if pin_cpu:
+        env["BENCH_PIN_CPU"] = "1"
+    else:
+        env.pop("BENCH_PIN_CPU", None)  # a leftover pin would silently
+        # turn the default-backend headline into a CPU number
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--inner"],
+            env=env,
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
         )
-    )
+    except subprocess.TimeoutExpired:
+        return {"error": f"timed out after {timeout:.0f}s"}
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("BENCH_JSON:"):
+            return json.loads(line[len("BENCH_JSON:"):])
+    return {
+        "error": (
+            f"rc={proc.returncode}, no BENCH_JSON line; stderr tail: "
+            + proc.stderr[-800:].replace("\n", " | ")
+        )
+    }
+
+
+def main() -> None:
+    errors = []
+
+    # Headline number on the default backend (TPU when available).  The
+    # subprocess doubles as the flaky-init probe; one retry.
+    dev = _run_sub(pin_cpu=False, timeout=480)
+    if "error" in dev:
+        errors.append(f"default-backend attempt 1: {dev['error']}")
+        dev = _run_sub(pin_cpu=False, timeout=240)
+        if "error" in dev:
+            errors.append(f"default-backend attempt 2: {dev['error']}")
+
+    # CPU baseline, measured in-run (VERDICT round 1 weak item 1).  If
+    # the default backend already WAS cpu, that run is the baseline.
+    if "error" not in dev and dev.get("platform") == "cpu":
+        cpu = dev
+    else:
+        cpu = _run_sub(pin_cpu=True, timeout=600)
+    if "error" in cpu:
+        errors.append(f"cpu baseline: {cpu['error']}")
+        baseline = FALLBACK_CPU_BASELINE
+        errors.append(
+            f"using recorded BASELINE.md cpu constant {baseline:.3g}"
+        )
+    else:
+        baseline = cpu["msgs_per_sec"]
+
+    if "error" not in dev:
+        headline = dev
+    elif "error" not in cpu:
+        headline = cpu  # fallback: report CPU so the line still parses
+    else:
+        headline = None
+
+    out = {
+        "metric": "maxsum_msgs_per_sec_10k_coloring",
+        "value": round(headline["msgs_per_sec"]) if headline else 0,
+        "unit": "msgs/sec",
+        "vs_baseline": (
+            round(headline["msgs_per_sec"] / baseline, 3) if headline else 0
+        ),
+    }
+    if headline:
+        out["backend"] = headline["platform"]
+        out["best_cost"] = headline["best_cost"]
+    if "error" not in cpu:
+        out["cpu_baseline_msgs_per_sec"] = round(cpu["msgs_per_sec"])
+    if errors:
+        out["error"] = "; ".join(errors)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        if "--inner" in sys.argv:
+            _inner_main()
+        else:
+            main()
+    except Exception as exc:  # the driver must ALWAYS get a JSON line
+        if "--inner" in sys.argv:
+            raise
+        print(
+            json.dumps(
+                {
+                    "metric": "maxsum_msgs_per_sec_10k_coloring",
+                    "value": 0,
+                    "unit": "msgs/sec",
+                    "vs_baseline": 0,
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+            )
+        )

@@ -107,12 +107,17 @@ def write_metrics(args, result: Dict[str, Any]) -> None:
                 prev = c
         elif mode == "period":
             period = getattr(args, "period", None) or 1.0
+            if period <= 0:
+                raise SystemExit("--period must be > 0")
             next_t = period
             for i in range(n):
                 t = total_time * (i + 1) / n
                 if t >= next_t or i == n - 1:
                     rows.append(row(i))
-                    next_t += period
+                    # advance past t, not by one period: one long
+                    # interval must not make later rows fire every round
+                    while next_t <= t:
+                        next_t += period
         else:  # cycle_change
             rows = [row(i) for i in range(n)]
         with open(args.run_metrics, "w", newline="") as f:

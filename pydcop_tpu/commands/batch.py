@@ -164,10 +164,14 @@ def run_cmd(args) -> int:
     done = set()
     exists = os.path.exists(args.result_file)
     if exists:
+        kept_rows: List[Dict[str, str]] = []
+        n_errors = 0
         with open(args.result_file, newline="") as f:
             for row in csv.DictReader(f):
                 if row.get("status", "").startswith("error"):
-                    continue  # failed runs are retried on resume
+                    n_errors += 1  # retried on resume; row superseded
+                    continue
+                kept_rows.append(row)
                 done.add(
                     (
                         row["batch"],
@@ -178,6 +182,25 @@ def run_cmd(args) -> int:
                         row["params"],
                     )
                 )
+        if n_errors and not args.simulate:
+            # drop the stale error rows so a successful retry doesn't
+            # leave two rows per key (consolidate would keep counting
+            # the superseded failure); write-then-rename so a crash
+            # mid-rewrite can't lose the successful rows
+            import tempfile
+
+            d = os.path.dirname(os.path.abspath(args.result_file))
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".csv.tmp")
+            try:
+                with os.fdopen(fd, "w", newline="") as f:
+                    w = csv.DictWriter(f, fieldnames=CSV_FIELDS)
+                    w.writeheader()
+                    w.writerows(kept_rows)
+                os.replace(tmp, args.result_file)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
 
     runs = list(iter_runs(spec, base_dir))
     if args.simulate:

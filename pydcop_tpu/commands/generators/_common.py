@@ -72,9 +72,17 @@ def scalefree_edges(
     targets = list(range(m))
     repeated: List[int] = []
     for v in range(m, n):
-        for t in set(targets):
+        for t in targets:
             edges.add((min(v, t), max(v, t)))
         repeated.extend(targets)
         repeated.extend([v] * m)
-        targets = rnd.sample(repeated, m)
+        # draw until m DISTINCT targets (networkx _random_subset
+        # semantics): sampling positions from the multiset can repeat a
+        # vertex, which would silently drop edges after dedup
+        chosen: List[int] = []
+        while len(chosen) < m:
+            t = rnd.choice(repeated)
+            if t not in chosen:
+                chosen.append(t)
+        targets = chosen
     return sorted(edges)

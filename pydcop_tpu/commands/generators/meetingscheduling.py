@@ -83,11 +83,15 @@ def generate(args):
     eq_matrix = np.where(eye, 0.0, np.float32(args.eq_cost))
     excl_matrix = np.where(eye, np.float32(args.noconflict_cost), 0.0)
 
-    # equality inside one event
+    # equality inside one event: ALL pairs of participant variables
+    # (PEAV encoding), not a chain — the chain under-penalizes
+    # disagreement for events with >2 participants
+    import itertools
+
     for e, members in attendance.items():
-        for i in range(len(members) - 1):
-            v1 = variables[(e, members[i])]
-            v2 = variables[(e, members[i + 1])]
+        for r1, r2 in itertools.combinations(members, 2):
+            v1 = variables[(e, r1)]
+            v2 = variables[(e, r2)]
             dcop.add_constraint(
                 NAryMatrixRelation(
                     [v1, v2], eq_matrix, name=f"eq_{v1.name}_{v2.name}"

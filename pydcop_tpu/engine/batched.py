@@ -128,6 +128,12 @@ def run_batched(
     t0 = time.perf_counter()
     sign = -1.0 if problem.maximize else 1.0
 
+    fingerprint = None
+    if checkpoint_path is not None:
+        from pydcop_tpu.ops.compile import problem_fingerprint
+
+        fingerprint = problem_fingerprint(problem)
+
     static_params = {
         k: v for k, v in params.items() if isinstance(v, (str, bool))
     }
@@ -195,6 +201,13 @@ def run_batched(
                     f"chunk_size {meta.get('chunk_size')}, not "
                     f"{chunk_size} — per-round keys are derived from "
                     "chunk boundaries, so the RNG stream would diverge"
+                )
+            if meta.get("problem") not in (None, fingerprint):
+                raise ValueError(
+                    f"Checkpoint {checkpoint_path} was written for a "
+                    f"different problem instance (fingerprint "
+                    f"{meta.get('problem')} != {fingerprint}) — "
+                    "resuming would silently produce wrong results"
                 )
             state = jax.tree_util.tree_map(jnp.asarray, state)
             best_cost = jnp.asarray(bc, dtype=best_cost.dtype)
@@ -270,6 +283,7 @@ def run_batched(
                         "algo": algo_module.__name__,
                         "seed": seed,
                         "chunk_size": chunk_size,
+                        "problem": fingerprint,
                     },
                 )
                 chunks_since_save = 0
@@ -301,6 +315,7 @@ def run_batched(
                 "algo": algo_module.__name__,
                 "seed": seed,
                 "chunk_size": chunk_size,
+                "problem": fingerprint,
             },
         )
 

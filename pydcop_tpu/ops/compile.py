@@ -456,6 +456,22 @@ def _tabulate_padded(c: RelationProtocol, d_max: int) -> np.ndarray:
     return padded
 
 
+def problem_fingerprint(problem: CompiledProblem) -> str:
+    """Stable hash identifying the problem *instance* (names, domains,
+    scopes and cost tables) — used to reject checkpoints written for a
+    structurally identical problem with different costs."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(repr(problem.var_names).encode())
+    h.update(repr(problem.domain_labels).encode())
+    h.update(repr(problem.con_names).encode())
+    h.update(np.asarray(problem.con_scopes).tobytes())
+    h.update(np.asarray(problem.unary).tobytes())
+    h.update(np.asarray(problem.tables_flat).tobytes())
+    return h.hexdigest()[:16]
+
+
 def encode_assignment(
     problem: CompiledProblem, assignment: Mapping[str, Any]
 ) -> jnp.ndarray:

@@ -78,6 +78,34 @@ def test_resume_matches_uninterrupted_run(tmp_path, algo):
     assert resumed.best_cost == full.best_cost
 
 
+def test_resume_rejects_different_problem_instance(tmp_path):
+    """A checkpoint from a structurally identical problem with different
+    costs must be rejected (problem fingerprint, ADVICE r1 medium)."""
+    module = load_algorithm_module("dsa")
+    params = prepare_algo_params({}, module.algo_params)
+    path = str(tmp_path / "ck.npz")
+
+    problem_a = ring_problem()
+
+    # same structure (6-var ring, same names/domains), different costs
+    dcop = DCOP("ring")
+    vs = [Variable(f"v{i}", D) for i in range(6)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(6):
+        j = (i + 1) % 6
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}_{j}", f"5 if v{i} == v{j} else 0", vs)
+        )
+    problem_b = compile_dcop(dcop)
+
+    run_batched(problem_a, module, params, rounds=8, seed=3, chunk_size=8,
+                checkpoint_path=path)
+    with pytest.raises(ValueError, match="different problem instance"):
+        run_batched(problem_b, module, params, rounds=16, seed=3,
+                    chunk_size=8, checkpoint_path=path, resume=True)
+
+
 def test_solve_cli_checkpoint_resume(tmp_path):
     from tests.test_cli import run_cli
 

@@ -1,0 +1,85 @@
+"""Perf-regression guards: HLO-text assertions on the hot path.
+
+All functional tests run on the CPU backend (conftest), so a layout
+regression — e.g. a ``segment_sum``/scatter sneaking back into the
+single-shard Max-Sum round, which cost ~4.6x in round 1 (BASELINE.md) —
+would pass CI silently.  These tests pin the *compiled program shape*
+instead: the single-shard round must stay scatter-free and within a
+bounded op count (VERDICT r1, next-round item 8).
+
+Bounds carry ~2x headroom over the measured values (519 HLO lines, 11
+gathers for the step; 165 lines for total_cost, jax 0.8/CPU) so routine
+jax upgrades don't trip them, while a structural regression (per-edge
+scatter ≈ +E ops, or segment_sum lowering to scatter) does.
+"""
+
+import re
+
+import jax
+import pytest
+
+from pydcop_tpu.algorithms import load_algorithm_module, prepare_algo_params
+from pydcop_tpu.ops import compile_dcop
+from pydcop_tpu.ops.costs import total_cost
+
+
+@pytest.fixture(scope="module")
+def coloring_problem():
+    import __graft_entry__ as g
+
+    return compile_dcop(g._make_coloring_dcop(64))
+
+
+def _compiled_text(fn, *args):
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+# HLO instruction usage looks like "f32[3,190]{1,0} scatter(...)" —
+# or "(f32[..]{0}, s32[..]{0}) scatter(" for tuple-shaped (variadic)
+# ops, or "f32[] op(" for scalars.  Match any shape terminator before
+# the op name; a plain substring check would also hit op metadata
+# (function names).
+def _has_op(txt, op):
+    return re.search(r"[\]})] %s\(" % op, txt) is not None
+
+
+def _count_op(txt, op):
+    return len(re.findall(r"[\]})] %s\(" % op, txt))
+
+
+def test_maxsum_round_hlo_is_clean(coloring_problem):
+    problem = coloring_problem
+    module = load_algorithm_module("maxsum")
+    params = prepare_algo_params({"damping": 0.5}, module.algo_params)
+    state = module.init_state(problem, jax.random.PRNGKey(0), params)
+
+    def fn(problem, state, key):
+        return module.step(problem, state, key, params)
+
+    txt = _compiled_text(fn, problem, state, jax.random.PRNGKey(1))
+    assert not _has_op(txt, "scatter"), (
+        "single-shard Max-Sum round compiled to a scatter — the "
+        "position-major edge layout (ops/compile.py edge_order) or the "
+        "gather-based belief path (maxsum.belief_from_r) regressed"
+    )
+    n_lines = len(txt.splitlines())
+    assert n_lines < 1200, (
+        f"Max-Sum round HLO grew to {n_lines} lines (measured 519): "
+        "op-count regression on the north-star hot path"
+    )
+    n_gather = _count_op(txt, "gather")
+    assert n_gather <= 24, (
+        f"Max-Sum round now has {n_gather} gathers (measured 11): "
+        "a per-edge or per-degree-slot gather was likely reintroduced"
+    )
+
+
+def test_total_cost_hlo_is_clean(coloring_problem):
+    problem = coloring_problem
+    values = problem.init_idx
+    txt = _compiled_text(lambda p, v: total_cost(p, v), problem, values)
+    assert not _has_op(txt, "scatter")
+    n_lines = len(txt.splitlines())
+    assert n_lines < 500, (
+        f"total_cost HLO grew to {n_lines} lines (measured 165)"
+    )
