@@ -111,3 +111,12 @@ def communication_load(
     node: _graph.VariableComputationNode, neighbor_name: str
 ) -> float:
     return UNIT_SIZE
+
+
+def build_computation(comp_def, seed: int = 0):
+    """Host message-driven computation (async semantics parity path —
+    see ``pydcop_tpu.infrastructure``); solving runs on the batched
+    engine via ``init_state``/``step``."""
+    from pydcop_tpu.algorithms import _host_dsa
+
+    return _host_dsa.build_computation(comp_def, seed=seed)

@@ -91,3 +91,12 @@ def messages_per_round(
         (params or {}).get("activation", _DEFAULT_ACTIVATION)
     )
     return max(1, round(activation * 2 * problem.n_real_edges))
+
+
+def build_computation(comp_def, seed: int = 0):
+    """Host message-driven computation (async semantics parity path —
+    see ``pydcop_tpu.infrastructure``); solving runs on the batched
+    engine via ``init_state``/``step``."""
+    from pydcop_tpu.algorithms import _host_maxsum
+
+    return _host_maxsum.build_computation(comp_def, seed=seed)

@@ -105,3 +105,12 @@ def communication_load(
 ) -> float:
     """One value message per round on each link."""
     return HEADER_SIZE + UNIT_SIZE
+
+
+def build_computation(comp_def, seed: int = 0):
+    """Host message-driven computation (async semantics parity path —
+    see ``pydcop_tpu.infrastructure``); solving runs on the batched
+    engine via ``init_state``/``step``."""
+    from pydcop_tpu.algorithms import _host_dsa
+
+    return _host_dsa.build_computation(comp_def, seed=seed)

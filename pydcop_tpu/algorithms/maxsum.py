@@ -239,3 +239,12 @@ def communication_load(node, neighbor_name: str) -> float:
     if hasattr(node, "variable"):
         return HEADER_SIZE + len(node.variable.domain)
     return HEADER_SIZE + UNIT_SIZE
+
+
+def build_computation(comp_def, seed: int = 0):
+    """Host message-driven computation (async semantics parity path —
+    see ``pydcop_tpu.infrastructure``); solving runs on the batched
+    engine via ``init_state``/``step``."""
+    from pydcop_tpu.algorithms import _host_maxsum
+
+    return _host_maxsum.build_computation(comp_def, seed=seed)

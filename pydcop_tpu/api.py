@@ -34,6 +34,7 @@ def solve(
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 1,
     resume: bool = False,
+    mode: str = "batched",
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -42,11 +43,31 @@ def solve(
     algorithm parameters, and stop conditions (round budget and/or
     wall-clock timeout).
 
+    ``mode`` selects the execution engine: ``"batched"`` (default, the
+    TPU engine), ``"thread"`` (reference-style thread-per-agent host
+    runtime), or ``"sim"`` (deterministic seeded async event loop —
+    the parity-test schedule).
+
     >>> result = solve(my_dcop, "dsa", {"variant": "B"}, rounds=100)
     >>> result["assignment"], result["cost"]
     """
     if isinstance(dcop, (str, list, tuple)):
         dcop = load_dcop_from_file(dcop)
+
+    if mode in ("thread", "sim"):
+        if checkpoint_path is not None or resume:
+            raise ValueError(
+                "checkpoint/resume is only supported on the batched "
+                f"engine, not mode={mode!r}"
+            )
+        from pydcop_tpu.infrastructure import solve_host
+
+        return solve_host(
+            dcop, algo, algo_params, mode=mode, timeout=timeout,
+            seed=seed, rounds=rounds,
+        )
+    if mode != "batched":
+        raise ValueError(f"solve: unknown mode {mode!r}")
 
     if isinstance(algo, AlgorithmDef):
         algo_name = algo.algo

@@ -33,8 +33,12 @@ def set_parser(subparsers) -> None:
         "the batched engine solves regardless of placement)",
     )
     p.add_argument(
-        "-m", "--mode", choices=["thread", "process", "tpu"],
-        default="tpu", help="execution mode (tpu = batched engine)",
+        "-m", "--mode", choices=["thread", "sim", "process", "tpu"],
+        default="tpu",
+        help="execution mode: tpu = batched engine (default); thread = "
+        "host thread-per-agent runtime; sim = deterministic async "
+        "event loop; process = cross-process (use the orchestrator/"
+        "agent commands)",
     )
     p.add_argument("--rounds", type=int, default=200, help="round budget")
     p.add_argument("--seed", type=int, default=0)
@@ -61,6 +65,12 @@ def set_parser(subparsers) -> None:
 def run_cmd(args) -> int:
     from pydcop_tpu.api import solve
 
+    if args.mode == "process":
+        raise SystemExit(
+            "solve --mode process is not supported in this build; use "
+            "--mode thread (in-process host runtime) or the default "
+            "tpu mode"
+        )
     params = parse_algo_params(args.algo_params)
     result = solve(
         args.dcop_files if len(args.dcop_files) > 1 else args.dcop_files[0],
@@ -73,6 +83,7 @@ def run_cmd(args) -> int:
         checkpoint_path=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
+        mode="batched" if args.mode == "tpu" else args.mode,
     )
     write_metrics(args, result)
     result.pop("cost_trace", None)  # keep the printed JSON compact

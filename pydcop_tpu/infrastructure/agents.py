@@ -1,0 +1,119 @@
+"""Thread-per-agent execution container (reference:
+``pydcop/infrastructure/agents.py``).
+
+One :class:`Agent` = one daemon thread + one :class:`Messaging` router
++ the computations the distribution placed on it.  This is the
+``--mode thread`` execution path; production solving uses the batched
+TPU engine instead (``pydcop_tpu.engine``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from pydcop_tpu.infrastructure.communication import (
+    MSG_ALGO,
+    CommunicationLayer,
+    Messaging,
+    UnknownComputation,
+)
+from pydcop_tpu.infrastructure.computations import (
+    Message,
+    MessagePassingComputation,
+)
+
+
+class Agent:
+    """Hosts computations and pumps their messages on its own thread."""
+
+    def __init__(
+        self,
+        name: str,
+        comm: CommunicationLayer,
+        directory: Dict[str, str],
+        on_error: Optional[Callable[[str, BaseException], None]] = None,
+    ):
+        self.name = name
+        self._comm = comm
+        # computation name -> agent name, shared by all agents of a run
+        self._directory = directory
+        self._computations: Dict[str, MessagePassingComputation] = {}
+        self.messaging = Messaging(name)
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._on_error = on_error
+        self._busy = False  # a handler is mid-execution
+        self.activity_time = 0.0  # seconds spent handling messages
+        comm.register(name, self.messaging)
+
+    # -- deployment ----------------------------------------------------
+
+    def deploy_computation(self, comp: MessagePassingComputation) -> None:
+        comp.message_sender = self._send
+        self._computations[comp.name] = comp
+        self._directory[comp.name] = self.name
+
+    @property
+    def computations(self) -> Dict[str, MessagePassingComputation]:
+        return dict(self._computations)
+
+    def _send(self, src_comp: str, dest_comp: str, msg: Message) -> None:
+        dest_agent = self._directory.get(dest_comp)
+        if dest_agent is None:
+            raise UnknownComputation(dest_comp)
+        self._comm.send_msg(dest_agent, src_comp, dest_comp, msg, MSG_ALGO)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"agent-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def start_computations(self) -> None:
+        for comp in self._computations.values():
+            comp.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        for comp in self._computations.values():
+            if comp.is_running:
+                comp.stop()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    @property
+    def is_idle(self) -> bool:
+        """No queued messages AND no handler mid-execution — without
+        the busy flag, a slow handler that will post more messages is
+        invisible and the quiescence monitor stops the run early."""
+        return self.messaging.pending == 0 and not self._busy
+
+    # -- message pump --------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop_evt.is_set():
+            item = self.messaging.next_msg(timeout=0.05)
+            if item is None:
+                continue
+            src, dest, msg = item
+            comp = self._computations.get(dest)
+            if comp is None:
+                continue  # computation moved/stopped mid-flight
+            t0 = time.perf_counter()
+            self._busy = True
+            try:
+                comp.on_message(src, msg, t0)
+            except BaseException as e:  # surface, don't kill the pump
+                if self._on_error is not None:
+                    self._on_error(dest, e)
+                else:
+                    raise
+            finally:
+                self._busy = False
+                self.activity_time += time.perf_counter() - t0

@@ -1,0 +1,126 @@
+"""Communication layer + per-agent Messaging router (reference:
+``pydcop/infrastructure/communication.py``).
+
+The reference ships two interchangeable layers: in-process queues and
+HTTP+JSON.  Here the in-process layer backs ``--mode thread``; the
+cross-machine story is TPU-native instead (XLA collectives over
+ICI/DCN, see ``pydcop_tpu.parallel``) with a socket control plane for
+cross-process runs (``pydcop_tpu.infrastructure.orchestrator``), so no
+HTTP server per agent is needed.
+
+``Messaging`` preserves the reference's observable behavior: priority
+classes (management messages preempt algorithm messages), per-message
+count/size metrics, and failure surfacing for unknown computations.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional, Tuple
+
+from pydcop_tpu.infrastructure.computations import Message
+
+# priority classes: lower value = delivered first
+MSG_MGT = 10
+MSG_VALUE = 15
+MSG_ALGO = 20
+
+
+class UnknownComputation(Exception):
+    pass
+
+
+class UnreachableAgent(Exception):
+    pass
+
+
+class CommunicationLayer:
+    """Transport abstraction: routes a message to the agent hosting the
+    destination computation."""
+
+    def __init__(self):
+        self.discovery: Dict[str, "Messaging"] = {}
+
+    def register(self, agent_name: str, messaging: "Messaging") -> None:
+        self.discovery[agent_name] = messaging
+
+    def unregister(self, agent_name: str) -> None:
+        self.discovery.pop(agent_name, None)
+
+    def send_msg(
+        self,
+        dest_agent: str,
+        src_comp: str,
+        dest_comp: str,
+        msg: Message,
+        priority: int = MSG_ALGO,
+    ) -> None:
+        raise NotImplementedError
+
+
+class InProcessCommunicationLayer(CommunicationLayer):
+    """Direct queue delivery between agents of one process."""
+
+    def send_msg(
+        self,
+        dest_agent: str,
+        src_comp: str,
+        dest_comp: str,
+        msg: Message,
+        priority: int = MSG_ALGO,
+    ) -> None:
+        messaging = self.discovery.get(dest_agent)
+        if messaging is None:
+            raise UnreachableAgent(dest_agent)
+        messaging.deliver(src_comp, dest_comp, msg, priority)
+
+
+class Messaging:
+    """Per-agent message router with priority queues and metrics.
+
+    One consumer (the agent thread) pops with :meth:`next_msg`; any
+    thread may :meth:`deliver`.  Counts every message and its logical
+    size (``Message.size``), split by priority class — the counters the
+    reference's msgs/sec metric is derived from.
+    """
+
+    def __init__(self, agent_name: str):
+        self.agent_name = agent_name
+        self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._seq = 0  # FIFO tie-break within a priority class
+        self._lock = threading.Lock()
+        self.count_msg = 0
+        self.size_msg = 0
+        self.count_by_priority: Dict[int, int] = {}
+
+    def deliver(
+        self,
+        src_comp: str,
+        dest_comp: str,
+        msg: Message,
+        priority: int = MSG_ALGO,
+    ) -> None:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self.count_msg += 1
+            self.size_msg += msg.size
+            self.count_by_priority[priority] = (
+                self.count_by_priority.get(priority, 0) + 1
+            )
+        self._queue.put((priority, seq, src_comp, dest_comp, msg))
+
+    def next_msg(
+        self, timeout: Optional[float] = None
+    ) -> Optional[Tuple[str, str, Message]]:
+        """Pop the next (src, dest, msg), or None on timeout."""
+        try:
+            _, _, src, dest, msg = self._queue.get(timeout=timeout)
+            return src, dest, msg
+        except queue.Empty:
+            return None
+
+    @property
+    def pending(self) -> int:
+        return self._queue.qsize()

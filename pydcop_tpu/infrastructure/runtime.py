@@ -1,0 +1,296 @@
+"""``solve_host()`` — run a DCOP on the host message-driven runtime
+(reference: ``pydcop/infrastructure/run.py:run_local_thread_dcop``).
+
+Two execution modes over the SAME computations:
+
+- ``mode='sim'``: a deterministic single-thread event loop.  Pending
+  messages live in per-(src, dest) FIFO channels; each step picks a
+  random nonempty channel (seeded) and delivers its head.  This models
+  asynchrony (any interleaving ACROSS channels, in-order within one,
+  matching the reference's queue delivery) while staying reproducible —
+  the workhorse of the async-parity tests.
+- ``mode='thread'``: one real thread per agent
+  (``infrastructure.agents.Agent``), in-process queue delivery — the
+  reference's ``--mode thread`` execution model.
+
+Termination: quiescence (no pending messages — host algorithms stop
+re-sending stable messages), a message budget, or wall-clock timeout.
+Algorithms with tie-moves (DSA B/C) never quiesce under asynchrony, so
+the runtime tracks the ANYTIME BEST assignment (as the reference
+orchestrator does) and reports it as ``cost``/``assignment``, with the
+last state in ``final_*``.  Result dict matches the reference surface:
+``{assignment, cost, cycle, msg_count, msg_size, status, time}``
+(``cycle`` reports delivered messages, the async analogue of rounds).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Dict, List, Mapping, Optional, Tuple, Union
+
+from pydcop_tpu.algorithms import (
+    AlgorithmDef,
+    ComputationDef,
+    load_algorithm_module,
+    prepare_algo_params,
+)
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.graphs import load_graph_module
+from pydcop_tpu.infrastructure.computations import (
+    Message,
+    MessagePassingComputation,
+    VariableComputation,
+)
+
+
+def _build_computations(
+    dcop: DCOP, algo_name: str, params: Dict[str, Any], seed: int
+) -> List[MessagePassingComputation]:
+    module = load_algorithm_module(algo_name)
+    if not hasattr(module, "build_computation"):
+        raise ValueError(
+            f"{algo_name}: no host build_computation — only the batched "
+            "TPU engine supports this algorithm"
+        )
+    graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(dcop)
+    algo_def = AlgorithmDef(algo_name, params, dcop.objective)
+    return [
+        module.build_computation(ComputationDef(node, algo_def), seed=seed)
+        for node in graph.nodes
+    ]
+
+
+def solve_host(
+    dcop: DCOP,
+    algo: Union[str, AlgorithmDef],
+    algo_params: Optional[Mapping[str, Any]] = None,
+    mode: str = "sim",
+    timeout: Optional[float] = None,
+    max_msgs: Optional[int] = None,
+    seed: int = 0,
+    distribution=None,
+    rounds: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Solve ``dcop`` with the host message-driven runtime.
+
+    The budget is ``max_msgs`` delivered messages; when only ``rounds``
+    is given it is converted as rounds × number of computations (one
+    activation per computation ≈ one synchronous round), so a CLI
+    ``--rounds`` budget stays meaningful across engines.
+    """
+    t0 = time.perf_counter()
+    if isinstance(algo, AlgorithmDef):
+        algo_name, params_in = algo.algo, dict(algo.params)
+        if algo_params:
+            params_in.update(algo_params)
+    else:
+        algo_name, params_in = algo, dict(algo_params or {})
+    module = load_algorithm_module(algo_name)
+    params = prepare_algo_params(params_in, module.algo_params)
+
+    computations = _build_computations(dcop, algo_name, params, seed)
+
+    if max_msgs is None:
+        max_msgs = (
+            rounds * len(computations) if rounds else 100_000
+        )
+
+    # anytime-best tracking (what the reference orchestrator records):
+    # async variants with tie-moves (DSA B/C) never quiesce, so the
+    # budget-stopped run's meaningful result is the best state seen
+    var_comps = [
+        c for c in computations if isinstance(c, VariableComputation)
+    ]
+    sign = -1.0 if dcop.objective == "max" else 1.0
+    best = {"cost": float("inf"), "assignment": {}}
+
+    def snapshot() -> None:
+        assignment = {c.variable.name: c.current_value for c in var_comps}
+        if any(v is None for v in assignment.values()):
+            return
+        cost = dcop.solution_cost(assignment)
+        if sign * cost < best["cost"]:
+            best["cost"] = sign * cost
+            best["assignment"] = assignment
+
+    if mode == "sim":
+        status, delivered, size = _run_sim(
+            computations, timeout, max_msgs, seed, t0, snapshot
+        )
+    elif mode == "thread":
+        status, delivered, size = _run_threads(
+            dcop, computations, timeout, max_msgs, distribution, t0,
+            snapshot,
+        )
+    else:
+        raise ValueError(f"solve_host: unknown mode {mode!r}")
+
+    assignment = {c.variable.name: c.current_value for c in var_comps}
+    cost = dcop.solution_cost(assignment)
+    snapshot()
+    return {
+        "assignment": best["assignment"],
+        "cost": sign * best["cost"],  # back to the native sign
+        "final_assignment": assignment,
+        "final_cost": cost,
+        "cycle": delivered,
+        "msg_count": delivered,
+        "msg_size": size,
+        "status": status,
+        "time": time.perf_counter() - t0,
+    }
+
+
+def _run_sim(
+    computations: List[MessagePassingComputation],
+    timeout: Optional[float],
+    max_msgs: int,
+    seed: int,
+    t0: float,
+    snapshot,
+) -> Tuple[str, int, int]:
+    rnd = random.Random(seed)
+    # per-(src, dest) FIFO channels: asynchrony means ANY interleaving
+    # ACROSS channels, but messages within one channel stay ordered
+    # (the reference's queue/TCP delivery guarantees this; violating it
+    # lets a stale message clobber a newer one and breaks convergence)
+    from collections import deque
+
+    channels: Dict[Tuple[str, str], "deque"] = {}
+    nonempty: List[Tuple[str, str]] = []
+    by_name = {c.name: c for c in computations}
+
+    def sender(src: str, dest: str, msg: Message) -> None:
+        if dest not in by_name:
+            raise ValueError(f"message to unknown computation {dest!r}")
+        ch = (src, dest)
+        q = channels.get(ch)
+        if q is None:
+            q = channels[ch] = deque()
+        if not q:
+            nonempty.append(ch)
+        q.append(msg)
+
+    for c in computations:
+        c.message_sender = sender
+    # start in randomized order — part of the modeled asynchrony
+    order = list(computations)
+    rnd.shuffle(order)
+    for c in order:
+        c.start()
+
+    delivered = 0
+    size = 0
+    status = "finished"  # quiescence
+    snap_every = max(1, len(computations))
+    while nonempty:
+        if delivered % snap_every == 0:
+            snapshot()
+        if delivered >= max_msgs:
+            status = "msg_budget"
+            break
+        if timeout is not None and time.perf_counter() - t0 > timeout:
+            status = "timeout"
+            break
+        i = rnd.randrange(len(nonempty))
+        nonempty[i], nonempty[-1] = nonempty[-1], nonempty[i]
+        ch = nonempty[-1]
+        q = channels[ch]
+        msg = q.popleft()
+        if not q:
+            nonempty.pop()
+        src, dest = ch
+        delivered += 1
+        size += msg.size
+        by_name[dest].on_message(src, msg)
+    for c in computations:
+        c.stop()
+    return status, delivered, size
+
+
+def _run_threads(
+    dcop: DCOP,
+    computations: List[MessagePassingComputation],
+    timeout: Optional[float],
+    max_msgs: int,
+    distribution,
+    t0: float,
+    snapshot,
+) -> Tuple[str, int, int]:
+    from pydcop_tpu.infrastructure.agents import Agent
+    from pydcop_tpu.infrastructure.communication import (
+        InProcessCommunicationLayer,
+    )
+
+    # placement: given Distribution, else dcop agents round-robin, else
+    # one agent per computation (the reference's oneagent default)
+    placement: Dict[str, List[str]] = {}
+    if distribution is not None:
+        for comp in computations:
+            placement.setdefault(
+                distribution.agent_for(comp.name), []
+            ).append(comp.name)
+    elif dcop.agents:
+        agent_names = sorted(dcop.agents)
+        for i, comp in enumerate(computations):
+            placement.setdefault(
+                agent_names[i % len(agent_names)], []
+            ).append(comp.name)
+    else:
+        for comp in computations:
+            placement.setdefault(f"a_{comp.name}", []).append(comp.name)
+
+    comm = InProcessCommunicationLayer()
+    directory: Dict[str, str] = {}
+    by_name = {c.name: c for c in computations}
+    errors: List[Tuple[str, BaseException]] = []
+    agents = []
+    for aname, comp_names in placement.items():
+        agent = Agent(
+            aname, comm, directory,
+            on_error=lambda comp, e: errors.append((comp, e)),
+        )
+        for cname in comp_names:
+            agent.deploy_computation(by_name[cname])
+        agents.append(agent)
+
+    for a in agents:
+        a.start()
+    for a in agents:
+        a.start_computations()
+
+    # run until quiescent (all queues empty twice in a row), message
+    # budget, or timeout
+    status = "finished"
+    idle_checks = 0
+    while True:
+        time.sleep(0.02)
+        snapshot()  # values are plain attributes; a torn read at worst
+        # yields a mix of valid values, whose cost is still a valid
+        # anytime sample
+        total = sum(a.messaging.count_msg for a in agents)
+        if timeout is not None and time.perf_counter() - t0 > timeout:
+            status = "timeout"
+            break
+        if total >= max_msgs:
+            status = "msg_budget"
+            break
+        if all(a.is_idle for a in agents):
+            idle_checks += 1
+            if idle_checks >= 3:
+                break
+        else:
+            idle_checks = 0
+    for a in agents:
+        a.stop()
+    for a in agents:
+        a.join(timeout=1.0)
+    if errors:
+        comp, err = errors[0]
+        raise RuntimeError(
+            f"computation {comp!r} failed in thread mode: {err!r}"
+        ) from err
+    delivered = sum(a.messaging.count_msg for a in agents)
+    size = sum(a.messaging.size_msg for a in agents)
+    return status, delivered, size

@@ -1,0 +1,182 @@
+"""Tests for the host message-driven runtime (infrastructure/)."""
+
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.infrastructure import (
+    Message,
+    MessagePassingComputation,
+    message_type,
+    register,
+    solve_host,
+)
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+D = Domain("colors", "", [0, 1, 2])
+
+
+def ring_dcop(n=6):
+    dcop = DCOP("ring")
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    return dcop
+
+
+def tree_dcop(n=7):
+    dcop = DCOP("tree")
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(1, n):
+        p = (i - 1) // 2
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}", f"1 if v{i} == v{p} else 0", vs)
+        )
+    return dcop
+
+
+# -- computations base classes -----------------------------------------
+
+
+def test_message_type_factory():
+    ValueMsg = message_type("value", ["value", "extra"])
+    m = ValueMsg(value=3, extra="x")
+    assert m.type == "value"
+    assert m.value == 3
+    assert m.extra == "x"
+    with pytest.raises(TypeError):
+        ValueMsg(value=1)  # missing field
+    with pytest.raises(TypeError):
+        ValueMsg(value=1, extra=2, nope=3)  # unknown field
+
+
+def test_message_simple_repr_roundtrip():
+    from pydcop_tpu.algorithms._host_dsa import DsaValueMessage
+    from pydcop_tpu.algorithms._host_maxsum import MaxSumCostMessage
+
+    m = DsaValueMessage(2)
+    m2 = from_repr(simple_repr(m))
+    assert m2.value == 2 and m2.type == "dsa_value"
+
+    c = MaxSumCostMessage({0: 1.5, 1: 0.0})
+    c2 = from_repr(simple_repr(c))
+    assert c2.costs == {0: 1.5, 1: 0.0}
+    assert c2.size == 2
+
+
+def test_register_dispatch():
+    log = []
+
+    class Comp(MessagePassingComputation):
+        @register("ping")
+        def _on_ping(self, sender, msg, t):
+            log.append(("ping", sender, msg.content))
+
+        @register("pong")
+        def _on_pong(self, sender, msg, t):
+            log.append(("pong", sender, msg.content))
+
+    c = Comp("c1")
+    c.start()
+    c.on_message("x", Message("ping", 1))
+    c.on_message("y", Message("pong", 2))
+    assert log == [("ping", "x", 1), ("pong", "y", 2)]
+    with pytest.raises(ValueError, match="no handler"):
+        c.on_message("z", Message("nope"))
+    # messages to a stopped computation are dropped, not dispatched
+    c.stop()
+    c.on_message("x", Message("ping", 3))
+    assert len(log) == 2
+
+
+# -- sim mode ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("algo", ["adsa", "dsa", "dsatuto"])
+def test_sim_dsa_reaches_optimum_on_ring(algo):
+    r = solve_host(ring_dcop(), algo, mode="sim", seed=1)
+    assert r["status"] == "finished"  # quiescent at a local optimum
+    assert r["cost"] == 0
+    assert r["msg_count"] > 0
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_sim_amaxsum_exact_on_tree(seed):
+    """Async Max-Sum must be exact on trees for any async schedule."""
+    r = solve_host(tree_dcop(), "amaxsum", mode="sim", seed=seed)
+    assert r["status"] == "finished"
+    assert r["cost"] == 0
+
+
+def test_sim_is_deterministic():
+    r1 = solve_host(ring_dcop(), "amaxsum", mode="sim", seed=3)
+    r2 = solve_host(ring_dcop(), "amaxsum", mode="sim", seed=3)
+    assert r1["assignment"] == r2["assignment"]
+    assert r1["msg_count"] == r2["msg_count"]
+
+
+def test_sim_msg_budget():
+    r = solve_host(ring_dcop(), "amaxsum", mode="sim", seed=0, max_msgs=5)
+    assert r["status"] == "msg_budget"
+    assert r["msg_count"] == 5
+
+
+# -- thread mode -------------------------------------------------------
+
+
+def test_thread_mode_solves_ring():
+    r = solve_host(ring_dcop(), "adsa", mode="thread", timeout=15)
+    assert r["status"] == "finished"
+    assert r["cost"] == 0
+    assert r["msg_count"] > 0
+
+
+def test_thread_mode_uses_declared_agents():
+    dcop = ring_dcop()
+    from pydcop_tpu.dcop.objects import AgentDef
+
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(3)])
+    r = solve_host(dcop, "adsa", mode="thread", timeout=15)
+    assert r["cost"] == 0
+
+
+@pytest.mark.parametrize("algo", ["adsa", "amaxsum"])
+def test_sim_respects_max_objective(algo):
+    """'max' DCOPs must be maximized on the host path too (the batched
+    engine negates costs at compile time; the host computations flip
+    their comparison sign instead)."""
+    dcop = DCOP("maxprob", objective="max")
+    vs = [Variable(f"v{i}", D) for i in range(4)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(3):
+        # reward 5 when adjacent variables AGREE
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}", f"5 if v{i} == v{i+1} else 0", vs
+            )
+        )
+    r = solve_host(dcop, algo, mode="sim", seed=0)
+    assert r["cost"] == 15, r  # all agree = maximal reward
+    assert len(set(r["assignment"].values())) == 1
+
+
+def test_api_solve_mode_thread_and_sim():
+    from pydcop_tpu.api import solve
+
+    r = solve(ring_dcop(), "adsa", mode="sim")
+    assert r["cost"] == 0
+    r = solve(ring_dcop(), "adsa", mode="thread", timeout=15)
+    assert r["cost"] == 0
+    with pytest.raises(ValueError, match="unknown mode"):
+        solve(ring_dcop(), "adsa", mode="bogus")
+    with pytest.raises(ValueError, match="checkpoint"):
+        solve(ring_dcop(), "adsa", mode="sim", checkpoint_path="x.npz")
