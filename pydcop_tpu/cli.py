@@ -24,6 +24,8 @@ COMMANDS = [
     "batch",
     "consolidate",
     "replica_dist",
+    "orchestrator",
+    "agent",
 ]
 
 

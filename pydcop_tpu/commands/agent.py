@@ -1,0 +1,72 @@
+"""``pydcop_tpu agent`` (reference: ``pydcop/commands/agent.py``).
+
+Start one or more agent processes that register with an orchestrator,
+receive their deployment, and participate in the sharded SPMD solve as
+one ``jax.distributed`` process each.  With several ``--names``, one OS
+subprocess is forked per agent (the reference's multi-agent form).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "agent",
+        help="join an orchestrator-coordinated cross-process run",
+    )
+    p.add_argument(
+        "--names", "-n", nargs="+", required=True,
+        help="agent name(s); several names fork one process each",
+    )
+    p.add_argument(
+        "--orchestrator", "-o", required=True, metavar="HOST:PORT",
+        help="orchestrator management address",
+    )
+    p.add_argument(
+        "--retry_for", type=float, default=30.0,
+        help="seconds to keep retrying the initial connection",
+    )
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    if len(args.names) > 1:
+        # one OS process per agent: each is an independent
+        # jax.distributed participant, so fork real subprocesses
+        import subprocess
+
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "pydcop_tpu", "agent",
+                    "--names", name,
+                    "--orchestrator", args.orchestrator,
+                    "--retry_for", str(args.retry_for),
+                ]
+            )
+            for name in args.names
+        ]
+        rc = 0
+        for p in procs:
+            rc = rc or p.wait()
+        return rc
+
+    from pydcop_tpu.infrastructure.orchestrator import run_agent
+
+    result = run_agent(
+        args.orchestrator, args.names[0], retry_for=args.retry_for
+    )
+    print(
+        json.dumps(
+            {
+                "agent": args.names[0],
+                "cost": result["cost"],
+                "cycle": result["cycle"],
+                "status": result["status"],
+            }
+        )
+    )
+    return 0

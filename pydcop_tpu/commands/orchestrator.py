@@ -1,0 +1,73 @@
+"""``pydcop_tpu orchestrator`` (reference: ``pydcop/commands/orchestrator.py``).
+
+Start the management plane for a cross-process run: wait for
+``--nb_agents`` agent processes to register on ``--port``, deploy the
+problem + algorithm to them, run the sharded SPMD solve as process 0 of
+the ``jax.distributed`` cluster, cross-check every agent's replicated
+result, and print the assembled JSON (same shape as ``solve``).
+
+Example (two terminals)::
+
+    pydcop_tpu orchestrator coloring.yaml -a maxsum --port 9500
+    pydcop_tpu agent --names a1 --orchestrator localhost:9500
+"""
+
+from __future__ import annotations
+
+from pydcop_tpu.commands._common import parse_algo_params, write_result
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "orchestrator",
+        help="serve a cross-process run: deploy to agents, solve as "
+        "process 0, assemble the result",
+    )
+    p.add_argument("dcop_files", nargs="+", help="dcop yaml file(s)")
+    p.add_argument("-a", "--algo", required=True, help="algorithm name")
+    p.add_argument(
+        "-p", "--algo_params", action="append", default=[],
+        metavar="NAME:VALUE", help="algorithm parameter (repeatable)",
+    )
+    p.add_argument("--port", type=int, default=9500)
+    p.add_argument(
+        "--nb_agents", type=int, default=1,
+        help="agent processes to wait for before starting",
+    )
+    p.add_argument(
+        "--advertise_host", default="localhost",
+        help="hostname agents should use to reach the jax.distributed "
+        "coordinator (multi-host runs: this machine's address)",
+    )
+    p.add_argument("--rounds", type=int, default=200)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--chunk_size", type=int, default=64)
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml as dump_yaml
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.infrastructure.orchestrator import run_orchestrator
+
+    # load (merging multi-file specs) and re-dump: the deploy message
+    # ships ONE self-contained yaml text to every agent
+    dcop = load_dcop_from_file(
+        args.dcop_files if len(args.dcop_files) > 1 else args.dcop_files[0]
+    )
+    dcop_yaml = dump_yaml(dcop)
+
+    result = run_orchestrator(
+        dcop_yaml,
+        args.algo,
+        parse_algo_params(args.algo_params),
+        port=args.port,
+        nb_agents=args.nb_agents,
+        rounds=args.rounds,
+        seed=args.seed,
+        chunk_size=args.chunk_size,
+        timeout=args.timeout,
+        advertise_host=args.advertise_host,
+    )
+    write_result(args, result)
+    return 0

@@ -67,9 +67,10 @@ def run_cmd(args) -> int:
 
     if args.mode == "process":
         raise SystemExit(
-            "solve --mode process is not supported in this build; use "
-            "--mode thread (in-process host runtime) or the default "
-            "tpu mode"
+            "solve --mode process: cross-process runs go through the "
+            "orchestrator — start `pydcop_tpu orchestrator <dcop> -a "
+            "<algo> --nb_agents N` and N `pydcop_tpu agent` processes "
+            "(see those commands' --help)"
         )
     params = parse_algo_params(args.algo_params)
     result = solve(
