@@ -9,15 +9,30 @@ the join is a broadcast-add over aligned axes, and the projection is a
 XLA tiles well.
 
 Execution model: the pseudo-tree walk is host-side (it is inherently
-sequential in tree depth and runs once), while each join/projection is
-a pure array op in float64 numpy — DPOP is an *exact* algorithm, and
-the accelerator's float32 would silently round large UTIL tables, so
-the hot tensor work stays on host where exact dtype is native.  The
-VALUE phase only needs each node's argmin over its own axis, so the
-UTIL phase retains just that (int) table per node, not the full joint.
-UTIL width is exponential in the induced width — ``max_util_size``
-guards against accidental blowups with a clear error (the reference
-fails with MemoryError instead).
+sequential in tree depth and runs once).  Each join/projection runs
+
+- **on device (f32)** when the node's joined table has at least
+  ``device_min_cells`` cells (``util_device='auto'``, the default) —
+  this is where DPOP's time actually goes, since table sizes are
+  exponential in separator width while small tables are dominated by
+  dispatch overhead;
+- **on host (f64 numpy)** otherwise.
+
+DPOP is an *exact* algorithm, so the f32 path carries a certificate:
+per node we track an absolute error bound (propagated child error +
+local f32 rounding, (#parts+1)·eps32·max|J|) and the decision margin
+(second-best − best over each projected cell).  If any node's margin
+fails to clear twice its error bound, the f32 argmin decisions cannot
+be trusted and THE WHOLE UTIL PHASE RESTARTS on the host f64 path —
+one clean fallback, no mixed-precision partial states.  Margins on
+real-valued problems are many orders above eps32; exact-tie-heavy
+symmetric problems fall back and stay exact.
+
+The VALUE phase only needs each node's argmin over its own axis, so
+the UTIL phase retains just that (int) table per node, not the full
+joint.  UTIL width is exponential in the induced width —
+``max_util_size`` guards against accidental blowups with a clear error
+(the reference fails with MemoryError instead).
 
 Each constraint is owned by the deepest variable in its scope; the
 pseudo-tree invariant (every constraint's scope lies on one root-leaf
@@ -41,7 +56,18 @@ from pydcop_tpu.graphs import pseudotree as _pt
 
 GRAPH_TYPE = "pseudotree"
 
-algo_params: list = []
+from pydcop_tpu.algorithms import AlgoParameterDef  # noqa: E402
+
+algo_params: list = [
+    # device offload of the UTIL joins (see module docstring)
+    AlgoParameterDef(
+        "util_device", "str", ["auto", "never", "always"], "auto"
+    ),
+    # smallest joined-table size worth a device dispatch
+    AlgoParameterDef("device_min_cells", "int", None, 1 << 14),
+]
+
+_EPS32 = float(np.finfo(np.float32).eps)
 
 
 def _align(
@@ -105,50 +131,39 @@ def solve_host(
         owned[owner].append((scope, table))
 
     # -- UTIL phase: post-order over each tree -------------------------
-    util: Dict[str, Tuple[List[str], np.ndarray]] = {}
-    # per node: (separator order, argmin over own axis) — all the VALUE
-    # phase needs, at 1/d the cells and int dtype vs the full joint
-    best_choice: Dict[str, Tuple[List[str], np.ndarray]] = {}
-    util_cells = 0
-    for root in graph.roots:
-        for name in reversed(graph.depth_first_order(root)):
-            if timeout is not None and time.perf_counter() - t0 > timeout:
-                return _timeout_result(dcop, t0)
-            node = graph.node(name)
-            # effective separator: ancestors referenced by own relations
-            # or children's separators
-            sep: List[str] = []
-            parts: List[Tuple[List[str], np.ndarray]] = []
-            for dims, table in owned[name]:
-                parts.append((dims, table))
-                sep.extend(d for d in dims if d != name)
-            for child in node.children:
-                cdims, ctable = util[child]
-                parts.append((cdims, ctable))
-                sep.extend(d for d in cdims if d != name)
-            sep = sorted(set(sep), key=lambda n: depth[n])
-            target = sep + [name]
-            size = int(
-                np.prod([len(domains[d]) for d in target], dtype=np.int64)
+    use_device = params.get("util_device", "auto")
+    device_min_cells = int(params.get("device_min_cells", 1 << 14))
+    if use_device == "always":
+        device_min_cells = 0
+    t_util = time.perf_counter()
+    try:
+        if use_device == "never":
+            raise _PrecisionFallback(None, 0.0, 0.0)
+        util_stats = _util_phase(
+            dcop, graph, domains, depth, owned, t0, timeout,
+            device_min_cells=device_min_cells,
+            max_util_size=max_util_size,
+        )
+        util_backend = "device"
+    except _PrecisionFallback as fb:
+        if fb.node is not None:  # an actual failed margin, not 'never'
+            import logging
+
+            logging.getLogger(__name__).info(
+                "DPOP UTIL f32 margin %.3g below error bound %.3g at "
+                "node %s; restarting UTIL on the host f64 path",
+                fb.margin, fb.bound, fb.node,
             )
-            if size > max_util_size:
-                raise ValueError(
-                    f"DPOP UTIL table for {name!r} needs {size} cells "
-                    f"(separator {sep}); exceeds max_util_size="
-                    f"{max_util_size}.  The induced width is too large "
-                    f"for exact DPOP — use a local-search or message-"
-                    f"passing algorithm instead."
-                )
-            j = np.zeros(
-                [len(domains[d]) for d in target], dtype=np.float64
-            )
-            for dims, table in parts:
-                j = j + _align(table, dims, target)
-            u = j.min(axis=-1)
-            best_choice[name] = (sep, np.argmin(j, axis=-1))
-            del j
-            util[name] = (sep, u)
-            util_cells += u.size if node.parent is not None else 0
+        util_stats = _util_phase(
+            dcop, graph, domains, depth, owned, t0, timeout,
+            device_min_cells=None,
+            max_util_size=max_util_size,
+        )
+        util_backend = "host"
+    if util_stats is None:
+        return _timeout_result(dcop, t0)
+    best_choice, util_cells, device_nodes, host_nodes = util_stats
+    util_time = time.perf_counter() - t_util
 
     # -- VALUE phase: pre-order ---------------------------------------
     assignment: Dict[str, Any] = {}
@@ -176,7 +191,150 @@ def solve_host(
         "status": "finished",
         "time": time.perf_counter() - t0,
         "cost_trace": [cost],
+        # UTIL-phase observability (BASELINE config #4 reports these)
+        "util_time": util_time,
+        "util_backend": util_backend,
+        "util_device_nodes": device_nodes,
+        "util_host_nodes": host_nodes,
     }
+
+
+class _PrecisionFallback(Exception):
+    """Raised when an f32 decision margin fails its error bound."""
+
+    def __init__(self, node, margin, bound):
+        super().__init__(node)
+        self.node = node
+        self.margin = margin
+        self.bound = bound
+
+
+def _util_phase(
+    dcop: DCOP,
+    graph,
+    domains: Dict[str, list],
+    depth: Dict[str, int],
+    owned: Dict[str, List[Tuple[List[str], np.ndarray]]],
+    t0: float,
+    timeout: Optional[float],
+    device_min_cells: Optional[int],
+    max_util_size: int = 1 << 26,
+):
+    """Bottom-up joins.  ``device_min_cells=None`` forces the pure host
+    f64 path; otherwise joins of >= that many cells run on device in
+    f32 under the error-certificate scheme (module docstring), raising
+    :class:`_PrecisionFallback` when a margin cannot be certified.
+
+    Returns ``(best_choice, util_cells, device_nodes, host_nodes)`` or
+    None on timeout.
+    """
+    util: Dict[str, Tuple[List[str], np.ndarray]] = {}
+    # per node: (separator order, argmin over own axis) — all the VALUE
+    # phase needs, at 1/d the cells and int dtype vs the full joint
+    best_choice: Dict[str, Tuple[List[str], np.ndarray]] = {}
+    err: Dict[str, float] = {}  # absolute error bound per node's util
+    util_cells = 0
+    device_nodes = host_nodes = 0
+    for root in graph.roots:
+        for name in reversed(graph.depth_first_order(root)):
+            if timeout is not None and time.perf_counter() - t0 > timeout:
+                return None
+            node = graph.node(name)
+            # effective separator: ancestors referenced by own relations
+            # or children's separators
+            sep: List[str] = []
+            parts: List[Tuple[List[str], np.ndarray]] = []
+            child_err = 0.0
+            for dims, table in owned[name]:
+                parts.append((dims, table))
+                sep.extend(d for d in dims if d != name)
+            for child in node.children:
+                cdims, ctable = util[child]
+                parts.append((cdims, ctable))
+                sep.extend(d for d in cdims if d != name)
+                child_err += err.get(child, 0.0)
+            sep = sorted(set(sep), key=lambda n: depth[n])
+            target = sep + [name]
+            size = int(
+                np.prod([len(domains[d]) for d in target], dtype=np.int64)
+            )
+            if size > max_util_size:
+                raise ValueError(
+                    f"DPOP UTIL table for {name!r} needs {size} cells "
+                    f"(separator {sep}); exceeds max_util_size="
+                    f"{max_util_size}.  The induced width is too large "
+                    f"for exact DPOP — use a local-search or message-"
+                    f"passing algorithm instead."
+                )
+            shape = [len(domains[d]) for d in target]
+            on_device = (
+                device_min_cells is not None and size >= device_min_cells
+            )
+            if on_device:
+                u, amin, margin, max_abs = _device_join(parts, target, shape)
+                local_err = _EPS32 * (len(parts) + 1) * max_abs
+                bound = child_err + local_err
+                if margin < 2.0 * bound:
+                    raise _PrecisionFallback(name, margin, 2.0 * bound)
+                err[name] = bound
+                device_nodes += 1
+            else:
+                j = np.zeros(shape, dtype=np.float64)
+                for dims, table in parts:
+                    j = j + _align(table, dims, target)
+                u = j.min(axis=-1)
+                amin = np.argmin(j, axis=-1)
+                del j
+                err[name] = child_err  # f64 adds no tracked error
+                host_nodes += 1
+            best_choice[name] = (sep, amin)
+            util[name] = (sep, u)
+            util_cells += u.size if node.parent is not None else 0
+    return best_choice, util_cells, device_nodes, host_nodes
+
+
+def _device_join(
+    parts: List[Tuple[List[str], np.ndarray]],
+    target: List[str],
+    shape: List[int],
+):
+    """One node's join+projection on device in f32.
+
+    Returns ``(u float64 ndarray, argmin ndarray, decision margin,
+    max |J|)`` where margin = min over projected cells of
+    (second best − best) along the own axis.
+    """
+    import jax.numpy as jnp
+
+    j = jnp.zeros(shape, dtype=jnp.float32)
+    for dims, table in parts:
+        j = j + jnp.asarray(
+            _align(np.asarray(table, dtype=np.float32), dims, target)
+        )
+    u = jnp.min(j, axis=-1)
+    amin = jnp.argmin(j, axis=-1)
+    # second best via masking the argmin cell (exact; no partial sort)
+    masked = jnp.where(
+        jax_one_hot(amin, shape[-1]), jnp.inf, j
+    )
+    second = jnp.min(masked, axis=-1)
+    if shape[-1] == 1:
+        margin = np.inf  # a single own value: no decision to get wrong
+    else:
+        margin = float(jnp.min(second - u))
+    max_abs = float(jnp.max(jnp.abs(j)))
+    return (
+        np.asarray(u, dtype=np.float64),
+        np.asarray(amin),
+        margin,
+        max_abs,
+    )
+
+
+def jax_one_hot(idx, n):
+    import jax.numpy as jnp
+
+    return jnp.arange(n) == idx[..., None]
 
 
 def _timeout_result(dcop: DCOP, t0: float) -> Dict[str, Any]:
