@@ -1,0 +1,102 @@
+"""Tests for the generic graph helpers (utils/graphs.py)."""
+
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.utils.graphs import (
+    as_bipartite_networkx_graph,
+    as_networkx_graph,
+    connected_components,
+    cycles_count,
+    graph_diameter,
+    has_cycle,
+)
+
+D = Domain("d", "", [0, 1, 2])
+
+
+def _dcop(edges, n):
+    dcop = DCOP("g")
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for k, (i, j) in enumerate(edges):
+        dcop.add_constraint(
+            constraint_from_str(f"c{k}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    return dcop
+
+
+def test_has_cycle():
+    assert not has_cycle(_dcop([(0, 1), (1, 2), (2, 3)], 4))  # path
+    assert has_cycle(_dcop([(0, 1), (1, 2), (2, 0)], 3))  # triangle
+    assert not has_cycle({})  # empty
+    assert has_cycle({0: [1], 1: [2], 2: [0]})  # adjacency input
+
+
+def test_cycles_count():
+    assert cycles_count(_dcop([(0, 1), (1, 2), (2, 3)], 4)) == 0
+    assert cycles_count(_dcop([(0, 1), (1, 2), (2, 0)], 3)) == 1
+    # two independent cycles sharing an edge chain
+    assert (
+        cycles_count(
+            _dcop([(0, 1), (1, 2), (2, 0), (2, 3), (3, 4), (4, 2)], 5)
+        )
+        == 2
+    )
+
+
+def test_graph_diameter():
+    assert graph_diameter(_dcop([(0, 1), (1, 2), (2, 3)], 4)) == 3
+    assert graph_diameter(_dcop([(0, 1), (1, 2), (2, 0)], 3)) == 1
+    with pytest.raises(ValueError, match="disconnected"):
+        graph_diameter(_dcop([(0, 1)], 4))  # v2, v3 isolated
+
+
+def test_connected_components():
+    comps = connected_components(_dcop([(0, 1), (2, 3)], 5))
+    sizes = sorted(len(c) for c in comps)
+    assert sizes == [1, 2, 2]
+
+
+def test_networkx_exports():
+    dcop = _dcop([(0, 1), (1, 2)], 3)
+    g = as_networkx_graph(dcop)
+    assert g.number_of_nodes() == 3
+    assert g.number_of_edges() == 2
+    fg = as_bipartite_networkx_graph(dcop)
+    # 3 variables + 2 constraints, each constraint linked to 2 vars
+    assert fg.number_of_nodes() == 5
+    assert fg.number_of_edges() == 4
+    assert all(
+        fg.nodes[n]["bipartite"] == 1 for n in ("c0", "c1")
+    )
+
+
+def test_ternary_constraint_forms_clique_in_primal():
+    dcop = DCOP("t")
+    vs = [Variable(f"v{i}", D) for i in range(3)]
+    for v in vs:
+        dcop.add_variable(v)
+    dcop.add_constraint(
+        constraint_from_str("c0", "v0 + v1 + v2", vs)
+    )
+    g = as_networkx_graph(dcop)
+    assert g.number_of_edges() == 3  # triangle from one ternary scope
+    assert has_cycle(dcop)
+
+
+def test_various_helpers():
+    from pydcop_tpu.utils.various import (
+        elapsed_str,
+        func_args,
+        number_format,
+    )
+
+    assert func_args(lambda a, b, c=1: 0) == ["a", "b", "c"]
+    assert number_format(1500) == "1.5k"
+    assert number_format(2.5e6) == "2.5M"
+    assert number_format(3) == "3"
+    assert elapsed_str(3723) == "1h 02m 03s"
