@@ -1,0 +1,185 @@
+"""The five driver-specified benchmark configs (BASELINE.json:6-12).
+
+One command fills BASELINE.md's table for the current backend:
+
+    python bench_configs.py --pin-cpu          # CPU baseline column
+    python bench_configs.py                    # default backend (TPU)
+    python bench_configs.py --only 3 5         # subset while iterating
+
+Prints one JSON line per config (and a ready-to-paste markdown block
+with --markdown).  Metrics per BASELINE.md: msgs/sec + best cost for
+the message-passing/local-search configs; UTIL-phase time + exact cost
+for the DPOP config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import types
+
+
+def _gen_coloring_50():
+    import __graft_entry__ as g
+
+    return g._make_coloring_dcop(50, colors=3, degree=3, seed=1)
+
+
+def _gen_ising_32():
+    from pydcop_tpu.commands.generators.ising import generate
+
+    return generate(
+        types.SimpleNamespace(
+            row_count=32, col_count=32, bin_range=1.6, un_range=0.05,
+            no_agents=False, capacity=100.0, seed=1,
+        )
+    )
+
+
+def _gen_scalefree_1k():
+    from pydcop_tpu.commands.generators.graphcoloring import generate
+
+    return generate(
+        types.SimpleNamespace(
+            variables_count=1000, colors_count=3, graph="scalefree",
+            m_edge=2, p_edge=None, noise=0.02, soft=False,
+            intentional=False, agents_count=None, capacity=100.0, seed=1,
+        )
+    )
+
+
+def _gen_secp():
+    from pydcop_tpu.commands.generators.secp import generate
+
+    return generate(
+        types.SimpleNamespace(
+            nb_lights=40, nb_models=30, nb_rules=20, light_levels=8,
+            model_arity=3, efficiency_weight=0.1, capacity=1000.0,
+            seed=1,
+        )
+    )
+
+
+def _gen_meeting_10k():
+    from pydcop_tpu.commands.generators.meetingscheduling import generate
+
+    return generate(
+        types.SimpleNamespace(
+            slots_count=8, events_count=2500, resources_count=500,
+            max_resources_event=4, eq_cost=10.0, noconflict_cost=10.0,
+            value_range=1.0, capacity=1000.0, seed=1,
+        )
+    )
+
+
+def _run_batched_config(dcop, algo, params, rounds, chunk):
+    import jax
+
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_batched
+    from pydcop_tpu.ops import compile_dcop
+
+    problem = compile_dcop(dcop)
+    module = load_algorithm_module(algo)
+    full = prepare_algo_params(params, module.algo_params)
+    # warmup chunk: XLA compile out of the measured window
+    run_batched(problem, module, full, rounds=chunk, seed=0, chunk_size=chunk)
+    t0 = time.perf_counter()
+    r = run_batched(
+        problem, module, full, rounds=rounds, seed=0, chunk_size=chunk
+    )
+    dt = time.perf_counter() - t0
+    msgs = module.messages_per_round(problem, full) * r.cycles
+    return {
+        "platform": jax.devices()[0].platform,
+        "msgs_per_sec": round(msgs / dt),
+        "best_cost": round(float(r.best_cost), 4),
+        "rounds": r.cycles,
+        "n_vars": problem.n_vars,
+        "n_edges": int(problem.n_real_edges),
+        "seconds": round(dt, 3),
+    }
+
+
+def _run_dpop_config(dcop):
+    import jax
+
+    from pydcop_tpu.api import solve
+
+    out = {}
+    for variant in ("never", "auto"):
+        r = solve(dcop, "dpop", {"util_device": variant})
+        key = "host" if variant == "never" else "device"
+        out[f"util_time_{key}"] = round(r["util_time"], 4)
+        if variant == "auto":
+            out["util_backend"] = r["util_backend"]
+            out["util_device_nodes"] = r["util_device_nodes"]
+            out["util_host_nodes"] = r["util_host_nodes"]
+            out["cost"] = round(float(r["cost"]), 4)
+            out["total_time"] = round(r["time"], 3)
+    out["platform"] = jax.devices()[0].platform
+    out["n_vars"] = len(dcop.variables)
+    return out
+
+
+CONFIGS = {
+    1: ("coloring50_dsaB", _gen_coloring_50, "dsa",
+        {"variant": "B", "probability": 0.7}, 1024, 256),
+    2: ("ising32_mgm2", _gen_ising_32, "mgm2", {}, 1024, 256),
+    3: ("scalefree1k_maxsum", _gen_scalefree_1k, "maxsum",
+        {"damping": 0.5}, 1024, 256),
+    4: ("secp_dpop", _gen_secp, "dpop", None, None, None),
+    5: ("meeting10k_maxsum", _gen_meeting_10k, "maxsum",
+        {"damping": 0.5}, 512, 128),
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pin-cpu", action="store_true")
+    ap.add_argument("--only", type=int, nargs="*", default=None)
+    ap.add_argument("--markdown", action="store_true")
+    args = ap.parse_args()
+    if args.pin_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    rows = []
+    for num in sorted(CONFIGS):
+        if args.only and num not in args.only:
+            continue
+        name, gen, algo, params, rounds, chunk = CONFIGS[num]
+        dcop = gen()
+        if algo == "dpop":
+            res = _run_dpop_config(dcop)
+        else:
+            res = _run_batched_config(dcop, algo, params, rounds, chunk)
+        res = {"config": num, "name": name, **res}
+        rows.append(res)
+        print(json.dumps(res), flush=True)
+
+    if args.markdown:
+        print()
+        for r in rows:
+            if "msgs_per_sec" in r:
+                print(
+                    f"| {r['config']} | {r['name']} | {r['platform']} | "
+                    f"{r['msgs_per_sec']:.3g} msgs/s | cost "
+                    f"{r['best_cost']} |"
+                )
+            else:
+                print(
+                    f"| {r['config']} | {r['name']} | {r['platform']} | "
+                    f"UTIL {r['util_time_device']}s (host "
+                    f"{r['util_time_host']}s) | cost {r['cost']} |"
+                )
+
+
+if __name__ == "__main__":
+    main()

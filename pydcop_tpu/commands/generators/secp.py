@@ -35,6 +35,13 @@ def set_parser(subparsers) -> None:
         help="max lights per model zone",
     )
     p.add_argument(
+        "--zone_size", type=int, default=0,
+        help="locality window: each model draws its lights from a "
+        "window of this many consecutive lights (0 = anywhere). "
+        "Bounds the constraint graph's treewidth the way physical "
+        "rooms do — required for exact DPOP at scale",
+    )
+    p.add_argument(
         "--efficiency_weight", type=float, default=0.1,
         help="unary cost per emitted light level",
     )
@@ -74,9 +81,15 @@ def generate(args):
         )
 
     max_level = levels - 1
+    zone = int(getattr(args, "zone_size", 0) or 0)
     for m in range(args.nb_models):
         arity = rnd.randint(1, min(args.model_arity, args.nb_lights))
-        scope = rnd.sample(lights, arity)
+        if zone and zone < args.nb_lights:
+            start = rnd.randrange(args.nb_lights - zone + 1)
+            pool = lights[start : start + zone]
+            scope = rnd.sample(pool, min(arity, len(pool)))
+        else:
+            scope = rnd.sample(lights, arity)
         target = rnd.uniform(0.3, 1.0) * arity * max_level
         shape = (levels,) * arity
         matrix = np.zeros(shape, dtype=np.float32)
