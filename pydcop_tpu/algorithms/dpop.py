@@ -353,9 +353,14 @@ def _device_join(
         second = jnp.min(jnp.where(one_hot, jnp.inf, j), axis=-1)
         margins = np.asarray(second - u, dtype=np.float64)
     max_abs = float(jnp.max(jnp.abs(j)))
+    # np.array (not asarray): jax hands back its cached buffer with
+    # writeable=False when the dtype is unchanged, and the near-tie
+    # repair loop writes into amin.  u is f32->f64 converted (a fresh
+    # writable copy already), but copy it explicitly too so neither
+    # return value ever aliases device memory.
     return (
-        np.asarray(u, dtype=np.float64),
-        np.asarray(amin),
+        np.array(u, dtype=np.float64),
+        np.array(amin),
         margins,
         max_abs,
     )
@@ -379,9 +384,9 @@ def _cell_slice(
             idx.append(cell[target.index(d)])
     row = np.asarray(table, dtype=np.float64)[tuple(idx)]
     if own not in dims:
-        return np.full(1, float(row)) if row.ndim == 0 else np.full(
-            1, float(row)
-        )
+        # every axis was scalar-indexed: row is 0-d, broadcast it over
+        # the own axis as a length-1 row
+        return np.full(1, float(row))
     return row
 
 

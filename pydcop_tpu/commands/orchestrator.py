@@ -42,6 +42,25 @@ def set_parser(subparsers) -> None:
     p.add_argument("--rounds", type=int, default=200)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--chunk_size", type=int, default=64)
+    p.add_argument(
+        "--scenario", default=None, metavar="FILE",
+        help="scenario yaml to replay across all processes (dynamic "
+        "run: remove/add agents, set external values)",
+    )
+    p.add_argument(
+        "--ktarget", type=int, default=0,
+        help="replication level for scenario runs (k-resilience)",
+    )
+    p.add_argument(
+        "--heartbeat_timeout", type=float, default=120.0,
+        help="seconds an agent may miss the chunk barrier before the "
+        "run is failed",
+    )
+    p.add_argument(
+        "--abort_grace", type=float, default=5.0,
+        help="seconds to wait for a clean unwind after a peer death "
+        "before force-exiting a wedged process",
+    )
     p.set_defaults(func=run_cmd)
 
 
@@ -57,6 +76,11 @@ def run_cmd(args) -> int:
     )
     dcop_yaml = dump_yaml(dcop)
 
+    scenario_yaml = None
+    if args.scenario:
+        with open(args.scenario) as f:
+            scenario_yaml = f.read()
+
     result = run_orchestrator(
         dcop_yaml,
         args.algo,
@@ -68,6 +92,10 @@ def run_cmd(args) -> int:
         chunk_size=args.chunk_size,
         timeout=args.timeout,
         advertise_host=args.advertise_host,
+        heartbeat_timeout=args.heartbeat_timeout,
+        abort_grace=args.abort_grace,
+        scenario_yaml=scenario_yaml,
+        k_target=args.ktarget,
     )
     write_result(args, result)
     return 0

@@ -101,6 +101,7 @@ def run_batched(
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 1,
     resume: bool = False,
+    chunk_callback: Optional[Callable[[int, float], Optional[str]]] = None,
 ) -> RunResult:
     """Run a batched algorithm for up to ``rounds`` rounds.
 
@@ -124,6 +125,14 @@ def run_batched(
     ``checkpoint_every`` chunks (atomic .npz, see
     ``engine.checkpoint``); ``resume=True`` restores it and continues
     from the recorded round counter.
+
+    ``chunk_callback(done_rounds, best_cost)`` is invoked at every
+    *interior* chunk boundary (``done < rounds``), before the local
+    timeout/convergence checks.  Returning a status string stops the
+    run with that status; returning ``None`` continues.  The
+    cross-process orchestrator uses this as its lockstep control point
+    so every ``jax.distributed`` process stops at the same boundary
+    (a wall-clock check per process would diverge).
     """
     t0 = time.perf_counter()
     sign = -1.0 if problem.maximize else 1.0
@@ -287,6 +296,11 @@ def run_batched(
                     },
                 )
                 chunks_since_save = 0
+        if chunk_callback is not None and done < rounds:
+            cb_status = chunk_callback(done, float(best_cost))
+            if cb_status is not None:
+                status = cb_status
+                break
         if timeout is not None and time.perf_counter() - t0 > timeout:
             status = "timeout"
             break

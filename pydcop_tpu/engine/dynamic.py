@@ -46,9 +46,23 @@ def run_dynamic(
     seed: int = 0,
     timeout: Optional[float] = None,
     repair_algo: str = "mgm",
+    mesh=None,
+    n_shards: int = 1,
+    chunk_size: int = 64,
+    chunk_callback=None,
 ) -> Dict[str, Any]:
     """Play a scenario against a DCOP and return the result dict
-    (reference ``pydcop run`` JSON shape + ``events`` log)."""
+    (reference ``pydcop run`` JSON shape + ``events`` log).
+
+    With ``mesh``/``n_shards`` set, every solve segment runs sharded
+    over the mesh (each segment's problem is recompiled with the same
+    shard count after events change it).  ``chunk_callback`` is
+    forwarded to each segment's :func:`run_batched` — the cross-process
+    orchestrator uses it as its lockstep barrier, which works across
+    segments because the segment schedule (budgets, seeds, event
+    ordering) is a deterministic function of (dcop, scenario, seed)
+    and therefore identical in every SPMD process.
+    """
     from pydcop_tpu.algorithms import (
         load_algorithm_module,
         prepare_algo_params,
@@ -150,7 +164,7 @@ def run_dynamic(
         ad = active_dcop()
         if not ad.variables:
             return  # everything frozen/lost
-        problem = compile_dcop(ad)
+        problem = compile_dcop(ad, n_shards=n_shards)
         seg_params = dict(params)
         if current_values:
             known = {
@@ -173,6 +187,9 @@ def run_dynamic(
             rounds=n_rounds,
             seed=seg_seed,
             timeout=remaining,
+            chunk_size=chunk_size,
+            mesh=mesh,
+            chunk_callback=chunk_callback,
         )
         cycles += result.cycles
         messages += result.messages

@@ -89,10 +89,12 @@ class Agent:
 
     @property
     def is_idle(self) -> bool:
-        """No queued messages AND no handler mid-execution — without
-        the busy flag, a slow handler that will post more messages is
-        invisible and the quiescence monitor stops the run early."""
-        return self.messaging.pending == 0 and not self._busy
+        """No queued AND no in-flight message.  ``Messaging.pending``
+        counts a popped message until ``task_done``, with the pop and
+        the in-flight mark under one lock — so a handler that is about
+        to run (and may post more messages) is never invisible to the
+        quiescence monitor."""
+        return self.messaging.pending == 0
 
     # -- message pump --------------------------------------------------
 
@@ -104,6 +106,7 @@ class Agent:
             src, dest, msg = item
             comp = self._computations.get(dest)
             if comp is None:
+                self.messaging.task_done()
                 continue  # computation moved/stopped mid-flight
             t0 = time.perf_counter()
             self._busy = True
@@ -116,4 +119,5 @@ class Agent:
                     raise
             finally:
                 self._busy = False
+                self.messaging.task_done()
                 self.activity_time += time.perf_counter() - t0

@@ -15,7 +15,7 @@ count/size metrics, and failure surfacing for unknown computations.
 
 from __future__ import annotations
 
-import queue
+import heapq
 import threading
 from typing import Dict, Optional, Tuple
 
@@ -83,13 +83,21 @@ class Messaging:
     thread may :meth:`deliver`.  Counts every message and its logical
     size (``Message.size``), split by priority class — the counters the
     reference's msgs/sec metric is derived from.
+
+    A popped message stays accounted in :attr:`pending` until the
+    consumer calls :meth:`task_done`: the pop and the in-flight mark
+    happen under one lock, so a quiescence monitor reading ``pending``
+    can never observe the gap between "message dequeued" and "handler
+    started" (that gap once let thread-mode runs terminate with a
+    message in flight).
     """
 
     def __init__(self, agent_name: str):
         self.agent_name = agent_name
-        self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._heap: list = []
         self._seq = 0  # FIFO tie-break within a priority class
-        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._in_flight = False
         self.count_msg = 0
         self.size_msg = 0
         self.count_by_priority: Dict[int, int] = {}
@@ -101,26 +109,42 @@ class Messaging:
         msg: Message,
         priority: int = MSG_ALGO,
     ) -> None:
-        with self._lock:
+        with self._cond:
             self._seq += 1
-            seq = self._seq
             self.count_msg += 1
             self.size_msg += msg.size
             self.count_by_priority[priority] = (
                 self.count_by_priority.get(priority, 0) + 1
             )
-        self._queue.put((priority, seq, src_comp, dest_comp, msg))
+            heapq.heappush(
+                self._heap, (priority, self._seq, src_comp, dest_comp, msg)
+            )
+            self._cond.notify()
 
     def next_msg(
         self, timeout: Optional[float] = None
     ) -> Optional[Tuple[str, str, Message]]:
-        """Pop the next (src, dest, msg), or None on timeout."""
-        try:
-            _, _, src, dest, msg = self._queue.get(timeout=timeout)
+        """Pop the next (src, dest, msg), or None on timeout.
+
+        Atomically marks the popped message in-flight; the consumer
+        must call :meth:`task_done` when its handler finishes.
+        """
+        with self._cond:
+            if not self._heap:
+                self._cond.wait(timeout)
+            if not self._heap:
+                return None
+            _, _, src, dest, msg = heapq.heappop(self._heap)
+            self._in_flight = True
             return src, dest, msg
-        except queue.Empty:
-            return None
+
+    def task_done(self) -> None:
+        """Mark the last popped message as fully handled."""
+        with self._cond:
+            self._in_flight = False
 
     @property
     def pending(self) -> int:
-        return self._queue.qsize()
+        """Queued messages + the in-flight one (if any)."""
+        with self._cond:
+            return len(self._heap) + (1 if self._in_flight else 0)

@@ -17,25 +17,52 @@ JSON lines:
 3. every process joins ``jax.distributed`` (the orchestrator is
    process 0 and hosts the coordinator) and runs the sharded solve —
    one process = one mesh segment, results replicated;
-4. agents report their result; the orchestrator cross-checks all
+4. **lockstep control**: at every interior chunk boundary each agent
+   sends a ``chunk`` message and waits for the orchestrator's
+   ``go``/``halt`` decision.  This is simultaneously (a) the heartbeat
+   that detects hung agents, (b) the only place a wall-clock
+   ``timeout`` is decided — by the orchestrator alone, so every
+   ``jax.distributed`` process stops at the same chunk boundary (a
+   per-process wall-clock check would diverge and trip the SPMD
+   cross-check), and (c) the point where a run can be halted early;
+5. agents report their result; the orchestrator cross-checks all
    reported costs agree (SPMD determinism check), replies ``stop``,
    and returns the assembled result dict.
 
-Capability parity: `pydcop orchestrator` / `pydcop agent` let one
-problem span multiple OS processes (and, with a reachable coordinator
-address, multiple hosts) exactly like the reference's HTTP deployment,
-while the heavy traffic rides collectives instead of HTTP.
+Failure handling (reference parity: the orchestrator surfaces agent
+failure, SURVEY.md §2.5): a reader thread per connection turns peer
+death into an immediate EOF event — a SIGKILLed process's sockets are
+closed by the kernel, so detection is sub-second, not a socket-timeout
+wait.  On failure the orchestrator notifies the surviving agents
+(``abort``), fails the solve with a clean error naming the dead agent,
+and — because a process wedged inside a collective whose peer died may
+never return from XLA — a watchdog force-exits the process after
+``abort_grace`` seconds with exit code 70.  Agents mirror the same
+logic when the orchestrator dies.  ``stop``/``abort`` is always sent
+in a ``finally`` so healthy peers never sit out the socket timeout.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import queue
 import socket
+import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
 _ENC = "utf-8"
 _TIMEOUT = 120.0
+
+# exit code for "force-killed while wedged in a collective whose peer
+# died" — distinguishable from ordinary tracebacks in tests and scripts
+ABORT_EXIT_CODE = 70
+
+
+class AgentFailureError(RuntimeError):
+    """An agent process died or stopped responding mid-solve."""
 
 
 def _send(conn: socket.socket, obj: Dict[str, Any]) -> None:
@@ -55,6 +82,82 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+class _Peer:
+    """One control-plane connection, pumped by a reader thread.
+
+    All inbound messages land in :attr:`inbox`; EOF or a read error
+    lands a ``None`` sentinel and fires ``on_eof`` (unless the run
+    already finished).  This keeps the main thread free to block in
+    XLA while death detection stays immediate.
+    """
+
+    def __init__(self, name: str, conn: socket.socket, done_evt,
+                 on_eof=None, on_msg=None, reader=None):
+        self.name = name
+        self.conn = conn
+        self.inbox: "queue.Queue" = queue.Queue()
+        self._done_evt = done_evt
+        self._on_eof = on_eof
+        self._on_msg = on_msg
+        # reuse the registration-phase reader when given: a second
+        # makefile() on the same socket would race its buffer
+        self._reader = reader if reader is not None else conn.makefile("rb")
+        self._thread = threading.Thread(
+            target=self._pump, name=f"ctl-{name}", daemon=True
+        )
+        self._thread.start()
+
+    def _pump(self) -> None:
+        try:
+            while True:
+                msg = _recv(self._reader)
+                if msg is None:
+                    break
+                if self._on_msg is not None:
+                    self._on_msg(msg)
+                self.inbox.put(msg)
+        except (OSError, ValueError):
+            pass
+        self.inbox.put(None)
+        if self._on_eof is not None and not self._done_evt.is_set():
+            self._on_eof(self.name)
+
+    def send(self, obj: Dict[str, Any]) -> bool:
+        try:
+            _send(self.conn, obj)
+            return True
+        except OSError:
+            return False
+
+    def get(self, timeout: float) -> Optional[Dict[str, Any]]:
+        """Next inbound message; None on peer EOF; raises on timeout."""
+        return self.inbox.get(timeout=timeout)
+
+    def close(self) -> None:
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+def _arm_watchdog(done_evt, grace: float, reason: str) -> None:
+    """Force-exit the process if the main thread stays wedged (inside a
+    collective whose peer died) past ``grace`` seconds."""
+
+    def _watch():
+        if not done_evt.wait(grace):
+            print(
+                f"pydcop_tpu: FATAL: {reason}; main thread did not "
+                f"return within {grace:.0f}s (wedged in a collective?) "
+                "— force-exiting",
+                file=sys.stderr,
+                flush=True,
+            )
+            os._exit(ABORT_EXIT_CODE)
+
+    threading.Thread(target=_watch, daemon=True).start()
+
+
 def run_orchestrator(
     dcop_yaml: str,
     algo: str,
@@ -67,33 +170,62 @@ def run_orchestrator(
     timeout: Optional[float] = None,
     host: str = "0.0.0.0",
     advertise_host: str = "localhost",
+    heartbeat_timeout: float = _TIMEOUT,
+    abort_grace: float = 5.0,
+    scenario_yaml: Optional[str] = None,
+    k_target: int = 0,
 ) -> Dict[str, Any]:
     """Serve the management plane, run the solve as process 0, and
-    return the assembled result dict."""
+    return the assembled result dict.
+
+    Raises :class:`AgentFailureError` (after notifying survivors) if an
+    agent dies or stops heartbeating mid-solve.
+    """
     coord_port = _free_port()
     num_processes = nb_agents + 1
+    t_start = time.monotonic()
 
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     server.bind((host, port))
     server.listen(nb_agents)
-    server.settimeout(_TIMEOUT)
+    server.settimeout(heartbeat_timeout)
 
-    conns: List[socket.socket] = []
-    readers = []
-    names: List[str] = []
+    done_evt = threading.Event()
+    dead: List[str] = []  # names of agents whose connection dropped
+    peers: List[_Peer] = []
+
+    def _on_peer_eof(name: str) -> None:
+        dead.append(name)
+        for p in peers:
+            if p.name != name:
+                p.send({"type": "abort", "reason": f"agent {name} died"})
+        _arm_watchdog(done_evt, abort_grace, f"agent {name!r} died")
+
+    def _broadcast(obj: Dict[str, Any]) -> None:
+        for p in peers:
+            p.send(obj)
+
+    def _fail(why: str) -> AgentFailureError:
+        # notify survivors before raising so they don't sit out the
+        # socket timeout blocked on our next decision
+        _broadcast({"type": "abort", "reason": why})
+        return AgentFailureError(why)
+
     try:
-        while len(conns) < nb_agents:
+        while len(peers) < nb_agents:
             conn, _ = server.accept()
-            conn.settimeout(_TIMEOUT)
+            conn.settimeout(heartbeat_timeout)
             reader = conn.makefile("rb")
             msg = _recv(reader)
             if not msg or msg.get("type") != "register":
                 conn.close()
                 continue
-            conns.append(conn)
-            readers.append(reader)
-            names.append(msg.get("name", f"agent_{len(conns)}"))
+            name = msg.get("name", f"agent_{len(peers) + 1}")
+            peers.append(
+                _Peer(name, conn, done_evt, on_eof=_on_peer_eof,
+                      reader=reader)
+            )
 
         deploy_base = {
             "type": "deploy",
@@ -105,44 +237,107 @@ def run_orchestrator(
             "chunk_size": chunk_size,
             "num_processes": num_processes,
             "coordinator": f"{advertise_host}:{coord_port}",
+            "heartbeat_timeout": heartbeat_timeout,
+            "abort_grace": abort_grace,
         }
-        for i, conn in enumerate(conns):
-            _send(conn, {**deploy_base, "process_id": i + 1})
+        if scenario_yaml is not None:
+            deploy_base["scenario_yaml"] = scenario_yaml
+            deploy_base["k_target"] = k_target
+        for i, peer in enumerate(peers):
+            peer.send({**deploy_base, "process_id": i + 1})
+
+        def chunk_cb(done_rounds: int, best_cost: float) -> Optional[str]:
+            # lockstep barrier: collect one `chunk` ack per agent,
+            # then broadcast the shared go/halt decision
+            deadline = time.monotonic() + heartbeat_timeout
+            for peer in peers:
+                while True:
+                    if dead:
+                        raise _fail(f"agent {dead[0]!r} died mid-solve")
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise _fail(
+                            f"agent {peer.name!r} missed the chunk "
+                            f"heartbeat ({heartbeat_timeout:.0f}s)"
+                        )
+                    try:
+                        msg = peer.get(timeout=min(remaining, 1.0))
+                    except queue.Empty:
+                        continue
+                    if msg is None:
+                        raise _fail(f"agent {peer.name!r} died mid-solve")
+                    if msg.get("type") == "chunk":
+                        break
+            if (
+                timeout is not None
+                and time.monotonic() - t_start > timeout
+            ):
+                _broadcast({"type": "halt", "status": "timeout"})
+                return "timeout"
+            _broadcast({"type": "go"})
+            return None
 
         result = _run_spmd(
             dcop_yaml, algo, params, rounds, seed, chunk_size,
             coordinator=f"localhost:{coord_port}",
             num_processes=num_processes,
             process_id=0,
-            timeout=timeout,
+            chunk_callback=chunk_cb,
+            scenario_yaml=scenario_yaml,
+            k_target=k_target,
         )
 
         # collect + cross-check agent results (SPMD replication means
         # every process must report the identical cost)
         agent_results = []
-        for name, reader in zip(names, readers):
-            msg = _recv(reader)
-            if not msg or msg.get("type") != "result":
-                raise RuntimeError(
-                    f"agent {name!r} disconnected without a result"
-                )
+        for peer in peers:
+            deadline = time.monotonic() + heartbeat_timeout
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise _fail(
+                        f"agent {peer.name!r} sent no result within "
+                        f"{heartbeat_timeout:.0f}s"
+                    )
+                try:
+                    msg = peer.get(timeout=remaining)
+                except queue.Empty:
+                    continue
+                if msg is None:
+                    raise _fail(
+                        f"agent {peer.name!r} disconnected without a "
+                        "result"
+                    )
+                if msg.get("type") == "result":
+                    break
+                # late chunk acks from the final boundary: skip
             agent_results.append(msg)
             if abs(msg["cost"] - result["cost"]) > 1e-5:
-                raise RuntimeError(
-                    f"agent {name!r} reported cost {msg['cost']}, "
+                raise _fail(
+                    f"agent {peer.name!r} reported cost {msg['cost']}, "
                     f"orchestrator computed {result['cost']} — SPMD "
                     "divergence"
                 )
-        for conn in conns:
-            _send(conn, {"type": "stop"})
-        result["agents"] = names
+        result["agents"] = [p.name for p in peers]
         return result
+    except BaseException as exc:
+        # a peer death usually surfaces as a failed Gloo/XLA collective
+        # before the chunk barrier notices — name the dead agent
+        if dead and not isinstance(exc, AgentFailureError):
+            exc = AgentFailureError(
+                f"agent {dead[0]!r} died mid-solve "
+                f"(collective failed: {type(exc).__name__})"
+            )
+        # after any mid-solve failure the jax.distributed runtime is
+        # unrecoverable and its atexit teardown can hang trying to
+        # reach the dead peer: guarantee the process exits
+        _arm_watchdog(threading.Event(), abort_grace, str(exc))
+        raise exc
     finally:
-        for conn in conns:
-            try:
-                conn.close()
-            except OSError:
-                pass
+        done_evt.set()
+        _broadcast({"type": "stop"})
+        for peer in peers:
+            peer.close()
         server.close()
 
 
@@ -152,7 +347,8 @@ def run_agent(
     retry_for: float = 30.0,
 ) -> Dict[str, Any]:
     """Register with the orchestrator, run the deployed solve as one
-    SPMD process, report the result, and return it."""
+    SPMD process in lockstep with the control plane, report the
+    result, and return it."""
     ohost, oport = orchestrator_addr.rsplit(":", 1)
     deadline = time.monotonic() + retry_for
     conn = None
@@ -165,12 +361,70 @@ def run_agent(
                 raise
             time.sleep(0.3)
     conn.settimeout(_TIMEOUT)
-    reader = conn.makefile("rb")
+    done_evt = threading.Event()
+    abort_reason: List[str] = []
+
     try:
         _send(conn, {"type": "register", "name": name})
+        reader = conn.makefile("rb")
         deploy = _recv(reader)
         if not deploy or deploy.get("type") != "deploy":
             raise RuntimeError(f"agent {name}: bad deploy message {deploy}")
+        heartbeat = float(deploy.get("heartbeat_timeout", _TIMEOUT))
+        grace = float(deploy.get("abort_grace", 5.0))
+
+        # from here on, a reader thread owns the socket: an `abort`
+        # (another agent died) or EOF (orchestrator died) must be able
+        # to unwedge this process even while the main thread is blocked
+        # inside a collective
+
+        def _on_eof(_name: str) -> None:
+            abort_reason.append("orchestrator died")
+            _arm_watchdog(done_evt, grace, "orchestrator died")
+
+        def _watch_abort(msg):
+            if msg.get("type") == "abort":
+                abort_reason.append(msg.get("reason", "aborted"))
+                _arm_watchdog(
+                    done_evt, grace, f"aborted: {abort_reason[-1]}"
+                )
+
+        peer = _Peer("orchestrator", conn, done_evt, on_eof=_on_eof,
+                     on_msg=_watch_abort, reader=reader)
+
+        def chunk_cb(done_rounds: int, best_cost: float) -> Optional[str]:
+            peer.send({"type": "chunk", "n": done_rounds})
+            deadline = time.monotonic() + heartbeat
+            while True:
+                if abort_reason:
+                    raise AgentFailureError(
+                        f"agent {name}: run aborted ({abort_reason[0]})"
+                    )
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise AgentFailureError(
+                        f"agent {name}: no go/halt from orchestrator "
+                        f"within {heartbeat:.0f}s"
+                    )
+                try:
+                    msg = peer.get(timeout=min(remaining, 1.0))
+                except queue.Empty:
+                    continue
+                if msg is None:
+                    raise AgentFailureError(
+                        f"agent {name}: orchestrator died mid-solve"
+                    )
+                t = msg.get("type")
+                if t == "go":
+                    return None
+                if t == "halt":
+                    return msg.get("status", "halted")
+                if t == "abort":
+                    raise AgentFailureError(
+                        f"agent {name}: run aborted "
+                        f"({msg.get('reason', '')})"
+                    )
+                # anything else (early stop) — keep waiting
 
         result = _run_spmd(
             deploy["dcop_yaml"],
@@ -182,20 +436,38 @@ def run_agent(
             coordinator=deploy["coordinator"],
             num_processes=deploy["num_processes"],
             process_id=deploy["process_id"],
-            timeout=None,
+            chunk_callback=chunk_cb,
+            scenario_yaml=deploy.get("scenario_yaml"),
+            k_target=int(deploy.get("k_target", 0)),
         )
-        _send(
-            conn,
+        peer.send(
             {
                 "type": "result",
                 "name": name,
                 "cost": result["cost"],
                 "cycle": result["cycle"],
-            },
+            }
         )
-        _recv(reader)  # stop
+        # wait for stop (or EOF) so the orchestrator's cross-check
+        # finishes before our socket goes away
+        try:
+            while True:
+                msg = peer.get(timeout=heartbeat)
+                if msg is None or msg.get("type") in ("stop", "abort"):
+                    break
+        except queue.Empty:
+            pass
         return result
+    except BaseException as exc:
+        if abort_reason and not isinstance(exc, AgentFailureError):
+            exc = AgentFailureError(
+                f"agent {name}: run aborted ({abort_reason[0]}; "
+                f"collective failed: {type(exc).__name__})"
+            )
+        _arm_watchdog(threading.Event(), 5.0, str(exc))
+        raise exc
     finally:
+        done_evt.set()
         conn.close()
 
 
@@ -209,12 +481,18 @@ def _run_spmd(
     coordinator: str,
     num_processes: int,
     process_id: int,
-    timeout: Optional[float],
+    timeout: Optional[float] = None,
+    chunk_callback=None,
+    scenario_yaml: Optional[str] = None,
+    k_target: int = 0,
 ) -> Dict[str, Any]:
     """Join the jax.distributed cluster and run the sharded solve.
 
     Every process executes this identical function; arrays with
-    replicated out-specs give every process the full result.
+    replicated out-specs give every process the full result.  The
+    wall-clock ``timeout`` is only honored on single-process runs —
+    orchestrated runs stop via ``chunk_callback`` so all processes
+    stop at the same chunk boundary.
     """
     import jax
 
@@ -240,8 +518,37 @@ def _run_spmd(
     full_params = prepare_algo_params(params, module.algo_params)
 
     n_shards = jax.device_count()  # global
-    problem = compile_dcop(dcop, n_shards=n_shards)
     mesh = Mesh(np.array(jax.devices()), (SHARD_AXIS,))
+
+    if scenario_yaml is not None:
+        from pydcop_tpu.dcop.yamldcop import load_scenario
+        from pydcop_tpu.engine.dynamic import run_dynamic
+
+        scenario = load_scenario(scenario_yaml)
+        # run_dynamic's segment schedule is a deterministic function of
+        # (dcop, scenario, seed), so every SPMD process replays the
+        # exact same recompile/resume sequence; no wall-clock timeout
+        # here for the same reason
+        r = run_dynamic(
+            dcop,
+            algo,
+            params,
+            scenario,
+            k_target=k_target,
+            final_rounds=rounds,
+            seed=seed,
+            mesh=mesh,
+            n_shards=n_shards,
+            chunk_size=chunk_size,
+            chunk_callback=chunk_callback,
+        )
+        return {
+            **r,
+            "num_processes": num_processes,
+            "n_shards": n_shards,
+        }
+
+    problem = compile_dcop(dcop, n_shards=n_shards)
     r = run_batched(
         problem,
         module,
@@ -251,6 +558,7 @@ def _run_spmd(
         timeout=timeout,
         chunk_size=chunk_size,
         mesh=mesh,
+        chunk_callback=chunk_callback,
     )
     return {
         "assignment": r.best_assignment,

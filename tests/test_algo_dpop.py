@@ -195,3 +195,31 @@ def test_dpop_device_util_falls_back_on_exact_ties():
     r = solve(dcop, "dpop", {"util_device": "always"})
     assert r["util_backend"] == "host"  # fell back
     assert r["cost"] == 0  # and stayed exact
+
+
+def test_dpop_device_util_repairs_sparse_ties():
+    """A FEW exact-tie cells in an otherwise random table must be
+    repaired in host f64 (not fall back wholesale) — and the repair
+    writes into the argmin table, which must be a writable copy, not
+    jax's read-only buffer (ADVICE r2, high)."""
+    d = 50
+    rnd = np.random.RandomState(7)
+    dom = Domain("c", "", list(range(d)))
+    dcop = DCOP("sparse_ties")
+    v0, v1 = Variable("v0", dom), Variable("v1", dom)
+    dcop.add_variable(v0)
+    dcop.add_variable(v1)
+    t = rnd.uniform(2, 10, (d, d))
+    # 3/50 rows with an exact tie between their two minima; distinct
+    # per-row minima so the ROOT's own argmin keeps a healthy margin
+    # (a root-level tie would legitimately force the full fallback)
+    for row, m in ((3, 1.0), (17, 1.25), (29, 1.5)):
+        t[row, 5] = m
+        t[row, 31] = m
+    dcop.add_constraint(NAryMatrixRelation([v0, v1], t, name="c01"))
+
+    r_dev = solve(dcop, "dpop", {"util_device": "always"})
+    r_host = solve(dcop, "dpop", {"util_device": "never"})
+    assert r_dev["util_backend"] == "device"  # repaired, no fallback
+    assert r_dev["assignment"] == r_host["assignment"]
+    assert r_dev["cost"] == pytest.approx(r_host["cost"])
