@@ -4,15 +4,28 @@ Workload (north star, BASELINE.md): 10k-variable random graph-coloring
 Max-Sum on the factor graph; metric = logical messages/sec (1 message =
 1 directed-edge update per round, both q and r directions counted).
 
-Robustness contract (VERDICT.md round 1, item 1b): the driver must get a
-parseable JSON line NO MATTER WHAT.  TPU backend init on this image can
-hang or fail, so every measurement runs in a bounded-time subprocess:
+Robustness contract (VERDICT.md rounds 1-2): the driver must get a
+parseable JSON line NO MATTER WHAT, and when something fails the line
+must say exactly WHICH STAGE failed and how long it took — "TPU timed
+out" with one opaque 480 s subprocess is not attributable.  So the
+default-backend attempt runs as **staged, individually-bounded
+subprocess probes**:
 
-- the TPU attempt (default backend) doubles as the init probe and gets
-  one retry;
-- the CPU baseline is measured IN-RUN in a subprocess pinned to the CPU
-  backend (``JAX_PLATFORMS=cpu``) — not hardcoded;
-- on any failure the line still prints, with an ``"error"`` field.
+- ``init``   — backend init only (jax.devices + a tiny op), 90 s.
+  Separates "the axon TPU plugin hangs" (round-2 failure mode) from
+  everything downstream.
+- ``small``  — compile + run at 1k vars, 180 s.  Separates "XLA
+  compile of the big program blew the budget" from init problems.
+  All stages share a **persistent XLA compilation cache**
+  (jax_compilation_cache_dir), so a retry of a stage — or the next
+  driver round — does not pay that stage's compile again.
+- ``north_star`` — the 10k-var measurement, 300 s budget.
+
+Every stage reports ``{stage, ok, seconds, ...}`` into the final JSON
+line's ``stages`` list.  The headline value comes from the deepest
+successful stage; the CPU baseline is measured IN-RUN in a subprocess
+pinned to the CPU backend (never hardcoded — the constant below is a
+last resort that is flagged in ``error`` when used).
 
 ``vs_baseline`` = msgs/sec on the default backend divided by the
 measured single-host CPU msgs/sec of this same engine/workload.  The
@@ -31,6 +44,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+CACHE_DIR = os.path.join(REPO, ".xla_cache")
 
 # Last-resort constant (BASELINE.md CPU row) used ONLY if the in-run CPU
 # measurement itself fails; flagged via the "error" field when used.
@@ -40,6 +54,13 @@ N_VARS = 10_000
 ROUNDS = 1024
 CHUNK = 256
 DEGREE = 3
+
+# stage name -> (n_vars, rounds, subprocess budget seconds)
+STAGES = [
+    ("init", 0, 0, 90.0),
+    ("small", 1_000, 256, 180.0),
+    ("north_star", N_VARS, ROUNDS, 300.0),
+]
 
 
 def _measure(n_vars: int, rounds: int, chunk: int) -> dict:
@@ -54,17 +75,39 @@ def _measure(n_vars: int, rounds: int, chunk: int) -> dict:
     from pydcop_tpu.engine.batched import run_batched
     from pydcop_tpu.ops import compile_dcop
 
+    if n_vars == 0:  # init probe: backend up + one tiny device op
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        platform = jax.devices()[0].platform
+        x = jnp.ones((256, 256))
+        float((x @ x).sum().block_until_ready())
+        return {
+            "platform": platform,
+            "init_seconds": time.perf_counter() - t0,
+            "n_devices": jax.device_count(),
+        }
+
     dcop = g._make_coloring_dcop(n_vars, degree=DEGREE, seed=1)
     problem = compile_dcop(dcop)
     module = load_algorithm_module("maxsum")
     params = prepare_algo_params({"damping": 0.5}, module.algo_params)
 
-    # warmup: XLA compile + cache the chunk runner
-    run_batched(problem, module, params, rounds=chunk, seed=0, chunk_size=chunk)
+    # cost_every=8: sample the anytime cost tracking instead of paying
+    # a cost evaluation (≈ one full round's time on TPU) every round —
+    # the same setting is used for the CPU baseline, and the reference
+    # likewise observes cost only at its collection period
+    t0 = time.perf_counter()
+    run_batched(
+        problem, module, params, rounds=chunk, seed=0, chunk_size=chunk,
+        cost_every=8,
+    )
+    compile_seconds = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     result = run_batched(
-        problem, module, params, rounds=rounds, seed=0, chunk_size=chunk
+        problem, module, params, rounds=rounds, seed=0, chunk_size=chunk,
+        cost_every=8,
     )
     dt = time.perf_counter() - t0
     msgs = module.messages_per_round(problem, params) * result.cycles
@@ -74,6 +117,8 @@ def _measure(n_vars: int, rounds: int, chunk: int) -> dict:
         "best_cost": result.best_cost,
         "n_edges": int(problem.n_edges),
         "rounds": int(result.cycles),
+        "compile_seconds": compile_seconds,
+        "run_seconds": dt,
     }
 
 
@@ -84,16 +129,25 @@ def _inner_main() -> None:
     p.add_argument("--rounds", type=int, default=ROUNDS)
     p.add_argument("--chunk", type=int, default=CHUNK)
     a = p.parse_args()
+    import jax
+
     if os.environ.get("BENCH_PIN_CPU"):
         # the axon TPU plugin overrides the JAX_PLATFORMS env var, so
         # the CPU pin must go through jax.config BEFORE backend init
-        import jax
-
         jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache: a retried stage (or the north-star
+    # after `small`) must not pay XLA compile twice
+    try:
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax: cache flags absent — correctness unaffected
     print("BENCH_JSON:" + json.dumps(_measure(a.vars, a.rounds, a.chunk)))
 
 
-def _run_sub(pin_cpu: bool, timeout: float) -> dict:
+def _run_sub(
+    pin_cpu: bool, timeout: float, n_vars: int, rounds: int
+) -> dict:
     """Run ``bench.py --inner`` in a subprocess; parse its JSON line.
 
     Returns the metrics dict, or {"error": ...} on failure/timeout.
@@ -105,9 +159,13 @@ def _run_sub(pin_cpu: bool, timeout: float) -> dict:
     else:
         env.pop("BENCH_PIN_CPU", None)  # a leftover pin would silently
         # turn the default-backend headline into a CPU number
+    t0 = time.perf_counter()
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py"), "--inner"],
+            [
+                sys.executable, os.path.join(REPO, "bench.py"), "--inner",
+                "--vars", str(n_vars), "--rounds", str(rounds),
+            ],
             env=env,
             cwd=REPO,
             capture_output=True,
@@ -115,51 +173,112 @@ def _run_sub(pin_cpu: bool, timeout: float) -> dict:
             timeout=timeout,
         )
     except subprocess.TimeoutExpired:
-        return {"error": f"timed out after {timeout:.0f}s"}
+        return {
+            "error": f"timed out after {timeout:.0f}s",
+            "seconds": time.perf_counter() - t0,
+        }
+    out = {"seconds": time.perf_counter() - t0}
     for line in reversed(proc.stdout.splitlines()):
         if line.startswith("BENCH_JSON:"):
-            return json.loads(line[len("BENCH_JSON:"):])
-    return {
-        "error": (
-            f"rc={proc.returncode}, no BENCH_JSON line; stderr tail: "
-            + proc.stderr[-800:].replace("\n", " | ")
+            out.update(json.loads(line[len("BENCH_JSON:"):]))
+            return out
+    out["error"] = (
+        f"rc={proc.returncode}, no BENCH_JSON line; stderr tail: "
+        + proc.stderr[-800:].replace("\n", " | ")
+    )
+    return out
+
+
+def _staged_default_backend() -> tuple:
+    """Run the staged probes on the default backend.
+
+    Returns (headline metrics dict or None, stage report list).
+    """
+    report = []
+    best = None
+    for stage, n_vars, rounds, budget in STAGES:
+        r = _run_sub(
+            pin_cpu=False, timeout=budget, n_vars=n_vars, rounds=rounds
         )
-    }
+        ok = "error" not in r
+        entry = {
+            "stage": stage,
+            "ok": ok,
+            "seconds": round(r.get("seconds", 0.0), 1),
+        }
+        for k in (
+            "platform", "msgs_per_sec", "compile_seconds", "error"
+        ):
+            if k in r:
+                entry[k] = (
+                    round(r[k], 1)
+                    if isinstance(r[k], float) and k != "msgs_per_sec"
+                    else r[k]
+                )
+        report.append(entry)
+        if not ok:
+            # one retry per failing stage: the compile cache makes the
+            # second attempt much cheaper if the failure was a slow
+            # first compile rather than a hang
+            r2 = _run_sub(
+                pin_cpu=False, timeout=budget, n_vars=n_vars, rounds=rounds
+            )
+            ok = "error" not in r2
+            entry2 = {
+                "stage": stage + "_retry",
+                "ok": ok,
+                "seconds": round(r2.get("seconds", 0.0), 1),
+            }
+            if "error" in r2:
+                entry2["error"] = r2["error"]
+            report.append(entry2)
+            if not ok:
+                break  # deeper stages would fail the same way
+            r = r2
+        if "msgs_per_sec" in r:
+            best = r
+    return best, report
 
 
 def main() -> None:
     errors = []
+    os.makedirs(CACHE_DIR, exist_ok=True)
 
-    # Headline number on the default backend (TPU when available).  The
-    # subprocess doubles as the flaky-init probe; one retry.
-    dev = _run_sub(pin_cpu=False, timeout=480)
-    if "error" in dev:
-        errors.append(f"default-backend attempt 1: {dev['error']}")
-        dev = _run_sub(pin_cpu=False, timeout=240)
-        if "error" in dev:
-            errors.append(f"default-backend attempt 2: {dev['error']}")
+    dev, stages = _staged_default_backend()
+    failed = [s for s in stages if not s["ok"]]
+    if failed:
+        errors.append(
+            "; ".join(
+                f"stage {s['stage']} failed after {s['seconds']}s: "
+                f"{s.get('error', '?')}"
+                for s in failed
+            )
+        )
 
-    # CPU baseline, measured in-run (VERDICT round 1 weak item 1).  If
-    # the default backend already WAS cpu, that run is the baseline.
-    if "error" not in dev and dev.get("platform") == "cpu":
+    # CPU baseline, measured in-run AT THE SAME SCALE as the deepest
+    # device stage that succeeded (comparing a 1k-var device number to
+    # a 10k-var cpu number would be meaningless).  If the default
+    # backend already WAS cpu, that run is the baseline.
+    base_vars, base_rounds = N_VARS, ROUNDS
+    if dev is not None and dev.get("n_edges", 1 << 30) < 25_000:
+        base_vars, base_rounds = 1_000, 256
+    if dev is not None and dev.get("platform") == "cpu":
         cpu = dev
     else:
-        cpu = _run_sub(pin_cpu=True, timeout=600)
+        cpu = _run_sub(
+            pin_cpu=True, timeout=600, n_vars=base_vars, rounds=base_rounds
+        )
     if "error" in cpu:
         errors.append(f"cpu baseline: {cpu['error']}")
         baseline = FALLBACK_CPU_BASELINE
         errors.append(
             f"using recorded BASELINE.md cpu constant {baseline:.3g}"
         )
+        cpu = None
     else:
         baseline = cpu["msgs_per_sec"]
 
-    if "error" not in dev:
-        headline = dev
-    elif "error" not in cpu:
-        headline = cpu  # fallback: report CPU so the line still parses
-    else:
-        headline = None
+    headline = dev if dev is not None else cpu
 
     out = {
         "metric": "maxsum_msgs_per_sec_10k_coloring",
@@ -171,9 +290,14 @@ def main() -> None:
     }
     if headline:
         out["backend"] = headline["platform"]
-        out["best_cost"] = headline["best_cost"]
-    if "error" not in cpu:
+        out["best_cost"] = headline.get("best_cost")
+        # the headline must say when it is NOT the 10k north star
+        # (e.g. only the `small` stage survived on the default backend)
+        if headline.get("n_edges") and headline["n_edges"] < 25_000:
+            out["metric"] = "maxsum_msgs_per_sec_1k_coloring"
+    if cpu is not None:
         out["cpu_baseline_msgs_per_sec"] = round(cpu["msgs_per_sec"])
+    out["stages"] = stages
     if errors:
         out["error"] = "; ".join(errors)
     print(json.dumps(out))

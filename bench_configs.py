@@ -87,11 +87,16 @@ def _run_batched_config(dcop, algo, params, rounds, chunk):
     problem = compile_dcop(dcop)
     module = load_algorithm_module(algo)
     full = prepare_algo_params(params, module.algo_params)
-    # warmup chunk: XLA compile out of the measured window
-    run_batched(problem, module, full, rounds=chunk, seed=0, chunk_size=chunk)
+    # warmup chunk: XLA compile out of the measured window.
+    # cost_every=8 matches bench.py (sampled anytime-cost tracking)
+    run_batched(
+        problem, module, full, rounds=chunk, seed=0, chunk_size=chunk,
+        cost_every=8,
+    )
     t0 = time.perf_counter()
     r = run_batched(
-        problem, module, full, rounds=rounds, seed=0, chunk_size=chunk
+        problem, module, full, rounds=rounds, seed=0, chunk_size=chunk,
+        cost_every=8,
     )
     dt = time.perf_counter() - t0
     msgs = module.messages_per_round(problem, full) * r.cycles

@@ -138,16 +138,13 @@ def step(
     stuck = jnp.maximum(improve, nbr_improve) <= EPS
     qlm = has_violation & stuck  # [n_vars], replicated
 
-    # weight += increase on violated constraints touching a QLM variable
-    # (a constraint's edges all live in its own shard: no collective)
-    touch_qlm = (
-        jax.ops.segment_max(
-            qlm[problem.edge_var].astype(problem.unary.dtype),
-            local_con,
-            num_segments=weights.shape[0],
-        )
-        > 0.5
-    )
+    # weight += increase on violated constraints touching a QLM
+    # variable.  Gather-dual of the per-edge segment_max: a
+    # constraint's scope variables are its edges' owners, so read qlm
+    # straight through con_scopes (stride 0 marks padded scope slots;
+    # qlm is replicated so no collective is needed either way).
+    scope_mask = problem.con_strides > 0  # [C, k_max]
+    touch_qlm = jnp.any(qlm[problem.con_scopes] & scope_mask, axis=1)
     new_weights = jnp.where(
         violated & touch_qlm, weights + params["increase"], weights
     )

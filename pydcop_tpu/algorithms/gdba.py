@@ -27,7 +27,7 @@ costs always use the raw problem.
 State layout: one weight table per arity bucket (``w{k}:
 f32[m, d^k]``), sharded with its bucket under ``shard_map`` so all
 weight reads/updates are shard-local; the candidate sweep scatters
-per-edge rows through the bucket's ``edge_slot`` map exactly like
+per-edge rows as position-major contiguous blocks exactly like
 Max-Sum's marginalization does.
 
 Message accounting: one ok + one improve message per directed primal
@@ -90,15 +90,8 @@ def step(
     vmode = params["violation"]
     imode = params["increase_mode"]
 
-    local_off = 0
-    if axis_name is not None:
-        local_off = jax.lax.axis_index(axis_name) * problem.edge_var.shape[0]
-
     # -- per-bucket: effective sweep rows + raw violation flags ---------
-    E_local = problem.edge_var.shape[0]
-    edge_sweep = jnp.zeros((E_local, d), dtype=problem.unary.dtype)
-    edge_violated = jnp.zeros(E_local, dtype=problem.unary.dtype)
-    per_bucket = {}  # k -> (cur_cell, violated, vals)
+    per_bucket = {}  # k -> (eff_flat, cur_cell, violated, vals)
     for k, bucket in sorted(problem.buckets.items()):
         m = bucket.tables.shape[0]
         base_flat = bucket.tables.reshape(m, d**k)
@@ -121,17 +114,49 @@ def step(
             tmin = jnp.min(base_flat, axis=1)
             tmax = jnp.max(base_flat, axis=1)
             violated = (cc_raw >= tmax - EPS) & (tmax > tmin + EPS)
-        per_bucket[k] = (cur_cell, violated, vals)
+        per_bucket[k] = (eff_flat, cur_cell, violated, vals)
 
-        slots = bucket.edge_slot - local_off  # [m, k] local edge ids
-        for p in range(k):
-            base_wo_p = cur_cell - vals[:, p] * strides[p]
-            cells = base_wo_p[:, None] + jnp.arange(d)[None, :] * strides[p]
-            sweep_p = jnp.take_along_axis(eff_flat, cells, axis=1)  # [m, d]
-            edge_sweep = edge_sweep.at[slots[:, p]].set(sweep_p)
-            edge_violated = edge_violated.at[slots[:, p]].set(
-                violated.astype(edge_violated.dtype)
-            )
+    # Edge-indexed arrays by CONCATENATION, not scatter: edge ids are
+    # position-major per (shard segment, arity) run (compile.py
+    # edge_order), so each bucket position's edges are one contiguous
+    # block and the blocks in (segment, arity, position) order tile the
+    # local edge axis exactly — the same layout contract Max-Sum's
+    # factor phase relies on.
+    n_segments = problem.n_shards if axis_name is None else 1
+    sweep_blocks = []
+    viol_blocks = []
+    for seg in range(n_segments):
+        for k, bucket in sorted(problem.buckets.items()):
+            eff_flat, cur_cell, violated, vals = per_bucket[k]
+            m = bucket.tables.shape[0] // n_segments
+            rows = slice(seg * m, (seg + 1) * m)
+            strides = _bucket_strides(k, d)
+            for p in range(k):
+                base_wo_p = (
+                    cur_cell[rows] - vals[rows, p] * strides[p]
+                )
+                cells = (
+                    base_wo_p[:, None]
+                    + jnp.arange(d)[None, :] * strides[p]
+                )
+                sweep_p = jnp.take_along_axis(
+                    eff_flat[rows], cells, axis=1
+                )  # [m, d]
+                sweep_blocks.append(sweep_p)
+                viol_blocks.append(
+                    violated[rows].astype(problem.unary.dtype)
+                )
+    E_local = problem.edge_var.shape[0]
+    if sweep_blocks:
+        edge_sweep = jnp.concatenate(sweep_blocks, axis=0)
+        edge_violated = jnp.concatenate(viol_blocks, axis=0)
+        if edge_sweep.shape[0] < E_local:  # min-1-length edge padding
+            pad = E_local - edge_sweep.shape[0]
+            edge_sweep = jnp.pad(edge_sweep, ((0, pad), (0, 0)))
+            edge_violated = jnp.pad(edge_violated, ((0, pad),))
+    else:  # constraint-free problem
+        edge_sweep = jnp.zeros((E_local, d), dtype=problem.unary.dtype)
+        edge_violated = jnp.zeros(E_local, dtype=problem.unary.dtype)
 
     local = segment_sum_edges(problem, edge_sweep, axis_name) + problem.unary
     current = jnp.take_along_axis(local, values[:, None], axis=1)[:, 0]
@@ -155,7 +180,7 @@ def step(
 
     new_state: Dict[str, jax.Array] = {"values": new_values}
     for k, bucket in sorted(problem.buckets.items()):
-        cur_cell, violated, vals = per_bucket[k]
+        _, cur_cell, violated, vals = per_bucket[k]
         m = bucket.tables.shape[0]
         strides = _bucket_strides(k, d)
         w = state[f"w{k}"]
@@ -176,17 +201,18 @@ def step(
                 if imode == "C":
                     # own axis at current value, co-cells free
                     mask = on_own_axis.astype(w.dtype)
-                else:  # R: own axis free, co-vars at current values
-                    base_wo_p = cur_cell - vals[:, p] * strides[p]
-                    cells = (
-                        base_wo_p[:, None]
-                        + jnp.arange(d)[None, :] * strides[p]
-                    )
-                    mask = (
-                        jnp.zeros_like(w)
-                        .at[jnp.arange(m)[:, None], cells]
-                        .set(1.0)
-                    )
+                else:  # R: own axis free, co-vars at current values —
+                    # cells agreeing with every co-axis's current
+                    # value, built by comparison (no scatter)
+                    on_co = jnp.ones((m, d**k), dtype=bool)
+                    for q2 in range(k):
+                        if q2 == p:
+                            continue
+                        axis_val_q = (
+                            cell_axis[None, :] // strides[q2]
+                        ) % d
+                        on_co &= axis_val_q == vals[:, q2 : q2 + 1]
+                    mask = on_co.astype(w.dtype)
             delta = delta + active * mask
         new_state[f"w{k}"] = w + delta
     return new_state

@@ -111,6 +111,12 @@ def belief_from_r(
     if axis_name is None:
         pad = jnp.zeros((r.shape[0], 1), dtype=r.dtype)
         r_pad = jnp.concatenate([r, pad], axis=1)  # sentinel column
+        # per-slot gather loop.  Measured on the TPU (round-3,
+        # tools/bench_gather.py): all aggregation shapes — this loop,
+        # grouped/flat gathers, row-major gathers, segment_sum — land
+        # within 570-790 us at 10k vars; the gather is element-bound in
+        # the TPU lowering, not launch-bound, so restructuring does not
+        # help and the slot loop is the simplest of the equals.
         acc = unary_t
         for p in range(problem.var_edges.shape[1]):
             acc = acc + r_pad[:, problem.var_edges[:, p]]
@@ -140,6 +146,21 @@ def step(
     # variable-first phasing — messages just carry a half-round-older
     # q, which is a legal BP schedule.
 
+    # On the TPU backend, the two contiguous phases (factor round and
+    # q update) each run as ONE fused Pallas kernel — the XLA versions
+    # span many tiny kernels and the round is launch-bound at this
+    # scale (BASELINE.md round-3 profile).  The belief gather stays in
+    # XLA either way (element-bound, not fixable by fusion).
+    from pydcop_tpu.ops import pallas_maxsum
+
+    use_fused = (
+        axis_name is None
+        and problem.n_shards == 1
+        and set(problem.buckets) == {2}
+        and problem.d_max <= pallas_maxsum.MAX_D  # VMEM: d² lane block
+        and pallas_maxsum.available()
+    )
+
     # -- 1. factor -> variable, per arity bucket ----------------------
     # Edges are position-major per (shard segment, arity) run
     # (compile.py edge_order), so every bucket position's q is one
@@ -155,6 +176,13 @@ def step(
                 q[:, off + p * m : off + (p + 1) * m]  # [d, m]
                 for p in range(k)
             ]
+            if use_fused:  # k == 2 by the use_fused condition
+                r0, r1 = pallas_maxsum.factor_round_binary(
+                    tab, q_pos[0], q_pos[1]
+                )
+                r_blocks.append(jnp.concatenate([r0, r1], axis=1))
+                off += m * k
+                continue
             s = tab  # [d, ..., d, m]
             for p in range(k):
                 shape = (1,) * p + (d,) + (1,) * (k - 1 - p) + (m,)
@@ -176,9 +204,15 @@ def step(
 
     # -- 2. variable -> factor + value selection ----------------------
     belief = belief_from_r(problem, r_new, unary_t, axis_name)  # [d, n]
-    q_new = belief[:, problem.edge_var] - r_new  # exclude own incoming r
-    q_new = q_new - jnp.min(q_new, axis=0, keepdims=True)
-    q_new = damping * q + (1.0 - damping) * q_new
+    belief_e = belief[:, problem.edge_var]  # exclude own incoming r
+    if use_fused:
+        q_new = pallas_maxsum.q_update(
+            belief_e, r_new, q, jnp.asarray(damping)
+        )
+    else:
+        q_new = belief_e - r_new
+        q_new = q_new - jnp.min(q_new, axis=0, keepdims=True)
+        q_new = damping * q + (1.0 - damping) * q_new
     values = jnp.argmin(belief, axis=0).astype(state["values"].dtype)
     return {
         "q": q_new,

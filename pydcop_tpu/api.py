@@ -35,6 +35,7 @@ def solve(
     checkpoint_every: int = 1,
     resume: bool = False,
     mode: str = "batched",
+    ui_port: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -48,6 +49,12 @@ def solve(
     runtime), or ``"sim"`` (deterministic seeded async event loop —
     the parity-test schedule).
 
+    Stop conditions differ per engine (round budget + optional
+    ``convergence_chunks`` for batched; quiescence for thread/sim) —
+    ``docs/termination.md`` maps them to the reference's
+    stable-message / cycle-limit semantics and defines what ``cycle``
+    and ``msg_count`` mean in each.
+
     >>> result = solve(my_dcop, "dsa", {"variant": "B"}, rounds=100)
     >>> result["assignment"], result["cost"]
     """
@@ -59,6 +66,11 @@ def solve(
             raise ValueError(
                 "checkpoint/resume is only supported on the batched "
                 f"engine, not mode={mode!r}"
+            )
+        if ui_port is not None:
+            raise ValueError(
+                "ui_port (live observability) is only supported on "
+                f"the batched engine, not mode={mode!r}"
             )
         from pydcop_tpu.infrastructure import solve_host
 
@@ -93,19 +105,39 @@ def solve(
     problem = compile_dcop(dcop)
     from pydcop_tpu.engine.batched import run_batched
 
-    result = run_batched(
-        problem,
-        module,
-        params,
-        rounds=rounds,
-        seed=seed,
-        timeout=timeout,
-        chunk_size=chunk_size,
-        convergence_chunks=convergence_chunks,
-        checkpoint_path=checkpoint_path,
-        checkpoint_every=checkpoint_every,
-        resume=resume,
-    )
+    ui = None
+    chunk_callback = None
+    if ui_port is not None:
+        from pydcop_tpu.infrastructure.ui import UiServer, chunk_publisher
+
+        ui = UiServer(ui_port)
+        chunk_callback = chunk_publisher(ui)
+    try:
+        result = run_batched(
+            problem,
+            module,
+            params,
+            rounds=rounds,
+            seed=seed,
+            timeout=timeout,
+            chunk_size=chunk_size,
+            convergence_chunks=convergence_chunks,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every,
+            resume=resume,
+            chunk_callback=chunk_callback,
+        )
+        if ui is not None:  # final event carries the assignment
+            ui.publish(
+                result.cycles,
+                result.cost,
+                result.best_cost,
+                values=result.best_assignment,
+                status=result.status,
+            )
+    finally:
+        if ui is not None:
+            ui.close()
     return {
         "assignment": result.best_assignment,
         "cost": result.best_cost,

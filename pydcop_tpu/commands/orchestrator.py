@@ -61,6 +61,11 @@ def set_parser(subparsers) -> None:
         help="seconds to wait for a clean unwind after a peer death "
         "before force-exiting a wedged process",
     )
+    p.add_argument(
+        "--uiport", type=int, default=None,
+        help="serve a live observability feed on this port during "
+        "the run (SSE /events + /state, see infrastructure/ui.py)",
+    )
     p.set_defaults(func=run_cmd)
 
 
@@ -96,6 +101,7 @@ def run_cmd(args) -> int:
         abort_grace=args.abort_grace,
         scenario_yaml=scenario_yaml,
         k_target=args.ktarget,
+        ui_port=args.uiport,
     )
     write_result(args, result)
     return 0

@@ -58,6 +58,12 @@ def set_parser(subparsers) -> None:
         "--resume", action="store_true",
         help="restore --checkpoint (if present) and continue the run",
     )
+    p.add_argument(
+        "--uiport", type=int, default=None,
+        help="serve a live observability feed on this port while "
+        "solving (SSE /events + /state + built-in page, see "
+        "infrastructure/ui.py)",
+    )
     add_collect_arguments(p)
     p.set_defaults(func=run_cmd)
 
@@ -85,6 +91,7 @@ def run_cmd(args) -> int:
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
         mode="batched" if args.mode == "tpu" else args.mode,
+        ui_port=args.uiport,
     )
     write_metrics(args, result)
     result.pop("cost_trace", None)  # keep the printed JSON compact

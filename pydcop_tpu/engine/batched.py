@@ -54,19 +54,59 @@ class RunResult:
 _RUNNER_CACHE: Dict[Tuple, Callable] = {}
 
 
+def _default_unroll() -> int:
+    """Scan unroll for the round loop, by backend: on CPU unrolling 2
+    rounds lets XLA fuse across the boundary (measured 2.3x at 10k
+    vars, BASELINE.md round 1); on TPU the same unroll is ~25% SLOWER
+    (round-3 profile: 606 vs 768 us/round) — the round is launch-bound
+    and unrolling just bloats the program."""
+    return 1 if jax.default_backend() == "tpu" else 2
+
+
 def _chunk_runner(
-    algo_step: Callable, n_rounds: int, axis_name: Optional[str] = None
+    algo_step: Callable,
+    n_rounds: int,
+    axis_name: Optional[str] = None,
+    cost_every: int = 1,
 ) -> Callable:
     """Build the scan over ``n_rounds`` rounds.
 
-    Carry: (state, best_cost, best_values).  Output: per-round cost.
+    Carry: (state, best_cost, best_values).  Output: cost at every
+    ``cost_every``-th round (``ceil(n_rounds / cost_every)`` values).
+
+    ``cost_every > 1`` samples the anytime cost/best tracking instead
+    of paying it each round — on TPU the cost evaluation costs as much
+    as a whole Max-Sum round (round-3 profile), and the reference
+    itself only observes cost at the orchestrator's collection period,
+    not per agent cycle.  Per-round RNG streams are unchanged: the key
+    for round ``i`` of a chunk is ``fold_in(chunk_key, i)`` regardless
+    of the sampling structure.
     """
+    unroll = _default_unroll()
 
     def run_chunk(problem, state, key, params, best_cost, best_values):
-        def round_fn(carry, i):
+        def rounds_span(state, start, count):
+            """``count`` algorithm rounds, no cost evaluation."""
+
+            def round_fn(s, i):
+                return algo_step(
+                    problem, s, jax.random.fold_in(key, i), params
+                ), ()
+
+            if count == 1:
+                s, _ = round_fn(state, start)
+                return s
+            state, _ = jax.lax.scan(
+                round_fn,
+                state,
+                start + jnp.arange(count),
+                unroll=unroll if count % unroll == 0 else 1,
+            )
+            return state
+
+        def sample_fn(carry, j):
             state, best_cost, best_values = carry
-            k = jax.random.fold_in(key, i)
-            state = algo_step(problem, state, k, params)
+            state = rounds_span(state, j * cost_every, cost_every)
             values = state["values"]
             cost = total_cost(problem, values, axis_name)
             better = cost < best_cost
@@ -74,14 +114,38 @@ def _chunk_runner(
             best_values = jnp.where(better, values, best_values)
             return (state, best_cost, best_values), cost
 
-        (state, best_cost, best_values), costs = jax.lax.scan(
-            round_fn,
-            (state, best_cost, best_values),
-            jnp.arange(n_rounds),
-            # unrolling lets XLA fuse across round boundaries: measured
-            # 2.3x on the 10k-var Max-Sum workload (BASELINE.md); >2
-            # adds compile time for no further gain
-            unroll=2 if n_rounds % 2 == 0 else 1,
+        n_outer, rem = divmod(n_rounds, cost_every)
+        carry = (state, best_cost, best_values)
+        costs_parts = []
+        if n_outer:
+            carry, costs = jax.lax.scan(
+                sample_fn,
+                carry,
+                jnp.arange(n_outer),
+                # with cost_every == 1 the sample loop IS the round
+                # loop — keep the cross-round unroll fusion there
+                unroll=(
+                    unroll
+                    if cost_every == 1 and n_outer % unroll == 0
+                    else 1
+                ),
+            )
+            costs_parts.append(costs)
+        if rem:  # tail rounds of a chunk not divisible by cost_every
+            state, best_cost, best_values = carry
+            state = rounds_span(state, n_outer * cost_every, rem)
+            values = state["values"]
+            cost = total_cost(problem, values, axis_name)
+            better = cost < best_cost
+            best_cost = jnp.where(better, cost, best_cost)
+            best_values = jnp.where(better, values, best_values)
+            carry = (state, best_cost, best_values)
+            costs_parts.append(cost[None])
+        state, best_cost, best_values = carry
+        costs = (
+            jnp.concatenate(costs_parts)
+            if len(costs_parts) > 1
+            else costs_parts[0]
         )
         return state, best_cost, best_values, costs
 
@@ -102,6 +166,7 @@ def run_batched(
     checkpoint_every: int = 1,
     resume: bool = False,
     chunk_callback: Optional[Callable[[int, float], Optional[str]]] = None,
+    cost_every: int = 1,
 ) -> RunResult:
     """Run a batched algorithm for up to ``rounds`` rounds.
 
@@ -125,6 +190,12 @@ def run_batched(
     ``checkpoint_every`` chunks (atomic .npz, see
     ``engine.checkpoint``); ``resume=True`` restores it and continues
     from the recorded round counter.
+
+    ``cost_every`` samples the anytime cost/best-assignment tracking
+    every that many rounds instead of every round (the cost evaluation
+    is as expensive as a whole Max-Sum round on TPU); the cost trace
+    then has one entry per sample.  Algorithm semantics and RNG
+    streams are unaffected.
 
     ``chunk_callback(done_rounds, best_cost)`` is invoked at every
     *interior* chunk boundary (``done < rounds``), before the local
@@ -173,6 +244,7 @@ def run_batched(
         id(mesh) if mesh is not None else None,
         tuple(sorted(problem.buckets)),  # pspecs structure
         problem.n_shards,
+        cost_every,
     )
 
     key = jax.random.PRNGKey(seed)
@@ -191,7 +263,9 @@ def run_batched(
 
         if os.path.exists(checkpoint_path):
             state, bc, bv, resumed_rounds, meta = load_checkpoint(
-                checkpoint_path, state
+                checkpoint_path,
+                state,
+                static_keys=getattr(algo_module, "STATIC_STATE_KEYS", ()),
             )
             if meta.get("algo") != algo_module.__name__:
                 raise ValueError(
@@ -226,7 +300,7 @@ def run_batched(
         cache_key = cache_key_base + (n,)
         if cache_key in _RUNNER_CACHE:
             return _RUNNER_CACHE[cache_key]
-        fn = _chunk_runner(algo_step, n, axis_name)
+        fn = _chunk_runner(algo_step, n, axis_name, cost_every)
         if mesh is None:
             runner = jax.jit(fn)
         else:

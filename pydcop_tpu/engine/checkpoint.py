@@ -62,13 +62,19 @@ def save_checkpoint(
 
 
 def load_checkpoint(
-    path: str, state_template
+    path: str, state_template, static_keys=()
 ) -> Tuple[Any, float, np.ndarray, int, Dict[str, Any]]:
     """Restore ``(state, best_cost, best_values, rounds_done, meta)``.
 
     ``state_template`` (a freshly-initialized state of the same
     algorithm/problem) provides the pytree structure; every leaf must be
-    present in the checkpoint with a matching shape.
+    present in the checkpoint with a matching shape, EXCEPT leaves
+    whose top-level key is in ``static_keys`` (an algorithm module's
+    ``STATIC_STATE_KEYS``): those are pure problem-derived index data
+    that ``init_state`` rebuilds identically, so a missing or stale
+    copy in the file is backfilled from the template — this keeps
+    checkpoints from older builds resumable when an algorithm grows a
+    new static index.
     """
     with np.load(path) as data:
         meta = json.loads(bytes(data[_META_KEY]).decode())
@@ -76,6 +82,10 @@ def load_checkpoint(
         leaves = []
         for kpath, tmpl in paths_leaves[0]:
             key = f"state/{_leaf_key(kpath)}"
+            top = _leaf_key(kpath[:1])
+            if top in static_keys:
+                leaves.append(np.asarray(tmpl))
+                continue
             if key not in data:
                 raise ValueError(
                     f"Checkpoint {path} misses state leaf {key!r} — "

@@ -174,9 +174,14 @@ def run_orchestrator(
     abort_grace: float = 5.0,
     scenario_yaml: Optional[str] = None,
     k_target: int = 0,
+    ui_port: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Serve the management plane, run the solve as process 0, and
     return the assembled result dict.
+
+    With ``ui_port``, a live observability feed (SSE, see
+    ``infrastructure/ui.py``) publishes the lockstep progress and the
+    final result while the run is in flight.
 
     Raises :class:`AgentFailureError` (after notifying survivors) if an
     agent dies or stops heartbeating mid-solve.
@@ -194,6 +199,7 @@ def run_orchestrator(
     done_evt = threading.Event()
     dead: List[str] = []  # names of agents whose connection dropped
     peers: List[_Peer] = []
+    solve_started = False  # jax.distributed up → teardown can wedge
 
     def _on_peer_eof(name: str) -> None:
         dead.append(name)
@@ -277,15 +283,37 @@ def run_orchestrator(
             _broadcast({"type": "go"})
             return None
 
-        result = _run_spmd(
-            dcop_yaml, algo, params, rounds, seed, chunk_size,
-            coordinator=f"localhost:{coord_port}",
-            num_processes=num_processes,
-            process_id=0,
-            chunk_callback=chunk_cb,
-            scenario_yaml=scenario_yaml,
-            k_target=k_target,
-        )
+        ui = None
+        cb = chunk_cb
+        if ui_port is not None:
+            from pydcop_tpu.infrastructure.ui import (
+                UiServer,
+                chunk_publisher,
+            )
+
+            ui = UiServer(ui_port)
+            cb = chunk_publisher(ui, prev_callback=chunk_cb)
+
+        solve_started = True
+        try:
+            result = _run_spmd(
+                dcop_yaml, algo, params, rounds, seed, chunk_size,
+                coordinator=f"localhost:{coord_port}",
+                num_processes=num_processes,
+                process_id=0,
+                chunk_callback=cb,
+                scenario_yaml=scenario_yaml,
+                k_target=k_target,
+            )
+            if ui is not None:
+                ui.publish(
+                    result["cycle"], result["cost"], result["cost"],
+                    values=result.get("assignment"),
+                    status=result.get("status"),
+                )
+        finally:
+            if ui is not None:
+                ui.close()
 
         # collect + cross-check agent results (SPMD replication means
         # every process must report the identical cost)
@@ -328,10 +356,13 @@ def run_orchestrator(
                 f"agent {dead[0]!r} died mid-solve "
                 f"(collective failed: {type(exc).__name__})"
             )
-        # after any mid-solve failure the jax.distributed runtime is
+        # after a MID-SOLVE failure the jax.distributed runtime is
         # unrecoverable and its atexit teardown can hang trying to
-        # reach the dead peer: guarantee the process exits
-        _arm_watchdog(threading.Event(), abort_grace, str(exc))
+        # reach the dead peer: guarantee the process exits.  Pre-solve
+        # failures (registration/deploy) leave nothing wedged — let
+        # the caller handle the exception normally.
+        if solve_started:
+            _arm_watchdog(threading.Event(), abort_grace, str(exc))
         raise exc
     finally:
         done_evt.set()
@@ -363,6 +394,8 @@ def run_agent(
     conn.settimeout(_TIMEOUT)
     done_evt = threading.Event()
     abort_reason: List[str] = []
+    grace = 5.0
+    solve_started = False
 
     try:
         _send(conn, {"type": "register", "name": name})
@@ -426,6 +459,7 @@ def run_agent(
                     )
                 # anything else (early stop) — keep waiting
 
+        solve_started = True
         result = _run_spmd(
             deploy["dcop_yaml"],
             deploy["algo"],
@@ -464,7 +498,9 @@ def run_agent(
                 f"agent {name}: run aborted ({abort_reason[0]}; "
                 f"collective failed: {type(exc).__name__})"
             )
-        _arm_watchdog(threading.Event(), 5.0, str(exc))
+        if solve_started:  # see run_orchestrator: pre-solve failures
+            # leave nothing wedged, don't force-exit the host process
+            _arm_watchdog(threading.Event(), grace, str(exc))
         raise exc
     finally:
         done_evt.set()

@@ -78,6 +78,12 @@ def solve_host(
     is given it is converted as rounds × number of computations (one
     activation per computation ≈ one synchronous round), so a CLI
     ``--rounds`` budget stays meaningful across engines.
+
+    The run normally ends by *quiescence* (no queued or in-flight
+    messages — algorithms stop re-sending stable messages), the
+    host-engine analogue of the reference's stable-message stop
+    conditions; see ``docs/termination.md`` for the full mapping
+    across engines.
     """
     t0 = time.perf_counter()
     if isinstance(algo, AlgorithmDef):

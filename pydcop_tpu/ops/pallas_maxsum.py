@@ -1,0 +1,182 @@
+"""Fused Pallas TPU kernels for Max-Sum's contiguous phases.
+
+The round-3 TPU profile (tools/profile_maxsum.py, BASELINE.md) showed
+the 10k-var Max-Sum round is dominated by fixed per-kernel overhead,
+not data: the factor phase (~260 us) and the q update (~257 us) each
+span many tiny XLA kernels over [d, E] arrays that hold well under
+1 MB.  Both phases touch only *contiguous* blocks — the position-major
+edge layout (ops/compile.py) means a binary factor's two q inputs are
+two contiguous [d, m] slices and its r outputs two contiguous blocks —
+so each phase collapses into ONE Pallas kernel over a 1-D grid of
+edge blocks:
+
+- :func:`factor_round_binary` — the arity-2 bucket's whole factor
+  phase: S = table ⊕ q0 ⊕ q1 (d·d lane-vector adds, d is a small
+  static constant), both min-projections, subtract-own-q, and the
+  per-edge min-normalization, in one VMEM-resident pass.
+- :func:`q_update` — q_new = norm(belief_e − r) damped against q.
+
+The belief aggregation itself (per-variable gather over the edge
+permutation) stays in XLA: TPU lane gathers are element-bound in the
+Mosaic lowering (tools/bench_gather.py: every gather/scatter shape of
+the aggregation costs 570-790 us at 10k vars) and Pallas has no
+vectorized lane gather at all, so there is nothing to win by moving
+it.
+
+Used automatically by ``algorithms/maxsum.step`` on the TPU backend
+for problems whose constraints are all binary (single shard);
+``PYDCOP_TPU_NO_PALLAS=1`` forces the plain XLA path.  CPU tests run
+these kernels in interpreter mode and assert bit-level parity with
+the XLA phases.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas ships with jax on this image; guard for odd builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+# lanes per grid block: small enough that a [d, d, BLK] f32 table block
+# (9 * BLK * 4 B = 72 KB at d=3) triple-buffers comfortably in VMEM,
+# large enough that the ~15-block grid amortizes launch overhead.
+# Scaled down for larger domains so the table block stays ≤ _BLK_BYTES.
+_BLK = 2048
+_BLK_BYTES = 2 << 20  # per-input VMEM budget for the [d, d, blk] block
+
+# largest domain the fused factor kernel accepts: at blk=128 (the lane
+# minimum) the table block is d*d*128*4 B — keep it inside the budget
+MAX_D = 64
+
+
+def _blk_for(d: int, m: int) -> int:
+    blk = _BLK_BYTES // max(1, d * d * 4)
+    blk = max(128, min(_BLK, (blk // 128) * 128))
+    return min(blk, max(128, ((m + 127) // 128) * 128))
+
+
+def available() -> bool:
+    """Fused kernels are used on the real TPU backend only (the XLA
+    path is faster under CPU emulation, and interpret mode is for
+    tests)."""
+    if os.environ.get("PYDCOP_TPU_NO_PALLAS"):
+        return False
+    if not _HAVE_PALLAS:
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _pad_lanes(x: jax.Array, m_padded: int) -> jax.Array:
+    m = x.shape[-1]
+    if m == m_padded:
+        return x
+    pad = [(0, 0)] * (x.ndim - 1) + [(0, m_padded - m)]
+    return jnp.pad(x, pad)
+
+
+def _factor_kernel(d: int, tab_ref, q0_ref, q1_ref, r0_ref, r1_ref):
+    # S[a, b, :] = tab[a, b, :] + q0[a, :] + q1[b, :]; min-project over
+    # the other axis, both directions in one pass.  d is a static
+    # Python int, so this is d*d lane-vector adds — no reductions over
+    # a traced axis.
+    m0 = [None] * d
+    m1 = [None] * d
+    for a in range(d):
+        qa = q0_ref[a : a + 1, :]  # [1, BLK]
+        for b in range(d):
+            s = tab_ref[a, b : b + 1, :] + qa + q1_ref[b : b + 1, :]
+            m0[a] = s if m0[a] is None else jnp.minimum(m0[a], s)
+            m1[b] = s if m1[b] is None else jnp.minimum(m1[b], s)
+    r0 = jnp.concatenate(m0, axis=0) - q0_ref[:]  # [d, BLK]
+    r1 = jnp.concatenate(m1, axis=0) - q1_ref[:]
+    r0_ref[:] = r0 - jnp.min(r0, axis=0, keepdims=True)
+    r1_ref[:] = r1 - jnp.min(r1, axis=0, keepdims=True)
+
+
+def factor_round_binary(
+    tab: jax.Array,  # f32[d, d, m] — the arity-2 bucket's tables
+    q0: jax.Array,  # f32[d, m] — position-0 variable→factor messages
+    q1: jax.Array,  # f32[d, m]
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """One fused kernel for the whole binary factor phase.
+
+    Returns ``(r0, r1)``: min-normalized factor→variable messages for
+    scope positions 0 and 1 (each [d, m]).
+    """
+    d, m = q0.shape
+    blk = _blk_for(d, m)
+    mp = ((m + blk - 1) // blk) * blk
+    tab_p = _pad_lanes(tab, mp)
+    q0_p = _pad_lanes(q0, mp)
+    q1_p = _pad_lanes(q1, mp)
+    grid = (mp // blk,)
+    q_spec = pl.BlockSpec((d, blk), lambda i: (0, i))
+    r0, r1 = pl.pallas_call(
+        functools.partial(_factor_kernel, d),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((d, d, blk), lambda i: (0, 0, i)),
+            q_spec,
+            q_spec,
+        ],
+        out_specs=[q_spec, q_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((d, mp), q0.dtype),
+            jax.ShapeDtypeStruct((d, mp), q0.dtype),
+        ],
+        interpret=interpret,
+    )(tab_p, q0_p, q1_p)
+    return r0[:, :m], r1[:, :m]
+
+
+def _qup_kernel(be_ref, r_ref, q_ref, damp_ref, out_ref):
+    qn = be_ref[:] - r_ref[:]
+    qn = qn - jnp.min(qn, axis=0, keepdims=True)
+    dmp = damp_ref[0, 0]
+    out_ref[:] = dmp * q_ref[:] + (1.0 - dmp) * qn
+
+
+def q_update(
+    belief_e: jax.Array,  # f32[d, E] — belief gathered back per edge
+    r_new: jax.Array,  # f32[d, E]
+    q: jax.Array,  # f32[d, E] — previous q (damping)
+    damping: jax.Array,  # scalar (traced — parameter sweeps don't retrace)
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused q update: subtract own r, min-normalize, damp."""
+    d, e = q.shape
+    blk = _blk_for(d, e)  # conservative (d² budget) — extra grid
+    # steps at large d beat a VMEM overflow
+    ep = ((e + blk - 1) // blk) * blk
+    spec = pl.BlockSpec((d, blk), lambda i: (0, i))
+    damp = jnp.asarray(damping, dtype=q.dtype).reshape(1, 1)
+    out = pl.pallas_call(
+        _qup_kernel,
+        grid=(ep // blk,),
+        in_specs=[
+            spec,
+            spec,
+            spec,
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((d, ep), q.dtype),
+        interpret=interpret,
+    )(
+        _pad_lanes(belief_e, ep),
+        _pad_lanes(r_new, ep),
+        _pad_lanes(q, ep),
+        damp,
+    )
+    return out[:, :e]

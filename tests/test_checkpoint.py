@@ -141,3 +141,37 @@ def test_solve_cli_checkpoint_resume(tmp_path):
     assert r2.returncode == 0, r2.stderr
     result = json.loads(r2.stdout)
     assert result["cycle"] == 40  # 20 restored + 20 new
+
+
+def test_resume_backfills_static_state_keys(tmp_path):
+    """A checkpoint written before an algorithm grew a new STATIC
+    state key (pure problem-derived index data) must stay resumable:
+    the missing leaf is backfilled from the fresh init_state template
+    (mgm2 grew pe_inv in round 3)."""
+    problem = ring_problem()
+    module = load_algorithm_module("mgm2")
+    params = prepare_algo_params({}, module.algo_params)
+    path = str(tmp_path / "old.npz")
+
+    full = run_batched(problem, module, params, rounds=64, seed=9,
+                       chunk_size=32)
+    part1 = run_batched(
+        problem, module, params, rounds=32, seed=9, chunk_size=32,
+        checkpoint_path=path,
+    )
+    assert part1.cycles == 32
+
+    # simulate the old build's checkpoint: same file minus pe_inv
+    with np.load(path) as data:
+        stripped = {
+            k: data[k] for k in data.files if k != "state/pe_inv"
+        }
+    np.savez(path, **stripped)
+
+    resumed = run_batched(
+        problem, module, params, rounds=64, seed=9, chunk_size=32,
+        checkpoint_path=path, resume=True,
+    )
+    assert resumed.cycles == 64
+    assert resumed.assignment == full.assignment
+    assert resumed.best_cost == full.best_cost

@@ -83,3 +83,86 @@ def test_total_cost_hlo_is_clean(coloring_problem):
     assert n_lines < 500, (
         f"total_cost HLO grew to {n_lines} lines (measured 165)"
     )
+
+
+@pytest.mark.parametrize(
+    "algo,params,max_lines",
+    [
+        # measured (jax 0.8/CPU, 64-var coloring): dsa 962, mgm 312,
+        # mgm2 2739 (5-phase), dba 569, gdba 629 — bounds ~2x
+        ("dsa", {"variant": "B", "probability": 0.7}, 2000),
+        ("mgm", {}, 700),
+        ("mgm2", {"probability": 0.5}, 5500),
+        ("dba", {}, 1200),
+        ("gdba", {}, 1300),
+    ],
+)
+def test_local_search_round_hlo_is_clean(
+    coloring_problem, algo, params, max_lines
+):
+    """VERDICT r2 weak #7: the DSA/MGM/MGM-2/DBA/GDBA hot paths had no
+    HLO guard, so a scatter regression there passed CI silently."""
+    problem = coloring_problem
+    module = load_algorithm_module(algo)
+    full = prepare_algo_params(params, module.algo_params)
+    state = module.init_state(problem, jax.random.PRNGKey(0), full)
+
+    def fn(problem, state, key):
+        return module.step(problem, state, key, full)
+
+    txt = _compiled_text(fn, problem, state, jax.random.PRNGKey(1))
+    assert not _has_op(txt, "scatter"), (
+        f"{algo} round compiled to a scatter — the gather-based "
+        "neighbor exchange (ops/costs.py) regressed"
+    )
+    n_lines = len(txt.splitlines())
+    assert n_lines < max_lines, (
+        f"{algo} round HLO grew to {n_lines} lines (bound {max_lines}): "
+        "op-count regression on a local-search hot path"
+    )
+
+
+def test_sharded_maxsum_round_hlo_is_clean():
+    """The axis_name (shard_map) Max-Sum path: segment-sum + psum are
+    expected (the sharded aggregation), but per-edge scatters are not,
+    and the collective count must stay at one psum per round."""
+    import __graft_entry__ as g
+    from jax.sharding import PartitionSpec as P
+
+    from pydcop_tpu.parallel import make_mesh
+    from pydcop_tpu.parallel.mesh import (
+        SHARD_AXIS,
+        problem_pspecs,
+        shard_problem,
+        state_pspecs,
+    )
+
+    mesh = make_mesh(2)
+    problem = compile_dcop(g._make_coloring_dcop(64), n_shards=2)
+    problem = shard_problem(problem, mesh)
+    module = load_algorithm_module("maxsum")
+    params = prepare_algo_params({"damping": 0.5}, module.algo_params)
+    state = module.init_state(problem, jax.random.PRNGKey(0), params)
+
+    def fn(problem, state, key):
+        return module.step(
+            problem, state, key, params, axis_name=SHARD_AXIS
+        )
+
+    sharded = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(problem_pspecs(problem), state_pspecs(module, problem), P()),
+        out_specs=state_pspecs(module, problem),
+        check_vma=False,
+    )
+    txt = _compiled_text(sharded, problem, state, jax.random.PRNGKey(1))
+    n_allreduce = _count_op(txt, "all-reduce")
+    assert 1 <= n_allreduce <= 2, (
+        f"sharded Max-Sum round has {n_allreduce} all-reduces "
+        "(design: ONE belief psum per round)"
+    )
+    n_lines = len(txt.splitlines())
+    assert n_lines < 1500, (
+        f"sharded Max-Sum round HLO grew to {n_lines} lines"
+    )

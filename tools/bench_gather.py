@@ -1,0 +1,88 @@
+"""Micro-benchmark of per-variable aggregation variants on TPU.
+
+Decides how maxsum.belief_from_r should aggregate r into [d, n_vars]:
+per-slot gathers, grouped gathers, one flat gather, row-major gathers,
+or segment_sum.  Run on the target backend; results in BASELINE.md.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench(fn, *args, n=200):
+    f = jax.jit(fn)
+    jax.block_until_ready(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n * 1e6
+
+
+def main():
+    print("platform:", jax.devices()[0].platform)
+    rng = np.random.RandomState(0)
+    n, deg, d = 10_000, 16, 3
+    E = 59_980
+    ve = rng.randint(0, E + 1, size=(n, deg)).astype(np.int32)
+    ev = rng.randint(0, n, size=(E,)).astype(np.int32)
+    r = jnp.asarray(rng.rand(d, E + 1).astype(np.float32))
+    r_rows = jnp.asarray(np.asarray(r).T.copy())  # [E+1, d]
+    ve_j = jnp.asarray(ve)
+    ev_j = jnp.asarray(ev)
+
+    def scan200(body):
+        def run(r):
+            def f(s, i):
+                out = body(s)
+                return s + 0.0 * out.sum(), ()
+
+            s, _ = jax.lax.scan(f, r, jnp.arange(200))
+            return s
+
+        return run
+
+    def slot_loop(r):
+        acc = jnp.zeros((d, n), r.dtype)
+        for p in range(deg):
+            acc = acc + r[:, ve_j[:, p]]
+        return acc
+
+    def grouped4(r):
+        acc = jnp.zeros((d, n), r.dtype)
+        for p in range(0, deg, 4):
+            g = r[:, ve_j[:, p : p + 4].reshape(-1)]
+            acc = acc + g.reshape(d, n, 4).sum(-1)
+        return acc
+
+    def flat(r):
+        g = r[:, ve_j.reshape(-1)]
+        return g.reshape(d, n, deg).sum(-1)
+
+    def rows(r_rows):
+        return r_rows[ve_j].sum(axis=1).T  # [n, deg, d] -> [d, n]
+
+    def seg(r):
+        return jax.ops.segment_sum(r[:, :E].T, ev_j, num_segments=n).T
+
+    for name, fn, arg in [
+        ("slot_loop (16 x [d,n])", slot_loop, r),
+        ("grouped4  (4 x [d,4n])", grouped4, r),
+        ("flat      (1 x [d,16n])", flat, r),
+        ("rows      ([E,d] major)", rows, r_rows),
+        ("segment_sum (scatter)", seg, r),
+    ]:
+        # time as 200 iterations inside ONE jit (launch patterns match
+        # the scan-compiled round, not eager dispatch)
+        us = bench(scan200(fn), arg, n=1) / 200
+        print(f"{name:<26} {us:8.1f} us/iter")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,168 @@
+"""Break down the Max-Sum round's time on the current backend.
+
+Times the full step and its three phases (factor, belief, q-update)
+separately — each as a jitted 256-round scan, so per-op dispatch is
+excluded and we see pure XLA execution per phase.  Also sweeps the
+scan unroll factor.  Used to decide where fusion work (Pallas) should
+go; results recorded in BASELINE.md.
+
+Usage: python tools/profile_maxsum.py [--vars 10000] [--trace DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+
+def _bench(fn, state, rounds, label, results):
+    fn = jax.jit(fn)
+    out = fn(state)  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(state)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    per_round = dt / rounds * 1e6
+    results[label] = per_round
+    print(f"{label:<28} {per_round:9.1f} us/round")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vars", type=int, default=10_000)
+    ap.add_argument("--rounds", type=int, default=256)
+    ap.add_argument("--trace", default=None)
+    args = ap.parse_args()
+
+    import __graft_entry__ as g
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.ops import compile_dcop
+    from pydcop_tpu.ops.costs import total_cost
+
+    print("platform:", jax.devices()[0].platform)
+    dcop = g._make_coloring_dcop(args.vars, degree=3, seed=1)
+    problem = compile_dcop(dcop)
+    module = load_algorithm_module("maxsum")
+    params = prepare_algo_params({"damping": 0.5}, module.algo_params)
+    key = jax.random.PRNGKey(0)
+    state = module.init_state(problem, key, params)
+    print(
+        f"n_vars={problem.n_vars} n_edges={problem.n_edges} "
+        f"d={problem.d_max} max_var_deg={problem.var_edges.shape[1]}"
+    )
+    R = args.rounds
+    results = {}
+
+    def scan_of(body):
+        def run(state):
+            def f(s, i):
+                return body(s, jax.random.fold_in(key, i)), ()
+
+            s, _ = jax.lax.scan(f, state, jnp.arange(R), unroll=2)
+            return s
+
+        return run
+
+    # full step (what run_batched executes, minus best-cost tracking)
+    _bench(
+        scan_of(lambda s, k: module.step(problem, s, k, params)),
+        state, R, "full step", results,
+    )
+
+    # full step + cost (the real engine round)
+    def step_cost(s, k):
+        s = module.step(problem, s, k, params)
+        c = total_cost(problem, s["values"])
+        return {**s, "noise": s["noise"] + 0.0 * c}
+
+    _bench(scan_of(step_cost), state, R, "full step + cost", results)
+
+    # factor phase only: r = F(q)  (iterate on q <- r's shape)
+    unary_t = problem.unary.T
+    d = problem.d_max
+
+    def factor_only(s, k):
+        q = s["q"]
+        r_blocks = []
+        off = 0
+        for kk, bucket in sorted(problem.buckets.items()):
+            m = bucket.tables_t.shape[-1]
+            q_pos = [q[:, off + p * m : off + (p + 1) * m] for p in range(kk)]
+            ss = bucket.tables_t
+            for p in range(kk):
+                shape = (1,) * p + (d,) + (1,) * (kk - 1 - p) + (m,)
+                ss = ss + q_pos[p].reshape(shape)
+            outs = []
+            for p in range(kk):
+                axes = tuple(a for a in range(kk) if a != p)
+                mp = jnp.min(ss, axis=axes)
+                rp = mp - q_pos[p]
+                rp = rp - jnp.min(rp, axis=0, keepdims=True)
+                outs.append(rp)
+            r_blocks.append(jnp.concatenate(outs, axis=1))
+            off += m * kk
+        r_new = (
+            jnp.concatenate(r_blocks, axis=1)
+            if len(r_blocks) > 1
+            else r_blocks[0]
+        )
+        return {**s, "q": r_new}
+
+    _bench(scan_of(factor_only), state, R, "factor phase only", results)
+
+    # belief only: gather-sum per degree slot
+    def belief_only(s, k):
+        b = maxsum.belief_from_r(problem, s["r"], unary_t)
+        return {**s, "r": s["r"] + 0.0 * b[:, problem.edge_var]}
+
+    _bench(scan_of(belief_only), state, R, "belief+scatterback only", results)
+
+    # q update only (elementwise on [d, E])
+    def qup_only(s, k):
+        q_new = s["r"] * 0.5 + s["q"]
+        q_new = q_new - jnp.min(q_new, axis=0, keepdims=True)
+        return {**s, "q": q_new}
+
+    _bench(scan_of(qup_only), state, R, "q update only", results)
+
+    # unroll sweep on the full step
+    for unroll in (1, 2, 4, 8):
+        def run(state, unroll=unroll):
+            def f(s, i):
+                return module.step(
+                    problem, s, jax.random.fold_in(key, i), params
+                ), ()
+
+            s, _ = jax.lax.scan(f, state, jnp.arange(R), unroll=unroll)
+            return s
+
+        _bench(run, state, R, f"full step unroll={unroll}", results)
+
+    E = problem.n_real_edges
+    full = results["full step + cost"]
+    print(
+        f"\nmsgs/sec at full-step+cost rate: {2 * E / (full * 1e-6):.3g}"
+    )
+
+    if args.trace:
+        with jax.profiler.trace(args.trace):
+            f = jax.jit(scan_of(step_cost))
+            jax.block_until_ready(f(state))
+        print("trace written to", args.trace)
+
+
+if __name__ == "__main__":
+    main()
