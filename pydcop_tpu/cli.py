@@ -26,6 +26,7 @@ COMMANDS = [
     "replica_dist",
     "orchestrator",
     "agent",
+    "worker",
 ]
 
 

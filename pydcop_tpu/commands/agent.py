@@ -63,9 +63,12 @@ def run_cmd(args) -> int:
         json.dumps(
             {
                 "agent": args.names[0],
-                "cost": result["cost"],
-                "cycle": result["cycle"],
-                "status": result["status"],
+                # elastic-supervisor results carry no cost/cycle (the
+                # orchestrator assembles those); static runs do
+                "cost": result.get("cost"),
+                "cycle": result.get("cycle"),
+                "status": result.get("status"),
+                "deploys": result.get("deploys"),
             }
         )
     )

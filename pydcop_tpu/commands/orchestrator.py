@@ -66,6 +66,13 @@ def set_parser(subparsers) -> None:
         help="serve a live observability feed on this port during "
         "the run (SSE /events + /state, see infrastructure/ui.py)",
     )
+    p.add_argument(
+        "--elastic", action="store_true",
+        help="resilient runtime: survive agent death mid-solve by "
+        "re-forming the cluster on the survivors (dead agents' "
+        "variables migrate to replicas with --ktarget, else freeze "
+        "at their last value) — see infrastructure/elastic.py",
+    )
     p.set_defaults(func=run_cmd)
 
 
@@ -85,6 +92,35 @@ def run_cmd(args) -> int:
     if args.scenario:
         with open(args.scenario) as f:
             scenario_yaml = f.read()
+
+    if args.elastic:
+        from pydcop_tpu.infrastructure.elastic import (
+            run_elastic_orchestrator,
+        )
+
+        if args.scenario:
+            raise SystemExit(
+                "orchestrator: --elastic and --scenario are separate "
+                "dynamics modes (reactive vs scripted); use one"
+            )
+        result = run_elastic_orchestrator(
+            dcop_yaml,
+            args.algo,
+            parse_algo_params(args.algo_params),
+            port=args.port,
+            nb_agents=args.nb_agents,
+            rounds=args.rounds,
+            seed=args.seed,
+            chunk_size=args.chunk_size,
+            timeout=args.timeout,
+            advertise_host=args.advertise_host,
+            heartbeat_timeout=args.heartbeat_timeout,
+            k_target=args.ktarget,
+            ui_port=args.uiport,
+            abort_grace=args.abort_grace,
+        )
+        write_result(args, result)
+        return 0
 
     result = run_orchestrator(
         dcop_yaml,

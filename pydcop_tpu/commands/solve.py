@@ -64,6 +64,11 @@ def set_parser(subparsers) -> None:
         "solving (SSE /events + /state + built-in page, see "
         "infrastructure/ui.py)",
     )
+    p.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="capture a jax.profiler trace of the solve into DIR "
+        "(inspect with tensorboard or xprof)",
+    )
     add_collect_arguments(p)
     p.set_defaults(func=run_cmd)
 
@@ -79,20 +84,34 @@ def run_cmd(args) -> int:
             "(see those commands' --help)"
         )
     params = parse_algo_params(args.algo_params)
-    result = solve(
-        args.dcop_files if len(args.dcop_files) > 1 else args.dcop_files[0],
-        args.algo,
-        params,
-        rounds=args.rounds,
-        timeout=args.timeout,
-        seed=args.seed,
-        convergence_chunks=args.convergence_chunks,
-        checkpoint_path=args.checkpoint,
-        checkpoint_every=args.checkpoint_every,
-        resume=args.resume,
-        mode="batched" if args.mode == "tpu" else args.mode,
-        ui_port=args.uiport,
-    )
+    profile_ctx = None
+    if args.profile:
+        import jax
+
+        profile_ctx = jax.profiler.trace(args.profile)
+        profile_ctx.__enter__()
+    try:
+        result = solve(
+            args.dcop_files
+            if len(args.dcop_files) > 1
+            else args.dcop_files[0],
+            args.algo,
+            params,
+            rounds=args.rounds,
+            timeout=args.timeout,
+            seed=args.seed,
+            convergence_chunks=args.convergence_chunks,
+            checkpoint_path=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            resume=args.resume,
+            mode="batched" if args.mode == "tpu" else args.mode,
+            ui_port=args.uiport,
+        )
+    finally:
+        # flush the trace even when the solve raises — a profile of a
+        # failing run is exactly when you want the data
+        if profile_ctx is not None:
+            profile_ctx.__exit__(None, None, None)
     write_metrics(args, result)
     result.pop("cost_trace", None)  # keep the printed JSON compact
     write_result(args, result)

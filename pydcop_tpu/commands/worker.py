@@ -1,0 +1,29 @@
+"""``pydcop_tpu worker`` — internal: one elastic-runtime SPMD worker.
+
+Spawned by the elastic orchestrator/agent supervisors
+(``infrastructure/elastic.py``); not intended for direct use.  The
+worker connects to the orchestrator's control port, receives its
+epoch's deployment, joins the ``jax.distributed`` cluster, and solves
+in lockstep until the epoch ends (result/halt) or is killed by its
+supervisor at a reform.
+"""
+
+from __future__ import annotations
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "worker",
+        help="internal: elastic-runtime SPMD worker (spawned by the "
+        "elastic orchestrator/agent, see orchestrator --elastic)",
+    )
+    p.add_argument("--orchestrator", required=True, metavar="HOST:PORT")
+    p.add_argument("--epoch", type=int, required=True)
+    p.add_argument("--process-id", type=int, required=True)
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    from pydcop_tpu.infrastructure.elastic import run_worker
+
+    return run_worker(args.orchestrator, args.epoch, args.process_id)

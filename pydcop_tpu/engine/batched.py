@@ -371,7 +371,17 @@ def run_batched(
                 )
                 chunks_since_save = 0
         if chunk_callback is not None and done < rounds:
-            cb_status = chunk_callback(done, float(best_cost))
+            # callbacks marked wants_values also receive the CURRENT
+            # values array (the elastic runtime carries them across
+            # cluster re-forms); the 2-arg form stays the default so
+            # existing callbacks (orchestrator barrier, UI feed) are
+            # untouched
+            if getattr(chunk_callback, "wants_values", False):
+                cb_status = chunk_callback(
+                    done, float(best_cost), np.asarray(state["values"])
+                )
+            else:
+                cb_status = chunk_callback(done, float(best_cost))
             if cb_status is not None:
                 status = cb_status
                 break

@@ -403,6 +403,24 @@ def run_agent(
         deploy = _recv(reader)
         if not deploy or deploy.get("type") != "deploy":
             raise RuntimeError(f"agent {name}: bad deploy message {deploy}")
+        if deploy.get("elastic"):
+            # elastic runtime: this process becomes a worker SUPERVISOR
+            # (spawns/kills SPMD worker subprocesses across reforms).
+            # Supervisors are IDLE between reforms by design — the
+            # read timeout must go or the pump thread mistakes quiet
+            # for orchestrator death and kills its healthy worker
+            from pydcop_tpu.infrastructure.elastic import (
+                elastic_agent_loop,
+            )
+
+            conn.settimeout(None)
+            peer = _Peer("orchestrator", conn, done_evt, reader=reader)
+            try:
+                return elastic_agent_loop(
+                    conn, peer, deploy, name, orchestrator_addr
+                )
+            finally:
+                done_evt.set()
         heartbeat = float(deploy.get("heartbeat_timeout", _TIMEOUT))
         grace = float(deploy.get("abort_grace", 5.0))
 
