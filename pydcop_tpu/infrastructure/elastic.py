@@ -58,6 +58,27 @@ from pydcop_tpu.infrastructure.orchestrator import (
 
 _HEARTBEAT = 120.0
 
+# first barrier of an epoch additionally covers jax import +
+# compile_dcop + the cold XLA compile on every worker — give it at
+# least this much regardless of the configured heartbeat
+_FIRST_BARRIER_MIN = 600.0
+
+
+def _spawn_worker(
+    orchestrator_addr: str, epoch: int, process_id: int
+) -> subprocess.Popen:
+    """The one place the worker subprocess command is built (used by
+    the orchestrator for its local worker and by agent supervisors)."""
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "pydcop_tpu", "worker",
+            "--orchestrator", orchestrator_addr,
+            "--epoch", str(epoch),
+            "--process-id", str(process_id),
+        ],
+        env=dict(os.environ),
+    )
+
 
 # ---------------------------------------------------------------------------
 # orchestrator (supervisor + control plane)
@@ -269,16 +290,7 @@ def run_elastic_orchestrator(
         )
 
     def spawn_local_worker(process_id: int) -> subprocess.Popen:
-        env = dict(os.environ)
-        return subprocess.Popen(
-            [
-                sys.executable, "-m", "pydcop_tpu", "worker",
-                "--orchestrator", f"localhost:{ctrl_port}",
-                "--epoch", str(epoch),
-                "--process-id", str(process_id),
-            ],
-            env=env,
-        )
+        return _spawn_worker(f"localhost:{ctrl_port}", epoch, process_id)
 
     def kill_workers(live: List[_Participant]) -> None:
         for p in live:
@@ -374,9 +386,17 @@ def run_elastic_orchestrator(
 
             # -- barrier loop ----------------------------------------
             completed = 0
+            first_barrier = True
             while failed is None:
                 acks: Dict[int, Dict] = {}
-                bd = time.monotonic() + heartbeat_timeout
+                # the first barrier also covers jax import +
+                # compile_dcop + cold XLA compile on every worker
+                bd = time.monotonic() + (
+                    max(heartbeat_timeout, _FIRST_BARRIER_MIN)
+                    if first_barrier
+                    else heartbeat_timeout
+                )
+                first_barrier = False
                 while len(acks) < num_processes and failed is None:
                     remaining = bd - time.monotonic()
                     if remaining <= 0:
@@ -404,8 +424,8 @@ def run_elastic_orchestrator(
                     break
                 if all(a.get("type") == "result" for a in acks.values()):
                     # epoch solved to completion: cross-check + done
-                    costs = {a["cost"] for a in acks.values()}
-                    if len({round(c, 5) for c in costs}) != 1:
+                    costs = [a["cost"] for a in acks.values()]
+                    if max(costs) - min(costs) > 1e-5:
                         raise AgentFailureError(
                             f"SPMD divergence across workers: {costs}"
                         )
@@ -438,6 +458,25 @@ def run_elastic_orchestrator(
 
             if failed is not None:
                 # -- reform ------------------------------------------
+                if (
+                    timeout is not None
+                    and time.monotonic() - t_start > timeout
+                ):
+                    raise AgentFailureError(
+                        "wall-clock timeout reached during reform"
+                    )
+                reforms = sum(
+                    1 for e in events_log
+                    if e["type"] in ("participant_lost", "worker_crash")
+                )
+                # crash-loop cap: a worker that deterministically dies
+                # before its first barrier would otherwise respawn on
+                # the identical problem forever
+                if reforms >= 2 * (nb_agents + 1):
+                    raise AgentFailureError(
+                        f"giving up after {reforms} reforms "
+                        "(crash-looping worker?)"
+                    )
                 rounds_left = max(1, rounds_left - completed)
                 kill_workers(live)
                 if isinstance(failed, _WorkerOnlyFailure):
@@ -565,14 +604,8 @@ def elastic_agent_loop(conn, peer, first_deploy, name, orchestrator_addr):
         nonlocal worker, deploys
         kill()
         deploys += 1
-        worker = subprocess.Popen(
-            [
-                sys.executable, "-m", "pydcop_tpu", "worker",
-                "--orchestrator", orchestrator_addr,
-                "--epoch", str(msg["epoch"]),
-                "--process-id", str(msg["process_id"]),
-            ],
-            env=dict(os.environ),
+        worker = _spawn_worker(
+            orchestrator_addr, msg["epoch"], msg["process_id"]
         )
 
     def kill():

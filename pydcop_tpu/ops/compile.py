@@ -256,32 +256,15 @@ def compile_dcop(
     k_max = max((len(s) for _, s, _ in multi_cons), default=2)
     k_max = max(k_max, 2)
 
-    # flat form (constraint-major)
-    offsets = np.zeros(n_cons, dtype=np.int32)
-    con_scopes = np.zeros((n_cons, k_max), dtype=np.int32)
-    con_strides = np.zeros((n_cons, k_max), dtype=np.int32)
-    con_stride_list: List[List[int]] = []
-    flat_parts: List[np.ndarray] = []
-    total = 0
-    for ci, (name, scope, table) in enumerate(multi_cons):
-        k = len(scope)
-        offsets[ci] = total
-        strides = [d_max ** (k - 1 - j) for j in range(k)]
-        con_stride_list.append(strides)
-        con_scopes[ci, :k] = scope
-        con_strides[ci, :k] = strides
-        flat_parts.append(table.reshape(-1))
-        total += table.size
-
-    # Edge ids are POSITION-MAJOR within each (shard segment, arity)
-    # run: all position-0 edges of the run's constraints, then all
-    # position-1, …  Max-Sum then reads each bucket position's q as one
-    # contiguous slice and writes r as concatenated blocks — zero
-    # scatters/gathers on the factor side (n_shards=1: whole list is
-    # one segment; shard-major: each shard's sublist is arity-sorted).
-    per_seg = n_cons // max(n_shards, 1) if n_cons else 0
-    edge_order: List[Tuple[int, int]] = []  # (ci, position)
-    for s in range(max(n_shards, 1)):
+    # Contiguous same-arity RUNS per shard segment (constraints are
+    # arity-sorted within each segment, so one run per arity per
+    # segment).  All per-constraint/per-edge packing below works in
+    # numpy blocks over runs — the former per-edge Python loops
+    # dominated compile time beyond ~50k variables.
+    seg_count = max(n_shards, 1)
+    per_seg = n_cons // seg_count if n_cons else 0
+    runs: List[Tuple[int, int, int]] = []  # (ci_start, ci_end, arity)
+    for s in range(seg_count):
         c0, c1 = s * per_seg, (s + 1) * per_seg
         i = c0
         while i < c1:
@@ -289,87 +272,142 @@ def compile_dcop(
             j = i
             while j < c1 and len(multi_cons[j][1]) == k:
                 j += 1
-            for p in range(k):
-                for ci in range(i, j):
-                    edge_order.append((ci, p))
+            runs.append((i, j, k))
             i = j
 
-    edge_rows: List[Tuple[int, int, int, int, List[int], List[int]]] = []
-    # edge_rows: (var, con, offset, stride, covars, costrides)
-    edge_slot_per_con: List[List[int]] = [
-        [0] * len(scope) for _, scope, _ in multi_cons
+    # per-run scope matrices (the one remaining per-constraint pass)
+    run_scopes = [
+        np.asarray(
+            [multi_cons[ci][1] for ci in range(i, j)], dtype=np.int32
+        ).reshape(j - i, k)
+        for i, j, k in runs
     ]
-    for e, (ci, p) in enumerate(edge_order):
-        _, scope, _ = multi_cons[ci]
-        k = len(scope)
-        strides = con_stride_list[ci]
-        covars = [scope[q] for q in range(k) if q != p]
-        costr = [strides[q] for q in range(k) if q != p]
-        edge_rows.append(
-            (scope[p], ci, int(offsets[ci]), strides[p], covars, costr)
+
+    # flat form (constraint-major): offsets/scopes/strides per run
+    offsets = np.zeros(n_cons, dtype=np.int32)
+    con_scopes = np.zeros((n_cons, k_max), dtype=np.int32)
+    con_strides = np.zeros((n_cons, k_max), dtype=np.int32)
+    total = 0
+    for (i, j, k), sc in zip(runs, run_scopes):
+        m = j - i
+        size = d_max**k
+        offsets[i:j] = total + np.arange(m, dtype=np.int64) * size
+        strides = np.array(
+            [d_max ** (k - 1 - q) for q in range(k)], dtype=np.int32
         )
-        edge_slot_per_con[ci][p] = e
-    n_edges = len(edge_rows)
+        con_scopes[i:j, :k] = sc
+        con_strides[i:j, :k] = strides
+        total += m * size
+    flat_parts = [table.reshape(-1) for _, _, table in multi_cons]
     tables_flat = (
         np.concatenate(flat_parts)
         if flat_parts
         else np.zeros(1, dtype=np.float32)
     )
 
+    # Edge ids are POSITION-MAJOR within each (shard segment, arity)
+    # run: all position-0 edges of the run's constraints, then all
+    # position-1, …  Max-Sum then reads each bucket position's q as one
+    # contiguous slice and writes r as concatenated blocks — zero
+    # scatters/gathers on the factor side (n_shards=1: whole list is
+    # one segment; shard-major: each shard's sublist is arity-sorted).
+    n_edges = sum((j - i) * k for i, j, k in runs)
     edge_var = np.zeros(max(n_edges, 1), dtype=np.int32)
     edge_con = np.zeros(max(n_edges, 1), dtype=np.int32)
     edge_offset = np.zeros(max(n_edges, 1), dtype=np.int32)
     edge_stride = np.zeros(max(n_edges, 1), dtype=np.int32)
     edge_covars = np.zeros((max(n_edges, 1), k_max - 1), dtype=np.int32)
     edge_costrides = np.zeros((max(n_edges, 1), k_max - 1), dtype=np.int32)
-    for e, (v, ci, off, st, covars, costr) in enumerate(edge_rows):
-        edge_var[e] = v
-        edge_con[e] = ci
-        edge_offset[e] = off
-        edge_stride[e] = st
-        edge_covars[e, : len(covars)] = covars
-        edge_costrides[e, : len(costr)] = costr
+    run_edge_base = []
+    edge_base = 0
+    for (i, j, k), sc in zip(runs, run_scopes):
+        m = j - i
+        strides = np.array(
+            [d_max ** (k - 1 - q) for q in range(k)], dtype=np.int32
+        )
+        run_edge_base.append(edge_base)
+        for p in range(k):
+            sl = slice(edge_base + p * m, edge_base + (p + 1) * m)
+            edge_var[sl] = sc[:, p]
+            edge_con[sl] = np.arange(i, j, dtype=np.int32)
+            edge_offset[sl] = offsets[i:j]
+            edge_stride[sl] = strides[p]
+            other = [q for q in range(k) if q != p]
+            edge_covars[sl, : k - 1] = sc[:, other]
+            edge_costrides[sl, : k - 1] = strides[other]
+        edge_base += m * k
 
-    # per-variable incoming edge lists (sentinel-padded with n_edges)
-    var_edge_lists: List[List[int]] = [[] for _ in range(n_vars)]
-    for e in range(n_edges):
-        var_edge_lists[int(edge_var[e])].append(e)
-    max_var_deg = max((len(l) for l in var_edge_lists), default=1)
-    max_var_deg = max(max_var_deg, 1)
-    var_edges = np.full((n_vars, max_var_deg), n_edges, dtype=np.int32)
-    for i, lst in enumerate(var_edge_lists):
-        var_edges[i, : len(lst)] = lst
+    # per-variable incoming edge lists (sentinel-padded with n_edges):
+    # stable sort by owner variable = the ascending edge ids the old
+    # append loop produced
+    if n_edges:
+        ev = edge_var[:n_edges]
+        counts = np.bincount(ev, minlength=n_vars)
+        max_var_deg = max(int(counts.max(initial=0)), 1)
+        var_edges = np.full((n_vars, max_var_deg), n_edges, dtype=np.int32)
+        order = np.argsort(ev, kind="stable")
+        ev_sorted = ev[order]
+        starts = np.zeros(n_vars, dtype=np.int64)
+        starts[1:] = np.cumsum(counts)[:-1]
+        rank = np.arange(n_edges, dtype=np.int64) - starts[ev_sorted]
+        var_edges[ev_sorted, rank] = order.astype(np.int32)
+    else:
+        max_var_deg = 1
+        var_edges = np.full((n_vars, 1), n_edges, dtype=np.int32)
 
-    # primal neighbors (padded)
-    neigh_sets: List[set] = [set() for _ in range(n_vars)]
-    for _, scope, _ in multi_cons:
-        for a in scope:
-            for b in scope:
+    # primal neighbors (padded): directed in-scope pairs, value-deduped
+    # (ghost constraints self-reference a variable → dropped by the
+    # a != b value test, as before)
+    pair_parts = []
+    for (i, j, k), sc in zip(runs, run_scopes):
+        for a in range(k):
+            for b in range(k):
                 if a != b:
-                    neigh_sets[a].add(b)
-    max_deg = max((len(s) for s in neigh_sets), default=1)
-    max_deg = max(max_deg, 1)
+                    pair_parts.append(
+                        np.stack([sc[:, a], sc[:, b]], axis=1)
+                    )
+    if pair_parts:
+        pairs = np.concatenate(pair_parts)
+        pairs = pairs[pairs[:, 0] != pairs[:, 1]]
+        pairs = np.unique(pairs, axis=0)  # sorted (var, neighbor)
+    else:
+        pairs = np.zeros((0, 2), dtype=np.int32)
+    ncounts = np.bincount(pairs[:, 0], minlength=n_vars)
+    max_deg = max(int(ncounts.max(initial=0)), 1)
     neighbors = np.zeros((n_vars, max_deg), dtype=np.int32)
     neighbor_mask = np.zeros((n_vars, max_deg), dtype=bool)
-    for i, s in enumerate(neigh_sets):
-        lst = sorted(s)
-        neighbors[i, : len(lst)] = lst
-        neighbor_mask[i, : len(lst)] = True
+    if len(pairs):
+        nstarts = np.zeros(n_vars, dtype=np.int64)
+        nstarts[1:] = np.cumsum(ncounts)[:-1]
+        nrank = np.arange(len(pairs), dtype=np.int64) - nstarts[pairs[:, 0]]
+        neighbors[pairs[:, 0], nrank] = pairs[:, 1]
+        neighbor_mask[pairs[:, 0], nrank] = True
 
-    # arity buckets
+    # arity buckets: concatenate each arity's runs in run order; edge
+    # slots are pure arithmetic on the run layout
     by_arity: Dict[int, List[int]] = {}
-    for ci, (_, scope, _) in enumerate(multi_cons):
-        by_arity.setdefault(len(scope), []).append(ci)
+    for ri, (i, j, k) in enumerate(runs):
+        by_arity.setdefault(k, []).append(ri)
     buckets: Dict[int, ArityBucket] = {}
-    for k, cons in sorted(by_arity.items()):
-        m = len(cons)
-        btables = np.zeros((m,) + (d_max,) * k, dtype=np.float32)
-        bscopes = np.zeros((m, k), dtype=np.int32)
-        bslots = np.zeros((m, k), dtype=np.int32)
-        for bi, ci in enumerate(cons):
-            btables[bi] = multi_cons[ci][2]
-            bscopes[bi] = multi_cons[ci][1]
-            bslots[bi] = edge_slot_per_con[ci]
+    for k, run_ids in sorted(by_arity.items()):
+        tparts, sparts, slparts = [], [], []
+        for ri in run_ids:
+            i, j, _ = runs[ri]
+            m = j - i
+            tparts.append(
+                np.stack([multi_cons[ci][2] for ci in range(i, j)])
+                if m
+                else np.zeros((0,) + (d_max,) * k, dtype=np.float32)
+            )
+            sparts.append(run_scopes[ri])
+            slparts.append(
+                run_edge_base[ri]
+                + np.arange(m, dtype=np.int32)[:, None]
+                + np.arange(k, dtype=np.int32)[None, :] * m
+            )
+        btables = np.concatenate(tparts).astype(np.float32)
+        bscopes = np.concatenate(sparts)
+        bslots = np.concatenate(slparts)
         buckets[k] = ArityBucket(
             tables=jnp.asarray(btables, dtype=dtype),
             tables_t=jnp.asarray(
