@@ -26,19 +26,29 @@ from pydcop_tpu.infrastructure.computations import (
 
 
 class Agent:
-    """Hosts computations and pumps their messages on its own thread."""
+    """Hosts computations and pumps their messages on its own thread.
+
+    Routing goes through a shared :class:`Discovery` directory when one
+    is given (registration/removal events flow to its subscribers, the
+    reference's dynamic-discovery behavior); a plain dict works as the
+    minimal static directory otherwise.
+    """
 
     def __init__(
         self,
         name: str,
         comm: CommunicationLayer,
-        directory: Dict[str, str],
+        directory: Optional[Dict[str, str]] = None,
         on_error: Optional[Callable[[str, BaseException], None]] = None,
+        discovery=None,
     ):
         self.name = name
         self._comm = comm
         # computation name -> agent name, shared by all agents of a run
-        self._directory = directory
+        self._directory = directory if directory is not None else {}
+        self._discovery = discovery
+        if discovery is not None:
+            discovery.register_agent(name)
         self._computations: Dict[str, MessagePassingComputation] = {}
         self.messaging = Messaging(name)
         self._thread: Optional[threading.Thread] = None
@@ -53,14 +63,20 @@ class Agent:
     def deploy_computation(self, comp: MessagePassingComputation) -> None:
         comp.message_sender = self._send
         self._computations[comp.name] = comp
-        self._directory[comp.name] = self.name
+        if self._discovery is not None:
+            self._discovery.register_computation(comp.name, self.name)
+        else:
+            self._directory[comp.name] = self.name
 
     @property
     def computations(self) -> Dict[str, MessagePassingComputation]:
         return dict(self._computations)
 
     def _send(self, src_comp: str, dest_comp: str, msg: Message) -> None:
-        dest_agent = self._directory.get(dest_comp)
+        if self._discovery is not None:
+            dest_agent = self._discovery.computation_agent(dest_comp)
+        else:
+            dest_agent = self._directory.get(dest_comp)
         if dest_agent is None:
             raise UnknownComputation(dest_comp)
         self._comm.send_msg(dest_agent, src_comp, dest_comp, msg, MSG_ALGO)
@@ -82,6 +98,9 @@ class Agent:
         for comp in self._computations.values():
             if comp.is_running:
                 comp.stop()
+        if self._discovery is not None:
+            # publishes computation + agent removal events
+            self._discovery.unregister_agent(self.name)
 
     def join(self, timeout: Optional[float] = None) -> None:
         if self._thread is not None:

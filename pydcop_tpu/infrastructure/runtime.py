@@ -247,15 +247,18 @@ def _run_threads(
         for comp in computations:
             placement.setdefault(f"a_{comp.name}", []).append(comp.name)
 
+    from pydcop_tpu.infrastructure.discovery import Discovery
+
     comm = InProcessCommunicationLayer()
-    directory: Dict[str, str] = {}
+    discovery = Discovery()  # dynamic directory: add/remove events
     by_name = {c.name: c for c in computations}
     errors: List[Tuple[str, BaseException]] = []
     agents = []
     for aname, comp_names in placement.items():
         agent = Agent(
-            aname, comm, directory,
+            aname, comm,
             on_error=lambda comp, e: errors.append((comp, e)),
+            discovery=discovery,
         )
         for cname in comp_names:
             agent.deploy_computation(by_name[cname])
