@@ -111,15 +111,25 @@ def belief_from_r(
     if axis_name is None:
         pad = jnp.zeros((r.shape[0], 1), dtype=r.dtype)
         r_pad = jnp.concatenate([r, pad], axis=1)  # sentinel column
-        # per-slot gather loop.  Measured on the TPU (round-3,
-        # tools/bench_gather.py): all aggregation shapes — this loop,
-        # grouped/flat gathers, row-major gathers, segment_sum — land
-        # within 570-790 us at 10k vars; the gather is element-bound in
-        # the TPU lowering, not launch-bound, so restructuring does not
-        # help and the slot loop is the simplest of the equals.
+        # Per-slot gather loop over PREFIXES: variables are compiled
+        # degree-descending (ops/compile.py), so slot p's real entries
+        # are rows [0, var_slot_counts[p]) — only those are gathered.
+        # The gather is element-bound in the TPU lowering (round-3
+        # tools/bench_gather.py: every aggregation shape costs the
+        # same per element), so shrinking the gathered element count
+        # is the one lever that helps.
+        ve = problem.var_edges
+        n = ve.shape[0]
+        counts = problem.var_slot_counts or (n,) * ve.shape[1]
         acc = unary_t
-        for p in range(problem.var_edges.shape[1]):
-            acc = acc + r_pad[:, problem.var_edges[:, p]]
+        for p in range(ve.shape[1]):
+            n_p = min(counts[p], n)
+            if n_p == 0:
+                break  # later slots are empty too (monotone counts)
+            g = r_pad[:, ve[:n_p, p]]  # [d, n_p]
+            if n_p < n:
+                g = jnp.pad(g, ((0, 0), (0, n - n_p)))
+            acc = acc + g
         return acc
     local = jax.ops.segment_sum(
         r.T, problem.edge_var, num_segments=problem.n_vars

@@ -28,27 +28,27 @@ from pydcop_tpu.infrastructure.computations import (
 class Agent:
     """Hosts computations and pumps their messages on its own thread.
 
-    Routing goes through a shared :class:`Discovery` directory when one
-    is given (registration/removal events flow to its subscribers, the
-    reference's dynamic-discovery behavior); a plain dict works as the
-    minimal static directory otherwise.
+    Routing goes through a shared :class:`Discovery` directory
+    (registration/removal events flow to its subscribers, the
+    reference's dynamic-discovery behavior); a private one is created
+    when none is given.
     """
 
     def __init__(
         self,
         name: str,
         comm: CommunicationLayer,
-        directory: Optional[Dict[str, str]] = None,
         on_error: Optional[Callable[[str, BaseException], None]] = None,
         discovery=None,
     ):
+        if discovery is None:
+            from pydcop_tpu.infrastructure.discovery import Discovery
+
+            discovery = Discovery()
         self.name = name
         self._comm = comm
-        # computation name -> agent name, shared by all agents of a run
-        self._directory = directory if directory is not None else {}
         self._discovery = discovery
-        if discovery is not None:
-            discovery.register_agent(name)
+        discovery.register_agent(name)
         self._computations: Dict[str, MessagePassingComputation] = {}
         self.messaging = Messaging(name)
         self._thread: Optional[threading.Thread] = None
@@ -63,20 +63,14 @@ class Agent:
     def deploy_computation(self, comp: MessagePassingComputation) -> None:
         comp.message_sender = self._send
         self._computations[comp.name] = comp
-        if self._discovery is not None:
-            self._discovery.register_computation(comp.name, self.name)
-        else:
-            self._directory[comp.name] = self.name
+        self._discovery.register_computation(comp.name, self.name)
 
     @property
     def computations(self) -> Dict[str, MessagePassingComputation]:
         return dict(self._computations)
 
     def _send(self, src_comp: str, dest_comp: str, msg: Message) -> None:
-        if self._discovery is not None:
-            dest_agent = self._discovery.computation_agent(dest_comp)
-        else:
-            dest_agent = self._directory.get(dest_comp)
+        dest_agent = self._discovery.computation_agent(dest_comp)
         if dest_agent is None:
             raise UnknownComputation(dest_comp)
         self._comm.send_msg(dest_agent, src_comp, dest_comp, msg, MSG_ALGO)
@@ -94,13 +88,22 @@ class Agent:
             comp.start()
 
     def stop(self) -> None:
+        """Orderly end-of-run stop.  Does NOT unregister from the
+        directory: sibling agent threads may still be draining late
+        in-flight messages addressed to this agent's computations —
+        removal here would turn those sends into UnknownComputation
+        failures during a successful shutdown."""
         self._stop_evt.set()
         for comp in self._computations.values():
             if comp.is_running:
                 comp.stop()
-        if self._discovery is not None:
-            # publishes computation + agent removal events
-            self._discovery.unregister_agent(self.name)
+
+    def leave(self) -> None:
+        """DEPART the system (the dynamic/resilience event): stop and
+        unregister, publishing computation + agent removal events to
+        the directory's subscribers."""
+        self.stop()
+        self._discovery.unregister_agent(self.name)
 
     def join(self, timeout: Optional[float] = None) -> None:
         if self._thread is not None:

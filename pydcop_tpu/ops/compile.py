@@ -135,6 +135,14 @@ class CompiledProblem:
     # directed edges belonging to real (non-ghost-padding) constraints —
     # the auditable message count (BASELINE.md accounting rule)
     n_real_edges: int = dataclasses.field(metadata={"static": True})
+    # per var_edges slot p: how many variables have a REAL edge there.
+    # Variables are compiled in degree-descending order, so column p's
+    # real entries are the prefix [0, var_slot_counts[p]) — Max-Sum's
+    # belief gather reads only that prefix instead of n_vars rows per
+    # slot (the gather is element-bound on TPU, BASELINE.md round 3)
+    var_slot_counts: Tuple[int, ...] = dataclasses.field(
+        metadata={"static": True}, default=()
+    )
 
     # -- derived sizes (host-side helpers, not traced) ------------------
 
@@ -182,6 +190,21 @@ def compile_dcop(
     variables: List[Variable] = list(dcop.variables.values())
     if not variables:
         raise ValueError("Cannot compile a DCOP with no variables")
+    # Compile variables in DEGREE-DESCENDING order (stable): each
+    # variable's incoming-edge count is its appearance count over
+    # multi-variable constraint scopes.  The per-variable edge table
+    # then has the prefix property var_slot_counts documents, halving
+    # the belief-gather volume on low-degree-tailed graphs.  Order is
+    # internal: assignments in/out are keyed by name.
+    _ext = set(dcop.external_variables)
+    _deg: Dict[str, int] = {v.name: 0 for v in variables}
+    for c in dcop.constraints.values():
+        scope_live = [n for n in c.scope_names if n not in _ext]
+        if len(scope_live) >= 2:
+            for n in scope_live:
+                if n in _deg:
+                    _deg[n] += 1
+    variables.sort(key=lambda v: -_deg.get(v.name, 0))
     var_names = tuple(v.name for v in variables)
     var_idx = {n: i for i, n in enumerate(var_names)}
     n_vars = len(variables)
@@ -354,6 +377,23 @@ def compile_dcop(
     else:
         max_var_deg = 1
         var_edges = np.full((n_vars, 1), n_edges, dtype=np.int32)
+    # prefix invariant check: the degree sort above must reproduce the
+    # ACTUAL per-variable edge counts (non-increasing over rows) or the
+    # prefix gather would silently drop real edges — fall back to full
+    # gathers loudly if a future constraint path breaks the invariant
+    _row_deg = (var_edges != n_edges).sum(axis=1)
+    if np.all(_row_deg[:-1] >= _row_deg[1:]):
+        var_slot_counts = tuple(
+            int(x) for x in (var_edges != n_edges).sum(axis=0)
+        )
+    else:  # pragma: no cover — guarded invariant
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "variable degree sort does not match edge counts; belief "
+            "prefix-gather optimization disabled for this problem"
+        )
+        var_slot_counts = ()
 
     # primal neighbors (padded): directed in-scope pairs, value-deduped
     # (ghost constraints self-reference a variable → dropped by the
@@ -441,6 +481,7 @@ def compile_dcop(
         maximize=dcop.objective == "max",
         n_shards=n_shards,
         n_real_edges=n_real_edges,
+        var_slot_counts=var_slot_counts,
     )
 
 

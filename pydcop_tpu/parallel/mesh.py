@@ -81,6 +81,7 @@ def problem_pspecs(problem: CompiledProblem) -> CompiledProblem:
         maximize=problem.maximize,
         n_shards=problem.n_shards,
         n_real_edges=problem.n_real_edges,
+        var_slot_counts=problem.var_slot_counts,
     )
 
 

@@ -179,7 +179,13 @@ def test_neighbor_gather():
     dcop.add_constraint(constraint_from_str("c01", "v0 * v1", vs))
     dcop.add_constraint(constraint_from_str("c12", "v1 * v2", vs))
     problem = compile_dcop(dcop)
-    q = jnp.asarray([10.0, 20.0, 30.0])
+    # q keyed by the COMPILED variable order (the compiler relabels
+    # variables degree-descending; names are the contract)
+    q_host = np.zeros(3, dtype=np.float32)
+    q_host[problem.var_index("v0")] = 10.0
+    q_host[problem.var_index("v1")] = 20.0
+    q_host[problem.var_index("v2")] = 30.0
+    q = jnp.asarray(q_host)
     g = np.asarray(neighbor_gather(problem, q, fill=-1.0))
     i0 = problem.var_index("v0")
     i1 = problem.var_index("v1")
