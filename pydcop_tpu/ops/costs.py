@@ -48,7 +48,29 @@ def segment_sum_edges(
             (1,) + per_edge.shape[1:], dtype=per_edge.dtype
         )
         padded = jnp.concatenate([per_edge, pad], axis=0)
-        return jnp.sum(padded[problem.var_edges], axis=1)
+        ve = problem.var_edges
+        n = ve.shape[0]
+        # per-slot PREFIX gathers: variables are compiled degree-
+        # descending (ops/compile.py var_slot_counts), so slot p's
+        # real entries are rows [0, counts[p]) — gathering only those
+        # cuts the element count from n·max_deg to Σ deg(v).  The
+        # gather is element-bound on TPU (BASELINE.md round 3), so
+        # this is the lever.
+        counts = problem.var_slot_counts or (n,) * ve.shape[1]
+        acc = jnp.zeros(
+            (n,) + per_edge.shape[1:], dtype=per_edge.dtype
+        )
+        for p in range(ve.shape[1]):
+            n_p = min(counts[p], n)
+            if n_p == 0:
+                break  # counts are monotone over slots
+            g = padded[ve[:n_p, p]]
+            if n_p < n:
+                g = jnp.pad(
+                    g, ((0, n - n_p),) + ((0, 0),) * (g.ndim - 1)
+                )
+            acc = acc + g
+        return acc
     out = jax.ops.segment_sum(
         per_edge, problem.edge_var, num_segments=problem.n_vars
     )

@@ -1,0 +1,54 @@
+"""Generate docs/algorithms.md from the algorithm registry.
+
+Usage: python tools/gen_algo_docs.py > docs/algorithms.md
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from pydcop_tpu.algorithms import (  # noqa: E402
+    list_available_algorithms,
+    load_algorithm_module,
+)
+
+print(
+    """# Algorithm reference
+
+Every algorithm is a plugin module under `pydcop_tpu/algorithms/`
+implementing the registry contract (reference
+`pydcop/algorithms/__init__.py` parity): `GRAPH_TYPE`, typed
+`algo_params`, plus the batched contract (`init_state`/`step`) and/or a
+host path (`solve_host` for exact algorithms, `build_computation` for
+the message-driven runtime).  Parameters are passed as
+`-p name:value` on the CLI or an `algo_params` dict in `solve()`.
+
+This page is generated from the registry
+(`python tools/gen_algo_docs.py > docs/algorithms.md`).
+"""
+)
+for name in sorted(list_available_algorithms()):
+    m = load_algorithm_module(name)
+    engines = []
+    if hasattr(m, "step"):
+        engines.append("batched (jit/scan)")
+    if hasattr(m, "solve_host"):
+        engines.append("host exact")
+    if hasattr(m, "build_computation"):
+        engines.append("message-driven host")
+    doc = (m.__doc__ or "").strip().splitlines()[0]
+    print(f"## {name}\n")
+    print(f"{doc}\n")
+    print(f"- graph: `{m.GRAPH_TYPE}` — engines: {', '.join(engines)}")
+    params = getattr(m, "algo_params", [])
+    if params:
+        print("\n| param | type | values | default |")
+        print("|---|---|---|---|")
+        for p in params:
+            vals = ", ".join(map(str, p.values)) if p.values else "—"
+            print(f"| `{p.name}` | {p.type} | {vals} | {p.default} |")
+    print()
