@@ -20,6 +20,14 @@ subprocess probes**:
   (jax_compilation_cache_dir), so a retry of a stage — or the next
   driver round — does not pay that stage's compile again.
 - ``north_star`` — the 10k-var measurement, 300 s budget.
+- ``mid`` — 4k vars, probed ONLY if the north star failed, to localize
+  the breaking scale and give a stronger headline than ``small``.
+
+Attribution inside a stage: the inner process prints ``BENCH_PHASE:``
+markers (imports → problem_built → host_compiled → xla_compiled →
+measured).  On a timeout the parent reads the partial stdout captured
+so far and reports the LAST phase reached, so "timed out" always says
+*where* (e.g. ``at phase=host_compiled`` means XLA compile hung).
 
 Every stage reports ``{stage, ok, seconds, ...}`` into the final JSON
 line's ``stages`` list.  The headline value comes from the deepest
@@ -63,6 +71,17 @@ STAGES = [
 ]
 
 
+_PHASE_T0 = time.perf_counter()
+
+
+def _phase(name: str) -> None:
+    """Progress marker parsed by the parent on timeout (attribution)."""
+    print(
+        f"BENCH_PHASE:{name} t={time.perf_counter() - _PHASE_T0:.1f}",
+        flush=True,
+    )
+
+
 def _measure(n_vars: int, rounds: int, chunk: int) -> dict:
     """Run the workload on whatever backend JAX picks; return metrics."""
     import jax
@@ -74,6 +93,8 @@ def _measure(n_vars: int, rounds: int, chunk: int) -> dict:
     )
     from pydcop_tpu.engine.batched import run_batched
     from pydcop_tpu.ops import compile_dcop
+
+    _phase("imports")
 
     if n_vars == 0:  # init probe: backend up + one tiny device op
         import jax.numpy as jnp
@@ -89,7 +110,9 @@ def _measure(n_vars: int, rounds: int, chunk: int) -> dict:
         }
 
     dcop = g._make_coloring_dcop(n_vars, degree=DEGREE, seed=1)
+    _phase("problem_built")
     problem = compile_dcop(dcop)
+    _phase("host_compiled")
     module = load_algorithm_module("maxsum")
     params = prepare_algo_params({"damping": 0.5}, module.algo_params)
 
@@ -103,6 +126,7 @@ def _measure(n_vars: int, rounds: int, chunk: int) -> dict:
         cost_every=8,
     )
     compile_seconds = time.perf_counter() - t0
+    _phase("xla_compiled")
 
     t0 = time.perf_counter()
     result = run_batched(
@@ -110,11 +134,13 @@ def _measure(n_vars: int, rounds: int, chunk: int) -> dict:
         cost_every=8,
     )
     dt = time.perf_counter() - t0
+    _phase("measured")
     msgs = module.messages_per_round(problem, params) * result.cycles
     return {
         "msgs_per_sec": msgs / dt,
         "platform": jax.devices()[0].platform,
         "best_cost": result.best_cost,
+        "n_vars": int(n_vars),
         "n_edges": int(problem.n_edges),
         "rounds": int(result.cycles),
         "compile_seconds": compile_seconds,
@@ -172,9 +198,20 @@ def _run_sub(
             text=True,
             timeout=timeout,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as exc:
+        # attribute the hang: last BENCH_PHASE marker in the partial
+        # stdout says how far the child got before the clock ran out
+        partial = exc.stdout or b""
+        if isinstance(partial, bytes):
+            partial = partial.decode("utf-8", "replace")
+        last = "none (backend init)"
+        for line in partial.splitlines():
+            if line.startswith("BENCH_PHASE:"):
+                last = line[len("BENCH_PHASE:"):]
         return {
-            "error": f"timed out after {timeout:.0f}s",
+            "error": (
+                f"timed out after {timeout:.0f}s; last phase: {last}"
+            ),
             "seconds": time.perf_counter() - t0,
         }
     out = {"seconds": time.perf_counter() - t0}
@@ -189,6 +226,22 @@ def _run_sub(
     return out
 
 
+def _stage_entry(stage: str, r: dict, ok: bool) -> dict:
+    entry = {
+        "stage": stage,
+        "ok": ok,
+        "seconds": round(r.get("seconds", 0.0), 1),
+    }
+    for k in ("platform", "msgs_per_sec", "compile_seconds", "error"):
+        if k in r:
+            entry[k] = (
+                round(r[k], 1)
+                if isinstance(r[k], float) and k != "msgs_per_sec"
+                else r[k]
+            )
+    return entry
+
+
 def _staged_default_backend() -> tuple:
     """Run the staged probes on the default backend.
 
@@ -196,26 +249,15 @@ def _staged_default_backend() -> tuple:
     """
     report = []
     best = None
+    # post-retry outcome per base stage (the `stage_retry` entries in
+    # `report` carry the attempts; this carries the verdict)
+    final_ok = {}
     for stage, n_vars, rounds, budget in STAGES:
         r = _run_sub(
             pin_cpu=False, timeout=budget, n_vars=n_vars, rounds=rounds
         )
         ok = "error" not in r
-        entry = {
-            "stage": stage,
-            "ok": ok,
-            "seconds": round(r.get("seconds", 0.0), 1),
-        }
-        for k in (
-            "platform", "msgs_per_sec", "compile_seconds", "error"
-        ):
-            if k in r:
-                entry[k] = (
-                    round(r[k], 1)
-                    if isinstance(r[k], float) and k != "msgs_per_sec"
-                    else r[k]
-                )
-        report.append(entry)
+        report.append(_stage_entry(stage, r, ok))
         if not ok:
             # one retry per failing stage: the compile cache makes the
             # second attempt much cheaper if the failure was a slow
@@ -224,18 +266,24 @@ def _staged_default_backend() -> tuple:
                 pin_cpu=False, timeout=budget, n_vars=n_vars, rounds=rounds
             )
             ok = "error" not in r2
-            entry2 = {
-                "stage": stage + "_retry",
-                "ok": ok,
-                "seconds": round(r2.get("seconds", 0.0), 1),
-            }
-            if "error" in r2:
-                entry2["error"] = r2["error"]
-            report.append(entry2)
+            report.append(_stage_entry(stage + "_retry", r2, ok))
             if not ok:
+                final_ok[stage] = False
                 break  # deeper stages would fail the same way
             r = r2
+        final_ok[stage] = True
         if "msgs_per_sec" in r:
+            best = r
+
+    # localization probe: north star failed but 1k worked → try 4k so
+    # the report pins the breaking scale and the headline is stronger
+    if not final_ok.get("north_star", False) and final_ok.get(
+        "small", False
+    ):
+        r = _run_sub(pin_cpu=False, timeout=240.0, n_vars=4_000, rounds=512)
+        ok = "error" not in r
+        report.append(_stage_entry("mid_4k", r, ok))
+        if ok and "msgs_per_sec" in r:
             best = r
     return best, report
 
@@ -260,8 +308,9 @@ def main() -> None:
     # a 10k-var cpu number would be meaningless).  If the default
     # backend already WAS cpu, that run is the baseline.
     base_vars, base_rounds = N_VARS, ROUNDS
-    if dev is not None and dev.get("n_edges", 1 << 30) < 25_000:
-        base_vars, base_rounds = 1_000, 256
+    if dev is not None and dev.get("n_vars", N_VARS) < N_VARS:
+        base_vars = dev["n_vars"]
+        base_rounds = dev.get("rounds", 256)
     if dev is not None and dev.get("platform") == "cpu":
         cpu = dev
     else:
@@ -292,9 +341,11 @@ def main() -> None:
         out["backend"] = headline["platform"]
         out["best_cost"] = headline.get("best_cost")
         # the headline must say when it is NOT the 10k north star
-        # (e.g. only the `small` stage survived on the default backend)
-        if headline.get("n_edges") and headline["n_edges"] < 25_000:
-            out["metric"] = "maxsum_msgs_per_sec_1k_coloring"
+        # (e.g. only the `small`/`mid_4k` stage survived on the
+        # default backend)
+        hv = headline.get("n_vars")
+        if hv and hv < N_VARS:
+            out["metric"] = f"maxsum_msgs_per_sec_{hv // 1000}k_coloring"
     if cpu is not None:
         out["cpu_baseline_msgs_per_sec"] = round(cpu["msgs_per_sec"])
     out["stages"] = stages

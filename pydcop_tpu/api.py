@@ -103,6 +103,80 @@ def solve(
         return module.solve_host(dcop, params, timeout=timeout)
 
     problem = compile_dcop(dcop)
+    return _run_compiled(
+        problem, module, params, rounds=rounds, seed=seed,
+        timeout=timeout, chunk_size=chunk_size,
+        convergence_chunks=convergence_chunks,
+        checkpoint_path=checkpoint_path,
+        checkpoint_every=checkpoint_every, resume=resume,
+        ui_port=ui_port,
+    )
+
+
+def solve_compiled(
+    problem,
+    algo: Union[str, AlgorithmDef],
+    algo_params: Optional[Mapping[str, Any]] = None,
+    rounds: int = 200,
+    timeout: Optional[float] = None,
+    seed: int = 0,
+    convergence_chunks: int = 0,
+    chunk_size: int = 64,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 1,
+    resume: bool = False,
+    ui_port: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Solve an already-compiled problem (same result dict as
+    :func:`solve`).
+
+    The entry point for array-built problems
+    (:func:`pydcop_tpu.ops.compile.compile_from_arrays`) — generated
+    instances beyond ~100k variables skip the Python model layer
+    entirely.  Only batched-engine algorithms apply; exact host-path
+    algorithms (DPOP, SyncBB) need the model/graph objects — use
+    :func:`solve` for those.
+    """
+    if isinstance(algo, AlgorithmDef):
+        algo_name = algo.algo
+        params_in = dict(algo.params)
+        if algo_params:
+            params_in.update(algo_params)
+    else:
+        algo_name = algo
+        params_in = dict(algo_params or {})
+    module = load_algorithm_module(algo_name)
+    if hasattr(module, "solve_host"):
+        raise ValueError(
+            f"{algo_name} runs on the host path and needs the DCOP "
+            "model objects — use solve() instead of solve_compiled()"
+        )
+    params = prepare_algo_params(params_in, module.algo_params)
+    return _run_compiled(
+        problem, module, params, rounds=rounds, seed=seed,
+        timeout=timeout, chunk_size=chunk_size,
+        convergence_chunks=convergence_chunks,
+        checkpoint_path=checkpoint_path,
+        checkpoint_every=checkpoint_every, resume=resume,
+        ui_port=ui_port,
+    )
+
+
+def _run_compiled(
+    problem,
+    module,
+    params: Dict[str, Any],
+    *,
+    rounds: int,
+    seed: int,
+    timeout: Optional[float],
+    chunk_size: int,
+    convergence_chunks: int,
+    checkpoint_path: Optional[str],
+    checkpoint_every: int,
+    resume: bool,
+    ui_port: Optional[int],
+) -> Dict[str, Any]:
     from pydcop_tpu.engine.batched import run_batched
 
     ui = None

@@ -3,6 +3,7 @@ from pydcop_tpu.ops.compile import (
     ArityBucket,
     CompiledProblem,
     compile_dcop,
+    compile_from_arrays,
     decode_assignment,
     encode_assignment,
 )
@@ -18,6 +19,7 @@ __all__ = [
     "ArityBucket",
     "CompiledProblem",
     "compile_dcop",
+    "compile_from_arrays",
     "decode_assignment",
     "encode_assignment",
     "local_cost_sweep",

@@ -276,17 +276,15 @@ def compile_dcop(
 
     con_names = tuple(name for name, _, _ in multi_cons)
     n_cons = len(multi_cons)
-    k_max = max((len(s) for _, s, _ in multi_cons), default=2)
-    k_max = max(k_max, 2)
 
     # Contiguous same-arity RUNS per shard segment (constraints are
     # arity-sorted within each segment, so one run per arity per
-    # segment).  All per-constraint/per-edge packing below works in
-    # numpy blocks over runs — the former per-edge Python loops
+    # segment).  All per-constraint/per-edge packing works in numpy
+    # blocks over runs (see ``_pack_runs``) — per-edge Python loops
     # dominated compile time beyond ~50k variables.
     seg_count = max(n_shards, 1)
     per_seg = n_cons // seg_count if n_cons else 0
-    runs: List[Tuple[int, int, int]] = []  # (ci_start, ci_end, arity)
+    run_bounds: List[Tuple[int, int, int]] = []  # (ci_start, ci_end, k)
     for s in range(seg_count):
         c0, c1 = s * per_seg, (s + 1) * per_seg
         i = c0
@@ -295,35 +293,80 @@ def compile_dcop(
             j = i
             while j < c1 and len(multi_cons[j][1]) == k:
                 j += 1
-            runs.append((i, j, k))
+            run_bounds.append((i, j, k))
             i = j
 
-    # per-run scope matrices (the one remaining per-constraint pass)
-    run_scopes = [
-        np.asarray(
+    # per-run scope matrices + table stacks (the one remaining
+    # per-constraint pass)
+    runs: List[Tuple[int, np.ndarray, np.ndarray]] = []
+    for i, j, k in run_bounds:
+        sc = np.asarray(
             [multi_cons[ci][1] for ci in range(i, j)], dtype=np.int32
         ).reshape(j - i, k)
-        for i, j, k in runs
-    ]
+        tb = (
+            np.stack([multi_cons[ci][2] for ci in range(i, j)])
+            if j > i
+            else np.zeros((0,) + (d_max,) * k, dtype=np.float32)
+        )
+        runs.append((k, sc, tb))
+
+    packed = _pack_runs(runs, n_vars, d_max, dtype)
+
+    return CompiledProblem(
+        domain_sizes=jnp.asarray(domain_sizes),
+        unary=jnp.asarray(unary, dtype=dtype),
+        init_idx=jnp.asarray(init_idx),
+        var_names=var_names,
+        domain_labels=domain_labels,
+        con_names=con_names,
+        maximize=dcop.objective == "max",
+        n_shards=n_shards,
+        n_real_edges=n_real_edges,
+        **packed,
+    )
+
+
+def _pack_runs(
+    runs: Sequence[Tuple[int, np.ndarray, np.ndarray]],
+    n_vars: int,
+    d_max: int,
+    dtype,
+) -> Dict[str, Any]:
+    """Vectorized packing of constraint runs into the flat + edge +
+    bucket arrays of :class:`CompiledProblem`.
+
+    ``runs`` is the constraint list in its final (segment-major,
+    arity-sorted-within-segment) order, as contiguous same-arity runs:
+    ``(k, scopes i32[m, k], tables f32[m, d_max^k])`` — one run per
+    (shard segment, arity).  Returns the keyword dict of every
+    constraint-derived CompiledProblem field.
+    """
+    k_max = max((k for k, _, _ in runs), default=2)
+    k_max = max(k_max, 2)
+    n_cons = sum(sc.shape[0] for _, sc, _ in runs)
 
     # flat form (constraint-major): offsets/scopes/strides per run
     offsets = np.zeros(n_cons, dtype=np.int32)
     con_scopes = np.zeros((n_cons, k_max), dtype=np.int32)
     con_strides = np.zeros((n_cons, k_max), dtype=np.int32)
     total = 0
-    for (i, j, k), sc in zip(runs, run_scopes):
-        m = j - i
+    ci = 0
+    run_con_base = []
+    for k, sc, _ in runs:
+        m = sc.shape[0]
         size = d_max**k
-        offsets[i:j] = total + np.arange(m, dtype=np.int64) * size
+        run_con_base.append(ci)
+        offsets[ci : ci + m] = total + np.arange(m, dtype=np.int64) * size
         strides = np.array(
             [d_max ** (k - 1 - q) for q in range(k)], dtype=np.int32
         )
-        con_scopes[i:j, :k] = sc
-        con_strides[i:j, :k] = strides
+        con_scopes[ci : ci + m, :k] = sc
+        con_strides[ci : ci + m, :k] = strides
         total += m * size
-    flat_parts = [table.reshape(-1) for _, _, table in multi_cons]
+        ci += m
+    flat_parts = [tb.reshape(tb.shape[0], -1) for _, _, tb in runs]
     tables_flat = (
-        np.concatenate(flat_parts)
+        np.concatenate([f.reshape(-1) for f in flat_parts])
         if flat_parts
         else np.zeros(1, dtype=np.float32)
     )
@@ -334,7 +377,7 @@ def compile_dcop(
     # contiguous slice and writes r as concatenated blocks — zero
     # scatters/gathers on the factor side (n_shards=1: whole list is
     # one segment; shard-major: each shard's sublist is arity-sorted).
-    n_edges = sum((j - i) * k for i, j, k in runs)
+    n_edges = sum(sc.shape[0] * k for k, sc, _ in runs)
     edge_var = np.zeros(max(n_edges, 1), dtype=np.int32)
     edge_con = np.zeros(max(n_edges, 1), dtype=np.int32)
     edge_offset = np.zeros(max(n_edges, 1), dtype=np.int32)
@@ -343,8 +386,9 @@ def compile_dcop(
     edge_costrides = np.zeros((max(n_edges, 1), k_max - 1), dtype=np.int32)
     run_edge_base = []
     edge_base = 0
-    for (i, j, k), sc in zip(runs, run_scopes):
-        m = j - i
+    for ri, (k, sc, _) in enumerate(runs):
+        m = sc.shape[0]
+        i = run_con_base[ri]
         strides = np.array(
             [d_max ** (k - 1 - q) for q in range(k)], dtype=np.int32
         )
@@ -352,8 +396,8 @@ def compile_dcop(
         for p in range(k):
             sl = slice(edge_base + p * m, edge_base + (p + 1) * m)
             edge_var[sl] = sc[:, p]
-            edge_con[sl] = np.arange(i, j, dtype=np.int32)
-            edge_offset[sl] = offsets[i:j]
+            edge_con[sl] = np.arange(i, i + m, dtype=np.int32)
+            edge_offset[sl] = offsets[i : i + m]
             edge_stride[sl] = strides[p]
             other = [q for q in range(k) if q != p]
             edge_covars[sl, : k - 1] = sc[:, other]
@@ -399,7 +443,7 @@ def compile_dcop(
     # (ghost constraints self-reference a variable → dropped by the
     # a != b value test, as before)
     pair_parts = []
-    for (i, j, k), sc in zip(runs, run_scopes):
+    for k, sc, _ in runs:
         for a in range(k):
             for b in range(k):
                 if a != b:
@@ -426,20 +470,16 @@ def compile_dcop(
     # arity buckets: concatenate each arity's runs in run order; edge
     # slots are pure arithmetic on the run layout
     by_arity: Dict[int, List[int]] = {}
-    for ri, (i, j, k) in enumerate(runs):
+    for ri, (k, _, _) in enumerate(runs):
         by_arity.setdefault(k, []).append(ri)
     buckets: Dict[int, ArityBucket] = {}
     for k, run_ids in sorted(by_arity.items()):
         tparts, sparts, slparts = [], [], []
         for ri in run_ids:
-            i, j, _ = runs[ri]
-            m = j - i
-            tparts.append(
-                np.stack([multi_cons[ci][2] for ci in range(i, j)])
-                if m
-                else np.zeros((0,) + (d_max,) * k, dtype=np.float32)
-            )
-            sparts.append(run_scopes[ri])
+            _, sc, tb = runs[ri]
+            m = sc.shape[0]
+            tparts.append(tb)
+            sparts.append(sc)
             slparts.append(
                 run_edge_base[ri]
                 + np.arange(m, dtype=np.int32)[:, None]
@@ -457,10 +497,7 @@ def compile_dcop(
             edge_slot=jnp.asarray(bslots),
         )
 
-    return CompiledProblem(
-        domain_sizes=jnp.asarray(domain_sizes),
-        unary=jnp.asarray(unary, dtype=dtype),
-        init_idx=jnp.asarray(init_idx),
+    return dict(
         tables_flat=jnp.asarray(tables_flat, dtype=dtype),
         con_offset=jnp.asarray(offsets),
         con_scopes=jnp.asarray(con_scopes),
@@ -475,13 +512,324 @@ def compile_dcop(
         neighbor_mask=jnp.asarray(neighbor_mask),
         var_edges=jnp.asarray(var_edges),
         buckets=buckets,
-        var_names=var_names,
-        domain_labels=domain_labels,
-        con_names=con_names,
-        maximize=dcop.objective == "max",
+        var_slot_counts=var_slot_counts,
+    )
+
+
+class AutoNames:
+    """Compact, lazily-materialized name sequence for array-built
+    problems: slot ``i`` is named ``f"{prefix}{ids[i]}"`` (``ids`` is
+    the degree-sort permutation — original id order is what callers
+    index by).  O(1) memory instead of a million-string tuple, with a
+    stable hash/eq so it is safe as jit-static CompiledProblem
+    metadata."""
+
+    __slots__ = ("prefix", "ids", "_inv", "_hash")
+
+    def __init__(self, prefix: str, ids: np.ndarray):
+        self.prefix = prefix
+        self.ids = np.asarray(ids)
+        inv = np.empty(len(self.ids), dtype=np.int64)
+        inv[self.ids] = np.arange(len(self.ids))
+        self._inv = inv
+        self._hash = hash((prefix, len(self.ids), self.ids.tobytes()))
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return tuple(
+                f"{self.prefix}{int(j)}" for j in self.ids[i]
+            )
+        return f"{self.prefix}{int(self.ids[i])}"
+
+    def __iter__(self):
+        return (f"{self.prefix}{int(j)}" for j in self.ids)
+
+    def __contains__(self, name) -> bool:
+        try:
+            self.index(name)
+            return True
+        except ValueError:
+            return False
+
+    def index(self, name: str) -> int:
+        if not isinstance(name, str) or not name.startswith(self.prefix):
+            raise ValueError(f"{name!r} is not in names")
+        try:
+            j = int(name[len(self.prefix):])
+        except ValueError:
+            raise ValueError(f"{name!r} is not in names") from None
+        if not 0 <= j < len(self.ids):
+            raise ValueError(f"{name!r} is not in names")
+        return int(self._inv[j])
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, AutoNames):
+            return (
+                self.prefix == other.prefix
+                and np.array_equal(self.ids, other.ids)
+            )
+        if isinstance(other, tuple):
+            return len(other) == len(self) and tuple(self) == other
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return self._hash
+
+    def __repr__(self) -> str:  # compact + content-stable (fingerprint)
+        import hashlib
+
+        digest = hashlib.sha256(self.ids.tobytes()).hexdigest()[:12]
+        return (
+            f"AutoNames({self.prefix!r}, n={len(self.ids)}, ids={digest})"
+        )
+
+
+class UniformLabels:
+    """All ``n`` variables share one label tuple — O(1) stand-in for
+    ``domain_labels`` on uniform-domain array-built problems."""
+
+    __slots__ = ("labels", "n")
+
+    def __init__(self, labels: Tuple[Any, ...], n: int):
+        self.labels = tuple(labels)
+        self.n = n
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return tuple([self.labels] * len(range(*i.indices(self.n))))
+        if not -self.n <= i < self.n:
+            raise IndexError(i)
+        return self.labels
+
+    def __iter__(self):
+        return iter([self.labels] * self.n)
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, UniformLabels):
+            return self.labels == other.labels and self.n == other.n
+        if isinstance(other, tuple):
+            return len(other) == self.n and all(
+                t == self.labels for t in other
+            )
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash((self.labels, self.n))
+
+    def __repr__(self) -> str:
+        return f"UniformLabels({self.labels!r} x {self.n})"
+
+
+def compile_from_arrays(
+    scopes,
+    tables,
+    n_values: int,
+    *,
+    n_vars: Optional[int] = None,
+    unary: Optional[np.ndarray] = None,
+    init_idx: Optional[np.ndarray] = None,
+    domain_values: Optional[Sequence[Any]] = None,
+    maximize: bool = False,
+    n_shards: int = 1,
+    var_prefix: str = "v",
+    con_prefix: str = "c",
+    dtype=jnp.float32,
+) -> CompiledProblem:
+    """Array-level problem construction — the fast path for big
+    generated instances.
+
+    The Python model layer (``DCOP``/``Variable``/``Constraint`` +
+    ``compile_dcop``) costs ~35 s per 100k variables building and
+    tabulating per-constraint Python objects; this entry point builds
+    the identical :class:`CompiledProblem` pytree straight from numpy
+    arrays in well under a second per million edges.  It exists for
+    generators and benchmarks (reference-scale parity: pyDcop's
+    biggest experiments are generated, not hand-written YAML).
+
+    Parameters
+    ----------
+    scopes:
+        ``i32[m, k]`` variable ids per constraint (uniform arity), or a
+        list of such arrays for mixed arities.
+    tables:
+        Cost tables matching ``scopes``: ``f32[(n_values,)*k]`` (one
+        table SHARED by all m constraints) or ``f32[m, (n_values,)*k]``
+        (per-constraint).  A list when ``scopes`` is a list.
+    n_values:
+        Uniform domain size d (every variable shares it).
+    n_vars:
+        Number of variables; default ``max(scopes) + 1``.
+    unary:
+        Optional ``f32[n_vars, n_values]`` value costs in ORIGINAL
+        variable-id order.
+    init_idx:
+        Optional ``i32[n_vars]`` initial value indices (original order).
+    domain_values:
+        Domain labels (default ``range(n_values)``).
+    maximize:
+        Compile a max objective (costs negated internally).
+    n_shards:
+        Shard-major layout over this many mesh shards (ghost-padded
+        per arity, round-robin balanced — same layout contract as
+        :func:`compile_dcop`).
+
+    Variable ``i`` is named ``f"{var_prefix}{i}"``; assignments in and
+    out are keyed by those names exactly as with :func:`compile_dcop`.
+    """
+    if not isinstance(scopes, (list, tuple)):
+        scopes = [scopes]
+        tables = [tables]
+    if len(scopes) != len(tables):
+        raise ValueError("scopes and tables lists must match")
+    scopes = [np.ascontiguousarray(s, dtype=np.int32) for s in scopes]
+    if any(s.ndim != 2 for s in scopes):
+        raise ValueError("each scopes entry must be [m, k]")
+    for s in scopes:
+        if s.shape[1] > MAX_ARITY:
+            raise ValueError(
+                f"arity {s.shape[1]} > MAX_ARITY={MAX_ARITY}"
+            )
+    d = int(n_values)
+    max_id = max((int(s.max()) for s in scopes if s.size), default=-1)
+    min_id = min((int(s.min()) for s in scopes if s.size), default=0)
+    if min_id < 0:
+        raise ValueError(
+            f"scope references negative variable id {min_id}"
+        )
+    if n_vars is None:
+        n_vars = max_id + 1
+    elif max_id >= n_vars:
+        raise ValueError(
+            f"scope references variable {max_id} >= n_vars={n_vars}"
+        )
+    if domain_values is not None and len(domain_values) != d:
+        raise ValueError(
+            f"domain_values has {len(domain_values)} labels, "
+            f"n_values={d}"
+        )
+    sign = -1.0 if maximize else 1.0
+
+    # normalize tables to f32[m, (d,)*k] (shared tables broadcast —
+    # materialized for now; the flat/bucket forms index per constraint)
+    norm_tables: List[np.ndarray] = []
+    for s, t in zip(scopes, tables):
+        m, k = s.shape
+        t = np.asarray(t, dtype=np.float32) * sign
+        if t.shape == (d,) * k:
+            t = np.broadcast_to(t, (m,) + (d,) * k)
+        elif t.shape != (m,) + (d,) * k:
+            raise ValueError(
+                f"table shape {t.shape} matches neither {(d,) * k} "
+                f"nor {(m,) + (d,) * k}"
+            )
+        norm_tables.append(t)
+
+    # degree-descending relabel (same invariant as compile_dcop): slot
+    # order is internal; names carry original ids
+    deg = np.zeros(n_vars, dtype=np.int64)
+    for s in scopes:
+        if s.shape[1] >= 2 and s.size:
+            np.add.at(deg, s.reshape(-1), 1)
+    perm = np.argsort(-deg, kind="stable")  # slot -> original id
+    inv = np.empty(n_vars, dtype=np.int64)
+    inv[perm] = np.arange(n_vars)
+    scopes = [inv[s].astype(np.int32) for s in scopes]
+
+    n_real_edges = sum(s.shape[0] * s.shape[1] for s in scopes)
+
+    # build (segment, arity) runs: shard-major when n_shards > 1 (ghost
+    # padding + round-robin, the _shard_major_layout contract), else
+    # arity-major.  Same-arity entries MUST merge into ONE run — the
+    # factor phase reads each bucket position's q as one contiguous
+    # slice of the whole (segment, arity) group (_pack_runs contract)
+    by_k: Dict[int, Tuple[List[np.ndarray], List[np.ndarray]]] = {}
+    for s, t in zip(scopes, norm_tables):
+        by_k.setdefault(s.shape[1], ([], []))
+        by_k[s.shape[1]][0].append(s)
+        by_k[s.shape[1]][1].append(t)
+    scopes = [np.concatenate(ss) for _, (ss, _) in sorted(by_k.items())]
+    norm_tables = [
+        np.concatenate(ts) for _, (_, ts) in sorted(by_k.items())
+    ]
+    runs: List[Tuple[int, np.ndarray, np.ndarray]] = []
+    auto_con_ids: List[np.ndarray] = []
+    cid_base = 0
+    if n_shards <= 1:
+        for s, t in zip(scopes, norm_tables):
+            runs.append((s.shape[1], s, t))
+            auto_con_ids.append(
+                np.arange(cid_base, cid_base + s.shape[0], dtype=np.int64)
+            )
+            cid_base += s.shape[0]
+    else:
+        import math
+
+        per_shard_parts: List[List[Tuple[np.ndarray, np.ndarray, np.ndarray]]] = [
+            [] for _ in range(n_shards)
+        ]
+        for s, t in zip(scopes, norm_tables):
+            m, k = s.shape
+            tgt = math.ceil(m / n_shards) * n_shards
+            if tgt > m:  # ghost constraints: scope 0s, zero table
+                s = np.concatenate(
+                    [s, np.zeros((tgt - m, k), dtype=np.int32)]
+                )
+                t = np.concatenate(
+                    [t, np.zeros((tgt - m,) + (d,) * k, dtype=np.float32)]
+                )
+            ids = np.arange(cid_base, cid_base + tgt, dtype=np.int64)
+            cid_base += tgt
+            for sh in range(n_shards):
+                per_shard_parts[sh].append(
+                    (s[sh::n_shards], t[sh::n_shards], ids[sh::n_shards])
+                )
+        for sh in range(n_shards):
+            for s, t, ids in per_shard_parts[sh]:
+                runs.append((s.shape[1], s, t))
+                auto_con_ids.append(ids)
+
+    packed = _pack_runs(runs, n_vars, d, dtype)
+
+    # unary / init in original id order -> slot order
+    if unary is None:
+        unary_np = np.zeros((n_vars, d), dtype=np.float32)
+    else:
+        unary_np = np.asarray(unary, dtype=np.float32) * sign
+        if unary_np.shape != (n_vars, d):
+            raise ValueError(
+                f"unary shape {unary_np.shape} != {(n_vars, d)}"
+            )
+        unary_np = unary_np[perm]
+    if init_idx is None:
+        init_np = np.zeros(n_vars, dtype=np.int32)
+    else:
+        init_np = np.asarray(init_idx, dtype=np.int32)[perm]
+
+    labels = tuple(
+        domain_values if domain_values is not None else range(d)
+    )
+    con_ids = (
+        np.concatenate(auto_con_ids)
+        if auto_con_ids
+        else np.zeros(0, dtype=np.int64)
+    )
+    return CompiledProblem(
+        domain_sizes=jnp.full(n_vars, d, dtype=jnp.int32),
+        unary=jnp.asarray(unary_np, dtype=dtype),
+        init_idx=jnp.asarray(init_np),
+        var_names=AutoNames(var_prefix, perm),
+        domain_labels=UniformLabels(labels, n_vars),
+        con_names=AutoNames(con_prefix, con_ids),
+        maximize=maximize,
         n_shards=n_shards,
         n_real_edges=n_real_edges,
-        var_slot_counts=var_slot_counts,
+        **packed,
     )
 
 

@@ -220,3 +220,175 @@ def test_arity_guard():
     )
     with pytest.raises(ValueError, match="MAX_ARITY"):
         compile_dcop(dcop)
+
+
+# -- compile_from_arrays: the array-level fast path ---------------------
+
+
+def _uniform_dcop_and_arrays(seed=7, n_vars=20, n_bin=28, d=3):
+    """The same problem built both ways: model objects for
+    ``compile_dcop`` and raw arrays for ``compile_from_arrays``."""
+    rnd = random.Random(seed)
+    dom = Domain("colors", "", list(range(d)))
+    dcop = DCOP("parity")
+    vs = [Variable(f"v{i}", dom) for i in range(n_vars)]
+    for v in vs:
+        dcop.add_variable(v)
+    scopes = []
+    seen = set()
+    cid = 0
+    table = np.round(
+        np.random.RandomState(seed).uniform(0, 10, (d, d)), 2
+    ).astype(np.float32)
+    while len(scopes) < n_bin:
+        a, b = rnd.sample(range(n_vars), 2)
+        if (a, b) in seen:
+            continue
+        seen.add((a, b))
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[a], vs[b]], table, name=f"c{cid}")
+        )
+        scopes.append((a, b))
+        cid += 1
+    unary = np.round(
+        np.random.RandomState(seed + 1).uniform(0, 1, (n_vars, d)), 3
+    ).astype(np.float32)
+    for i, v in enumerate(vs):
+        for k in range(d):
+            dcop.add_constraint(
+                constraint_from_str(
+                    f"u{i}_{k}",
+                    f"{float(unary[i, k])!r} if v{i} == {k} else 0",
+                    [vs[i]],
+                )
+            )
+    return dcop, np.asarray(scopes, dtype=np.int32), table, unary
+
+
+def test_from_arrays_matches_compile_dcop():
+    from pydcop_tpu.ops.compile import compile_from_arrays
+
+    dcop, scopes, table, unary = _uniform_dcop_and_arrays()
+    p_model = compile_dcop(dcop)
+    p_array = compile_from_arrays(scopes, table, 3, unary=unary)
+
+    # identical slot ordering (same degree-sort invariant) ...
+    assert tuple(p_array.var_names) == p_model.var_names
+    assert p_array.var_slot_counts == p_model.var_slot_counts
+    # ... and identical array fields
+    for field in (
+        "domain_sizes", "unary", "init_idx", "tables_flat",
+        "con_offset", "con_scopes", "con_strides", "edge_var",
+        "edge_con", "edge_offset", "edge_stride", "edge_covars",
+        "edge_costrides", "neighbors", "neighbor_mask", "var_edges",
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(p_array, field)),
+            np.asarray(getattr(p_model, field)),
+            err_msg=field,
+        )
+    for k in p_model.buckets:
+        np.testing.assert_array_equal(
+            np.asarray(p_array.buckets[k].tables),
+            np.asarray(p_model.buckets[k].tables),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p_array.buckets[k].edge_slot),
+            np.asarray(p_model.buckets[k].edge_slot),
+        )
+
+    # assignment round-trip agrees across the two name objects
+    rnd = random.Random(0)
+    assign = rand_assignment(dcop, rnd)
+    np.testing.assert_array_equal(
+        np.asarray(encode_assignment(p_array, assign)),
+        np.asarray(encode_assignment(p_model, assign)),
+    )
+    c_a = float(total_cost(p_array, encode_assignment(p_array, assign)))
+    c_m = float(total_cost(p_model, encode_assignment(p_model, assign)))
+    assert c_a == pytest.approx(c_m, abs=1e-4)
+
+
+def test_from_arrays_sharded_layout():
+    from pydcop_tpu.ops.compile import compile_from_arrays
+
+    dcop, scopes, table, unary = _uniform_dcop_and_arrays(n_bin=26)
+    p1 = compile_from_arrays(scopes, table, 3, unary=unary)
+    p4 = compile_from_arrays(scopes, table, 3, unary=unary, n_shards=4)
+    # ghost-padded to equal per-shard buckets; real edge count unchanged
+    assert p4.n_shards == 4
+    assert p4.n_real_edges == p1.n_real_edges == 2 * len(scopes)
+    assert p4.n_cons % 4 == 0
+    # cost parity between layouts
+    rnd = random.Random(1)
+    assign = rand_assignment(dcop, rnd)
+    c1 = float(total_cost(p1, encode_assignment(p1, assign)))
+    c4 = float(total_cost(p4, encode_assignment(p4, assign)))
+    assert c1 == pytest.approx(c4, abs=1e-4)
+
+
+def test_from_arrays_maxsum_runs():
+    from pydcop_tpu.api import solve_compiled
+    from pydcop_tpu.ops.compile import compile_from_arrays
+
+    _, scopes, table, unary = _uniform_dcop_and_arrays()
+    p = compile_from_arrays(scopes, table, 3, unary=unary)
+    res = solve_compiled(p, algo="maxsum", rounds=40, seed=0)
+    assert set(res["assignment"]) == set(p.var_names)
+    assert res["cost"] < BIG
+
+
+def test_from_arrays_shared_vs_stacked_tables_equal():
+    from pydcop_tpu.ops.compile import compile_from_arrays
+
+    _, scopes, table, unary = _uniform_dcop_and_arrays()
+    stacked = np.broadcast_to(
+        table, (scopes.shape[0],) + table.shape
+    ).copy()
+    p_shared = compile_from_arrays(scopes, table, 3, unary=unary)
+    p_stacked = compile_from_arrays(scopes, stacked, 3, unary=unary)
+    np.testing.assert_array_equal(
+        np.asarray(p_shared.tables_flat), np.asarray(p_stacked.tables_flat)
+    )
+
+
+def test_from_arrays_merges_same_arity_groups():
+    """Two same-arity scope groups must land in ONE (segment, arity)
+    run: the Max-Sum factor phase reads each bucket position's q as a
+    contiguous slice of the whole arity group (code-review r3)."""
+    from pydcop_tpu.api import solve_compiled
+    from pydcop_tpu.ops.compile import compile_from_arrays
+
+    _, scopes, table, unary = _uniform_dcop_and_arrays()
+    half = scopes.shape[0] // 2
+    # identical problem, passed as two same-arity groups (one shared
+    # table, one stacked)
+    stacked_tail = np.broadcast_to(
+        table, (scopes.shape[0] - half,) + table.shape
+    ).copy()
+    p_split = compile_from_arrays(
+        [scopes[:half], scopes[half:]], [table, stacked_tail], 3,
+        unary=unary,
+    )
+    p_whole = compile_from_arrays(scopes, table, 3, unary=unary)
+    np.testing.assert_array_equal(
+        np.asarray(p_split.edge_var), np.asarray(p_whole.edge_var)
+    )
+    r_split = solve_compiled(p_split, "maxsum", rounds=40, seed=0)
+    r_whole = solve_compiled(p_whole, "maxsum", rounds=40, seed=0)
+    assert r_split["cost"] == pytest.approx(r_whole["cost"], abs=1e-4)
+
+
+def test_from_arrays_rejects_bad_input():
+    from pydcop_tpu.ops.compile import compile_from_arrays
+
+    table = np.eye(3, dtype=np.float32)
+    with pytest.raises(ValueError, match="negative"):
+        compile_from_arrays(
+            np.array([[0, -1]], dtype=np.int32), table, 3
+        )
+    with pytest.raises(ValueError, match="domain_values"):
+        compile_from_arrays(
+            np.array([[0, 1]], dtype=np.int32), table, 3,
+            domain_values=["a", "b"],
+        )

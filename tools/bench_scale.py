@@ -1,0 +1,100 @@
+"""Scaling benchmark: Max-Sum msgs/sec at 10k / 100k / 1M variables.
+
+Source of BASELINE.md's "North star + scaling" table.  Problems are
+built through the array fast path (ops/generate.py +
+compile_from_arrays) so host-side construction stays negligible at
+1M variables; the measured window is solver-only (compile warms up out
+of band), identical to bench.py's methodology (chunked scans,
+cost_every=8, logical-message accounting per BASELINE.md).
+
+Usage:  python tools/bench_scale.py [--pin-cpu] [--sizes 10000 ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(n_vars: int, rounds: int, chunk: int, degree: int = 3) -> dict:
+    import jax
+
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_batched
+    from pydcop_tpu.ops.compile import compile_from_arrays
+    from pydcop_tpu.ops.generate import coloring_arrays
+
+    t0 = time.perf_counter()
+    scopes, table, unary = coloring_arrays(
+        n_vars, colors=3, degree=degree, seed=1
+    )
+    t_gen = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    problem = compile_from_arrays(scopes, table, 3, unary=unary)
+    t_compile_host = time.perf_counter() - t0
+
+    module = load_algorithm_module("maxsum")
+    params = prepare_algo_params({"damping": 0.5}, module.algo_params)
+    t0 = time.perf_counter()
+    run_batched(
+        problem, module, params, rounds=chunk, seed=0, chunk_size=chunk,
+        cost_every=8,
+    )
+    t_warm = time.perf_counter() - t0  # XLA compile + one chunk's run
+    t0 = time.perf_counter()
+    r = run_batched(
+        problem, module, params, rounds=rounds, seed=0, chunk_size=chunk,
+        cost_every=8,
+    )
+    dt = time.perf_counter() - t0
+    msgs = module.messages_per_round(problem, params) * r.cycles
+    return {
+        "n_vars": n_vars,
+        "n_edges": int(problem.n_real_edges),
+        "platform": jax.devices()[0].platform,
+        "msgs_per_sec": round(msgs / dt),
+        "best_cost": round(float(r.best_cost), 2),
+        "rounds": int(r.cycles),
+        "gen_seconds": round(t_gen, 2),
+        "host_compile_seconds": round(t_compile_host, 2),
+        # warmup = XLA compile + chunk execution; subtract the steady
+        # per-round time to estimate the pure compile cost
+        "warmup_seconds": round(t_warm, 1),
+        "xla_compile_est_seconds": round(
+            max(t_warm - dt * chunk / max(r.cycles, 1), 0.0), 1
+        ),
+        "run_seconds": round(dt, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pin-cpu", action="store_true")
+    ap.add_argument(
+        "--sizes", type=int, nargs="*", default=[10_000, 100_000, 1_000_000]
+    )
+    ap.add_argument("--rounds", type=int, default=None)
+    args = ap.parse_args()
+    if args.pin_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    for n in args.sizes:
+        # fewer rounds at the largest scales: the steady state is
+        # reached quickly and the measured window stays ~constant
+        rounds = args.rounds or (1024 if n <= 100_000 else 256)
+        chunk = min(256, rounds)
+        print(json.dumps(measure(n, rounds, chunk)), flush=True)
+
+
+if __name__ == "__main__":
+    main()
