@@ -69,7 +69,9 @@ def init_state(
         "values": init_values(problem, key, params)
     }
     for k, bucket in sorted(problem.buckets.items()):
-        m = bucket.tables.shape[0]
+        # weights are per CONSTRAINT even when the bucket shares one
+        # base table (bucket.n_cons, not tables.shape[0])
+        m = bucket.n_cons
         d = problem.d_max
         state[f"w{k}"] = jnp.full(
             (m, d**k), init_w, dtype=problem.unary.dtype
@@ -93,8 +95,13 @@ def step(
     # -- per-bucket: effective sweep rows + raw violation flags ---------
     per_bucket = {}  # k -> (eff_flat, cur_cell, violated, vals)
     for k, bucket in sorted(problem.buckets.items()):
-        m = bucket.tables.shape[0]
-        base_flat = bucket.tables.reshape(m, d**k)
+        m = bucket.n_cons
+        # shared-table buckets broadcast the one base row over all m
+        # constraints (XLA fuses the broadcast into the consumers)
+        base_flat = jnp.broadcast_to(
+            bucket.tables.reshape(bucket.tables.shape[0], d**k),
+            (m, d**k),
+        )
         w = state[f"w{k}"]
         eff_flat = base_flat + w if additive else base_flat * w
 
@@ -128,7 +135,7 @@ def step(
     for seg in range(n_segments):
         for k, bucket in sorted(problem.buckets.items()):
             eff_flat, cur_cell, violated, vals = per_bucket[k]
-            m = bucket.tables.shape[0] // n_segments
+            m = bucket.n_cons // n_segments
             rows = slice(seg * m, (seg + 1) * m)
             strides = _bucket_strides(k, d)
             for p in range(k):
@@ -181,7 +188,7 @@ def step(
     new_state: Dict[str, jax.Array] = {"values": new_values}
     for k, bucket in sorted(problem.buckets.items()):
         _, cur_cell, violated, vals = per_bucket[k]
-        m = bucket.tables.shape[0]
+        m = bucket.n_cons
         strides = _bucket_strides(k, d)
         w = state[f"w{k}"]
         qlm_scope = qlm[bucket.scopes]  # [m, k] bool

@@ -180,16 +180,28 @@ def step(
     off = 0
     for seg in range(n_segments):
         for k, bucket in sorted(problem.buckets.items()):
-            m = bucket.tables_t.shape[-1] // n_segments
-            tab = bucket.tables_t[..., seg * m : (seg + 1) * m]
+            m = bucket.n_cons // n_segments
+            # shared-table bucket: ONE [d, ..., d, 1] table broadcasts
+            # over all m constraints (coloring-style instances — saves
+            # d^k·m floats of HBM traffic per round)
+            tab = (
+                bucket.tables_t
+                if bucket.shared_table
+                else bucket.tables_t[..., seg * m : (seg + 1) * m]
+            )
             q_pos = [
                 q[:, off + p * m : off + (p + 1) * m]  # [d, m]
                 for p in range(k)
             ]
             if use_fused:  # k == 2 by the use_fused condition
-                r0, r1 = pallas_maxsum.factor_round_binary(
-                    tab, q_pos[0], q_pos[1]
-                )
+                if bucket.shared_table:
+                    r0, r1 = pallas_maxsum.factor_round_binary_shared(
+                        tab[..., 0], q_pos[0], q_pos[1]
+                    )
+                else:
+                    r0, r1 = pallas_maxsum.factor_round_binary(
+                        tab, q_pos[0], q_pos[1]
+                    )
                 r_blocks.append(jnp.concatenate([r0, r1], axis=1))
                 off += m * k
                 continue

@@ -140,6 +140,59 @@ def factor_round_binary(
     return r0[:, :m], r1[:, :m]
 
 
+def _factor_kernel_shared(d: int, tab_ref, q0_ref, q1_ref, r0_ref, r1_ref):
+    # Same math as _factor_kernel with the ONE shared [d, d] table in
+    # SMEM: tab[a, b] is a scalar broadcast over the lane block, so the
+    # kernel never streams table data from HBM at all.
+    m0 = [None] * d
+    m1 = [None] * d
+    for a in range(d):
+        qa = q0_ref[a : a + 1, :]  # [1, BLK]
+        for b in range(d):
+            s = tab_ref[a, b] + qa + q1_ref[b : b + 1, :]
+            m0[a] = s if m0[a] is None else jnp.minimum(m0[a], s)
+            m1[b] = s if m1[b] is None else jnp.minimum(m1[b], s)
+    r0 = jnp.concatenate(m0, axis=0) - q0_ref[:]  # [d, BLK]
+    r1 = jnp.concatenate(m1, axis=0) - q1_ref[:]
+    r0_ref[:] = r0 - jnp.min(r0, axis=0, keepdims=True)
+    r1_ref[:] = r1 - jnp.min(r1, axis=0, keepdims=True)
+
+
+def factor_round_binary_shared(
+    tab: jax.Array,  # f32[d, d] — ONE table shared by all m factors
+    q0: jax.Array,  # f32[d, m]
+    q1: jax.Array,  # f32[d, m]
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Binary factor phase when every factor shares one cost table
+    (shared-table arity buckets — see ops/compile.py ``_pack_runs``)."""
+    d, m = q0.shape
+    blk = _blk_for(d, m)
+    mp = ((m + blk - 1) // blk) * blk
+    q0_p = _pad_lanes(q0, mp)
+    q1_p = _pad_lanes(q1, mp)
+    grid = (mp // blk,)
+    q_spec = pl.BlockSpec((d, blk), lambda i: (0, i))
+    r0, r1 = pl.pallas_call(
+        functools.partial(_factor_kernel_shared, d),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (d, d), lambda i: (0, 0), memory_space=pltpu.SMEM
+            ),
+            q_spec,
+            q_spec,
+        ],
+        out_specs=[q_spec, q_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((d, mp), q0.dtype),
+            jax.ShapeDtypeStruct((d, mp), q0.dtype),
+        ],
+        interpret=interpret,
+    )(tab, q0_p, q1_p)
+    return r0[:, :m], r1[:, :m]
+
+
 def _qup_kernel(be_ref, r_ref, q_ref, damp_ref, out_ref):
     qn = be_ref[:] - r_ref[:]
     qn = qn - jnp.min(qn, axis=0, keepdims=True)

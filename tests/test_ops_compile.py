@@ -270,7 +270,13 @@ def test_from_arrays_matches_compile_dcop():
 
     dcop, scopes, table, unary = _uniform_dcop_and_arrays()
     p_model = compile_dcop(dcop)
-    p_array = compile_from_arrays(scopes, table, 3, unary=unary)
+    # stacked (per-constraint) tables: byte-identical layout with the
+    # model path; the deduplicated shared layout has its own parity
+    # test (test_from_arrays_shared_vs_stacked_tables_equal)
+    stacked = np.broadcast_to(
+        table, (scopes.shape[0],) + table.shape
+    ).copy()
+    p_array = compile_from_arrays(scopes, stacked, 3, unary=unary)
 
     # identical slot ordering (same degree-sort invariant) ...
     assert tuple(p_array.var_names) == p_model.var_names
@@ -339,17 +345,39 @@ def test_from_arrays_maxsum_runs():
 
 
 def test_from_arrays_shared_vs_stacked_tables_equal():
+    """A shared table is stored ONCE (flat + bucket) yet every cost
+    and every algorithm result matches the per-constraint layout."""
+    from pydcop_tpu.api import solve_compiled
     from pydcop_tpu.ops.compile import compile_from_arrays
 
-    _, scopes, table, unary = _uniform_dcop_and_arrays()
-    stacked = np.broadcast_to(
-        table, (scopes.shape[0],) + table.shape
-    ).copy()
+    dcop, scopes, table, unary = _uniform_dcop_and_arrays()
+    m = scopes.shape[0]
+    stacked = np.broadcast_to(table, (m,) + table.shape).copy()
     p_shared = compile_from_arrays(scopes, table, 3, unary=unary)
     p_stacked = compile_from_arrays(scopes, stacked, 3, unary=unary)
-    np.testing.assert_array_equal(
-        np.asarray(p_shared.tables_flat), np.asarray(p_stacked.tables_flat)
-    )
+    # deduplicated storage...
+    assert p_shared.tables_flat.shape[0] == table.size
+    assert p_shared.buckets[2].shared_table
+    assert p_shared.buckets[2].tables.shape[0] == 1
+    assert p_shared.buckets[2].n_cons == m
+    assert not p_stacked.buckets[2].shared_table
+    # ...identical semantics: costs and algorithm runs agree exactly
+    rnd = random.Random(3)
+    for _ in range(5):
+        a = rand_assignment(dcop, rnd)
+        c_sh = float(total_cost(p_shared, encode_assignment(p_shared, a)))
+        c_st = float(total_cost(p_stacked, encode_assignment(p_stacked, a)))
+        assert c_sh == pytest.approx(c_st, abs=1e-5)
+    for algo, params in (
+        ("maxsum", None),
+        ("dsa", {"variant": "B"}),
+        ("gdba", None),
+        ("mgm", None),
+    ):
+        r_sh = solve_compiled(p_shared, algo, params, rounds=30, seed=0)
+        r_st = solve_compiled(p_stacked, algo, params, rounds=30, seed=0)
+        assert r_sh["cost"] == pytest.approx(r_st["cost"], abs=1e-4), algo
+        assert r_sh["assignment"] == r_st["assignment"], algo
 
 
 def test_from_arrays_merges_same_arity_groups():

@@ -60,3 +60,24 @@ def test_q_update_matches_xla(rng, d, e):
 def test_fused_step_disabled_on_cpu():
     # the CPU test backend must take the XLA path automatically
     assert not pallas_maxsum.available()
+
+
+@pytest.mark.parametrize("d,m", [(3, 257), (2, 64), (5, 1000)])
+def test_factor_round_binary_shared_matches_xla(rng, d, m):
+    """Shared-table kernel (one [d, d] table in SMEM) must agree with
+    the broadcast XLA phase bit-for-bit."""
+    tab2 = jnp.asarray(rng.rand(d, d).astype(np.float32) * 10)
+    q0 = jnp.asarray(rng.rand(d, m).astype(np.float32))
+    q1 = jnp.asarray(rng.rand(d, m).astype(np.float32))
+
+    s = tab2.reshape(d, d, 1) + q0.reshape(d, 1, m) + q1.reshape(1, d, m)
+    ref0 = jnp.min(s, axis=1) - q0
+    ref0 = ref0 - jnp.min(ref0, axis=0, keepdims=True)
+    ref1 = jnp.min(s, axis=0) - q1
+    ref1 = ref1 - jnp.min(ref1, axis=0, keepdims=True)
+
+    r0, r1 = pallas_maxsum.factor_round_binary_shared(
+        tab2, q0, q1, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(r0), np.asarray(ref0))
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(ref1))

@@ -99,7 +99,9 @@ def main():
         r_blocks = []
         off = 0
         for kk, bucket in sorted(problem.buckets.items()):
-            m = bucket.tables_t.shape[-1]
+            # n_cons, NOT tables_t.shape[-1]: shared-table buckets
+            # hold one table for n_cons constraints
+            m = bucket.n_cons
             q_pos = [q[:, off + p * m : off + (p + 1) * m] for p in range(kk)]
             ss = bucket.tables_t
             for p in range(kk):
