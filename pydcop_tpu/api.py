@@ -36,6 +36,7 @@ def solve(
     resume: bool = False,
     mode: str = "batched",
     ui_port: Optional[int] = None,
+    n_restarts: int = 1,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -72,6 +73,11 @@ def solve(
                 "ui_port (live observability) is only supported on "
                 f"the batched engine, not mode={mode!r}"
             )
+        if n_restarts != 1:
+            raise ValueError(
+                "n_restarts (batched parallel restarts) is only "
+                f"supported on the batched engine, not mode={mode!r}"
+            )
         from pydcop_tpu.infrastructure import solve_host
 
         return solve_host(
@@ -100,6 +106,12 @@ def solve(
                 f"{algo_name}: checkpoint/resume is only supported on "
                 "the batched engine, not host-path (exact) algorithms"
             )
+        if n_restarts != 1:
+            raise ValueError(
+                f"{algo_name} is an exact host-path algorithm — "
+                "n_restarts (best-of-K for stochastic solvers) does "
+                "not apply"
+            )
         return module.solve_host(dcop, params, timeout=timeout)
 
     problem = compile_dcop(dcop)
@@ -109,7 +121,7 @@ def solve(
         convergence_chunks=convergence_chunks,
         checkpoint_path=checkpoint_path,
         checkpoint_every=checkpoint_every, resume=resume,
-        ui_port=ui_port,
+        ui_port=ui_port, n_restarts=n_restarts,
     )
 
 
@@ -126,6 +138,7 @@ def solve_compiled(
     checkpoint_every: int = 1,
     resume: bool = False,
     ui_port: Optional[int] = None,
+    n_restarts: int = 1,
 ) -> Dict[str, Any]:
     """Solve an already-compiled problem (same result dict as
     :func:`solve`).
@@ -158,7 +171,7 @@ def solve_compiled(
         convergence_chunks=convergence_chunks,
         checkpoint_path=checkpoint_path,
         checkpoint_every=checkpoint_every, resume=resume,
-        ui_port=ui_port,
+        ui_port=ui_port, n_restarts=n_restarts,
     )
 
 
@@ -176,6 +189,7 @@ def _run_compiled(
     checkpoint_every: int,
     resume: bool,
     ui_port: Optional[int],
+    n_restarts: int = 1,
 ) -> Dict[str, Any]:
     from pydcop_tpu.engine.batched import run_batched
 
@@ -200,6 +214,7 @@ def _run_compiled(
             checkpoint_every=checkpoint_every,
             resume=resume,
             chunk_callback=chunk_callback,
+            n_restarts=n_restarts,
         )
         if ui is not None:  # final event carries the assignment
             ui.publish(

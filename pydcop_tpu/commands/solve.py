@@ -69,6 +69,12 @@ def set_parser(subparsers) -> None:
         help="capture a jax.profiler trace of the solve into DIR "
         "(inspect with tensorboard or xprof)",
     )
+    p.add_argument(
+        "--restarts", type=int, default=1,
+        help="run this many independent solver instances batched in "
+        "one device program (vmap) and report the best — parallel "
+        "restarts for stochastic algorithms",
+    )
     add_collect_arguments(p)
     p.set_defaults(func=run_cmd)
 
@@ -106,6 +112,7 @@ def run_cmd(args) -> int:
             resume=args.resume,
             mode="batched" if args.mode == "tpu" else args.mode,
             ui_port=args.uiport,
+            n_restarts=args.restarts,
         )
     finally:
         # flush the trace even when the solve raises — a profile of a

@@ -68,6 +68,7 @@ def _chunk_runner(
     n_rounds: int,
     axis_name: Optional[str] = None,
     cost_every: int = 1,
+    cost_fn: Optional[Callable] = None,
 ) -> Callable:
     """Build the scan over ``n_rounds`` rounds.
 
@@ -81,8 +82,26 @@ def _chunk_runner(
     not per agent cycle.  Per-round RNG streams are unchanged: the key
     for round ``i`` of a chunk is ``fold_in(chunk_key, i)`` regardless
     of the sampling structure.
+
+    ``cost_fn(problem, values)`` overrides the cost evaluation (the
+    multi-restart engine passes a vmapped one; ``best_cost`` is then
+    per-restart ``[R]`` and ``best_values`` ``[R, n]`` — the selection
+    below broadcasts over both layouts).
     """
     unroll = _default_unroll()
+    if cost_fn is None:
+        def cost_fn(problem, values):
+            return total_cost(problem, values, axis_name)
+
+    def _track_best(problem, state, best_cost, best_values):
+        values = state["values"]
+        cost = cost_fn(problem, values)
+        better = cost < best_cost
+        best_cost = jnp.where(better, cost, best_cost)
+        # scalar: better[..., None] is [1], broadcasts over [n];
+        # per-restart: [R, 1] over [R, n]
+        best_values = jnp.where(better[..., None], values, best_values)
+        return best_cost, best_values, cost
 
     def run_chunk(problem, state, key, params, best_cost, best_values):
         def rounds_span(state, start, count):
@@ -107,11 +126,9 @@ def _chunk_runner(
         def sample_fn(carry, j):
             state, best_cost, best_values = carry
             state = rounds_span(state, j * cost_every, cost_every)
-            values = state["values"]
-            cost = total_cost(problem, values, axis_name)
-            better = cost < best_cost
-            best_cost = jnp.where(better, cost, best_cost)
-            best_values = jnp.where(better, values, best_values)
+            best_cost, best_values, cost = _track_best(
+                problem, state, best_cost, best_values
+            )
             return (state, best_cost, best_values), cost
 
         n_outer, rem = divmod(n_rounds, cost_every)
@@ -134,11 +151,9 @@ def _chunk_runner(
         if rem:  # tail rounds of a chunk not divisible by cost_every
             state, best_cost, best_values = carry
             state = rounds_span(state, n_outer * cost_every, rem)
-            values = state["values"]
-            cost = total_cost(problem, values, axis_name)
-            better = cost < best_cost
-            best_cost = jnp.where(better, cost, best_cost)
-            best_values = jnp.where(better, values, best_values)
+            best_cost, best_values, cost = _track_best(
+                problem, state, best_cost, best_values
+            )
             carry = (state, best_cost, best_values)
             costs_parts.append(cost[None])
         state, best_cost, best_values = carry
@@ -167,6 +182,7 @@ def run_batched(
     resume: bool = False,
     chunk_callback: Optional[Callable[[int, float], Optional[str]]] = None,
     cost_every: int = 1,
+    n_restarts: int = 1,
 ) -> RunResult:
     """Run a batched algorithm for up to ``rounds`` rounds.
 
@@ -204,9 +220,33 @@ def run_batched(
     cross-process orchestrator uses this as its lockstep control point
     so every ``jax.distributed`` process stops at the same boundary
     (a wall-clock check per process would diverge).
+
+    ``n_restarts > 1`` runs that many INDEPENDENT solver instances
+    (distinct RNG streams, same problem) inside every jitted step via
+    ``vmap`` and reports the best across them — batched parallel
+    restarts.  This is the reference's "run the stochastic algorithm K
+    times, keep the best" experiment loop collapsed into one device
+    program: on accelerators small problems are launch-bound, so K
+    restarts cost barely more wall-clock than one.  The cost trace
+    carries the per-sample minimum across restarts; ``msg_count``
+    counts all restarts' messages (K independent runs).  Incompatible
+    with ``mesh`` and checkpointing for now.
     """
     t0 = time.perf_counter()
     sign = -1.0 if problem.maximize else 1.0
+
+    if n_restarts < 1:
+        raise ValueError(f"n_restarts must be >= 1, got {n_restarts}")
+    batched_restarts = n_restarts > 1
+    if batched_restarts and mesh is not None:
+        raise ValueError(
+            "n_restarts > 1 cannot be combined with a mesh (vmap over "
+            "restarts + shard_map over edges is not wired up)"
+        )
+    if batched_restarts and (checkpoint_path is not None or resume):
+        raise ValueError(
+            "n_restarts > 1 does not support checkpoint/resume yet"
+        )
 
     fingerprint = None
     if checkpoint_path is not None:
@@ -230,11 +270,31 @@ def run_batched(
         axis_name = SHARD_AXIS
         problem = shard_problem(problem, mesh)
 
-    def algo_step(problem, state, key, dyn):
-        return algo_module.step(
-            problem, state, key, {**static_params, **dyn},
-            axis_name=axis_name,
-        )
+    if batched_restarts:
+        restart_ids = jnp.arange(n_restarts)
+
+        def algo_step(problem, state, key, dyn):
+            keys = jax.vmap(
+                lambda i: jax.random.fold_in(key, i)
+            )(restart_ids)
+            return jax.vmap(
+                lambda s, k: algo_module.step(
+                    problem, s, k, {**static_params, **dyn},
+                    axis_name=None,
+                ),
+                in_axes=(0, 0),
+            )(state, keys)
+
+        def cost_fn(problem, values):
+            return jax.vmap(lambda v: total_cost(problem, v))(values)
+    else:
+        cost_fn = None
+
+        def algo_step(problem, state, key, dyn):
+            return algo_module.step(
+                problem, state, key, {**static_params, **dyn},
+                axis_name=axis_name,
+            )
 
     cache_key_base = (
         algo_module.__name__,
@@ -245,15 +305,24 @@ def run_batched(
         tuple(sorted(problem.buckets)),  # pspecs structure
         problem.n_shards,
         cost_every,
+        n_restarts,
     )
 
     key = jax.random.PRNGKey(seed)
     k_init, k_run = jax.random.split(key)
-    state = algo_module.init_state(
-        problem, k_init, {**static_params, **{k: params[k] for k in dyn_params}}
-    )
-    best_values = state["values"]
-    best_cost = total_cost(problem, best_values)
+    init_params = {
+        **static_params, **{k: params[k] for k in dyn_params}
+    }
+    if batched_restarts:
+        state = jax.vmap(
+            lambda k: algo_module.init_state(problem, k, init_params)
+        )(jax.random.split(k_init, n_restarts))
+        best_values = state["values"]  # [R, n]
+        best_cost = cost_fn(problem, best_values)  # [R]
+    else:
+        state = algo_module.init_state(problem, k_init, init_params)
+        best_values = state["values"]
+        best_cost = total_cost(problem, best_values)
 
     resumed_rounds = 0
     if resume and checkpoint_path is not None:
@@ -296,11 +365,14 @@ def run_batched(
             best_cost = jnp.asarray(bc, dtype=best_cost.dtype)
             best_values = jnp.asarray(bv, dtype=best_values.dtype)
 
+    def _best_scalar(bc) -> float:
+        return float(jnp.min(bc)) if batched_restarts else float(bc)
+
     def make_runner(n: int):
         cache_key = cache_key_base + (n,)
         if cache_key in _RUNNER_CACHE:
             return _RUNNER_CACHE[cache_key]
-        fn = _chunk_runner(algo_step, n, axis_name, cost_every)
+        fn = _chunk_runner(algo_step, n, axis_name, cost_every, cost_fn)
         if mesh is None:
             runner = jax.jit(fn)
         else:
@@ -338,7 +410,7 @@ def run_batched(
     status = "finished"
     stall = 0
     chunks_since_save = 0
-    prev_best = float(best_cost)
+    prev_best = _best_scalar(best_cost)
     prev_values = np.asarray(best_values)
     while done < rounds:
         this_chunk = min(chunk_size, rounds - done)
@@ -352,7 +424,10 @@ def run_batched(
         state, best_cost, best_values, costs = r(
             problem, state, k_chunk, dyn_params, best_cost, best_values
         )
-        traces.append(np.asarray(costs))
+        costs_np = np.asarray(costs)
+        if batched_restarts:
+            costs_np = costs_np.min(axis=-1)
+        traces.append(costs_np)
         done += this_chunk
         if checkpoint_path is not None:
             chunks_since_save += 1
@@ -378,10 +453,11 @@ def run_batched(
             # untouched
             if getattr(chunk_callback, "wants_values", False):
                 cb_status = chunk_callback(
-                    done, float(best_cost), np.asarray(state["values"])
+                    done, _best_scalar(best_cost),
+                    np.asarray(state["values"]),
                 )
             else:
-                cb_status = chunk_callback(done, float(best_cost))
+                cb_status = chunk_callback(done, _best_scalar(best_cost))
             if cb_status is not None:
                 status = cb_status
                 break
@@ -391,7 +467,7 @@ def run_batched(
         if convergence_chunks:
             cur_values = np.asarray(state["values"])
             if (
-                float(best_cost) >= prev_best - 1e-9
+                _best_scalar(best_cost) >= prev_best - 1e-9
                 and np.array_equal(cur_values, prev_values)
             ):
                 stall += 1
@@ -400,7 +476,7 @@ def run_batched(
                     break
             else:
                 stall = 0
-            prev_best = float(best_cost)
+            prev_best = _best_scalar(best_cost)
             prev_values = cur_values
 
     if checkpoint_path is not None and chunks_since_save:
@@ -418,15 +494,29 @@ def run_batched(
         )
 
     final_values = state["values"]
-    final_cost = float(total_cost(problem, final_values))
+    if batched_restarts:
+        # report the best restart: final = lowest final cost, anytime
+        # best = lowest best-seen cost across all restarts
+        final_costs = cost_fn(problem, final_values)
+        i_fin = int(jnp.argmin(final_costs))
+        final_values = final_values[i_fin]
+        final_cost = float(final_costs[i_fin])
+        i_best = int(jnp.argmin(best_cost))
+        best_values = best_values[i_best]
+        best_cost_f = float(best_cost[i_best])
+    else:
+        final_cost = float(total_cost(problem, final_values))
+        best_cost_f = float(best_cost)
     elapsed = time.perf_counter() - t0
-    msgs = algo_module.messages_per_round(problem, params) * done
+    msgs = (
+        algo_module.messages_per_round(problem, params) * done * n_restarts
+    )
     trace = np.concatenate(traces) if traces else np.zeros(0)
     return RunResult(
         assignment=decode_assignment(problem, final_values),
         cost=sign * final_cost,
         best_assignment=decode_assignment(problem, best_values),
-        best_cost=sign * float(best_cost),
+        best_cost=sign * best_cost_f,
         cycles=done,
         messages=msgs,
         time=elapsed,
