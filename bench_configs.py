@@ -118,7 +118,7 @@ def _gen_meeting_10k():
     )
 
 
-def _run_batched_config(dcop, algo, params, rounds, chunk):
+def _run_batched_config(dcop, algo, params, rounds, chunk, n_restarts=1):
     import jax
 
     from pydcop_tpu.algorithms import (
@@ -135,16 +135,16 @@ def _run_batched_config(dcop, algo, params, rounds, chunk):
     # cost_every=8 matches bench.py (sampled anytime-cost tracking)
     run_batched(
         problem, module, full, rounds=chunk, seed=0, chunk_size=chunk,
-        cost_every=8,
+        cost_every=8, n_restarts=n_restarts,
     )
     t0 = time.perf_counter()
     r = run_batched(
         problem, module, full, rounds=rounds, seed=0, chunk_size=chunk,
-        cost_every=8,
+        cost_every=8, n_restarts=n_restarts,
     )
     dt = time.perf_counter() - t0
-    msgs = module.messages_per_round(problem, full) * r.cycles
-    return {
+    msgs = r.messages  # counts all restarts' messages (K full runs)
+    out = {
         "platform": jax.devices()[0].platform,
         "msgs_per_sec": round(msgs / dt),
         "best_cost": round(float(r.best_cost), 4),
@@ -153,6 +153,9 @@ def _run_batched_config(dcop, algo, params, rounds, chunk):
         "n_edges": int(problem.n_real_edges),
         "seconds": round(dt, 3),
     }
+    if n_restarts > 1:
+        out["restarts"] = n_restarts
+    return out
 
 
 def _run_dpop_config(dcop):
@@ -203,6 +206,11 @@ def main() -> None:
     ap.add_argument("--pin-cpu", action="store_true")
     ap.add_argument("--only", type=int, nargs="*", default=None)
     ap.add_argument("--markdown", action="store_true")
+    ap.add_argument(
+        "--restarts", type=int, default=1,
+        help="batched parallel restarts for the local-search/message "
+        "configs (best-of-K; msgs/sec covers all K runs)",
+    )
     args = ap.parse_args()
     if args.pin_cpu:
         import jax
@@ -218,7 +226,10 @@ def main() -> None:
         if algo == "dpop":
             res = _run_dpop_config(dcop)
         else:
-            res = _run_batched_config(dcop, algo, params, rounds, chunk)
+            res = _run_batched_config(
+                dcop, algo, params, rounds, chunk,
+                n_restarts=args.restarts,
+            )
         res = {"config": num, "name": name, **res}
         rows.append(res)
         print(json.dumps(res), flush=True)

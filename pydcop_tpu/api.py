@@ -238,4 +238,9 @@ def _run_compiled(
         "status": result.status,
         "time": result.time,
         "cost_trace": result.cost_trace.tolist(),
+        **(
+            {"restart_costs": result.restart_costs.tolist()}
+            if result.restart_costs is not None
+            else {}
+        ),
     }

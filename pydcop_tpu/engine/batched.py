@@ -42,6 +42,9 @@ class RunResult:
     time: float  # wall-clock seconds (incl. compile)
     status: str  # 'finished' | 'timeout' | 'converged'
     cost_trace: np.ndarray  # per-round cost (native sign)
+    # per-restart best costs (native sign) when n_restarts > 1 — the
+    # K-sample distribution behind the reported best (None otherwise)
+    restart_costs: Optional[np.ndarray] = None
 
 
 # Compiled chunk runners, reused across run_batched calls so repeated
@@ -229,8 +232,11 @@ def run_batched(
     program: on accelerators small problems are launch-bound, so K
     restarts cost barely more wall-clock than one.  The cost trace
     carries the per-sample minimum across restarts; ``msg_count``
-    counts all restarts' messages (K independent runs).  Incompatible
-    with ``mesh`` and checkpointing for now.
+    counts all restarts' messages (K independent runs);
+    ``convergence_chunks`` judges the across-restart BEST cost only
+    (requiring all K instances to freeze would disable early stop).
+    Incompatible with ``mesh``, checkpointing, and ``wants_values``
+    callbacks (elastic runtime) for now.
     """
     t0 = time.perf_counter()
     sign = -1.0 if problem.maximize else 1.0
@@ -246,6 +252,12 @@ def run_batched(
     if batched_restarts and (checkpoint_path is not None or resume):
         raise ValueError(
             "n_restarts > 1 does not support checkpoint/resume yet"
+        )
+    if batched_restarts and getattr(chunk_callback, "wants_values", False):
+        raise ValueError(
+            "n_restarts > 1 cannot feed a wants_values chunk_callback "
+            "(the elastic runtime expects per-variable [n] values, not "
+            "the [K, n] restart stack)"
         )
 
     fingerprint = None
@@ -466,10 +478,13 @@ def run_batched(
             break
         if convergence_chunks:
             cur_values = np.asarray(state["values"])
-            if (
-                _best_scalar(best_cost) >= prev_best - 1e-9
-                and np.array_equal(cur_values, prev_values)
-            ):
+            # multi-restart: requiring ALL K instances to freeze would
+            # effectively disable early stop (one mover blocks it), so
+            # convergence is judged on the across-restart best alone
+            frozen = batched_restarts or np.array_equal(
+                cur_values, prev_values
+            )
+            if _best_scalar(best_cost) >= prev_best - 1e-9 and frozen:
                 stall += 1
                 if stall >= convergence_chunks:
                     status = "converged"
@@ -494,6 +509,7 @@ def run_batched(
         )
 
     final_values = state["values"]
+    restart_costs = None
     if batched_restarts:
         # report the best restart: final = lowest final cost, anytime
         # best = lowest best-seen cost across all restarts
@@ -502,6 +518,7 @@ def run_batched(
         final_values = final_values[i_fin]
         final_cost = float(final_costs[i_fin])
         i_best = int(jnp.argmin(best_cost))
+        restart_costs = sign * np.asarray(best_cost)
         best_values = best_values[i_best]
         best_cost_f = float(best_cost[i_best])
     else:
@@ -522,4 +539,5 @@ def run_batched(
         time=elapsed,
         status=status,
         cost_trace=sign * trace,
+        restart_costs=restart_costs,
     )

@@ -453,6 +453,10 @@ def test_multi_restart_best_of():
         total_cost(p, encode_assignment(p, r8["final_assignment"]))
     )
     assert cf == pytest.approx(r8["final_cost"], abs=1e-4)
+    # the K-sample distribution is exposed; its min IS the reported best
+    assert len(r8["restart_costs"]) == 8
+    assert min(r8["restart_costs"]) == pytest.approx(r8["cost"], abs=1e-5)
+    assert "restart_costs" not in r1
 
 
 def test_multi_restart_rejects_checkpoint_and_mesh():

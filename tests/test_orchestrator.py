@@ -107,3 +107,55 @@ def test_orchestrator_agent_matches_inprocess(tmp_path):
         mesh=make_mesh(2),
     )
     np.testing.assert_allclose(local.best_cost, result["cost"], atol=1e-5)
+
+
+def test_orchestrator_three_processes(tmp_path):
+    """N > 2 control-plane scaling: 1 orchestrator + 2 agent processes
+    form a 3-way SPMD mesh; all three report the identical cost."""
+    yaml_file = tmp_path / "ring.yaml"
+    yaml_file.write_text(_ring_yaml())
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYDCOP_TPU_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+    port = 9420 + (os.getpid() % 180)
+    orch = subprocess.Popen(
+        [
+            sys.executable, "-m", "pydcop_tpu", "orchestrator",
+            str(yaml_file), "-a", "maxsum", "--port", str(port),
+            "--nb_agents", "2", "--rounds", "24", "--chunk_size", "8",
+            "--seed", "7",
+        ],
+        env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    time.sleep(0.5)
+    agents = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu", "agent",
+                "--names", name, "--orchestrator", f"localhost:{port}",
+            ],
+            env=env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for name in ("a1", "a2")
+    ]
+    try:
+        orc_out, orc_err = orch.communicate(timeout=180)
+        assert orch.returncode == 0, orc_err[-3000:]
+        result = _parse_json_tail(orc_out)
+        assert result["n_shards"] == 3
+        assert result["num_processes"] == 3
+        assert sorted(result["agents"]) == ["a1", "a2"]
+        for a in agents:
+            a_out, a_err = a.communicate(timeout=30)
+            assert a.returncode == 0, a_err[-3000:]
+            assert _parse_json_tail(a_out)["cost"] == result["cost"]
+    finally:  # never orphan the subprocesses on a timeout/assert
+        for proc in [orch, *agents]:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
