@@ -74,9 +74,31 @@ STATIC_STATE_KEYS = frozenset(
 )
 
 
+# Pair-accumulator cells (n_vars * max_degree * d^2) above which MGM-2
+# warns: beyond this the [P, d, d] tensors rebuilt each round dominate
+# memory/bandwidth (hub degree O(sqrt n) on scale-free graphs blows
+# P = n * max_degree up quadratically) — prefer MGM or a
+# degree-capping distribution there.
+PAIR_CELLS_WARN = 1 << 27  # 512 MB of f32
+
+
 def init_state(
     problem: CompiledProblem, key: jax.Array, params: Dict[str, Any]
 ) -> Dict[str, jax.Array]:
+    pair_cells = (
+        problem.n_vars * problem.max_degree * problem.d_max**2
+    )
+    if pair_cells > PAIR_CELLS_WARN:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "MGM-2 pair accumulator needs %d cells "
+            "(n_vars=%d x max_degree=%d x d^2=%d, ~%.1f GB of f32) — "
+            "on high-degree graphs prefer MGM or cap hub degree via "
+            "the distribution layer",
+            pair_cells, problem.n_vars, problem.max_degree,
+            problem.d_max**2, pair_cells * 4 / 1e9,
+        )
     values = init_values(problem, key, params)
     pe_e, pe_p, pe_q, pe_valid, pe_inv = _pair_index(problem)
     return {

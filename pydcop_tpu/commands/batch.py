@@ -11,6 +11,12 @@ Finished runs are skipped when the output CSV already contains their
 key, giving crude experiment-level resume (same behavior the reference
 gets by skipping existing output files).
 
+``--vmap_iterations`` collapses each (problem, parameter-combination)
+cell's iterations into ONE vmapped multi-restart solve (engine
+``n_restarts``) — the TPU-idiomatic way to run repetition sweeps: K
+iterations at roughly one run's wall-clock, one row per iteration from
+the per-restart cost distribution.
+
 Spec format::
 
     sets:
@@ -71,6 +77,20 @@ def set_parser(subparsers) -> None:
     p.add_argument(
         "--simulate", action="store_true",
         help="list the runs without executing them",
+    )
+    p.add_argument(
+        "--vmap_iterations", action="store_true",
+        help="solve all iterations of a (problem, params) cell as ONE "
+        "vmapped multi-restart run (engine n_restarts) — K iterations "
+        "at roughly one run's wall-clock on accelerators.  Each "
+        "iteration's row gets its own restart's cost; RNG streams "
+        "differ from sequential per-seed runs (both are valid "
+        "independent samples, but rows are not bit-reproducible "
+        "across the two modes).  Applies only to plain fixed-round "
+        "cells; cells with timeout/convergence_chunks (early stops "
+        "would truncate non-best restarts), partially-done cells, "
+        "host-path algorithms, single-iteration cells, and cells "
+        "whose vmapped solve fails all fall back to sequential runs",
     )
     p.set_defaults(func=run_cmd)
 
@@ -154,6 +174,26 @@ def _run_key(batch, set_, problem, iteration, algo, params, base_dir) -> Tuple:
     )
 
 
+def _write_row(writer, run, result, base_dir) -> None:
+    batch, set_, problem, it, algo, params, _ = run
+    key = _run_key(batch, set_, problem, it, algo, params, base_dir)
+    writer.writerow(
+        {
+            "batch": key[0],
+            "set": key[1],
+            "problem": key[2],
+            "iteration": key[3],
+            "algo": key[4],
+            "params": key[5],
+            "status": result["status"],
+            "cost": result["cost"],
+            "cycle": result["cycle"],
+            "msg_count": result["msg_count"],
+            "time": result["time"],
+        }
+    )
+
+
 def run_cmd(args) -> int:
     import yaml
 
@@ -216,50 +256,95 @@ def run_cmd(args) -> int:
 
     from pydcop_tpu.api import solve
 
+    # group consecutive runs that differ only in `iteration` (the
+    # innermost loop of iter_runs): each group is one sweep cell
+    cells: List[List[Tuple]] = []
+    for run in runs:
+        if cells and cells[-1][0][:3] + cells[-1][0][4:] == run[:3] + run[4:]:
+            cells[-1].append(run)
+        else:
+            cells.append([run])
+
+    def _vmappable(algo: str) -> bool:
+        from pydcop_tpu.algorithms import load_algorithm_module
+
+        try:
+            return not hasattr(load_algorithm_module(algo), "solve_host")
+        except Exception:
+            return False
+
     executed = skipped = failed = 0
     with open(args.result_file, "a", newline="") as f:
         writer = csv.DictWriter(f, fieldnames=CSV_FIELDS)
         if not exists:
             writer.writeheader()
-        for batch, set_, problem, it, algo, params, options in runs:
-            key = _run_key(batch, set_, problem, it, algo, params, base_dir)
-            if key in done:
-                skipped += 1
+        for cell in cells:
+            batch, set_, problem, _, algo, params, options = cell[0]
+            pending = [
+                run for run in cell
+                if _run_key(
+                    run[0], run[1], run[2], run[3], run[4], run[5],
+                    base_dir,
+                ) not in done
+            ]
+            skipped += len(cell) - len(pending)
+            if not pending:
                 continue
-            try:
-                result = solve(
-                    problem,
-                    algo,
-                    params,
-                    rounds=int(options.get("rounds", 200)),
-                    timeout=options.get("timeout"),
-                    seed=it,
-                    chunk_size=int(options.get("chunk_size", 64)),
-                    convergence_chunks=int(
-                        options.get("convergence_chunks", 0)
-                    ),
-                )
-            except Exception as e:  # record the failure, keep sweeping
-                failed += 1
-                result = {"status": f"error: {e}", "cost": "", "cycle": "",
-                          "msg_count": "", "time": ""}
-            writer.writerow(
-                {
-                    "batch": key[0],
-                    "set": key[1],
-                    "problem": key[2],
-                    "iteration": key[3],
-                    "algo": key[4],
-                    "params": key[5],
-                    "status": result["status"],
-                    "cost": result["cost"],
-                    "cycle": result["cycle"],
-                    "msg_count": result["msg_count"],
-                    "time": result["time"],
-                }
+            common = dict(
+                rounds=int(options.get("rounds", 200)),
+                timeout=options.get("timeout"),
+                chunk_size=int(options.get("chunk_size", 64)),
+                convergence_chunks=int(
+                    options.get("convergence_chunks", 0)
+                ),
             )
-            f.flush()
-            executed += 1
+            # vmap only plain fixed-round cells: a shared timeout or a
+            # best-judged convergence stop would truncate the non-best
+            # restarts mid-descent, biasing their cost rows vs what
+            # the same spec records sequentially
+            if (
+                args.vmap_iterations
+                and len(pending) == len(cell)  # whole cell fresh
+                and len(cell) > 1
+                and common["timeout"] is None
+                and common["convergence_chunks"] == 0
+                and _vmappable(algo)
+            ):
+                try:
+                    result = solve(
+                        problem, algo, params, seed=0,
+                        n_restarts=len(cell), **common,
+                    )
+                    for i, run in enumerate(cell):
+                        _write_row(writer, run, {
+                            "status": result["status"],
+                            "cost": result["restart_costs"][i],
+                            "cycle": result["cycle"],
+                            # per-iteration share of the cell's totals
+                            "msg_count": result["msg_count"] // len(cell),
+                            "time": round(result["time"] / len(cell), 6),
+                        }, base_dir)
+                        executed += 1
+                    f.flush()
+                    continue
+                except Exception:
+                    # e.g. the K-fold state OOMs where one run fits —
+                    # fall through to the sequential per-run loop
+                    # rather than condemning the whole cell
+                    pass
+            for run in pending:
+                it = run[3]
+                try:
+                    result = solve(problem, algo, params, seed=it, **common)
+                except Exception as e:  # record failure, keep sweeping
+                    failed += 1
+                    result = {
+                        "status": f"error: {e}", "cost": "", "cycle": "",
+                        "msg_count": "", "time": "",
+                    }
+                _write_row(writer, run, result, base_dir)
+                f.flush()
+                executed += 1
     print(
         json.dumps(
             {

@@ -477,13 +477,16 @@ def run_batched(
             status = "timeout"
             break
         if convergence_chunks:
-            cur_values = np.asarray(state["values"])
             # multi-restart: requiring ALL K instances to freeze would
             # effectively disable early stop (one mover blocks it), so
-            # convergence is judged on the across-restart best alone
-            frozen = batched_restarts or np.array_equal(
-                cur_values, prev_values
-            )
+            # convergence is judged on the across-restart best alone —
+            # and the [K, n] values stack never crosses to the host
+            if batched_restarts:
+                frozen = True
+                cur_values = prev_values
+            else:
+                cur_values = np.asarray(state["values"])
+                frozen = np.array_equal(cur_values, prev_values)
             if _best_scalar(best_cost) >= prev_best - 1e-9 and frozen:
                 stall += 1
                 if stall >= convergence_chunks:

@@ -98,3 +98,36 @@ def test_consolidate_merge_and_aggregate(tmp_path):
     assert len(rows) == 2
     assert all(row["n_runs"] == "4" for row in rows)
     assert all(float(row["cost"]) >= 0 for row in rows)
+
+
+def test_batch_vmap_iterations(tmp_path):
+    """--vmap_iterations solves each (problem, params) cell's
+    iterations as one multi-restart run: same row count and key set as
+    the sequential mode, one valid cost sample per iteration row."""
+    _write_instances(tmp_path, n_files=1)
+    spec = _write_spec(tmp_path)
+    out = tmp_path / "res.csv"
+    r = run_cli(
+        "batch", str(spec), "--result_file", str(out),
+        "--vmap_iterations",
+    )
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["executed"] == 4  # 1 file x 2 variants x 2 iters
+    assert summary["failed"] == 0
+    with open(out, newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 4
+    assert {r_["iteration"] for r_ in rows} == {"0", "1"}
+    for row in rows:
+        assert row["status"] == "finished"
+        assert float(row["cost"]) >= 0
+        assert int(row["msg_count"]) > 0
+    # resume: everything already recorded → nothing executed
+    r2 = run_cli(
+        "batch", str(spec), "--result_file", str(out),
+        "--vmap_iterations",
+    )
+    summary2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert summary2["executed"] == 0
+    assert summary2["skipped"] == 4
