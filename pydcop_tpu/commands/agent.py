@@ -29,6 +29,12 @@ def set_parser(subparsers) -> None:
         "--retry_for", type=float, default=30.0,
         help="seconds to keep retrying the initial connection",
     )
+    p.add_argument(
+        "--runtime", choices=["spmd", "host"], default="spmd",
+        help="must match the orchestrator's --runtime (spmd: sharded "
+        "batched solve as a jax.distributed process; host: "
+        "message-driven computations over TCP)",
+    )
     p.set_defaults(func=run_cmd)
 
 
@@ -45,6 +51,7 @@ def run_cmd(args) -> int:
                     "--names", name,
                     "--orchestrator", args.orchestrator,
                     "--retry_for", str(args.retry_for),
+                    "--runtime", args.runtime,
                 ]
             )
             for name in args.names
@@ -53,6 +60,15 @@ def run_cmd(args) -> int:
         for p in procs:
             rc = rc or p.wait()
         return rc
+
+    if args.runtime == "host":
+        from pydcop_tpu.infrastructure.hostnet import run_host_agent
+
+        result = run_host_agent(
+            args.names[0], args.orchestrator, retry_for=args.retry_for
+        )
+        print(json.dumps(result))
+        return 0
 
     from pydcop_tpu.infrastructure.orchestrator import run_agent
 

@@ -73,6 +73,19 @@ def set_parser(subparsers) -> None:
         "variables migrate to replicas with --ktarget, else freeze "
         "at their last value) — see infrastructure/elastic.py",
     )
+    p.add_argument(
+        "--register_timeout", type=float, default=120.0,
+        help="seconds to wait for all --nb_agents registrations",
+    )
+    p.add_argument(
+        "--runtime", choices=["spmd", "host"], default="spmd",
+        help="spmd (default): batched engine over a jax.distributed "
+        "mesh, every process computes the whole sharded problem in "
+        "lockstep.  host: message-driven agents over TCP — each agent "
+        "runs only its placed computations, exchanging simple_repr "
+        "JSON messages (the reference's heterogeneous deployment; "
+        "agents need no accelerator)",
+    )
     p.set_defaults(func=run_cmd)
 
 
@@ -81,17 +94,44 @@ def run_cmd(args) -> int:
     from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
     from pydcop_tpu.infrastructure.orchestrator import run_orchestrator
 
-    # load (merging multi-file specs) and re-dump: the deploy message
-    # ships ONE self-contained yaml text to every agent
+    # load (merging multi-file specs); the SPMD runtimes re-dump ONE
+    # self-contained yaml text for their deploy messages below — the
+    # host runtime serializes internally
     dcop = load_dcop_from_file(
         args.dcop_files if len(args.dcop_files) > 1 else args.dcop_files[0]
     )
-    dcop_yaml = dump_yaml(dcop)
 
     scenario_yaml = None
     if args.scenario:
         with open(args.scenario) as f:
             scenario_yaml = f.read()
+
+    if args.runtime == "host":
+        from pydcop_tpu.infrastructure.hostnet import (
+            run_host_orchestrator,
+        )
+
+        if args.elastic or args.scenario or args.ktarget:
+            raise SystemExit(
+                "orchestrator: --runtime host does not support "
+                "--elastic/--scenario/--ktarget (the SPMD runtime "
+                "carries the dynamics/resilience modes)"
+            )
+        result = run_host_orchestrator(
+            dcop,
+            args.algo,
+            parse_algo_params(args.algo_params),
+            nb_agents=args.nb_agents,
+            port=args.port,
+            rounds=args.rounds,
+            timeout=args.timeout,
+            seed=args.seed,
+            register_timeout=args.register_timeout,
+        )
+        write_result(args, result)
+        return 0
+
+    dcop_yaml = dump_yaml(dcop)
 
     if args.elastic:
         from pydcop_tpu.infrastructure.elastic import (

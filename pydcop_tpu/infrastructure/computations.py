@@ -16,7 +16,7 @@ batched TPU engine.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from pydcop_tpu.utils.simple_repr import _CLASS_KEY, _MODULE_KEY, SimpleRepr
 
@@ -139,6 +139,12 @@ class MessagePassingComputation:
     def __init__(self, name: str):
         self._name = name
         self._running = False
+        self._started = False
+        # algorithm messages that arrive before start(): a peer whose
+        # start raced ahead may legitimately send first (the
+        # cross-process runtimes broadcast 'start' sequentially) —
+        # buffered and replayed instead of dropped
+        self._pre_start: List[Tuple[str, Message, float]] = []
         self.message_sender: Optional[Callable[[str, str, Message], None]] = None
         # collect @register handlers from the class hierarchy
         self._handlers: Dict[str, Callable] = {}
@@ -157,9 +163,14 @@ class MessagePassingComputation:
         return self._running
 
     def start(self) -> None:
-        """Enter the running state, then fire ``on_start``."""
+        """Enter the running state, fire ``on_start``, then replay any
+        messages that arrived before the start."""
         self._running = True
+        self._started = True
         self.on_start()
+        buffered, self._pre_start = self._pre_start, []
+        for sender, msg, t in buffered:
+            self.on_message(sender, msg, t)
 
     def stop(self) -> None:
         self._running = False
@@ -181,7 +192,9 @@ class MessagePassingComputation:
     def on_message(self, sender: str, msg: Message, t: float = 0.0) -> None:
         """Dispatch one message to its ``@register``-ed handler."""
         if not self._running:
-            return
+            if not self._started:  # early message: replayed by start()
+                self._pre_start.append((sender, msg, t))
+            return  # stopped: late messages are dropped
         handler = self._handlers.get(msg.type)
         if handler is None:
             raise ValueError(

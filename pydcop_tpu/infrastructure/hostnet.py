@@ -1,0 +1,606 @@
+"""Cross-process host runtime: message-driven agents over TCP.
+
+This is the TPU build's equivalent of the reference's HTTP agent
+deployment (``pydcop/infrastructure/communication.py``
+``HttpCommunicationLayer`` + ``commands/agent.py``): real
+``MessagePassingComputation`` agents spread over OS processes (or
+hosts), exchanging algorithm messages as ``simple_repr`` JSON frames —
+the reference's wire format — over persistent TCP connections instead
+of per-message HTTP POSTs.
+
+It complements the SPMD path (``infrastructure/orchestrator.py``):
+that one runs the *batched* engine over a ``jax.distributed`` mesh
+(homogeneous, lockstep); this one runs the *host* message-driven
+engine with arbitrary per-agent placement — the heterogeneous-agent
+deployment mode, where machines need nothing but Python + this
+package.
+
+Deployment protocol (control plane, newline-JSON over the agent's
+orchestrator connection):
+
+1. agents connect and ``register`` with their name + message-plane
+   address (their ``TcpCommunicationLayer`` listener),
+2. the orchestrator ships each agent ``deploy``: the DCOP yaml, algo
+   + params, its computation placement, the full agent directory, and
+   the seed — each agent rebuilds the problem locally and instantiates
+   ONLY its computations through the algorithm registry
+   (``build_computation``), the reference's deployment seam,
+3. ``start`` begins message passing; the orchestrator polls ``status``
+   (pending messages + delivered count per agent) and declares
+   quiescence when every agent is idle and the global delivered count
+   is stable across 3 consecutive polls (the distributed analogue of
+   the in-process quiescence rule, see ``docs/termination.md``),
+4. ``collect`` gathers each agent's variable values; the orchestrator
+   assembles the assignment, evaluates the cost, and broadcasts
+   ``stop``.
+
+Failure handling: a dead agent connection aborts the run with a clean
+``AgentFailureError`` (control connections double as liveness
+monitors); surviving agents receive ``stop`` on the way out.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from pydcop_tpu.infrastructure.communication import (
+    MSG_ALGO,
+    CommunicationLayer,
+    Messaging,
+    UnreachableAgent,
+)
+from pydcop_tpu.infrastructure.computations import Message
+
+_ENC = "utf-8"
+
+
+class TcpCommunicationLayer(CommunicationLayer):
+    """Message-plane transport: one listener per process, pooled
+    outbound connections, ``simple_repr`` JSON frames.
+
+    Frame format (one JSON object per line)::
+
+        {"da": dest_agent, "sc": src_comp, "dc": dest_comp,
+         "p": priority, "m": simple_repr(message)}
+    """
+
+    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0):
+        super().__init__()
+        self.addresses: Dict[str, Tuple[str, int]] = {}
+        self._pool: Dict[Tuple[str, int], socket.socket] = {}
+        self._pool_lock = threading.Lock()
+        self._server = socket.create_server(
+            (bind_host, port), reuse_port=False
+        )
+        self.address: Tuple[str, int] = (
+            bind_host, self._server.getsockname()[1]
+        )
+        self._closing = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="hostnet-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- directory ------------------------------------------------------
+
+    def set_addresses(self, directory: Dict[str, Any]) -> None:
+        """Install the agent → (host, port) message-plane directory."""
+        self.addresses.update(
+            {a: (h, int(p)) for a, (h, p) in directory.items()}
+        )
+
+    # -- inbound --------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._recv_loop, args=(conn,),
+                name="hostnet-recv", daemon=True,
+            ).start()
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        from pydcop_tpu.utils.simple_repr import from_repr
+
+        reader = conn.makefile("rb")
+        try:
+            while True:
+                line = reader.readline()
+                if not line:
+                    return
+                frame = json.loads(line.decode(_ENC))
+                messaging = self.discovery.get(frame["da"])
+                if messaging is None:
+                    continue  # late frame for a stopped agent
+                messaging.deliver(
+                    frame["sc"], frame["dc"], from_repr(frame["m"]),
+                    frame.get("p", MSG_ALGO),
+                )
+        except (OSError, ValueError):
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- outbound -------------------------------------------------------
+
+    def send_msg(
+        self,
+        dest_agent: str,
+        src_comp: str,
+        dest_comp: str,
+        msg: Message,
+        priority: int = MSG_ALGO,
+    ) -> None:
+        local = self.discovery.get(dest_agent)
+        if local is not None:  # same process: no serialization
+            local.deliver(src_comp, dest_comp, msg, priority)
+            return
+        addr = self.addresses.get(dest_agent)
+        if addr is None:
+            raise UnreachableAgent(dest_agent)
+        from pydcop_tpu.utils.simple_repr import simple_repr
+
+        frame = (
+            json.dumps(
+                {
+                    "da": dest_agent,
+                    "sc": src_comp,
+                    "dc": dest_comp,
+                    "p": priority,
+                    "m": simple_repr(msg),
+                }
+            )
+            + "\n"
+        ).encode(_ENC)
+        with self._pool_lock:
+            conn = self._pool.get(addr)
+            try:
+                if conn is None:
+                    conn = socket.create_connection(addr, timeout=10)
+                    self._pool[addr] = conn
+                conn.sendall(frame)
+            except OSError as e:
+                self._pool.pop(addr, None)
+                raise UnreachableAgent(f"{dest_agent}: {e}") from e
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._pool_lock:
+            for conn in self._pool.values():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._pool.clear()
+
+
+# -- control-plane helpers (same framing as the SPMD orchestrator) ------
+
+
+def _send(conn: socket.socket, obj: Dict[str, Any]) -> None:
+    conn.sendall((json.dumps(obj) + "\n").encode(_ENC))
+
+
+def _recv(reader) -> Optional[Dict[str, Any]]:
+    line = reader.readline()
+    if not line:
+        return None
+    return json.loads(line.decode(_ENC))
+
+
+class AgentFailureError(RuntimeError):
+    pass
+
+
+def run_host_orchestrator(
+    dcop,
+    algo: str,
+    params: Dict[str, Any],
+    nb_agents: int,
+    port: int,
+    rounds: int = 200,
+    timeout: Optional[float] = None,
+    seed: int = 0,
+    distribution: Optional[Dict[str, str]] = None,
+    register_timeout: float = 120.0,
+    poll_timeout: float = 30.0,
+    best_sample_period: float = 0.5,
+) -> Dict[str, Any]:
+    """Wait for ``nb_agents`` host agents, deploy, run to quiescence /
+    budget / timeout, and return the assembled result dict.
+
+    ``poll_timeout`` bounds every control-plane read after
+    registration: a wedged or partitioned agent (no RST, nothing to
+    read) fails the run with :class:`AgentFailureError` instead of
+    hanging it.  Anytime-best tracking: agent values are sampled every
+    ``best_sample_period`` seconds and the best-cost sample is what
+    ``cost``/``assignment`` report (``final_*`` is the last state) —
+    the same semantics as the other engines.
+    """
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.graphs import load_graph_module
+
+    t0 = time.perf_counter()
+    module = load_algorithm_module(algo)
+    if not hasattr(module, "build_computation"):
+        raise ValueError(
+            f"{algo}: no host build_computation — use the SPMD "
+            "orchestrator for batched-only algorithms"
+        )
+    params = prepare_algo_params(params, module.algo_params)
+    graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
+        dcop
+    )
+    comp_names = sorted(n.name for n in graph.nodes)
+
+    server = socket.create_server(("", port))
+    server.settimeout(register_timeout)
+    peers: Dict[str, Tuple[socket.socket, Any]] = {}
+    addresses: Dict[str, Tuple[str, int]] = {}
+
+    def _ask(name: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """One control round-trip; any failure → AgentFailureError."""
+        conn, reader = peers[name]
+        try:
+            _send(conn, obj)
+            reply = _recv(reader)
+        except (OSError, ValueError) as e:
+            raise AgentFailureError(
+                f"agent {name} died mid-solve ({type(e).__name__})"
+            ) from e
+        if reply is None:
+            raise AgentFailureError(f"agent {name} died mid-solve")
+        if reply.get("error"):
+            raise AgentFailureError(
+                f"agent {name} failed: {reply['error']}"
+            )
+        return reply
+
+    try:
+        while len(peers) < nb_agents:
+            try:
+                conn, peer_addr = server.accept()
+            except socket.timeout:
+                raise AgentFailureError(
+                    f"only {len(peers)}/{nb_agents} agents registered "
+                    f"within {register_timeout:.0f}s"
+                ) from None
+            conn.settimeout(register_timeout)
+            reader = conn.makefile("rb")
+            try:
+                reg = _recv(reader)
+            except (OSError, ValueError):
+                conn.close()
+                continue
+            if not reg or reg.get("type") != "register":
+                conn.close()
+                continue
+            name = reg["agent"]
+            if name in peers:  # fail the duplicate fast + accurately
+                try:
+                    _send(
+                        conn,
+                        {
+                            "type": "error",
+                            "reason": f"agent name {name!r} is already "
+                            "registered",
+                        },
+                    )
+                except OSError:
+                    pass
+                conn.close()
+                continue
+            conn.settimeout(poll_timeout)
+            peers[name] = (conn, reader)
+            # the message-plane port the agent listens on, reached at
+            # the IP its control connection came from
+            addresses[name] = (peer_addr[0], int(reg["msg_port"]))
+
+        agent_names = sorted(peers)
+        # placement: explicit map, else round-robin over agents
+        if distribution is None:
+            placement: Dict[str, List[str]] = {a: [] for a in agent_names}
+            for i, cname in enumerate(comp_names):
+                placement[agent_names[i % len(agent_names)]].append(cname)
+        else:
+            placement = {a: [] for a in agent_names}
+            for cname, aname in distribution.items():
+                if aname not in placement:
+                    raise ValueError(
+                        f"distribution places {cname} on unknown "
+                        f"agent {aname}"
+                    )
+                placement[aname].append(cname)
+
+        yaml_text = dcop_yaml(dcop)
+        directory = {a: list(addresses[a]) for a in agent_names}
+        for name, (conn, _) in peers.items():
+            _send(
+                conn,
+                {
+                    "type": "deploy",
+                    "dcop_yaml": yaml_text,
+                    "algo": algo,
+                    "params": params,
+                    "computations": placement[name],
+                    "placement": placement,
+                    "directory": directory,
+                    "seed": seed,
+                },
+            )
+        for name in peers:
+            conn, reader = peers[name]
+            try:
+                ack = _recv(reader)
+            except (OSError, ValueError) as e:
+                raise AgentFailureError(
+                    f"agent {name} died during deploy "
+                    f"({type(e).__name__})"
+                ) from e
+            if not ack or ack.get("type") != "deployed":
+                raise AgentFailureError(f"agent {name} failed to deploy")
+
+        for name in peers:
+            try:
+                _send(peers[name][0], {"type": "start"})
+            except OSError as e:
+                raise AgentFailureError(
+                    f"agent {name} died at start"
+                ) from e
+
+        def _collect() -> Tuple[Dict[str, Any], int, int]:
+            assignment: Dict[str, Any] = {}
+            delivered = size = 0
+            for name in peers:
+                res = _ask(name, {"type": "collect"})
+                assignment.update(res["values"])
+                delivered += res["delivered"]
+                size += res["size"]
+            return assignment, delivered, size
+
+        # anytime-best tracking (same semantics as the other engines:
+        # ``cost``/``assignment`` = best sampled state, ``final_*`` =
+        # last state).  A sample torn across agents is still a valid
+        # assignment — just a mix of two instants (runtime.py snapshot
+        # makes the same argument).
+        sign = -1.0 if dcop.objective == "max" else 1.0
+        best = {"cost": float("inf"), "assignment": {}}
+
+        def _sample_best() -> None:
+            assignment, _, _ = _collect()
+            if any(v is None for v in assignment.values()) or set(
+                assignment
+            ) != set(dcop.variables):
+                return  # some variable has no selected value yet
+            cost = dcop.solution_cost(assignment)
+            if sign * cost < best["cost"]:
+                best["cost"] = sign * cost
+                best["assignment"] = assignment
+
+        # run loop: poll status until quiescent / budget / timeout
+        max_msgs = rounds * max(len(comp_names), 1)
+        status = "finished"
+        stable = 0
+        last_total = -1
+        last_sample = 0.0
+        while True:
+            time.sleep(0.05)
+            total = 0
+            all_idle = True
+            for name in peers:
+                st = _ask(name, {"type": "status?"})
+                total += st["delivered"]
+                all_idle = all_idle and st["idle"]
+            now = time.perf_counter()
+            if now - last_sample >= best_sample_period:
+                _sample_best()
+                last_sample = now
+            if timeout is not None and now - t0 > timeout:
+                status = "timeout"
+                break
+            if total >= max_msgs:
+                status = "msg_budget"
+                break
+            if all_idle and total == last_total:
+                stable += 1
+                if stable >= 3:
+                    break
+            else:
+                stable = 0
+            last_total = total
+
+        final_assignment, delivered, size = _collect()
+        final_cost = dcop.solution_cost(final_assignment)
+        if sign * final_cost < best["cost"]:
+            best["cost"] = sign * final_cost
+            best["assignment"] = final_assignment
+        return {
+            "assignment": best["assignment"],
+            "cost": sign * best["cost"],
+            "final_assignment": final_assignment,
+            "final_cost": final_cost,
+            "cycle": delivered,
+            "msg_count": delivered,
+            "msg_size": size,
+            "status": status,
+            "time": time.perf_counter() - t0,
+            "agents": agent_names,
+            "placement": {a: sorted(c) for a, c in placement.items()},
+        }
+    finally:
+        for conn, _ in peers.values():
+            try:
+                _send(conn, {"type": "stop"})
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        server.close()
+
+
+def run_host_agent(
+    name: str,
+    orchestrator: str,
+    retry_for: float = 30.0,
+) -> Dict[str, Any]:
+    """One host agent process: register, deploy, run until ``stop``.
+
+    Returns a summary dict (delivered count, values) for logging."""
+    from pydcop_tpu.algorithms import (
+        AlgorithmDef,
+        ComputationDef,
+        load_algorithm_module,
+    )
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.graphs import load_graph_module
+    from pydcop_tpu.infrastructure.agents import Agent
+    from pydcop_tpu.infrastructure.computations import (
+        VariableComputation,
+    )
+    from pydcop_tpu.infrastructure.discovery import Discovery
+
+    ohost, _, oport = orchestrator.partition(":")
+    deadline = time.monotonic() + retry_for
+    while True:
+        try:
+            conn = socket.create_connection(
+                (ohost, int(oport)), timeout=5
+            )
+            break
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.3)
+    conn.settimeout(None)
+    reader = conn.makefile("rb")
+
+    comm = TcpCommunicationLayer()
+    _send(
+        conn,
+        {
+            "type": "register",
+            "agent": name,
+            "msg_port": comm.address[1],
+        },
+    )
+    dep = _recv(reader)
+    if dep and dep.get("type") == "error":
+        comm.close()
+        raise AgentFailureError(
+            f"agent {name}: rejected by orchestrator: {dep['reason']}"
+        )
+    if not dep or dep.get("type") != "deploy":
+        comm.close()
+        raise AgentFailureError(
+            f"agent {name}: expected deploy, got {dep!r}"
+        )
+
+    dcop = load_dcop(dep["dcop_yaml"])
+    module = load_algorithm_module(dep["algo"])
+    graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
+        dcop
+    )
+    algo_def = AlgorithmDef(dep["algo"], dep["params"], dcop.objective)
+    mine = set(dep["computations"])
+    by_name = {n.name: n for n in graph.nodes}
+    comm.set_addresses(
+        {a: tuple(addr) for a, addr in dep["directory"].items()}
+    )
+    # computation → agent routing for the messaging layer
+    directory = Discovery()
+    for aname, comps in dep["placement"].items():
+        directory.register_agent(aname)
+        for cname in comps:
+            directory.register_computation(cname, aname)
+
+    # handler/transport errors surface through the next status reply
+    # (a dead pump must never masquerade as quiescence)
+    errors: List[str] = []
+    agent = Agent(
+        name, comm,
+        on_error=lambda comp, e: errors.append(f"{comp}: {e!r}"),
+        discovery=directory,
+    )
+    computations = [
+        module.build_computation(
+            ComputationDef(by_name[cname], algo_def),
+            seed=dep["seed"],
+        )
+        for cname in sorted(mine)
+    ]
+    for comp in computations:
+        agent.deploy_computation(comp)
+    _send(conn, {"type": "deployed", "n": len(computations)})
+
+    delivered = 0
+    try:
+        while True:
+            msg = _recv(reader)
+            if msg is None:
+                break  # orchestrator died: stop quietly
+            mtype = msg.get("type")
+            if mtype == "start":
+                # the pump starts WITH the computations: inbound
+                # frames that arrived early sit queued in Messaging
+                # (and any popped before a computation's own start are
+                # buffered by the computation itself)
+                agent.start()
+                agent.start_computations()
+            elif mtype == "status?":
+                _send(
+                    conn,
+                    {
+                        "type": "status",
+                        "idle": agent.is_idle,
+                        "delivered": agent.messaging.count_msg,
+                        "error": errors[0] if errors else None,
+                    },
+                )
+            elif mtype == "collect":
+                values = {
+                    c.variable.name: c.current_value
+                    for c in computations
+                    if isinstance(c, VariableComputation)
+                }
+                delivered = agent.messaging.count_msg
+                _send(
+                    conn,
+                    {
+                        "type": "result",
+                        "values": values,
+                        "delivered": delivered,
+                        "size": agent.messaging.size_msg,
+                    },
+                )
+            elif mtype == "stop":
+                break
+    finally:
+        agent.stop()
+        comm.close()
+        try:
+            conn.close()
+        except OSError:
+            pass
+    return {"agent": name, "delivered": delivered}
+
+

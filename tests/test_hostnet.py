@@ -1,0 +1,192 @@
+"""Cross-process HOST runtime: message-driven agents over TCP
+(infrastructure/hostnet.py) — the heterogeneous deployment mode
+mirroring the reference's HTTP agents (reference:
+``pydcop/infrastructure/communication.py`` HttpCommunicationLayer).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ring_yaml(n=8):
+    lines = [
+        "name: ring",
+        "objective: min",
+        "domains:",
+        "  colors: {values: [0, 1, 2]}",
+        "variables:",
+    ]
+    for i in range(n):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for i in range(n):
+        j = (i + 1) % n
+        lines.append(f"  c{i}:")
+        lines.append("    type: intention")
+        lines.append(f"    function: 1 if v{i} == v{j} else 0")
+    lines.append(f"agents: [{', '.join(f'a{i}' for i in range(n))}]")
+    return "\n".join(lines) + "\n"
+
+
+def _parse_json_tail(text):
+    start = text.index("{")
+    return json.loads(text[start:])
+
+
+def test_host_runtime_two_processes(tmp_path):
+    """2 agent processes × N message-driven computations each solve a
+    ring to its optimum, messages crossing process boundaries as
+    simple_repr JSON over TCP."""
+    yaml_file = tmp_path / "ring.yaml"
+    yaml_file.write_text(_ring_yaml())
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYDCOP_TPU_PLATFORM"] = "cpu"
+
+    port = 9250 + (os.getpid() % 150)
+    orch = subprocess.Popen(
+        [
+            sys.executable, "-m", "pydcop_tpu", "orchestrator",
+            str(yaml_file), "-a", "maxsum", "--runtime", "host",
+            "--port", str(port), "--nb_agents", "2", "--rounds", "200",
+            "--seed", "3",
+        ],
+        env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    time.sleep(0.5)
+    agents = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu", "agent",
+                "--names", name, "--runtime", "host",
+                "--orchestrator", f"localhost:{port}",
+            ],
+            env=env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for name in ("a1", "a2")
+    ]
+    try:
+        orc_out, orc_err = orch.communicate(timeout=120)
+        assert orch.returncode == 0, orc_err[-3000:]
+        result = _parse_json_tail(orc_out)
+        # a ring is 3-colorable: the host Max-Sum must find optimum 0
+        assert result["cost"] == 0.0
+        assert result["status"] in ("finished", "msg_budget")
+        assert set(result["assignment"]) == {f"v{i}" for i in range(8)}
+        assert sorted(result["agents"]) == ["a1", "a2"]
+        # both agents hosted computations and exchanged real messages
+        placement = result["placement"]
+        assert placement["a1"] and placement["a2"]
+        assert result["msg_count"] > 0
+        for a in agents:
+            a_out, a_err = a.communicate(timeout=30)
+            assert a.returncode == 0, a_err[-3000:]
+    finally:
+        for proc in [orch, *agents]:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+
+
+def test_host_runtime_agent_death_fails_cleanly():
+    """An agent connection dying mid-solve must fail the orchestrator
+    with AgentFailureError promptly — exercised deterministically with
+    scripted protocol agents (one keeps reporting busy, one dies after
+    start), so no kill-timing race against quiescence."""
+    import socket
+    import threading
+
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.infrastructure.hostnet import (
+        AgentFailureError,
+        run_host_orchestrator,
+        _recv,
+        _send,
+    )
+
+    dcop = load_dcop(_ring_yaml())
+    port = 9250 + (os.getpid() % 150) + 2
+    outcome = {}
+
+    def orchestrate():
+        try:
+            run_host_orchestrator(
+                dcop, "maxsum", {}, nb_agents=2, port=port,
+                rounds=10_000_000, register_timeout=30.0,
+            )
+            outcome["result"] = "finished"
+        except AgentFailureError as e:
+            outcome["error"] = str(e)
+        except Exception as e:  # pragma: no cover — test diagnostics
+            outcome["error"] = f"unexpected {type(e).__name__}: {e}"
+
+    orch = threading.Thread(target=orchestrate, daemon=True)
+    orch.start()
+
+    def scripted_agent(name, die_after_polls):
+        conn = None
+        deadline = time.monotonic() + 20
+        while True:
+            try:
+                conn = socket.create_connection(
+                    ("localhost", port), timeout=5
+                )
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        reader = conn.makefile("rb")
+        _send(conn, {"type": "register", "agent": name, "msg_port": 1})
+        dep = _recv(reader)
+        assert dep["type"] == "deploy"
+        my_vars = [c for c in dep["computations"] if c.startswith("v")]
+        _send(conn, {"type": "deployed", "n": len(dep["computations"])})
+        polls = 0
+        while True:
+            msg = _recv(reader)
+            if msg is None or msg["type"] == "stop":
+                break
+            if msg["type"] == "status?":
+                polls += 1
+                if die_after_polls and polls >= die_after_polls:
+                    conn.close()  # mid-solve death
+                    return
+                # never idle: the run can only end via agent death
+                _send(
+                    conn,
+                    {"type": "status", "idle": False, "delivered": polls},
+                )
+            elif msg["type"] == "collect":  # anytime-best sampling
+                _send(
+                    conn,
+                    {
+                        "type": "result",
+                        "values": {v: 0 for v in my_vars},
+                        "delivered": polls,
+                        "size": polls,
+                    },
+                )
+        conn.close()
+
+    t1 = threading.Thread(
+        target=scripted_agent, args=("a1", 3), daemon=True
+    )
+    t2 = threading.Thread(
+        target=scripted_agent, args=("a2", 0), daemon=True
+    )
+    t0 = time.monotonic()
+    t1.start()
+    t2.start()
+    orch.join(timeout=30)
+    assert not orch.is_alive(), "orchestrator hung after agent death"
+    assert "died" in outcome.get("error", ""), outcome
+    assert time.monotonic() - t0 < 25
