@@ -78,6 +78,14 @@ def set_parser(subparsers) -> None:
         help="seconds to wait for all --nb_agents registrations",
     )
     p.add_argument(
+        "-d", "--distribution", default=None,
+        help="--runtime host placement: a distribution strategy name "
+        "(oneagent/adhoc/heur_comhost/...) computed over the "
+        "registered agents, or a yaml file with a `distribution:` "
+        "mapping (the `distribute --output` format); default "
+        "round-robin",
+    )
+    p.add_argument(
         "--runtime", choices=["spmd", "host"], default="spmd",
         help="spmd (default): batched engine over a jax.distributed "
         "mesh, every process computes the whole sharded problem in "
@@ -93,6 +101,46 @@ def run_cmd(args) -> int:
     from pydcop_tpu.dcop.yamldcop import dcop_yaml as dump_yaml
     from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
     from pydcop_tpu.infrastructure.orchestrator import run_orchestrator
+
+    # pure argument validation FIRST — before any problem parsing
+    if args.distribution and args.runtime != "host":
+        raise SystemExit(
+            "orchestrator: --distribution applies to --runtime host "
+            "(the SPMD runtime shards the whole compiled problem; "
+            "placement is the mesh layout)"
+        )
+    placement = None
+    dist_name = None
+    if args.distribution:
+        import os
+
+        if os.path.exists(args.distribution):
+            import yaml
+
+            with open(args.distribution) as f:
+                spec = yaml.safe_load(f)
+            if (
+                not isinstance(spec, dict)
+                or "distribution" not in spec
+                or not isinstance(spec["distribution"], dict)
+            ):
+                raise SystemExit(
+                    f"orchestrator: {args.distribution} is not a "
+                    "placement file (expected a yaml `distribution:` "
+                    "mapping of agent -> computation names, the "
+                    "`distribute --output` format)"
+                )
+            placement = spec["distribution"]
+        else:
+            from pydcop_tpu.distribution import (
+                load_distribution_module,
+            )
+
+            try:  # fail fast on a typo'd name, not after registration
+                load_distribution_module(args.distribution)
+            except Exception as e:
+                raise SystemExit(f"orchestrator: {e}")
+            dist_name = args.distribution
 
     # load (merging multi-file specs); the SPMD runtimes re-dump ONE
     # self-contained yaml text for their deploy messages below — the
@@ -127,6 +175,8 @@ def run_cmd(args) -> int:
             timeout=args.timeout,
             seed=args.seed,
             register_timeout=args.register_timeout,
+            distribution=dist_name,
+            placement=placement,
         )
         write_result(args, result)
         return 0

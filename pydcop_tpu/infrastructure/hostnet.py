@@ -215,13 +215,21 @@ def run_host_orchestrator(
     rounds: int = 200,
     timeout: Optional[float] = None,
     seed: int = 0,
-    distribution: Optional[Dict[str, str]] = None,
+    distribution: Optional[str] = None,
+    placement: Optional[Dict[str, List[str]]] = None,
     register_timeout: float = 120.0,
     poll_timeout: float = 30.0,
     best_sample_period: float = 0.5,
 ) -> Dict[str, Any]:
     """Wait for ``nb_agents`` host agents, deploy, run to quiescence /
     budget / timeout, and return the assembled result dict.
+
+    Placement: an explicit ``placement`` (agent → computation names,
+    the ``distribute --output`` yaml's ``distribution:`` mapping), or
+    a ``distribution`` strategy name (computed over the REGISTERED
+    agents through the distribution layer, using the dcop's AgentDef
+    capacity/hosting data when the registered names match), else
+    round-robin.
 
     ``poll_timeout`` bounds every control-plane read after
     registration: a wedged or partitioned agent (no RST, nothing to
@@ -315,20 +323,59 @@ def run_host_orchestrator(
             addresses[name] = (peer_addr[0], int(reg["msg_port"]))
 
         agent_names = sorted(peers)
-        # placement: explicit map, else round-robin over agents
-        if distribution is None:
-            placement: Dict[str, List[str]] = {a: [] for a in agent_names}
-            for i, cname in enumerate(comp_names):
-                placement[agent_names[i % len(agent_names)]].append(cname)
+        # placement: explicit map > distribution strategy > round-robin
+        if placement is not None:
+            from pydcop_tpu.distribution import Distribution
+
+            unknown = set(placement) - set(agent_names)
+            if unknown:
+                raise ValueError(
+                    f"placement names unregistered agent(s) "
+                    f"{sorted(unknown)} (registered: {agent_names})"
+                )
+            # Distribution() rejects a computation hosted twice
+            placed = set(Distribution(placement).computations)
+            missing = set(comp_names) - placed
+            if missing:
+                raise ValueError(
+                    f"placement leaves computation(s) "
+                    f"{sorted(missing)} unhosted"
+                )
+            bogus = placed - set(comp_names)
+            if bogus:
+                raise ValueError(
+                    f"placement names unknown computation(s) "
+                    f"{sorted(bogus)} (this problem/graph has: "
+                    f"{comp_names[:10]}...)"
+                )
+            placement = {a: list(placement.get(a, [])) for a in agent_names}
+        elif distribution is not None:
+            from pydcop_tpu.dcop.objects import AgentDef
+            from pydcop_tpu.distribution import load_distribution_module
+
+            dist_module = load_distribution_module(distribution)
+            agent_defs = [
+                dcop.agents[a] if a in dcop.agents else AgentDef(a)
+                for a in agent_names
+            ]
+            dist = dist_module.distribute(
+                graph,
+                agent_defs,
+                hints=dcop.dist_hints,
+                computation_memory=getattr(
+                    module, "computation_memory", None
+                ),
+                communication_load=getattr(
+                    module, "communication_load", None
+                ),
+            )
+            placement = {
+                a: dist.computations_hosted(a) for a in agent_names
+            }
         else:
             placement = {a: [] for a in agent_names}
-            for cname, aname in distribution.items():
-                if aname not in placement:
-                    raise ValueError(
-                        f"distribution places {cname} on unknown "
-                        f"agent {aname}"
-                    )
-                placement[aname].append(cname)
+            for i, cname in enumerate(comp_names):
+                placement[agent_names[i % len(agent_names)]].append(cname)
 
         yaml_text = dcop_yaml(dcop)
         directory = {a: list(addresses[a]) for a in agent_names}

@@ -190,3 +190,124 @@ def test_host_runtime_agent_death_fails_cleanly():
     assert not orch.is_alive(), "orchestrator hung after agent death"
     assert "died" in outcome.get("error", ""), outcome
     assert time.monotonic() - t0 < 25
+
+
+def test_host_runtime_placement_and_strategy():
+    """Explicit placement maps and distribution-layer strategies both
+    drive the host deploy (protocol-level, scripted agents)."""
+    import socket
+    import threading
+
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.infrastructure.hostnet import (
+        run_host_orchestrator,
+        _recv,
+        _send,
+    )
+
+    dcop = load_dcop(_ring_yaml())
+    var_names = [f"v{i}" for i in range(8)]
+    want = {
+        "a1": var_names[:2] + [f"c{i}" for i in range(8)],
+        "a2": var_names[2:],
+    }
+
+    def run_with(**kw):
+        port = 9250 + (os.getpid() % 150) + 3
+        box = {}
+
+        def orchestrate():
+            try:
+                box["result"] = run_host_orchestrator(
+                    dcop, "maxsum", {}, nb_agents=2, port=port,
+                    rounds=50, register_timeout=30.0, **kw,
+                )
+            except Exception as e:
+                box["error"] = f"{type(e).__name__}: {e}"
+
+        orch = threading.Thread(target=orchestrate, daemon=True)
+        orch.start()
+        deploys = {}
+
+        def scripted_agent(name):
+            deadline = time.monotonic() + 20
+            while True:
+                try:
+                    conn = socket.create_connection(
+                        ("localhost", port), timeout=5
+                    )
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
+            reader = conn.makefile("rb")
+            _send(
+                conn, {"type": "register", "agent": name, "msg_port": 1}
+            )
+            dep = _recv(reader)
+            if not dep or dep.get("type") != "deploy":
+                conn.close()  # run failed before deploy (e.g. bad
+                return        # placement): end quietly
+            deploys[name] = dep
+            _send(conn, {"type": "deployed", "n": 0})
+            vals = {
+                v: 0 for v in dep["computations"] if v.startswith("v")
+            }
+            while True:
+                msg = _recv(reader)
+                if msg is None or msg["type"] == "stop":
+                    break
+                if msg["type"] == "status?":
+                    _send(
+                        conn,
+                        {"type": "status", "idle": True, "delivered": 1},
+                    )
+                elif msg["type"] == "collect":
+                    _send(
+                        conn,
+                        {
+                            "type": "result",
+                            "values": vals,
+                            "delivered": 1,
+                            "size": 1,
+                        },
+                    )
+            conn.close()
+
+        ts = [
+            threading.Thread(
+                target=scripted_agent, args=(n,), daemon=True
+            )
+            for n in ("a1", "a2")
+        ]
+        for t in ts:
+            t.start()
+        orch.join(timeout=30)
+        assert not orch.is_alive()
+        return box, deploys
+
+    # explicit placement map is honored exactly
+    box, deploys = run_with(placement=want)
+    result = box["result"]
+    assert sorted(deploys["a1"]["computations"]) == sorted(want["a1"])
+    assert sorted(deploys["a2"]["computations"]) == sorted(want["a2"])
+    assert result["placement"]["a1"] == sorted(want["a1"])
+
+    # a computation hosted twice is rejected loudly, not solved wrong
+    dup = dict(want)
+    dup["a2"] = want["a2"] + [want["a1"][0]]
+    box, _ = run_with(placement=dup)
+    assert "result" not in box and "assigned to both" in box.get(
+        "error", ""
+    ), box
+
+    # a distribution-layer strategy (adhoc) covers every computation
+    box, deploys = run_with(distribution="adhoc")
+    result = box["result"]
+    all_comps = sorted(
+        deploys["a1"]["computations"] + deploys["a2"]["computations"]
+    )
+    assert all_comps == sorted(
+        var_names + [f"c{i}" for i in range(8)]
+    )
