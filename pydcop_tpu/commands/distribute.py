@@ -51,7 +51,10 @@ def run_cmd(args) -> int:
 
         computation_memory = getattr(algo_module, "computation_memory", None)
         communication_load = getattr(algo_module, "communication_load", None)
-        distribution = dist_module.distribute(
+        from pydcop_tpu.distribution import compute_distribution
+
+        distribution = compute_distribution(
+            dist_module,
             graph,
             dcop.agents.values(),
             hints=dcop.dist_hints,

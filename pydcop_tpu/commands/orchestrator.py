@@ -131,6 +131,17 @@ def run_cmd(args) -> int:
                     "`distribute --output` format)"
                 )
             placement = spec["distribution"]
+            bad = {
+                a: v
+                for a, v in placement.items()
+                if not isinstance(v, list)
+                or not all(isinstance(c, str) for c in v)
+            }
+            if bad:
+                raise SystemExit(
+                    "orchestrator: placement entries must be lists of "
+                    f"computation names; got {bad}"
+                )
         else:
             from pydcop_tpu.distribution import (
                 load_distribution_module,
@@ -165,19 +176,23 @@ def run_cmd(args) -> int:
                 "--elastic/--scenario/--ktarget (the SPMD runtime "
                 "carries the dynamics/resilience modes)"
             )
-        result = run_host_orchestrator(
-            dcop,
-            args.algo,
-            parse_algo_params(args.algo_params),
-            nb_agents=args.nb_agents,
-            port=args.port,
-            rounds=args.rounds,
-            timeout=args.timeout,
-            seed=args.seed,
-            register_timeout=args.register_timeout,
-            distribution=dist_name,
-            placement=placement,
-        )
+        try:
+            result = run_host_orchestrator(
+                dcop,
+                args.algo,
+                parse_algo_params(args.algo_params),
+                nb_agents=args.nb_agents,
+                port=args.port,
+                rounds=args.rounds,
+                timeout=args.timeout,
+                seed=args.seed,
+                register_timeout=args.register_timeout,
+                distribution=dist_name,
+                placement=placement,
+                ui_port=args.uiport,
+            )
+        except ValueError as e:  # placement/strategy errors: clean exit
+            raise SystemExit(f"orchestrator: {e}")
         write_result(args, result)
         return 0
 

@@ -87,7 +87,9 @@ def run_cmd(args) -> int:
             "solve --mode process: cross-process runs go through the "
             "orchestrator — start `pydcop_tpu orchestrator <dcop> -a "
             "<algo> --nb_agents N` and N `pydcop_tpu agent` processes "
-            "(see those commands' --help)"
+            "(add `--runtime host` on both for message-driven agents "
+            "instead of the sharded SPMD solve; see those commands' "
+            "--help)"
         )
     params = parse_algo_params(args.algo_params)
     profile_ctx = None

@@ -37,6 +37,47 @@ def load_distribution_module(name: str):
     return mod
 
 
+def compute_distribution(
+    distribution,
+    graph,
+    agent_defs,
+    *,
+    hints=None,
+    algo_module=None,
+    computation_memory=None,
+    communication_load=None,
+) -> Distribution:
+    """Run a distribution strategy — the one shared invocation ritual
+    (used by the distribute CLI, the dynamic engine, and the host
+    runtime, which would otherwise each copy it).
+
+    ``distribution`` is a strategy name or an already-imported
+    strategy module.  Footprint callbacks default to the algorithm
+    module's ``computation_memory``/``communication_load`` when an
+    ``algo_module`` is given; explicit callbacks win.
+    """
+    mod = (
+        load_distribution_module(distribution)
+        if isinstance(distribution, str)
+        else distribution
+    )
+    if computation_memory is None and algo_module is not None:
+        computation_memory = getattr(
+            algo_module, "computation_memory", None
+        )
+    if communication_load is None and algo_module is not None:
+        communication_load = getattr(
+            algo_module, "communication_load", None
+        )
+    return mod.distribute(
+        graph,
+        agent_defs,
+        hints=hints,
+        computation_memory=computation_memory,
+        communication_load=communication_load,
+    )
+
+
 def list_available_distributions() -> List[str]:
     import pydcop_tpu.distribution as pkg
 

@@ -104,13 +104,15 @@ def run_dynamic(
     if isinstance(distribution, Distribution):
         dist = distribution
     else:
-        dist_module = load_distribution_module(distribution)
-        dist = dist_module.distribute(
+        from pydcop_tpu.distribution import compute_distribution
+
+        dist = compute_distribution(
+            distribution,
             graph,
             live_agents.values(),
             hints=dcop.dist_hints,
+            algo_module=module,
             computation_memory=computation_memory,
-            communication_load=getattr(module, "communication_load", None),
         )
 
     replicas = (

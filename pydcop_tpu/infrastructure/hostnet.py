@@ -220,6 +220,7 @@ def run_host_orchestrator(
     register_timeout: float = 120.0,
     poll_timeout: float = 30.0,
     best_sample_period: float = 0.5,
+    ui_port: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Wait for ``nb_agents`` host agents, deploy, run to quiescence /
     budget / timeout, and return the assembled result dict.
@@ -259,6 +260,7 @@ def run_host_orchestrator(
     )
     comp_names = sorted(n.name for n in graph.nodes)
 
+    ui = None  # created after registration; closed in the finally
     server = socket.create_server(("", port))
     server.settimeout(register_timeout)
     peers: Dict[str, Tuple[socket.socket, Any]] = {}
@@ -324,50 +326,26 @@ def run_host_orchestrator(
 
         agent_names = sorted(peers)
         # placement: explicit map > distribution strategy > round-robin
-        if placement is not None:
-            from pydcop_tpu.distribution import Distribution
+        from pydcop_tpu.distribution import Distribution
 
+        if placement is not None:
             unknown = set(placement) - set(agent_names)
             if unknown:
                 raise ValueError(
                     f"placement names unregistered agent(s) "
                     f"{sorted(unknown)} (registered: {agent_names})"
                 )
-            # Distribution() rejects a computation hosted twice
-            placed = set(Distribution(placement).computations)
-            missing = set(comp_names) - placed
-            if missing:
-                raise ValueError(
-                    f"placement leaves computation(s) "
-                    f"{sorted(missing)} unhosted"
-                )
-            bogus = placed - set(comp_names)
-            if bogus:
-                raise ValueError(
-                    f"placement names unknown computation(s) "
-                    f"{sorted(bogus)} (this problem/graph has: "
-                    f"{comp_names[:10]}...)"
-                )
-            placement = {a: list(placement.get(a, [])) for a in agent_names}
         elif distribution is not None:
             from pydcop_tpu.dcop.objects import AgentDef
-            from pydcop_tpu.distribution import load_distribution_module
+            from pydcop_tpu.distribution import compute_distribution
 
-            dist_module = load_distribution_module(distribution)
             agent_defs = [
                 dcop.agents[a] if a in dcop.agents else AgentDef(a)
                 for a in agent_names
             ]
-            dist = dist_module.distribute(
-                graph,
-                agent_defs,
-                hints=dcop.dist_hints,
-                computation_memory=getattr(
-                    module, "computation_memory", None
-                ),
-                communication_load=getattr(
-                    module, "communication_load", None
-                ),
+            dist = compute_distribution(
+                distribution, graph, agent_defs,
+                hints=dcop.dist_hints, algo_module=module,
             )
             placement = {
                 a: dist.computations_hosted(a) for a in agent_names
@@ -376,6 +354,25 @@ def run_host_orchestrator(
             placement = {a: [] for a in agent_names}
             for i, cname in enumerate(comp_names):
                 placement[agent_names[i % len(agent_names)]].append(cname)
+
+        # uniform validation whatever produced the placement:
+        # Distribution() rejects a computation hosted twice; coverage
+        # and name checks catch incomplete/bogus strategies and files
+        placed = set(Distribution(placement).computations)
+        missing = set(comp_names) - placed
+        if missing:
+            raise ValueError(
+                f"placement leaves computation(s) {sorted(missing)} "
+                "unhosted"
+            )
+        bogus = placed - set(comp_names)
+        if bogus:
+            raise ValueError(
+                f"placement names unknown computation(s) "
+                f"{sorted(bogus)} (this problem/graph has: "
+                f"{comp_names[:10]}...)"
+            )
+        placement = {a: list(placement.get(a, [])) for a in agent_names}
 
         yaml_text = dcop_yaml(dcop)
         directory = {a: list(addresses[a]) for a in agent_names}
@@ -431,7 +428,12 @@ def run_host_orchestrator(
         sign = -1.0 if dcop.objective == "max" else 1.0
         best = {"cost": float("inf"), "assignment": {}}
 
-        def _sample_best() -> None:
+        if ui_port is not None:
+            from pydcop_tpu.infrastructure.ui import UiServer
+
+            ui = UiServer(ui_port)
+
+        def _sample_best(delivered: int = 0) -> None:
             assignment, _, _ = _collect()
             if any(v is None for v in assignment.values()) or set(
                 assignment
@@ -441,6 +443,11 @@ def run_host_orchestrator(
             if sign * cost < best["cost"]:
                 best["cost"] = sign * cost
                 best["assignment"] = assignment
+            if ui is not None:
+                ui.publish(
+                    delivered, cost, sign * best["cost"],
+                    values=assignment,
+                )
 
         # run loop: poll status until quiescent / budget / timeout
         max_msgs = rounds * max(len(comp_names), 1)
@@ -458,7 +465,7 @@ def run_host_orchestrator(
                 all_idle = all_idle and st["idle"]
             now = time.perf_counter()
             if now - last_sample >= best_sample_period:
-                _sample_best()
+                _sample_best(total)
                 last_sample = now
             if timeout is not None and now - t0 > timeout:
                 status = "timeout"
@@ -479,6 +486,12 @@ def run_host_orchestrator(
         if sign * final_cost < best["cost"]:
             best["cost"] = sign * final_cost
             best["assignment"] = final_assignment
+        if ui is not None:  # final event: the BEST pair (cost and
+            # values belong together, matching the SPMD orchestrator)
+            ui.publish(
+                delivered, sign * best["cost"], sign * best["cost"],
+                values=best["assignment"], status=status,
+            )
         return {
             "assignment": best["assignment"],
             "cost": sign * best["cost"],
@@ -493,6 +506,8 @@ def run_host_orchestrator(
             "placement": {a: sorted(c) for a, c in placement.items()},
         }
     finally:
+        if ui is not None:
+            ui.close()
         for conn, _ in peers.values():
             try:
                 _send(conn, {"type": "stop"})

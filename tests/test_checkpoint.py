@@ -175,3 +175,56 @@ def test_resume_backfills_static_state_keys(tmp_path):
     assert resumed.cycles == 64
     assert resumed.assignment == full.assignment
     assert resumed.best_cost == full.best_cost
+
+
+def test_resume_array_built_problem(tmp_path):
+    """Checkpoint/resume works for compile_from_arrays problems: the
+    AutoNames/UniformLabels metadata fingerprints stably across
+    processes (content-hash reprs), so a resume matches an
+    uninterrupted run exactly."""
+    import numpy as np
+
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_batched
+    from pydcop_tpu.ops.compile import compile_from_arrays
+    from pydcop_tpu.ops.generate import coloring_arrays
+
+    sc, tb, un = coloring_arrays(60, seed=4)
+    problem = compile_from_arrays(sc, tb, 3, unary=un)
+    module = load_algorithm_module("dsa")
+    params = prepare_algo_params({"variant": "B"}, module.algo_params)
+    ckpt = str(tmp_path / "arr.npz")
+
+    full = run_batched(
+        problem, module, params, rounds=64, seed=2, chunk_size=16
+    )
+    run_batched(
+        problem, module, params, rounds=32, seed=2, chunk_size=16,
+        checkpoint_path=ckpt,
+    )
+    resumed = run_batched(
+        problem, module, params, rounds=64, seed=2, chunk_size=16,
+        checkpoint_path=ckpt, resume=True,
+    )
+    assert resumed.cost == full.cost
+    np.testing.assert_array_equal(
+        np.asarray(
+            [resumed.assignment[n] for n in sorted(resumed.assignment)]
+        ),
+        np.asarray(
+            [full.assignment[n] for n in sorted(full.assignment)]
+        ),
+    )
+    # a different instance is still rejected via the fingerprint
+    sc2, tb2, un2 = coloring_arrays(60, seed=5)
+    other = compile_from_arrays(sc2, tb2, 3, unary=un2)
+    import pytest
+
+    with pytest.raises(ValueError, match="different problem"):
+        run_batched(
+            other, module, params, rounds=32, seed=2, chunk_size=16,
+            checkpoint_path=ckpt, resume=True,
+        )

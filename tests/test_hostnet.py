@@ -311,3 +311,119 @@ def test_host_runtime_placement_and_strategy():
     assert all_comps == sorted(
         var_names + [f"c{i}" for i in range(8)]
     )
+
+
+def test_host_runtime_ui_feed():
+    """--uiport on the host orchestrator streams best-cost samples; a
+    client sees events during the run and the final status event."""
+    import json as jsonmod
+    import socket
+    import threading
+    import urllib.request
+
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.infrastructure.hostnet import (
+        run_host_orchestrator,
+        _recv,
+        _send,
+    )
+
+    def _free_port():
+        with socket.socket() as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
+
+    dcop = load_dcop(_ring_yaml())
+    port = 9250 + (os.getpid() % 150) + 4
+    ui_port = _free_port()
+    box = {}
+    events = []
+    ready = threading.Event()
+
+    def client():
+        deadline = time.monotonic() + 15
+        while True:  # the UI server comes up after agents register
+            try:
+                req = urllib.request.urlopen(
+                    f"http://localhost:{ui_port}/events", timeout=30
+                )
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        ready.set()
+        for raw in req:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                events.append(jsonmod.loads(line[6:]))
+
+    def orchestrate():
+        try:
+            box["result"] = run_host_orchestrator(
+                dcop, "maxsum", {}, nb_agents=1, port=port,
+                rounds=5000, register_timeout=30.0, ui_port=ui_port,
+                best_sample_period=0.2,
+            )
+        except Exception as e:
+            box["error"] = f"{type(e).__name__}: {e}"
+
+    # SSE client attaches BEFORE the run starts so it sees every event
+    t = threading.Thread(target=client, daemon=True)
+    orch = threading.Thread(target=orchestrate, daemon=True)
+
+    def scripted_agent():
+        deadline = time.monotonic() + 20
+        while True:
+            try:
+                conn = socket.create_connection(
+                    ("localhost", port), timeout=5
+                )
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        reader = conn.makefile("rb")
+        _send(conn, {"type": "register", "agent": "a1", "msg_port": 1})
+        dep = _recv(reader)
+        vals = {v: 0 for v in dep["computations"] if v.startswith("v")}
+        _send(conn, {"type": "deployed", "n": 0})
+        t_busy = time.monotonic() + 1.5  # stay busy ~3 sample periods
+        while True:
+            msg = _recv(reader)
+            if msg is None or msg["type"] == "stop":
+                break
+            if msg["type"] == "status?":
+                _send(
+                    conn,
+                    {
+                        "type": "status",
+                        "idle": time.monotonic() > t_busy,
+                        "delivered": 5,
+                    },
+                )
+            elif msg["type"] == "collect":
+                _send(
+                    conn,
+                    {
+                        "type": "result",
+                        "values": vals,
+                        "delivered": 5,
+                        "size": 5,
+                    },
+                )
+        conn.close()
+
+    orch.start()
+    t.start()
+    threading.Thread(target=scripted_agent, daemon=True).start()
+    ready.wait(15)  # client attached (server up => agents registered)
+    orch.join(timeout=30)
+    assert not orch.is_alive()
+    assert "result" in box, box
+    t.join(10)
+    assert len(events) >= 2  # in-run samples + the final event
+    final = events[-1]
+    assert final["status"] == "finished"
+    assert final["values"] == box["result"]["assignment"]
