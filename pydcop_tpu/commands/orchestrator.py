@@ -167,6 +167,7 @@ def run_cmd(args) -> int:
 
     if args.runtime == "host":
         from pydcop_tpu.infrastructure.hostnet import (
+            PlacementError,
             run_host_orchestrator,
         )
 
@@ -191,7 +192,7 @@ def run_cmd(args) -> int:
                 placement=placement,
                 ui_port=args.uiport,
             )
-        except ValueError as e:  # placement/strategy errors: clean exit
+        except PlacementError as e:  # usage errors: clean exit
             raise SystemExit(f"orchestrator: {e}")
         write_result(args, result)
         return 0

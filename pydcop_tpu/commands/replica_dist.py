@@ -51,12 +51,15 @@ def run_cmd(args) -> int:
         with open(args.distribution) as f:
             dist = Distribution(yaml.safe_load(f)["distribution"])
     else:
-        dist = load_distribution_module(args.distribution).distribute(
+        from pydcop_tpu.distribution import compute_distribution
+
+        dist = compute_distribution(
+            args.distribution,
             graph,
             dcop.agents.values(),
             hints=dcop.dist_hints,
+            algo_module=module,
             computation_memory=computation_memory,
-            communication_load=getattr(module, "communication_load", None),
         )
 
     def footprint(comp: str) -> float:

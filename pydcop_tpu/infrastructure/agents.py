@@ -53,6 +53,7 @@ class Agent:
         self.messaging = Messaging(name)
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
+        self._comps_started = threading.Event()
         self._on_error = on_error
         self._busy = False  # a handler is mid-execution
         self.activity_time = 0.0  # seconds spent handling messages
@@ -86,6 +87,7 @@ class Agent:
     def start_computations(self) -> None:
         for comp in self._computations.values():
             comp.start()
+        self._comps_started.set()
 
     def stop(self) -> None:
         """Orderly end-of-run stop.  Does NOT unregister from the
@@ -121,6 +123,15 @@ class Agent:
     # -- message pump --------------------------------------------------
 
     def _run(self) -> None:
+        # gate the pump until this agent's computations have started:
+        # a faster peer's opening messages then simply WAIT in the
+        # thread-safe Messaging queue instead of being popped into
+        # not-yet-running computations (whose pre-start buffers would
+        # replay them on the starter's thread — measured pathological
+        # under a 100-agent message flood)
+        while not self._stop_evt.is_set():
+            if self._comps_started.wait(timeout=0.05):
+                break
         while not self._stop_evt.is_set():
             item = self.messaging.next_msg(timeout=0.05)
             if item is None:

@@ -206,6 +206,11 @@ class AgentFailureError(RuntimeError):
     pass
 
 
+class PlacementError(ValueError):
+    """Invalid placement/distribution input (a usage error, not an
+    internal failure — the CLI converts it to a clean exit)."""
+
+
 def run_host_orchestrator(
     dcop,
     algo: str,
@@ -331,7 +336,7 @@ def run_host_orchestrator(
         if placement is not None:
             unknown = set(placement) - set(agent_names)
             if unknown:
-                raise ValueError(
+                raise PlacementError(
                     f"placement names unregistered agent(s) "
                     f"{sorted(unknown)} (registered: {agent_names})"
                 )
@@ -358,16 +363,19 @@ def run_host_orchestrator(
         # uniform validation whatever produced the placement:
         # Distribution() rejects a computation hosted twice; coverage
         # and name checks catch incomplete/bogus strategies and files
-        placed = set(Distribution(placement).computations)
+        try:  # Distribution() rejects a computation hosted twice
+            placed = set(Distribution(placement).computations)
+        except ValueError as e:
+            raise PlacementError(str(e)) from e
         missing = set(comp_names) - placed
         if missing:
-            raise ValueError(
+            raise PlacementError(
                 f"placement leaves computation(s) {sorted(missing)} "
                 "unhosted"
             )
         bogus = placed - set(comp_names)
         if bogus:
-            raise ValueError(
+            raise PlacementError(
                 f"placement names unknown computation(s) "
                 f"{sorted(bogus)} (this problem/graph has: "
                 f"{comp_names[:10]}...)"

@@ -247,6 +247,18 @@ def _run_threads(
         for comp in computations:
             placement.setdefault(f"a_{comp.name}", []).append(comp.name)
 
+    if len(placement) > 512:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "thread mode with %d agents: one OS thread per agent "
+            "starves the GIL well before 1000 agents (the classic "
+            "thread-per-agent scaling wall, measured in BASELINE.md) "
+            "— prefer mode='sim', fewer agents via a distribution, or "
+            "the batched engine",
+            len(placement),
+        )
+
     from pydcop_tpu.infrastructure.discovery import Discovery
 
     comm = InProcessCommunicationLayer()
