@@ -109,6 +109,25 @@ def _measure(n_vars: int, rounds: int, chunk: int) -> dict:
             "n_devices": jax.device_count(),
         }
 
+    if n_vars < 0:  # reference-class probe: the HOST message-driven
+        # runtime (thread-per-agent architecture like pyDcop's) on
+        # |n_vars| variables — measures the "reference-runtime class"
+        # msgs/sec so vs_reference_class is a measured ratio
+        from pydcop_tpu.infrastructure import solve_host
+
+        dcop = g._make_coloring_dcop(-n_vars, degree=DEGREE, seed=1)
+        r = solve_host(
+            dcop, "maxsum", {"damping": 0.5}, mode="sim",
+            rounds=10_000, timeout=10.0,
+        )
+        return {
+            "msgs_per_sec": r["msg_count"] / r["time"],
+            "platform": "host-runtime",
+            "best_cost": r["cost"],
+            "n_vars": -n_vars,
+            "rounds": r["cycle"],
+        }
+
     dcop = g._make_coloring_dcop(n_vars, degree=DEGREE, seed=1)
     _phase("problem_built")
     problem = compile_dcop(dcop)
@@ -329,6 +348,16 @@ def main() -> None:
 
     headline = dev if dev is not None else cpu
 
+    # reference-class baseline: the host message-driven runtime (the
+    # reference's architecture) measured in-run at 1k vars — pinned to
+    # cpu, tightly bounded, optional (failure only annotates).  Probed
+    # only when there is a headline to compare against.
+    host = {}
+    if headline:
+        host = _run_sub(pin_cpu=True, timeout=90, n_vars=-1_000, rounds=0)
+        if "error" in host:
+            errors.append(f"host-runtime baseline: {host['error']}")
+
     out = {
         "metric": "maxsum_msgs_per_sec_10k_coloring",
         "value": round(headline["msgs_per_sec"]) if headline else 0,
@@ -348,6 +377,13 @@ def main() -> None:
             out["metric"] = f"maxsum_msgs_per_sec_{hv // 1000}k_coloring"
     if cpu is not None:
         out["cpu_baseline_msgs_per_sec"] = round(cpu["msgs_per_sec"])
+    if "msgs_per_sec" in host and headline:
+        # ratio vs the measured reference-ARCHITECTURE runtime (pyDcop
+        # class: message-driven host agents) — see BASELINE.md
+        out["host_runtime_msgs_per_sec"] = round(host["msgs_per_sec"])
+        out["vs_reference_class"] = round(
+            headline["msgs_per_sec"] / host["msgs_per_sec"], 1
+        )
     out["stages"] = stages
     if errors:
         out["error"] = "; ".join(errors)

@@ -177,6 +177,26 @@ def run_cmd(args) -> int:
                 "--elastic/--scenario/--ktarget (the SPMD runtime "
                 "carries the dynamics/resilience modes)"
             )
+        # algo/params usage errors fail fast and cleanly, before any
+        # agent registration
+        from pydcop_tpu.algorithms import (
+            load_algorithm_module,
+            prepare_algo_params,
+        )
+
+        try:
+            _mod = load_algorithm_module(args.algo)
+            prepare_algo_params(
+                parse_algo_params(args.algo_params), _mod.algo_params
+            )
+            if not hasattr(_mod, "build_computation"):
+                raise ValueError(
+                    f"{args.algo} has no host (message-driven) "
+                    "implementation — use the SPMD runtime for "
+                    "batched-only algorithms"
+                )
+        except ValueError as e:
+            raise SystemExit(f"orchestrator: {e}")
         try:
             result = run_host_orchestrator(
                 dcop,

@@ -34,7 +34,6 @@ def run_cmd(args) -> int:
     from pydcop_tpu.algorithms import load_algorithm_module
     from pydcop_tpu.commands._common import write_result
     from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
-    from pydcop_tpu.distribution import load_distribution_module
     from pydcop_tpu.distribution.objects import Distribution
     from pydcop_tpu.graphs import load_graph_module
     from pydcop_tpu.replication import replica_distribution

@@ -348,10 +348,14 @@ def run_host_orchestrator(
                 dcop.agents[a] if a in dcop.agents else AgentDef(a)
                 for a in agent_names
             ]
-            dist = compute_distribution(
-                distribution, graph, agent_defs,
-                hints=dcop.dist_hints, algo_module=module,
-            )
+            try:
+                dist = compute_distribution(
+                    distribution, graph, agent_defs,
+                    hints=dcop.dist_hints, algo_module=module,
+                )
+            except ValueError as e:  # unknown/impossible strategy —
+                # a usage/problem error, not an internal failure
+                raise PlacementError(str(e)) from e
             placement = {
                 a: dist.computations_hosted(a) for a in agent_names
             }
