@@ -57,6 +57,15 @@ from pydcop_tpu.ops.compile import CompiledProblem
 
 GRAPH_TYPE = "factor_graph"
 
+# Single-shard belief aggregation on the CPU backend uses one
+# segment-sum instead of the per-slot prefix gathers (the TPU shape)
+# above this many edges.  Measured (round 3): segment-sum wins at
+# EVERY size on CPU — 1.5× at 200 vars, 2.6× at 10k, 6.9× at 1M —
+# so the default is 0 (always).  The TPU keeps the gather path:
+# segment_sum lowers to scatter-add there, the worst-profiled shape.
+# tests/test_perf_guard.py raises this to pin the TPU lowering.
+CPU_SEGMENT_MIN_EDGES = 0
+
 algo_params = [
     AlgoParameterDef("damping", "float", None, 0.5),
     # deterministic per-(variable, value) perturbation added to the unary
@@ -103,38 +112,53 @@ def belief_from_r(
 ) -> jax.Array:
     """[d, n_vars] belief: unary + Σ incoming r per variable.
 
-    Single-shard: per-variable incoming-edge gathers over the padded
-    edge lists (one [d, n_vars] gather per degree slot — all lanes
-    useful).  Sharded: edges are mesh-local, so sum locally by
-    segment-sum and ``psum`` the [d, n] accumulator across the mesh.
+    Three lowerings of the same sum, chosen by backend/sharding:
+
+    - **TPU single-shard**: per-variable incoming-edge gathers over
+      the padded edge lists (one [d, n_vars] gather per degree slot,
+      real prefixes only) — segment-sum would lower to scatter-add,
+      the worst-profiled shape on that backend.
+    - **CPU single-shard**: ONE segment-sum — contiguous writes beat
+      a cache-missing gather per slot at every size (measured round
+      3: 1.5× at 200 vars to 6.9× at 1M; ``CPU_SEGMENT_MIN_EDGES``
+      gates it, default 0 = always, tests pin the TPU shape).
+    - **Sharded**: edges are mesh-local → local segment-sum, then one
+      ``psum`` of the [d, n] accumulator across the mesh.
     """
-    if axis_name is None:
-        pad = jnp.zeros((r.shape[0], 1), dtype=r.dtype)
-        r_pad = jnp.concatenate([r, pad], axis=1)  # sentinel column
-        # Per-slot gather loop over PREFIXES: variables are compiled
-        # degree-descending (ops/compile.py), so slot p's real entries
-        # are rows [0, var_slot_counts[p]) — only those are gathered.
-        # The gather is element-bound in the TPU lowering (round-3
-        # tools/bench_gather.py: every aggregation shape costs the
-        # same per element), so shrinking the gathered element count
-        # is the one lever that helps.
-        ve = problem.var_edges
-        n = ve.shape[0]
-        counts = problem.var_slot_counts or (n,) * ve.shape[1]
-        acc = unary_t
-        for p in range(ve.shape[1]):
-            n_p = min(counts[p], n)
-            if n_p == 0:
-                break  # later slots are empty too (monotone counts)
-            g = r_pad[:, ve[:n_p, p]]  # [d, n_p]
-            if n_p < n:
-                g = jnp.pad(g, ((0, 0), (0, n - n_p)))
-            acc = acc + g
-        return acc
-    local = jax.ops.segment_sum(
-        r.T, problem.edge_var, num_segments=problem.n_vars
-    )  # [n, d]
-    return jax.lax.psum(local.T, axis_name) + unary_t
+    use_segment = axis_name is not None or (
+        jax.default_backend() == "cpu"
+        and problem.n_edges >= CPU_SEGMENT_MIN_EDGES
+    )
+    if use_segment:
+        local = jax.ops.segment_sum(
+            r.T, problem.edge_var, num_segments=problem.n_vars
+        )  # [n, d]
+        if axis_name is not None:
+            local = jax.lax.psum(local, axis_name)
+        return local.T + unary_t
+    # TPU single-shard gather path.  Per-slot gather loop over
+    # PREFIXES: variables are compiled degree-descending
+    # (ops/compile.py), so slot p's real entries are rows
+    # [0, var_slot_counts[p]) — only those are gathered.  The gather
+    # is element-bound in the TPU lowering (round-3
+    # tools/bench_gather.py: every aggregation shape costs the same
+    # per element), so shrinking the gathered element count is the
+    # one lever that helps.
+    pad = jnp.zeros((r.shape[0], 1), dtype=r.dtype)
+    r_pad = jnp.concatenate([r, pad], axis=1)  # sentinel column
+    ve = problem.var_edges
+    n = ve.shape[0]
+    counts = problem.var_slot_counts or (n,) * ve.shape[1]
+    acc = unary_t
+    for p in range(ve.shape[1]):
+        n_p = min(counts[p], n)
+        if n_p == 0:
+            break  # later slots are empty too (monotone counts)
+        g = r_pad[:, ve[:n_p, p]]  # [d, n_p]
+        if n_p < n:
+            g = jnp.pad(g, ((0, 0), (0, n - n_p)))
+        acc = acc + g
+    return acc
 
 
 def step(
