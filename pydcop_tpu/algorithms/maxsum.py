@@ -53,18 +53,10 @@ import jax.numpy as jnp
 
 from pydcop_tpu.algorithms import AlgoParameterDef
 from pydcop_tpu.graphs import factor_graph as _graph
+from pydcop_tpu.ops import costs as _costs
 from pydcop_tpu.ops.compile import CompiledProblem
 
 GRAPH_TYPE = "factor_graph"
-
-# Single-shard belief aggregation on the CPU backend uses one
-# segment-sum instead of the per-slot prefix gathers (the TPU shape)
-# above this many edges.  Measured (round 3): segment-sum wins at
-# EVERY size on CPU — 1.5× at 200 vars, 2.6× at 10k, 6.9× at 1M —
-# so the default is 0 (always).  The TPU keeps the gather path:
-# segment_sum lowers to scatter-add there, the worst-profiled shape.
-# tests/test_perf_guard.py raises this to pin the TPU lowering.
-CPU_SEGMENT_MIN_EDGES = 0
 
 algo_params = [
     AlgoParameterDef("damping", "float", None, 0.5),
@@ -120,14 +112,14 @@ def belief_from_r(
       the worst-profiled shape on that backend.
     - **CPU single-shard**: ONE segment-sum — contiguous writes beat
       a cache-missing gather per slot at every size (measured round
-      3: 1.5× at 200 vars to 6.9× at 1M; ``CPU_SEGMENT_MIN_EDGES``
-      gates it, default 0 = always, tests pin the TPU shape).
+      3: 1.5× at 200 vars to 6.9× at 1M; ``ops.costs.
+      CPU_SEGMENT_MIN_EDGES`` gates it, default 0 = always, tests pin
+      the TPU shape).
     - **Sharded**: edges are mesh-local → local segment-sum, then one
       ``psum`` of the [d, n] accumulator across the mesh.
     """
-    use_segment = axis_name is not None or (
-        jax.default_backend() == "cpu"
-        and problem.n_edges >= CPU_SEGMENT_MIN_EDGES
+    use_segment = (
+        axis_name is not None or _costs.use_cpu_segment_path(problem)
     )
     if use_segment:
         local = jax.ops.segment_sum(

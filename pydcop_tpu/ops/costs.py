@@ -29,6 +29,26 @@ import jax.numpy as jnp
 
 from pydcop_tpu.ops.compile import CompiledProblem
 
+# Single-shard per-variable aggregations on the CPU backend switch
+# from the TPU-shaped per-slot prefix gathers to one segment-sum above
+# this many edges.  Measured (round 3, Max-Sum belief + local-search
+# sweep): segment-sum wins at EVERY size on CPU (1.5x at 200 vars to
+# 6.9x at 1M), so the default is 0 (always on CPU).  The TPU keeps
+# gathers — segment_sum lowers to scatter-add there, the
+# worst-profiled shape.  tests/test_perf_guard.py raises this to pin
+# the TPU lowering.
+CPU_SEGMENT_MIN_EDGES = 0
+
+
+def use_cpu_segment_path(problem: "CompiledProblem") -> bool:
+    """True when a SINGLE-SHARD per-variable aggregation should take
+    the CPU segment-sum lowering instead of the TPU gather shape —
+    the one dispatch switch shared by every aggregation call site."""
+    return (
+        jax.default_backend() == "cpu"
+        and problem.n_edges >= CPU_SEGMENT_MIN_EDGES
+    )
+
 
 def segment_sum_edges(
     problem: CompiledProblem,
@@ -37,13 +57,16 @@ def segment_sum_edges(
 ) -> jax.Array:
     """Sum per-edge rows into per-variable rows: [E, ...] → [n_vars, ...].
 
-    Single-shard path: gather via the compiler's padded per-variable
-    incoming-edge lists and reduce — XLA scatters (``segment_sum``)
-    cost ~6× a same-size gather on TPU (BASELINE.md round-1 notes).
-    Sharded path: edges are mesh-local so the replicated global edge
-    lists don't apply; keep segment-sum + ``psum``.
+    Backend-aware like ``maxsum.belief_from_r``: the TPU single-shard
+    path gathers via the compiler's padded per-variable incoming-edge
+    lists (XLA scatters / ``segment_sum`` cost ~6× a same-size gather
+    there, BASELINE.md round-1 notes); the CPU single-shard path takes
+    one ``segment_sum`` (contiguous writes beat the cache-missing
+    gather loop — same round-3 measurement series as Max-Sum's
+    belief).  Sharded path: edges are mesh-local so the replicated
+    global edge lists don't apply; segment-sum + ``psum``.
     """
-    if axis_name is None:
+    if axis_name is None and not use_cpu_segment_path(problem):
         pad = jnp.zeros(
             (1,) + per_edge.shape[1:], dtype=per_edge.dtype
         )
@@ -74,7 +97,9 @@ def segment_sum_edges(
     out = jax.ops.segment_sum(
         per_edge, problem.edge_var, num_segments=problem.n_vars
     )
-    return jax.lax.psum(out, axis_name)
+    if axis_name is not None:
+        out = jax.lax.psum(out, axis_name)
+    return out
 
 
 def local_cost_sweep(

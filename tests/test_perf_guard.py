@@ -54,10 +54,12 @@ def _count_op(txt, op):
 def test_maxsum_round_hlo_is_clean(coloring_problem, monkeypatch):
     problem = coloring_problem
     module = load_algorithm_module("maxsum")
-    # pin the TPU lowering shape: on the CPU test backend the belief
-    # aggregation would otherwise take the CPU segment-sum (scatter)
+    # pin the TPU lowering shape: on the CPU test backend the
+    # aggregations would otherwise take the CPU segment-sum (scatter)
     # path, which is deliberately NOT what runs on the accelerator
-    monkeypatch.setattr(module, "CPU_SEGMENT_MIN_EDGES", 1 << 60)
+    from pydcop_tpu.ops import costs as _costs
+
+    monkeypatch.setattr(_costs, "CPU_SEGMENT_MIN_EDGES", 1 << 60)
     params = prepare_algo_params({"damping": 0.5}, module.algo_params)
     state = module.init_state(problem, jax.random.PRNGKey(0), params)
 
@@ -106,10 +108,13 @@ def test_total_cost_hlo_is_clean(coloring_problem):
     ],
 )
 def test_local_search_round_hlo_is_clean(
-    coloring_problem, algo, params, max_lines
+    coloring_problem, algo, params, max_lines, monkeypatch
 ):
     """VERDICT r2 weak #7: the DSA/MGM/MGM-2/DBA/GDBA hot paths had no
     HLO guard, so a scatter regression there passed CI silently."""
+    from pydcop_tpu.ops import costs as _costs
+
+    monkeypatch.setattr(_costs, "CPU_SEGMENT_MIN_EDGES", 1 << 60)
     problem = coloring_problem
     module = load_algorithm_module(algo)
     full = prepare_algo_params(params, module.algo_params)
