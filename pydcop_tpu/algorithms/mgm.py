@@ -107,3 +107,12 @@ def communication_load(
 ) -> float:
     """One value + one gain message per round on each link."""
     return HEADER_SIZE + 2 * UNIT_SIZE
+
+
+def build_computation(comp_def, seed: int = 0):
+    """Host message-driven computation (round-synchronized value/gain
+    phases over real messages — the reference's MGM deployment shape);
+    batched solving uses ``init_state``/``step``."""
+    from pydcop_tpu.algorithms import _host_mgm
+
+    return _host_mgm.build_computation(comp_def, seed=seed)

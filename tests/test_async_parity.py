@@ -127,3 +127,43 @@ def test_host_sync_maxsum_matches_batched_on_tree():
         )
         assert host["cost"] == 0, host
         assert batched.best_cost == 0, batched
+
+
+def test_host_mgm_reaches_local_optimum():
+    """The message-driven MGM (round-synchronized value/gain phases,
+    _host_mgm.py) must end 1-opt locally optimal: no single variable
+    can improve the assignment — MGM's convergence guarantee."""
+    import __graft_entry__ as g
+    from pydcop_tpu.infrastructure import solve_host
+
+    for mode in ("sim", "thread"):
+        dcop = g._make_coloring_dcop(24, degree=2, seed=3)
+        r = solve_host(dcop, "mgm", {}, mode=mode, rounds=400, timeout=30)
+        final = r["final_assignment"]
+        base = dcop.solution_cost(final)
+        for name, var in dcop.variables.items():
+            for val in var.domain.values:
+                if val == final[name]:
+                    continue
+                mod = dict(final)
+                mod[name] = val
+                assert dcop.solution_cost(mod) >= base - 1e-6, (
+                    mode, name, val,
+                )
+
+
+def test_host_mgm_isolated_variable_settles_unary_best():
+    """An unconstrained variable has no message-driven phases; MGM must
+    still settle its best unary value (code-review r3 finding)."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import Domain, VariableWithCostDict
+    from pydcop_tpu.infrastructure import solve_host
+
+    d = Domain("d", "", [0, 1, 2])
+    dcop = DCOP("iso")
+    dcop.add_variable(
+        VariableWithCostDict("x", d, {0: 0.0, 1: 5.0, 2: 5.0})
+    )
+    r = solve_host(dcop, "mgm", {}, mode="sim", rounds=20, timeout=10)
+    assert r["final_assignment"]["x"] == 0
+    assert r["final_cost"] == 0.0
