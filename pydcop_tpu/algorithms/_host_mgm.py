@@ -2,20 +2,17 @@
 
 Reference-shaped Maximum Gain Messages (reference:
 ``pydcop/algorithms/mgm.py``): one computation per variable on the
-constraints hypergraph, alternating two synchronized phases per round —
+constraints hypergraph, two synchronized phases per round —
 
-1. *value*: broadcast the current value; once every neighbor's value
-   for this round is known, evaluate the best local improvement
+1. *value*: broadcast the current value; with every neighbor's value
+   known, evaluate the best local improvement
    (gain = current cost − best candidate cost),
-2. *gain*: broadcast the gain; once every neighbor's gain is known,
-   the strict neighborhood winner (ties broken by name, so exact ties
-   on symmetric problems cannot deadlock the round) moves, and the
-   next round's value broadcast starts.
+2. *gain*: broadcast the gain; the strict neighborhood winner (name
+   tie-break) moves.
 
-Messages are tagged with their round number and buffered: an
-asynchronous runtime may deliver a faster neighbor's round-(t+1)
-message before this computation finishes round t (skew is bounded by
-one phase because neighbors cannot advance without our own message).
+The round synchronization (tagged buffers, duplicate-broadcast guard,
+isolated variables, winner rule) lives in
+:class:`~pydcop_tpu.algorithms._host_twophase.TwoPhaseComputation`.
 
 Like the reference, MGM keeps exchanging messages at a fixed point
 (the values simply stop changing), so runs end on the runtime's
@@ -28,114 +25,29 @@ kernels in ``algorithms/mgm.py``), like the other host computations.
 
 from __future__ import annotations
 
-import random
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
-from pydcop_tpu.infrastructure.computations import (
-    Message,
-    VariableComputation,
-    register,
-    stable_seed,
-)
+from pydcop_tpu.algorithms._host_twophase import TwoPhaseComputation
 
 
-class MgmValueMessage(Message):
-    def __init__(self, cycle: int, value: Any):
-        super().__init__("mgm_value", (cycle, value))
-
-    @property
-    def cycle(self) -> int:
-        return self._content[0]
-
-    @property
-    def value(self) -> Any:
-        return self._content[1]
-
-
-class MgmGainMessage(Message):
-    def __init__(self, cycle: int, gain: float):
-        super().__init__("mgm_gain", (cycle, gain))
-
-    @property
-    def cycle(self) -> int:
-        return self._content[0]
-
-    @property
-    def gain(self) -> float:
-        return self._content[1]
-
-
-class HostMgmComputation(VariableComputation):
+class HostMgmComputation(TwoPhaseComputation):
     def __init__(self, comp_def, seed: int = 0):
-        super().__init__(comp_def.node.variable, comp_def)
-        self._constraints = list(comp_def.node.constraints)
-        self._sign = -1.0 if comp_def.algo.mode == "max" else 1.0
-        self._initial = comp_def.algo.params.get("initial", "random")
-        self._rnd = random.Random(stable_seed(seed, self.name))
-        self._cycle = 0
-        # round-tagged buffers: {cycle: {neighbor: payload}}
-        self._values: Dict[int, Dict[str, Any]] = {}
-        self._gains: Dict[int, Dict[str, float]] = {}
+        super().__init__(comp_def, seed=seed)
         self._candidate: Any = None
         self._gain = 0.0
-        self._gain_sent_cycle = -1  # guard against re-broadcasting
 
-    # -- helpers --------------------------------------------------------
-
-    def _neighbor_set(self):
-        return set(self.neighbors)
-
-    def on_start(self) -> None:
-        if self._initial == "declared" and (
-            self._variable.initial_value is not None
-        ):
-            self.value_selection(self._variable.initial_value)
-        else:
-            self.value_selection(self.random_value(self._rnd))
-        if not self._neighbor_set():
-            # unconstrained variable: no phases will ever fire (both
-            # are message-driven) — settle the best unary value NOW so
-            # the 1-opt guarantee holds for isolated variables too
-            best = min(
-                self._variable.domain.values,
-                key=lambda val: self._local_cost(val, {}),
-            )
-            self.value_selection(best)
-            return
-        self.post_to_all_neighbors(
-            MgmValueMessage(self._cycle, self.current_value)
-        )
-
-    def _local_cost(self, value: Any, neighbor_values: Dict[str, Any]):
-        v = self._variable
-        cost = self._sign * (
-            v.cost_for_val(value) if v.has_cost else 0.0
-        )
+    def _local_cost(self, value: Any, nv: Dict[str, Any]) -> float:
+        cost = self._raw_unary(value)
         for c in self._constraints:
-            assignment = {v.name: value}
-            for d in c.dimensions:
-                if d.name != v.name:
-                    assignment[d.name] = neighbor_values[d.name]
-            cost += self._sign * c.get_value_for_assignment(assignment)
+            cost += self._constraint_cost(c, value, nv)
         return cost
 
-    # -- phase 1: values in → gain out ---------------------------------
+    # phase 1 payload: the current value
+    def initial_payload(self) -> Any:
+        return self.current_value
 
-    @register("mgm_value")
-    def _on_value(self, sender: str, msg: MgmValueMessage, t: float) -> None:
-        if msg.cycle < self._cycle:
-            return  # late duplicate for a completed round
-        self._values.setdefault(msg.cycle, {})[sender] = msg.value
-        self._maybe_finish_value_phase()
-
-    def _maybe_finish_value_phase(self) -> None:
-        if self._gain_sent_cycle >= self._cycle:
-            return  # this round's gain already went out — waiting on
-            # neighbor gains; a buffered next-round value must not
-            # re-fire the value phase (it would re-broadcast the gain)
-        got = self._values.get(self._cycle, {})
-        if set(got) != self._neighbor_set():
-            return
+    # all neighbor values in → gain out
+    def finish_phase1(self, got: Dict[str, Any]) -> float:
         current = self._local_cost(self.current_value, got)
         best_val, best_cost = self.current_value, current
         for val in self._variable.domain.values:
@@ -144,44 +56,13 @@ class HostMgmComputation(VariableComputation):
                 best_val, best_cost = val, c
         self._candidate = best_val
         self._gain = current - best_cost
-        self._gain_sent_cycle = self._cycle
-        self.post_to_all_neighbors(
-            MgmGainMessage(self._cycle, self._gain)
-        )
-        self._maybe_finish_gain_phase()
+        return self._gain
 
-    # -- phase 2: gains in → move + next round -------------------------
-
-    @register("mgm_gain")
-    def _on_gain(self, sender: str, msg: MgmGainMessage, t: float) -> None:
-        if msg.cycle < self._cycle:
-            return  # late duplicate for a completed round
-        self._gains.setdefault(msg.cycle, {})[sender] = msg.gain
-        self._maybe_finish_gain_phase()
-
-    def _maybe_finish_gain_phase(self) -> None:
-        # gains only resolve after OUR gain for this round went out
-        if self._gain_sent_cycle < self._cycle:
-            return
-        got = self._gains.get(self._cycle, {})
-        if set(got) != self._neighbor_set():
-            return
-        win = self._gain > 1e-9 and all(
-            self._gain > g + 1e-9
-            or (abs(self._gain - g) <= 1e-9 and self.name < n)
-            for n, g in got.items()
-        )
-        if win:
+    # all neighbor gains in → the strict winner moves
+    def finish_round(self, got: Dict[str, float]) -> Any:
+        if self.strict_winner(self._gain, got):
             self.value_selection(self._candidate)
-        # round complete: drop buffers, advance, broadcast next value
-        self._values.pop(self._cycle, None)
-        self._gains.pop(self._cycle, None)
-        self._cycle += 1
-        self.post_to_all_neighbors(
-            MgmValueMessage(self._cycle, self.current_value)
-        )
-        # a faster neighbor's next-round value may already be buffered
-        self._maybe_finish_value_phase()
+        return self.current_value
 
 
 def build_computation(comp_def, seed: int = 0):

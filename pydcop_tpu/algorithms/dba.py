@@ -187,3 +187,12 @@ def communication_load(
     node: _graph.VariableComputationNode, neighbor_name: str
 ) -> float:
     return 2 * UNIT_SIZE
+
+
+def build_computation(comp_def, seed: int = 0):
+    """Host message-driven computation (round-synchronized ok?/improve
+    phases with per-computation breakout weights — the reference's DBA
+    deployment shape); batched solving uses ``init_state``/``step``."""
+    from pydcop_tpu.algorithms import _host_dba
+
+    return _host_dba.build_computation(comp_def, seed=seed)

@@ -167,3 +167,18 @@ def test_host_mgm_isolated_variable_settles_unary_best():
     r = solve_host(dcop, "mgm", {}, mode="sim", rounds=20, timeout=10)
     assert r["final_assignment"]["x"] == 0
     assert r["final_cost"] == 0.0
+
+
+def test_host_dba_breaks_out_of_local_minimum():
+    """Message-driven DBA (_host_dba.py): the weight-increase breakout
+    must escape the local optimum MGM gets stuck in on the same
+    instance — ending conflict-free (cost = noise only)."""
+    import __graft_entry__ as g
+    from pydcop_tpu.infrastructure import solve_host
+
+    dcop = g._make_coloring_dcop(24, degree=2, seed=3)
+    r_mgm = solve_host(dcop, "mgm", {}, mode="sim", rounds=400, timeout=30)
+    r_dba = solve_host(dcop, "dba", {}, mode="sim", rounds=400, timeout=30)
+    # the coloring penalty per conflict is 1; noise sums to < 0.5
+    assert r_mgm["cost"] > 1.0  # MGM: stuck with >= 1 conflict
+    assert r_dba["cost"] < 0.5  # DBA: broke out, zero conflicts
