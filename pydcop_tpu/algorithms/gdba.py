@@ -270,3 +270,13 @@ def communication_load(
     node: _graph.VariableComputationNode, neighbor_name: str
 ) -> float:
     return 2 * UNIT_SIZE
+
+
+def build_computation(comp_def, seed: int = 0):
+    """Host message-driven computation (round-synchronized ok?/improve
+    phases with synchronized per-cell weight increases — the
+    reference's GDBA deployment shape); batched solving uses
+    ``init_state``/``step``."""
+    from pydcop_tpu.algorithms import _host_gdba
+
+    return _host_gdba.build_computation(comp_def, seed=seed)

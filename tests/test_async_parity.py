@@ -182,3 +182,41 @@ def test_host_dba_breaks_out_of_local_minimum():
     # the coloring penalty per conflict is 1; noise sums to < 0.5
     assert r_mgm["cost"] > 1.0  # MGM: stuck with >= 1 conflict
     assert r_dba["cost"] < 0.5  # DBA: broke out, zero conflicts
+
+
+def test_host_gdba_breaks_out_and_syncs_weights():
+    """Message-driven GDBA (_host_gdba.py): the cell-targeted increase
+    modes (E/R/C) escape the local minimum, and endpoint copies of the
+    per-cell weight tables stay identical (the flags carry explicit
+    cell lists, applied additively like the batched delta)."""
+    import __graft_entry__ as g
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.infrastructure import solve_host
+    from pydcop_tpu.infrastructure.runtime import (
+        _build_computations,
+        _run_sim,
+    )
+
+    dcop = g._make_coloring_dcop(24, degree=2, seed=3)
+    for imode in ("E", "R", "C"):
+        r = solve_host(
+            dcop, "gdba", {"increase_mode": imode}, mode="sim",
+            rounds=400, timeout=30,
+        )
+        assert r["cost"] < 0.5, (imode, r["cost"])  # conflict-free
+
+    module = load_algorithm_module("gdba")
+    params = prepare_algo_params({}, module.algo_params)
+    comps = _build_computations(dcop, "gdba", params, seed=0)
+    _run_sim(comps, 30.0, 40_000, 0, 0.0, lambda: None)
+    tables = {}
+    for comp in comps:
+        for cname, wt in comp._weights.items():
+            key = tuple(sorted(wt.items()))
+            tables.setdefault(cname, set()).add(key)
+    assert all(len(v) == 1 for v in tables.values())
+    # breakout actually fired somewhere
+    assert any(wt for comp in comps for wt in comp._weights.values())
