@@ -189,6 +189,8 @@ def test_host_gdba_breaks_out_and_syncs_weights():
     modes (E/R/C) escape the local minimum, and endpoint copies of the
     per-cell weight tables stay identical (the flags carry explicit
     cell lists, applied additively like the batched delta)."""
+    import time
+
     import __graft_entry__ as g
     from pydcop_tpu.algorithms import (
         load_algorithm_module,
@@ -201,6 +203,9 @@ def test_host_gdba_breaks_out_and_syncs_weights():
     )
 
     dcop = g._make_coloring_dcop(24, degree=2, seed=3)
+    # the same instance MGM stays stuck on (test_host_dba_breaks_out)
+    r_mgm = solve_host(dcop, "mgm", {}, mode="sim", rounds=400, timeout=30)
+    assert r_mgm["cost"] > 1.0
     for imode in ("E", "R", "C"):
         r = solve_host(
             dcop, "gdba", {"increase_mode": imode}, mode="sim",
@@ -211,7 +216,11 @@ def test_host_gdba_breaks_out_and_syncs_weights():
     module = load_algorithm_module("gdba")
     params = prepare_algo_params({}, module.algo_params)
     comps = _build_computations(dcop, "gdba", params, seed=0)
-    _run_sim(comps, 30.0, 40_000, 0, 0.0, lambda: None)
+    # t0 is a perf_counter() origin — 0.0 would trip the timeout on
+    # the first delivery and run zero messages (round-3 bug)
+    _run_sim(comps, 30.0, 40_000, 0, time.perf_counter(), lambda: None)
+    final = {c.name: c.current_value for c in comps}
+    assert dcop.solution_cost(final) < 0.5  # escaped the minimum
     tables = {}
     for comp in comps:
         for cname, wt in comp._weights.items():
