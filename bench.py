@@ -53,6 +53,7 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE_DIR = os.path.join(REPO, ".xla_cache")
+TPU_LOG = os.path.join(REPO, "BENCH_TPU_LOG.jsonl")
 
 # Last-resort constant (BASELINE.md CPU row) used ONLY if the in-run CPU
 # measurement itself fails; flagged via the "error" field when used.
@@ -69,6 +70,60 @@ STAGES = [
     ("small", 1_000, 256, 180.0),
     ("north_star", N_VARS, ROUNDS, 300.0),
 ]
+
+
+def _git_sha() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=REPO, capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def append_tpu_log(workload: str, msgs_per_sec: float, **extra) -> None:
+    """Persist a successful TPU measurement to BENCH_TPU_LOG.jsonl.
+
+    The axon TPU tunnel has multi-hour outages that have eaten the
+    driver's live bench in rounds 1-3 (VERDICT r3 weak #2); every
+    successful TPU measurement — staged-bench stages, watcher
+    captures, tools — appends here so a later bench run can surface
+    the last-good number with provenance even when the tunnel is down.
+    """
+    entry = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "sha": _git_sha(),
+        "workload": workload,
+        "msgs_per_sec": round(float(msgs_per_sec)),
+    }
+    entry.update(extra)
+    try:
+        line = json.dumps(entry, default=float)
+        with open(TPU_LOG, "a") as f:
+            f.write(line + "\n")
+    except (OSError, TypeError, ValueError):
+        pass  # logging must never break a measurement
+
+
+def last_good_tpu(workload: str | None = None) -> dict | None:
+    """Latest BENCH_TPU_LOG.jsonl entry (exact workload match, or any)."""
+    try:
+        with open(TPU_LOG) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            entry = json.loads(line)
+        except ValueError:
+            continue
+        if workload is None or entry.get("workload") == workload:
+            return entry
+    return None
 
 
 _PHASE_T0 = time.perf_counter()
@@ -293,6 +348,13 @@ def _staged_default_backend() -> tuple:
         final_ok[stage] = True
         if "msgs_per_sec" in r:
             best = r
+            if r.get("platform") == "tpu":
+                append_tpu_log(
+                    f"maxsum_coloring_{r.get('n_vars', n_vars)}",
+                    r["msgs_per_sec"],
+                    best_cost=r.get("best_cost"),
+                    source="bench_stage_" + stage,
+                )
 
     # localization probe: north star failed but 1k worked → try 4k so
     # the report pins the breaking scale and the headline is stronger
@@ -304,6 +366,13 @@ def _staged_default_backend() -> tuple:
         report.append(_stage_entry("mid_4k", r, ok))
         if ok and "msgs_per_sec" in r:
             best = r
+            if r.get("platform") == "tpu":
+                append_tpu_log(
+                    f"maxsum_coloring_{r.get('n_vars', 4000)}",
+                    r["msgs_per_sec"],
+                    best_cost=r.get("best_cost"),
+                    source="bench_stage_mid_4k",
+                )
     return best, report
 
 
@@ -385,6 +454,42 @@ def main() -> None:
             headline["msgs_per_sec"] / host["msgs_per_sec"], 1
         )
     out["stages"] = stages
+    if (
+        headline is None
+        or headline.get("platform") != "tpu"
+        or headline.get("n_vars", 0) < N_VARS  # partial outage: only a
+        # shallow stage survived on TPU — still surface the strongest
+        # persisted north-star evidence
+    ):
+        # the live TPU stage failed (or fell back to cpu): surface the
+        # last persisted TPU measurement with provenance so the driver
+        # round still carries machine-readable TPU evidence
+        last = last_good_tpu("maxsum_coloring_10000") or last_good_tpu()
+        if last is not None:
+            try:
+                import calendar
+
+                age_h = (
+                    time.time()
+                    - calendar.timegm(
+                        time.strptime(last["ts"], "%Y-%m-%dT%H:%M:%SZ")
+                    )
+                ) / 3600.0
+            except (KeyError, ValueError):
+                age_h = None
+            out["last_good_tpu"] = {
+                "msgs_per_sec": last.get("msgs_per_sec"),
+                "workload": last.get("workload"),
+                "sha": last.get("sha"),
+                "ts": last.get("ts"),
+                "age_hours": round(age_h, 1) if age_h is not None else None,
+                "source": last.get("source"),
+                "provenance": (
+                    "persisted from an earlier successful TPU "
+                    "measurement (BENCH_TPU_LOG.jsonl); NOT measured "
+                    "in this bench run"
+                ),
+            }
     if errors:
         out["error"] = "; ".join(errors)
     print(json.dumps(out))
