@@ -316,6 +316,17 @@ def _stage_entry(stage: str, r: dict, ok: bool) -> dict:
     return entry
 
 
+def _log_if_tpu(r: dict, source: str) -> None:
+    """Persist a successful TPU stage measurement (no-op otherwise)."""
+    if r.get("platform") == "tpu" and "msgs_per_sec" in r:
+        append_tpu_log(
+            f"maxsum_coloring_{r.get('n_vars', 0)}",
+            r["msgs_per_sec"],
+            best_cost=r.get("best_cost"),
+            source=source,
+        )
+
+
 def _staged_default_backend() -> tuple:
     """Run the staged probes on the default backend.
 
@@ -348,13 +359,7 @@ def _staged_default_backend() -> tuple:
         final_ok[stage] = True
         if "msgs_per_sec" in r:
             best = r
-            if r.get("platform") == "tpu":
-                append_tpu_log(
-                    f"maxsum_coloring_{r.get('n_vars', n_vars)}",
-                    r["msgs_per_sec"],
-                    best_cost=r.get("best_cost"),
-                    source="bench_stage_" + stage,
-                )
+            _log_if_tpu(r, "bench_stage_" + stage)
 
     # localization probe: north star failed but 1k worked → try 4k so
     # the report pins the breaking scale and the headline is stronger
@@ -366,13 +371,7 @@ def _staged_default_backend() -> tuple:
         report.append(_stage_entry("mid_4k", r, ok))
         if ok and "msgs_per_sec" in r:
             best = r
-            if r.get("platform") == "tpu":
-                append_tpu_log(
-                    f"maxsum_coloring_{r.get('n_vars', 4000)}",
-                    r["msgs_per_sec"],
-                    best_cost=r.get("best_cost"),
-                    source="bench_stage_mid_4k",
-                )
+            _log_if_tpu(r, "bench_stage_mid_4k")
     return best, report
 
 

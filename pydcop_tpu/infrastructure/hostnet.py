@@ -57,6 +57,24 @@ from pydcop_tpu.infrastructure.computations import Message
 
 _ENC = "utf-8"
 
+# per-destination outbound queue bound (frames): the backpressure
+# high-water mark that keeps a slow-but-alive peer from growing a
+# sender's memory without limit
+MAX_QUEUED_FRAMES = 10_000
+
+
+class _DestChannel:
+    """One destination's outbound state: pending frames, a condition
+    sharing the layer lock (so only this destination's writer and
+    backpressured senders are woken), and the dead-link marker."""
+
+    __slots__ = ("frames", "cond", "dead")
+
+    def __init__(self, lock: threading.Lock):
+        self.frames: List[bytes] = []
+        self.cond = threading.Condition(lock)
+        self.dead: Optional[str] = None
+
 
 class TcpCommunicationLayer(CommunicationLayer):
     """Message-plane transport: one listener per process, pooled
@@ -68,11 +86,37 @@ class TcpCommunicationLayer(CommunicationLayer):
          "p": priority, "m": simple_repr(message)}
     """
 
-    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        bind_host: str = "127.0.0.1",
+        port: int = 0,
+        on_send_error=None,
+    ):
         super().__init__()
         self.addresses: Dict[str, Tuple[str, int]] = {}
-        self._pool: Dict[Tuple[str, int], socket.socket] = {}
-        self._pool_lock = threading.Lock()
+        # outbound: one bounded FIFO queue + writer thread per
+        # destination, so a slow or unresponsive peer (blocking
+        # connect/sendall, up to 10s) only stalls ITS queue — the
+        # sending (pump) thread never blocks on the network for other
+        # destinations.  The bound restores the old blocking-send
+        # backpressure per destination: a slow-but-alive peer blocks
+        # senders to IT at MAX_QUEUED frames instead of growing the
+        # queue without limit.
+        self._channels: Dict[Tuple[str, int], "_DestChannel"] = {}
+        self._lock = threading.Lock()
+        # send failures are asynchronous now: surfaced through this
+        # callback (agent → errors list → status reply → orchestrator
+        # fails the run), preserving the old fail-fast behavior; with
+        # no callback the failure is logged (never silent)
+        self.on_send_error = on_send_error
+        # messages handed to the transport (local + remote): one half
+        # of the two-counter quiescence rule — the orchestrator may
+        # declare quiescence only when global sent == global delivered,
+        # otherwise a frame queued here or in flight on a slow TCP
+        # link is invisible and the run can end mid-propagation.
+        # Guarded by _lock: a lost increment would leave sent <
+        # delivered forever and break quiescence.
+        self.count_sent = 0
         self._server = socket.create_server(
             (bind_host, port), reuse_port=False
         )
@@ -144,6 +188,8 @@ class TcpCommunicationLayer(CommunicationLayer):
         local = self.discovery.get(dest_agent)
         if local is not None:  # same process: no serialization
             local.deliver(src_comp, dest_comp, msg, priority)
+            with self._lock:
+                self.count_sent += 1
             return
         addr = self.addresses.get(dest_agent)
         if addr is None:
@@ -162,30 +208,85 @@ class TcpCommunicationLayer(CommunicationLayer):
             )
             + "\n"
         ).encode(_ENC)
-        with self._pool_lock:
-            conn = self._pool.get(addr)
-            try:
+        with self._lock:
+            ch = self._channels.get(addr)
+            if ch is None:
+                ch = self._channels[addr] = _DestChannel(self._lock)
+                threading.Thread(
+                    target=self._writer_loop,
+                    args=(addr, ch, dest_agent),
+                    name=f"hostnet-send-{addr[0]}:{addr[1]}",
+                    daemon=True,
+                ).start()
+            # bounded queue = per-destination backpressure; only
+            # senders to THIS peer ever block here
+            while (
+                len(ch.frames) >= MAX_QUEUED_FRAMES
+                and ch.dead is None
+                and not self._closing
+            ):
+                ch.cond.wait()
+            if ch.dead is not None:
+                raise UnreachableAgent(f"{dest_agent}: {ch.dead}")
+            # counted at ENQUEUE: a queued-but-unsent frame must keep
+            # sent > delivered so quiescence cannot fire mid-flight
+            self.count_sent += 1
+            ch.frames.append(frame)
+            ch.cond.notify_all()
+
+    def _writer_loop(
+        self, addr: Tuple[str, int], ch: "_DestChannel", dest_agent: str
+    ) -> None:
+        """Drain one destination's queue over a persistent connection.
+
+        A failure marks the destination dead and reports it through
+        ``on_send_error`` — the run is failed by the control plane
+        (the old synchronous path raised into the pump instead)."""
+        conn: Optional[socket.socket] = None
+        try:
+            while True:
+                with self._lock:
+                    while not ch.frames and not self._closing:
+                        ch.cond.wait()
+                    if self._closing and not ch.frames:
+                        return
+                    batch = ch.frames
+                    ch.frames = []
+                    ch.cond.notify_all()  # wake backpressured senders
                 if conn is None:
                     conn = socket.create_connection(addr, timeout=10)
-                    self._pool[addr] = conn
-                conn.sendall(frame)
-            except OSError as e:
-                self._pool.pop(addr, None)
-                raise UnreachableAgent(f"{dest_agent}: {e}") from e
+                conn.sendall(b"".join(batch))
+        except OSError as e:
+            with self._lock:
+                ch.dead = str(e)
+                ch.frames = []
+                ch.cond.notify_all()
+            cb = self.on_send_error
+            if cb is not None:
+                cb(dest_agent, e)
+            else:
+                import logging
 
-    def close(self) -> None:
-        self._closing = True
-        try:
-            self._server.close()
-        except OSError:
-            pass
-        with self._pool_lock:
-            for conn in self._pool.values():
+                logging.getLogger(__name__).warning(
+                    "hostnet: dropping messages to %s (%s): %s",
+                    dest_agent, addr, e,
+                )
+        finally:
+            if conn is not None:
                 try:
                     conn.close()
                 except OSError:
                     pass
-            self._pool.clear()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closing = True
+            for ch in self._channels.values():
+                ch.cond.notify_all()
+        try:
+            self._server.close()
+        except OSError:
+            pass
 
 
 # -- control-plane helpers (same framing as the SPMD orchestrator) ------
@@ -404,6 +505,11 @@ def run_host_orchestrator(
             )
         for name in peers:
             conn, reader = peers[name]
+            # deploy = yaml parse + graph build + computation
+            # construction on the agent — a large DCOP legitimately
+            # takes longer than a status poll, so the ack read gets
+            # the registration budget, not poll_timeout
+            conn.settimeout(register_timeout)
             try:
                 ack = _recv(reader)
             except (OSError, ValueError) as e:
@@ -411,6 +517,8 @@ def run_host_orchestrator(
                     f"agent {name} died during deploy "
                     f"({type(e).__name__})"
                 ) from e
+            finally:
+                conn.settimeout(poll_timeout)
             if not ack or ack.get("type") != "deployed":
                 raise AgentFailureError(f"agent {name} failed to deploy")
 
@@ -445,11 +553,16 @@ def run_host_orchestrator(
 
             ui = UiServer(ui_port)
 
+        def _complete(assignment: Dict[str, Any]) -> bool:
+            """Every variable covered, every value selected — the one
+            predicate both the sampler and the final collect use."""
+            return set(assignment) == set(dcop.variables) and not any(
+                v is None for v in assignment.values()
+            )
+
         def _sample_best(delivered: int = 0) -> None:
             assignment, _, _ = _collect()
-            if any(v is None for v in assignment.values()) or set(
-                assignment
-            ) != set(dcop.variables):
+            if not _complete(assignment):
                 return  # some variable has no selected value yet
             cost = dcop.solution_cost(assignment)
             if sign * cost < best["cost"]:
@@ -470,10 +583,14 @@ def run_host_orchestrator(
         while True:
             time.sleep(0.05)
             total = 0
+            total_sent = 0
             all_idle = True
             for name in peers:
                 st = _ask(name, {"type": "status?"})
                 total += st["delivered"]
+                # missing field (older agent) degrades to the old
+                # idle+stability rule instead of never quiescing
+                total_sent += st.get("sent", st["delivered"])
                 all_idle = all_idle and st["idle"]
             now = time.perf_counter()
             if now - last_sample >= best_sample_period:
@@ -485,7 +602,12 @@ def run_host_orchestrator(
             if total >= max_msgs:
                 status = "msg_budget"
                 break
-            if all_idle and total == last_total:
+            # two-counter quiescence: every agent idle, every SENT
+            # frame also DELIVERED (nothing in flight on any TCP
+            # link), and the totals stable across 3 polls — idle +
+            # stability alone can declare quiescence mid-propagation
+            # on a slow link (advisor r3, medium)
+            if all_idle and total_sent == total and total == last_total:
                 stable += 1
                 if stable >= 3:
                     break
@@ -494,10 +616,25 @@ def run_host_orchestrator(
             last_total = total
 
         final_assignment, delivered, size = _collect()
-        final_cost = dcop.solution_cost(final_assignment)
-        if sign * final_cost < best["cost"]:
-            best["cost"] = sign * final_cost
-            best["assignment"] = final_assignment
+        # same guard as _sample_best: under a very short timeout or
+        # budget an agent may report values before its computations
+        # started (None) — solution_cost would crash inside constraint
+        # evaluation; fall back to the best sampled assignment, or
+        # fail cleanly when no complete snapshot ever existed
+        if _complete(final_assignment):
+            final_cost = dcop.solution_cost(final_assignment)
+            if sign * final_cost < best["cost"]:
+                best["cost"] = sign * final_cost
+                best["assignment"] = final_assignment
+        elif best["assignment"]:
+            final_assignment = best["assignment"]
+            final_cost = sign * best["cost"]
+        else:
+            raise AgentFailureError(
+                "run ended before any complete assignment was "
+                "collected (timeout/message budget too short for the "
+                "computations to start)"
+            )
         if ui is not None:  # final event: the BEST pair (cost and
             # values belong together, matching the SPMD orchestrator)
             ui.publish(
@@ -568,7 +705,15 @@ def run_host_agent(
     conn.settimeout(None)
     reader = conn.makefile("rb")
 
-    comm = TcpCommunicationLayer()
+    # handler/transport errors surface through the next status reply
+    # (a dead pump or dead peer link must never masquerade as
+    # quiescence) — shared by the agent pump and the async senders
+    errors: List[str] = []
+    comm = TcpCommunicationLayer(
+        on_send_error=lambda dest, e: errors.append(
+            f"send to {dest}: {e!r}"
+        )
+    )
     _send(
         conn,
         {
@@ -607,9 +752,6 @@ def run_host_agent(
         for cname in comps:
             directory.register_computation(cname, aname)
 
-    # handler/transport errors surface through the next status reply
-    # (a dead pump must never masquerade as quiescence)
-    errors: List[str] = []
     agent = Agent(
         name, comm,
         on_error=lambda comp, e: errors.append(f"{comp}: {e!r}"),
@@ -647,6 +789,7 @@ def run_host_agent(
                         "type": "status",
                         "idle": agent.is_idle,
                         "delivered": agent.messaging.count_msg,
+                        "sent": comm.count_sent,
                         "error": errors[0] if errors else None,
                     },
                 )

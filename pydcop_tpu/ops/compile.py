@@ -364,6 +364,21 @@ def _pack_runs(
     def _is_shared(sc: np.ndarray, tb: np.ndarray) -> bool:
         return tb.shape[0] == 1 and sc.shape[0] > 1
 
+    # offsets are int32 (this IS the ~1M-variable entry point): beyond
+    # 2^31 flat table cells the offset assignments below would silently
+    # wrap — corrupt offsets, wrong costs, no error.  Refuse up front.
+    total_cells = sum(
+        (1 if _is_shared(sc, tb) else sc.shape[0]) * d_max**k
+        for k, sc, tb in runs
+    )
+    if total_cells > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"problem too large for int32 table offsets: the flat "
+            f"table needs {total_cells} cells "
+            f"(> {np.iinfo(np.int32).max}); reduce domain size, "
+            "arity, or constraint count — or split the problem"
+        )
+
     # flat form (constraint-major): offsets/scopes/strides per run
     offsets = np.zeros(n_cons, dtype=np.int32)
     con_scopes = np.zeros((n_cons, k_max), dtype=np.int32)

@@ -102,6 +102,43 @@ def test_host_runtime_two_processes(tmp_path, algo):
                 proc.communicate()
 
 
+def test_tcp_layer_dead_peer_reports_and_raises():
+    """A dead destination must (1) surface asynchronously through
+    on_send_error — the async writer replaced the old synchronous
+    raise — and (2) fail subsequent sends to it fast with
+    UnreachableAgent, while count_sent keeps sent >= delivered so the
+    two-counter quiescence rule can never fire with frames lost."""
+    import socket as _socket
+
+    from pydcop_tpu.infrastructure.communication import UnreachableAgent
+    from pydcop_tpu.infrastructure.computations import Message
+    from pydcop_tpu.infrastructure.hostnet import TcpCommunicationLayer
+
+    # reserve a port with nothing listening on it
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+
+    errors = []
+    layer = TcpCommunicationLayer(
+        on_send_error=lambda dest, e: errors.append((dest, e))
+    )
+    try:
+        layer.set_addresses({"ghost": ("127.0.0.1", dead_port)})
+        layer.send_msg("ghost", "c1", "c2", Message("m", 1))
+        deadline = time.time() + 15
+        while not errors and time.time() < deadline:
+            time.sleep(0.02)
+        assert errors and errors[0][0] == "ghost", errors
+        with pytest.raises(UnreachableAgent):
+            layer.send_msg("ghost", "c1", "c2", Message("m", 2))
+        # the lost frame stays counted: sent can only exceed delivered
+        assert layer.count_sent == 1
+    finally:
+        layer.close()
+
+
 def test_host_runtime_agent_death_fails_cleanly():
     """An agent connection dying mid-solve must fail the orchestrator
     with AgentFailureError promptly — exercised deterministically with

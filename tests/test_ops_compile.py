@@ -222,6 +222,26 @@ def test_arity_guard():
         compile_dcop(dcop)
 
 
+def test_int32_offset_overflow_guard():
+    """A problem whose flat table would exceed 2^31 cells must be
+    refused up front — int32 offsets would otherwise silently wrap
+    into corrupt table indices (advisor r3)."""
+    from pydcop_tpu.ops.compile import _pack_runs
+
+    # 1 arity-3 constraint at padded domain 1300: 1300^3 > 2^31 cells.
+    # The guard fires before any table memory is touched, so a tiny
+    # placeholder table array is enough.
+    runs = [
+        (
+            3,
+            np.array([[0, 1, 2]], dtype=np.int32),
+            np.zeros((1, 1), dtype=np.float32),
+        )
+    ]
+    with pytest.raises(ValueError, match="int32 table offsets"):
+        _pack_runs(runs, n_vars=3, d_max=1300, dtype=np.float32)
+
+
 # -- compile_from_arrays: the array-level fast path ---------------------
 
 

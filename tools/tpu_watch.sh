@@ -31,7 +31,16 @@ for i in $(seq 1 "$MAX"); do
     if [ "$rc" -eq 0 ] && grep -q '"backend": *"tpu"' "$OUT/bench.json"; then
       timeout -k 30 3000 python bench_configs.py --json \
         > "$OUT/configs.json" 2> "$OUT/configs.err"
-      echo "[tpu_watch] configs done rc=$?" | tee -a "$OUT/watch.log"
+      crc=$?
+      echo "[tpu_watch] configs done rc=$crc" | tee -a "$OUT/watch.log"
+      # the configs capture must ALSO be TPU evidence: a tunnel drop
+      # between the two runs would leave CPU-fallback numbers here
+      if [ "$crc" -ne 0 ] || ! grep -q '"platform": *"tpu"' "$OUT/configs.json" \
+          || grep -q '"platform": *"cpu"' "$OUT/configs.json"; then
+        mv "$OUT/configs.json" "$OUT/configs.SUSPECT.json" 2>/dev/null
+        echo "[tpu_watch] configs capture NOT all-TPU — kept bench.json," \
+          "configs marked SUSPECT" | tee -a "$OUT/watch.log"
+      fi
       exit 0
     fi
     echo "[tpu_watch] capture incomplete — resuming probes" \
