@@ -1,26 +1,17 @@
-"""Shared two-phase round synchronization for host computations.
+"""Two-phase round synchronization for host computations.
 
-MGM and DBA (and the reference's other coordinated local-search
+MGM and DBA/GDBA (and the reference's other coordinated local-search
 algorithms) share one message-driven skeleton: per round, every
 variable broadcasts a phase-1 payload to its hypergraph neighbors,
 completes phase 1 once all neighbor payloads for the round arrived,
 broadcasts a phase-2 payload, and completes the round once all
-phase-2 payloads arrived.  This base class owns everything that was
-previously duplicated (and had already drifted) between
-``_host_mgm.py`` and ``_host_dba.py``:
+phase-2 payloads arrived.
 
-- round-tagged buffers with late-message dropping (bounded memory),
-- the phase-2-already-sent guard (a buffered next-round phase-1
-  message must not re-complete the current round's phase 1 and
-  re-broadcast phase 2 — without it roughly half the message budget
-  went to duplicates),
-- the strict neighborhood winner rule with name tie-break (``EPS``
-  matches the batched kernels' ``algorithms._common.EPS`` so the two
-  engines resolve near-ties identically),
-- isolated-variable settling (no neighbors → no phases ever fire →
-  pick the best unary value at start).
-
-Subclasses implement three hooks:
+The synchronization machinery (tagged buffers, monotone phase cursor,
+winner rule, isolated variables) lives in the N-phase generalization
+:class:`~pydcop_tpu.algorithms._host_phased.PhasedComputation`
+(MGM-2's five phases forced the generalization); this class only maps
+the two-phase hook names onto it:
 
 - :meth:`initial_payload` — the phase-1 payload opening a round,
 - :meth:`finish_phase1` — all neighbor phase-1 payloads in; return
@@ -31,62 +22,17 @@ Subclasses implement three hooks:
 
 from __future__ import annotations
 
-import random
 from typing import Any, Dict
 
-from pydcop_tpu.algorithms._common import EPS
-from pydcop_tpu.infrastructure.computations import (
-    Message,
-    VariableComputation,
-    register,
-    stable_seed,
-)
+from pydcop_tpu.algorithms._host_phased import PhasedComputation
 
 
-class Phase1Message(Message):
-    def __init__(self, cycle: int, payload: Any):
-        super().__init__("tp_phase1", (cycle, payload))
-
-    @property
-    def cycle(self) -> int:
-        return self._content[0]
-
-    @property
-    def payload(self) -> Any:
-        return self._content[1]
-
-
-class Phase2Message(Message):
-    def __init__(self, cycle: int, payload: Any):
-        super().__init__("tp_phase2", (cycle, payload))
-
-    @property
-    def cycle(self) -> int:
-        return self._content[0]
-
-    @property
-    def payload(self) -> Any:
-        return self._content[1]
-
-
-class TwoPhaseComputation(VariableComputation):
+class TwoPhaseComputation(PhasedComputation):
     """Round-synchronized two-phase computation (see module docs)."""
 
-    def __init__(self, comp_def, seed: int = 0):
-        super().__init__(comp_def.node.variable, comp_def)
-        self._constraints = list(comp_def.node.constraints)
-        self._sign = -1.0 if comp_def.algo.mode == "max" else 1.0
-        self._initial = comp_def.algo.params.get("initial", "random")
-        self._rnd = random.Random(stable_seed(seed, self.name))
-        self._cycle = 0
-        self._p1: Dict[int, Dict[str, Any]] = {}
-        self._p2: Dict[int, Dict[str, Any]] = {}
-        self._p2_sent_cycle = -1
+    N_PHASES = 2
 
     # -- subclass hooks -------------------------------------------------
-
-    def initial_payload(self) -> Any:
-        raise NotImplementedError
 
     def finish_phase1(self, got: Dict[str, Any]) -> Any:
         """All phase-1 payloads for the round in; return phase 2's."""
@@ -96,91 +42,9 @@ class TwoPhaseComputation(VariableComputation):
         """All phase-2 payloads in; decide, return next phase 1's."""
         raise NotImplementedError
 
-    # -- shared cost helpers --------------------------------------------
+    # -- mapping onto the N-phase skeleton ------------------------------
 
-    def _raw_unary(self, value: Any) -> float:
-        v = self._variable
-        return self._sign * (v.cost_for_val(value) if v.has_cost else 0.0)
-
-    def _constraint_cost(self, c, value: Any, nv: Dict[str, Any]) -> float:
-        assignment = {self._variable.name: value}
-        for dim in c.dimensions:
-            if dim.name != self._variable.name:
-                assignment[dim.name] = nv[dim.name]
-        return self._sign * c.get_value_for_assignment(assignment)
-
-    def strict_winner(self, mine: float, got: Dict[str, float]) -> bool:
-        """Positive metric, strictly best in the neighborhood (exact
-        ties broken by name so symmetric instances cannot stall)."""
-        return mine > EPS and all(
-            mine > g + EPS
-            or (abs(mine - g) <= EPS and self.name < n)
-            for n, g in got.items()
-        )
-
-    # -- the synchronization skeleton ----------------------------------
-
-    def _neighbor_set(self):
-        return set(self.neighbors)
-
-    def on_start(self) -> None:
-        if self._initial == "declared" and (
-            self._variable.initial_value is not None
-        ):
-            self.value_selection(self._variable.initial_value)
-        else:
-            self.value_selection(self.random_value(self._rnd))
-        if not self._neighbor_set():
-            # unconstrained variable: the phases are neighbor-driven
-            # and never fire — settle the best unary value now so the
-            # 1-opt guarantee holds for isolated variables too
-            best = min(
-                self._variable.domain.values, key=self._raw_unary
-            )
-            self.value_selection(best)
-            return
-        self.post_to_all_neighbors(
-            Phase1Message(self._cycle, self.initial_payload())
-        )
-
-    @register("tp_phase1")
-    def _on_phase1(self, sender: str, msg: Phase1Message, t: float) -> None:
-        if msg.cycle < self._cycle:
-            return  # late duplicate for a completed round
-        self._p1.setdefault(msg.cycle, {})[sender] = msg.payload
-        self._maybe_finish_phase1()
-
-    def _maybe_finish_phase1(self) -> None:
-        if self._p2_sent_cycle >= self._cycle:
-            return  # phase 2 already went out — waiting on phase 2;
-            # a buffered next-round phase-1 must not re-fire this one
-        got = self._p1.get(self._cycle, {})
-        if set(got) != self._neighbor_set():
-            return
-        payload2 = self.finish_phase1(got)
-        self._p2_sent_cycle = self._cycle
-        self.post_to_all_neighbors(Phase2Message(self._cycle, payload2))
-        self._maybe_finish_round()
-
-    @register("tp_phase2")
-    def _on_phase2(self, sender: str, msg: Phase2Message, t: float) -> None:
-        if msg.cycle < self._cycle:
-            return  # late duplicate for a completed round
-        self._p2.setdefault(msg.cycle, {})[sender] = msg.payload
-        self._maybe_finish_round()
-
-    def _maybe_finish_round(self) -> None:
-        if self._p2_sent_cycle < self._cycle:
-            return  # our phase 2 has not gone out yet
-        got = self._p2.get(self._cycle, {})
-        if set(got) != self._neighbor_set():
-            return
-        next_payload = self.finish_round(got)
-        self._p1.pop(self._cycle, None)
-        self._p2.pop(self._cycle, None)
-        self._cycle += 1
-        self.post_to_all_neighbors(
-            Phase1Message(self._cycle, next_payload)
-        )
-        # a faster neighbor's next-round phase 1 may already be queued
-        self._maybe_finish_phase1()
+    def finish_phase(self, phase: int, got: Dict[str, Any]) -> Any:
+        if phase == 0:
+            return self.finish_phase1(got)
+        return self.finish_round(got)

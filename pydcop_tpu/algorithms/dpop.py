@@ -77,18 +77,18 @@ algo_params: list = [
 _EPS32 = float(np.finfo(np.float32).eps)
 
 
-def _align(
-    table: np.ndarray, dims: Sequence[str], target: Sequence[str]
-) -> np.ndarray:
-    """Transpose + expand ``table`` (axes ``dims``) to broadcast over
-    ``target`` (a superset of ``dims``)."""
-    perm = [dims.index(d) for d in target if d in dims]
-    t = np.transpose(table, perm)
-    shape = [
-        t.shape[[d for d in target if d in dims].index(d)] if d in dims else 1
-        for d in target
-    ]
-    return t.reshape(shape)
+def build_computation(comp_def, seed: int = 0):
+    """Host message-driven DPOP (thread/sim/hostnet runtimes) —
+    UTIL/VALUE messages over the pseudo-tree; the device UTIL path
+    below remains the production engine."""
+    from pydcop_tpu.algorithms._host_dpop import (
+        build_computation as _build,
+    )
+
+    return _build(comp_def, seed=seed)
+
+
+from pydcop_tpu.algorithms._tables import align_table as _align  # noqa: E402
 
 
 def solve_host(

@@ -358,6 +358,15 @@ def step(
     return {**state, "values": new_values}
 
 
+def build_computation(comp_def, seed: int = 0):
+    """Host message-driven MGM-2 (thread/sim/hostnet runtimes)."""
+    from pydcop_tpu.algorithms._host_mgm2 import (
+        build_computation as _build,
+    )
+
+    return _build(comp_def, seed=seed)
+
+
 def values_from_state(state: Dict[str, jax.Array]) -> jax.Array:
     return state["values"]
 

@@ -33,6 +33,17 @@ GRAPH_TYPE = "ordered_graph"
 algo_params: list = []
 
 
+def build_computation(comp_def, seed: int = 0):
+    """Host message-driven SyncBB (thread/sim/hostnet runtimes) —
+    the bound-token walk as real messages; the vectorized per-level
+    solver below remains the production engine."""
+    from pydcop_tpu.algorithms._host_syncbb import (
+        build_computation as _build,
+    )
+
+    return _build(comp_def, seed=seed)
+
+
 def solve_host(
     dcop: DCOP,
     params: Dict[str, Any],

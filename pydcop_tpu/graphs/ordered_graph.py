@@ -28,6 +28,11 @@ class OrderedVariableNode(ComputationNode):
         self._variable = variable
         self._constraints = list(constraints)
         self._position = position
+        # chain neighbors by DIRECTION (Link sorts its endpoints, so
+        # the ordering cannot be recovered from links alone); set by
+        # build_computation_graph, consumed by the SyncBB token walk
+        self.prev: Optional[str] = None
+        self.next: Optional[str] = None
 
     @property
     def variable(self) -> Variable:
@@ -99,4 +104,6 @@ def build_computation_graph(
         link = Link([a.name, b.name], link_type="ordering")
         a.add_link(link)
         b.add_link(link)
+        a.next = b.name
+        b.prev = a.name
     return graph

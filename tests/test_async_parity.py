@@ -184,6 +184,93 @@ def test_host_dba_breaks_out_of_local_minimum():
     assert r_dba["cost"] < 0.5  # DBA: broke out, zero conflicts
 
 
+def test_host_mgm2_cost_distribution_matches_batched():
+    """Message-driven MGM-2 (_host_mgm2.py, 5 synchronized phases) and
+    the batched one-jitted-step engine share semantics; their final
+    cost distributions must sit in the same band on the same seeds."""
+    import __graft_entry__ as g
+    from pydcop_tpu.infrastructure import solve_host
+
+    dcop = g._make_coloring_dcop(24, degree=2, seed=3)
+    problem = compile_dcop(dcop)
+    module = load_algorithm_module("mgm2")
+    params = prepare_algo_params({}, module.algo_params)
+    batched = [
+        run_batched(
+            problem, module, params, rounds=200, seed=s, chunk_size=64
+        ).best_cost
+        for s in range(N_SEEDS)
+    ]
+    host = [
+        solve_host(
+            dcop, "mgm2", {}, mode="sim", seed=s, max_msgs=MAX_MSGS,
+            timeout=30,
+        )["cost"]
+        for s in range(N_SEEDS)
+    ]
+    baseline = len(dcop.constraints) / 3.0
+    assert float(np.mean(host)) < baseline / 2, host
+    assert abs(float(np.mean(host)) - float(np.mean(batched))) <= 3.0, (
+        host,
+        batched,
+    )
+
+
+def test_host_mgm2_pair_move_escapes_mgm_minimum():
+    """The coordinated pair move is MGM-2's whole point: on a 2-variable
+    instance whose optimum (1,1) is unreachable by unilateral moves
+    from (0,0), MGM must stay stuck and MGM-2 must coordinate the
+    joint move — in both sim and thread modes."""
+    from pydcop_tpu.infrastructure import solve_host
+
+    D = Domain("b", "", [0, 1])
+    table = np.array([[0.5, 2.0], [2.0, 0.0]])
+    for mode in ("sim", "thread"):
+        dcop = DCOP("pair")
+        x = Variable("x", D, initial_value=0)
+        y = Variable("y", D, initial_value=0)
+        dcop.add_variable(x)
+        dcop.add_variable(y)
+        dcop.add_constraint(NAryMatrixRelation([x, y], table, name="c"))
+        r_mgm = solve_host(
+            dcop, "mgm", {"initial": "declared"}, mode=mode,
+            rounds=60, timeout=20,
+        )
+        r_mgm2 = solve_host(
+            dcop, "mgm2", {"initial": "declared"}, mode=mode,
+            rounds=200, timeout=30,
+        )
+        assert r_mgm["final_cost"] == 0.5, (mode, r_mgm)  # stuck
+        assert r_mgm2["final_cost"] == 0.0, (mode, r_mgm2)  # escaped
+        assert r_mgm2["final_assignment"] == {"x": 1, "y": 1}
+
+
+def test_host_dpop_and_syncbb_are_exact():
+    """The message-driven DPOP (UTIL/VALUE waves) and SyncBB (bound
+    token walk) must reproduce the production engines' exact optimum
+    and terminate by quiescence."""
+    import __graft_entry__ as g
+    from pydcop_tpu.algorithms import dpop as dpop_mod
+    from pydcop_tpu.algorithms import syncbb as syncbb_mod
+    from pydcop_tpu.infrastructure import solve_host
+
+    for seed in range(3):
+        dcop = g._make_coloring_dcop(12, degree=2, seed=seed)
+        exact = dpop_mod.solve_host(dcop, {})
+        bb = syncbb_mod.solve_host(dcop, {})
+        assert abs(exact["cost"] - bb["cost"]) < 1e-9
+        for algo in ("dpop", "syncbb"):
+            for mode in ("sim", "thread"):
+                r = solve_host(
+                    dcop, algo, {}, mode=mode, timeout=60,
+                    max_msgs=500_000,
+                )
+                assert r["status"] == "finished", (algo, mode, r)
+                assert abs(r["final_cost"] - exact["cost"]) < 1e-9, (
+                    algo, mode, seed, r["final_cost"], exact["cost"],
+                )
+
+
 def test_host_gdba_breaks_out_and_syncs_weights():
     """Message-driven GDBA (_host_gdba.py): the cell-targeted increase
     modes (E/R/C) escape the local minimum, and endpoint copies of the

@@ -40,13 +40,17 @@ def _parse_json_tail(text):
     return json.loads(text[start:])
 
 
-@pytest.mark.parametrize("algo", ["maxsum", "mgm"])
+@pytest.mark.parametrize(
+    "algo", ["maxsum", "mgm", "mgm2", "dpop", "syncbb"]
+)
 def test_host_runtime_two_processes(tmp_path, algo):
     """2 agent processes × N message-driven computations each solve a
     ring to its optimum, messages crossing process boundaries as
-    simple_repr JSON over TCP — both the quiescence-terminating
-    (maxsum) and round-synchronized budget-terminating (mgm) protocol
-    families."""
+    simple_repr JSON over TCP — covering every protocol family: the
+    quiescence-terminating factor graph (maxsum), round-synchronized
+    budget-terminating local search (mgm, 5-phase mgm2), the
+    pseudo-tree UTIL/VALUE waves (dpop), and the ordered-chain bound
+    token (syncbb)."""
     yaml_file = tmp_path / "ring.yaml"
     yaml_file.write_text(_ring_yaml())
 
