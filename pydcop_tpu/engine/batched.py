@@ -235,8 +235,12 @@ def run_batched(
     counts all restarts' messages (K independent runs);
     ``convergence_chunks`` judges the across-restart BEST cost only
     (requiring all K instances to freeze would disable early stop).
-    Incompatible with ``mesh``, checkpointing, and ``wants_values``
-    callbacks (elastic runtime) for now.
+    Restarts COMPOSE with ``mesh`` (the vmap runs inside
+    ``shard_map``: per restart, edges stay sharded and the neighbor
+    exchange still rides one psum) and with checkpoint/resume (the
+    whole [K, ...] restart stack round-trips; ``n_restarts`` is
+    validated against the checkpoint).  Only ``wants_values`` chunk
+    callbacks (the elastic runtime) remain incompatible.
     """
     t0 = time.perf_counter()
     sign = -1.0 if problem.maximize else 1.0
@@ -244,15 +248,6 @@ def run_batched(
     if n_restarts < 1:
         raise ValueError(f"n_restarts must be >= 1, got {n_restarts}")
     batched_restarts = n_restarts > 1
-    if batched_restarts and mesh is not None:
-        raise ValueError(
-            "n_restarts > 1 cannot be combined with a mesh (vmap over "
-            "restarts + shard_map over edges is not wired up)"
-        )
-    if batched_restarts and (checkpoint_path is not None or resume):
-        raise ValueError(
-            "n_restarts > 1 does not support checkpoint/resume yet"
-        )
     if batched_restarts and getattr(chunk_callback, "wants_values", False):
         raise ValueError(
             "n_restarts > 1 cannot feed a wants_values chunk_callback "
@@ -285,6 +280,9 @@ def run_batched(
     if batched_restarts:
         restart_ids = jnp.arange(n_restarts)
 
+        # vmap over the restart stack; under a mesh this runs INSIDE
+        # shard_map, so the step's psum still reduces over the shard
+        # axis per restart (vmap and the named axis are orthogonal)
         def algo_step(problem, state, key, dyn):
             keys = jax.vmap(
                 lambda i: jax.random.fold_in(key, i)
@@ -292,13 +290,15 @@ def run_batched(
             return jax.vmap(
                 lambda s, k: algo_module.step(
                     problem, s, k, {**static_params, **dyn},
-                    axis_name=None,
+                    axis_name=axis_name,
                 ),
                 in_axes=(0, 0),
             )(state, keys)
 
         def cost_fn(problem, values):
-            return jax.vmap(lambda v: total_cost(problem, v))(values)
+            return jax.vmap(
+                lambda v: total_cost(problem, v, axis_name)
+            )(values)
     else:
         cost_fn = None
 
@@ -330,7 +330,11 @@ def run_batched(
             lambda k: algo_module.init_state(problem, k, init_params)
         )(jax.random.split(k_init, n_restarts))
         best_values = state["values"]  # [R, n]
-        best_cost = cost_fn(problem, best_values)  # [R]
+        # eager (outside shard_map): arrays are globally shaped here,
+        # so no axis_name — the axis-aware cost_fn is runner-only
+        best_cost = jax.vmap(
+            lambda v: total_cost(problem, v)
+        )(best_values)  # [R]
     else:
         state = algo_module.init_state(problem, k_init, init_params)
         best_values = state["values"]
@@ -340,14 +344,17 @@ def run_batched(
     if resume and checkpoint_path is not None:
         import os
 
-        from pydcop_tpu.engine.checkpoint import load_checkpoint
+        from pydcop_tpu.engine.checkpoint import (
+            checkpoint_meta,
+            load_checkpoint,
+        )
 
         if os.path.exists(checkpoint_path):
-            state, bc, bv, resumed_rounds, meta = load_checkpoint(
-                checkpoint_path,
-                state,
-                static_keys=getattr(algo_module, "STATIC_STATE_KEYS", ()),
-            )
+            # validate compatibility from the meta record BEFORE the
+            # full load, so mismatches fail with the precise reason
+            # (a K-mismatch would otherwise surface as a leaf-shape
+            # "different problem?" error)
+            meta = checkpoint_meta(checkpoint_path)
             if meta.get("algo") != algo_module.__name__:
                 raise ValueError(
                     f"Checkpoint {checkpoint_path} was written by "
@@ -373,12 +380,35 @@ def run_batched(
                     f"{meta.get('problem')} != {fingerprint}) — "
                     "resuming would silently produce wrong results"
                 )
+            if meta.get("n_restarts", 1) != n_restarts:
+                raise ValueError(
+                    f"Checkpoint {checkpoint_path} was written with "
+                    f"n_restarts={meta.get('n_restarts', 1)}, not "
+                    f"{n_restarts} — the restart stack and RNG streams "
+                    "would not line up"
+                )
+            state, bc, bv, resumed_rounds, _ = load_checkpoint(
+                checkpoint_path,
+                state,
+                static_keys=getattr(algo_module, "STATIC_STATE_KEYS", ()),
+            )
             state = jax.tree_util.tree_map(jnp.asarray, state)
             best_cost = jnp.asarray(bc, dtype=best_cost.dtype)
             best_values = jnp.asarray(bv, dtype=best_values.dtype)
 
     def _best_scalar(bc) -> float:
         return float(jnp.min(bc)) if batched_restarts else float(bc)
+
+    def _stacked(sspecs):
+        """Prepend the restart axis (replicated) to every state spec:
+        a [K, ...] restart stack shards exactly like [...] did."""
+        if not batched_restarts:
+            return sspecs
+        return jax.tree_util.tree_map(
+            lambda s: P(*((None,) + tuple(s))),
+            sspecs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
 
     def make_runner(n: int):
         cache_key = cache_key_base + (n,)
@@ -391,7 +421,7 @@ def run_batched(
             from pydcop_tpu.parallel.mesh import problem_pspecs, state_pspecs
 
             pspecs = problem_pspecs(problem)
-            sspecs = state_pspecs(algo_module, problem)
+            sspecs = _stacked(state_pspecs(algo_module, problem))
             dyn_specs = {k: P() for k in dyn_params}
             sharded = jax.shard_map(
                 fn,
@@ -407,7 +437,7 @@ def run_batched(
     if mesh is not None:
         from pydcop_tpu.parallel.mesh import state_pspecs
 
-        sspecs = state_pspecs(algo_module, problem)
+        sspecs = _stacked(state_pspecs(algo_module, problem))
         state = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
             state,
@@ -447,13 +477,14 @@ def run_batched(
                 from pydcop_tpu.engine.checkpoint import save_checkpoint
 
                 save_checkpoint(
-                    checkpoint_path, state, float(best_cost), best_values,
+                    checkpoint_path, state, best_cost, best_values,
                     done,
                     {
                         "algo": algo_module.__name__,
                         "seed": seed,
                         "chunk_size": chunk_size,
                         "problem": fingerprint,
+                        "n_restarts": n_restarts,
                     },
                 )
                 chunks_since_save = 0
@@ -501,13 +532,14 @@ def run_batched(
         from pydcop_tpu.engine.checkpoint import save_checkpoint
 
         save_checkpoint(
-            checkpoint_path, state, float(best_cost), best_values,
+            checkpoint_path, state, best_cost, best_values,
             done,
             {
                 "algo": algo_module.__name__,
                 "seed": seed,
                 "chunk_size": chunk_size,
                 "problem": fingerprint,
+                "n_restarts": n_restarts,
             },
         )
 
@@ -515,8 +547,11 @@ def run_batched(
     restart_costs = None
     if batched_restarts:
         # report the best restart: final = lowest final cost, anytime
-        # best = lowest best-seen cost across all restarts
-        final_costs = cost_fn(problem, final_values)
+        # best = lowest best-seen cost across all restarts (eager →
+        # globally-shaped arrays, no axis_name)
+        final_costs = jax.vmap(
+            lambda v: total_cost(problem, v)
+        )(final_values)
         i_fin = int(jnp.argmin(final_costs))
         final_values = final_values[i_fin]
         final_cost = float(final_costs[i_fin])

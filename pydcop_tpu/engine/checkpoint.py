@@ -30,18 +30,22 @@ def _leaf_key(path) -> str:
 def save_checkpoint(
     path: str,
     state,
-    best_cost: float,
+    best_cost,
     best_values,
     rounds_done: int,
     extra_meta: Dict[str, Any] = None,
 ) -> None:
-    """Atomically write the run state to ``path`` (.npz)."""
+    """Atomically write the run state to ``path`` (.npz).
+
+    ``best_cost`` is a scalar, or a [K] vector for a multi-restart run
+    (the per-restart anytime bests — ``best_values`` is then the
+    [K, n] stack)."""
     leaves = {}
     for kpath, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
         leaves[f"state/{_leaf_key(kpath)}"] = np.asarray(leaf)
     leaves["best_values"] = np.asarray(best_values)
     meta = {
-        "best_cost": float(best_cost),
+        "best_cost": np.asarray(best_cost).tolist(),
         "rounds_done": int(rounds_done),
         **(extra_meta or {}),
     }
@@ -61,10 +65,21 @@ def save_checkpoint(
         raise
 
 
+def checkpoint_meta(path: str) -> Dict[str, Any]:
+    """Read only the metadata record — callers validate compatibility
+    (algo, seed, chunk size, problem fingerprint, n_restarts) BEFORE
+    paying the full load, and with precise error messages."""
+    with np.load(path) as data:
+        return json.loads(bytes(data[_META_KEY]).decode())
+
+
 def load_checkpoint(
     path: str, state_template, static_keys=()
-) -> Tuple[Any, float, np.ndarray, int, Dict[str, Any]]:
+) -> Tuple[Any, Any, np.ndarray, int, Dict[str, Any]]:
     """Restore ``(state, best_cost, best_values, rounds_done, meta)``.
+
+    ``best_cost`` is a float for single runs, or a length-K list for
+    multi-restart checkpoints (``best_values`` is then ``[K, n]``).
 
     ``state_template`` (a freshly-initialized state of the same
     algorithm/problem) provides the pytree structure; every leaf must be
@@ -102,7 +117,7 @@ def load_checkpoint(
         best_values = data["best_values"]
     return (
         state,
-        float(meta["best_cost"]),
+        meta["best_cost"],  # scalar, or [K] list for restart stacks
         best_values,
         int(meta["rounds_done"]),
         meta,

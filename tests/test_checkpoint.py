@@ -228,3 +228,38 @@ def test_resume_array_built_problem(tmp_path):
             other, module, params, rounds=32, seed=2, chunk_size=16,
             checkpoint_path=ckpt, resume=True,
         )
+
+
+def test_resume_restart_stack(tmp_path):
+    """n_restarts=4: the whole [K, ...] state stack, per-restart best
+    costs, and [K, n] best values round-trip through a checkpoint —
+    interrupt at round 32, resume to 64, match the straight run."""
+    problem = ring_problem()
+    module = load_algorithm_module("dsa")
+    params = prepare_algo_params({"variant": "B"}, module.algo_params)
+    path = str(tmp_path / "ck.npz")
+
+    full = run_batched(
+        problem, module, params, rounds=64, seed=9, chunk_size=32,
+        n_restarts=4,
+    )
+    run_batched(
+        problem, module, params, rounds=32, seed=9, chunk_size=32,
+        n_restarts=4, checkpoint_path=path,
+    )
+    resumed = run_batched(
+        problem, module, params, rounds=64, seed=9, chunk_size=32,
+        n_restarts=4, checkpoint_path=path, resume=True,
+    )
+    assert resumed.cycles == 64
+    np.testing.assert_allclose(
+        resumed.restart_costs, full.restart_costs, atol=1e-6
+    )
+    assert resumed.best_cost == full.best_cost
+    assert resumed.assignment == full.assignment
+    # a different K must be rejected (stack/RNG misalignment)
+    with pytest.raises(ValueError, match="n_restarts"):
+        run_batched(
+            problem, module, params, rounds=64, seed=9, chunk_size=32,
+            n_restarts=8, checkpoint_path=path, resume=True,
+        )

@@ -479,7 +479,10 @@ def test_multi_restart_best_of():
     assert "restart_costs" not in r1
 
 
-def test_multi_restart_rejects_checkpoint_and_mesh():
+def test_multi_restart_remaining_rejections():
+    """Restarts now compose with mesh + checkpointing (see
+    test_parallel_sharded / test_checkpoint); what must still be
+    rejected: n_restarts < 1 and the host-path solve modes."""
     from pydcop_tpu.engine.batched import run_batched
     from pydcop_tpu.algorithms import (
         load_algorithm_module,
@@ -492,16 +495,6 @@ def test_multi_restart_rejects_checkpoint_and_mesh():
     p = compile_from_arrays(sc, tb, 3, unary=un)
     module = load_algorithm_module("dsa")
     params = prepare_algo_params({"variant": "B"}, module.algo_params)
-    with pytest.raises(ValueError, match="checkpoint"):
-        run_batched(
-            p, module, params, rounds=8, n_restarts=4,
-            checkpoint_path="/tmp/x.npz",
-        )
-    from jax.sharding import Mesh
-
-    mesh = Mesh(np.array(jax.devices()[:2]), ("shard",))
-    with pytest.raises(ValueError, match="mesh"):
-        run_batched(p, module, params, rounds=8, n_restarts=4, mesh=mesh)
     with pytest.raises(ValueError, match="n_restarts"):
         run_batched(p, module, params, rounds=8, n_restarts=0)
     from pydcop_tpu.api import solve

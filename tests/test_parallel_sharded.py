@@ -125,3 +125,29 @@ def test_ghost_edges_excluded_from_message_count():
     module = load_algorithm_module("maxsum")
     assert module.messages_per_round(p1) == 40
     assert module.messages_per_round(p8) == 40  # ghosts not counted
+
+
+@pytest.mark.parametrize("algo_name", ["dsa", "maxsum"])
+def test_restarts_compose_with_mesh(algo_name):
+    """n_restarts=4 under an 8-device mesh (vmap inside shard_map):
+    the per-restart anytime bests must match the unsharded restart
+    run exactly — same RNG streams, per-restart psum exchange."""
+    dcop = coloring_ring(24, 3, with_ternary=True)
+    module = load_algorithm_module(algo_name)
+    params = prepare_algo_params(
+        {"variant": "B"} if algo_name == "dsa" else {"damping": 0.5},
+        module.algo_params,
+    )
+    r_flat = run_batched(
+        compile_dcop(dcop), module, params, rounds=24, seed=7,
+        chunk_size=12, n_restarts=4,
+    )
+    r_mesh = run_batched(
+        compile_dcop(dcop, n_shards=8), module, params, rounds=24,
+        seed=7, chunk_size=12, n_restarts=4, mesh=make_mesh(8),
+    )
+    np.testing.assert_allclose(
+        r_mesh.restart_costs, r_flat.restart_costs, atol=1e-4
+    )
+    assert r_mesh.best_cost == pytest.approx(r_flat.best_cost, abs=1e-4)
+    assert r_mesh.assignment == r_flat.assignment
