@@ -372,23 +372,37 @@ def run_host_orchestrator(
     peers: Dict[str, Tuple[socket.socket, Any]] = {}
     addresses: Dict[str, Tuple[str, int]] = {}
 
-    def _ask(name: str, obj: Dict[str, Any]) -> Dict[str, Any]:
-        """One control round-trip; any failure → AgentFailureError."""
-        conn, reader = peers[name]
-        try:
-            _send(conn, obj)
-            reply = _recv(reader)
-        except (OSError, ValueError) as e:
-            raise AgentFailureError(
-                f"agent {name} died mid-solve ({type(e).__name__})"
-            ) from e
-        if reply is None:
-            raise AgentFailureError(f"agent {name} died mid-solve")
-        if reply.get("error"):
-            raise AgentFailureError(
-                f"agent {name} failed: {reply['error']}"
-            )
-        return reply
+    def _ask_all(
+        obj: Dict[str, Any], names: Optional[List[str]] = None
+    ) -> Dict[str, Dict[str, Any]]:
+        """Pipelined control round-trip: the request goes to EVERY
+        agent before any reply is read, so a poll sweep costs one
+        round-trip latency instead of n_agents of them (the round-3
+        serial loop was a quadratic-ish drag at ~100 agents)."""
+        names = list(peers) if names is None else names
+        for name in names:
+            try:
+                _send(peers[name][0], obj)
+            except OSError as e:
+                raise AgentFailureError(
+                    f"agent {name} died mid-solve ({type(e).__name__})"
+                ) from e
+        replies: Dict[str, Dict[str, Any]] = {}
+        for name in names:
+            try:
+                reply = _recv(peers[name][1])
+            except (OSError, ValueError) as e:
+                raise AgentFailureError(
+                    f"agent {name} died mid-solve ({type(e).__name__})"
+                ) from e
+            if reply is None:
+                raise AgentFailureError(f"agent {name} died mid-solve")
+            if reply.get("error"):
+                raise AgentFailureError(
+                    f"agent {name} failed: {reply['error']}"
+                )
+            replies[name] = reply
+        return replies
 
     try:
         while len(peers) < nb_agents:
@@ -533,8 +547,7 @@ def run_host_orchestrator(
         def _collect() -> Tuple[Dict[str, Any], int, int]:
             assignment: Dict[str, Any] = {}
             delivered = size = 0
-            for name in peers:
-                res = _ask(name, {"type": "collect"})
+            for res in _ask_all({"type": "collect"}).values():
                 assignment.update(res["values"])
                 delivered += res["delivered"]
                 size += res["size"]
@@ -585,8 +598,7 @@ def run_host_orchestrator(
             total = 0
             total_sent = 0
             all_idle = True
-            for name in peers:
-                st = _ask(name, {"type": "status?"})
+            for st in _ask_all({"type": "status?"}).values():
                 total += st["delivered"]
                 # missing field (older agent) degrades to the old
                 # idle+stability rule instead of never quiescing

@@ -106,6 +106,64 @@ def test_host_runtime_two_processes(tmp_path, algo):
                 proc.communicate()
 
 
+def test_host_runtime_five_processes_with_strategy(tmp_path):
+    """5 agent OS processes, placement computed by a REAL distribution
+    strategy (adhoc) over the registered agents, on a 20-variable ring
+    — the first above-toy-count deployment (VERDICT r3 next #6).  All
+    five agents must host computations, exchange cross-process
+    messages, and the run must reach the ring optimum."""
+    n = 20
+    yaml_file = tmp_path / "ring20.yaml"
+    yaml_file.write_text(_ring_yaml(n))
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYDCOP_TPU_PLATFORM"] = "cpu"
+
+    port = 9405 + (os.getpid() % 140)
+    orch = subprocess.Popen(
+        [
+            sys.executable, "-m", "pydcop_tpu", "orchestrator",
+            str(yaml_file), "-a", "maxsum", "--runtime", "host",
+            "--port", str(port), "--nb_agents", "5", "--rounds", "200",
+            "--seed", "1", "-d", "adhoc",
+        ],
+        env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    time.sleep(0.5)
+    names = [f"a{i}" for i in range(1, 6)]
+    agents = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu", "agent",
+                "--names", name, "--runtime", "host",
+                "--orchestrator", f"localhost:{port}",
+            ],
+            env=env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for name in names
+    ]
+    try:
+        orc_out, orc_err = orch.communicate(timeout=180)
+        assert orch.returncode == 0, orc_err[-3000:]
+        result = _parse_json_tail(orc_out)
+        assert result["cost"] == 0.0
+        assert sorted(result["agents"]) == names
+        placement = result["placement"]
+        assert all(placement[a] for a in names), placement
+        assert result["msg_count"] > 0
+        for a in agents:
+            a.communicate(timeout=30)
+            assert a.returncode == 0
+    finally:
+        for proc in [orch, *agents]:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+
+
 def test_tcp_layer_dead_peer_reports_and_raises():
     """A dead destination must (1) surface asynchronously through
     on_send_error — the async writer replaced the old synchronous

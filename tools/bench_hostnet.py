@@ -1,0 +1,90 @@
+"""Measure cross-process host-runtime throughput (msgs/sec).
+
+The reference-class deployment shape: N agent OS processes exchanging
+simple_repr JSON frames over TCP, placement via a real distribution
+strategy.  Fills BASELINE.md's >=4-process row (VERDICT r4 next #6).
+
+Usage: python tools/bench_hostnet.py [n_agents] [n_vars]
+Prints one JSON line {n_agents, n_vars, msgs_per_sec, cost, time}.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    n_agents = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    n_vars = int(sys.argv[2]) if len(sys.argv) > 2 else 300
+
+    import __graft_entry__ as g
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+    dcop = g._make_coloring_dcop(n_vars, degree=3, seed=1)
+    tmp = f"/tmp/bench_hostnet_{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    yaml_path = os.path.join(tmp, "prob.yaml")
+    with open(yaml_path, "w") as f:
+        f.write(dcop_yaml(dcop))
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYDCOP_TPU_PLATFORM"] = "cpu"
+    port = 9650 + (os.getpid() % 200)
+
+    orch = subprocess.Popen(
+        [
+            sys.executable, "-m", "pydcop_tpu", "orchestrator",
+            yaml_path, "-a", "maxsum", "--runtime", "host",
+            "--port", str(port), "--nb_agents", str(n_agents),
+            "--rounds", "60", "--seed", "1",
+        ],
+        env=env, cwd=tmp,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    time.sleep(0.5)
+    agents = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu", "agent",
+                "--names", f"a{i}", "--runtime", "host",
+                "--orchestrator", f"localhost:{port}",
+            ],
+            env=env, cwd=tmp,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        for i in range(1, n_agents + 1)
+    ]
+    try:
+        out, err = orch.communicate(timeout=600)
+        if orch.returncode != 0:
+            print(json.dumps({"error": err[-500:]}))
+            return
+        r = json.loads(out[out.index("{"):])
+        print(
+            json.dumps(
+                {
+                    "n_agents": n_agents,
+                    "n_vars": n_vars,
+                    "msgs_per_sec": round(r["msg_count"] / r["time"]),
+                    "msg_count": r["msg_count"],
+                    "cost": r["cost"],
+                    "time": round(r["time"], 2),
+                    "status": r["status"],
+                }
+            )
+        )
+    finally:
+        for p in [orch, *agents]:
+            if p.poll() is None:
+                p.kill()
+
+
+if __name__ == "__main__":
+    main()

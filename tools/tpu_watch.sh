@@ -29,6 +29,11 @@ for i in $(seq 1 "$MAX"); do
     # a tunnel that answered the probe then dropped must NOT look like
     # a capture — keep probing instead
     if [ "$rc" -eq 0 ] && grep -q '"backend": *"tpu"' "$OUT/bench.json"; then
+      # layout-candidate microbench (VERDICT r4 next #1): which
+      # execution of the belief aggregation wins on the real chip
+      timeout -k 30 900 python tools/bench_gather.py \
+        > "$OUT/gather.txt" 2>&1
+      echo "[tpu_watch] gather bench rc=$?" | tee -a "$OUT/watch.log"
       timeout -k 30 3000 python bench_configs.py --json \
         > "$OUT/configs.json" 2> "$OUT/configs.err"
       crc=$?
