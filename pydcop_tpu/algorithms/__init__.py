@@ -241,6 +241,20 @@ class ComputationDef(SimpleRepr):
 # ---------------------------------------------------------------------------
 
 
+def resolve_algo(algo, algo_params=None):
+    """Normalize (algo, algo_params) into ``(name, params_dict)``.
+
+    ``algo`` is a name or an :class:`AlgorithmDef`; explicit
+    ``algo_params`` override the def's params.  The one home for the
+    merge semantics every solve entry point shares."""
+    if isinstance(algo, AlgorithmDef):
+        name, params = algo.algo, dict(algo.params)
+        if algo_params:
+            params.update(algo_params)
+        return name, params
+    return algo, dict(algo_params or {})
+
+
 def load_algorithm_module(name: str):
     """Import an algorithm plugin module by name."""
     try:

@@ -16,6 +16,7 @@ from pydcop_tpu.algorithms import (
     AlgorithmDef,
     load_algorithm_module,
     prepare_algo_params,
+    resolve_algo,
 )
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
@@ -37,6 +38,8 @@ def solve(
     mode: str = "batched",
     ui_port: Optional[int] = None,
     n_restarts: int = 1,
+    nb_agents: Optional[int] = None,
+    msg_log: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -47,8 +50,10 @@ def solve(
 
     ``mode`` selects the execution engine: ``"batched"`` (default, the
     TPU engine), ``"thread"`` (reference-style thread-per-agent host
-    runtime), or ``"sim"`` (deterministic seeded async event loop —
-    the parity-test schedule).
+    runtime), ``"sim"`` (deterministic seeded async event loop — the
+    parity-test schedule), or ``"process"`` (one OS process per agent
+    over the TCP host runtime — the reference's
+    ``run_local_process_dcop``; ``nb_agents`` caps the process count).
 
     Stop conditions differ per engine (round budget + optional
     ``convergence_chunks`` for batched; quiescence for thread/sim) —
@@ -78,23 +83,46 @@ def solve(
                 "n_restarts (batched parallel restarts) is only "
                 f"supported on the batched engine, not mode={mode!r}"
             )
+        if nb_agents is not None:
+            raise ValueError(
+                "nb_agents is the process count of mode='process'; "
+                f"mode={mode!r} decides its own parallelism"
+            )
         from pydcop_tpu.infrastructure import solve_host
 
         return solve_host(
             dcop, algo, algo_params, mode=mode, timeout=timeout,
-            seed=seed, rounds=rounds,
+            seed=seed, rounds=rounds, msg_log=msg_log,
+        )
+    if mode == "process":
+        if checkpoint_path is not None or resume or n_restarts != 1:
+            raise ValueError(
+                "checkpoint/resume and n_restarts are only supported "
+                "on the batched engine, not mode='process'"
+            )
+        return _solve_process(
+            dcop, algo, algo_params, rounds=rounds, timeout=timeout,
+            seed=seed, nb_agents=nb_agents, ui_port=ui_port,
+            msg_log=msg_log,
         )
     if mode != "batched":
         raise ValueError(f"solve: unknown mode {mode!r}")
+    if msg_log is not None:
+        raise ValueError(
+            "msg_log records individual message contents — only the "
+            "message-driven modes (thread/sim/process) deliver them; "
+            "the batched engine fuses a round into one device step "
+            "and the exact host-path solvers (dpop/syncbb) are "
+            "vectorized.  Run the algorithm with mode='thread'/'sim'/"
+            "'process' to log its messages."
+        )
+    if nb_agents is not None:
+        raise ValueError(
+            "nb_agents is the process count of mode='process'; other "
+            "modes decide their own parallelism"
+        )
 
-    if isinstance(algo, AlgorithmDef):
-        algo_name = algo.algo
-        params_in = dict(algo.params)
-        if algo_params:
-            params_in.update(algo_params)
-    else:
-        algo_name = algo
-        params_in = dict(algo_params or {})
+    algo_name, params_in = resolve_algo(algo, algo_params)
 
     module = load_algorithm_module(algo_name)
     params = prepare_algo_params(params_in, module.algo_params)
@@ -125,6 +153,134 @@ def solve(
     )
 
 
+def _solve_process(
+    dcop: DCOP,
+    algo: Union[str, AlgorithmDef],
+    algo_params: Optional[Mapping[str, Any]],
+    *,
+    rounds: int,
+    timeout: Optional[float],
+    seed: int,
+    nb_agents: Optional[int],
+    ui_port: Optional[int],
+    msg_log: Optional[str] = None,
+) -> Dict[str, Any]:
+    """One-call multi-process solve (reference:
+    ``pydcop/infrastructure/run.py:run_local_process_dcop``): spawn
+    ``nb_agents`` local agent OS processes, run the hostnet
+    orchestrator in THIS process, return its result dict.
+
+    Default process count: one per declared AgentDef, capped at the
+    machine's CPU count (and at 2 when the problem declares none) —
+    the reference forks one process per agent the same way.
+    """
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    from pydcop_tpu.infrastructure.hostnet import (
+        AgentFailureError,
+        run_host_orchestrator,
+    )
+
+    algo_name, params_in = resolve_algo(algo, algo_params)
+
+    if nb_agents is None:
+        nb_agents = min(len(dcop.agents) or 2, os.cpu_count() or 2)
+    if nb_agents < 1:
+        raise ValueError(f"nb_agents must be >= 1, got {nb_agents}")
+
+    # pre-bound control-plane listener: the port must be known before
+    # the agents fork, and a probe-then-rebind would race other port
+    # users — run_host_orchestrator accepts the live socket instead
+    server = socket.create_server(("", 0))
+    port = server.getsockname()[1]
+
+    # prefer the dcop's own agent names so hosting/capacity data flows
+    # into the placement; pad with generated names when it has fewer
+    # (skipping any declared name the generator would collide with)
+    names = sorted(dcop.agents)[:nb_agents]
+    used = set(names)
+    i = 0
+    while len(names) < nb_agents:
+        candidate = f"agent_{i}"
+        i += 1
+        if candidate not in used:
+            names.append(candidate)
+            used.add(candidate)
+
+    # the children must find THIS package wherever the embedding
+    # process imported it from (the parent may have extended sys.path
+    # programmatically — env PYTHONPATH is how that survives the fork)
+    import pydcop_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(pydcop_tpu.__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    # children's stderr goes to tempfiles: a crashing agent must be
+    # diagnosable from the parent's failure, not vanish into DEVNULL
+    # and surface only as a registration timeout
+    import tempfile
+
+    err_files = [
+        tempfile.NamedTemporaryFile(
+            mode="w+", suffix=f".{name}.err", delete=False
+        )
+        for name in names
+    ]
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu", "agent",
+                "--names", name, "--runtime", "host",
+                "--orchestrator", f"127.0.0.1:{port}",
+            ]
+            + (["--msg_log", f"{msg_log}.{name}"] if msg_log else []),
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=ef,
+        )
+        for name, ef in zip(names, err_files)
+    ]
+    try:
+        return run_host_orchestrator(
+            dcop, algo_name, params_in, nb_agents=nb_agents, port=port,
+            rounds=rounds, timeout=timeout, seed=seed, ui_port=ui_port,
+            server=server,
+        )
+    except AgentFailureError as e:
+        tails = []
+        for name, ef in zip(names, err_files):
+            try:
+                with open(ef.name) as f:
+                    tail = f.read()[-800:].strip()
+            except OSError:
+                tail = ""
+            if tail:
+                tails.append(f"--- {name} stderr ---\n{tail}")
+        if tails:
+            raise AgentFailureError(
+                f"{e}\n" + "\n".join(tails)
+            ) from e
+        raise
+    finally:
+        for p in procs:  # orchestrator's stop already reached them;
+            # this only reaps stragglers
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+        for ef in err_files:
+            try:
+                ef.close()
+                os.unlink(ef.name)
+            except OSError:
+                pass
+
+
 def solve_compiled(
     problem,
     algo: Union[str, AlgorithmDef],
@@ -150,14 +306,7 @@ def solve_compiled(
     algorithms (DPOP, SyncBB) need the model/graph objects — use
     :func:`solve` for those.
     """
-    if isinstance(algo, AlgorithmDef):
-        algo_name = algo.algo
-        params_in = dict(algo.params)
-        if algo_params:
-            params_in.update(algo_params)
-    else:
-        algo_name = algo
-        params_in = dict(algo_params or {})
+    algo_name, params_in = resolve_algo(algo, algo_params)
     module = load_algorithm_module(algo_name)
     if hasattr(module, "solve_host"):
         raise ValueError(

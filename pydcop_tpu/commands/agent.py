@@ -30,6 +30,12 @@ def set_parser(subparsers) -> None:
         help="seconds to keep retrying the initial connection",
     )
     p.add_argument(
+        "--msg_log", default=None, metavar="FILE",
+        help="(--runtime host) dump every delivered message's full "
+        "content to FILE as JSON lines — the reference Messaging's "
+        "per-message log; several --names get FILE.<agent> each",
+    )
+    p.add_argument(
         "--runtime", choices=["spmd", "host"], default="spmd",
         help="must match the orchestrator's --runtime (spmd: sharded "
         "batched solve as a jax.distributed process; host: "
@@ -53,6 +59,11 @@ def run_cmd(args) -> int:
                     "--retry_for", str(args.retry_for),
                     "--runtime", args.runtime,
                 ]
+                + (
+                    ["--msg_log", f"{args.msg_log}.{name}"]
+                    if args.msg_log
+                    else []
+                )
             )
             for name in args.names
         ]
@@ -65,7 +76,8 @@ def run_cmd(args) -> int:
         from pydcop_tpu.infrastructure.hostnet import run_host_agent
 
         result = run_host_agent(
-            args.names[0], args.orchestrator, retry_for=args.retry_for
+            args.names[0], args.orchestrator, retry_for=args.retry_for,
+            msg_log=args.msg_log,
         )
         print(json.dumps(result))
         return 0

@@ -37,8 +37,20 @@ def set_parser(subparsers) -> None:
         default="tpu",
         help="execution mode: tpu = batched engine (default); thread = "
         "host thread-per-agent runtime; sim = deterministic async "
-        "event loop; process = cross-process (use the orchestrator/"
-        "agent commands)",
+        "event loop; process = one local OS process per agent over the "
+        "TCP host runtime (the reference's run_local_process_dcop)",
+    )
+    p.add_argument(
+        "--nb_agents", type=int, default=None,
+        help="process count for --mode process (default: one per "
+        "declared agent, capped at the CPU count)",
+    )
+    p.add_argument(
+        "--msg_log", default=None, metavar="FILE",
+        help="(thread/sim/process modes) dump every delivered "
+        "message's full content to FILE as JSON lines (the reference "
+        "Messaging's per-message log; process mode writes "
+        "FILE.<agent> per agent)",
     )
     p.add_argument("--rounds", type=int, default=200, help="round budget")
     p.add_argument("--seed", type=int, default=0)
@@ -82,15 +94,6 @@ def set_parser(subparsers) -> None:
 def run_cmd(args) -> int:
     from pydcop_tpu.api import solve
 
-    if args.mode == "process":
-        raise SystemExit(
-            "solve --mode process: cross-process runs go through the "
-            "orchestrator — start `pydcop_tpu orchestrator <dcop> -a "
-            "<algo> --nb_agents N` and N `pydcop_tpu agent` processes "
-            "(add `--runtime host` on both for message-driven agents "
-            "instead of the sharded SPMD solve; see those commands' "
-            "--help)"
-        )
     params = parse_algo_params(args.algo_params)
     profile_ctx = None
     if args.profile:
@@ -115,6 +118,8 @@ def run_cmd(args) -> int:
             mode="batched" if args.mode == "tpu" else args.mode,
             ui_port=args.uiport,
             n_restarts=args.restarts,
+            nb_agents=args.nb_agents,
+            msg_log=args.msg_log,
         )
     finally:
         # flush the trace even when the solve raises — a profile of a

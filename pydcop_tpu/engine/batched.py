@@ -45,6 +45,9 @@ class RunResult:
     # per-restart best costs (native sign) when n_restarts > 1 — the
     # K-sample distribution behind the reported best (None otherwise)
     restart_costs: Optional[np.ndarray] = None
+    # the final algorithm state as host arrays when return_state=True
+    # (the dynamic engine's state-transfer carry; None otherwise)
+    state: Optional[Dict[str, np.ndarray]] = None
 
 
 # Compiled chunk runners, reused across run_batched calls so repeated
@@ -186,6 +189,8 @@ def run_batched(
     chunk_callback: Optional[Callable[[int, float], Optional[str]]] = None,
     cost_every: int = 1,
     n_restarts: int = 1,
+    initial_state: Optional[Dict[str, Any]] = None,
+    return_state: bool = False,
 ) -> RunResult:
     """Run a batched algorithm for up to ``rounds`` rounds.
 
@@ -241,6 +246,14 @@ def run_batched(
     whole [K, ...] restart stack round-trips; ``n_restarts`` is
     validated against the checkpoint).  Only ``wants_values`` chunk
     callbacks (the elastic runtime) remain incompatible.
+
+    ``initial_state`` seeds the run with a previous run's full state
+    pytree (same problem structure and, for restarts, same K) instead
+    of ``init_state`` — the dynamic engine's state transfer: Max-Sum
+    messages / DBA weights survive a migration exactly as the
+    reference resumes computations from their replicated state.  A
+    checkpoint ``resume`` takes precedence.  ``return_state=True``
+    puts the final state (host arrays) on ``RunResult.state``.
     """
     t0 = time.perf_counter()
     sign = -1.0 if problem.maximize else 1.0
@@ -318,6 +331,12 @@ def run_batched(
         problem.n_shards,
         cost_every,
         n_restarts,
+        # a mesh runner closes over problem-shaped in_specs whose
+        # pytree AUX DATA (names, flags) must match the argument's —
+        # two different problems with identical bucket structure would
+        # otherwise reuse one runner and fail with a treedef mismatch
+        # (dynamic runs recompile per segment and hit exactly this)
+        jax.tree_util.tree_structure(problem) if mesh is not None else None,
     )
 
     key = jax.random.PRNGKey(seed)
@@ -325,19 +344,22 @@ def run_batched(
     init_params = {
         **static_params, **{k: params[k] for k in dyn_params}
     }
-    if batched_restarts:
+    if initial_state is not None:
+        state = jax.tree_util.tree_map(jnp.asarray, initial_state)
+    elif batched_restarts:
         state = jax.vmap(
             lambda k: algo_module.init_state(problem, k, init_params)
         )(jax.random.split(k_init, n_restarts))
-        best_values = state["values"]  # [R, n]
+    else:
+        state = algo_module.init_state(problem, k_init, init_params)
+    best_values = state["values"]  # [R, n] under restarts
+    if batched_restarts:
         # eager (outside shard_map): arrays are globally shaped here,
         # so no axis_name — the axis-aware cost_fn is runner-only
         best_cost = jax.vmap(
             lambda v: total_cost(problem, v)
         )(best_values)  # [R]
     else:
-        state = algo_module.init_state(problem, k_init, init_params)
-        best_values = state["values"]
         best_cost = total_cost(problem, best_values)
 
     resumed_rounds = 0
@@ -567,6 +589,19 @@ def run_batched(
         algo_module.messages_per_round(problem, params) * done * n_restarts
     )
     trace = np.concatenate(traces) if traces else np.zeros(0)
+    out_state = None
+    if return_state:
+        def _to_host(x):
+            try:
+                return np.asarray(x)
+            except RuntimeError:
+                # multi-host mesh: the global array spans
+                # non-addressable devices — keep the jax array, which
+                # is still a valid initial_state for a next segment
+                # on the same global mesh
+                return x
+
+        out_state = jax.tree_util.tree_map(_to_host, state)
     return RunResult(
         assignment=decode_assignment(problem, final_values),
         cost=sign * final_cost,
@@ -578,4 +613,5 @@ def run_batched(
         status=status,
         cost_trace=sign * trace,
         restart_costs=restart_costs,
+        state=out_state,
     )

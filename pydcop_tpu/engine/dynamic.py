@@ -18,8 +18,15 @@ dynamics become:
 - **set_value** — an external variable changes; constraints are
   re-sliced at the new value (recompile) and solving resumes.
 
-Between events the solve state carries over: current values re-enter
-the recompiled problem as declared initial values.
+Between events the solve state carries over at FULL fidelity whenever
+the recompiled problem is unchanged (fingerprint match — every delay
+and every clean migration): the complete algorithm state (Max-Sum
+messages, DBA/GDBA weights, values) transfers into the next segment,
+the batched equivalent of the reference resuming computations from
+their replicated state.  When an event reshapes the problem (a lost
+variable freezes into an external, an external value changes), the
+carry degrades to declared initial values — exactly as the reference
+loses the state of computations with no surviving replica.
 """
 
 from __future__ import annotations
@@ -132,6 +139,18 @@ def run_dynamic(
     cycles = 0
     messages = 0
     status = "finished"
+    # full-state carry (reference parity: computations resume from
+    # their REPLICATED STATE after a migration, not from scratch).
+    # The batched state (Max-Sum messages, DBA weights, ...) transfers
+    # verbatim across segments whenever the recompiled problem is
+    # byte-identical (fingerprint match) — which is every delay event
+    # and every remove_agent whose orphans all migrated.  Events that
+    # freeze a variable or change an external value reshape the
+    # problem, so only values carry there (the reference equivalently
+    # loses the state of computations with no surviving replica).
+    carry_state: Optional[Dict[str, np.ndarray]] = None
+    carry_fp: Optional[str] = None
+    state_transfers = 0
 
     def active_dcop() -> DCOP:
         """The current solvable problem: frozen variables become
@@ -156,19 +175,27 @@ def run_dynamic(
         d.add_agents(live_agents.values())
         return d
 
-    def run_segment(n_rounds: int, seg_seed: int) -> None:
+    def run_segment(n_rounds: int, seg_seed: int) -> bool:
+        """One solve segment; returns whether full state carried."""
         nonlocal cycles, messages, current_values, status
+        nonlocal carry_state, carry_fp, state_transfers
         import dataclasses as dc
 
         from pydcop_tpu.engine.batched import run_batched
-        from pydcop_tpu.ops.compile import compile_dcop, encode_assignment
+        from pydcop_tpu.ops.compile import (
+            compile_dcop,
+            encode_assignment,
+            problem_fingerprint,
+        )
 
         ad = active_dcop()
         if not ad.variables:
-            return  # everything frozen/lost
+            return False  # everything frozen/lost
         problem = compile_dcop(ad, n_shards=n_shards)
+        fp = problem_fingerprint(problem)
+        carried = carry_state is not None and fp == carry_fp
         seg_params = dict(params)
-        if current_values:
+        if not carried and current_values:
             known = {
                 name: current_values[name]
                 for name in problem.var_names
@@ -192,13 +219,20 @@ def run_dynamic(
             chunk_size=chunk_size,
             mesh=mesh,
             chunk_callback=chunk_callback,
+            initial_state=carry_state if carried else None,
+            return_state=True,
         )
         cycles += result.cycles
         messages += result.messages
         traces.append(np.asarray(result.cost_trace))
         current_values.update(result.assignment)
+        carry_state = result.state
+        carry_fp = fp
+        if carried:
+            state_transfers += 1
         if result.status == "timeout":
             status = "timeout"
+        return carried
 
     def remove_agent(name: str) -> Dict[str, Any]:
         nonlocal replicas, dist
@@ -266,8 +300,10 @@ def run_dynamic(
         if event.is_delay:
             n = max(1, int(round(event.delay * rounds_per_second)))
             rng_seq += 1
-            run_segment(n, rng_seq)
-            events_log.append({"type": "delay", "rounds": n})
+            carried = run_segment(n, rng_seq)
+            events_log.append(
+                {"type": "delay", "rounds": n, "state_carried": carried}
+            )
             continue
         for action in event.actions or []:
             args = action.args
@@ -332,6 +368,7 @@ def run_dynamic(
         "status": status,
         "time": time.perf_counter() - t0,
         "events": events_log,
+        "state_transfers": state_transfers,
         "lost_computations": sorted(frozen),
         "agents_final": sorted(live_agents),
         "replicas": replicas.mapping if replicas is not None else None,

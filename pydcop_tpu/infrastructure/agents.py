@@ -40,6 +40,7 @@ class Agent:
         comm: CommunicationLayer,
         on_error: Optional[Callable[[str, BaseException], None]] = None,
         discovery=None,
+        msg_log=None,
     ):
         if discovery is None:
             from pydcop_tpu.infrastructure.discovery import Discovery
@@ -50,7 +51,7 @@ class Agent:
         self._discovery = discovery
         discovery.register_agent(name)
         self._computations: Dict[str, MessagePassingComputation] = {}
-        self.messaging = Messaging(name)
+        self.messaging = Messaging(name, msg_log=msg_log)
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._comps_started = threading.Event()

@@ -76,6 +76,56 @@ class InProcessCommunicationLayer(CommunicationLayer):
         messaging.deliver(src_comp, dest_comp, msg, priority)
 
 
+class MessageLog:
+    """Full-message-content log (reference parity: the reference's
+    ``Messaging`` can dump every message for debugging a distributed
+    run — SURVEY §5 tracing row).  One JSON line per delivered
+    message: ``{t, agent, src, dest, type, size, content}`` with the
+    content in ``simple_repr`` form (the wire format), so a log line
+    is exactly what the TCP plane would have carried.
+
+    One run per file: the path is truncated on open, so rerunning
+    against the same path cannot silently interleave two runs' lines.
+    Thread-safe append; logging failures never break delivery."""
+
+    def __init__(self, path: str):
+        import threading as _threading
+
+        self._f = open(path, "w", encoding="utf-8")
+        self._lock = _threading.Lock()
+
+    def log(self, agent: str, src: str, dest: str, msg: Message) -> None:
+        import json as _json
+        import time as _time
+
+        from pydcop_tpu.utils.simple_repr import simple_repr
+
+        try:
+            line = _json.dumps(
+                {
+                    "t": _time.time(),
+                    "agent": agent,
+                    "src": src,
+                    "dest": dest,
+                    "type": msg.type,
+                    "size": msg.size,
+                    "content": simple_repr(msg),
+                },
+                default=str,
+            )
+            with self._lock:
+                self._f.write(line + "\n")
+        except Exception:
+            pass  # a malformed message must not break delivery
+
+    def close(self) -> None:
+        try:
+            with self._lock:
+                self._f.close()
+        except Exception:
+            pass
+
+
 class Messaging:
     """Per-agent message router with priority queues and metrics.
 
@@ -92,7 +142,7 @@ class Messaging:
     message in flight).
     """
 
-    def __init__(self, agent_name: str):
+    def __init__(self, agent_name: str, msg_log: Optional[MessageLog] = None):
         self.agent_name = agent_name
         self._heap: list = []
         self._seq = 0  # FIFO tie-break within a priority class
@@ -101,6 +151,7 @@ class Messaging:
         self.count_msg = 0
         self.size_msg = 0
         self.count_by_priority: Dict[int, int] = {}
+        self.msg_log = msg_log
 
     def deliver(
         self,
@@ -120,6 +171,8 @@ class Messaging:
                 self._heap, (priority, self._seq, src_comp, dest_comp, msg)
             )
             self._cond.notify()
+        if self.msg_log is not None:  # outside the lock: file IO
+            self.msg_log.log(self.agent_name, src_comp, dest_comp, msg)
 
     def next_msg(
         self, timeout: Optional[float] = None

@@ -327,6 +327,7 @@ def run_host_orchestrator(
     poll_timeout: float = 30.0,
     best_sample_period: float = 0.5,
     ui_port: Optional[int] = None,
+    server: Optional[socket.socket] = None,
 ) -> Dict[str, Any]:
     """Wait for ``nb_agents`` host agents, deploy, run to quiescence /
     budget / timeout, and return the assembled result dict.
@@ -367,7 +368,11 @@ def run_host_orchestrator(
     comp_names = sorted(n.name for n in graph.nodes)
 
     ui = None  # created after registration; closed in the finally
-    server = socket.create_server(("", port))
+    if server is None:
+        server = socket.create_server(("", port))
+    # a caller may pass a PRE-BOUND listener (solve(mode='process')
+    # does: it must know the port before forking the agents, and a
+    # probe-then-rebind would race other port users)
     server.settimeout(register_timeout)
     peers: Dict[str, Tuple[socket.socket, Any]] = {}
     addresses: Dict[str, Tuple[str, int]] = {}
@@ -685,10 +690,13 @@ def run_host_agent(
     name: str,
     orchestrator: str,
     retry_for: float = 30.0,
+    msg_log: Optional[str] = None,
 ) -> Dict[str, Any]:
     """One host agent process: register, deploy, run until ``stop``.
 
-    Returns a summary dict (delivered count, values) for logging."""
+    ``msg_log`` dumps every delivered message's full content to a
+    JSONL file (the reference's per-message log option).  Returns a
+    summary dict (delivered count, values) for logging."""
     from pydcop_tpu.algorithms import (
         AlgorithmDef,
         ComputationDef,
@@ -764,10 +772,16 @@ def run_host_agent(
         for cname in comps:
             directory.register_computation(cname, aname)
 
+    log = None
+    if msg_log is not None:
+        from pydcop_tpu.infrastructure.communication import MessageLog
+
+        log = MessageLog(msg_log)
     agent = Agent(
         name, comm,
         on_error=lambda comp, e: errors.append(f"{comp}: {e!r}"),
         discovery=directory,
+        msg_log=log,
     )
     computations = [
         module.build_computation(
@@ -826,6 +840,8 @@ def run_host_agent(
     finally:
         agent.stop()
         comm.close()
+        if log is not None:
+            log.close()
         try:
             conn.close()
         except OSError:

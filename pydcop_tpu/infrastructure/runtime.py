@@ -71,8 +71,13 @@ def solve_host(
     seed: int = 0,
     distribution=None,
     rounds: Optional[int] = None,
+    msg_log: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Solve ``dcop`` with the host message-driven runtime.
+
+    ``msg_log`` writes every delivered message's full content to a
+    JSONL file (the reference's per-message log option — one line per
+    message in ``simple_repr`` wire form).
 
     The budget is ``max_msgs`` delivered messages; when only ``rounds``
     is given it is converted as rounds × number of computations (one
@@ -86,12 +91,9 @@ def solve_host(
     across engines.
     """
     t0 = time.perf_counter()
-    if isinstance(algo, AlgorithmDef):
-        algo_name, params_in = algo.algo, dict(algo.params)
-        if algo_params:
-            params_in.update(algo_params)
-    else:
-        algo_name, params_in = algo, dict(algo_params or {})
+    from pydcop_tpu.algorithms import resolve_algo
+
+    algo_name, params_in = resolve_algo(algo, algo_params)
     module = load_algorithm_module(algo_name)
     params = prepare_algo_params(params_in, module.algo_params)
 
@@ -120,17 +122,27 @@ def solve_host(
             best["cost"] = sign * cost
             best["assignment"] = assignment
 
-    if mode == "sim":
-        status, delivered, size = _run_sim(
-            computations, timeout, max_msgs, seed, t0, snapshot
-        )
-    elif mode == "thread":
-        status, delivered, size = _run_threads(
-            dcop, computations, timeout, max_msgs, distribution, t0,
-            snapshot,
-        )
-    else:
-        raise ValueError(f"solve_host: unknown mode {mode!r}")
+    log = None
+    if msg_log is not None:
+        from pydcop_tpu.infrastructure.communication import MessageLog
+
+        log = MessageLog(msg_log)
+    try:
+        if mode == "sim":
+            status, delivered, size = _run_sim(
+                computations, timeout, max_msgs, seed, t0, snapshot,
+                msg_log=log,
+            )
+        elif mode == "thread":
+            status, delivered, size = _run_threads(
+                dcop, computations, timeout, max_msgs, distribution, t0,
+                snapshot, msg_log=log,
+            )
+        else:
+            raise ValueError(f"solve_host: unknown mode {mode!r}")
+    finally:
+        if log is not None:
+            log.close()
 
     assignment = {c.variable.name: c.current_value for c in var_comps}
     cost = dcop.solution_cost(assignment)
@@ -155,6 +167,7 @@ def _run_sim(
     seed: int,
     t0: float,
     snapshot,
+    msg_log=None,
 ) -> Tuple[str, int, int]:
     rnd = random.Random(seed)
     # per-(src, dest) FIFO channels: asynchrony means ANY interleaving
@@ -209,6 +222,8 @@ def _run_sim(
         src, dest = ch
         delivered += 1
         size += msg.size
+        if msg_log is not None:
+            msg_log.log("_sim", src, dest, msg)
         by_name[dest].on_message(src, msg)
     for c in computations:
         c.stop()
@@ -223,6 +238,7 @@ def _run_threads(
     distribution,
     t0: float,
     snapshot,
+    msg_log=None,
 ) -> Tuple[str, int, int]:
     from pydcop_tpu.infrastructure.agents import Agent
     from pydcop_tpu.infrastructure.communication import (
@@ -271,6 +287,7 @@ def _run_threads(
             aname, comm,
             on_error=lambda comp, e: errors.append((comp, e)),
             discovery=discovery,
+            msg_log=msg_log,
         )
         for cname in comp_names:
             agent.deploy_computation(by_name[cname])

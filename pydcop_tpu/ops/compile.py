@@ -925,12 +925,22 @@ def _shard_major_layout(multi_cons, n_shards: int, d_max: int):
     for item in multi_cons:
         by_arity.setdefault(len(item[1]), []).append(item)
 
+    # a constraint-FREE problem must still shard: without this, the
+    # (1,)-sized placeholder arrays cannot split over the mesh and
+    # device_put fails — hit by dynamic/elastic runs whose surviving
+    # variables share no constraint (every neighbor frozen), where the
+    # reform then crash-loops.  One ghost binary constraint per shard
+    # keeps every axis divisible; ghosts carry zero cost and are
+    # excluded from message accounting (n_real_edges).
+    if not by_arity and n_shards > 1:
+        by_arity[2] = []
+
     shards: List[List[Tuple[str, List[int], np.ndarray]]] = [
         [] for _ in range(n_shards)
     ]
     for k in sorted(by_arity):
         items = by_arity[k]
-        per_shard = math.ceil(len(items) / n_shards)
+        per_shard = max(1, math.ceil(len(items) / n_shards))
         target = per_shard * n_shards
         for i in range(target - len(items)):
             ghost_table = np.zeros((d_max,) * k, dtype=np.float32)

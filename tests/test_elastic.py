@@ -169,7 +169,11 @@ def test_elastic_two_kills_and_orchestrator_worker_death(tmp_path):
         [
             sys.executable, "-m", "pydcop_tpu", "orchestrator",
             str(yaml_file), "-a", "maxsum", "--port", str(port),
-            "--nb_agents", "3", "--rounds", "20000",
+            # budget balance: enough barriers that the kill sequence
+            # (~60-90s of epoch-driven waits) cannot outrun the solve,
+            # but few enough that the post-reform run cannot overrun
+            # the final communicate timeout on a loaded box
+            "--nb_agents", "3", "--rounds", "12000",
             "--chunk_size", "4", "--seed", "5", "--elastic",
             "--heartbeat_timeout", "60", "--uiport", str(ui_port),
         ],
@@ -215,7 +219,7 @@ def test_elastic_two_kills_and_orchestrator_worker_death(tmp_path):
             "epoch 4", proc=orch,
         )
 
-        orc_out, orc_err = orch.communicate(timeout=420)
+        orc_out, orc_err = orch.communicate(timeout=600)
         assert orch.returncode == 0, orc_err[-3000:]
         r = _parse_json_tail(orc_out)
         assert r["status"] == "finished"

@@ -151,3 +151,42 @@ def test_restarts_compose_with_mesh(algo_name):
     )
     assert r_mesh.best_cost == pytest.approx(r_flat.best_cost, abs=1e-4)
     assert r_mesh.assignment == r_flat.assignment
+
+
+def test_constraint_free_problem_shards():
+    """A problem whose surviving variables share NO constraint (every
+    neighbor frozen into an external) must still compile and run over
+    a mesh — dynamic/elastic reforms hit this shape and used to
+    crash-loop on the (1,)-placeholder device_put (round-4 fix:
+    ghost-constraint padding covers the empty case; the runner cache
+    keys on the problem's tree structure so per-segment recompiles
+    cannot reuse a mismatched sharded runner)."""
+    from pydcop_tpu.dcop.objects import ExternalVariable
+
+    d = Domain("colors", "", [0, 1, 2])
+    dcop = DCOP("frozen_ring")
+    vs = []
+    for i in range(8):
+        v = (
+            ExternalVariable(f"v{i}", d, 0)
+            if i % 2
+            else Variable(f"v{i}", d)
+        )
+        vs.append(v)
+        dcop.add_variable(v)
+    for i in range(8):
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}", f"1 if v{i} == v{(i + 1) % 8} else 0", vs
+            )
+        )
+    problem = compile_dcop(dcop, n_shards=8)
+    assert problem.n_real_edges == 0  # everything sliced to unary
+    module = load_algorithm_module("maxsum")
+    params = prepare_algo_params({}, module.algo_params)
+    r = run_batched(
+        problem, module, params, rounds=4, seed=0, mesh=make_mesh(8),
+        chunk_size=4,
+    )
+    assert r.cycles == 4
+    assert module.messages_per_round(problem) == 0  # ghosts not counted
