@@ -223,48 +223,75 @@ def _solve_process(
     # and surface only as a registration timeout
     import tempfile
 
-    err_files = [
-        tempfile.NamedTemporaryFile(
-            mode="w+", suffix=f".{name}.err", delete=False
-        )
-        for name in names
-    ]
-    procs = [
-        subprocess.Popen(
-            [
-                sys.executable, "-m", "pydcop_tpu", "agent",
-                "--names", name, "--runtime", "host",
-                "--orchestrator", f"127.0.0.1:{port}",
-            ]
-            + (["--msg_log", f"{msg_log}.{name}"] if msg_log else []),
-            env=env,
-            stdout=subprocess.DEVNULL,
-            stderr=ef,
-        )
-        for name, ef in zip(names, err_files)
-    ]
+    err_files = []
+    procs = []
+    orchestrated = False
     try:
-        return run_host_orchestrator(
-            dcop, algo_name, params_in, nb_agents=nb_agents, port=port,
-            rounds=rounds, timeout=timeout, seed=seed, ui_port=ui_port,
-            server=server,
-        )
-    except AgentFailureError as e:
-        tails = []
-        for name, ef in zip(names, err_files):
-            try:
-                with open(ef.name) as f:
-                    tail = f.read()[-800:].strip()
-            except OSError:
-                tail = ""
-            if tail:
-                tails.append(f"--- {name} stderr ---\n{tail}")
-        if tails:
-            raise AgentFailureError(
-                f"{e}\n" + "\n".join(tails)
-            ) from e
-        raise
+        for name in names:
+            ef = tempfile.NamedTemporaryFile(
+                mode="w+", suffix=f".{name}.err", delete=False
+            )
+            err_files.append(ef)
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "pydcop_tpu", "agent",
+                        "--names", name, "--runtime", "host",
+                        "--orchestrator", f"127.0.0.1:{port}",
+                    ]
+                    + (
+                        ["--msg_log", f"{msg_log}.{name}"]
+                        if msg_log
+                        else []
+                    ),
+                    env=env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=ef,
+                )
+            )
+        try:
+            orchestrated = True
+            return run_host_orchestrator(
+                dcop, algo_name, params_in, nb_agents=nb_agents,
+                port=port, rounds=rounds, timeout=timeout, seed=seed,
+                ui_port=ui_port, server=server,
+                # the caller's timeout must also bound registration: a
+                # child crashing at startup must not stall a short-
+                # timeout call for the full default register window
+                register_timeout=(
+                    min(120.0, max(timeout, 1.0))
+                    if timeout is not None
+                    else 120.0
+                ),
+            )
+        except AgentFailureError as e:
+            tails = []
+            for name, ef in zip(names, err_files):
+                try:
+                    with open(ef.name, "rb") as f:
+                        f.seek(0, os.SEEK_END)
+                        f.seek(max(0, f.tell() - 800))
+                        tail = f.read().decode("utf-8", "replace").strip()
+                except OSError:
+                    tail = ""
+                if tail:
+                    tails.append(f"--- {name} stderr ---\n{tail}")
+            if tails:
+                raise AgentFailureError(
+                    f"{e}\n" + "\n".join(tails)
+                ) from e
+            raise
     finally:
+        if not orchestrated:
+            # a spawn failure never reached the orchestrator: the
+            # pre-bound listener and any children are ours to reap
+            try:
+                server.close()
+            except OSError:
+                pass
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
         for p in procs:  # orchestrator's stop already reached them;
             # this only reaps stragglers
             if p.poll() is None:

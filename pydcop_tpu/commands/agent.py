@@ -45,6 +45,12 @@ def set_parser(subparsers) -> None:
 
 
 def run_cmd(args) -> int:
+    if args.msg_log and args.runtime != "host":
+        raise SystemExit(
+            "--msg_log records delivered message contents — only the "
+            "host runtime has per-message delivery (--runtime host); "
+            "the spmd runtime runs the fused batched engine"
+        )
     if len(args.names) > 1:
         # one OS process per agent: each is an independent
         # jax.distributed participant, so fork real subprocesses
