@@ -225,7 +225,6 @@ def _solve_process(
 
     err_files = []
     procs = []
-    orchestrated = False
     try:
         for name in names:
             ef = tempfile.NamedTemporaryFile(
@@ -250,7 +249,6 @@ def _solve_process(
                 )
             )
         try:
-            orchestrated = True
             return run_host_orchestrator(
                 dcop, algo_name, params_in, nb_agents=nb_agents,
                 port=port, rounds=rounds, timeout=timeout, seed=seed,
@@ -258,8 +256,12 @@ def _solve_process(
                 # the caller's timeout must also bound registration: a
                 # child crashing at startup must not stall a short-
                 # timeout call for the full default register window
+                # floor of 30s: each child must exec a fresh
+                # interpreter and import jax before it can register —
+                # a shorter solve timeout must not turn startup into
+                # a hard registration failure
                 register_timeout=(
-                    min(120.0, max(timeout, 1.0))
+                    min(120.0, max(timeout, 30.0))
                     if timeout is not None
                     else 120.0
                 ),
@@ -282,16 +284,15 @@ def _solve_process(
                 ) from e
             raise
     finally:
-        if not orchestrated:
-            # a spawn failure never reached the orchestrator: the
-            # pre-bound listener and any children are ours to reap
-            try:
-                server.close()
-            except OSError:
-                pass
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
+        # close() is idempotent: on the success path the orchestrator
+        # already closed it, on every failure path (spawn error, an
+        # exception before the orchestrator's own try) this is the
+        # only close — and it EOFs lingering children so the reap
+        # below is quick
+        try:
+            server.close()
+        except OSError:
+            pass
         for p in procs:  # orchestrator's stop already reached them;
             # this only reaps stragglers
             if p.poll() is None:

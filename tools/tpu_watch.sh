@@ -34,7 +34,7 @@ for i in $(seq 1 "$MAX"); do
       timeout -k 30 900 python tools/bench_gather.py \
         > "$OUT/gather.txt" 2>&1
       echo "[tpu_watch] gather bench rc=$?" | tee -a "$OUT/watch.log"
-      timeout -k 30 3000 python bench_configs.py --json \
+      timeout -k 30 3000 python bench_configs.py \
         > "$OUT/configs.json" 2> "$OUT/configs.err"
       crc=$?
       echo "[tpu_watch] configs done rc=$crc" | tee -a "$OUT/watch.log"
