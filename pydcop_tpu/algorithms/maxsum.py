@@ -67,6 +67,13 @@ algo_params = [
     AlgoParameterDef("noise", "float", None, 0.001),
     # value selection: argmin of belief each round
     AlgoParameterDef("initial", "str", ["declared", "random", "zero"], "zero"),
+    # belief-aggregation lowering (single-shard only): 'auto' = the
+    # backend-tuned default (TPU per-slot prefix gathers / CPU
+    # segment-sum); 'blockdiag' = ONE static variable-major
+    # permutation gather + per-128-variable-block one-hot matmuls on
+    # the MXU — the round-4 layout candidate (BASELINE.md headroom
+    # notes; adopt iff it beats 'auto' on the real chip)
+    AlgoParameterDef("belief", "str", ["auto", "blockdiag"], "auto"),
 ]
 
 
@@ -88,12 +95,93 @@ def init_state(
     noise = params.get("noise", 0.0) * jax.random.uniform(
         k_noise, (d, problem.n_vars), dtype=problem.unary.dtype
     )
-    return {
+    state = {
         "q": jnp.zeros((d, E), dtype=problem.unary.dtype),
         "r": jnp.zeros((d, E), dtype=problem.unary.dtype),
         "values": values,
         "noise": noise,
     }
+    if params.get("belief", "auto") == "blockdiag":
+        # the index is problem structure, built here (eagerly) because
+        # the step only sees traced arrays; single-shard only — the
+        # sharded step keeps its segment+psum path
+        perm, onehot = _blockdiag_index(problem)
+        state["bd_perm"] = perm
+        state["bd_onehot"] = onehot
+    return state
+
+
+_BLOCKDIAG_BLK = 128  # variables per one-hot block (one MXU tile side)
+
+# state keys that are pure problem-derived index data (rebuilt
+# identically by init_state): excluded from checkpoint-shape
+# strictness, like mgm2's pair index
+STATIC_STATE_KEYS = frozenset({"bd_perm", "bd_onehot"})
+
+
+def _blockdiag_index(problem: CompiledProblem):
+    """(perm i32[B·Lmax], onehot f32[B, Lmax, BLK]): a variable-major
+    padded edge order and the block-diagonal incidence such that
+    ``einsum('dbl,blv->dbv', r_pad[:, perm].reshape(d, B, Lmax),
+    onehot)`` is the per-variable sum of incoming r.  Built EAGERLY
+    (init_state) and carried as state leaves — inside the traced step
+    the problem arrays are tracers, so the index cannot be built
+    there (the mgm2 pair-index pattern, minus the cache: init_state
+    runs once per run and the build is O(n_edges) numpy)."""
+    import numpy as np
+
+    BLK = _BLOCKDIAG_BLK
+    ev = np.asarray(problem.edge_var)[: problem.n_edges]
+    n = problem.n_vars
+    n_blocks = (n + BLK - 1) // BLK
+    counts = np.bincount(ev, minlength=n_blocks * BLK)
+    block_counts = counts.reshape(n_blocks, BLK).sum(axis=1)
+    lmax = max(int(block_counts.max()), 1)
+    lmax = ((lmax + 127) // 128) * 128  # lane-align the block length
+    cells = n_blocks * lmax * BLK
+    if cells > (1 << 28):  # 1 GB of f32 incidence
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "belief='blockdiag' incidence needs %d cells (~%.1f GB of "
+            "f32: %d blocks x lmax=%d x %d) — a dense one-hot this "
+            "size likely exceeds the win; high-degree hubs inflate "
+            "lmax for EVERY block, prefer belief='auto' there",
+            cells, cells * 4 / 1e9, n_blocks, lmax, BLK,
+        )
+    order = np.argsort(ev, kind="stable")  # edges by target variable
+    starts = np.zeros(n_blocks * BLK, dtype=np.int64)
+    starts[1:] = np.cumsum(counts)[:-1]
+    perm = np.full(n_blocks * lmax, problem.n_edges, dtype=np.int32)
+    onehot = np.zeros((n_blocks, lmax, BLK), dtype=np.float32)
+    for b in range(n_blocks):
+        pos = 0
+        for v in range(b * BLK, min((b + 1) * BLK, n)):
+            c = int(counts[v])
+            if c:
+                sl = order[starts[v] : starts[v] + c]
+                perm[b * lmax + pos : b * lmax + pos + c] = sl
+                onehot[b, pos : pos + c, v - b * BLK] = 1.0
+                pos += c
+    return jnp.asarray(perm), jnp.asarray(onehot)
+
+
+def _belief_blockdiag(
+    problem: CompiledProblem,
+    perm: jax.Array,
+    onehot: jax.Array,
+    r: jax.Array,
+    unary_t: jax.Array,
+) -> jax.Array:
+    """Belief via ONE static permutation gather + block-diagonal
+    one-hot matmuls (MXU) — the round-4 layout candidate."""
+    d = r.shape[0]
+    pad = jnp.zeros((d, 1), dtype=r.dtype)
+    r_pad = jnp.concatenate([r, pad], axis=1)
+    n_blocks, lmax, blk = onehot.shape
+    r_vm = r_pad[:, perm].reshape(d, n_blocks, lmax)
+    acc = jnp.einsum("dbl,blv->dbv", r_vm, onehot)
+    return acc.reshape(d, n_blocks * blk)[:, : problem.n_vars] + unary_t
 
 
 def belief_from_r(
@@ -101,6 +189,7 @@ def belief_from_r(
     r: jax.Array,
     unary_t: jax.Array,
     axis_name: Optional[str] = None,
+    mode: str = "auto",
 ) -> jax.Array:
     """[d, n_vars] belief: unary + Σ incoming r per variable.
 
@@ -118,6 +207,11 @@ def belief_from_r(
     - **Sharded**: edges are mesh-local → local segment-sum, then one
       ``psum`` of the [d, n] accumulator across the mesh.
     """
+    if mode == "blockdiag" and axis_name is None:
+        # eager/analysis entry: build the index on the spot (the
+        # compiled step carries it in state instead — see init_state)
+        perm, onehot = _blockdiag_index(problem)
+        return _belief_blockdiag(problem, perm, onehot, r, unary_t)
     use_segment = (
         axis_name is not None or _costs.use_cpu_segment_path(problem)
     )
@@ -241,7 +335,17 @@ def step(
     )
 
     # -- 2. variable -> factor + value selection ----------------------
-    belief = belief_from_r(problem, r_new, unary_t, axis_name)  # [d, n]
+    if (
+        params.get("belief", "auto") == "blockdiag"
+        and axis_name is None
+        and "bd_perm" in state
+    ):
+        belief = _belief_blockdiag(
+            problem, state["bd_perm"], state["bd_onehot"], r_new,
+            unary_t,
+        )
+    else:
+        belief = belief_from_r(problem, r_new, unary_t, axis_name)
     belief_e = belief[:, problem.edge_var]  # exclude own incoming r
     if use_fused:
         q_new = pallas_maxsum.q_update(
@@ -253,10 +357,10 @@ def step(
         q_new = damping * q + (1.0 - damping) * q_new
     values = jnp.argmin(belief, axis=0).astype(state["values"].dtype)
     return {
+        **state,  # carries the static bd_* index leaves when present
         "q": q_new,
         "r": r_new,
         "values": values,
-        "noise": state["noise"],
     }
 
 

@@ -421,6 +421,18 @@ def run_batched(
     def _best_scalar(bc) -> float:
         return float(jnp.min(bc)) if batched_restarts else float(bc)
 
+    def _full_state_specs():
+        """The algorithm's declared state specs, completed with a
+        replicated P() for any state leaf it does not name — optional
+        leaves (e.g. maxsum's blockdiag index, present only under
+        that belief mode) must not break the shard_map pytree match."""
+        from jax.sharding import PartitionSpec as _P
+
+        from pydcop_tpu.parallel.mesh import state_pspecs
+
+        declared = state_pspecs(algo_module, problem)
+        return {k: declared.get(k, _P()) for k in state}
+
     def _stacked(sspecs):
         """Prepend the restart axis (replicated) to every state spec:
         a [K, ...] restart stack shards exactly like [...] did."""
@@ -443,7 +455,7 @@ def run_batched(
             from pydcop_tpu.parallel.mesh import problem_pspecs, state_pspecs
 
             pspecs = problem_pspecs(problem)
-            sspecs = _stacked(state_pspecs(algo_module, problem))
+            sspecs = _stacked(_full_state_specs())
             dyn_specs = {k: P() for k in dyn_params}
             sharded = jax.shard_map(
                 fn,
@@ -457,9 +469,7 @@ def run_batched(
         return runner
 
     if mesh is not None:
-        from pydcop_tpu.parallel.mesh import state_pspecs
-
-        sspecs = _stacked(state_pspecs(algo_module, problem))
+        sspecs = _stacked(_full_state_specs())
         state = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
             state,
@@ -508,6 +518,9 @@ def run_batched(
                         "problem": fingerprint,
                         "n_restarts": n_restarts,
                     },
+                    static_keys=getattr(
+                        algo_module, "STATIC_STATE_KEYS", ()
+                    ),
                 )
                 chunks_since_save = 0
         if chunk_callback is not None and done < rounds:
@@ -563,6 +576,7 @@ def run_batched(
                 "problem": fingerprint,
                 "n_restarts": n_restarts,
             },
+            static_keys=getattr(algo_module, "STATIC_STATE_KEYS", ()),
         )
 
     final_values = state["values"]

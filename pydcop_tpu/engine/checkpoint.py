@@ -34,14 +34,20 @@ def save_checkpoint(
     best_values,
     rounds_done: int,
     extra_meta: Dict[str, Any] = None,
+    static_keys=(),
 ) -> None:
     """Atomically write the run state to ``path`` (.npz).
 
     ``best_cost`` is a scalar, or a [K] vector for a multi-restart run
     (the per-restart anytime bests — ``best_values`` is then the
-    [K, n] stack)."""
+    [K, n] stack).  Leaves under ``static_keys`` are SKIPPED: the load
+    side backfills them from a freshly-initialized template anyway
+    (they are pure problem-derived index data), so writing them —
+    e.g. maxsum's dense blockdiag incidence — would be wasted I/O."""
     leaves = {}
     for kpath, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if _leaf_key(kpath[:1]) in static_keys:
+            continue
         leaves[f"state/{_leaf_key(kpath)}"] = np.asarray(leaf)
     leaves["best_values"] = np.asarray(best_values)
     meta = {

@@ -1,0 +1,87 @@
+"""A/B the belief-aggregation lowering on the north-star workload.
+
+Runs 10k-var coloring Max-Sum with ``belief='auto'`` (the backend
+default) and ``belief='blockdiag'`` (one static variable-major
+permutation + block-diagonal one-hot MXU matmuls — the round-4 layout
+candidate) and prints one JSON line per mode.  On a TPU backend each
+successful measurement also lands in BENCH_TPU_LOG.jsonl.
+
+Usage: python tools/bench_belief_mode.py [--cpu] [--vars N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if "--cpu" in sys.argv or "cpu" in (
+    os.environ.get("PYDCOP_TPU_PLATFORM", ""),
+    os.environ.get("JAX_PLATFORMS", ""),
+):
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--vars", type=int, default=10_000)
+    ap.add_argument("--rounds", type=int, default=1024)
+    ap.add_argument("--chunk", type=int, default=256)
+    args = ap.parse_args()
+
+    import __graft_entry__ as g
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_batched
+    from pydcop_tpu.ops import compile_dcop
+
+    dcop = g._make_coloring_dcop(args.vars, degree=3, seed=1)
+    problem = compile_dcop(dcop)
+    module = load_algorithm_module("maxsum")
+    platform = jax.devices()[0].platform
+    for mode in ("auto", "blockdiag"):
+        params = prepare_algo_params(
+            {"damping": 0.5, "belief": mode}, module.algo_params
+        )
+        run_batched(  # warmup: XLA compile out of the window
+            problem, module, params, rounds=args.chunk, seed=0,
+            chunk_size=args.chunk, cost_every=8,
+        )
+        t0 = time.perf_counter()
+        r = run_batched(
+            problem, module, params, rounds=args.rounds, seed=0,
+            chunk_size=args.chunk, cost_every=8,
+        )
+        dt = time.perf_counter() - t0
+        msgs_per_sec = module.messages_per_round(problem) * r.cycles / dt
+        out = {
+            "mode": mode,
+            "platform": platform,
+            "msgs_per_sec": round(msgs_per_sec),
+            "best_cost": round(float(r.best_cost), 4),
+            "n_vars": args.vars,
+            "seconds": round(dt, 3),
+        }
+        print(json.dumps(out), flush=True)
+        if platform == "tpu":
+            import bench
+
+            bench.append_tpu_log(
+                f"maxsum_coloring_{args.vars}_belief_{mode}",
+                msgs_per_sec,
+                best_cost=float(r.best_cost),
+                source="bench_belief_mode",
+            )
+
+
+if __name__ == "__main__":
+    main()

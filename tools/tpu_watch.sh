@@ -34,6 +34,11 @@ for i in $(seq 1 "$MAX"); do
       timeout -k 30 900 python tools/bench_gather.py \
         > "$OUT/gather.txt" 2>&1
       echo "[tpu_watch] gather bench rc=$?" | tee -a "$OUT/watch.log"
+      # the INTEGRATED A/B: north star with belief=auto vs blockdiag
+      # (also appends TPU results to BENCH_TPU_LOG.jsonl)
+      timeout -k 30 1200 python tools/bench_belief_mode.py \
+        > "$OUT/belief_ab.json" 2> "$OUT/belief_ab.err"
+      echo "[tpu_watch] belief A/B rc=$?" | tee -a "$OUT/watch.log"
       timeout -k 30 3000 python bench_configs.py \
         > "$OUT/configs.json" 2> "$OUT/configs.err"
       crc=$?
