@@ -95,7 +95,11 @@ def append_tpu_log(workload: str, msgs_per_sec: float, **extra) -> None:
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "sha": _git_sha(),
         "workload": workload,
-        "msgs_per_sec": round(float(msgs_per_sec)),
+        # None = "no throughput metric" (e.g. DPOP UTIL-seconds
+        # entries); last_good_tpu skips those
+        "msgs_per_sec": (
+            None if msgs_per_sec is None else round(float(msgs_per_sec))
+        ),
     }
     entry.update(extra)
     try:
@@ -107,12 +111,23 @@ def append_tpu_log(workload: str, msgs_per_sec: float, **extra) -> None:
 
 
 def last_good_tpu(workload: str | None = None) -> dict | None:
-    """Latest BENCH_TPU_LOG.jsonl entry (exact workload match, or any)."""
+    """Latest BENCH_TPU_LOG.jsonl entry for the workload (or any).
+
+    A measurement of ``<workload>_belief_auto`` (the A/B tool's label
+    for the backend-default lowering, same problem/params/accounting)
+    counts as the workload itself; other suffixed variants (e.g.
+    ``_belief_blockdiag``) are different lowerings and do not.
+    """
     try:
         with open(TPU_LOG) as f:
             lines = f.read().splitlines()
     except OSError:
         return None
+    aliases = (
+        None
+        if workload is None
+        else {workload, workload + "_belief_auto"}
+    )
     for line in reversed(lines):
         line = line.strip()
         if not line:
@@ -121,7 +136,21 @@ def last_good_tpu(workload: str | None = None) -> dict | None:
             entry = json.loads(line)
         except ValueError:
             continue
-        if workload is None or entry.get("workload") == workload:
+        msgs = entry.get("msgs_per_sec")
+        if not (isinstance(msgs, (int, float)) and msgs > 0):
+            # only positive throughput measurements count as "good
+            # TPU evidence" (DPOP config entries record UTIL seconds
+            # with no meaningful msgs/sec; surfacing one as the
+            # headline would claim the chip ran at 0 msgs/s)
+            continue
+        w = entry.get("workload", "")
+        if "_belief_" in w and not w.endswith("_belief_auto"):
+            # A/B entries for non-default lowerings (e.g. the
+            # rejected blockdiag candidate) are decision evidence,
+            # never headline evidence — excluded on the fallback
+            # path too, not just by the alias set
+            continue
+        if aliases is None or w in aliases:
             return entry
     return None
 
@@ -316,15 +345,24 @@ def _stage_entry(stage: str, r: dict, ok: bool) -> dict:
     return entry
 
 
-def _log_if_tpu(r: dict, source: str) -> None:
-    """Persist a successful TPU stage measurement (no-op otherwise)."""
+def log_if_tpu(r: dict, source: str, workload: str | None = None) -> None:
+    """Persist a successful TPU measurement (no-op otherwise).
+
+    The single durable-log entry point shared by the staged bench,
+    bench_configs and bench_scale, so the platform guard and entry
+    schema cannot diverge across tools.  ``workload`` defaults to the
+    canonical coloring key for the measurement's size.
+    """
     if r.get("platform") == "tpu" and "msgs_per_sec" in r:
         append_tpu_log(
-            f"maxsum_coloring_{r.get('n_vars', 0)}",
+            workload or f"maxsum_coloring_{r.get('n_vars', 0)}",
             r["msgs_per_sec"],
             best_cost=r.get("best_cost"),
             source=source,
         )
+
+
+_log_if_tpu = log_if_tpu  # internal callers predate the public name
 
 
 def _staged_default_backend() -> tuple:

@@ -251,6 +251,33 @@ def main() -> None:
         res = {"config": num, "name": name, **res}
         rows.append(res)
         print(json.dumps(res), flush=True)
+        if res.get("platform") == "tpu":
+            # durable TPU evidence (VERDICT r3 next #3): config
+            # measurements must survive the axon tunnel outages too
+            import bench
+
+            if "msgs_per_sec" in res:
+                # canonical workload keys where earlier log entries /
+                # BASELINE.md rows already use them, so fresh
+                # evidence supersedes stale entries under the SAME
+                # key a later last_good_tpu(workload) lookup uses
+                canonical = {5: "maxsum_meeting_10000"}
+                bench.log_if_tpu(
+                    res, "bench_configs",
+                    workload=canonical.get(num, f"config{num}_{name}"),
+                )
+            elif "util_time_device" in res:
+                # msgs_per_sec=None: DPOP evidence is UTIL seconds;
+                # bench.last_good_tpu skips non-positive entries so
+                # this can never surface as a throughput headline
+                bench.append_tpu_log(
+                    f"config{num}_{name}", None,
+                    util_time_device=res["util_time_device"],
+                    util_time_host=res["util_time_host"],
+                    best_cost=res.get("cost"),
+                    source="bench_configs (DPOP: util seconds, not "
+                    "msgs/sec)",
+                )
 
     if args.markdown:
         print()

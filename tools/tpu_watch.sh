@@ -29,8 +29,38 @@ for i in $(seq 1 "$MAX"); do
     # a tunnel that answered the probe then dropped must NOT look like
     # a capture — keep probing instead
     if [ "$rc" -eq 0 ] && grep -q '"backend": *"tpu"' "$OUT/bench.json"; then
-      # layout-candidate microbench (VERDICT r4 next #1): which
-      # execution of the belief aggregation wins on the real chip
+      # Capture order = staleness priority (tunnel windows can be
+      # ~4 min): the driver-config and scaling cells have been stale
+      # since r3, so they run FIRST; the layout micro-benches were
+      # already decided this round and run last.  Every capture that
+      # can silently fall back to CPU gets the same all-TPU check —
+      # a mid-chain tunnel drop must leave a SUSPECT marker, never
+      # CPU numbers posing as TPU cells.
+      timeout -k 30 3000 python bench_configs.py \
+        > "$OUT/configs.json" 2> "$OUT/configs.err"
+      crc=$?
+      echo "[tpu_watch] configs done rc=$crc" | tee -a "$OUT/watch.log"
+      if [ "$crc" -ne 0 ] || ! grep -q '"platform": *"tpu"' "$OUT/configs.json" \
+          || grep -q '"platform": *"cpu"' "$OUT/configs.json"; then
+        mv "$OUT/configs.json" "$OUT/configs.SUSPECT.json" 2>/dev/null
+        echo "[tpu_watch] configs capture NOT all-TPU — kept bench.json," \
+          "configs marked SUSPECT" | tee -a "$OUT/watch.log"
+      fi
+      # scaling rows (100k + 1M vars) — TPU cells stale since r3;
+      # successful TPU rows self-append to BENCH_TPU_LOG.jsonl
+      timeout -k 30 3000 python tools/bench_scale.py \
+        --sizes 100000 1000000 > "$OUT/scale.json" 2> "$OUT/scale.err"
+      src=$?
+      echo "[tpu_watch] scale bench rc=$src" | tee -a "$OUT/watch.log"
+      if [ "$src" -ne 0 ] || ! grep -q '"platform": *"tpu"' "$OUT/scale.json" \
+          || grep -q '"platform": *"cpu"' "$OUT/scale.json"; then
+        mv "$OUT/scale.json" "$OUT/scale.SUSPECT.json" 2>/dev/null
+        echo "[tpu_watch] scale capture NOT all-TPU — marked SUSPECT" \
+          | tee -a "$OUT/watch.log"
+      fi
+      # layout-candidate microbench (VERDICT r4 next #1, decided
+      # 2026-07-31: auto wins) — kept so future chips can re-open
+      # the decision cheaply
       timeout -k 30 900 python tools/bench_gather.py \
         > "$OUT/gather.txt" 2>&1
       echo "[tpu_watch] gather bench rc=$?" | tee -a "$OUT/watch.log"
@@ -39,18 +69,6 @@ for i in $(seq 1 "$MAX"); do
       timeout -k 30 1200 python tools/bench_belief_mode.py \
         > "$OUT/belief_ab.json" 2> "$OUT/belief_ab.err"
       echo "[tpu_watch] belief A/B rc=$?" | tee -a "$OUT/watch.log"
-      timeout -k 30 3000 python bench_configs.py \
-        > "$OUT/configs.json" 2> "$OUT/configs.err"
-      crc=$?
-      echo "[tpu_watch] configs done rc=$crc" | tee -a "$OUT/watch.log"
-      # the configs capture must ALSO be TPU evidence: a tunnel drop
-      # between the two runs would leave CPU-fallback numbers here
-      if [ "$crc" -ne 0 ] || ! grep -q '"platform": *"tpu"' "$OUT/configs.json" \
-          || grep -q '"platform": *"cpu"' "$OUT/configs.json"; then
-        mv "$OUT/configs.json" "$OUT/configs.SUSPECT.json" 2>/dev/null
-        echo "[tpu_watch] configs capture NOT all-TPU — kept bench.json," \
-          "configs marked SUSPECT" | tee -a "$OUT/watch.log"
-      fi
       exit 0
     fi
     echo "[tpu_watch] capture incomplete — resuming probes" \
