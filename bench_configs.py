@@ -192,15 +192,22 @@ def _run_dpop_config(dcop):
 
 
 # (name, generator, algo, params, rounds, chunk, canonical restarts).
-# Config 3 pins EIGHT parallel restarts as its canonical measurement:
-# Max-Sum on hubby loopy graphs is basin-sensitive to f32 summation
-# order (round-3 ledger: recorded cost moved 8.07 -> 27.02 from an
-# aggregation-order change alone), and best-of-8 at seed 0 is stable
-# across such changes while costing ~nothing extra on an accelerator.
+# Configs 1-3 pin parallel restarts as their canonical measurement
+# (best-of-K, both backends, per-restart spread reported):
+# - config 3 (r3): Max-Sum on hubby loopy graphs is basin-sensitive
+#   to f32 summation order (round-3 ledger: recorded cost moved
+#   8.07 -> 27.02 from an aggregation-order change alone); best-of-8
+#   at seed 0 is stable across such changes.
+# - configs 1-2 (r4): the small instances are pure dispatch overhead
+#   per round on EVERY backend (50-var DSA: 6.5x more msgs/s on CPU
+#   at K=64), and best-of-K is the accelerator-idiomatic execution
+#   of a stochastic local search.  K=64 for DSA-50 (cost 11.35 ->
+#   6.44); K=8 for MGM-2 (K=64 halves throughput — the [P,d,d]
+#   pair-tensor blowup documented in algorithms/mgm2.py).
 CONFIGS = {
     1: ("coloring50_dsaB", _gen_coloring_50, "dsa",
-        {"variant": "B", "probability": 0.7}, 1024, 256, 1),
-    2: ("ising32_mgm2", _gen_ising_32, "mgm2", {}, 1024, 256, 1),
+        {"variant": "B", "probability": 0.7}, 1024, 256, 64),
+    2: ("ising32_mgm2", _gen_ising_32, "mgm2", {}, 1024, 256, 8),
     3: ("scalefree1k_maxsum", _gen_scalefree_1k, "maxsum",
         {"damping": 0.5}, 1024, 256, 8),
     4: ("secp_dpop", _gen_secp, "dpop", None, None, None, 1),

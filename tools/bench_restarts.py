@@ -1,0 +1,107 @@
+"""Restart-scaling sweep on the north-star workload.
+
+Measures aggregate msgs/sec for K ∈ {1, 2, 4, 8} vmapped parallel
+restarts of 10k-var coloring Max-Sum, on whatever backend JAX picks.
+Two questions it answers (BASELINE.md headroom notes):
+
+1. **Does vmap-over-restarts amortize the per-round fixed costs?**
+   On CPU the host is already saturated, so aggregate msgs/s should
+   stay ~flat as K grows.  On TPU the round is partly launch/gather
+   bound; if the K-batched gathers cost closer to "per index" than
+   "per element", aggregate msgs/s rises toward K×.
+2. **The equal-footing pinned-restart comparison** for the north-star
+   table: config 3 already pins best-of-8 as its canonical
+   measurement; this gives the 10k-coloring equivalent on both
+   backends so a restarts row in BASELINE.md compares like with like.
+
+Message accounting: each restart is an independent solver instance
+performing every directed-edge update per round, so aggregate
+msgs/s = messages_per_round × K × cycles / seconds (config 3's rule).
+
+Usage: python tools/bench_restarts.py [--cpu] [--vars N] [--ks 1 2 4 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# the axon TPU plugin overrides JAX_PLATFORMS; a CPU pin must go
+# through jax.config BEFORE backend init (memory: axon-tpu-outage-
+# handling) or this bench hangs in TPU init when the tunnel is wedged
+if "--cpu" in sys.argv or "cpu" in (
+    os.environ.get("PYDCOP_TPU_PLATFORM", ""),
+    os.environ.get("JAX_PLATFORMS", ""),
+):
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--vars", type=int, default=10_000)
+    ap.add_argument("--rounds", type=int, default=1024)
+    ap.add_argument("--chunk", type=int, default=256)
+    ap.add_argument("--ks", type=int, nargs="*", default=[1, 2, 4, 8])
+    args = ap.parse_args()
+
+    import __graft_entry__ as g
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_batched
+    from pydcop_tpu.ops import compile_dcop
+
+    dcop = g._make_coloring_dcop(args.vars, degree=3, seed=1)
+    problem = compile_dcop(dcop)
+    module = load_algorithm_module("maxsum")
+    params = prepare_algo_params({"damping": 0.5}, module.algo_params)
+    platform = jax.devices()[0].platform
+    for k in args.ks:
+        run_batched(  # warmup: XLA compile out of the window
+            problem, module, params, rounds=args.chunk, seed=0,
+            chunk_size=args.chunk, cost_every=8, n_restarts=k,
+        )
+        t0 = time.perf_counter()
+        r = run_batched(
+            problem, module, params, rounds=args.rounds, seed=0,
+            chunk_size=args.chunk, cost_every=8, n_restarts=k,
+        )
+        dt = time.perf_counter() - t0
+        msgs_per_sec = (
+            module.messages_per_round(problem) * k * r.cycles / dt
+        )
+        out = {
+            "n_restarts": k,
+            "platform": platform,
+            "msgs_per_sec": round(msgs_per_sec),
+            "best_cost": round(float(r.best_cost), 4),
+            "restart_costs": (
+                None if r.restart_costs is None
+                else [round(float(c), 2) for c in r.restart_costs]
+            ),
+            "n_vars": args.vars,
+            "seconds": round(dt, 3),
+        }
+        print(json.dumps(out), flush=True)
+        if platform == "tpu":
+            import bench
+
+            bench.append_tpu_log(
+                f"maxsum_coloring_{args.vars}_restarts{k}",
+                msgs_per_sec,
+                best_cost=float(r.best_cost),
+                source="bench_restarts",
+            )
+
+
+if __name__ == "__main__":
+    main()

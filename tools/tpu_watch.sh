@@ -58,6 +58,12 @@ for i in $(seq 1 "$MAX"); do
         echo "[tpu_watch] scale capture NOT all-TPU — marked SUSPECT" \
           | tee -a "$OUT/watch.log"
       fi
+      # restart-scaling sweep (K=1..8 on the north star): does vmap
+      # over restarts amortize the TPU round's fixed costs?  TPU rows
+      # self-append to BENCH_TPU_LOG.jsonl
+      timeout -k 30 1800 python tools/bench_restarts.py \
+        > "$OUT/restarts.json" 2> "$OUT/restarts.err"
+      echo "[tpu_watch] restarts bench rc=$?" | tee -a "$OUT/watch.log"
       # layout-candidate microbench (VERDICT r4 next #1, decided
       # 2026-07-31: auto wins) — kept so future chips can re-open
       # the decision cheaply
