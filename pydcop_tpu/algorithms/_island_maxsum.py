@@ -1,0 +1,427 @@
+"""Compiled-island Max-Sum: one agent's subgraph on the array engine.
+
+The heterogeneous deployment mode of the host runtime (reference
+analogue: ``pydcop/infrastructure/agents.py`` hosts many Python
+computations per agent; here ONE strong agent — e.g. the machine with
+the TPU — hosts its computations as a single *compiled island* while
+every other agent runs the plain message-driven computations of
+``_host_maxsum``).  Boundary messages stay ``MaxSumCostMessage``
+frames on the wire, so remote agents cannot tell an island from a
+thousand Python computations.
+
+Mechanism (exact, not approximate):
+
+- The island's owned variables + factors form a sub-DCOP.  For every
+  boundary edge (owned factor ``f``, remote variable ``u``) a **shadow
+  variable** ``__shadow__f__u`` with ``u``'s domain joins the
+  sub-DCOP in ``u``'s scope position.  The sub-DCOP compiles through
+  the standard ``ops.compile_dcop`` path — the island then runs real
+  jitted :mod:`pydcop_tpu.algorithms.maxsum` rounds on it.
+- An incoming ``u → f`` cost message is pinned as the shadow's
+  outgoing ``q`` on its single edge before every internal round
+  (``q`` is recomputed in-step, so the pin is re-applied each round;
+  the shadow's noise column is zeroed so the authoritative message is
+  not perturbed).  The factor phase then marginalizes with EXACTLY
+  the remote's message, as the host factor computation would.
+- An incoming ``g → v`` cost message from a remote factor ``g`` to an
+  owned variable ``v`` folds into ``v``'s unary override
+  (``CompiledProblem.unary`` is a traced array leaf, so replacing it
+  costs no recompile) — belief and all internal ``q`` then include it.
+- Outgoing boundary messages are read back from device state: the
+  ``r`` row on a shadow edge IS ``f``'s message to ``u``; an owned
+  ``v``'s message to a remote factor ``g`` is ``belief_v`` minus the
+  last message received FROM ``g`` (the standard own-contribution
+  exclusion), with the same normalization + stability filter as
+  ``_host_maxsum`` so quiescence-based termination works unchanged.
+
+Each owned graph node is represented by a lightweight proxy
+computation, so hostnet deploy/routing/status/collect plumbing is
+untouched: message routing, ``current_value`` collection and the
+quiescence monitor all see ordinary computations.
+
+Scheduling: the island steps ``island_start_rounds`` internal rounds
+when started (interior convergence needs no boundary traffic) and
+``island_rounds`` more whenever its agent's inbox drains after new
+boundary messages — a legal BP schedule, like the engine's documented
+async-as-schedule equivalence (``docs/algorithms.md``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pydcop_tpu.algorithms._host_maxsum import (
+    STABILITY,
+    MaxSumCostMessage,
+    _normalize,
+    _stable,
+)
+from pydcop_tpu.infrastructure.computations import (
+    DcopComputation,
+    VariableComputation,
+    register,
+)
+
+_SHADOW = "__shadow__{}__{}"
+
+
+def _shadow_name(factor_name: str, var_name: str) -> str:
+    return _SHADOW.format(factor_name, var_name)
+
+
+class MaxSumIsland:
+    """Shared core behind one agent's island proxies."""
+
+    def __init__(
+        self,
+        var_nodes: List[Any],
+        factor_nodes: List[Any],
+        dcop,
+        algo_def,
+        seed: int,
+        pending_fn: Optional[Callable[[], int]] = None,
+    ):
+        import jax
+
+        from pydcop_tpu.algorithms import load_algorithm_module
+        from pydcop_tpu.dcop.dcop import DCOP
+        from pydcop_tpu.dcop.objects import Variable
+        from pydcop_tpu.dcop.relations import NAryMatrixRelation
+        from pydcop_tpu.ops import compile_dcop
+
+        self._module = load_algorithm_module("maxsum")
+        self._pending_fn = pending_fn or (lambda: 0)
+        params = dict(algo_def.params)
+        self._params = params
+        rounds = params.get("island_rounds")
+        self._rounds = 4 if rounds is None else int(rounds)
+        start_rounds = params.get("island_start_rounds")
+        self._start_rounds = (
+            64 if start_rounds is None else int(start_rounds)
+        )
+
+        owned_vars = {n.variable.name: n.variable for n in var_nodes}
+        owned_factors = {n.factor.name: n.factor for n in factor_nodes}
+        self.owned_var_names = set(owned_vars)
+        self.owned_factor_names = set(owned_factors)
+
+        # -- boundary discovery -----------------------------------------
+        # (owned factor, remote var) -> shadow; (owned var, remote
+        # factor) -> unary fold + host-side outgoing q
+        sub = DCOP(f"island_{seed}", objective=dcop.objective)
+        for v in owned_vars.values():
+            sub.add_variable(v)
+        self._shadow_of: Dict[Tuple[str, str], str] = {}
+        shadow_vars: Dict[str, Variable] = {}
+        for f in owned_factors.values():
+            scope = []
+            for v in f.dimensions:
+                if v.name in owned_vars:
+                    scope.append(v)
+                    continue
+                sname = _shadow_name(f.name, v.name)
+                if sname not in shadow_vars:
+                    shadow_vars[sname] = Variable(sname, v.domain)
+                    sub.add_variable(shadow_vars[sname])
+                self._shadow_of[(f.name, v.name)] = sname
+                scope.append(shadow_vars[sname])
+            # any relation kind -> table, dims remapped to the
+            # island-local scope (shadows standing in for remote vars)
+            sub.add_constraint(
+                NAryMatrixRelation(
+                    scope, f.as_matrix().matrix, name=f.name
+                )
+            )
+        # remote factors each owned variable hears from: graph
+        # neighbors of the variable node that are not owned factors
+        self._remote_factors_of: Dict[str, List[str]] = {}
+        for n in var_nodes:
+            remote = [
+                f for f in n.neighbors if f not in owned_factors
+            ]
+            if remote:
+                self._remote_factors_of[n.variable.name] = remote
+
+        self._problem = compile_dcop(sub)
+        p = self._problem
+        self._slot = {name: i for i, name in enumerate(p.var_names)}
+        self._labels = {
+            name: list(p.domain_labels[self._slot[name]])
+            for name in list(owned_vars) + list(shadow_vars)
+        }
+        self._d_max = p.d_max
+        self._n_edges = p.n_edges
+        ve = np.asarray(p.var_edges)
+        self._var_edges = {
+            name: [int(e) for e in ve[self._slot[name]] if e < p.n_edges]
+            for name in self._slot
+        }
+        # shadow vars have exactly one (incoming) edge: their factor's
+        self._shadow_edge = {
+            s: self._var_edges[s][0] for s in shadow_vars
+        }
+
+        # -- device state -------------------------------------------------
+        key = jax.random.PRNGKey(
+            (seed * 0x9E3779B1) & 0x7FFFFFFF
+        )
+        state = self._module.init_state(p, key, params)
+        if shadow_vars:
+            import jax.numpy as jnp
+
+            cols = jnp.asarray(
+                [self._slot[s] for s in shadow_vars], dtype=jnp.int32
+            )
+            state["noise"] = state["noise"].at[:, cols].set(0.0)
+        self._state = state
+        self._base_unary = np.asarray(p.unary).copy()
+
+        # received boundary messages, as padded float rows
+        self._q_in: Dict[Tuple[str, str], np.ndarray] = {}  # (f,u)->q
+        self._r_in: Dict[Tuple[str, str], np.ndarray] = {}  # (v,g)->r
+        self._last_sent: Dict[Tuple[str, str], Dict[Any, float]] = {}
+        self._proxies: Dict[str, "MessagePassingComputation"] = {}
+        self._n_started = 0
+        self._dirty = False
+        self._flushed_once = False
+
+        # n_rounds static: two jit cache entries (start burst + steady)
+        self._jit_step = jax.jit(
+            self._make_step(), static_argnums=(3,)
+        )
+        self._key0 = jax.random.PRNGKey(0)
+
+    # -- wiring ----------------------------------------------------------
+
+    def attach(self, proxy) -> None:
+        self._proxies[proxy.name] = proxy
+
+    def node_started(self) -> None:
+        self._n_started += 1
+        if self._n_started == len(self._proxies):
+            self._flush(self._start_rounds)
+
+    # -- inbound ---------------------------------------------------------
+
+    def _row(
+        self,
+        costs: Dict[Any, float],
+        labels: List[Any],
+        pad: float = 0.0,
+    ) -> np.ndarray:
+        """Cost dict -> padded [d_max] row.  ``pad`` fills positions
+        beyond the real domain: q pins need BIG there (a padded value
+        must never win a factor marginalization — normal edges get
+        this through the BIG unary flowing into q, which the pin
+        bypasses), while r folds need 0 (the base unary already
+        carries BIG on padded positions)."""
+        row = np.full(self._d_max, pad, dtype=np.float32)
+        for i, lab in enumerate(labels):
+            row[i] = float(costs.get(lab, 0.0))
+        return row
+
+    def receive(self, dest: str, sender: str, costs: Dict[Any, float]) -> None:
+        from pydcop_tpu.ops.compile import BIG
+
+        if dest in self.owned_factor_names:
+            # q from a remote variable: pin on the shadow edge
+            key = (dest, sender)
+            if key not in self._shadow_of:
+                return  # not a boundary edge of this island (stale)
+            sname = self._shadow_of[key]
+            self._q_in[key] = self._row(
+                costs, self._labels[sname], pad=BIG
+            )
+        elif dest in self.owned_var_names:
+            # r from a remote factor: folds into dest's unary override
+            self._r_in[(dest, sender)] = self._row(
+                costs, self._labels[dest]
+            )
+        else:
+            return  # stale/unknown destination
+        self._dirty = True
+        if self._flushed_once and self._pending_fn() == 0:
+            self._flush(self._rounds)
+
+    # -- the compiled step ------------------------------------------------
+
+    def _make_step(self):
+        import dataclasses
+        import jax.numpy as jnp
+
+        module, params = self._module, self._params
+        n_edges, d = self._n_edges, self._d_max
+        shadow_edges = sorted(self._shadow_edge.values())
+        se = jnp.asarray(shadow_edges, dtype=jnp.int32)
+
+        def run(problem_unary, state, q_pin, n_rounds):
+            problem = dataclasses.replace(
+                self._problem, unary=problem_unary
+            )
+
+            def body(carry, _):
+                st = carry
+                if len(shadow_edges):
+                    q = st["q"].at[:, se].set(q_pin)
+                    st = {**st, "q": q}
+                st = module.step(problem, st, self._key0, params)
+                return st, ()
+
+            import jax
+
+            state, _ = jax.lax.scan(body, state, None, length=n_rounds)
+            return state
+
+        return run
+
+    def _flush(self, n_rounds: int) -> None:
+        """Run internal rounds with current boundary pins, then emit
+        changed boundary messages and refresh proxy values."""
+        self._flushed_once = True
+        self._dirty = False
+        import jax.numpy as jnp
+
+        # unary override: base + sum of received remote-factor rows
+        unary = self._base_unary.copy()
+        for (v, _g), row in self._r_in.items():
+            unary[self._slot[v]] += row
+        # q pin matrix [d, n_shadow_edges] (column order = sorted
+        # edges).  Default column = zeros on the real domain (the host
+        # factor's "no message yet" assumption) and BIG on the padded
+        # tail, so a padded value can never win the marginalization
+        from pydcop_tpu.ops.compile import BIG
+
+        shadow_edges = sorted(self._shadow_edge.values())
+        q_pin = np.zeros(
+            (self._d_max, len(shadow_edges)), dtype=np.float32
+        )
+        col = {e: i for i, e in enumerate(shadow_edges)}
+        for sname, e in self._shadow_edge.items():
+            q_pin[len(self._labels[sname]):, col[e]] = BIG
+        for (f, u), srow in self._q_in.items():
+            sname = self._shadow_of[(f, u)]
+            q_pin[:, col[self._shadow_edge[sname]]] = srow
+        # the jitted scan length must stay static per jit cache entry:
+        # two entries (start burst + steady rounds) is fine
+        import jax
+
+        self._state = jax.block_until_ready(
+            self._jit_step(
+                jnp.asarray(unary), self._state, jnp.asarray(q_pin),
+                n_rounds,
+            )
+        )
+        self._emit(unary)
+
+    # -- outbound ---------------------------------------------------------
+
+    def _emit(self, unary: np.ndarray) -> None:
+        r = np.asarray(self._state["r"])
+        noise = np.asarray(self._state["noise"])
+        values = np.asarray(self._state["values"])
+
+        # factor -> remote variable: the r row on the shadow edge
+        for (f, u), sname in self._shadow_of.items():
+            e = self._shadow_edge[sname]
+            labels = self._labels[sname]
+            costs = _normalize(
+                {
+                    lab: float(r[i, e])
+                    for i, lab in enumerate(labels)
+                }
+            )
+            if _stable(costs, self._last_sent.get((f, u))):
+                continue
+            self._last_sent[(f, u)] = costs
+            self._proxies[f].post_msg(u, MaxSumCostMessage(costs))
+
+        # owned variable: value refresh (+ messages to remote factors)
+        for v in self.owned_var_names:
+            slot = self._slot[v]
+            labels = self._labels[v]
+            proxy = self._proxies[v]
+            belief = unary[slot].astype(np.float64) + noise[:, slot]
+            for e in self._var_edges[v]:
+                belief += r[:, e]
+            proxy.value_selection(labels[int(values[slot])])
+            for g in self._remote_factors_of.get(v, ()):
+                rcv = self._r_in.get((v, g))
+                out = belief[: len(labels)].copy()
+                if rcv is not None:
+                    out -= rcv[: len(labels)]
+                costs = _normalize(
+                    {lab: float(c) for lab, c in zip(labels, out)}
+                )
+                if _stable(costs, self._last_sent.get((v, g))):
+                    continue
+                self._last_sent[(v, g)] = costs
+                proxy.post_msg(g, MaxSumCostMessage(costs))
+
+
+class IslandVariableProxy(VariableComputation):
+    """Routing/collect stand-in for one island-hosted variable."""
+
+    def __init__(self, comp_def, island: MaxSumIsland):
+        super().__init__(comp_def.node.variable, comp_def)
+        self._island = island
+        island.attach(self)
+
+    def on_start(self) -> None:
+        self._island.node_started()
+
+    @register("maxsum_costs")
+    def _on_costs(self, sender: str, msg: MaxSumCostMessage, t: float) -> None:
+        self._island.receive(self.name, sender, msg.costs)
+
+
+class IslandFactorProxy(DcopComputation):
+    """Routing stand-in for one island-hosted factor."""
+
+    def __init__(self, comp_def, island: MaxSumIsland):
+        super().__init__(comp_def.node.name, comp_def)
+        self._island = island
+        island.attach(self)
+
+    def on_start(self) -> None:
+        self._island.node_started()
+
+    @register("maxsum_costs")
+    def _on_costs(self, sender: str, msg: MaxSumCostMessage, t: float) -> None:
+        self._island.receive(self.name, sender, msg.costs)
+
+
+def build_island(
+    comp_defs: List[Any],
+    dcop,
+    seed: int = 0,
+    pending_fn: Optional[Callable[[], int]] = None,
+) -> List[Any]:
+    """Build ONE island + its per-node proxies for an agent's placed
+    factor-graph computations.  Returns the proxy list (deployable
+    like ordinary computations)."""
+    from pydcop_tpu.graphs.factor_graph import FactorComputationNode
+
+    var_defs = [
+        cd for cd in comp_defs
+        if not isinstance(cd.node, FactorComputationNode)
+    ]
+    factor_defs = [
+        cd for cd in comp_defs
+        if isinstance(cd.node, FactorComputationNode)
+    ]
+    if not var_defs and not factor_defs:
+        return []
+    algo_def = comp_defs[0].algo
+    island = MaxSumIsland(
+        [cd.node for cd in var_defs],
+        [cd.node for cd in factor_defs],
+        dcop,
+        algo_def,
+        seed,
+        pending_fn=pending_fn,
+    )
+    return [IslandVariableProxy(cd, island) for cd in var_defs] + [
+        IslandFactorProxy(cd, island) for cd in factor_defs
+    ]

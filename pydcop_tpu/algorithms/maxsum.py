@@ -74,6 +74,11 @@ algo_params = [
     # the MXU — the round-4 layout candidate (BASELINE.md headroom
     # notes; adopt iff it beats 'auto' on the real chip)
     AlgoParameterDef("belief", "str", ["auto", "blockdiag"], "auto"),
+    # compiled-island scheduling (host runtime --accel agents only;
+    # ignored by the batched engine): internal rounds run at island
+    # start and per boundary-message wave (_island_maxsum.py)
+    AlgoParameterDef("island_rounds", "int", None, 4),
+    AlgoParameterDef("island_start_rounds", "int", None, 64),
 ]
 
 
@@ -424,3 +429,14 @@ def build_computation(comp_def, seed: int = 0):
     from pydcop_tpu.algorithms import _host_maxsum
 
     return _host_maxsum.build_computation(comp_def, seed=seed)
+
+
+def build_island(comp_defs, dcop, seed: int = 0, pending_fn=None):
+    """Compiled-island deployment: one agent's placed factor-graph
+    nodes as a single array-engine island behind per-node proxies
+    (``--accel`` agents on the host runtime; ``_island_maxsum.py``)."""
+    from pydcop_tpu.algorithms import _island_maxsum
+
+    return _island_maxsum.build_island(
+        comp_defs, dcop, seed=seed, pending_fn=pending_fn
+    )

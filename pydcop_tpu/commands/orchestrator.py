@@ -86,6 +86,14 @@ def set_parser(subparsers) -> None:
         "round-robin",
     )
     p.add_argument(
+        "--accel_agents", nargs="+", default=None, metavar="AGENT",
+        help="--runtime host only: agent name(s) whose placed "
+        "computations run as ONE compiled array-engine island (TPU "
+        "when the agent's machine has one) behind per-node proxies — "
+        "the heterogeneous strong-host deployment.  Requires island "
+        "support in the algorithm (maxsum)",
+    )
+    p.add_argument(
         "--runtime", choices=["spmd", "host"], default="spmd",
         help="spmd (default): batched engine over a jax.distributed "
         "mesh, every process computes the whole sharded problem in "
@@ -108,6 +116,11 @@ def run_cmd(args) -> int:
             "orchestrator: --distribution applies to --runtime host "
             "(the SPMD runtime shards the whole compiled problem; "
             "placement is the mesh layout)"
+        )
+    if args.accel_agents and args.runtime != "host":
+        raise SystemExit(
+            "orchestrator: --accel_agents applies to --runtime host "
+            "(the SPMD runtime is all-accelerator already)"
         )
     placement = None
     dist_name = None
@@ -195,6 +208,12 @@ def run_cmd(args) -> int:
                     "implementation — use the SPMD runtime for "
                     "batched-only algorithms"
                 )
+            if args.accel_agents and not hasattr(_mod, "build_island"):
+                raise ValueError(
+                    f"{args.algo} has no compiled-island support "
+                    "(build_island) — --accel_agents works with: "
+                    "maxsum"
+                )
         except ValueError as e:
             raise SystemExit(f"orchestrator: {e}")
         try:
@@ -211,6 +230,7 @@ def run_cmd(args) -> int:
                 distribution=dist_name,
                 placement=placement,
                 ui_port=args.uiport,
+                accel_agents=args.accel_agents,
             )
         except PlacementError as e:  # usage errors: clean exit
             raise SystemExit(f"orchestrator: {e}")

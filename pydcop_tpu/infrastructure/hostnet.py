@@ -328,6 +328,7 @@ def run_host_orchestrator(
     best_sample_period: float = 0.5,
     ui_port: Optional[int] = None,
     server: Optional[socket.socket] = None,
+    accel_agents: Optional[List[str]] = None,
 ) -> Dict[str, Any]:
     """Wait for ``nb_agents`` host agents, deploy, run to quiescence /
     budget / timeout, and return the assembled result dict.
@@ -360,6 +361,12 @@ def run_host_orchestrator(
         raise ValueError(
             f"{algo}: no host build_computation — use the SPMD "
             "orchestrator for batched-only algorithms"
+        )
+    accel_agents = set(accel_agents or ())
+    if accel_agents and not hasattr(module, "build_island"):
+        raise ValueError(
+            f"{algo}: no compiled-island support (build_island) — "
+            "accel agents are available for: maxsum"
         )
     params = prepare_algo_params(params, module.algo_params)
     graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
@@ -506,6 +513,13 @@ def run_host_orchestrator(
             )
         placement = {a: list(placement.get(a, [])) for a in agent_names}
 
+        unknown_accel = accel_agents - set(agent_names)
+        if unknown_accel:
+            raise PlacementError(
+                f"accel_agents names unregistered agent(s) "
+                f"{sorted(unknown_accel)} (registered: {agent_names})"
+            )
+
         yaml_text = dcop_yaml(dcop)
         directory = {a: list(addresses[a]) for a in agent_names}
         for name, (conn, _) in peers.items():
@@ -520,6 +534,7 @@ def run_host_orchestrator(
                     "placement": placement,
                     "directory": directory,
                     "seed": seed,
+                    "accel": name in accel_agents,
                 },
             )
         for name in peers:
@@ -783,13 +798,30 @@ def run_host_agent(
         discovery=directory,
         msg_log=log,
     )
-    computations = [
-        module.build_computation(
-            ComputationDef(by_name[cname], algo_def),
+    if dep.get("accel") and hasattr(module, "build_island"):
+        # compiled island: this agent's whole sub-graph runs on the
+        # array engine (TPU when present) behind per-node proxies —
+        # the heterogeneous "one strong host" deployment
+        computations = module.build_island(
+            [
+                ComputationDef(by_name[cname], algo_def)
+                for cname in sorted(mine)
+            ],
+            dcop,
             seed=dep["seed"],
+            # called from inside a proxy handler, where pending counts
+            # the in-flight message itself: subtract it so "0" means
+            # the inbox is drained and the island should flush
+            pending_fn=lambda: max(0, agent.messaging.pending - 1),
         )
-        for cname in sorted(mine)
-    ]
+    else:
+        computations = [
+            module.build_computation(
+                ComputationDef(by_name[cname], algo_def),
+                seed=dep["seed"],
+            )
+            for cname in sorted(mine)
+        ]
     for comp in computations:
         agent.deploy_computation(comp)
     _send(conn, {"type": "deployed", "n": len(computations)})

@@ -1,0 +1,340 @@
+"""Compiled-island Max-Sum — the heterogeneous strong-host deployment
+(``algorithms/_island_maxsum.py``): one agent's factor-graph subgraph
+runs on the array engine behind per-node proxies while other agents run
+plain host computations; boundary messages stay MaxSumCostMessage
+frames, so the mix is invisible on the wire.
+
+Reference analogue: pyDcop deploys heterogeneous agents over HTTP
+(``pydcop/infrastructure/communication.py``); the island is this
+build's TPU-first version — the machine with the chip hosts its whole
+sub-problem as one compiled island.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _chain_dcop(n=6, colors=3):
+    """A path v0-v1-...-v{n-1} with equality-penalty constraints: a
+    TREE, so min-sum converges to the exact optimum (0)."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    d = Domain("colors", "", list(range(colors)))
+    dcop = DCOP("chain", objective="min")
+    vs = [Variable(f"v{i}", d) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    eye = np.eye(colors)
+    for i in range(n - 1):
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[i], vs[i + 1]], eye, name=f"c{i}")
+        )
+    return dcop
+
+
+def _graph_and_defs(dcop, params=None):
+    from pydcop_tpu.algorithms import (
+        AlgorithmDef,
+        ComputationDef,
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.graphs import load_graph_module
+
+    module = load_algorithm_module("maxsum")
+    params = prepare_algo_params(params or {}, module.algo_params)
+    graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
+        dcop
+    )
+    algo_def = AlgorithmDef("maxsum", params, dcop.objective)
+    defs = {
+        n.name: ComputationDef(n, algo_def) for n in graph.nodes
+    }
+    return module, defs
+
+
+def _cost(dcop, comps):
+    from pydcop_tpu.infrastructure.computations import (
+        VariableComputation,
+    )
+
+    assignment = {
+        c.variable.name: c.current_value
+        for c in comps
+        if isinstance(c, VariableComputation)
+    }
+    assert None not in assignment.values(), assignment
+    return dcop.solution_cost(assignment), assignment
+
+
+def test_island_pure():
+    """Whole problem on one island: the start burst alone must solve a
+    tree to its optimum (no boundary traffic exists)."""
+    from pydcop_tpu.algorithms import maxsum
+
+    dcop = _chain_dcop(8)
+    module, defs = _graph_and_defs(dcop)
+    comps = maxsum.build_island(list(defs.values()), dcop, seed=1)
+    # every graph node got a proxy (routing/collect surface intact)
+    assert {c.name for c in comps} == set(defs)
+    sent = []
+    for c in comps:
+        c.message_sender = lambda s, d, m: sent.append((s, d))
+    for c in comps:
+        c.start()
+    cost, assignment = _cost(dcop, comps)
+    assert cost == 0.0, assignment
+    assert sent == []  # no boundary — nothing may leave the island
+
+
+def test_island_mixed_sim_parity():
+    """Half the chain on an island, half as plain host computations,
+    run under the deterministic sim loop: the mixed deployment reaches
+    the tree optimum exactly like the all-host one, via wire-identical
+    messages."""
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.infrastructure.runtime import _run_sim, solve_host
+
+    dcop = _chain_dcop(8)
+    module, defs = _graph_and_defs(dcop)
+    # island owns v0..v3 and c0..c2 (c3 = boundary factor v3-v4 stays
+    # remote, so the island has BOTH boundary kinds: an owned variable
+    # hearing a remote factor (v3<-c3) is exercised, and the remote
+    # half keeps an owned-factor boundary in the all-host direction)
+    island_names = {f"v{i}" for i in range(4)} | {
+        f"c{i}" for i in range(3)
+    }
+    island_defs = [defs[n] for n in sorted(island_names)]
+    host_defs = [
+        defs[n] for n in sorted(set(defs) - island_names)
+    ]
+    comps = maxsum.build_island(island_defs, dcop, seed=1)
+    comps += [
+        module.build_computation(cd, seed=1) for cd in host_defs
+    ]
+    t0 = time.perf_counter()
+    status, delivered, _size = _run_sim(
+        comps, timeout=60, max_msgs=100_000, seed=5, t0=t0,
+        snapshot=lambda: None,
+    )
+    assert status == "finished", status  # quiescence, not budget
+    assert delivered > 0  # real boundary traffic crossed the seam
+    cost, assignment = _cost(dcop, comps)
+    assert cost == 0.0, (assignment, delivered)
+
+    # all-host reference run on the same problem
+    host = solve_host(dcop, "maxsum", mode="sim", seed=5, timeout=60)
+    assert host["cost"] == cost == 0.0
+
+
+def test_island_owned_factor_boundary():
+    """Island owns a FACTOR whose scope is split (one owned variable,
+    one remote): the shadow-variable path — pinned remote q, r row
+    read-back — must still reach the exact tree optimum."""
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.infrastructure.runtime import _run_sim
+
+    dcop = _chain_dcop(6)
+    module, defs = _graph_and_defs(dcop)
+    # v0,v1,c0,c1: c1 spans v1 (owned) and v2 (remote) -> shadow
+    island_names = {"v0", "v1", "c0", "c1"}
+    comps = maxsum.build_island(
+        [defs[n] for n in sorted(island_names)], dcop, seed=2
+    )
+    assert any(
+        c.name == "c1" for c in comps
+    ), "boundary factor proxy missing"
+    comps += [
+        module.build_computation(defs[n], seed=2)
+        for n in sorted(set(defs) - island_names)
+    ]
+    status, delivered, _ = _run_sim(
+        comps, timeout=60, max_msgs=100_000, seed=7,
+        t0=time.perf_counter(), snapshot=lambda: None,
+    )
+    assert status == "finished", status
+    cost, assignment = _cost(dcop, comps)
+    assert cost == 0.0, (assignment, delivered)
+
+
+def test_island_mixed_domain_sizes():
+    """Heterogeneous domains: a remote (shadow) variable whose domain
+    is smaller than the island's d_max.  The shadow q pin must carry
+    BIG on padded positions — zeros there let the factor
+    marginalization pick an invalid padded value (review-found bug:
+    mixed run converged to 5.0 instead of 0.0)."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.infrastructure.runtime import _run_sim
+
+    d4 = Domain("d4", "", [0, 1, 2, 3])
+    d2 = Domain("d2", "", [0, 1])
+    dcop = DCOP("mixed", objective="min")
+    vs = [
+        Variable("v0", d4), Variable("v1", d2), Variable("v2", d4)
+    ]
+    for v in vs:
+        dcop.add_variable(v)
+    # equality penalized where domains overlap: optimum 0 exists
+    def eq_table(da, db):
+        t = np.zeros((len(da), len(db)))
+        for i, a in enumerate(da):
+            for j, b in enumerate(db):
+                t[i, j] = 5.0 if a == b else 0.0
+        return t
+
+    dcop.add_constraint(
+        NAryMatrixRelation(
+            [vs[0], vs[1]], eq_table([0, 1, 2, 3], [0, 1]), name="c0"
+        )
+    )
+    dcop.add_constraint(
+        NAryMatrixRelation(
+            [vs[1], vs[2]], eq_table([0, 1], [0, 1, 2, 3]), name="c1"
+        )
+    )
+    module, defs = _graph_and_defs(dcop)
+    # island = {v0, c0}: c0's scope spans v1 (remote, |domain|=2 <
+    # island d_max=4) -> the shadow pin's padded tail is live
+    comps = maxsum.build_island(
+        [defs["v0"], defs["c0"]], dcop, seed=0
+    )
+    comps += [
+        module.build_computation(defs[n], seed=0)
+        for n in sorted(set(defs) - {"v0", "c0"})
+    ]
+    status, _, _ = _run_sim(
+        comps, timeout=60, max_msgs=100_000, seed=3,
+        t0=time.perf_counter(), snapshot=lambda: None,
+    )
+    assert status == "finished"
+    cost, assignment = _cost(dcop, comps)
+    assert cost == 0.0, assignment
+
+
+def test_island_max_objective():
+    """objective: max flows through the island's sign handling (the
+    compiled side negates at compile; hosts negate in-message): a
+    2-var 'prefer different' reward chain maximizes to n-1."""
+    from pydcop_tpu.algorithms import maxsum
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.infrastructure.runtime import _run_sim
+
+    d = Domain("colors", "", [0, 1, 2])
+    dcop = DCOP("maxchain", objective="max")
+    vs = [Variable(f"v{i}", d) for i in range(4)]
+    for v in vs:
+        dcop.add_variable(v)
+    reward = 1.0 - np.eye(3)  # 1 when different
+    for i in range(3):
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[i], vs[i + 1]], reward, name=f"c{i}")
+        )
+    module, defs = _graph_and_defs(dcop)
+    island_names = {"v0", "v1", "c0", "c1"}
+    comps = maxsum.build_island(
+        [defs[n] for n in sorted(island_names)], dcop, seed=0
+    )
+    comps += [
+        module.build_computation(defs[n], seed=0)
+        for n in sorted(set(defs) - island_names)
+    ]
+    status, _, _ = _run_sim(
+        comps, timeout=60, max_msgs=100_000, seed=1,
+        t0=time.perf_counter(), snapshot=lambda: None,
+    )
+    assert status == "finished"
+    cost, assignment = _cost(dcop, comps)
+    assert cost == 3.0, assignment
+
+
+def _ring_yaml(n=8):
+    lines = [
+        "name: ring",
+        "objective: min",
+        "domains:",
+        "  colors: {values: [0, 1, 2]}",
+        "variables:",
+    ]
+    for i in range(n):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for i in range(n):
+        j = (i + 1) % n
+        lines.append(f"  c{i}:")
+        lines.append("    type: intention")
+        lines.append(f"    function: 1 if v{i} == v{j} else 0")
+    lines.append(f"agents: [{', '.join(f'a{i}' for i in range(n))}]")
+    return "\n".join(lines) + "\n"
+
+
+def test_hostnet_accel_island(tmp_path):
+    """Cross-process heterogeneous deployment: agent a1 is a compiled
+    island (--accel_agents a1), a2 runs plain host computations; the
+    ring still solves to optimum over real TCP frames."""
+    yaml_file = tmp_path / "ring.yaml"
+    yaml_file.write_text(_ring_yaml())
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYDCOP_TPU_PLATFORM"] = "cpu"
+
+    port = 9440 + (os.getpid() % 120)
+    orch = subprocess.Popen(
+        [
+            sys.executable, "-m", "pydcop_tpu", "orchestrator",
+            str(yaml_file), "-a", "maxsum", "--runtime", "host",
+            "--port", str(port), "--nb_agents", "2",
+            "--rounds", "400", "--seed", "3",
+            "--accel_agents", "a1",
+        ],
+        env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    time.sleep(0.5)
+    agents = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu", "agent",
+                "--names", name, "--runtime", "host",
+                "--orchestrator", f"localhost:{port}",
+            ],
+            env=env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for name in ("a1", "a2")
+    ]
+    try:
+        orc_out, orc_err = orch.communicate(timeout=180)
+        assert orch.returncode == 0, orc_err[-3000:]
+        start = orc_out.index("{")
+        result = json.loads(orc_out[start:])
+        assert result["cost"] == 0.0, result
+        assert set(result["assignment"]) == {
+            f"v{i}" for i in range(8)
+        }
+        # the island agent really hosted computations
+        assert result["placement"]["a1"], result["placement"]
+        assert result["msg_count"] > 0
+    finally:
+        for a in agents:
+            if a.poll() is None:
+                a.kill()
+            a.communicate(timeout=30)
+        if orch.poll() is None:
+            orch.kill()
+            orch.communicate(timeout=30)
