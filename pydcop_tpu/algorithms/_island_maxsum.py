@@ -337,16 +337,22 @@ class MaxSumIsland:
             self._last_sent[(f, u)] = costs
             self._proxies[f].post_msg(u, MaxSumCostMessage(costs))
 
-        # owned variable: value refresh (+ messages to remote factors)
+        # owned variable: value refresh for every proxy (cheap — the
+        # device already argmin'ed), belief recomputation ONLY for
+        # boundary variables (the ones with remote factors): the
+        # interior can be thousands of variables per flush
         for v in self.owned_var_names:
+            self._proxies[v].value_selection(
+                self._labels[v][int(values[self._slot[v]])]
+            )
+        for v, remote in self._remote_factors_of.items():
             slot = self._slot[v]
             labels = self._labels[v]
             proxy = self._proxies[v]
             belief = unary[slot].astype(np.float64) + noise[:, slot]
             for e in self._var_edges[v]:
                 belief += r[:, e]
-            proxy.value_selection(labels[int(values[slot])])
-            for g in self._remote_factors_of.get(v, ()):
+            for g in remote:
                 rcv = self._r_in.get((v, g))
                 out = belief[: len(labels)].copy()
                 if rcv is not None:

@@ -4,8 +4,12 @@ The reference-class deployment shape: N agent OS processes exchanging
 simple_repr JSON frames over TCP, placement via a real distribution
 strategy.  Fills BASELINE.md's >=4-process row (VERDICT r4 next #6).
 
-Usage: python tools/bench_hostnet.py [n_agents] [n_vars]
+Usage: python tools/bench_hostnet.py [n_agents] [n_vars] [--accel]
 Prints one JSON line {n_agents, n_vars, msgs_per_sec, cost, time}.
+``--accel`` makes agent a1 a compiled island (the heterogeneous
+strong-host deployment): wire msgs/sec then counts only BOUNDARY
+traffic — compare ``cost`` and ``time``, not msgs/sec, against the
+all-host run.
 """
 
 import json
@@ -20,8 +24,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main() -> None:
-    n_agents = int(sys.argv[1]) if len(sys.argv) > 1 else 4
-    n_vars = int(sys.argv[2]) if len(sys.argv) > 2 else 300
+    accel = "--accel" in sys.argv
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n_agents = int(args[0]) if len(args) > 0 else 4
+    n_vars = int(args[1]) if len(args) > 1 else 300
 
     import __graft_entry__ as g
     from pydcop_tpu.dcop.yamldcop import dcop_yaml
@@ -44,7 +50,8 @@ def main() -> None:
             yaml_path, "-a", "maxsum", "--runtime", "host",
             "--port", str(port), "--nb_agents", str(n_agents),
             "--rounds", "60", "--seed", "1",
-        ],
+        ]
+        + (["--accel_agents", "a1"] if accel else []),
         env=env, cwd=tmp,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
@@ -72,6 +79,7 @@ def main() -> None:
                 {
                     "n_agents": n_agents,
                     "n_vars": n_vars,
+                    "accel": accel,
                     "msgs_per_sec": round(r["msg_count"] / r["time"]),
                     "msg_count": r["msg_count"],
                     "cost": r["cost"],
