@@ -38,6 +38,11 @@ algo_params = [
     # asynchrony knob (1.0 == synchronous Max-Sum)
     AlgoParameterDef("activation", "float", None, 0.5),
     AlgoParameterDef("initial", "str", ["declared", "random", "zero"], "zero"),
+    # compiled-island scheduling (host runtime --accel agents; the
+    # island steps its subgraph synchronously — a schedule choice,
+    # like the batched activation schedule above)
+    AlgoParameterDef("island_rounds", "int", None, 4),
+    AlgoParameterDef("island_start_rounds", "int", None, 64),
 ]
 
 # state layout is identical to synchronous Max-Sum
@@ -100,3 +105,14 @@ def build_computation(comp_def, seed: int = 0):
     from pydcop_tpu.algorithms import _host_maxsum
 
     return _host_maxsum.build_computation(comp_def, seed=seed)
+
+
+def build_island(comp_defs, dcop, seed: int = 0, pending_fn=None):
+    """Compiled-island deployment (shared with ``maxsum``): the island
+    steps its subgraph synchronously per boundary wave — one more
+    legal schedule for the same fixed point (``_island_maxsum.py``)."""
+    from pydcop_tpu.algorithms import _island_maxsum
+
+    return _island_maxsum.build_island(
+        comp_defs, dcop, seed=seed, pending_fn=pending_fn
+    )

@@ -42,7 +42,7 @@ def _chain_dcop(n=6, colors=3):
     return dcop
 
 
-def _graph_and_defs(dcop, params=None):
+def _graph_and_defs(dcop, params=None, algo="maxsum"):
     from pydcop_tpu.algorithms import (
         AlgorithmDef,
         ComputationDef,
@@ -51,12 +51,12 @@ def _graph_and_defs(dcop, params=None):
     )
     from pydcop_tpu.graphs import load_graph_module
 
-    module = load_algorithm_module("maxsum")
+    module = load_algorithm_module(algo)
     params = prepare_algo_params(params or {}, module.algo_params)
     graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
         dcop
     )
-    algo_def = AlgorithmDef("maxsum", params, dcop.objective)
+    algo_def = AlgorithmDef(algo, params, dcop.objective)
     defs = {
         n.name: ComputationDef(n, algo_def) for n in graph.nodes
     }
@@ -97,16 +97,18 @@ def test_island_pure():
     assert sent == []  # no boundary — nothing may leave the island
 
 
-def test_island_mixed_sim_parity():
+@pytest.mark.parametrize("algo", ["maxsum", "amaxsum"])
+def test_island_mixed_sim_parity(algo):
     """Half the chain on an island, half as plain host computations,
     run under the deterministic sim loop: the mixed deployment reaches
     the tree optimum exactly like the all-host one, via wire-identical
-    messages."""
+    messages.  amaxsum shares the island (one more schedule for the
+    same fixed point)."""
     from pydcop_tpu.algorithms import maxsum
     from pydcop_tpu.infrastructure.runtime import _run_sim, solve_host
 
     dcop = _chain_dcop(8)
-    module, defs = _graph_and_defs(dcop)
+    module, defs = _graph_and_defs(dcop, algo=algo)
     # island owns v0..v3 and c0..c2 (c3 = boundary factor v3-v4 stays
     # remote, so the island has BOTH boundary kinds: an owned variable
     # hearing a remote factor (v3<-c3) is exercised, and the remote
@@ -118,7 +120,7 @@ def test_island_mixed_sim_parity():
     host_defs = [
         defs[n] for n in sorted(set(defs) - island_names)
     ]
-    comps = maxsum.build_island(island_defs, dcop, seed=1)
+    comps = module.build_island(island_defs, dcop, seed=1)
     comps += [
         module.build_computation(cd, seed=1) for cd in host_defs
     ]
@@ -133,7 +135,7 @@ def test_island_mixed_sim_parity():
     assert cost == 0.0, (assignment, delivered)
 
     # all-host reference run on the same problem
-    host = solve_host(dcop, "maxsum", mode="sim", seed=5, timeout=60)
+    host = solve_host(dcop, algo, mode="sim", seed=5, timeout=60)
     assert host["cost"] == cost == 0.0
 
 
