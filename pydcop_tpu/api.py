@@ -10,7 +10,7 @@ with the same keys the reference's CLI/JSON surface exposes:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Mapping, Optional, Union
+from typing import Any, Dict, Mapping, Optional, Sequence, Union
 
 from pydcop_tpu.algorithms import (
     AlgorithmDef,
@@ -40,6 +40,7 @@ def solve(
     n_restarts: int = 1,
     nb_agents: Optional[int] = None,
     msg_log: Optional[str] = None,
+    accel_agents: Optional[Sequence[str]] = None,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -54,6 +55,11 @@ def solve(
     parity-test schedule), or ``"process"`` (one OS process per agent
     over the TCP host runtime — the reference's
     ``run_local_process_dcop``; ``nb_agents`` caps the process count).
+    In process mode ``accel_agents`` names agents deployed as compiled
+    array-engine islands (``algorithms/_island_maxsum.py``); agent
+    names are the dcop's declared AgentDefs (padded with
+    ``agent_0, agent_1, …`` when it declares fewer than
+    ``nb_agents``).
 
     Stop conditions differ per engine (round budget + optional
     ``convergence_chunks`` for batched; quiescence for thread/sim) —
@@ -88,6 +94,12 @@ def solve(
                 "nb_agents is the process count of mode='process'; "
                 f"mode={mode!r} decides its own parallelism"
             )
+        if accel_agents:
+            raise ValueError(
+                "accel_agents (compiled islands) deploys through the "
+                "host runtime's agents — use mode='process' or the "
+                "orchestrator/agent CLI with --accel_agents"
+            )
         from pydcop_tpu.infrastructure import solve_host
 
         return solve_host(
@@ -103,10 +115,17 @@ def solve(
         return _solve_process(
             dcop, algo, algo_params, rounds=rounds, timeout=timeout,
             seed=seed, nb_agents=nb_agents, ui_port=ui_port,
-            msg_log=msg_log,
+            msg_log=msg_log, accel_agents=accel_agents,
         )
     if mode != "batched":
         raise ValueError(f"solve: unknown mode {mode!r}")
+    if accel_agents:
+        raise ValueError(
+            "accel_agents (compiled islands) deploys through the host "
+            "runtime's agents — use mode='process' or the "
+            "orchestrator/agent CLI with --accel_agents (the batched "
+            "engine is all-accelerator already)"
+        )
     if msg_log is not None:
         raise ValueError(
             "msg_log records individual message contents — only the "
@@ -164,6 +183,7 @@ def _solve_process(
     nb_agents: Optional[int],
     ui_port: Optional[int],
     msg_log: Optional[str] = None,
+    accel_agents: Optional[Sequence[str]] = None,
 ) -> Dict[str, Any]:
     """One-call multi-process solve (reference:
     ``pydcop/infrastructure/run.py:run_local_process_dcop``): spawn
@@ -210,6 +230,14 @@ def _solve_process(
             names.append(candidate)
             used.add(candidate)
 
+    unknown = set(accel_agents or ()) - set(names)
+    if unknown:
+        raise ValueError(
+            f"accel_agents {sorted(unknown)} are not among this "
+            f"run's agent names {names} (declared AgentDefs first, "
+            "then generated agent_<i> padding)"
+        )
+
     # the children must find THIS package wherever the embedding
     # process imported it from (the parent may have extended sys.path
     # programmatically — env PYTHONPATH is how that survives the fork)
@@ -218,6 +246,20 @@ def _solve_process(
     pkg_root = os.path.dirname(os.path.dirname(pydcop_tpu.__file__))
     env = dict(os.environ)
     env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    # propagate the parent's jax platform pin: an embedding process
+    # pinned to CPU (jax.config — the only pin the axon TPU plugin
+    # cannot override) must not fork agent children that grab (or hang
+    # on) an accelerator it explicitly avoided.  Matters for island
+    # agents — plain host agents never initialize a backend.
+    if "PYDCOP_TPU_PLATFORM" not in env:
+        jax_mod = sys.modules.get("jax")
+        parent_pin = (
+            getattr(jax_mod.config, "jax_platforms", None)
+            if jax_mod is not None
+            else None
+        )
+        if parent_pin:
+            env["PYDCOP_TPU_PLATFORM"] = parent_pin
     # children's stderr goes to tempfiles: a crashing agent must be
     # diagnosable from the parent's failure, not vanish into DEVNULL
     # and surface only as a registration timeout
@@ -253,6 +295,7 @@ def _solve_process(
                 dcop, algo_name, params_in, nb_agents=nb_agents,
                 port=port, rounds=rounds, timeout=timeout, seed=seed,
                 ui_port=ui_port, server=server,
+                accel_agents=list(accel_agents or ()),
                 # the caller's timeout must also bound registration: a
                 # child crashing at startup must not stall a short-
                 # timeout call for the full default register window

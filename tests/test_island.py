@@ -284,6 +284,32 @@ def _ring_yaml(n=8):
     return "\n".join(lines) + "\n"
 
 
+def test_solve_process_accel_island():
+    """solve(mode='process', accel_agents=[...]) — the embedding
+    surface of the heterogeneous island deployment: one of two local
+    agent processes runs its subgraph as a compiled island."""
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+
+    dcop = load_dcop(_ring_yaml(8))
+    r = solve(
+        dcop, "maxsum", mode="process", nb_agents=2, rounds=400,
+        timeout=120, seed=1, accel_agents=["a0"],
+    )
+    assert r["cost"] == 0.0, r
+    assert len(r["agents"]) == 2
+
+    # validation: unknown island name fails fast, pre-fork
+    with pytest.raises(ValueError, match="accel_agents"):
+        solve(
+            dcop, "maxsum", mode="process", nb_agents=2,
+            accel_agents=["nope"], timeout=30,
+        )
+    # and the batched engine rejects it with a pointer
+    with pytest.raises(ValueError, match="accel_agents"):
+        solve(dcop, "maxsum", accel_agents=["a0"], rounds=4)
+
+
 def test_hostnet_accel_island(tmp_path):
     """Cross-process heterogeneous deployment: agent a1 is a compiled
     island (--accel_agents a1), a2 runs plain host computations; the
