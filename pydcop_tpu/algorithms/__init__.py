@@ -256,14 +256,54 @@ def resolve_algo(algo, algo_params=None):
 
 
 def load_algorithm_module(name: str):
-    """Import an algorithm plugin module by name."""
+    """Import an algorithm plugin module by name.
+
+    A plain name loads from this package; a dotted name is imported
+    as-is from ``sys.path``, so third-party algorithm modules plug in
+    without being copied into the package (``docs/extending.md``).
+    """
+    target = name if "." in name else f"{_ALGO_PACKAGE}.{name}"
+    if target.startswith(".") or target.endswith("."):
+        raise AlgorithmDefError(
+            f"Could not load algorithm {name!r}: relative module names "
+            "are not supported (see docs/extending.md)"
+        )
     try:
-        return importlib.import_module(f"{_ALGO_PACKAGE}.{name}")
+        mod = importlib.import_module(target)
     except ImportError as e:
+        if "." in name:
+            # external plugin: the internal algorithm list is never
+            # where a dotted name resolves, and a broken import INSIDE
+            # an existing module must not read as "unknown algorithm"
+            missing_target = isinstance(e, ModuleNotFoundError) and (
+                e.name == target
+                or (e.name and target.startswith(e.name + "."))
+            )
+            raise AlgorithmDefError(
+                f"Could not import external algorithm module "
+                f"{name!r}: {e}"
+                + (
+                    ""
+                    if missing_target
+                    else " (the module exists but failed to import)"
+                )
+            )
         raise AlgorithmDefError(
             f"Could not load algorithm {name!r}: {e}; available: "
             f"{list_available_algorithms()}"
         )
+    if (
+        "." in name
+        and not hasattr(mod, "GRAPH_TYPE")
+        and not hasattr(mod, "solve_host")
+    ):
+        # exact algorithms may export only solve_host (docs/extending.md)
+        raise AlgorithmDefError(
+            f"External module {name!r} is not an algorithm plugin "
+            "(no GRAPH_TYPE or solve_host; see docs/extending.md "
+            "for the contract)"
+        )
+    return mod
 
 
 def list_available_algorithms() -> List[str]:

@@ -211,12 +211,6 @@ def _solve_process(
     if nb_agents < 1:
         raise ValueError(f"nb_agents must be >= 1, got {nb_agents}")
 
-    # pre-bound control-plane listener: the port must be known before
-    # the agents fork, and a probe-then-rebind would race other port
-    # users — run_host_orchestrator accepts the live socket instead
-    server = socket.create_server(("", 0))
-    port = server.getsockname()[1]
-
     # prefer the dcop's own agent names so hosting/capacity data flows
     # into the placement; pad with generated names when it has fewer
     # (skipping any declared name the generator would collide with)
@@ -237,6 +231,23 @@ def _solve_process(
             f"run's agent names {names} (declared AgentDefs first, "
             "then generated agent_<i> padding)"
         )
+    if accel_agents:
+        # fail before forking nb_agents interpreters, mirroring the
+        # orchestrator-side check (hostnet.run_host_orchestrator)
+        from pydcop_tpu.algorithms import load_algorithm_module
+
+        if not hasattr(load_algorithm_module(algo_name), "build_island"):
+            raise ValueError(
+                f"{algo_name}: no compiled-island support "
+                "(build_island) — accel agents are available for: "
+                "maxsum, amaxsum"
+            )
+
+    # pre-bound control-plane listener: the port must be known before
+    # the agents fork, and a probe-then-rebind would race other port
+    # users — run_host_orchestrator accepts the live socket instead
+    server = socket.create_server(("", 0))
+    port = server.getsockname()[1]
 
     # the children must find THIS package wherever the embedding
     # process imported it from (the parent may have extended sys.path
@@ -244,8 +255,27 @@ def _solve_process(
     import pydcop_tpu
 
     pkg_root = os.path.dirname(os.path.dirname(pydcop_tpu.__file__))
+    path_entries = [pkg_root]
+    # a dotted algo name resolves on the parent's sys.path (an external
+    # plugin, docs/extending.md) — forward its top package's location
+    # too, or every child fails the deploy with an import error
+    if "." in algo_name:
+        import importlib.util
+
+        spec = importlib.util.find_spec(algo_name.split(".")[0])
+        if spec and spec.submodule_search_locations:
+            # every location: a PEP-420 namespace package may be split
+            # across several sys.path entries
+            for loc in spec.submodule_search_locations:
+                parent = os.path.dirname(loc)
+                if parent not in path_entries:
+                    path_entries.append(parent)
+        elif spec and spec.origin:
+            path_entries.append(os.path.dirname(spec.origin))
     env = dict(os.environ)
-    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = os.pathsep.join(
+        path_entries + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+    )
     # propagate the parent's jax platform pin: an embedding process
     # pinned to CPU (jax.config — the only pin the axon TPU plugin
     # cannot override) must not fork agent children that grab (or hang

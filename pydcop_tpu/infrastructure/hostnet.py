@@ -366,7 +366,7 @@ def run_host_orchestrator(
     if accel_agents and not hasattr(module, "build_island"):
         raise ValueError(
             f"{algo}: no compiled-island support (build_island) — "
-            "accel agents are available for: maxsum"
+            "accel agents are available for: maxsum, amaxsum"
         )
     params = prepare_algo_params(params, module.algo_params)
     graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(

@@ -1,0 +1,176 @@
+"""External algorithm plugins via dotted module names (docs/extending.md).
+
+The reference discovers algorithms inside its own package
+(``pydcop/algorithms/__init__.py`` module path); the dotted-name escape
+hatch lets third-party modules plug into the same registry seam without
+being copied into the package.
+"""
+
+import sys
+import textwrap
+
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDefError, load_algorithm_module
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+
+PLUGIN = textwrap.dedent(
+    """
+    # Minimal external plugin: greedy best-response (DSA-C with p=1).
+    import jax
+    import jax.numpy as jnp
+    from pydcop_tpu.ops.costs import local_cost_sweep
+
+    GRAPH_TYPE = "constraints_hypergraph"
+    algo_params = []
+
+    def init_state(problem, key, params):
+        return {"values": problem.init_idx}
+
+    def step(problem, state, key, params, axis_name=None):
+        local = local_cost_sweep(problem, state["values"], axis_name)
+        # alternate parity classes so neighbors never move together
+        parity = jnp.arange(problem.n_vars) % 2
+        rnd = jax.random.randint(key, (), 0, 2)
+        cand = jnp.argmin(local, axis=1).astype(state["values"].dtype)
+        move = parity == rnd
+        return {"values": jnp.where(move, cand, state["values"])}
+
+    def values_from_state(state):
+        return state["values"]
+
+    def messages_per_round(problem, params=None):
+        import numpy as np
+        return int(np.asarray(problem.neighbor_mask).sum())
+
+    def computation_memory(node):
+        return len(node.neighbors)
+
+    def communication_load(node, neighbor_name):
+        return 1.0
+
+    def build_computation(comp_def, seed=0):
+        # host path: reuse the DSA skeleton (docs/extending.md)
+        from pydcop_tpu.algorithms import _host_dsa
+        return _host_dsa.build_computation(
+            comp_def, seed=seed, variant="C", probability=1.0
+        )
+    """
+)
+
+
+def ring(n=8, colors=3):
+    d = Domain("colors", "", list(range(colors)))
+    dcop = DCOP(f"ring{n}")
+    vs = [Variable(f"v{i}", d) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}", f"1 if v{i} == v{(i + 1) % n} else 0", vs
+            )
+        )
+    return dcop
+
+
+@pytest.fixture()
+def plugin_on_path(tmp_path):
+    pkg = tmp_path / "extlab"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "greedy.py").write_text(PLUGIN)
+    sys.path.insert(0, str(tmp_path))
+    try:
+        yield "extlab.greedy"
+    finally:
+        sys.path.remove(str(tmp_path))
+        for m in ("extlab", "extlab.greedy"):
+            sys.modules.pop(m, None)
+
+
+def test_dotted_name_loads_and_solves(plugin_on_path):
+    mod = load_algorithm_module(plugin_on_path)
+    assert mod.GRAPH_TYPE == "constraints_hypergraph"
+    result = solve(ring(8, 3), plugin_on_path, rounds=60, seed=0)
+    assert result["cost"] == 0.0
+    assert result["msg_count"] > 0
+
+
+def test_dotted_name_reaches_process_mode_children(plugin_on_path):
+    # the forked agent processes must inherit the plugin's sys.path
+    # entry (api._solve_process forwards it via PYTHONPATH) — without
+    # it every child dies at deploy with an import error
+    result = solve(
+        ring(6, 3), plugin_on_path, mode="process", nb_agents=2,
+        timeout=60,
+    )
+    # any clean terminal status proves the children imported the
+    # plugin; a missing PYTHONPATH entry raises AgentFailureError
+    assert result["status"] in ("finished", "stopped", "msg_budget")
+    assert set(result["assignment"]) == {f"v{i}" for i in range(6)}
+
+
+def test_dotted_name_must_be_a_plugin():
+    with pytest.raises(AlgorithmDefError, match="not an algorithm plugin"):
+        load_algorithm_module("os.path")  # importable, but no GRAPH_TYPE
+
+
+def test_broken_external_plugin_reports_import_failure(tmp_path):
+    pkg = tmp_path / "brokenlab"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "bad.py").write_text("import not_a_real_dependency\n")
+    sys.path.insert(0, str(tmp_path))
+    try:
+        with pytest.raises(
+            AlgorithmDefError, match="exists but failed to import"
+        ):
+            load_algorithm_module("brokenlab.bad")
+        with pytest.raises(AlgorithmDefError) as ei:
+            load_algorithm_module("brokenlab.nope")
+        assert "available" not in str(ei.value)
+    finally:
+        sys.path.remove(str(tmp_path))
+        for m in ("brokenlab", "brokenlab.bad"):
+            sys.modules.pop(m, None)
+
+
+def test_unknown_plain_name_lists_available():
+    with pytest.raises(AlgorithmDefError, match="available"):
+        load_algorithm_module("definitely_not_an_algo")
+
+
+def test_relative_name_rejected_cleanly():
+    with pytest.raises(AlgorithmDefError, match="relative"):
+        load_algorithm_module(".foo")
+
+
+def test_solve_host_only_external_plugin_loads(tmp_path):
+    pkg = tmp_path / "exactlab"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "solver.py").write_text(
+        "algo_params = []\n"
+        "def solve_host(dcop, params, timeout=None):\n"
+        "    return {}\n"
+    )
+    sys.path.insert(0, str(tmp_path))
+    try:
+        mod = load_algorithm_module("exactlab.solver")
+        assert hasattr(mod, "solve_host")
+    finally:
+        sys.path.remove(str(tmp_path))
+        for m in ("exactlab", "exactlab.solver"):
+            sys.modules.pop(m, None)
+
+
+def test_accel_agents_without_island_support_fails_prefork():
+    with pytest.raises(ValueError, match="no compiled-island support"):
+        solve(
+            ring(6, 3), "dsa", mode="process", nb_agents=2,
+            accel_agents=["agent_0"], timeout=30,
+        )
