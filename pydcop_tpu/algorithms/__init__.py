@@ -292,18 +292,40 @@ def load_algorithm_module(name: str):
             f"Could not load algorithm {name!r}: {e}; available: "
             f"{list_available_algorithms()}"
         )
-    if (
-        "." in name
-        and not hasattr(mod, "GRAPH_TYPE")
-        and not hasattr(mod, "solve_host")
-    ):
-        # exact algorithms may export only solve_host (docs/extending.md)
-        raise AlgorithmDefError(
-            f"External module {name!r} is not an algorithm plugin "
-            "(no GRAPH_TYPE or solve_host; see docs/extending.md "
-            "for the contract)"
-        )
+    if "." in name:
+        # exact algorithms may export only solve_host (docs/extending.md);
+        # algo_params is required either way — every solve entry point
+        # dereferences it right after loading
+        if not hasattr(mod, "GRAPH_TYPE") and not hasattr(mod, "solve_host"):
+            raise AlgorithmDefError(
+                f"External module {name!r} is not an algorithm plugin "
+                "(no GRAPH_TYPE or solve_host; see docs/extending.md "
+                "for the contract)"
+            )
+        if not hasattr(mod, "algo_params"):
+            raise AlgorithmDefError(
+                f"External module {name!r} declares no algo_params "
+                "(use `algo_params = []` for a parameter-free "
+                "algorithm; see docs/extending.md)"
+            )
     return mod
+
+
+def require_island_support(module, algo_name: str) -> None:
+    """Raise unless ``module`` can deploy compiled islands
+    (``build_island`` — the heterogeneous strong-host path used by
+    ``accel_agents`` across the process/thread/sim runtimes and the
+    host orchestrator)."""
+    if not hasattr(module, "build_island"):
+        have = [
+            a
+            for a in list_available_algorithms()
+            if hasattr(load_algorithm_module(a), "build_island")
+        ]
+        raise ValueError(
+            f"{algo_name}: no compiled-island support (build_island) "
+            f"— accel agents are available for: {', '.join(have)}"
+        )
 
 
 def list_available_algorithms() -> List[str]:

@@ -55,11 +55,14 @@ def solve(
     parity-test schedule), or ``"process"`` (one OS process per agent
     over the TCP host runtime — the reference's
     ``run_local_process_dcop``; ``nb_agents`` caps the process count).
-    In process mode ``accel_agents`` names agents deployed as compiled
-    array-engine islands (``algorithms/_island_maxsum.py``); agent
-    names are the dcop's declared AgentDefs (padded with
+    In process, thread, and sim modes ``accel_agents`` names agents
+    deployed as compiled array-engine islands
+    (``algorithms/_island_maxsum.py``).  Process mode draws agent
+    names from the dcop's declared AgentDefs (padded with
     ``agent_0, agent_1, …`` when it declares fewer than
-    ``nb_agents``).
+    ``nb_agents``); thread/sim modes use the same placement as their
+    runs (declared agents round-robin, or ``a_<computation>`` when
+    the dcop declares none).
 
     Stop conditions differ per engine (round budget + optional
     ``convergence_chunks`` for batched; quiescence for thread/sim) —
@@ -94,17 +97,12 @@ def solve(
                 "nb_agents is the process count of mode='process'; "
                 f"mode={mode!r} decides its own parallelism"
             )
-        if accel_agents:
-            raise ValueError(
-                "accel_agents (compiled islands) deploys through the "
-                "host runtime's agents — use mode='process' or the "
-                "orchestrator/agent CLI with --accel_agents"
-            )
         from pydcop_tpu.infrastructure import solve_host
 
         return solve_host(
             dcop, algo, algo_params, mode=mode, timeout=timeout,
             seed=seed, rounds=rounds, msg_log=msg_log,
+            accel_agents=accel_agents,
         )
     if mode == "process":
         if checkpoint_path is not None or resume or n_restarts != 1:
@@ -234,14 +232,12 @@ def _solve_process(
     if accel_agents:
         # fail before forking nb_agents interpreters, mirroring the
         # orchestrator-side check (hostnet.run_host_orchestrator)
-        from pydcop_tpu.algorithms import load_algorithm_module
+        from pydcop_tpu.algorithms import (
+            load_algorithm_module,
+            require_island_support,
+        )
 
-        if not hasattr(load_algorithm_module(algo_name), "build_island"):
-            raise ValueError(
-                f"{algo_name}: no compiled-island support "
-                "(build_island) — accel agents are available for: "
-                "maxsum, amaxsum"
-            )
+        require_island_support(load_algorithm_module(algo_name), algo_name)
 
     # pre-bound control-plane listener: the port must be known before
     # the agents fork, and a probe-then-rebind would race other port

@@ -46,6 +46,13 @@ def set_parser(subparsers) -> None:
         "declared agent, capped at the CPU count)",
     )
     p.add_argument(
+        "--accel_agents", nargs="+", default=None, metavar="NAME",
+        help="(thread/sim/process modes) agents whose placed subgraph "
+        "runs as ONE compiled array-engine island instead of "
+        "per-computation host code (the heterogeneous strong-host "
+        "deployment; maxsum/amaxsum)",
+    )
+    p.add_argument(
         "--msg_log", default=None, metavar="FILE",
         help="(thread/sim/process modes) dump every delivered "
         "message's full content to FILE as JSON lines (the reference "
@@ -120,6 +127,7 @@ def run_cmd(args) -> int:
             n_restarts=args.restarts,
             nb_agents=args.nb_agents,
             msg_log=args.msg_log,
+            accel_agents=args.accel_agents,
         )
     finally:
         # flush the trace even when the solve raises — a profile of a

@@ -351,6 +351,7 @@ def run_host_orchestrator(
     from pydcop_tpu.algorithms import (
         load_algorithm_module,
         prepare_algo_params,
+        require_island_support,
     )
     from pydcop_tpu.dcop.yamldcop import dcop_yaml
     from pydcop_tpu.graphs import load_graph_module
@@ -363,11 +364,8 @@ def run_host_orchestrator(
             "orchestrator for batched-only algorithms"
         )
     accel_agents = set(accel_agents or ())
-    if accel_agents and not hasattr(module, "build_island"):
-        raise ValueError(
-            f"{algo}: no compiled-island support (build_island) — "
-            "accel agents are available for: maxsum, amaxsum"
-        )
+    if accel_agents:
+        require_island_support(module, algo)
     params = prepare_algo_params(params, module.algo_params)
     graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
         dcop

@@ -44,9 +44,47 @@ from pydcop_tpu.infrastructure.computations import (
 )
 
 
+def _placement(
+    dcop: DCOP, comp_names: List[str], distribution
+) -> Dict[str, List[str]]:
+    """agent -> [computation names]: given Distribution, else dcop
+    agents round-robin, else one agent per computation (the
+    reference's oneagent default)."""
+    placement: Dict[str, List[str]] = {}
+    if distribution is not None:
+        for cname in comp_names:
+            placement.setdefault(
+                distribution.agent_for(cname), []
+            ).append(cname)
+    elif dcop.agents:
+        agent_names = sorted(dcop.agents)
+        for i, cname in enumerate(comp_names):
+            placement.setdefault(
+                agent_names[i % len(agent_names)], []
+            ).append(cname)
+    else:
+        for cname in comp_names:
+            placement.setdefault(f"a_{cname}", []).append(cname)
+    return placement
+
+
 def _build_computations(
-    dcop: DCOP, algo_name: str, params: Dict[str, Any], seed: int
-) -> List[MessagePassingComputation]:
+    dcop: DCOP,
+    algo_name: str,
+    params: Dict[str, Any],
+    seed: int,
+    distribution=None,
+    accel: Optional[set] = None,
+    pending_refs: Optional[Dict[str, Dict[str, Any]]] = None,
+) -> Tuple[List[MessagePassingComputation], Optional[Dict[str, List[str]]]]:
+    """Build one computation per graph node; agents named in ``accel``
+    get their whole placed sub-graph as ONE compiled island
+    (``build_island`` proxies) instead of per-node host computations.
+    Returns ``(computations, placement)`` — placement is None unless
+    islands forced it to be computed here (one graph build either way).
+    ``pending_refs[agent]['fn']`` is the island's late-bound
+    inbox-drained probe — the runtime rebinds it once its delivery
+    structure exists."""
     module = load_algorithm_module(algo_name)
     if not hasattr(module, "build_computation"):
         raise ValueError(
@@ -55,10 +93,40 @@ def _build_computations(
         )
     graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(dcop)
     algo_def = AlgorithmDef(algo_name, params, dcop.objective)
-    return [
-        module.build_computation(ComputationDef(node, algo_def), seed=seed)
-        for node in graph.nodes
-    ]
+    defs = {
+        node.name: ComputationDef(node, algo_def) for node in graph.nodes
+    }
+    accel = accel or set()
+    if not accel:
+        return [
+            module.build_computation(defs[n], seed=seed) for n in defs
+        ], None
+    placement = _placement(dcop, list(defs), distribution)
+    unknown = accel - set(placement)
+    if unknown:
+        raise ValueError(
+            f"accel_agents {sorted(unknown)} have no computations "
+            f"placed on them (agents: {sorted(placement)})"
+        )
+    computations: List[MessagePassingComputation] = []
+    for aname, cnames in placement.items():
+        if aname in accel:
+            ref = {"fn": lambda: 0}
+            pending_refs[aname] = ref
+            computations.extend(
+                module.build_island(
+                    [defs[c] for c in sorted(cnames)],
+                    dcop,
+                    seed=seed,
+                    pending_fn=lambda ref=ref: ref["fn"](),
+                )
+            )
+        else:
+            computations.extend(
+                module.build_computation(defs[c], seed=seed)
+                for c in cnames
+            )
+    return computations, placement
 
 
 def solve_host(
@@ -72,6 +140,7 @@ def solve_host(
     distribution=None,
     rounds: Optional[int] = None,
     msg_log: Optional[str] = None,
+    accel_agents=None,
 ) -> Dict[str, Any]:
     """Solve ``dcop`` with the host message-driven runtime.
 
@@ -97,7 +166,22 @@ def solve_host(
     module = load_algorithm_module(algo_name)
     params = prepare_algo_params(params_in, module.algo_params)
 
-    computations = _build_computations(dcop, algo_name, params, seed)
+    # compiled islands (heterogeneous deployment, as in the hostnet
+    # runtime): agents named in accel_agents host their placed
+    # sub-graph as one array-engine island behind per-node proxies
+    accel = set(accel_agents or ())
+    if accel:
+        from pydcop_tpu.algorithms import require_island_support
+
+        require_island_support(module, algo_name)
+    placement = None
+    pending_refs: Dict[str, Dict[str, Any]] = {}
+
+    computations, placement = _build_computations(
+        dcop, algo_name, params, seed,
+        distribution=distribution, accel=accel,
+        pending_refs=pending_refs,
+    )
 
     if max_msgs is None:
         max_msgs = (
@@ -131,12 +215,13 @@ def solve_host(
         if mode == "sim":
             status, delivered, size = _run_sim(
                 computations, timeout, max_msgs, seed, t0, snapshot,
-                msg_log=log,
+                msg_log=log, pending_refs=pending_refs,
             )
         elif mode == "thread":
             status, delivered, size = _run_threads(
                 dcop, computations, timeout, max_msgs, distribution, t0,
-                snapshot, msg_log=log,
+                snapshot, msg_log=log, placement=placement,
+                pending_refs=pending_refs,
             )
         else:
             raise ValueError(f"solve_host: unknown mode {mode!r}")
@@ -168,6 +253,7 @@ def _run_sim(
     t0: float,
     snapshot,
     msg_log=None,
+    pending_refs: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> Tuple[str, int, int]:
     rnd = random.Random(seed)
     # per-(src, dest) FIFO channels: asynchrony means ANY interleaving
@@ -178,6 +264,7 @@ def _run_sim(
 
     channels: Dict[Tuple[str, str], "deque"] = {}
     nonempty: List[Tuple[str, str]] = []
+    queued = [0]  # total undelivered messages (island flush probe)
     by_name = {c.name: c for c in computations}
 
     def sender(src: str, dest: str, msg: Message) -> None:
@@ -190,9 +277,16 @@ def _run_sim(
         if not q:
             nonempty.append(ch)
         q.append(msg)
+        queued[0] += 1
 
     for c in computations:
         c.message_sender = sender
+    # islands flush when nothing is left in flight anywhere — the
+    # deterministic analogue of the hostnet inbox-drained trigger (the
+    # delivered message is popped before the handler runs, so 0 really
+    # means drained)
+    for ref in (pending_refs or {}).values():
+        ref["fn"] = lambda: queued[0]
     # start in randomized order — part of the modeled asynchrony
     order = list(computations)
     rnd.shuffle(order)
@@ -217,6 +311,7 @@ def _run_sim(
         ch = nonempty[-1]
         q = channels[ch]
         msg = q.popleft()
+        queued[0] -= 1
         if not q:
             nonempty.pop()
         src, dest = ch
@@ -239,29 +334,18 @@ def _run_threads(
     t0: float,
     snapshot,
     msg_log=None,
+    placement: Optional[Dict[str, List[str]]] = None,
+    pending_refs: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> Tuple[str, int, int]:
     from pydcop_tpu.infrastructure.agents import Agent
     from pydcop_tpu.infrastructure.communication import (
         InProcessCommunicationLayer,
     )
 
-    # placement: given Distribution, else dcop agents round-robin, else
-    # one agent per computation (the reference's oneagent default)
-    placement: Dict[str, List[str]] = {}
-    if distribution is not None:
-        for comp in computations:
-            placement.setdefault(
-                distribution.agent_for(comp.name), []
-            ).append(comp.name)
-    elif dcop.agents:
-        agent_names = sorted(dcop.agents)
-        for i, comp in enumerate(computations):
-            placement.setdefault(
-                agent_names[i % len(agent_names)], []
-            ).append(comp.name)
-    else:
-        for comp in computations:
-            placement.setdefault(f"a_{comp.name}", []).append(comp.name)
+    if placement is None:
+        placement = _placement(
+            dcop, [c.name for c in computations], distribution
+        )
 
     if len(placement) > 512:
         import logging
@@ -292,6 +376,12 @@ def _run_threads(
         for cname in comp_names:
             agent.deploy_computation(by_name[cname])
         agents.append(agent)
+        if pending_refs and aname in pending_refs:
+            # island flush probe: drained when only the in-flight
+            # message (popped before its handler runs) remains
+            pending_refs[aname]["fn"] = (
+                lambda a=agent: max(0, a.messaging.pending - 1)
+            )
 
     for a in agents:
         a.start()

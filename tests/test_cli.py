@@ -91,6 +91,19 @@ def test_solve_bad_param(ring_yaml):
     assert "variant" in r.stderr
 
 
+def test_solve_sim_accel_agents(ring_yaml):
+    """--accel_agents in the one-process sim runtime: a0's placed
+    subgraph runs as a compiled island, the rest as host code."""
+    r = run_cli(
+        "solve", "--algo", "maxsum", "-m", "sim", "--rounds", "400",
+        "--accel_agents", "a0", "--seed", "2", ring_yaml,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    result = json.loads(r.stdout[r.stdout.index("{"):])
+    assert result["cost"] == 0.0, result
+    assert result["msg_count"] > 0
+
+
 def test_graph_command(ring_yaml):
     r = run_cli("graph", "--algo", "dsa", ring_yaml)
     assert r.returncode == 0, r.stderr
