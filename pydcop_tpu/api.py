@@ -120,9 +120,9 @@ def solve(
     if accel_agents:
         raise ValueError(
             "accel_agents (compiled islands) deploys through the host "
-            "runtime's agents — use mode='process' or the "
-            "orchestrator/agent CLI with --accel_agents (the batched "
-            "engine is all-accelerator already)"
+            "runtimes' agents — use mode='sim', 'thread' or 'process' "
+            "(or the orchestrator/agent CLI with --accel_agents); the "
+            "batched engine is all-accelerator already"
         )
     if msg_log is not None:
         raise ValueError(

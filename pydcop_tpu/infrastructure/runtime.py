@@ -111,7 +111,7 @@ def _build_computations(
     computations: List[MessagePassingComputation] = []
     for aname, cnames in placement.items():
         if aname in accel:
-            ref = {"fn": lambda: 0}
+            ref = {"fn": lambda: 0, "comps": set(cnames)}
             pending_refs[aname] = ref
             computations.extend(
                 module.build_island(
@@ -174,7 +174,6 @@ def solve_host(
         from pydcop_tpu.algorithms import require_island_support
 
         require_island_support(module, algo_name)
-    placement = None
     pending_refs: Dict[str, Dict[str, Any]] = {}
 
     computations, placement = _build_computations(
@@ -264,8 +263,20 @@ def _run_sim(
 
     channels: Dict[Tuple[str, str], "deque"] = {}
     nonempty: List[Tuple[str, str]] = []
-    queued = [0]  # total undelivered messages (island flush probe)
     by_name = {c.name: c for c in computations}
+
+    # islands flush when THEIR inbox drains — the same per-agent probe
+    # as the hostnet/thread runtimes (a global in-flight count would
+    # let an unrelated queued message suppress the island's final
+    # flush and quiesce with unpropagated boundary beliefs).  The
+    # delivered message is decremented before its handler runs, so 0
+    # really means drained.
+    dest_ref: Dict[str, Dict[str, Any]] = {}
+    for ref in (pending_refs or {}).values():
+        ref["queued"] = 0
+        ref["fn"] = lambda ref=ref: ref["queued"]
+        for cname in ref["comps"]:
+            dest_ref[cname] = ref
 
     def sender(src: str, dest: str, msg: Message) -> None:
         if dest not in by_name:
@@ -277,16 +288,12 @@ def _run_sim(
         if not q:
             nonempty.append(ch)
         q.append(msg)
-        queued[0] += 1
+        r = dest_ref.get(dest)
+        if r is not None:
+            r["queued"] += 1
 
     for c in computations:
         c.message_sender = sender
-    # islands flush when nothing is left in flight anywhere — the
-    # deterministic analogue of the hostnet inbox-drained trigger (the
-    # delivered message is popped before the handler runs, so 0 really
-    # means drained)
-    for ref in (pending_refs or {}).values():
-        ref["fn"] = lambda: queued[0]
     # start in randomized order — part of the modeled asynchrony
     order = list(computations)
     rnd.shuffle(order)
@@ -311,10 +318,12 @@ def _run_sim(
         ch = nonempty[-1]
         q = channels[ch]
         msg = q.popleft()
-        queued[0] -= 1
         if not q:
             nonempty.pop()
         src, dest = ch
+        r = dest_ref.get(dest)
+        if r is not None:
+            r["queued"] -= 1
         delivered += 1
         size += msg.size
         if msg_log is not None:

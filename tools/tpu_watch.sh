@@ -1,86 +1,122 @@
 #!/bin/bash
-# TPU-tunnel watcher (memory: axon-tpu-outage-handling).
+# TPU-tunnel watcher (memory: axon-tpu-outage-handling), queue-based.
 #
-# The axon TPU tunnel flips between working windows and multi-hour
-# outages; this loop retries a BOUNDED init probe every ~9 min and,
-# the moment the chip answers, fires the queued measurements:
-#   1. the staged driver bench (bench.py) — its TPU stages append to
-#      BENCH_TPU_LOG.jsonl automatically,
-#   2. the five-config table (bench_configs.py --json),
-# then exits so the builder session gets a completion notification
-# and can fold the numbers into BASELINE.md.
+# The axon TPU tunnel flips between working windows (sometimes ~4 min)
+# and multi-hour outages; init of a downed tunnel HANGS rather than
+# failing.  This loop retries a BOUNDED init probe every ~9 min and,
+# whenever the chip answers, works through the PENDING stage queue in
+# staleness-priority order.  Between stages it re-probes: a mid-window
+# tunnel drop sends it back to the probe loop with the remaining queue
+# intact, instead of burning each stage's full timeout on a dead
+# tunnel (the round-4 failure mode this rewrite removes).  A stage
+# only leaves the queue when its output really came from the TPU
+# backend — CPU numbers posing as TPU cells are the one unforgivable
+# capture error.
 #
-# Usage: bash tools/tpu_watch.sh [max_probes]   (default 70 ≈ 11 h)
+# Stage queue (first = most stale, BASELINE.md):
+#   bench    — the staged driver bench (appends to BENCH_TPU_LOG.jsonl)
+#   configs  — the five driver configs (bench_configs.py)
+#   scale    — 100k + 1M-var scaling rows
+#   restarts — K=1..8 restart sweep on the north star
+#   gather   — layout-candidate microbench (decision re-open data)
+#   belief   — integrated belief=auto vs blockdiag A/B
+#
+# Usage: bash tools/tpu_watch.sh [max_probes] [queue...]
+#   default max_probes 70 ≈ 11 h; default queue = all stages
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 OUT=/tmp/tpu_watch
 mkdir -p "$OUT"
 MAX=${1:-70}
-for i in $(seq 1 "$MAX"); do
-  echo "[tpu_watch] probe $i/$MAX $(date -u +%FT%TZ)" | tee -a "$OUT/watch.log"
-  if timeout -k 10 90 python -c "import jax; assert jax.devices()[0].platform=='tpu'" \
-      >>"$OUT/watch.log" 2>&1; then
-    echo "[tpu_watch] TPU UP — capturing" | tee -a "$OUT/watch.log"
-    cd "$REPO"
-    timeout -k 30 2400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
-    rc=$?
-    echo "[tpu_watch] bench done rc=$rc" | tee -a "$OUT/watch.log"
-    # success only if the headline really came from the TPU backend;
-    # a tunnel that answered the probe then dropped must NOT look like
-    # a capture — keep probing instead
-    if [ "$rc" -eq 0 ] && grep -q '"backend": *"tpu"' "$OUT/bench.json"; then
-      # Capture order = staleness priority (tunnel windows can be
-      # ~4 min): the driver-config and scaling cells have been stale
-      # since r3, so they run FIRST; the layout micro-benches were
-      # already decided this round and run last.  Every capture that
-      # can silently fall back to CPU gets the same all-TPU check —
-      # a mid-chain tunnel drop must leave a SUSPECT marker, never
-      # CPU numbers posing as TPU cells.
+shift 2>/dev/null || true
+QUEUE="${*:-bench configs scale restarts gather belief}"
+cd "$REPO"
+
+probe() {
+  timeout -k 10 90 python -c \
+    "import jax; assert jax.devices()[0].platform=='tpu'" \
+    >>"$OUT/watch.log" 2>&1
+}
+
+# run_stage NAME -> 0 when captured-from-TPU, 1 otherwise
+run_stage() {
+  local rc
+  case "$1" in
+    bench)
+      timeout -k 30 2400 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.err"
+      rc=$?
+      [ $rc -eq 0 ] && grep -q '"backend": *"tpu"' "$OUT/bench.json" ;;
+    configs)
       timeout -k 30 3000 python bench_configs.py \
-        > "$OUT/configs.json" 2> "$OUT/configs.err"
-      crc=$?
-      echo "[tpu_watch] configs done rc=$crc" | tee -a "$OUT/watch.log"
-      if [ "$crc" -ne 0 ] || ! grep -q '"platform": *"tpu"' "$OUT/configs.json" \
+        >"$OUT/configs.json" 2>"$OUT/configs.err"
+      rc=$?
+      if [ $rc -ne 0 ] || ! grep -q '"platform": *"tpu"' "$OUT/configs.json" \
           || grep -q '"platform": *"cpu"' "$OUT/configs.json"; then
         mv "$OUT/configs.json" "$OUT/configs.SUSPECT.json" 2>/dev/null
-        echo "[tpu_watch] configs capture NOT all-TPU — kept bench.json," \
-          "configs marked SUSPECT" | tee -a "$OUT/watch.log"
-      fi
-      # scaling rows (100k + 1M vars) — TPU cells stale since r3;
-      # successful TPU rows self-append to BENCH_TPU_LOG.jsonl
+        return 1
+      fi ;;
+    scale)
       timeout -k 30 3000 python tools/bench_scale.py \
-        --sizes 100000 1000000 > "$OUT/scale.json" 2> "$OUT/scale.err"
-      src=$?
-      echo "[tpu_watch] scale bench rc=$src" | tee -a "$OUT/watch.log"
-      if [ "$src" -ne 0 ] || ! grep -q '"platform": *"tpu"' "$OUT/scale.json" \
+        --sizes 100000 1000000 >"$OUT/scale.json" 2>"$OUT/scale.err"
+      rc=$?
+      if [ $rc -ne 0 ] || ! grep -q '"platform": *"tpu"' "$OUT/scale.json" \
           || grep -q '"platform": *"cpu"' "$OUT/scale.json"; then
         mv "$OUT/scale.json" "$OUT/scale.SUSPECT.json" 2>/dev/null
-        echo "[tpu_watch] scale capture NOT all-TPU — marked SUSPECT" \
-          | tee -a "$OUT/watch.log"
-      fi
-      # restart-scaling sweep (K=1..8 on the north star): does vmap
-      # over restarts amortize the TPU round's fixed costs?  TPU rows
-      # self-append to BENCH_TPU_LOG.jsonl
+        return 1
+      fi ;;
+    restarts)
       timeout -k 30 1800 python tools/bench_restarts.py \
-        > "$OUT/restarts.json" 2> "$OUT/restarts.err"
-      echo "[tpu_watch] restarts bench rc=$?" | tee -a "$OUT/watch.log"
-      # layout-candidate microbench (VERDICT r4 next #1, decided
-      # 2026-07-31: auto wins) — kept so future chips can re-open
-      # the decision cheaply
-      timeout -k 30 900 python tools/bench_gather.py \
-        > "$OUT/gather.txt" 2>&1
-      echo "[tpu_watch] gather bench rc=$?" | tee -a "$OUT/watch.log"
-      # the INTEGRATED A/B: north star with belief=auto vs blockdiag
-      # (also appends TPU results to BENCH_TPU_LOG.jsonl)
+        >"$OUT/restarts.json" 2>"$OUT/restarts.err"
+      rc=$?
+      [ $rc -eq 0 ] && grep -q '"platform": *"tpu"' "$OUT/restarts.json" ;;
+    gather)
+      timeout -k 30 900 python tools/bench_gather.py >"$OUT/gather.txt" 2>&1
+      rc=$?
+      [ $rc -eq 0 ] && grep -q '^platform: tpu' "$OUT/gather.txt" ;;
+    belief)
       timeout -k 30 1200 python tools/bench_belief_mode.py \
-        > "$OUT/belief_ab.json" 2> "$OUT/belief_ab.err"
-      echo "[tpu_watch] belief A/B rc=$?" | tee -a "$OUT/watch.log"
+        >"$OUT/belief_ab.json" 2>"$OUT/belief_ab.err"
+      rc=$?
+      [ $rc -eq 0 ] && grep -q '"platform": *"tpu"' "$OUT/belief_ab.json" ;;
+    *)
+      # an unknown stage must stay visible, never count as captured
+      echo "[tpu_watch] unknown stage '$1'" | tee -a "$OUT/watch.log"
+      return 1 ;;
+  esac
+}
+
+for i in $(seq 1 "$MAX"); do
+  echo "[tpu_watch] probe $i/$MAX $(date -u +%FT%TZ) queue: $QUEUE" \
+    | tee -a "$OUT/watch.log"
+  if probe; then
+    echo "[tpu_watch] TPU UP — capturing" | tee -a "$OUT/watch.log"
+    REMAINING=""
+    for stage in $QUEUE; do
+      # re-probe between stages: a dropped tunnel hangs init, so a
+      # cheap bounded probe saves the stage's whole timeout
+      if ! probe; then
+        echo "[tpu_watch] tunnel dropped before $stage — back to probing" \
+          | tee -a "$OUT/watch.log"
+        REMAINING="$REMAINING $stage"
+        continue
+      fi
+      if run_stage "$stage"; then
+        echo "[tpu_watch] $stage CAPTURED $(date -u +%FT%TZ)" \
+          | tee -a "$OUT/watch.log"
+      else
+        echo "[tpu_watch] $stage failed/not-tpu — requeued" \
+          | tee -a "$OUT/watch.log"
+        REMAINING="$REMAINING $stage"
+      fi
+    done
+    QUEUE="$(echo $REMAINING)"
+    if [ -z "$QUEUE" ]; then
+      echo "[tpu_watch] queue empty — done" | tee -a "$OUT/watch.log"
       exit 0
     fi
-    echo "[tpu_watch] capture incomplete — resuming probes" \
-      | tee -a "$OUT/watch.log"
   fi
   sleep 540
 done
-echo "[tpu_watch] gave up after $MAX probes" | tee -a "$OUT/watch.log"
+echo "[tpu_watch] probes exhausted; still pending: $QUEUE" \
+  | tee -a "$OUT/watch.log"
 exit 1
