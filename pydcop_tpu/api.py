@@ -41,6 +41,7 @@ def solve(
     nb_agents: Optional[int] = None,
     msg_log: Optional[str] = None,
     accel_agents: Optional[Sequence[str]] = None,
+    distribution: Optional[Any] = None,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -69,6 +70,15 @@ def solve(
     ``docs/termination.md`` maps them to the reference's
     stable-message / cycle-limit semantics and defines what ``cycle``
     and ``msg_count`` mean in each.
+
+    ``distribution`` (reference-parity) shapes the host runtimes'
+    placement: a strategy name (``"adhoc"``, ``"heur_comhost"``, …), a
+    ``distribute --output`` yaml path, or a ``Distribution`` object.
+    thread mode groups computations onto agent threads with it; sim
+    mode consults it only for ``accel_agents`` island grouping (the
+    event loop has no agent containers); process mode hands it to the
+    hostnet orchestrator; the batched engine accepts and ignores it
+    (one device program solves regardless of placement).
 
     >>> result = solve(my_dcop, "dsa", {"variant": "B"}, rounds=100)
     >>> result["assignment"], result["cost"]
@@ -99,10 +109,18 @@ def solve(
             )
         from pydcop_tpu.infrastructure import solve_host
 
+        # sim consults placement only for island grouping — don't run
+        # a (possibly ILP) strategy whose result would be discarded
+        dist_obj = (
+            _resolve_distribution(dcop, algo, distribution)
+            if distribution is not None
+            and (mode == "thread" or accel_agents)
+            else None
+        )
         return solve_host(
             dcop, algo, algo_params, mode=mode, timeout=timeout,
             seed=seed, rounds=rounds, msg_log=msg_log,
-            accel_agents=accel_agents,
+            accel_agents=accel_agents, distribution=dist_obj,
         )
     if mode == "process":
         if checkpoint_path is not None or resume or n_restarts != 1:
@@ -114,6 +132,7 @@ def solve(
             dcop, algo, algo_params, rounds=rounds, timeout=timeout,
             seed=seed, nb_agents=nb_agents, ui_port=ui_port,
             msg_log=msg_log, accel_agents=accel_agents,
+            distribution=distribution,
         )
     if mode != "batched":
         raise ValueError(f"solve: unknown mode {mode!r}")
@@ -170,6 +189,54 @@ def solve(
     )
 
 
+def _resolve_distribution(dcop: DCOP, algo, distribution):
+    """Normalize ``solve(distribution=...)`` for the host runtimes:
+    pass through a ``Distribution``, load a ``distribute --output``
+    yaml path, or run a strategy name over the dcop's declared agents
+    (with the algorithm's footprint callbacks)."""
+    if distribution is None:
+        return None
+    from pydcop_tpu.distribution import Distribution
+
+    if isinstance(distribution, Distribution):
+        return distribution
+    import os
+
+    if os.path.exists(str(distribution)):
+        import yaml
+
+        with open(distribution) as f:
+            spec = yaml.safe_load(f)
+        mapping = (
+            spec.get("distribution") if isinstance(spec, dict) else None
+        )
+        if not isinstance(mapping, dict):
+            raise ValueError(
+                f"{distribution}: not a placement file (expected a "
+                "yaml `distribution:` mapping of agent -> computation "
+                "names, the `distribute --output` format)"
+            )
+        return Distribution(mapping)
+    from pydcop_tpu.distribution import compute_distribution
+    from pydcop_tpu.graphs import load_graph_module
+
+    algo_name, _ = resolve_algo(algo)
+    module = load_algorithm_module(algo_name)
+    graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
+        dcop
+    )
+    if not dcop.agents:
+        raise ValueError(
+            f"distribution={distribution!r} needs declared agents "
+            "(the dcop has none); declare AgentDefs or pass a "
+            "placement file"
+        )
+    return compute_distribution(
+        distribution, graph, list(dcop.agents.values()),
+        hints=dcop.dist_hints, algo_module=module,
+    )
+
+
 def _solve_process(
     dcop: DCOP,
     algo: Union[str, AlgorithmDef],
@@ -182,6 +249,7 @@ def _solve_process(
     ui_port: Optional[int],
     msg_log: Optional[str] = None,
     accel_agents: Optional[Sequence[str]] = None,
+    distribution=None,
 ) -> Dict[str, Any]:
     """One-call multi-process solve (reference:
     ``pydcop/infrastructure/run.py:run_local_process_dcop``): spawn
@@ -204,30 +272,78 @@ def _solve_process(
 
     algo_name, params_in = resolve_algo(algo, algo_params)
 
+    # hostnet takes either a strategy NAME (computed over registered
+    # agents at deploy time) or an explicit placement map; normalize
+    # Distribution objects / placement files to the latter
+    dist_name = None
+    placement = None
+    if distribution is not None:
+        if isinstance(distribution, str) and not os.path.exists(
+            distribution
+        ):
+            dist_name = distribution
+            # fail fast, before forking nb_agents interpreters — and
+            # catch the mistyped-file-path case (a path that doesn't
+            # exist is indistinguishable from a strategy name here)
+            from pydcop_tpu.distribution import load_distribution_module
+
+            try:
+                load_distribution_module(dist_name)
+            except Exception as e:
+                raise ValueError(
+                    f"distribution {dist_name!r} is neither an "
+                    f"existing placement file nor a loadable "
+                    f"strategy: {e}"
+                )
+        else:
+            placement = _resolve_distribution(
+                dcop, algo, distribution
+            ).mapping
+
     if nb_agents is None:
-        nb_agents = min(len(dcop.agents) or 2, os.cpu_count() or 2)
+        if placement is not None:
+            nb_agents = len(placement)
+        else:
+            nb_agents = min(len(dcop.agents) or 2, os.cpu_count() or 2)
     if nb_agents < 1:
         raise ValueError(f"nb_agents must be >= 1, got {nb_agents}")
 
-    # prefer the dcop's own agent names so hosting/capacity data flows
-    # into the placement; pad with generated names when it has fewer
-    # (skipping any declared name the generator would collide with)
-    names = sorted(dcop.agents)[:nb_agents]
-    used = set(names)
-    i = 0
-    while len(names) < nb_agents:
-        candidate = f"agent_{i}"
-        i += 1
-        if candidate not in used:
-            names.append(candidate)
-            used.add(candidate)
+    if placement is not None:
+        # explicit placement: the spawned processes must carry exactly
+        # its agent names or the orchestrator can never deploy to them
+        if nb_agents != len(placement):
+            raise ValueError(
+                f"nb_agents={nb_agents} conflicts with the "
+                f"placement's {len(placement)} agents — omit "
+                "nb_agents or make them match"
+            )
+        names = sorted(placement)
+    else:
+        # prefer the dcop's own agent names so hosting/capacity data
+        # flows into the placement; pad with generated names when it
+        # has fewer (skipping declared names the generator collides
+        # with)
+        names = sorted(dcop.agents)[:nb_agents]
+        used = set(names)
+        i = 0
+        while len(names) < nb_agents:
+            candidate = f"agent_{i}"
+            i += 1
+            if candidate not in used:
+                names.append(candidate)
+                used.add(candidate)
 
     unknown = set(accel_agents or ()) - set(names)
     if unknown:
+        source = (
+            "the placement's agent names"
+            if placement is not None
+            else "declared AgentDefs first, then generated "
+            "agent_<i> padding"
+        )
         raise ValueError(
             f"accel_agents {sorted(unknown)} are not among this "
-            f"run's agent names {names} (declared AgentDefs first, "
-            "then generated agent_<i> padding)"
+            f"run's agent names {names} ({source})"
         )
     if accel_agents:
         # fail before forking nb_agents interpreters, mirroring the
@@ -322,6 +438,7 @@ def _solve_process(
                 port=port, rounds=rounds, timeout=timeout, seed=seed,
                 ui_port=ui_port, server=server,
                 accel_agents=list(accel_agents or ()),
+                distribution=dist_name, placement=placement,
                 # the caller's timeout must also bound registration: a
                 # child crashing at startup must not stall a short-
                 # timeout call for the full default register window

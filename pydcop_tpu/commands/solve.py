@@ -28,9 +28,11 @@ def set_parser(subparsers) -> None:
         metavar="NAME:VALUE", help="algorithm parameter (repeatable)",
     )
     p.add_argument(
-        "-d", "--distribution", default="oneagent",
-        help="distribution algorithm or yaml file (capability parity; "
-        "the batched engine solves regardless of placement)",
+        "-d", "--distribution", default=None,
+        help="distribution strategy name or `distribute --output` "
+        "yaml file: shapes the host modes' placement (thread agent "
+        "grouping, process-per-agent, island subgraphs); the batched "
+        "engine solves regardless of placement",
     )
     p.add_argument(
         "-m", "--mode", choices=["thread", "sim", "process", "tpu"],
@@ -128,6 +130,7 @@ def run_cmd(args) -> int:
             nb_agents=args.nb_agents,
             msg_log=args.msg_log,
             accel_agents=args.accel_agents,
+            distribution=args.distribution,
         )
     finally:
         # flush the trace even when the solve raises — a profile of a

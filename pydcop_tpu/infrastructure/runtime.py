@@ -52,6 +52,21 @@ def _placement(
     reference's oneagent default)."""
     placement: Dict[str, List[str]] = {}
     if distribution is not None:
+        # same validation the hostnet orchestrator applies: a stale
+        # placement must fail loudly, not KeyError mid-build or drop
+        # entries silently
+        hosted = set(distribution.computations)
+        missing = [c for c in comp_names if c not in hosted]
+        if missing:
+            raise ValueError(
+                f"placement leaves computation(s) {missing} unhosted"
+            )
+        extra = sorted(hosted - set(comp_names))
+        if extra:
+            raise ValueError(
+                f"placement names unknown computation(s) {extra} "
+                "(not in this problem's graph)"
+            )
         for cname in comp_names:
             placement.setdefault(
                 distribution.agent_for(cname), []

@@ -295,6 +295,95 @@ def test_solve_accel_island_in_process_runtimes(mode):
         )
 
 
+def test_solve_distribution_shapes_island_placement(tmp_path):
+    """solve(distribution=...) (reference-parity): an explicit
+    Distribution object and a `distribute --output` yaml both shape
+    which computations the island owns in sim mode."""
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.distribution import Distribution
+
+    dcop = _chain_dcop(6)
+    mapping = {
+        "left": ["v0", "v1", "v2", "c0", "c1"],
+        "right": ["v3", "v4", "v5", "c2", "c3", "c4"],
+    }
+    r = solve(
+        dcop, "maxsum", mode="sim", seed=1, timeout=60,
+        accel_agents=["left"], distribution=Distribution(mapping),
+    )
+    assert r["cost"] == 0.0, r
+
+    # same placement from a distribute --output yaml file
+    import yaml as _yaml
+
+    pfile = tmp_path / "dist.yaml"
+    pfile.write_text(_yaml.safe_dump({"distribution": mapping}))
+    r2 = solve(
+        dcop, "maxsum", mode="sim", seed=1, timeout=60,
+        accel_agents=["left"], distribution=str(pfile),
+    )
+    assert r2["cost"] == 0.0
+    assert r2["assignment"] == r["assignment"]
+
+    # a strategy name needs declared agents
+    with pytest.raises(ValueError, match="declared agents"):
+        solve(
+            dcop, "maxsum", mode="sim", distribution="adhoc",
+            accel_agents=["left"], timeout=30,
+        )
+
+    # stale placements fail loudly, with hostnet-style messages
+    incomplete = dict(mapping)
+    incomplete["right"] = incomplete["right"][:-1]  # drop c4
+    with pytest.raises(ValueError, match="unhosted"):
+        solve(
+            dcop, "maxsum", mode="thread", timeout=30,
+            distribution=Distribution(incomplete),
+        )
+    stale = {**mapping, "ghost": ["v99"]}
+    with pytest.raises(ValueError, match="unknown computation"):
+        solve(
+            dcop, "maxsum", mode="thread", timeout=30,
+            distribution=Distribution(stale),
+        )
+
+
+def test_solve_process_distribution_placement(tmp_path):
+    """Process mode with an explicit placement file: agent processes
+    take the placement's names, one per placed agent."""
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    import yaml as _yaml
+
+    dcop = load_dcop(_ring_yaml(6))
+    mapping = {
+        "west": [f"v{i}" for i in range(3)] + [f"c{i}" for i in range(3)],
+        "east": [f"v{i}" for i in range(3, 6)]
+        + [f"c{i}" for i in range(3, 6)],
+    }
+    pfile = tmp_path / "dist.yaml"
+    pfile.write_text(_yaml.safe_dump({"distribution": mapping}))
+    r = solve(
+        dcop, "maxsum", mode="process", rounds=400, timeout=120,
+        seed=1, distribution=str(pfile),
+    )
+    assert r["cost"] == 0.0, r
+    assert sorted(r["placement"]) == ["east", "west"]
+
+    with pytest.raises(ValueError, match="conflicts with"):
+        solve(
+            dcop, "maxsum", mode="process", nb_agents=3,
+            distribution=str(pfile), timeout=30,
+        )
+    # a mistyped placement path must fail before any fork, not be
+    # silently reinterpreted as a strategy name
+    with pytest.raises(ValueError, match="neither an existing"):
+        solve(
+            dcop, "maxsum", mode="process",
+            distribution=str(pfile) + ".nope", timeout=30,
+        )
+
+
 def test_solve_sim_accel_island_deterministic():
     """The sim-mode island flush trigger is the global queued count —
     fully deterministic: two identical runs give identical results."""
