@@ -72,6 +72,17 @@ algo_params: list = [
     ),
     # smallest joined-table size worth a device dispatch
     AlgoParameterDef("device_min_cells", "int", None, 1 << 14),
+    # bounded-memory exact mode: cap every UTIL table at this many
+    # cells by CONDITIONING a cut set of variables (enumerate their
+    # assignments, best-of over bounded passes).  0 = off (reject
+    # over-width problems with a clear error).  Memory becomes
+    # O(memory_bound); time multiplies by the cut set's domain
+    # product — the MB-DPOP trade (PAPERS.md: RMB-DPOP,
+    # arxiv.org/pdf/2002.10641; this build realizes it centrally by
+    # shrinking conditioned domains to singletons so the standard
+    # UTIL/VALUE machinery — device certificates included — runs
+    # unchanged per assignment)
+    AlgoParameterDef("memory_bound", "int", None, 0),
 ]
 
 _EPS32 = float(np.finfo(np.float32).eps)
@@ -137,73 +148,235 @@ def solve_host(
         owner = max(scope, key=lambda n: depth[n])
         owned[owner].append((scope, table))
 
-    # -- UTIL phase: post-order over each tree -------------------------
+    # -- bounded-memory planning (memory_bound > 0): pick a cut set
+    # whose conditioning keeps every UTIL table under the bound
+    memory_bound = int(params.get("memory_bound", 0) or 0)
+    cut: List[str] = []
+    if memory_bound > 0:
+        bound = min(memory_bound, max_util_size)
+        cut = _plan_conditioning(graph, domains, depth, owned, bound)
+        max_util_size = bound
+
     use_device = params.get("util_device", "auto")
     device_min_cells = int(params.get("device_min_cells", 1 << 14))
     if use_device == "always":
         device_min_cells = 0
-    t_util = time.perf_counter()
-    try:
-        if use_device == "never":
-            raise _PrecisionFallback(None, 0.0, 0.0)
-        util_stats = _util_phase(
-            dcop, graph, domains, depth, owned, t0, timeout,
-            device_min_cells=device_min_cells,
-            max_util_size=max_util_size,
-        )
-        util_backend = "device"
-    except _PrecisionFallback as fb:
-        if fb.node is not None:  # an actual failed margin, not 'never'
-            import logging
 
-            logging.getLogger(__name__).info(
-                "DPOP UTIL f32 margin %.3g below error bound %.3g at "
-                "node %s; restarting UTIL on the host f64 path",
-                fb.margin, fb.bound, fb.node,
+    def one_pass(domains_p, owned_p):
+        """One full UTIL+VALUE run (device path w/ host fallback).
+        Returns (assignment, stats dict) or None on timeout."""
+        t_util = time.perf_counter()
+        try:
+            if use_device == "never":
+                raise _PrecisionFallback(None, 0.0, 0.0)
+            util_stats = _util_phase(
+                dcop, graph, domains_p, depth, owned_p, t0, timeout,
+                device_min_cells=device_min_cells,
+                max_util_size=max_util_size,
             )
-        util_stats = _util_phase(
-            dcop, graph, domains, depth, owned, t0, timeout,
-            device_min_cells=None,
-            max_util_size=max_util_size,
-        )
-        util_backend = "host"
-    if util_stats is None:
-        return _timeout_result(dcop, t0)
-    best_choice, util_cells, device_nodes, host_nodes = util_stats
-    util_time = time.perf_counter() - t_util
+            util_backend = "device"
+        except _PrecisionFallback as fb:
+            if fb.node is not None:  # an actual failed margin
+                import logging
 
-    # -- VALUE phase: pre-order ---------------------------------------
-    assignment: Dict[str, Any] = {}
-    idx: Dict[str, int] = {}
-    for root in graph.roots:
-        for name in graph.depth_first_order(root):
-            sep, amin = best_choice[name]
-            best = int(amin[tuple(idx[d] for d in sep)])
-            idx[name] = best
-            assignment[name] = domains[name][best]
+                logging.getLogger(__name__).info(
+                    "DPOP UTIL f32 margin %.3g below error bound %.3g "
+                    "at node %s; restarting UTIL on the host f64 path",
+                    fb.margin, fb.bound, fb.node,
+                )
+            util_stats = _util_phase(
+                dcop, graph, domains_p, depth, owned_p, t0, timeout,
+                device_min_cells=None,
+                max_util_size=max_util_size,
+            )
+            util_backend = "host"
+        if util_stats is None:
+            return None
+        best_choice, util_cells, device_nodes, host_nodes = util_stats
+
+        # VALUE phase: pre-order
+        assignment: Dict[str, Any] = {}
+        idx: Dict[str, int] = {}
+        for root in graph.roots:
+            for name in graph.depth_first_order(root):
+                sep, amin = best_choice[name]
+                best = int(amin[tuple(idx[d] for d in sep)])
+                idx[name] = best
+                assignment[name] = domains_p[name][best]
+        return assignment, {
+            "util_time": time.perf_counter() - t_util,
+            "util_backend": util_backend,
+            "util_cells": util_cells,
+            "util_device_nodes": device_nodes,
+            "util_host_nodes": host_nodes,
+        }
+
+    if not cut:
+        out = one_pass(domains, owned)
+        if out is None:
+            return _timeout_result(dcop, t0)
+        assignment, stats = out
+        n_passes = 1
+    else:
+        # conditioning search: one bounded pass per cut-set assignment,
+        # keep the best (exact: every pass is optimal given its cut
+        # values, and the enumeration covers the cut's whole space)
+        from itertools import product as _product
+
+        sign_best = float("inf")
+        assignment = None
+        stats = {
+            "util_time": 0.0, "util_backend": "device",
+            "util_cells": 0, "util_device_nodes": 0,
+            "util_host_nodes": 0,
+        }
+        n_passes = 0
+        exhausted = True
+        for combo in _product(*(range(len(domains[v])) for v in cut)):
+            if timeout is not None and time.perf_counter() - t0 > timeout:
+                exhausted = False
+                break
+            domains_p = dict(domains)
+            for v, i in zip(cut, combo):
+                domains_p[v] = [domains[v][i]]
+            owned_p = {
+                n: [
+                    _condition_part(dims, table, cut, combo, domains)
+                    for dims, table in parts
+                ]
+                for n, parts in owned.items()
+            }
+            out = one_pass(domains_p, owned_p)
+            if out is None:
+                exhausted = False
+                break
+            n_passes += 1
+            a, s = out
+            stats["util_time"] += s["util_time"]
+            stats["util_cells"] += s["util_cells"]
+            stats["util_device_nodes"] += s["util_device_nodes"]
+            stats["util_host_nodes"] += s["util_host_nodes"]
+            if s["util_backend"] == "host":
+                stats["util_backend"] = "host"
+            c = sign * dcop.solution_cost(a)
+            if c < sign_best:
+                sign_best = c
+                assignment = a
+        if assignment is None:
+            return _timeout_result(dcop, t0)
+        if not exhausted:
+            # partial enumeration is NOT exact — surface it (a run
+            # whose LAST pass finished under the deadline is complete
+            # and exact, however late the clock reads now)
+            r = _timeout_result(dcop, t0)
+            r["assignment"] = r["final_assignment"] = assignment
+            r["cost"] = r["final_cost"] = dcop.solution_cost(assignment)
+            r["conditioned_vars"] = list(cut)
+            r["conditioning_passes"] = n_passes
+            return r
 
     cost = dcop.solution_cost(assignment)
     n_msgs = sum(
         1 for n in domains if graph.node(n).parent is not None
     )
     height = max(depth.values(), default=0)
-    return {
+    result = {
         "assignment": assignment,
         "cost": cost,
         "final_assignment": assignment,
         "final_cost": cost,
         "cycle": height,
-        "msg_count": 2 * n_msgs,
-        "msg_size": util_cells + n_msgs,  # UTIL cells + VALUE payloads
+        # per pass: one UTIL + one VALUE message per non-root node
+        # (MB-DPOP sends one bounded UTIL per cut instantiation)
+        "msg_count": 2 * n_msgs * n_passes,
+        "msg_size": stats["util_cells"] + n_msgs * n_passes,
         "status": "finished",
         "time": time.perf_counter() - t0,
         "cost_trace": [cost],
         # UTIL-phase observability (BASELINE config #4 reports these)
-        "util_time": util_time,
-        "util_backend": util_backend,
-        "util_device_nodes": device_nodes,
-        "util_host_nodes": host_nodes,
+        "util_time": stats["util_time"],
+        "util_backend": stats["util_backend"],
+        "util_device_nodes": stats["util_device_nodes"],
+        "util_host_nodes": stats["util_host_nodes"],
     }
+    if cut:
+        result["conditioned_vars"] = list(cut)
+        result["conditioning_passes"] = n_passes
+    return result
+
+
+def _condition_part(
+    dims: List[str],
+    table: np.ndarray,
+    cut: List[str],
+    combo: Tuple[int, ...],
+    domains: Dict[str, list],
+) -> Tuple[List[str], np.ndarray]:
+    """Slice a part's conditioned axes to the chosen values,
+    KEEPING the axes (length 1) so dims stay aligned with the
+    singleton domains of the conditioned pass."""
+    fixed = {v: i for v, i in zip(cut, combo)}
+    hit = [d for d in dims if d in fixed]
+    if not hit:
+        return dims, table
+    t = np.asarray(table)
+    for d in hit:
+        t = np.take(t, [fixed[d]], axis=dims.index(d))
+    return dims, t
+
+
+def _plan_conditioning(
+    graph,
+    domains: Dict[str, list],
+    depth: Dict[str, int],
+    owned: Dict[str, List[Tuple[List[str], np.ndarray]]],
+    bound: int,
+) -> List[str]:
+    """Choose a cut set whose conditioning keeps every node's UTIL
+    target under ``bound`` cells.  Dims-only simulation of the UTIL
+    separator propagation (no tables); greedy pick: from the largest
+    oversized node, the shallowest unconditioned separator variable —
+    ancestors close to the root appear in the most separators, so one
+    pick shrinks many tables (the MB-DPOP 'highest cycle-cut node'
+    heuristic)."""
+    names = [
+        n for root in graph.roots for n in graph.depth_first_order(root)
+    ]
+    post = sorted(names, key=lambda n: -depth[n])
+
+    def oversized(cut: set):
+        util_dims: Dict[str, set] = {}
+        out = []
+        for name in post:
+            node = graph.node(name)
+            sep: set = set()
+            for dims, _ in owned[name]:
+                sep |= {d for d in dims if d != name}
+            for child in node.children:
+                sep |= util_dims[child] - {name}
+            util_dims[name] = sep
+            size = 1
+            for d in list(sep) + [name]:
+                size *= 1 if d in cut else len(domains[d])
+            if size > bound:
+                out.append((size, name, sep))
+        return out
+
+    cut: List[str] = []
+    while True:
+        ov = oversized(set(cut))
+        if not ov:
+            return cut
+        size, name, sep = max(ov)
+        cands = [
+            d
+            for d in list(sep) + [name]
+            if d not in cut and len(domains[d]) > 1
+        ]
+        # a node with everything conditioned has size 1 <= bound, so
+        # an oversized node always has an unconditioned multi-value dim
+        assert cands, (name, size, cut)
+        cut.append(min(cands, key=lambda d: (depth[d], d)))
 
 
 class _PrecisionFallback(Exception):

@@ -109,14 +109,33 @@ def solve(
             )
         from pydcop_tpu.infrastructure import solve_host
 
-        # sim consults placement only for island grouping — don't run
-        # a (possibly ILP) strategy whose result would be discarded
-        dist_obj = (
-            _resolve_distribution(dcop, algo, distribution)
-            if distribution is not None
-            and (mode == "thread" or accel_agents)
-            else None
-        )
+        # sim consults placement only for island grouping — don't
+        # resolve a distribution whose result would be discarded.
+        # Strategy NAMES pass through as-is (the runtime computes
+        # them over the graph it builds anyway); files/objects
+        # resolve here.
+        dist_obj = None
+        if distribution is not None and (mode == "thread" or accel_agents):
+            import os
+
+            if isinstance(distribution, str) and not os.path.isfile(
+                distribution
+            ):
+                from pydcop_tpu.distribution import (
+                    load_distribution_module,
+                )
+
+                try:
+                    load_distribution_module(distribution)
+                except Exception as e:
+                    raise ValueError(
+                        f"distribution {distribution!r} is neither an "
+                        f"existing placement file nor a loadable "
+                        f"strategy: {e}"
+                    )
+                dist_obj = distribution
+            else:
+                dist_obj = _resolve_distribution(dcop, distribution)
         return solve_host(
             dcop, algo, algo_params, mode=mode, timeout=timeout,
             seed=seed, rounds=rounds, msg_log=msg_log,
@@ -189,11 +208,11 @@ def solve(
     )
 
 
-def _resolve_distribution(dcop: DCOP, algo, distribution):
-    """Normalize ``solve(distribution=...)`` for the host runtimes:
-    pass through a ``Distribution``, load a ``distribute --output``
-    yaml path, or run a strategy name over the dcop's declared agents
-    (with the algorithm's footprint callbacks)."""
+def _resolve_distribution(dcop: DCOP, distribution):
+    """Normalize a non-strategy ``solve(distribution=...)``: pass
+    through a ``Distribution``, or load a ``distribute --output`` yaml
+    path.  Strategy names are resolved by the runtime that owns the
+    computation graph (``runtime.solve_host`` / hostnet)."""
     if distribution is None:
         return None
     from pydcop_tpu.distribution import Distribution
@@ -202,39 +221,24 @@ def _resolve_distribution(dcop: DCOP, algo, distribution):
         return distribution
     import os
 
-    if os.path.exists(str(distribution)):
-        import yaml
-
-        with open(distribution) as f:
-            spec = yaml.safe_load(f)
-        mapping = (
-            spec.get("distribution") if isinstance(spec, dict) else None
-        )
-        if not isinstance(mapping, dict):
-            raise ValueError(
-                f"{distribution}: not a placement file (expected a "
-                "yaml `distribution:` mapping of agent -> computation "
-                "names, the `distribute --output` format)"
-            )
-        return Distribution(mapping)
-    from pydcop_tpu.distribution import compute_distribution
-    from pydcop_tpu.graphs import load_graph_module
-
-    algo_name, _ = resolve_algo(algo)
-    module = load_algorithm_module(algo_name)
-    graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
-        dcop
-    )
-    if not dcop.agents:
+    if not os.path.isfile(str(distribution)):
         raise ValueError(
-            f"distribution={distribution!r} needs declared agents "
-            "(the dcop has none); declare AgentDefs or pass a "
-            "placement file"
+            f"{distribution!r}: not a placement file (expected a yaml "
+            "`distribution:` mapping of agent -> computation names, "
+            "the `distribute --output` format)"
         )
-    return compute_distribution(
-        distribution, graph, list(dcop.agents.values()),
-        hints=dcop.dist_hints, algo_module=module,
-    )
+    import yaml
+
+    with open(distribution) as f:
+        spec = yaml.safe_load(f)
+    mapping = spec.get("distribution") if isinstance(spec, dict) else None
+    if not isinstance(mapping, dict):
+        raise ValueError(
+            f"{distribution}: not a placement file (expected a "
+            "yaml `distribution:` mapping of agent -> computation "
+            "names, the `distribute --output` format)"
+        )
+    return Distribution(mapping)
 
 
 def _solve_process(
@@ -278,7 +282,7 @@ def _solve_process(
     dist_name = None
     placement = None
     if distribution is not None:
-        if isinstance(distribution, str) and not os.path.exists(
+        if isinstance(distribution, str) and not os.path.isfile(
             distribution
         ):
             dist_name = distribution
@@ -296,9 +300,7 @@ def _solve_process(
                     f"strategy: {e}"
                 )
         else:
-            placement = _resolve_distribution(
-                dcop, algo, distribution
-            ).mapping
+            placement = _resolve_distribution(dcop, distribution).mapping
 
     if nb_agents is None:
         if placement is not None:

@@ -91,6 +91,7 @@ def _build_computations(
     distribution=None,
     accel: Optional[set] = None,
     pending_refs: Optional[Dict[str, Dict[str, Any]]] = None,
+    graph=None,
 ) -> Tuple[List[MessagePassingComputation], Optional[Dict[str, List[str]]]]:
     """Build one computation per graph node; agents named in ``accel``
     get their whole placed sub-graph as ONE compiled island
@@ -106,7 +107,10 @@ def _build_computations(
             f"{algo_name}: no host build_computation — only the batched "
             "TPU engine supports this algorithm"
         )
-    graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(dcop)
+    if graph is None:
+        graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
+            dcop
+        )
     algo_def = AlgorithmDef(algo_name, params, dcop.objective)
     defs = {
         node.name: ComputationDef(node, algo_def) for node in graph.nodes
@@ -191,10 +195,36 @@ def solve_host(
         require_island_support(module, algo_name)
     pending_refs: Dict[str, Dict[str, Any]] = {}
 
+    # a strategy NAME resolves here, over the one graph this run
+    # builds anyway (placement files / Distribution objects arrive
+    # already resolved from the embedding layer)
+    graph = None
+    if isinstance(distribution, str):
+        if not hasattr(module, "GRAPH_TYPE"):
+            raise ValueError(
+                f"{algo_name}: no GRAPH_TYPE — cannot compute a "
+                f"distribution strategy for it"
+            )
+        graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
+            dcop
+        )
+        if not dcop.agents:
+            raise ValueError(
+                f"distribution={distribution!r} needs declared agents "
+                "(the dcop has none); declare AgentDefs or pass a "
+                "placement file"
+            )
+        from pydcop_tpu.distribution import compute_distribution
+
+        distribution = compute_distribution(
+            distribution, graph, list(dcop.agents.values()),
+            hints=dcop.dist_hints, algo_module=module,
+        )
+
     computations, placement = _build_computations(
         dcop, algo_name, params, seed,
         distribution=distribution, accel=accel,
-        pending_refs=pending_refs,
+        pending_refs=pending_refs, graph=graph,
     )
 
     if max_msgs is None:
