@@ -1,0 +1,341 @@
+"""Compiled-island DSA: one agent's variables on the array engine.
+
+The constraints-hypergraph counterpart of
+:mod:`pydcop_tpu.algorithms._island_maxsum` (heterogeneous strong-host
+deployment, reference analogue ``pydcop/infrastructure/agents.py``
+hosting many Python computations per agent): one agent hosts its
+placed variables as a single compiled sub-problem stepped by the
+batched DSA kernel, while remote agents run the plain message-driven
+computations of ``_host_dsa``.  Boundary traffic stays
+``DsaValueMessage`` frames, so remote agents cannot tell an island
+from per-variable Python computations.
+
+Mechanism:
+
+- The island's owned variables plus every constraint touching them
+  form a sub-DCOP; each remote scope variable is represented by ONE
+  **shadow variable** ``__shadow__<name>`` with its domain (shared
+  across all boundary constraints that reference it).
+- An incoming ``DsaValueMessage`` from remote ``u`` pins ``u``'s
+  shadow: before every internal round burst the shadow's state value
+  is set to the received index and its unary row carries BIG off that
+  index, so the DSA sweep can neither move it nor profit from moving
+  it — the island evaluates EXACTLY against the last heard values, as
+  a host computation would.
+- After each burst, owned boundary variables whose value changed are
+  announced to their remote neighbor computations; interior updates
+  stay on-device.  No message is sent when nothing changed, so
+  quiescence-based termination works unchanged.
+
+Scheduling: DSA islands run NO start burst (the host semantics skip
+constraints whose neighbors are unknown; the island instead waits for
+the initial value wave, then steps ``island_rounds`` whenever its
+inbox drains).  Asynchrony-as-schedule: this is one more legal
+activation schedule of the same local-search semantics
+(``docs/algorithms.md``).
+
+This island is only built for DSA-family algorithms (dsa / adsa /
+dsatuto).  MGM/DBA/GDBA deliberately have no island: their gain
+phases coordinate with ALL neighbors per round, and a boundary that
+replays stale remote gains could let two adjacent variables move
+together — violating the guarantee the algorithms are built on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from pydcop_tpu.algorithms._host_dsa import DsaValueMessage
+from pydcop_tpu.infrastructure.computations import (
+    VariableComputation,
+    register,
+)
+
+_SHADOW = "__shadow__{}"
+
+
+class DsaIsland:
+    """Shared core behind one agent's island proxies."""
+
+    def __init__(
+        self,
+        var_nodes: List[Any],
+        dcop,
+        algo_def,
+        seed: int,
+        pending_fn: Optional[Callable[[], int]] = None,
+    ):
+        import jax
+
+        from pydcop_tpu.algorithms import load_algorithm_module
+        from pydcop_tpu.dcop.dcop import DCOP
+        from pydcop_tpu.dcop.objects import Variable
+        from pydcop_tpu.dcop.relations import NAryMatrixRelation
+        from pydcop_tpu.ops import compile_dcop
+
+        # the island steps the ACTUAL algorithm's batched kernel:
+        # dsa's sweep, adsa's activation schedule, dsatuto's fixed rule
+        self._module = load_algorithm_module(algo_def.algo)
+        self._pending_fn = pending_fn or (lambda: 0)
+        params = dict(algo_def.params)
+        self._params = params
+        rounds = params.get("island_rounds")
+        self._rounds = 4 if rounds is None else int(rounds)
+        start_rounds = params.get("island_start_rounds")
+        self._start_rounds = (
+            64 if start_rounds is None else int(start_rounds)
+        )
+
+        owned = {n.variable.name: n.variable for n in var_nodes}
+        self.owned_names = set(owned)
+
+        sub = DCOP(f"dsa_island_{seed}", objective=dcop.objective)
+        for v in owned.values():
+            sub.add_variable(v)
+        shadow_vars: Dict[str, Variable] = {}
+        self._remote_neighbors_of: Dict[str, List[str]] = {}
+        seen_constraints: Dict[str, bool] = {}
+        for n in var_nodes:
+            vname = n.variable.name
+            remotes: set = set()
+            for c in n.constraints:
+                remotes |= {
+                    d.name for d in c.dimensions if d.name not in owned
+                }
+                if c.name in seen_constraints:
+                    continue
+                seen_constraints[c.name] = True
+                scope = []
+                for d in c.dimensions:
+                    if d.name in owned:
+                        scope.append(d)
+                        continue
+                    sname = _SHADOW.format(d.name)
+                    if sname not in shadow_vars:
+                        shadow_vars[sname] = Variable(sname, d.domain)
+                        sub.add_variable(shadow_vars[sname])
+                    scope.append(shadow_vars[sname])
+                sub.add_constraint(
+                    NAryMatrixRelation(
+                        scope, c.as_matrix().matrix, name=c.name
+                    )
+                )
+            remotes.discard(vname)
+            if remotes:
+                self._remote_neighbors_of[vname] = sorted(remotes)
+
+        self._problem = compile_dcop(sub)
+        p = self._problem
+        self._slot = {name: i for i, name in enumerate(p.var_names)}
+        self._labels = {
+            name: list(p.domain_labels[self._slot[name]])
+            for name in p.var_names
+        }
+        self._shadow_slot = {
+            real: self._slot[s]
+            for s, real in (
+                (s, s[len("__shadow__"):]) for s in shadow_vars
+            )
+        }
+        self._base_unary = np.asarray(p.unary).copy()
+        self._owned_slots = np.asarray(
+            sorted(self._slot[v] for v in self.owned_names),
+            dtype=np.int64,
+        )
+
+        self._pin: Dict[str, int] = {}  # remote var -> pinned index
+        self._last_sent: Dict[str, Any] = {}
+        self._proxies: Dict[str, "IslandDsaProxy"] = {}
+        self._n_started = 0
+        self._dirty = False
+        self._started = False
+        self._flushes = 0
+
+        self._key = jax.random.PRNGKey((seed * 0x9E3779B1) & 0x7FFFFFFF)
+        self._state = self._module.init_state(p, self._key, params)
+        self._jit_step = jax.jit(self._make_step(), static_argnums=(3,))
+
+    # -- wiring ----------------------------------------------------------
+
+    def attach(self, proxy) -> None:
+        self._proxies[proxy.name] = proxy
+
+    def node_started(self) -> None:
+        self._n_started += 1
+        if self._n_started == len(self._proxies):
+            self._started = True
+            if not self._shadow_slot:
+                # no boundary at all (whole problem on this island):
+                # there are no unknown neighbors to wait for, and no
+                # message will ever trigger a flush — converge now
+                self._rounds, burst = self._start_rounds, self._rounds
+                try:
+                    self._flush()
+                finally:
+                    self._rounds = burst
+                return
+            # announce initial values; internal rounds wait for the
+            # neighbor value wave (host DSA likewise skips constraints
+            # with unknown neighbors)
+            self._emit(announce_all=True)
+
+    # -- inbound ---------------------------------------------------------
+
+    def receive(self, dest: str, sender: str, value: Any) -> None:
+        if dest not in self.owned_names:
+            return  # stale destination
+        if sender in self._shadow_slot:
+            labels = self._labels[_SHADOW.format(sender)]
+            try:
+                self._pin[sender] = labels.index(value)
+            except ValueError:
+                return  # value outside the declared domain: drop
+            self._dirty = True
+        if self._started and self._dirty and self._pending_fn() == 0:
+            self._flush()
+
+    def tick(self) -> None:
+        """Self-addressed re-fire (see the tick note in ``_flush``)."""
+        self._dirty = True
+        if self._started and self._pending_fn() == 0:
+            self._flush()
+
+    # -- the compiled burst ----------------------------------------------
+
+    def _make_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        module, params = self._module, self._params
+        problem = self._problem
+
+        def run(unary, state, key, n_rounds):
+            import dataclasses
+
+            prob = dataclasses.replace(problem, unary=unary)
+
+            def body(st, k):
+                return module.step(prob, st, k, params), ()
+
+            keys = jax.random.split(key, n_rounds)
+            state_out, _ = jax.lax.scan(body, state, keys)
+            return state_out
+
+        return run
+
+    def _flush(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from pydcop_tpu.ops.compile import BIG
+
+        self._dirty = False
+        self._flushes += 1
+        unary = self._base_unary.copy()
+        values = np.asarray(self._state["values"]).copy()
+        for real, slot in self._shadow_slot.items():
+            pin = self._pin.get(real)
+            if pin is None:
+                continue  # not heard yet: leave the random init
+            row = np.full(unary.shape[1], BIG, dtype=unary.dtype)
+            row[pin] = 0.0
+            unary[slot] = row
+            values[slot] = pin
+        state = {**self._state, "values": jnp.asarray(values)}
+        key = jax.random.fold_in(self._key, self._flushes)
+        unary_j = jnp.asarray(unary)
+        self._state = jax.block_until_ready(
+            self._jit_step(unary_j, state, key, self._rounds)
+        )
+        self._emit()
+        # interior progress must not depend on boundary traffic: a
+        # burst that changed values (boundary OR interior) or left a
+        # strictly-improving move wanted (probability-gated) re-fires
+        # via a self-addressed tick — the island analogue of
+        # _host_dsa._evaluate's dsa_tick.  At a local optimum neither
+        # condition holds and the island goes quiescent.
+        new_values = np.asarray(self._state["values"])
+        changed = bool(
+            (new_values[self._owned_slots] != values[self._owned_slots])
+            .any()
+        )
+        if changed or self._wants_move(unary_j):
+            anchor = next(iter(self._proxies.values()))
+            from pydcop_tpu.infrastructure.computations import Message
+
+            anchor.post_msg(anchor.name, Message("dsa_tick"))
+
+    def _wants_move(self, unary_j) -> bool:
+        """Any owned variable with a strictly better value under the
+        current (pinned) assignment?"""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from pydcop_tpu.ops.costs import local_cost_sweep
+
+        prob = dataclasses.replace(self._problem, unary=unary_j)
+        values = self._state["values"]
+        local = local_cost_sweep(prob, values)
+        current = jnp.take_along_axis(local, values[:, None], axis=1)[:, 0]
+        best = jnp.min(local, axis=1)
+        gain = (current - best)[jnp.asarray(self._owned_slots)]
+        return bool((gain > 1e-6).any())
+
+    # -- outbound ---------------------------------------------------------
+
+    def _emit(self, announce_all: bool = False) -> None:
+        values = np.asarray(self._state["values"])
+        for v in self.owned_names:
+            label = self._labels[v][int(values[self._slot[v]])]
+            self._proxies[v].value_selection(label)
+            remotes = self._remote_neighbors_of.get(v)
+            if not remotes:
+                continue
+            if not announce_all and self._last_sent.get(v) == label:
+                continue
+            self._last_sent[v] = label
+            for u in remotes:
+                self._proxies[v].post_msg(u, DsaValueMessage(label))
+
+
+class IslandDsaProxy(VariableComputation):
+    """Routing/collect stand-in for one island-hosted variable."""
+
+    def __init__(self, comp_def, island: DsaIsland):
+        super().__init__(comp_def.node.variable, comp_def)
+        self._island = island
+        island.attach(self)
+
+    def on_start(self) -> None:
+        self._island.node_started()
+
+    @register("dsa_value")
+    def _on_value(self, sender: str, msg: DsaValueMessage, t: float) -> None:
+        self._island.receive(self.name, sender, msg.value)
+
+    @register("dsa_tick")
+    def _on_tick(self, sender: str, msg, t: float) -> None:
+        self._island.tick()
+
+
+def build_island(
+    comp_defs: List[Any],
+    dcop,
+    seed: int = 0,
+    pending_fn: Optional[Callable[[], int]] = None,
+) -> List[Any]:
+    """Build ONE island + per-variable proxies for an agent's placed
+    constraints-hypergraph computations."""
+    if not comp_defs:
+        return []
+    island = DsaIsland(
+        [cd.node for cd in comp_defs],
+        dcop,
+        comp_defs[0].algo,
+        seed,
+        pending_fn=pending_fn,
+    )
+    return [IslandDsaProxy(cd, island) for cd in comp_defs]

@@ -43,7 +43,20 @@ algo_params = [
     # asynchrony knob (1.0 == synchronous DSA)
     AlgoParameterDef("activation", "float", None, 0.5),
     AlgoParameterDef("initial", "str", ["declared", "random"], "random"),
+    # compiled-island deployment (accel agents, _island_dsa.py)
+    AlgoParameterDef("island_rounds", "int", None, 4),
+    AlgoParameterDef("island_start_rounds", "int", None, 64),
 ]
+
+
+def build_island(comp_defs, dcop, seed: int = 0, pending_fn=None):
+    """Compiled-island deployment (``_island_dsa.py``): internal
+    rounds step THIS module's batched activation schedule."""
+    from pydcop_tpu.algorithms import _island_dsa
+
+    return _island_dsa.build_island(
+        comp_defs, dcop, seed=seed, pending_fn=pending_fn
+    )
 
 
 def init_state(

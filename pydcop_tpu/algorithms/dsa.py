@@ -45,7 +45,21 @@ algo_params = [
     AlgoParameterDef("probability", "float", None, 0.7),
     # 'initial': start values — declared initial_value/zeros or random
     AlgoParameterDef("initial", "str", ["declared", "random"], "random"),
+    # compiled-island deployment (accel agents, _island_dsa.py)
+    AlgoParameterDef("island_rounds", "int", None, 4),
+    AlgoParameterDef("island_start_rounds", "int", None, 64),
 ]
+
+
+def build_island(comp_defs, dcop, seed: int = 0, pending_fn=None):
+    """Compiled-island deployment: one agent's placed variables as a
+    single array-engine island behind per-variable proxies
+    (``--accel`` agents on the host runtimes; ``_island_dsa.py``)."""
+    from pydcop_tpu.algorithms import _island_dsa
+
+    return _island_dsa.build_island(
+        comp_defs, dcop, seed=seed, pending_fn=pending_fn
+    )
 
 
 def init_state(

@@ -103,6 +103,16 @@ def communication_load(
     return UNIT_SIZE
 
 
+def build_island(comp_defs, dcop, seed: int = 0, pending_fn=None):
+    """Compiled-island deployment (``_island_dsa.py``): internal
+    rounds step this module's fixed A/0.5 rule."""
+    from pydcop_tpu.algorithms import _island_dsa
+
+    return _island_dsa.build_island(
+        comp_defs, dcop, seed=seed, pending_fn=pending_fn
+    )
+
+
 def build_computation(comp_def, seed: int = 0):
     """Host message-driven computation (async semantics parity path —
     see ``pydcop_tpu.infrastructure``); solving runs on the batched

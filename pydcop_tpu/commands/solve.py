@@ -52,7 +52,7 @@ def set_parser(subparsers) -> None:
         help="(thread/sim/process modes) agents whose placed subgraph "
         "runs as ONE compiled array-engine island instead of "
         "per-computation host code (the heterogeneous strong-host "
-        "deployment; maxsum/amaxsum)",
+        "deployment; maxsum/amaxsum and the dsa family)",
     )
     p.add_argument(
         "--msg_log", default=None, metavar="FILE",

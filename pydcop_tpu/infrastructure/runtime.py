@@ -197,8 +197,13 @@ def solve_host(
 
     # a strategy NAME resolves here, over the one graph this run
     # builds anyway (placement files / Distribution objects arrive
-    # already resolved from the embedding layer)
+    # already resolved from the embedding layer).  Sim without islands
+    # has no agent containers — a strategy's result would be
+    # discarded, so don't compute it (and don't error on undeclared
+    # agents for a call that never needed them)
     graph = None
+    if mode == "sim" and not accel:
+        distribution = None
     if isinstance(distribution, str):
         if not hasattr(module, "GRAPH_TYPE"):
             raise ValueError(

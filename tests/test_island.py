@@ -288,10 +288,11 @@ def test_solve_accel_island_in_process_runtimes(mode):
             dcop, "maxsum", mode=mode, accel_agents=["nope"],
             timeout=30,
         )
-    # and a no-island algorithm is rejected up front
+    # and a no-island algorithm is rejected up front (mgm has none by
+    # design: its gain phase coordinates with ALL neighbors per round)
     with pytest.raises(ValueError, match="compiled-island"):
         solve(
-            dcop, "dsa", mode=mode, accel_agents=["a0"], timeout=30
+            dcop, "mgm", mode=mode, accel_agents=["a0"], timeout=30
         )
 
 
@@ -402,6 +403,94 @@ def test_solve_sim_accel_island_deterministic():
     assert r1["cost"] == r2["cost"] == 0.0
     assert r1["assignment"] == r2["assignment"]
     assert r1["msg_count"] == r2["msg_count"]
+
+
+# -- DSA-family islands (_island_dsa.py) --------------------------------
+
+
+@pytest.mark.parametrize("algo", ["dsa", "adsa", "dsatuto"])
+def test_dsa_island_mixed_sim(algo):
+    """Half the variables on a compiled DSA island, half as host
+    computations, under the deterministic sim loop: the ring still
+    colors to 0 and the run quiesces."""
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.dcop.objects import AgentDef
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import constraint_from_str
+
+    d = Domain("colors", "", [0, 1, 2])
+    dcop = DCOP("ring8")
+    vs = [Variable(f"v{i}", d) for i in range(8)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(8):
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}", f"1 if v{i} == v{(i + 1) % 8} else 0", vs
+            )
+        )
+    dcop.add_agents([AgentDef("a0"), AgentDef("a1")])
+    r = solve(
+        dcop, algo, mode="sim", seed=3, timeout=60,
+        accel_agents=["a0"],
+    )
+    assert r["cost"] == 0.0, r
+    assert r["status"] == "finished"  # quiescence, not budget
+    assert r["msg_count"] > 0
+
+
+def test_dsa_island_interior_converges_without_boundary_traffic():
+    """Review-found stall: with a tiny burst size and one boundary
+    variable, interior-only changes used to produce no outbound
+    message, so the island never re-burst and quiesced arbitrarily
+    far from a local optimum.  The self-tick keeps it running until
+    no strictly-improving move remains — a 30-var chain must reach 0
+    even at island_rounds=1."""
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.distribution import Distribution
+
+    dcop = _chain_dcop(30)
+    mapping = {
+        "big": [f"v{i}" for i in range(28)],
+        "small": ["v28", "v29"],
+    }
+    r = solve(
+        dcop, "dsa", {"island_rounds": 1}, mode="sim", seed=6,
+        timeout=120, accel_agents=["big"],
+        distribution=Distribution(mapping),
+    )
+    assert r["cost"] == 0.0, r
+    assert r["status"] == "finished"
+
+
+def test_dsa_island_pure():
+    """Whole problem on one DSA island: the start burst alone must
+    solve it (no boundary traffic exists)."""
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.dcop.objects import AgentDef
+
+    dcop = _chain_dcop(8)
+    dcop.add_agents([AgentDef("a0")])
+    r = solve(
+        dcop, "dsa", mode="sim", seed=2, timeout=60,
+        accel_agents=["a0"],
+    )
+    assert r["cost"] == 0.0, r
+    assert r["msg_count"] == 0  # nothing may leave the island
+
+
+def test_dsa_island_thread_mode():
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.dcop.objects import AgentDef
+
+    dcop = _chain_dcop(10)
+    dcop.add_agents([AgentDef("a0"), AgentDef("a1"), AgentDef("a2")])
+    r = solve(
+        dcop, "dsa", mode="thread", seed=5, timeout=60,
+        accel_agents=["a0", "a2"],  # two islands, one plain agent
+    )
+    assert r["cost"] == 0.0, r
 
 
 def _ring_yaml(n=8):
