@@ -95,8 +95,9 @@ class DsaIsland:
         for v in owned.values():
             sub.add_variable(v)
         shadow_vars: Dict[str, Variable] = {}
+        shadow_real: Dict[str, str] = {}  # shadow name -> remote name
         self._remote_neighbors_of: Dict[str, List[str]] = {}
-        seen_constraints: Dict[str, bool] = {}
+        seen_constraints: set = set()
         for n in var_nodes:
             vname = n.variable.name
             remotes: set = set()
@@ -106,7 +107,7 @@ class DsaIsland:
                 }
                 if c.name in seen_constraints:
                     continue
-                seen_constraints[c.name] = True
+                seen_constraints.add(c.name)
                 scope = []
                 for d in c.dimensions:
                     if d.name in owned:
@@ -115,6 +116,7 @@ class DsaIsland:
                     sname = _SHADOW.format(d.name)
                     if sname not in shadow_vars:
                         shadow_vars[sname] = Variable(sname, d.domain)
+                        shadow_real[sname] = d.name
                         sub.add_variable(shadow_vars[sname])
                     scope.append(shadow_vars[sname])
                 sub.add_constraint(
@@ -134,10 +136,7 @@ class DsaIsland:
             for name in p.var_names
         }
         self._shadow_slot = {
-            real: self._slot[s]
-            for s, real in (
-                (s, s[len("__shadow__"):]) for s in shadow_vars
-            )
+            real: self._slot[s] for s, real in shadow_real.items()
         }
         self._base_unary = np.asarray(p.unary).copy()
         self._owned_slots = np.asarray(
@@ -153,7 +152,15 @@ class DsaIsland:
         self._started = False
         self._flushes = 0
 
-        self._key = jax.random.PRNGKey((seed * 0x9E3779B1) & 0x7FFFFFFF)
+        # per-island stream: two structurally identical islands (a
+        # symmetric split) must not draw correlated move gates, or
+        # they oscillate in lockstep — same rule as _host_dsa's
+        # stable_seed(seed, name) per computation
+        from pydcop_tpu.infrastructure.computations import stable_seed
+
+        self._key = jax.random.PRNGKey(
+            stable_seed(seed, "|".join(sorted(self.owned_names)))
+        )
         self._state = self._module.init_state(p, self._key, params)
         self._jit_step = jax.jit(self._make_step(), static_argnums=(3,))
 
@@ -180,6 +187,12 @@ class DsaIsland:
             # neighbor value wave (host DSA likewise skips constraints
             # with unknown neighbors)
             self._emit(announce_all=True)
+            # boundary values can arrive BEFORE the proxies start
+            # (thread mode buffers pre-start messages): a drained
+            # inbox with pins already set must burst now, or nothing
+            # may ever re-trigger the island
+            if self._dirty and self._pending_fn() == 0:
+                self._flush()
 
     # -- inbound ---------------------------------------------------------
 
@@ -238,7 +251,10 @@ class DsaIsland:
         for real, slot in self._shadow_slot.items():
             pin = self._pin.get(real)
             if pin is None:
-                continue  # not heard yet: leave the random init
+                # not heard yet: still pin (at the init value) — a
+                # movable shadow would let the island "resolve" a
+                # boundary constraint by moving the remote's proxy
+                pin = int(values[slot])
             row = np.full(unary.shape[1], BIG, dtype=unary.dtype)
             row[pin] = 0.0
             unary[slot] = row

@@ -41,7 +41,15 @@ from pydcop_tpu.ops.costs import local_cost_sweep
 
 GRAPH_TYPE = "constraints_hypergraph"
 
-algo_params = []  # the tutorial algorithm is parameter-free
+from pydcop_tpu.algorithms import AlgoParameterDef  # noqa: E402
+
+# the tutorial ALGORITHM is parameter-free (fixed variant A, p = 0.5);
+# the island knobs are deployment-engine parameters its compiled-island
+# form reads (_island_dsa.py), not algorithm semantics
+algo_params = [
+    AlgoParameterDef("island_rounds", "int", None, 4),
+    AlgoParameterDef("island_start_rounds", "int", None, 64),
+]
 
 PROBABILITY = 0.5
 

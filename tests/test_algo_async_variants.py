@@ -50,9 +50,15 @@ def test_dsatuto_solves_ring():
         assert a[f"v{i}"] != a[f"v{(i + 1) % 10}"]
 
 
-def test_dsatuto_has_no_params():
+def test_dsatuto_has_no_algorithm_params():
+    """The tutorial algorithm's SEMANTICS are parameter-free (fixed
+    variant A, p=0.5); the only declared params are the compiled-
+    island deployment knobs."""
     mod = load_algorithm_module("dsatuto")
-    assert prepare_algo_params({}, mod.algo_params) == {}
+    params = prepare_algo_params({}, mod.algo_params)
+    assert set(params) == {"island_rounds", "island_start_rounds"}
+    with pytest.raises(Exception):
+        prepare_algo_params({"variant": "B"}, mod.algo_params)
 
 
 def test_adsa_solves_ring():

@@ -5,11 +5,13 @@ simple_repr JSON frames over TCP, placement via a real distribution
 strategy.  Fills BASELINE.md's >=4-process row (VERDICT r4 next #6).
 
 Usage: python tools/bench_hostnet.py [n_agents] [n_vars] [--accel]
+                                     [--algo NAME]
 Prints one JSON line {n_agents, n_vars, msgs_per_sec, cost, time}.
 ``--accel`` makes agent a1 a compiled island (the heterogeneous
 strong-host deployment): wire msgs/sec then counts only BOUNDARY
 traffic — compare ``cost`` and ``time``, not msgs/sec, against the
-all-host run.
+all-host run.  ``--algo`` picks the algorithm (default maxsum;
+dsa/adsa/dsatuto exercise the constraints-hypergraph islands).
 """
 
 import json
@@ -25,7 +27,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def main() -> None:
     accel = "--accel" in sys.argv
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    algo = "maxsum"
+    argv = sys.argv[1:]
+    if "--algo" in argv:
+        i = argv.index("--algo")
+        if i + 1 >= len(argv):
+            sys.exit("usage: bench_hostnet.py [n] [vars] --algo NAME")
+        algo = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    args = [a for a in argv if not a.startswith("--")]
     n_agents = int(args[0]) if len(args) > 0 else 4
     n_vars = int(args[1]) if len(args) > 1 else 300
 
@@ -47,7 +57,7 @@ def main() -> None:
     orch = subprocess.Popen(
         [
             sys.executable, "-m", "pydcop_tpu", "orchestrator",
-            yaml_path, "-a", "maxsum", "--runtime", "host",
+            yaml_path, "-a", algo, "--runtime", "host",
             "--port", str(port), "--nb_agents", str(n_agents),
             "--rounds", "60", "--seed", "1",
         ]
@@ -79,6 +89,7 @@ def main() -> None:
                 {
                     "n_agents": n_agents,
                     "n_vars": n_vars,
+                    "algo": algo,
                     "accel": accel,
                     "msgs_per_sec": round(r["msg_count"] / r["time"]),
                     "msg_count": r["msg_count"],
