@@ -405,6 +405,63 @@ def test_solve_sim_accel_island_deterministic():
     assert r1["msg_count"] == r2["msg_count"]
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_island_random_tree_partition_matches_exact(seed):
+    """Property fuzz: on a random TREE with random tables, a random
+    island partition of the factor graph must reach the exact optimum
+    (min-sum is exact on trees), matching DPOP."""
+    import random as _random
+
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.distribution import Distribution
+
+    rnd = _random.Random(seed)
+    npr = np.random.RandomState(seed)
+    n = 12
+    d = Domain("d", "", [0, 1, 2])
+    dcop = DCOP(f"tree{seed}")
+    vs = [Variable(f"v{i}", d) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(1, n):
+        j = rnd.randrange(i)  # random tree: parent among earlier vars
+        dcop.add_constraint(
+            NAryMatrixRelation(
+                [vs[j], vs[i]],
+                npr.uniform(0, 10, (3, 3)).round(2),
+                name=f"c{i}",
+            )
+        )
+    opt = solve(dcop, "dpop")["cost"]
+
+    # random partition; each factor follows its child variable
+    island_vars = {f"v{i}" for i in range(n) if rnd.random() < 0.5}
+    mapping = {"isl": [], "rest": []}
+    for i in range(n):
+        mapping["isl" if f"v{i}" in island_vars else "rest"].append(
+            f"v{i}"
+        )
+        if i >= 1:
+            mapping[
+                "isl" if f"v{i}" in island_vars else "rest"
+            ].append(f"c{i}")
+    if not mapping["isl"] or not mapping["rest"]:
+        mapping["isl"], mapping["rest"] = (
+            mapping["isl"] + mapping["rest"]
+        )[:3], (mapping["isl"] + mapping["rest"])[3:]
+    r = solve(
+        dcop, "maxsum", mode="sim", seed=seed, timeout=120,
+        accel_agents=["isl"], distribution=Distribution(mapping),
+    )
+    assert r["cost"] == pytest.approx(opt, abs=1e-3), (
+        mapping, r["cost"], opt
+    )
+    assert r["status"] == "finished"
+
+
 # -- DSA-family islands (_island_dsa.py) --------------------------------
 
 
