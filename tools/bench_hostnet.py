@@ -5,13 +5,18 @@ simple_repr JSON frames over TCP, placement via a real distribution
 strategy.  Fills BASELINE.md's >=4-process row (VERDICT r4 next #6).
 
 Usage: python tools/bench_hostnet.py [n_agents] [n_vars] [--accel]
-                                     [--algo NAME]
+                                     [--algo NAME] [--island_tpu]
 Prints one JSON line {n_agents, n_vars, msgs_per_sec, cost, time}.
 ``--accel`` makes agent a1 a compiled island (the heterogeneous
 strong-host deployment): wire msgs/sec then counts only BOUNDARY
 traffic — compare ``cost`` and ``time``, not msgs/sec, against the
 all-host run.  ``--algo`` picks the algorithm (default maxsum;
 dsa/adsa/dsatuto exercise the constraints-hypergraph islands).
+``--island_tpu`` (with --accel) pins the island agent's process to
+the axon TPU plugin while every other process stays on CPU — the
+real mixed TPU-host + CPU-host deployment.  The axon pin HANGS if
+the tunnel is down and errors rather than falling back, so a
+completed run proves the island really ran on the chip.
 """
 
 import json
@@ -27,6 +32,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def main() -> None:
     accel = "--accel" in sys.argv
+    island_tpu = "--island_tpu" in sys.argv
+    if island_tpu and not accel:
+        # a plain host agent never initializes a backend, so the pin
+        # could neither hang nor error — the run would finish on CPU
+        # while reporting island_tpu: true
+        sys.exit("--island_tpu requires --accel (no island, no chip)")
     algo = "maxsum"
     argv = sys.argv[1:]
     if "--algo" in argv:
@@ -66,6 +77,15 @@ def main() -> None:
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
     time.sleep(0.5)
+    def agent_env(i: int) -> dict:
+        if island_tpu and i == 1:
+            # the island agent alone gets the chip; the axon pin
+            # hangs/errors rather than silently falling back to CPU
+            e = dict(env)
+            e["PYDCOP_TPU_PLATFORM"] = "axon"
+            return e
+        return env
+
     agents = [
         subprocess.Popen(
             [
@@ -73,7 +93,7 @@ def main() -> None:
                 "--names", f"a{i}", "--runtime", "host",
                 "--orchestrator", f"localhost:{port}",
             ],
-            env=env, cwd=tmp,
+            env=agent_env(i), cwd=tmp,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
         for i in range(1, n_agents + 1)
@@ -91,6 +111,7 @@ def main() -> None:
                     "n_vars": n_vars,
                     "algo": algo,
                     "accel": accel,
+                    "island_tpu": island_tpu,
                     "msgs_per_sec": round(r["msg_count"] / r["time"]),
                     "msg_count": r["msg_count"],
                     "cost": r["cost"],

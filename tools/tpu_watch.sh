@@ -20,6 +20,8 @@
 #   restarts — K=1..8 restart sweep on the north star
 #   gather   — layout-candidate microbench (decision re-open data)
 #   belief   — integrated belief=auto vs blockdiag A/B
+#   island   — mixed TPU-host + CPU-host deployment (the island agent
+#              pinned to the chip, everyone else CPU processes)
 #
 # Usage: bash tools/tpu_watch.sh [max_probes] [queue...]
 #   default max_probes 70 ≈ 11 h; default queue = all stages
@@ -29,7 +31,7 @@ OUT=/tmp/tpu_watch
 mkdir -p "$OUT"
 MAX=${1:-70}
 shift 2>/dev/null || true
-QUEUE="${*:-bench configs scale restarts gather belief}"
+QUEUE="${*:-bench configs scale restarts gather belief island}"
 cd "$REPO"
 
 probe() {
@@ -78,6 +80,15 @@ run_stage() {
         >"$OUT/belief_ab.json" 2>"$OUT/belief_ab.err"
       rc=$?
       [ $rc -eq 0 ] && grep -q '"platform": *"tpu"' "$OUT/belief_ab.json" ;;
+    island)
+      # the axon pin inside the island child hangs/errors rather than
+      # falling back, so a finished run proves the chip was used
+      timeout -k 30 1200 python tools/bench_hostnet.py 2 2000 \
+        --accel --island_tpu \
+        >"$OUT/island_tpu.json" 2>"$OUT/island_tpu.err"
+      rc=$?
+      [ $rc -eq 0 ] && grep -q '"island_tpu": true' "$OUT/island_tpu.json" \
+        && grep -q '"status": "finished"' "$OUT/island_tpu.json" ;;
     *)
       # an unknown stage must stay visible, never count as captured
       echo "[tpu_watch] unknown stage '$1'" | tee -a "$OUT/watch.log"
