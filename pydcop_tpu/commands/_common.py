@@ -87,9 +87,16 @@ def write_metrics(args, result: Dict[str, Any]) -> None:
         # printed JSON are whole-run too)
         first_cycle = cycles_total - n
         per_round_msgs = msgs_total / cycles_total if cycles_total else 0
+        # the host runtimes SUBSAMPLE their anytime trace (one entry
+        # per snapshot, not per cycle): label those proportionally or
+        # the whole history reads as the run's final n cycles
+        subsampled = bool(result.get("trace_subsampled"))
 
         def row(i):
-            cyc = first_cycle + i + 1
+            if subsampled:
+                cyc = max(1, round(cycles_total * (i + 1) / n)) if n else 0
+            else:
+                cyc = first_cycle + i + 1
             return [
                 round(total_time * (i + 1) / n, 6) if n else 0.0,
                 cyc,

@@ -234,6 +234,8 @@ def run_cmd(args) -> int:
             )
         except PlacementError as e:  # usage errors: clean exit
             raise SystemExit(f"orchestrator: {e}")
+        result.pop("cost_trace", None)  # keep the printed JSON compact
+        result.pop("trace_subsampled", None)
         write_result(args, result)
         return 0
 

@@ -85,5 +85,6 @@ def run_cmd(args) -> int:
         raise SystemExit(f"run: {e}")
     write_metrics(args, result)
     result.pop("cost_trace", None)
+    result.pop("trace_subsampled", None)
     write_result(args, result)
     return 0

@@ -139,5 +139,6 @@ def run_cmd(args) -> int:
             profile_ctx.__exit__(None, None, None)
     write_metrics(args, result)
     result.pop("cost_trace", None)  # keep the printed JSON compact
+    result.pop("trace_subsampled", None)
     write_result(args, result)
     return 0

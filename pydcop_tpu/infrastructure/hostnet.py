@@ -578,6 +578,7 @@ def run_host_orchestrator(
         # makes the same argument).
         sign = -1.0 if dcop.objective == "max" else 1.0
         best = {"cost": float("inf"), "assignment": {}}
+        trace: List[float] = []
 
         if ui_port is not None:
             from pydcop_tpu.infrastructure.ui import UiServer
@@ -596,6 +597,7 @@ def run_host_orchestrator(
             if not _complete(assignment):
                 return  # some variable has no selected value yet
             cost = dcop.solution_cost(assignment)
+            trace.append(cost)  # anytime stream (--collect_on CSVs)
             if sign * cost < best["cost"]:
                 best["cost"] = sign * cost
                 best["assignment"] = assignment
@@ -653,6 +655,9 @@ def run_host_orchestrator(
         # fail cleanly when no complete snapshot ever existed
         if _complete(final_assignment):
             final_cost = dcop.solution_cost(final_assignment)
+            trace.append(final_cost)  # the end state belongs in the
+            # anytime stream too (a short run may never have hit a
+            # complete periodic sample)
             if sign * final_cost < best["cost"]:
                 best["cost"] = sign * final_cost
                 best["assignment"] = final_assignment
@@ -681,6 +686,8 @@ def run_host_orchestrator(
             "msg_size": size,
             "status": status,
             "time": time.perf_counter() - t0,
+            "cost_trace": trace,
+            "trace_subsampled": True,  # one entry per 0.5s sample
             "agents": agent_names,
             "placement": {a: sorted(c) for a, c in placement.items()},
         }

@@ -245,12 +245,14 @@ def solve_host(
     ]
     sign = -1.0 if dcop.objective == "max" else 1.0
     best = {"cost": float("inf"), "assignment": {}}
+    trace: List[float] = []  # anytime cost stream (--collect_on CSVs)
 
     def snapshot() -> None:
         assignment = {c.variable.name: c.current_value for c in var_comps}
         if any(v is None for v in assignment.values()):
             return
         cost = dcop.solution_cost(assignment)
+        trace.append(cost)
         if sign * cost < best["cost"]:
             best["cost"] = sign * cost
             best["assignment"] = assignment
@@ -291,6 +293,8 @@ def solve_host(
         "msg_size": size,
         "status": status,
         "time": time.perf_counter() - t0,
+        "cost_trace": trace,
+        "trace_subsampled": True,  # one entry per snapshot, not cycle
     }
 
 

@@ -185,3 +185,22 @@ def test_solve_metrics_value_change_and_period(ring_yaml, tmp_path):
     assert rows, "period sampling produced no rows"
     times = [float(row["time"]) for row in rows]
     assert times == sorted(times)
+
+
+def test_solve_metrics_host_modes(ring_yaml, tmp_path):
+    """The host runtimes feed the same anytime-metrics CSV surface as
+    the batched engine (review-found gap: they used to emit only the
+    header)."""
+    import csv as csvmod
+
+    vc = tmp_path / "sim_vc.csv"
+    r = run_cli(
+        "solve", "--algo", "maxsum", "-m", "sim", "--rounds", "200",
+        ring_yaml, "--collect_on", "value_change",
+        "--run_metrics", str(vc),
+    )
+    assert r.returncode == 0, r.stderr
+    with open(vc, newline="") as f:
+        rows = list(csvmod.DictReader(f))
+    assert rows, "sim mode produced no anytime rows"
+    assert all(row["cost"] != "" for row in rows)
