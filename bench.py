@@ -533,6 +533,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--help" in sys.argv or "-h" in sys.argv:
+        try:
+            print(__doc__)
+        except BrokenPipeError:
+            pass
+        sys.exit(0)
     try:
         if "--inner" in sys.argv:
             _inner_main()

@@ -63,6 +63,9 @@ def test_batch_spec_example_expands(tmp_path):
             os.path.join(EXAMPLES, "batch_sweep.yaml"), "--simulate",
         ],
         capture_output=True, text=True, timeout=120,
+        # isolate from any batch_results.csv in the invoking cwd (the
+        # default --result_file would flip run: lines to skip:)
+        cwd=str(tmp_path),
         env={
             **os.environ,
             "PYDCOP_TPU_PLATFORM": "cpu",
