@@ -150,6 +150,12 @@ def last_good_tpu(workload: str | None = None) -> dict | None:
             # never headline evidence — excluded on the fallback
             # path too, not just by the alias set
             continue
+        if aliases is None and ("_restarts" in w or w.startswith("config")):
+            # K-restart aggregates (bench_restarts) and pinned-restart
+            # config cells (bench_configs) report aggregate-over-K
+            # throughput — comparable only under their own row's
+            # conventions, never as the single-instance headline
+            continue
         if aliases is None or w in aliases:
             return entry
     return None

@@ -197,15 +197,18 @@ class DsaIsland:
     # -- inbound ---------------------------------------------------------
 
     def receive(self, dest: str, sender: str, value: Any) -> None:
-        if dest not in self.owned_names:
-            return  # stale destination
-        if sender in self._shadow_slot:
+        # NOTE: every path falls through to the flush check — a
+        # dropped message (stale destination, unknown sender,
+        # out-of-domain value) may be the LAST queued item, and an
+        # early return would strand _dirty pins until the next
+        # delivery that may never come
+        if dest in self.owned_names and sender in self._shadow_slot:
             labels = self._labels[_SHADOW.format(sender)]
             try:
                 self._pin[sender] = labels.index(value)
+                self._dirty = True
             except ValueError:
-                return  # value outside the declared domain: drop
-            self._dirty = True
+                pass  # value outside the declared domain: drop
         if self._started and self._dirty and self._pending_fn() == 0:
             self._flush()
 

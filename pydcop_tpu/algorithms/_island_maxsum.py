@@ -225,24 +225,29 @@ class MaxSumIsland:
     def receive(self, dest: str, sender: str, costs: Dict[Any, float]) -> None:
         from pydcop_tpu.ops.compile import BIG
 
+        # NOTE: dropped messages (stale destination / non-boundary
+        # edge) still fall through to the flush check — the drop may
+        # be the LAST queued item and must not strand _dirty pins
         if dest in self.owned_factor_names:
             # q from a remote variable: pin on the shadow edge
             key = (dest, sender)
-            if key not in self._shadow_of:
-                return  # not a boundary edge of this island (stale)
-            sname = self._shadow_of[key]
-            self._q_in[key] = self._row(
-                costs, self._labels[sname], pad=BIG
-            )
+            if key in self._shadow_of:
+                sname = self._shadow_of[key]
+                self._q_in[key] = self._row(
+                    costs, self._labels[sname], pad=BIG
+                )
+                self._dirty = True
         elif dest in self.owned_var_names:
             # r from a remote factor: folds into dest's unary override
             self._r_in[(dest, sender)] = self._row(
                 costs, self._labels[dest]
             )
-        else:
-            return  # stale/unknown destination
-        self._dirty = True
-        if self._flushed_once and self._pending_fn() == 0:
+            self._dirty = True
+        if (
+            self._dirty
+            and self._flushed_once
+            and self._pending_fn() == 0
+        ):
             self._flush(self._rounds)
 
     # -- the compiled step ------------------------------------------------

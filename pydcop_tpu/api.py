@@ -116,23 +116,8 @@ def solve(
         # resolve here.
         dist_obj = None
         if distribution is not None and (mode == "thread" or accel_agents):
-            import os
-
-            if isinstance(distribution, str) and not os.path.isfile(
-                distribution
-            ):
-                from pydcop_tpu.distribution import (
-                    load_distribution_module,
-                )
-
-                try:
-                    load_distribution_module(distribution)
-                except Exception as e:
-                    raise ValueError(
-                        f"distribution {distribution!r} is neither an "
-                        f"existing placement file nor a loadable "
-                        f"strategy: {e}"
-                    )
+            if _is_strategy_name(distribution):
+                _validate_strategy_name(distribution)
                 dist_obj = distribution
             else:
                 dist_obj = _resolve_distribution(dcop, distribution)
@@ -206,6 +191,29 @@ def solve(
         checkpoint_every=checkpoint_every, resume=resume,
         ui_port=ui_port, n_restarts=n_restarts,
     )
+
+
+def _is_strategy_name(distribution) -> bool:
+    """A string that is not an existing file is a strategy name."""
+    import os
+
+    return isinstance(distribution, str) and not os.path.isfile(
+        distribution
+    )
+
+
+def _validate_strategy_name(name: str) -> None:
+    """Fail fast on an unloadable strategy (also catches mistyped
+    placement-file paths, indistinguishable from names here)."""
+    from pydcop_tpu.distribution import load_distribution_module
+
+    try:
+        load_distribution_module(name)
+    except Exception as e:
+        raise ValueError(
+            f"distribution {name!r} is neither an existing placement "
+            f"file nor a loadable strategy: {e}"
+        )
 
 
 def _resolve_distribution(dcop: DCOP, distribution):
@@ -282,23 +290,10 @@ def _solve_process(
     dist_name = None
     placement = None
     if distribution is not None:
-        if isinstance(distribution, str) and not os.path.isfile(
-            distribution
-        ):
+        if _is_strategy_name(distribution):
+            # fail fast, before forking nb_agents interpreters
+            _validate_strategy_name(distribution)
             dist_name = distribution
-            # fail fast, before forking nb_agents interpreters — and
-            # catch the mistyped-file-path case (a path that doesn't
-            # exist is indistinguishable from a strategy name here)
-            from pydcop_tpu.distribution import load_distribution_module
-
-            try:
-                load_distribution_module(dist_name)
-            except Exception as e:
-                raise ValueError(
-                    f"distribution {dist_name!r} is neither an "
-                    f"existing placement file nor a loadable "
-                    f"strategy: {e}"
-                )
         else:
             placement = _resolve_distribution(dcop, distribution).mapping
 

@@ -91,7 +91,7 @@ def set_parser(subparsers) -> None:
         "computations run as ONE compiled array-engine island (TPU "
         "when the agent's machine has one) behind per-node proxies — "
         "the heterogeneous strong-host deployment.  Requires island "
-        "support in the algorithm (maxsum)",
+        "support in the algorithm (maxsum/amaxsum and the dsa family)",
     )
     p.add_argument(
         "--runtime", choices=["spmd", "host"], default="spmd",
@@ -195,6 +195,7 @@ def run_cmd(args) -> int:
         from pydcop_tpu.algorithms import (
             load_algorithm_module,
             prepare_algo_params,
+            require_island_support,
         )
 
         try:
@@ -208,12 +209,8 @@ def run_cmd(args) -> int:
                     "implementation — use the SPMD runtime for "
                     "batched-only algorithms"
                 )
-            if args.accel_agents and not hasattr(_mod, "build_island"):
-                raise ValueError(
-                    f"{args.algo} has no compiled-island support "
-                    "(build_island) — --accel_agents works with: "
-                    "maxsum"
-                )
+            if args.accel_agents:
+                require_island_support(_mod, args.algo)
         except ValueError as e:
             raise SystemExit(f"orchestrator: {e}")
         try:

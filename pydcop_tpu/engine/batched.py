@@ -345,6 +345,49 @@ def run_batched(
         **static_params, **{k: params[k] for k in dyn_params}
     }
     if initial_state is not None:
+        # structural validation (the checkpoint resume path has meta
+        # to check algo/seed/fingerprint; a raw pytree has only its
+        # structure — validate everything it CAN prove): the 'values'
+        # leaf must exist with the exact expected shape, and the leaf
+        # set must match this algorithm's state, so a state from a
+        # different algorithm, problem size, or restart count fails
+        # loudly instead of continuing a foreign trajectory
+        if (
+            not isinstance(initial_state, dict)
+            or "values" not in initial_state
+        ):
+            raise ValueError(
+                "initial_state must be a state pytree with a "
+                "'values' leaf (RunResult.state of a previous run)"
+            )
+        want = (
+            (n_restarts, problem.n_vars)
+            if batched_restarts
+            else (problem.n_vars,)
+        )
+        got = tuple(jnp.shape(initial_state["values"]))
+        if got != want:
+            raise ValueError(
+                f"initial_state 'values' has shape {got}, expected "
+                f"{want} (n_restarts={n_restarts}, "
+                f"n_vars={problem.n_vars}) — a state from a different "
+                "problem or restart count?"
+            )
+        static_keys = frozenset(
+            getattr(algo_module, "STATIC_STATE_KEYS", ())
+        )
+        expect_keys = (
+            set(algo_module.init_state(problem, k_init, init_params))
+            - static_keys
+        )
+        have_keys = set(initial_state) - static_keys
+        if have_keys != expect_keys:
+            raise ValueError(
+                f"initial_state leaves {sorted(have_keys)} do not "
+                f"match {algo_module.__name__}'s state "
+                f"{sorted(expect_keys)} — a state from a different "
+                "algorithm?"
+            )
         state = jax.tree_util.tree_map(jnp.asarray, initial_state)
     elif batched_restarts:
         state = jax.vmap(

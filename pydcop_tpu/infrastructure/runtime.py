@@ -280,12 +280,22 @@ def solve_host(
         if log is not None:
             log.close()
 
-    assignment = {c.variable.name: c.current_value for c in var_comps}
-    cost = dcop.solution_cost(assignment)
     snapshot()
+    assignment = {c.variable.name: c.current_value for c in var_comps}
+    if any(v is None for v in assignment.values()):
+        # stopped before every computation selected a value (short
+        # timeout/budget mid-UTIL for dpop/syncbb): fall back to the
+        # best sampled assignment — same guard as the hostnet
+        # orchestrator's final collect — instead of crashing inside
+        # constraint evaluation
+        assignment = dict(best["assignment"])
+        cost = sign * best["cost"] if assignment else None
+    else:
+        cost = dcop.solution_cost(assignment)
+    best_cost = sign * best["cost"] if best["assignment"] else None
     return {
         "assignment": best["assignment"],
-        "cost": sign * best["cost"],  # back to the native sign
+        "cost": best_cost,  # back to the native sign
         "final_assignment": assignment,
         "final_cost": cost,
         "cycle": delivered,

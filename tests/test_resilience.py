@@ -319,6 +319,72 @@ def test_state_transfer_preserves_messages_exactly():
         )
 
 
+def test_state_transfer_rejects_foreign_states():
+    """A state from a different algorithm, problem size, or restart
+    count must fail loudly — continuing a foreign trajectory would
+    silently produce wrong results (review-found gap: the resume path
+    validated via checkpoint meta, the raw-pytree path not at all)."""
+    import pytest
+
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_batched
+    from pydcop_tpu.ops import compile_dcop
+
+    problem = compile_dcop(ring_dcop(8))
+    maxsum = load_algorithm_module("maxsum")
+    mparams = prepare_algo_params({}, maxsum.algo_params)
+    r = run_batched(
+        problem, maxsum, mparams, rounds=4, seed=0, chunk_size=4,
+        return_state=True,
+    )
+
+    # wrong algorithm: dsa's state has different leaves
+    dsa = load_algorithm_module("dsa")
+    dparams = prepare_algo_params({}, dsa.algo_params)
+    with pytest.raises(ValueError, match="different algorithm"):
+        run_batched(
+            problem, dsa, dparams, rounds=1, seed=0, chunk_size=1,
+            initial_state=r.state,
+        )
+    # wrong problem size
+    small = compile_dcop(ring_dcop(6))
+    with pytest.raises(ValueError, match="different problem"):
+        run_batched(
+            small, maxsum, mparams, rounds=1, seed=0, chunk_size=1,
+            initial_state=r.state,
+        )
+    # wrong restart count
+    with pytest.raises(ValueError, match="restart count|different"):
+        run_batched(
+            problem, maxsum, mparams, rounds=1, seed=0, chunk_size=1,
+            n_restarts=4, initial_state=r.state,
+        )
+    # not a state pytree at all
+    with pytest.raises(ValueError, match="'values' leaf"):
+        run_batched(
+            problem, maxsum, mparams, rounds=1, seed=0, chunk_size=1,
+            initial_state={"nope": 1},
+        )
+
+
+def test_host_runtime_short_budget_returns_cleanly():
+    """A budget/timeout that stops dpop/syncbb before any VALUE wave
+    must return a clean result, not crash in solution_cost on None
+    values (review-reproduced)."""
+    import __graft_entry__ as g
+    from pydcop_tpu.infrastructure import solve_host
+
+    dcop = g._make_coloring_dcop(8, degree=2, seed=1)
+    for algo in ("dpop", "syncbb"):
+        r = solve_host(dcop, algo, mode="sim", max_msgs=3)
+        assert r["status"] == "msg_budget"
+        assert r["cost"] is None
+        assert r["assignment"] == {}
+
+
 def test_dynamic_run_carries_state_across_events():
     """Scenario segments reuse the full algorithm state whenever the
     recompiled problem is unchanged (delays, clean migrations), and
