@@ -21,7 +21,12 @@ Mechanism:
   is set to the received index and its unary row carries BIG off that
   index, so the DSA sweep can neither move it nor profit from moving
   it — the island evaluates EXACTLY against the last heard values, as
-  a host computation would.
+  a host computation would.  No burst runs until EVERY boundary
+  neighbor has announced at least once: host DSA skips constraints
+  whose neighbors are unknown, and bursting earlier would optimize
+  boundary constraints against the shadows' arbitrary init values
+  instead.  (All computations announce on start, so the gate clears
+  after the initial value wave.)
 - After each burst, owned boundary variables whose value changed are
   announced to their remote neighbor computations; interior updates
   stay on-device.  No message is sent when nothing changed, so
@@ -54,6 +59,15 @@ from pydcop_tpu.infrastructure.computations import (
 )
 
 _SHADOW = "__shadow__{}"
+
+# consecutive bursts that changed nothing (while a probability-gated
+# improving move exists) before the island stops self-re-firing: keeps
+# quiescence-based termination even when the kernel's move gate never
+# opens (probability/activation ~ 0).  Any boundary message or any
+# actual change re-arms the budget.  16 bursts x island_rounds rounds
+# gives a gated kernel far more chances than host DSA gets between two
+# neighbor messages.
+_MAX_IDLE_TICKS = 16
 
 
 class DsaIsland:
@@ -145,12 +159,14 @@ class DsaIsland:
         )
 
         self._pin: Dict[str, int] = {}  # remote var -> pinned index
+        self._heard: set = set()  # remote vars announced at least once
         self._last_sent: Dict[str, Any] = {}
         self._proxies: Dict[str, "IslandDsaProxy"] = {}
         self._n_started = 0
         self._dirty = False
         self._started = False
         self._flushes = 0
+        self._idle_ticks = 0  # consecutive no-change self-re-fires
 
         # per-island stream: two structurally identical islands (a
         # symmetric split) must not draw correlated move gates, or
@@ -191,7 +207,7 @@ class DsaIsland:
             # (thread mode buffers pre-start messages): a drained
             # inbox with pins already set must burst now, or nothing
             # may ever re-trigger the island
-            if self._dirty and self._pending_fn() == 0:
+            if self._dirty and self._ready() and self._pending_fn() == 0:
                 self._flush()
 
     # -- inbound ---------------------------------------------------------
@@ -203,20 +219,38 @@ class DsaIsland:
         # early return would strand _dirty pins until the next
         # delivery that may never come
         if dest in self.owned_names and sender in self._shadow_slot:
+            # "heard" even when the value is unusable: a single
+            # malformed announcement from a never-changing neighbor
+            # must not gate the island shut for the whole run (the
+            # shadow then stays at its init-value pin, degrading one
+            # constraint instead of disabling every burst)
+            self._heard.add(sender)
             labels = self._labels[_SHADOW.format(sender)]
             try:
                 self._pin[sender] = labels.index(value)
                 self._dirty = True
+                self._idle_ticks = 0  # boundary news re-arms re-fires
             except ValueError:
                 pass  # value outside the declared domain: drop
-        if self._started and self._dirty and self._pending_fn() == 0:
+        if (
+            self._started
+            and self._dirty
+            and self._ready()
+            and self._pending_fn() == 0
+        ):
             self._flush()
 
     def tick(self) -> None:
         """Self-addressed re-fire (see the tick note in ``_flush``)."""
         self._dirty = True
-        if self._started and self._pending_fn() == 0:
+        if self._started and self._ready() and self._pending_fn() == 0:
             self._flush()
+
+    def _ready(self) -> bool:
+        """Every boundary neighbor announced at least once?  Bursting
+        earlier would optimize against shadow init values (host DSA
+        instead skips constraints with unknown neighbors)."""
+        return len(self._heard) == len(self._shadow_slot)
 
     # -- the compiled burst ----------------------------------------------
 
@@ -254,9 +288,10 @@ class DsaIsland:
         for real, slot in self._shadow_slot.items():
             pin = self._pin.get(real)
             if pin is None:
-                # not heard yet: still pin (at the init value) — a
-                # movable shadow would let the island "resolve" a
-                # boundary constraint by moving the remote's proxy
+                # heard but never a USABLE value (out-of-domain
+                # announcements): pin at the init value — a movable
+                # shadow would let the island "resolve" a boundary
+                # constraint by moving the remote's proxy
                 pin = int(values[slot])
             row = np.full(unary.shape[1], BIG, dtype=unary.dtype)
             row[pin] = 0.0
@@ -280,7 +315,16 @@ class DsaIsland:
             (new_values[self._owned_slots] != values[self._owned_slots])
             .any()
         )
-        if changed or self._wants_move(unary_j):
+        if changed:
+            self._idle_ticks = 0
+        else:
+            self._idle_ticks += 1
+        # a kernel whose move gate never opens (probability=0) would
+        # otherwise re-fire forever on _wants_move: the idle-tick cap
+        # restores quiescence, re-armed by any change or boundary news
+        if changed or (
+            self._idle_ticks < _MAX_IDLE_TICKS and self._wants_move(unary_j)
+        ):
             anchor = next(iter(self._proxies.values()))
             from pydcop_tpu.infrastructure.computations import Message
 

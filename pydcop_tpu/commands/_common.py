@@ -91,17 +91,28 @@ def write_metrics(args, result: Dict[str, Any]) -> None:
         # per snapshot, not per cycle): label those proportionally or
         # the whole history reads as the run's final n cycles
         subsampled = bool(result.get("trace_subsampled"))
+        # host runtimes record the ACTUAL delivered count per snapshot
+        # (trace_msgs); only fall back to the proportional
+        # reconstruction for traces that predate it
+        msgs_at = result.get("trace_msgs") or []
+        exact = len(msgs_at) == n
 
         def row(i):
-            if subsampled:
+            if exact:
+                # host cycle == delivered messages (async analogue of
+                # rounds), so both columns come straight off the record
+                cyc, msgs = msgs_at[i], msgs_at[i]
+            elif subsampled:
                 cyc = max(1, round(cycles_total * (i + 1) / n)) if n else 0
+                msgs = int(per_round_msgs * cyc)
             else:
                 cyc = first_cycle + i + 1
+                msgs = int(per_round_msgs * cyc)
             return [
                 round(total_time * (i + 1) / n, 6) if n else 0.0,
                 cyc,
                 trace[i],
-                int(per_round_msgs * cyc),
+                msgs,
             ]
 
         mode = getattr(args, "collect_on", "cycle_change")

@@ -14,7 +14,12 @@ Example (two terminals)::
 
 from __future__ import annotations
 
-from pydcop_tpu.commands._common import parse_algo_params, write_result
+from pydcop_tpu.commands._common import (
+    add_collect_arguments,
+    parse_algo_params,
+    write_metrics,
+    write_result,
+)
 
 
 def set_parser(subparsers) -> None:
@@ -102,6 +107,7 @@ def set_parser(subparsers) -> None:
         "JSON messages (the reference's heterogeneous deployment; "
         "agents need no accelerator)",
     )
+    add_collect_arguments(p)
     p.set_defaults(func=run_cmd)
 
 
@@ -231,8 +237,10 @@ def run_cmd(args) -> int:
             )
         except PlacementError as e:  # usage errors: clean exit
             raise SystemExit(f"orchestrator: {e}")
+        write_metrics(args, result)
         result.pop("cost_trace", None)  # keep the printed JSON compact
         result.pop("trace_subsampled", None)
+        result.pop("trace_msgs", None)
         write_result(args, result)
         return 0
 
@@ -284,5 +292,9 @@ def run_cmd(args) -> int:
         k_target=args.ktarget,
         ui_port=args.uiport,
     )
+    write_metrics(args, result)
+    result.pop("cost_trace", None)  # keep the printed JSON compact
+    result.pop("trace_subsampled", None)
+    result.pop("trace_msgs", None)
     write_result(args, result)
     return 0

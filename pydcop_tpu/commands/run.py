@@ -86,5 +86,6 @@ def run_cmd(args) -> int:
     write_metrics(args, result)
     result.pop("cost_trace", None)
     result.pop("trace_subsampled", None)
+    result.pop("trace_msgs", None)
     write_result(args, result)
     return 0

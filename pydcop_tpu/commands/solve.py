@@ -140,5 +140,6 @@ def run_cmd(args) -> int:
     write_metrics(args, result)
     result.pop("cost_trace", None)  # keep the printed JSON compact
     result.pop("trace_subsampled", None)
+    result.pop("trace_msgs", None)
     write_result(args, result)
     return 0

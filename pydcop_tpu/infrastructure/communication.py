@@ -201,3 +201,16 @@ class Messaging:
         """Queued messages + the in-flight one (if any)."""
         with self._cond:
             return len(self._heap) + (1 if self._in_flight else 0)
+
+    @property
+    def queued(self) -> int:
+        """Waiting messages only, excluding the in-flight one.
+
+        The island flush probe needs "anything still to deliver?"
+        regardless of whether it is asked from inside a handler (one
+        in-flight message — the one that triggered the probe) or from
+        ``on_start`` (none): counting the heap alone answers both
+        without the caller guessing the in-flight state.
+        """
+        with self._cond:
+            return len(self._heap)

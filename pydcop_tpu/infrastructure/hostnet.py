@@ -579,6 +579,7 @@ def run_host_orchestrator(
         sign = -1.0 if dcop.objective == "max" else 1.0
         best = {"cost": float("inf"), "assignment": {}}
         trace: List[float] = []
+        trace_msgs: List[int] = []  # delivered count at each sample
 
         if ui_port is not None:
             from pydcop_tpu.infrastructure.ui import UiServer
@@ -598,6 +599,7 @@ def run_host_orchestrator(
                 return  # some variable has no selected value yet
             cost = dcop.solution_cost(assignment)
             trace.append(cost)  # anytime stream (--collect_on CSVs)
+            trace_msgs.append(delivered)
             if sign * cost < best["cost"]:
                 best["cost"] = sign * cost
                 best["assignment"] = assignment
@@ -658,6 +660,7 @@ def run_host_orchestrator(
             trace.append(final_cost)  # the end state belongs in the
             # anytime stream too (a short run may never have hit a
             # complete periodic sample)
+            trace_msgs.append(delivered)
             if sign * final_cost < best["cost"]:
                 best["cost"] = sign * final_cost
                 best["assignment"] = final_assignment
@@ -688,6 +691,7 @@ def run_host_orchestrator(
             "time": time.perf_counter() - t0,
             "cost_trace": trace,
             "trace_subsampled": True,  # one entry per 0.5s sample
+            "trace_msgs": trace_msgs,  # exact delivered count per sample
             "agents": agent_names,
             "placement": {a: sorted(c) for a, c in placement.items()},
         }
@@ -814,10 +818,10 @@ def run_host_agent(
             ],
             dcop,
             seed=dep["seed"],
-            # called from inside a proxy handler, where pending counts
-            # the in-flight message itself: subtract it so "0" means
-            # the inbox is drained and the island should flush
-            pending_fn=lambda: max(0, agent.messaging.pending - 1),
+            # Messaging.queued excludes the in-flight message, so the
+            # probe is exact both inside a proxy handler and from
+            # on_start (where nothing is in flight)
+            pending_fn=lambda: agent.messaging.queued,
         )
     else:
         computations = [

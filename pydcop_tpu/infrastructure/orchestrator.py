@@ -625,4 +625,7 @@ def _run_spmd(
         "time": r.time,
         "num_processes": num_processes,
         "n_shards": n_shards,
+        # per-round anytime stream, same shape as api.solve's batched
+        # result: feeds the --collect_on metrics CSVs
+        "cost_trace": r.cost_trace.tolist(),
     }

@@ -246,13 +246,15 @@ def solve_host(
     sign = -1.0 if dcop.objective == "max" else 1.0
     best = {"cost": float("inf"), "assignment": {}}
     trace: List[float] = []  # anytime cost stream (--collect_on CSVs)
+    trace_msgs: List[int] = []  # delivered count at each snapshot
 
-    def snapshot() -> None:
+    def snapshot(delivered: int = 0) -> None:
         assignment = {c.variable.name: c.current_value for c in var_comps}
         if any(v is None for v in assignment.values()):
             return
         cost = dcop.solution_cost(assignment)
         trace.append(cost)
+        trace_msgs.append(delivered)
         if sign * cost < best["cost"]:
             best["cost"] = sign * cost
             best["assignment"] = assignment
@@ -280,7 +282,7 @@ def solve_host(
         if log is not None:
             log.close()
 
-    snapshot()
+    snapshot(delivered)
     assignment = {c.variable.name: c.current_value for c in var_comps}
     if any(v is None for v in assignment.values()):
         # stopped before every computation selected a value (short
@@ -305,6 +307,9 @@ def solve_host(
         "time": time.perf_counter() - t0,
         "cost_trace": trace,
         "trace_subsampled": True,  # one entry per snapshot, not cycle
+        # actual delivered count per snapshot, so the metrics CSVs can
+        # label rows exactly instead of reconstructing proportionally
+        "trace_msgs": trace_msgs,
     }
 
 
@@ -370,7 +375,7 @@ def _run_sim(
     snap_every = max(1, len(computations))
     while nonempty:
         if delivered % snap_every == 0:
-            snapshot()
+            snapshot(delivered)
         if delivered >= max_msgs:
             status = "msg_budget"
             break
@@ -450,10 +455,11 @@ def _run_threads(
             agent.deploy_computation(by_name[cname])
         agents.append(agent)
         if pending_refs and aname in pending_refs:
-            # island flush probe: drained when only the in-flight
-            # message (popped before its handler runs) remains
+            # island flush probe: drained when nothing is WAITING —
+            # Messaging.queued excludes the in-flight message, so the
+            # probe is exact both inside a handler and from on_start
             pending_refs[aname]["fn"] = (
-                lambda a=agent: max(0, a.messaging.pending - 1)
+                lambda a=agent: a.messaging.queued
             )
 
     for a in agents:
@@ -467,10 +473,10 @@ def _run_threads(
     idle_checks = 0
     while True:
         time.sleep(0.02)
-        snapshot()  # values are plain attributes; a torn read at worst
-        # yields a mix of valid values, whose cost is still a valid
-        # anytime sample
         total = sum(a.messaging.count_msg for a in agents)
+        snapshot(total)  # values are plain attributes; a torn read at
+        # worst yields a mix of valid values, whose cost is still a
+        # valid anytime sample
         if timeout is not None and time.perf_counter() - t0 > timeout:
             status = "timeout"
             break

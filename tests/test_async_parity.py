@@ -305,7 +305,7 @@ def test_host_gdba_breaks_out_and_syncs_weights():
     comps, _ = _build_computations(dcop, "gdba", params, seed=0)
     # t0 is a perf_counter() origin — 0.0 would trip the timeout on
     # the first delivery and run zero messages (round-3 bug)
-    _run_sim(comps, 30.0, 40_000, 0, time.perf_counter(), lambda: None)
+    _run_sim(comps, 30.0, 40_000, 0, time.perf_counter(), lambda *a: None)
     final = {c.name: c.current_value for c in comps}
     assert dcop.solution_cost(final) < 0.5  # escaped the minimum
     tables = {}

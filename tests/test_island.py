@@ -127,7 +127,7 @@ def test_island_mixed_sim_parity(algo):
     t0 = time.perf_counter()
     status, delivered, _size = _run_sim(
         comps, timeout=60, max_msgs=100_000, seed=5, t0=t0,
-        snapshot=lambda: None,
+        snapshot=lambda *a: None,
     )
     assert status == "finished", status  # quiescence, not budget
     assert delivered > 0  # real boundary traffic crossed the seam
@@ -162,7 +162,7 @@ def test_island_owned_factor_boundary():
     ]
     status, delivered, _ = _run_sim(
         comps, timeout=60, max_msgs=100_000, seed=7,
-        t0=time.perf_counter(), snapshot=lambda: None,
+        t0=time.perf_counter(), snapshot=lambda *a: None,
     )
     assert status == "finished", status
     cost, assignment = _cost(dcop, comps)
@@ -219,7 +219,7 @@ def test_island_mixed_domain_sizes():
     ]
     status, _, _ = _run_sim(
         comps, timeout=60, max_msgs=100_000, seed=3,
-        t0=time.perf_counter(), snapshot=lambda: None,
+        t0=time.perf_counter(), snapshot=lambda *a: None,
     )
     assert status == "finished"
     cost, assignment = _cost(dcop, comps)
@@ -257,7 +257,7 @@ def test_island_max_objective():
     ]
     status, _, _ = _run_sim(
         comps, timeout=60, max_msgs=100_000, seed=1,
-        t0=time.perf_counter(), snapshot=lambda: None,
+        t0=time.perf_counter(), snapshot=lambda *a: None,
     )
     assert status == "finished"
     cost, assignment = _cost(dcop, comps)

@@ -153,6 +153,34 @@ def test_restarts_compose_with_mesh(algo_name):
     assert r_mesh.assignment == r_flat.assignment
 
 
+def test_checkpoint_resume_under_mesh(tmp_path):
+    """Interrupt a sharded run at its midpoint, resume from the
+    checkpoint under the SAME mesh, and land on the uninterrupted
+    run's trajectory (the composition claim of engine/batched.py:
+    restarts x mesh x checkpoint)."""
+    dcop = coloring_ring(24, 3, with_ternary=True)
+    problem = compile_dcop(dcop, n_shards=8)
+    module = load_algorithm_module("maxsum")
+    params = prepare_algo_params({"damping": 0.5}, module.algo_params)
+    mesh = make_mesh(8)
+    ck = str(tmp_path / "mesh.ckpt.npz")
+    full = run_batched(
+        problem, module, params, rounds=32, seed=3, mesh=mesh,
+        chunk_size=8,
+    )
+    run_batched(
+        problem, module, params, rounds=16, seed=3, mesh=mesh,
+        chunk_size=8, checkpoint_path=ck,
+    )
+    resumed = run_batched(
+        problem, module, params, rounds=32, seed=3, mesh=mesh,
+        chunk_size=8, checkpoint_path=ck, resume=True,
+    )
+    assert resumed.best_cost == pytest.approx(full.best_cost, abs=1e-4)
+    assert resumed.cost == pytest.approx(full.cost, abs=1e-4)
+    assert resumed.assignment == full.assignment
+
+
 def test_constraint_free_problem_shards():
     """A problem whose surviving variables share NO constraint (every
     neighbor frozen into an external) must still compile and run over
