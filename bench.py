@@ -161,6 +161,93 @@ def last_good_tpu(workload: str | None = None) -> dict | None:
     return None
 
 
+# BASELINE.md table rows -> the BENCH_TPU_LOG.jsonl workload keys that
+# count as evidence for that row.  A key ending in "*" matches as a
+# prefix (config rows carry the instance name; the restart sweep
+# carries K).  Keeping this map HERE makes staleness machine-visible
+# row by row in every bench output (VERDICT r4 next #6) instead of
+# living in BASELINE.md footnotes.
+EVIDENCE_ROWS = [
+    ("north_star_coloring_10k",
+     ["maxsum_coloring_10000", "maxsum_coloring_10000_belief_auto"]),
+    ("coloring_1k", ["maxsum_coloring_1000"]),
+    ("coloring_100k", ["maxsum_coloring_100000"]),
+    ("coloring_1m", ["maxsum_coloring_1000000"]),
+    ("config1_dsa_coloring50", ["config1_*"]),
+    ("config2_mgm2_ising", ["config2_*"]),
+    ("config3_maxsum_scalefree1k", ["config3_*"]),
+    ("config4_dpop_secp", ["config4_*"]),
+    ("config5_maxsum_meeting10k", ["maxsum_meeting_10000"]),
+    ("restart_sweep_10k", ["maxsum_coloring_10000_restarts*"]),
+]
+
+
+def tpu_evidence_by_row() -> dict:
+    """Freshest logged TPU evidence per BASELINE.md table row.
+
+    Returns ``{row: {sha, ts, age_hours, msgs_per_sec?, ...}}`` with a
+    ``"never captured"`` marker for rows that have no entry at all, so
+    the driver (and the judge) can see per-row staleness without
+    cross-referencing footnotes.
+    """
+    try:
+        with open(TPU_LOG) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        lines = []
+    entries = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            entries.append(json.loads(line))
+        except ValueError:
+            continue
+
+    def matches(w: str, keys) -> bool:
+        for k in keys:
+            if k.endswith("*"):
+                if w.startswith(k[:-1]):
+                    return True
+            elif w == k:
+                return True
+        return False
+
+    now = time.time()
+    out = {}
+    for row, keys in EVIDENCE_ROWS:
+        found = None
+        for entry in reversed(entries):  # newest last in the log
+            if matches(entry.get("workload", ""), keys):
+                found = entry
+                break
+        if found is None:
+            out[row] = {"status": "never captured"}
+            continue
+        rec = {
+            "workload": found.get("workload"),
+            "sha": found.get("sha"),
+            "ts": found.get("ts"),
+            "source": found.get("source"),
+        }
+        try:
+            import calendar
+
+            rec["age_hours"] = round(
+                (now - calendar.timegm(
+                    time.strptime(found["ts"], "%Y-%m-%dT%H:%M:%SZ")
+                )) / 3600.0, 1,
+            )
+        except (KeyError, ValueError):
+            rec["age_hours"] = None
+        for k in ("msgs_per_sec", "best_cost", "util_time_device"):
+            if found.get(k) is not None:
+                rec[k] = found[k]
+        out[row] = rec
+    return out
+
+
 _PHASE_T0 = time.perf_counter()
 
 
@@ -533,6 +620,11 @@ def main() -> None:
                     "in this bench run"
                 ),
             }
+    # per-row evidence freshness: ALWAYS emitted, so staleness of every
+    # BASELINE.md TPU cell is machine-readable in each driver round
+    # (rows measured live in THIS run are superseded by the log entry
+    # the run just appended, so the block is self-consistent)
+    out["tpu_evidence_rows"] = tpu_evidence_by_row()
     if errors:
         out["error"] = "; ".join(errors)
     print(json.dumps(out))

@@ -74,6 +74,14 @@ algo_params = [
     # the MXU — the round-4 layout candidate (BASELINE.md headroom
     # notes; adopt iff it beats 'auto' on the real chip)
     AlgoParameterDef("belief", "str", ["auto", "blockdiag"], "auto"),
+    # message-array storage dtype.  'bf16' stores q/r (and gathers
+    # them) in bfloat16 while ALL arithmetic stays f32 (upcast inside
+    # the kernels; belief accumulates in f32; reported costs are exact
+    # evaluations of the selected assignment either way) — the
+    # round-5 candidate for the gather-bound belief crossing: it pays
+    # iff Mosaic's gather cost is per byte, which
+    # tools/bench_gather.py measures directly (VERDICT r4 next #1b).
+    AlgoParameterDef("msg_dtype", "str", ["f32", "bf16"], "f32"),
     # compiled-island scheduling (host runtime --accel agents only;
     # ignored by the batched engine): internal rounds run at island
     # start and per boundary-message wave (_island_maxsum.py)
@@ -100,9 +108,14 @@ def init_state(
     noise = params.get("noise", 0.0) * jax.random.uniform(
         k_noise, (d, problem.n_vars), dtype=problem.unary.dtype
     )
+    mdt = (
+        jnp.bfloat16
+        if params.get("msg_dtype", "f32") == "bf16"
+        else problem.unary.dtype
+    )
     state = {
-        "q": jnp.zeros((d, E), dtype=problem.unary.dtype),
-        "r": jnp.zeros((d, E), dtype=problem.unary.dtype),
+        "q": jnp.zeros((d, E), dtype=mdt),
+        "r": jnp.zeros((d, E), dtype=mdt),
         "values": values,
         "noise": noise,
     }
@@ -221,8 +234,12 @@ def belief_from_r(
         axis_name is not None or _costs.use_cpu_segment_path(problem)
     )
     if use_segment:
+        # accumulate in f32 even for bf16 messages (the storage dtype
+        # buys gather/psum bytes, never summation precision)
         local = jax.ops.segment_sum(
-            r.T, problem.edge_var, num_segments=problem.n_vars
+            r.T.astype(unary_t.dtype),
+            problem.edge_var,
+            num_segments=problem.n_vars,
         )  # [n, d]
         if axis_name is not None:
             local = jax.lax.psum(local, axis_name)
@@ -245,7 +262,9 @@ def belief_from_r(
         n_p = min(counts[p], n)
         if n_p == 0:
             break  # later slots are empty too (monotone counts)
-        g = r_pad[:, ve[:n_p, p]]  # [d, n_p]
+        # the gather runs in the MESSAGE dtype (bf16 halves its bytes
+        # when msg_dtype='bf16'); the accumulate upcasts to f32
+        g = r_pad[:, ve[:n_p, p]].astype(acc.dtype)  # [d, n_p]
         if n_p < n:
             g = jnp.pad(g, ((0, 0), (0, n - n_p)))
         acc = acc + g
@@ -260,6 +279,7 @@ def step(
     axis_name: Optional[str] = None,
 ) -> Dict[str, jax.Array]:
     q, r = state["q"], state["r"]
+    mdt = q.dtype  # message storage dtype (msg_dtype param)
     damping = params["damping"]
     unary_t = problem.unary.T + state["noise"]  # [d, n]
     d = problem.d_max
@@ -320,17 +340,17 @@ def step(
                 r_blocks.append(jnp.concatenate([r0, r1], axis=1))
                 off += m * k
                 continue
-            s = tab  # [d, ..., d, m]
+            s = tab  # [d, ..., d, m] — f32; bf16 q upcasts on the add
             for p in range(k):
                 shape = (1,) * p + (d,) + (1,) * (k - 1 - p) + (m,)
-                s = s + q_pos[p].reshape(shape)
+                s = s + q_pos[p].astype(tab.dtype).reshape(shape)
             outs = []
             for p in range(k):
                 axes = tuple(a for a in range(k) if a != p)
                 mp = jnp.min(s, axis=axes)  # [d, m]
-                rp = mp - q_pos[p]
+                rp = mp - q_pos[p].astype(tab.dtype)
                 rp = rp - jnp.min(rp, axis=0, keepdims=True)
-                outs.append(rp)
+                outs.append(rp.astype(mdt))
             r_blocks.append(jnp.concatenate(outs, axis=1))  # [d, m·k]
             off += m * k
     r_new = (
@@ -351,15 +371,21 @@ def step(
         )
     else:
         belief = belief_from_r(problem, r_new, unary_t, axis_name)
-    belief_e = belief[:, problem.edge_var]  # exclude own incoming r
+    # the broadcast-back crossing also runs in the message dtype: for
+    # bf16 messages the [d, E] gather moves half the bytes, and the q
+    # update upcasts before doing any arithmetic
+    belief_src = belief if belief.dtype == mdt else belief.astype(mdt)
+    belief_e = belief_src[:, problem.edge_var]  # exclude own incoming r
     if use_fused:
         q_new = pallas_maxsum.q_update(
             belief_e, r_new, q, jnp.asarray(damping)
         )
     else:
-        q_new = belief_e - r_new
+        q_new = belief_e.astype(belief.dtype) - r_new.astype(belief.dtype)
         q_new = q_new - jnp.min(q_new, axis=0, keepdims=True)
-        q_new = damping * q + (1.0 - damping) * q_new
+        q_new = (
+            damping * q.astype(belief.dtype) + (1.0 - damping) * q_new
+        ).astype(mdt)
     values = jnp.argmin(belief, axis=0).astype(state["values"].dtype)
     return {
         **state,  # carries the static bd_* index leaves when present

@@ -118,6 +118,29 @@ def load_checkpoint(
                     f"Checkpoint leaf {key!r} has shape {arr.shape}, "
                     f"expected {np.shape(tmpl)} — different problem?"
                 )
+            tdt = np.dtype(getattr(tmpl, "dtype", arr.dtype))
+            if arr.dtype.kind == "V":
+                # np.savez stores ml_dtypes arrays (bfloat16 message
+                # state, msg_dtype='bf16') as raw void records; the
+                # template knows the real dtype — reinterpret, never
+                # numerically convert
+                if tdt.itemsize != arr.dtype.itemsize:
+                    raise ValueError(
+                        f"Checkpoint leaf {key!r} has an opaque "
+                        f"{arr.dtype.itemsize}-byte dtype; the state "
+                        f"template expects {tdt} — different "
+                        "msg_dtype setting?"
+                    )
+                arr = arr.view(tdt)
+            elif arr.dtype != tdt:
+                # both directions must fail loudly: an f32 checkpoint
+                # resumed under msg_dtype='bf16' would otherwise run
+                # the whole job in f32 while the params claim bf16
+                raise ValueError(
+                    f"Checkpoint leaf {key!r} has dtype {arr.dtype}, "
+                    f"the state template expects {tdt} — different "
+                    "msg_dtype (or algorithm parameter) setting?"
+                )
             leaves.append(arr)
         state = jax.tree_util.tree_unflatten(paths_leaves[1], leaves)
         best_values = data["best_values"]

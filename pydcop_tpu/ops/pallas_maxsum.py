@@ -88,19 +88,27 @@ def _factor_kernel(d: int, tab_ref, q0_ref, q1_ref, r0_ref, r1_ref):
     # S[a, b, :] = tab[a, b, :] + q0[a, :] + q1[b, :]; min-project over
     # the other axis, both directions in one pass.  d is a static
     # Python int, so this is d*d lane-vector adds — no reductions over
-    # a traced axis.
+    # a traced axis.  Message refs may be bf16 (msg_dtype param): all
+    # arithmetic upcasts to the table dtype (f32), outputs cast back.
+    f = tab_ref.dtype
     m0 = [None] * d
     m1 = [None] * d
     for a in range(d):
-        qa = q0_ref[a : a + 1, :]  # [1, BLK]
+        qa = q0_ref[a : a + 1, :].astype(f)  # [1, BLK]
         for b in range(d):
-            s = tab_ref[a, b : b + 1, :] + qa + q1_ref[b : b + 1, :]
+            s = tab_ref[a, b : b + 1, :] + qa + (
+                q1_ref[b : b + 1, :].astype(f)
+            )
             m0[a] = s if m0[a] is None else jnp.minimum(m0[a], s)
             m1[b] = s if m1[b] is None else jnp.minimum(m1[b], s)
-    r0 = jnp.concatenate(m0, axis=0) - q0_ref[:]  # [d, BLK]
-    r1 = jnp.concatenate(m1, axis=0) - q1_ref[:]
-    r0_ref[:] = r0 - jnp.min(r0, axis=0, keepdims=True)
-    r1_ref[:] = r1 - jnp.min(r1, axis=0, keepdims=True)
+    r0 = jnp.concatenate(m0, axis=0) - q0_ref[:].astype(f)  # [d, BLK]
+    r1 = jnp.concatenate(m1, axis=0) - q1_ref[:].astype(f)
+    r0_ref[:] = (r0 - jnp.min(r0, axis=0, keepdims=True)).astype(
+        r0_ref.dtype
+    )
+    r1_ref[:] = (r1 - jnp.min(r1, axis=0, keepdims=True)).astype(
+        r1_ref.dtype
+    )
 
 
 def factor_round_binary(
@@ -143,19 +151,25 @@ def factor_round_binary(
 def _factor_kernel_shared(d: int, tab_ref, q0_ref, q1_ref, r0_ref, r1_ref):
     # Same math as _factor_kernel with the ONE shared [d, d] table in
     # SMEM: tab[a, b] is a scalar broadcast over the lane block, so the
-    # kernel never streams table data from HBM at all.
+    # kernel never streams table data from HBM at all.  bf16 message
+    # refs upcast to the table dtype (f32) before any arithmetic.
+    f = tab_ref.dtype
     m0 = [None] * d
     m1 = [None] * d
     for a in range(d):
-        qa = q0_ref[a : a + 1, :]  # [1, BLK]
+        qa = q0_ref[a : a + 1, :].astype(f)  # [1, BLK]
         for b in range(d):
-            s = tab_ref[a, b] + qa + q1_ref[b : b + 1, :]
+            s = tab_ref[a, b] + qa + q1_ref[b : b + 1, :].astype(f)
             m0[a] = s if m0[a] is None else jnp.minimum(m0[a], s)
             m1[b] = s if m1[b] is None else jnp.minimum(m1[b], s)
-    r0 = jnp.concatenate(m0, axis=0) - q0_ref[:]  # [d, BLK]
-    r1 = jnp.concatenate(m1, axis=0) - q1_ref[:]
-    r0_ref[:] = r0 - jnp.min(r0, axis=0, keepdims=True)
-    r1_ref[:] = r1 - jnp.min(r1, axis=0, keepdims=True)
+    r0 = jnp.concatenate(m0, axis=0) - q0_ref[:].astype(f)  # [d, BLK]
+    r1 = jnp.concatenate(m1, axis=0) - q1_ref[:].astype(f)
+    r0_ref[:] = (r0 - jnp.min(r0, axis=0, keepdims=True)).astype(
+        r0_ref.dtype
+    )
+    r1_ref[:] = (r1 - jnp.min(r1, axis=0, keepdims=True)).astype(
+        r1_ref.dtype
+    )
 
 
 def factor_round_binary_shared(
@@ -194,10 +208,15 @@ def factor_round_binary_shared(
 
 
 def _qup_kernel(be_ref, r_ref, q_ref, damp_ref, out_ref):
-    qn = be_ref[:] - r_ref[:]
+    # bf16 message refs upcast to the damping scalar's dtype (f32)
+    # before any arithmetic; the write casts back to storage
+    f = damp_ref.dtype
+    qn = be_ref[:].astype(f) - r_ref[:].astype(f)
     qn = qn - jnp.min(qn, axis=0, keepdims=True)
     dmp = damp_ref[0, 0]
-    out_ref[:] = dmp * q_ref[:] + (1.0 - dmp) * qn
+    out_ref[:] = (dmp * q_ref[:].astype(f) + (1.0 - dmp) * qn).astype(
+        out_ref.dtype
+    )
 
 
 def q_update(
@@ -213,7 +232,9 @@ def q_update(
     # steps at large d beat a VMEM overflow
     ep = ((e + blk - 1) // blk) * blk
     spec = pl.BlockSpec((d, blk), lambda i: (0, i))
-    damp = jnp.asarray(damping, dtype=q.dtype).reshape(1, 1)
+    # damping stays f32: it doubles as the kernel's compute dtype, so
+    # bf16 message storage never degrades the update arithmetic
+    damp = jnp.asarray(damping, dtype=jnp.float32).reshape(1, 1)
     out = pl.pallas_call(
         _qup_kernel,
         grid=(ep // blk,),

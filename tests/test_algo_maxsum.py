@@ -176,3 +176,62 @@ def test_belief_blockdiag_matches_gather():
         problem, module, p_blk, rounds=60, seed=2, chunk_size=30
     )
     assert r_blk.best_cost == pytest.approx(r_auto.best_cost, abs=1e-3)
+
+
+@pytest.mark.parametrize("seed", [1, 3])
+def test_maxsum_bf16_messages_exact_on_trees(seed):
+    """msg_dtype='bf16' stores/gathers messages in bfloat16 with f32
+    arithmetic: on trees the argmin decisions survive the storage
+    rounding and the result stays exact (costs are always exact
+    evaluations of the selected assignment)."""
+    dcop = random_tree_dcop(seed)
+    _, opt_cost = brute_force_optimum(dcop)
+    result = solve(
+        dcop, "maxsum",
+        {"damping": 0.0, "noise": 0.0, "msg_dtype": "bf16"},
+        rounds=30, seed=0,
+    )
+    assert result["cost"] == pytest.approx(opt_cost, rel=1e-5)
+
+
+def test_maxsum_bf16_messages_ring_coloring():
+    """bf16 messages find a proper coloring on the loopy ring too, and
+    the sharded mesh path accepts the dtype (f32 psum accumulate)."""
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_batched
+    from pydcop_tpu.ops import compile_dcop
+    from pydcop_tpu.parallel import make_mesh
+
+    dom = Domain("c", "", [0, 1, 2])
+    dcop = DCOP("ring")
+    n = 10
+    vs = []
+    for i in range(n):
+        v = VariableNoisyCostFunc(
+            f"v{i}", dom, ExpressionFunction(f"0 * v{i}"), noise_level=0.01
+        )
+        vs.append(v)
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    result = solve(
+        dcop, "maxsum", {"damping": 0.5, "msg_dtype": "bf16"},
+        rounds=60, seed=0,
+    )
+    assert result["cost"] < 1.0
+
+    module = load_algorithm_module("maxsum")
+    params = prepare_algo_params(
+        {"damping": 0.5, "msg_dtype": "bf16"}, module.algo_params
+    )
+    r_mesh = run_batched(
+        compile_dcop(dcop, n_shards=8), module, params, rounds=60,
+        seed=0, mesh=make_mesh(8),
+    )
+    assert r_mesh.best_cost < 1.0

@@ -263,3 +263,34 @@ def test_resume_restart_stack(tmp_path):
             problem, module, params, rounds=64, seed=9, chunk_size=32,
             n_restarts=8, checkpoint_path=path, resume=True,
         )
+
+
+def test_checkpoint_roundtrip_bf16_messages(tmp_path):
+    """bf16 message state survives the .npz round-trip: np.savez
+    stores ml_dtypes arrays as raw void records, and the loader
+    reinterprets them via the template dtype (never converts)."""
+    import __graft_entry__ as g
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_batched
+    from pydcop_tpu.ops import compile_dcop
+
+    dcop = g._make_coloring_dcop(24, degree=2, seed=3)
+    problem = compile_dcop(dcop)
+    module = load_algorithm_module("maxsum")
+    params = prepare_algo_params({"msg_dtype": "bf16"}, module.algo_params)
+    ck = str(tmp_path / "bf16.npz")
+    full = run_batched(
+        problem, module, params, rounds=16, seed=1, chunk_size=4
+    )
+    run_batched(
+        problem, module, params, rounds=8, seed=1, chunk_size=4,
+        checkpoint_path=ck,
+    )
+    resumed = run_batched(
+        problem, module, params, rounds=16, seed=1, chunk_size=4,
+        checkpoint_path=ck, resume=True,
+    )
+    assert resumed.best_cost == pytest.approx(full.best_cost, abs=1e-4)

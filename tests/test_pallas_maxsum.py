@@ -81,3 +81,67 @@ def test_factor_round_binary_shared_matches_xla(rng, d, m):
     )
     np.testing.assert_array_equal(np.asarray(r0), np.asarray(ref0))
     np.testing.assert_array_equal(np.asarray(r1), np.asarray(ref1))
+
+
+@pytest.mark.parametrize("d,m", [(3, 257), (4, 512)])
+def test_factor_round_binary_bf16_storage(rng, d, m):
+    """bf16 message refs: arithmetic runs in f32 inside the kernel, so
+    the result equals the f32 XLA phase computed on the UPCAST inputs,
+    rounded once to bf16 at the write."""
+    tab = jnp.asarray(rng.rand(d, d, m).astype(np.float32) * 10)
+    q0 = jnp.asarray(
+        rng.rand(d, m).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    q1 = jnp.asarray(
+        rng.rand(d, m).astype(np.float32)
+    ).astype(jnp.bfloat16)
+
+    q0f, q1f = q0.astype(jnp.float32), q1.astype(jnp.float32)
+    s = tab + q0f.reshape(d, 1, m) + q1f.reshape(1, d, m)
+    ref0 = jnp.min(s, axis=1) - q0f
+    ref0 = (ref0 - jnp.min(ref0, axis=0, keepdims=True)).astype(
+        jnp.bfloat16
+    )
+    ref1 = jnp.min(s, axis=0) - q1f
+    ref1 = (ref1 - jnp.min(ref1, axis=0, keepdims=True)).astype(
+        jnp.bfloat16
+    )
+
+    r0, r1 = pallas_maxsum.factor_round_binary(tab, q0, q1, interpret=True)
+    assert r0.dtype == jnp.bfloat16 and r1.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(r0.astype(jnp.float32)),
+        np.asarray(ref0.astype(jnp.float32)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(r1.astype(jnp.float32)),
+        np.asarray(ref1.astype(jnp.float32)),
+    )
+
+
+def test_q_update_bf16_storage(rng):
+    """bf16 q update: f32 math (the damping scalar's dtype), one bf16
+    rounding at the output write."""
+    d, e = 3, 500
+    be = jnp.asarray(
+        rng.rand(d, e).astype(np.float32) * 5
+    ).astype(jnp.bfloat16)
+    r = jnp.asarray(rng.rand(d, e).astype(np.float32)).astype(jnp.bfloat16)
+    q = jnp.asarray(rng.rand(d, e).astype(np.float32)).astype(jnp.bfloat16)
+    damping = 0.5
+
+    bef, rf, qf = (
+        be.astype(jnp.float32),
+        r.astype(jnp.float32),
+        q.astype(jnp.float32),
+    )
+    ref = bef - rf
+    ref = ref - jnp.min(ref, axis=0, keepdims=True)
+    ref = (damping * qf + (1.0 - damping) * ref).astype(jnp.bfloat16)
+
+    out = pallas_maxsum.q_update(be, r, q, jnp.asarray(damping), interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out.astype(jnp.float32)),
+        np.asarray(ref.astype(jnp.float32)),
+    )

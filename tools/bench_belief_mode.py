@@ -1,10 +1,21 @@
 """A/B the belief-aggregation lowering on the north-star workload.
 
-Runs 10k-var coloring Max-Sum with ``belief='auto'`` (the backend
-default) and ``belief='blockdiag'`` (one static variable-major
-permutation + block-diagonal one-hot MXU matmuls — the round-4 layout
-candidate) and prints one JSON line per mode.  On a TPU backend each
-successful measurement also lands in BENCH_TPU_LOG.jsonl.
+Runs 10k-var coloring Max-Sum across the lowering candidates and
+prints one JSON line per mode.  On a TPU backend each successful
+measurement also lands in BENCH_TPU_LOG.jsonl.
+
+Modes:
+
+- ``auto`` — the backend default (TPU slot-prefix gathers).
+- ``blockdiag`` — one static variable-major permutation +
+  block-diagonal one-hot MXU matmuls (round-4 layout candidate;
+  REJECTED on hardware 2026-07-31, kept so any future chip/Mosaic
+  generation re-opens the decision with one run).
+- ``auto`` + ``msg_dtype='bf16'`` — round-5 candidate: message arrays
+  stored/gathered in bfloat16, all arithmetic f32.  Pays iff Mosaic's
+  gather cost is per byte rather than per element
+  (tools/bench_gather.py measures the primitive directly; this is
+  the integrated end-to-end check).
 
 Usage: python tools/bench_belief_mode.py [--cpu] [--vars N]
 """
@@ -48,9 +59,14 @@ def main() -> None:
     problem = compile_dcop(dcop)
     module = load_algorithm_module("maxsum")
     platform = jax.devices()[0].platform
-    for mode in ("auto", "blockdiag"):
+    for mode, dtype in (
+        ("auto", "f32"),
+        ("blockdiag", "f32"),
+        ("auto", "bf16"),
+    ):
         params = prepare_algo_params(
-            {"damping": 0.5, "belief": mode}, module.algo_params
+            {"damping": 0.5, "belief": mode, "msg_dtype": dtype},
+            module.algo_params,
         )
         run_batched(  # warmup: XLA compile out of the window
             problem, module, params, rounds=args.chunk, seed=0,
@@ -63,8 +79,9 @@ def main() -> None:
         )
         dt = time.perf_counter() - t0
         msgs_per_sec = module.messages_per_round(problem) * r.cycles / dt
+        label = mode if dtype == "f32" else f"{mode}_{dtype}"
         out = {
-            "mode": mode,
+            "mode": label,
             "platform": platform,
             "msgs_per_sec": round(msgs_per_sec),
             "best_cost": round(float(r.best_cost), 4),
@@ -76,7 +93,7 @@ def main() -> None:
             import bench
 
             bench.append_tpu_log(
-                f"maxsum_coloring_{args.vars}_belief_{mode}",
+                f"maxsum_coloring_{args.vars}_belief_{label}",
                 msgs_per_sec,
                 best_cost=float(r.best_cost),
                 source="bench_belief_mode",

@@ -22,6 +22,26 @@ alternative executions of it:
 - ``blockdiag_mm_bf16``: same with the one-hot (and r) in bfloat16 —
   halves the incidence stream; exact for one-hot × f32-representable
   sums of ≤ 2^8 terms.
+
+Round-5 additions (VERDICT r4 next #1 — win the north star or bound
+it with a measured roofline):
+
+- ``prefix_gather``: the PRODUCTION aggregation shape — per-slot
+  gathers over the real degree-descending prefixes (~E elements
+  total, not deg·n) — so the roofline is computed from the shape the
+  round actually runs.
+- ``slot_loop_bf16`` / ``prefix_gather_bf16``: the same gathers on
+  bfloat16 operands.  If Mosaic's gather cost is per ELEMENT, these
+  tie f32 and bf16 messages buy nothing on the crossing; if per BYTE,
+  they halve it — this single measurement decides the msg_dtype
+  candidate's fate on the gather-bound phase.
+- ``lane_cumsum``: jnp.cumsum over the lane axis of [d, E] — the
+  primitive a sorted-run boundary trick would ride (segment reduce =
+  cumsum + n-element boundary gather).  Priced here so the idea can
+  be adopted/rejected from data.
+- A printed **roofline summary**: ns per gathered element from the
+  measured candidates, and the implied msgs/sec ceiling of the
+  2·E-element crossing bound at the north-star scale.
 """
 
 import os
@@ -90,6 +110,63 @@ def main():
             acc = acc + r[:, ve_j[:, p]]
         return acc
 
+    # -- round-5: the production prefix shape + dtype/cumsum probes ---
+    # realistic skewed degrees: Poisson-ish via the real `ev` tallies,
+    # variables relabeled degree-descending exactly like ops/compile.py
+    deg_of = np.bincount(ev, minlength=n)
+    order_desc = np.argsort(-deg_of, kind="stable")
+    counts_desc = deg_of[order_desc]
+    max_deg = int(counts_desc.max())
+    ve_pref = np.full((n, max_deg), E, dtype=np.int32)
+    # edge lists per original variable, placed at the degree rank
+    by_var_start = np.zeros(n + 1, dtype=np.int64)
+    by_var_start[1:] = np.cumsum(deg_of)
+    ev_sorted_edges = np.argsort(ev, kind="stable").astype(np.int32)
+    for rank, v in enumerate(order_desc):
+        c = int(deg_of[v])
+        if c:
+            ve_pref[rank, :c] = ev_sorted_edges[
+                by_var_start[v] : by_var_start[v] + c
+            ]
+    slot_counts = (ve_pref != E).sum(axis=0)
+    ve_pref_j = jnp.asarray(ve_pref)
+    pref_elems = int(slot_counts.sum())
+
+    def prefix_gather(r):
+        acc = jnp.zeros((d, n), r.dtype)
+        for p in range(max_deg):
+            n_p = int(slot_counts[p])
+            if n_p == 0:
+                break
+            g = r[:, ve_pref_j[:n_p, p]]
+            if n_p < n:
+                g = jnp.pad(g, ((0, 0), (0, n - n_p)))
+            acc = acc + g
+        return acc
+
+    r_bf = r.astype(jnp.bfloat16)
+
+    def slot_loop_bf16(r_bf):
+        acc = jnp.zeros((d, n), jnp.float32)
+        for p in range(deg):
+            acc = acc + r_bf[:, ve_j[:, p]].astype(jnp.float32)
+        return acc
+
+    def prefix_gather_bf16(r_bf):
+        acc = jnp.zeros((d, n), jnp.float32)
+        for p in range(max_deg):
+            n_p = int(slot_counts[p])
+            if n_p == 0:
+                break
+            g = r_bf[:, ve_pref_j[:n_p, p]].astype(jnp.float32)
+            if n_p < n:
+                g = jnp.pad(g, ((0, 0), (0, n - n_p)))
+            acc = acc + g
+        return acc
+
+    def lane_cumsum(r):
+        return jnp.cumsum(r, axis=1)
+
     def grouped4(r):
         acc = jnp.zeros((d, n), r.dtype)
         for p in range(0, deg, 4):
@@ -153,21 +230,52 @@ def main():
         )
         return out.reshape(d, n_blocks * BLK)
 
-    for name, fn, arg in [
-        ("slot_loop (16 x [d,n])", slot_loop, r),
-        ("grouped4  (4 x [d,4n])", grouped4, r),
-        ("flat      (1 x [d,16n])", flat, r),
-        ("rows      ([E,d] major)", rows, r_rows),
-        ("segment_sum (scatter)", seg, r),
-        ("perm_gather ([d,E] static)", perm_gather, r),
-        ("blockdiag_mm (MXU f32)", blockdiag_mm, r_vm),
-        ("blockdiag_mm (MXU bf16)", blockdiag_mm_bf16, r_vm_bf),
+    results = {}
+    for name, fn, arg, elems in [
+        ("slot_loop (16 x [d,n])", slot_loop, r, deg * n * d),
+        ("grouped4  (4 x [d,4n])", grouped4, r, deg * n * d),
+        ("flat      (1 x [d,16n])", flat, r, deg * n * d),
+        ("rows      ([E,d] major)", rows, r_rows, deg * n * d),
+        ("segment_sum (scatter)", seg, r, E * d),
+        ("perm_gather ([d,E] static)", perm_gather, r, (E + 1) * d),
+        ("blockdiag_mm (MXU f32)", blockdiag_mm, r_vm, None),
+        ("blockdiag_mm (MXU bf16)", blockdiag_mm_bf16, r_vm_bf, None),
+        ("prefix_gather (production)", prefix_gather, r, pref_elems * d),
+        ("slot_loop bf16", slot_loop_bf16, r_bf, deg * n * d),
+        ("prefix_gather bf16", prefix_gather_bf16, r_bf, pref_elems * d),
+        ("lane_cumsum ([d,E])", lane_cumsum, r, None),
     ]:
         # time as n_scan iterations inside ONE jit (launch patterns
         # match the scan-compiled round, not eager dispatch)
         print(f"{name:<26} ...", end="", flush=True)
         us = bench(scan200(fn), arg, n=1) / n_scan
+        results[name] = (us, elems)
         print(f"\r{name:<26} {us:8.1f} us/iter", flush=True)
+
+    # -- roofline summary ---------------------------------------------
+    # ns per gathered ELEMENT from the production shape, and the
+    # implied ceiling of the inherent 2-crossing round (aggregation E
+    # elements + belief_e E elements, each x d rows) at this scale.
+    us_pref, elems_pref = results["prefix_gather (production)"]
+    ns_per_elem = us_pref * 1000.0 / elems_pref
+    crossing_elems = 2 * E * d
+    floor_us = crossing_elems * ns_per_elem / 1000.0
+    ceiling = 2 * E / (floor_us / 1e6)  # 2E msgs per round
+    us_bf, _ = results["prefix_gather bf16"]
+    print()
+    print(
+        f"roofline: {ns_per_elem:.2f} ns/element (f32 production "
+        f"prefix shape, {elems_pref} elements)"
+    )
+    print(
+        f"  bf16 same shape: {us_bf * 1000.0 / elems_pref:.2f} "
+        f"ns/element ({'BYTE-bound — bf16 messages pay' if us_bf < 0.75 * us_pref else 'ELEMENT-bound — bf16 does not help the crossing'})"
+    )
+    print(
+        f"  2-crossing bound at E={E}, d={d}: {floor_us:.0f} us/round "
+        f"floor -> {ceiling:.3g} msgs/sec ceiling (gathers alone, "
+        f"everything else free)"
+    )
 
 
 if __name__ == "__main__":
