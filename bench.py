@@ -110,6 +110,28 @@ def append_tpu_log(workload: str, msgs_per_sec: float, **extra) -> None:
         pass  # logging must never break a measurement
 
 
+def _read_tpu_log() -> list:
+    """All parseable BENCH_TPU_LOG.jsonl entries, oldest first — the
+    ONE reader shared by the headline fallback (last_good_tpu) and the
+    per-row evidence block, so what counts as a valid entry can never
+    drift between them."""
+    try:
+        with open(TPU_LOG) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return []
+    entries = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            entries.append(json.loads(line))
+        except ValueError:
+            continue
+    return entries
+
+
 def last_good_tpu(workload: str | None = None) -> dict | None:
     """Latest BENCH_TPU_LOG.jsonl entry for the workload (or any).
 
@@ -118,24 +140,12 @@ def last_good_tpu(workload: str | None = None) -> dict | None:
     counts as the workload itself; other suffixed variants (e.g.
     ``_belief_blockdiag``) are different lowerings and do not.
     """
-    try:
-        with open(TPU_LOG) as f:
-            lines = f.read().splitlines()
-    except OSError:
-        return None
     aliases = (
         None
         if workload is None
         else {workload, workload + "_belief_auto"}
     )
-    for line in reversed(lines):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            entry = json.loads(line)
-        except ValueError:
-            continue
+    for entry in reversed(_read_tpu_log()):
         msgs = entry.get("msgs_per_sec")
         if not (isinstance(msgs, (int, float)) and msgs > 0):
             # only positive throughput measurements count as "good
@@ -190,20 +200,7 @@ def tpu_evidence_by_row() -> dict:
     the driver (and the judge) can see per-row staleness without
     cross-referencing footnotes.
     """
-    try:
-        with open(TPU_LOG) as f:
-            lines = f.read().splitlines()
-    except OSError:
-        lines = []
-    entries = []
-    for line in lines:
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            entries.append(json.loads(line))
-        except ValueError:
-            continue
+    entries = _read_tpu_log()
 
     def matches(w: str, keys) -> bool:
         for k in keys:

@@ -62,8 +62,20 @@ class HostDsaComputation(VariableComputation):
         self._neighbor_values: Dict[str, Any] = {}
 
     def on_start(self) -> None:
-        self.value_selection(self.random_value(self._rnd))
+        # migration restart: resume from the pre-failure value when
+        # the runtime provided one (restart_value), else random
+        self.value_selection(
+            self.initial_value_or(lambda: self.random_value(self._rnd))
+        )
         self.post_to_all_neighbors(DsaValueMessage(self.current_value))
+
+    def on_peer_restarted(self, peer: str) -> None:
+        # a migrated neighbor starts with no view of this variable —
+        # re-announce the current value to that one peer so it can
+        # evaluate its constraints again (quiescence-safe: one message,
+        # no loop: the peer only answers if it MOVES)
+        if self.current_value is not None:
+            self.post_msg(peer, DsaValueMessage(self.current_value))
 
     def _known_constraint_costs(self, value: Any):
         """Yield the cost of each constraint whose other variables'

@@ -114,6 +114,19 @@ class HostFactorComputation(DcopComputation):
             self._last_sent[v.name] = costs
             self.post_msg(v.name, MaxSumCostMessage(costs))
 
+    def on_peer_restarted(self, peer: str) -> None:
+        # a migrated variable lost this factor's last r message AND
+        # this factor's memory of what it last sent must be voided, or
+        # the change-only send gate would keep the fresh instance
+        # blind forever; its stale incoming q is dropped too
+        self._incoming.pop(peer, None)
+        self._last_sent.pop(peer, None)
+        for v in self._scope:
+            if v.name == peer:
+                costs = self._marginal_for(v)
+                self._last_sent[v.name] = costs
+                self.post_msg(v.name, MaxSumCostMessage(costs))
+
 
 class HostVariableComputation(VariableComputation):
     """One variable node: sums incoming factor costs (+ own value
@@ -149,11 +162,33 @@ class HostVariableComputation(VariableComputation):
 
     def on_start(self) -> None:
         own = self._own_costs()
-        self.value_selection(min(own, key=own.get))
+        # migration restart: resume from the pre-failure value when
+        # the runtime provided one; message flow restarts from own
+        # costs either way (messages are not part of the carried state)
+        self.value_selection(
+            self.initial_value_or(lambda: min(own, key=own.get))
+        )
         for f in self.neighbors:
             costs = _normalize(own)
             self._last_sent[f] = costs
             self.post_msg(f, MaxSumCostMessage(costs))
+
+    def on_peer_restarted(self, peer: str) -> None:
+        # re-seed a migrated factor with this variable's current q and
+        # void the stale bookkeeping for it (see the factor-side hook)
+        self._incoming.pop(peer, None)
+        self._last_sent.pop(peer, None)
+        if peer not in self.neighbors:
+            return
+        own = self._own_costs()
+        belief = {
+            val: own[val]
+            + sum(c.get(val, 0.0) for c in self._incoming.values())
+            for val in self._variable.domain
+        }
+        costs = _normalize(belief)
+        self._last_sent[peer] = costs
+        self.post_msg(peer, MaxSumCostMessage(costs))
 
     @register("maxsum_costs")
     def _on_costs(self, sender: str, msg: MaxSumCostMessage, t: float) -> None:

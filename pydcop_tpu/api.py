@@ -42,6 +42,7 @@ def solve(
     msg_log: Optional[str] = None,
     accel_agents: Optional[Sequence[str]] = None,
     distribution: Optional[Any] = None,
+    k_target: int = 0,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -107,6 +108,11 @@ def solve(
                 "nb_agents is the process count of mode='process'; "
                 f"mode={mode!r} decides its own parallelism"
             )
+        if k_target:
+            raise ValueError(
+                "k_target (replica-based migration) needs killable "
+                "agent OS processes — mode='process' only"
+            )
         from pydcop_tpu.infrastructure import solve_host
 
         # sim consults placement only for island grouping — don't
@@ -136,10 +142,16 @@ def solve(
             dcop, algo, algo_params, rounds=rounds, timeout=timeout,
             seed=seed, nb_agents=nb_agents, ui_port=ui_port,
             msg_log=msg_log, accel_agents=accel_agents,
-            distribution=distribution,
+            distribution=distribution, k_target=k_target,
         )
     if mode != "batched":
         raise ValueError(f"solve: unknown mode {mode!r}")
+    if k_target:
+        raise ValueError(
+            "k_target (replica-based migration) is a host-runtime "
+            "mode — use mode='process' (the batched engine's "
+            "resilience is engine-level: engine/dynamic.py)"
+        )
     if accel_agents:
         raise ValueError(
             "accel_agents (compiled islands) deploys through the host "
@@ -262,6 +274,7 @@ def _solve_process(
     msg_log: Optional[str] = None,
     accel_agents: Optional[Sequence[str]] = None,
     distribution=None,
+    k_target: int = 0,
 ) -> Dict[str, Any]:
     """One-call multi-process solve (reference:
     ``pydcop/infrastructure/run.py:run_local_process_dcop``): spawn
@@ -436,6 +449,7 @@ def _solve_process(
                 ui_port=ui_port, server=server,
                 accel_agents=list(accel_agents or ()),
                 distribution=dist_name, placement=placement,
+                k_target=k_target,
                 # the caller's timeout must also bound registration: a
                 # child crashing at startup must not stall a short-
                 # timeout call for the full default register window

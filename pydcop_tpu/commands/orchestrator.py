@@ -190,11 +190,12 @@ def run_cmd(args) -> int:
             run_host_orchestrator,
         )
 
-        if args.elastic or args.scenario or args.ktarget:
+        if args.elastic or args.scenario:
             raise SystemExit(
                 "orchestrator: --runtime host does not support "
-                "--elastic/--scenario/--ktarget (the SPMD runtime "
-                "carries the dynamics/resilience modes)"
+                "--elastic/--scenario (the SPMD runtime carries the "
+                "scripted-dynamics modes); --ktarget IS supported: "
+                "replica-based migration on agent death"
             )
         # algo/params usage errors fail fast and cleanly, before any
         # agent registration
@@ -234,6 +235,7 @@ def run_cmd(args) -> int:
                 placement=placement,
                 ui_port=args.uiport,
                 accel_agents=args.accel_agents,
+                k_target=args.ktarget or 0,
             )
         except PlacementError as e:  # usage errors: clean exit
             raise SystemExit(f"orchestrator: {e}")

@@ -18,6 +18,7 @@ from pydcop_tpu.infrastructure.communication import (
     CommunicationLayer,
     Messaging,
     UnknownComputation,
+    UnreachableAgent,
 )
 from pydcop_tpu.infrastructure.computations import (
     Message,
@@ -41,6 +42,9 @@ class Agent:
         on_error: Optional[Callable[[str, BaseException], None]] = None,
         discovery=None,
         msg_log=None,
+        on_unreachable: Optional[
+            Callable[[str, BaseException], None]
+        ] = None,
     ):
         if discovery is None:
             from pydcop_tpu.infrastructure.discovery import Discovery
@@ -56,6 +60,12 @@ class Agent:
         self._stop_evt = threading.Event()
         self._comps_started = threading.Event()
         self._on_error = on_error
+        # resilient runtimes (hostnet k_target) set this: a send to a
+        # dead/unknown peer is then reported here and DROPPED instead
+        # of raising into the posting computation's handler — the
+        # distributed best-effort semantics migration needs (the dead
+        # peer's computations are being re-deployed elsewhere)
+        self._on_unreachable = on_unreachable
         self._busy = False  # a handler is mid-execution
         self.activity_time = 0.0  # seconds spent handling messages
         comm.register(name, self.messaging)
@@ -73,6 +83,16 @@ class Agent:
 
     def _send(self, src_comp: str, dest_comp: str, msg: Message) -> None:
         dest_agent = self._discovery.computation_agent(dest_comp)
+        if self._on_unreachable is not None:
+            try:
+                if dest_agent is None:
+                    raise UnknownComputation(dest_comp)
+                self._comm.send_msg(
+                    dest_agent, src_comp, dest_comp, msg, MSG_ALGO
+                )
+            except (UnknownComputation, UnreachableAgent) as e:
+                self._on_unreachable(dest_agent or dest_comp, e)
+            return
         if dest_agent is None:
             raise UnknownComputation(dest_comp)
         self._comm.send_msg(dest_agent, src_comp, dest_comp, msg, MSG_ALGO)

@@ -229,6 +229,27 @@ class DcopComputation(MessagePassingComputation):
         for n in self._neighbors:
             self.post_msg(n, msg)
 
+    # -- resilience hook (replica migration, hostnet k_target) --------
+    #
+    # When a neighboring computation dies with its agent and is
+    # re-deployed on a replica holder, the fresh instance knows
+    # nothing this computation ever told it.  The runtime posts a
+    # ``_peer_restarted`` message (through the normal pump, so the
+    # hook runs on the computation thread like any handler) and
+    # algorithms override :meth:`on_peer_restarted` to re-send their
+    # current view to that one peer.  Default: no-op — an algorithm
+    # without the override still works, it just relies on its own
+    # periodic traffic to re-sync the migrated neighbor.
+
+    @register("_peer_restarted")
+    def _on_peer_restarted_msg(
+        self, sender: str, msg: Message, t: float
+    ) -> None:
+        self.on_peer_restarted(msg.content)
+
+    def on_peer_restarted(self, peer: str) -> None:  # override point
+        pass
+
     def footprint(self) -> float:
         if self.computation_def is None:
             return 1.0
@@ -247,6 +268,21 @@ class VariableComputation(DcopComputation):
         self._variable = variable
         self.current_value: Any = None
         self.value_history: List[Any] = []
+        # replica migration (hostnet k_target): the runtime sets this
+        # BEFORE start() to the variable's last orchestrator-sampled
+        # value, so a migrated computation resumes from the
+        # pre-failure assignment instead of a fresh random draw.
+        # Algorithms honor it in on_start where an initial draw exists.
+        self.restart_value: Any = None
+
+    def initial_value_or(self, default_fn) -> Any:
+        """``restart_value`` when set and in-domain, else
+        ``default_fn()`` — the one-line way for an algorithm's
+        ``on_start`` to support migration restarts."""
+        rv = self.restart_value
+        if rv is not None and rv in self._variable.domain:
+            return rv
+        return default_fn()
 
     @property
     def variable(self):

@@ -137,6 +137,22 @@ class TcpCommunicationLayer(CommunicationLayer):
             {a: (h, int(p)) for a, (h, p) in directory.items()}
         )
 
+    def forget_agent(self, name: str) -> None:
+        """Drop a dead agent: its address, and its outbound channel
+        (queued frames are discarded and backpressured senders are
+        released — they see ``UnreachableAgent``, which the resilient
+        agent loop tolerates as a send error, not a computation
+        error)."""
+        addr = self.addresses.pop(name, None)
+        if addr is None:
+            return
+        with self._lock:
+            ch = self._channels.get(addr)
+            if ch is not None:
+                ch.dead = ch.dead or "agent removed (migration)"
+                ch.frames = []
+                ch.cond.notify_all()
+
     # -- inbound --------------------------------------------------------
 
     def _accept_loop(self) -> None:
@@ -329,9 +345,29 @@ def run_host_orchestrator(
     ui_port: Optional[int] = None,
     server: Optional[socket.socket] = None,
     accel_agents: Optional[List[str]] = None,
+    k_target: int = 0,
 ) -> Dict[str, Any]:
     """Wait for ``nb_agents`` host agents, deploy, run to quiescence /
     budget / timeout, and return the assembled result dict.
+
+    ``k_target > 0`` enables k-resilience (the reference's
+    ``ResilientAgent`` + replication machinery, SURVEY §2.6): after
+    placement, ``replication.ucs_hostingcosts.replica_distribution``
+    picks ``k_target`` replica-holder agents per computation; when an
+    agent dies mid-run the orchestrator solves the reparation DCOP
+    (``replication.repair``) over the LIVE replica holders, ships the
+    orphaned computations to the chosen agents (with the variables'
+    last sampled values as restart state), updates every agent's
+    directory, and the run continues to quiescence.  A computation
+    whose replica holders are all dead is lost and fails the run.
+    After any migration the two-counter quiescence ledger is void
+    (frames sent to the dead agent can never be reconciled), so the
+    orchestrator falls back to idle + delivered-stability over a
+    doubled window — the reference has no global ledger at all.
+    An island (accel) agent's computations are re-deployed as PLAIN
+    host computations on the replica holders: the compiled pytree
+    state dies with its process, but the value restart carries the
+    assignment, which is the state that matters to the run.
 
     Placement: an explicit ``placement`` (agent → computation names,
     the ``distribute --output`` yaml's ``distribution:`` mapping), or
@@ -383,36 +419,64 @@ def run_host_orchestrator(
     addresses: Dict[str, Tuple[str, int]] = {}
 
     def _ask_all(
-        obj: Dict[str, Any], names: Optional[List[str]] = None
+        obj: Dict[str, Any],
+        names: Optional[List[str]] = None,
+        resilient: bool = False,
     ) -> Dict[str, Dict[str, Any]]:
         """Pipelined control round-trip: the request goes to EVERY
         agent before any reply is read, so a poll sweep costs one
         round-trip latency instead of n_agents of them (the round-3
-        serial loop was a quadratic-ish drag at ~100 agents)."""
+        serial loop was a quadratic-ish drag at ~100 agents).
+
+        ``resilient=True`` (k_target runs): a dead agent does not
+        abort the sweep — the surviving replies are returned and the
+        dead names land in the shared ``newly_dead`` list for the
+        caller's migration handler; reply ``error`` fields are also
+        returned (not raised) so send-errors toward a just-dead peer
+        can be tolerated instead of failing the run."""
         names = list(peers) if names is None else names
+        sent: List[str] = []
         for name in names:
             try:
                 _send(peers[name][0], obj)
+                sent.append(name)
             except OSError as e:
-                raise AgentFailureError(
-                    f"agent {name} died mid-solve ({type(e).__name__})"
-                ) from e
+                if not resilient:
+                    raise AgentFailureError(
+                        f"agent {name} died mid-solve "
+                        f"({type(e).__name__})"
+                    ) from e
+                newly_dead.append(name)
         replies: Dict[str, Dict[str, Any]] = {}
-        for name in names:
+        for name in sent:
             try:
                 reply = _recv(peers[name][1])
             except (OSError, ValueError) as e:
-                raise AgentFailureError(
-                    f"agent {name} died mid-solve ({type(e).__name__})"
-                ) from e
+                if not resilient:
+                    raise AgentFailureError(
+                        f"agent {name} died mid-solve "
+                        f"({type(e).__name__})"
+                    ) from e
+                newly_dead.append(name)
+                continue
             if reply is None:
-                raise AgentFailureError(f"agent {name} died mid-solve")
-            if reply.get("error"):
+                if not resilient:
+                    raise AgentFailureError(
+                        f"agent {name} died mid-solve"
+                    )
+                newly_dead.append(name)
+                continue
+            if reply.get("error") and not resilient:
                 raise AgentFailureError(
                     f"agent {name} failed: {reply['error']}"
                 )
             replies[name] = reply
         return replies
+
+    # agents found dead during a resilient sweep, consumed by the run
+    # loop's migration handler (duplicates possible across sweeps —
+    # consumers de-dup against `peers`)
+    newly_dead: List[str] = []
 
     try:
         while len(peers) < nb_agents:
@@ -518,6 +582,25 @@ def run_host_orchestrator(
                 f"{sorted(unknown_accel)} (registered: {agent_names})"
             )
 
+        # k-resilience: pick replica-holder agents per computation
+        # BEFORE the run (reference: replication happens at deploy
+        # time, so a failure never has to plan from scratch)
+        replica_map = None
+        if k_target > 0:
+            from pydcop_tpu.dcop.objects import AgentDef
+            from pydcop_tpu.distribution import Distribution as _Dist
+            from pydcop_tpu.replication.ucs_hostingcosts import (
+                replica_distribution,
+            )
+
+            agent_defs = {
+                a: dcop.agents[a] if a in dcop.agents else AgentDef(a)
+                for a in agent_names
+            }
+            replica_map = replica_distribution(
+                _Dist(placement), agent_defs.values(), k_target
+            )
+
         yaml_text = dcop_yaml(dcop)
         directory = {a: list(addresses[a]) for a in agent_names}
         for name, (conn, _) in peers.items():
@@ -562,10 +645,14 @@ def run_host_orchestrator(
                     f"agent {name} died at start"
                 ) from e
 
+        resilient = k_target > 0
+
         def _collect() -> Tuple[Dict[str, Any], int, int]:
             assignment: Dict[str, Any] = {}
             delivered = size = 0
-            for res in _ask_all({"type": "collect"}).values():
+            for res in _ask_all(
+                {"type": "collect"}, resilient=resilient
+            ).values():
                 assignment.update(res["values"])
                 delivered += res["delivered"]
                 size += res["size"]
@@ -578,6 +665,9 @@ def run_host_orchestrator(
         # makes the same argument).
         sign = -1.0 if dcop.objective == "max" else 1.0
         best = {"cost": float("inf"), "assignment": {}}
+        # most recent COMPLETE sample (not necessarily the best):
+        # migration restores a dead agent's variables from here
+        last_ok = {"assignment": {}}
         trace: List[float] = []
         trace_msgs: List[int] = []  # delivered count at each sample
 
@@ -598,6 +688,7 @@ def run_host_orchestrator(
             if not _complete(assignment):
                 return  # some variable has no selected value yet
             cost = dcop.solution_cost(assignment)
+            last_ok["assignment"] = assignment
             trace.append(cost)  # anytime stream (--collect_on CSVs)
             trace_msgs.append(delivered)
             if sign * cost < best["cost"]:
@@ -608,6 +699,84 @@ def run_host_orchestrator(
                     delivered, cost, sign * best["cost"],
                     values=assignment,
                 )
+
+        # -- k-resilience: replica-based migration on agent death -----
+        migrations: List[Dict[str, Any]] = []
+        ledger_void = False  # post-migration: sent/delivered ledger
+        # can never reconcile (frames to the dead peer are orphaned)
+        suspects: Dict[Tuple[str, str], float] = {}
+        dead_ever: set = set()  # every agent that has died this run:
+        # ONLY send-errors toward these are tolerable — an error whose
+        # "peer" is not a known-dead agent (e.g. an unroutable
+        # computation name) is a real fault and must fail the run
+
+        def _handle_failures() -> None:
+            nonlocal ledger_void
+            dead = sorted({d for d in newly_dead if d in peers})
+            newly_dead.clear()
+            if not dead:
+                return
+            dead_ever.update(dead)
+            from pydcop_tpu.dcop.objects import AgentDef
+            from pydcop_tpu.replication.repair import repair_placement
+
+            orphans: List[str] = []
+            for d in dead:
+                try:
+                    peers[d][0].close()
+                except OSError:
+                    pass
+                peers.pop(d)
+                addresses.pop(d, None)
+                orphans.extend(placement.pop(d, []))
+                accel_agents.discard(d)
+            if not peers:
+                raise AgentFailureError(
+                    f"all agents died (last: {dead})"
+                )
+            candidates = {
+                c: [a for a in replica_map.replicas(c) if a in peers]
+                for c in orphans
+            }
+            lost = sorted(c for c, cand in candidates.items() if not cand)
+            if lost:
+                raise AgentFailureError(
+                    f"agent(s) {dead} died and computation(s) {lost} "
+                    f"have no live replica holder (k_target={k_target})"
+                )
+            live_defs = [
+                dcop.agents[a] if a in dcop.agents else AgentDef(a)
+                for a in peers
+            ]
+            chosen = repair_placement(candidates, live_defs, seed=seed)
+            for c, a in sorted(chosen.items()):
+                placement[a].append(c)
+            init_vals = {
+                c: last_ok["assignment"][c]
+                for c in chosen
+                if c in dcop.variables and c in last_ok["assignment"]
+            }
+            msg = {
+                "type": "reconfigure",
+                "dead": dead,
+                "migrated": chosen,
+                "placement": placement,
+                "directory": {a: list(addresses[a]) for a in peers},
+                "initial_values": init_vals,
+            }
+            # phase 1: hosts GAINING computations deploy them first, so
+            # the phase-2 re-announcements from neighbors can never
+            # reach a not-yet-existing computation
+            new_hosts = sorted(set(chosen.values()))
+            _ask_all(msg, names=new_hosts, resilient=True)
+            others = [a for a in peers if a not in set(new_hosts)]
+            if others:
+                _ask_all(msg, names=others, resilient=True)
+            # a second failure DURING migration lands in newly_dead
+            # and the next sweep handles it against the updated state
+            migrations.append({"dead": dead, "moved": dict(chosen)})
+            suspects.clear()
+            ledger_void = True
 
         # run loop: poll status until quiescent / budget / timeout
         max_msgs = rounds * max(len(comp_names), 1)
@@ -620,13 +789,44 @@ def run_host_orchestrator(
             total = 0
             total_sent = 0
             all_idle = True
-            for st in _ask_all({"type": "status?"}).values():
+            replies = _ask_all({"type": "status?"}, resilient=resilient)
+            now = time.perf_counter()
+            if resilient and newly_dead:
+                _handle_failures()
+                stable, last_total = 0, -1
+                continue
+            for name, st in replies.items():
+                if st.get("error"):
+                    kind = st.get("error_kind")
+                    peer_name = st.get("error_peer")
+                    if not (resilient and kind == "send"):
+                        raise AgentFailureError(
+                            f"agent {name} failed: {st['error']}"
+                        )
+                    if peer_name not in dead_ever:
+                        # a send-error whose peer is NOT a known-dead
+                        # agent (a live peer, or an unroutable
+                        # computation name): grace window for the
+                        # control plane to notice a death, then it is
+                        # a real fault — the pre-resilience semantics
+                        first = suspects.setdefault(
+                            (name, peer_name), now
+                        )
+                        if now - first > 5.0:
+                            raise AgentFailureError(
+                                f"agent {name} send failure toward "
+                                f"{peer_name!r} (not a dead agent): "
+                                f"{st['error']}"
+                            )
+                        all_idle = False
+                    # tolerated (dead peer / in-grace): the agent's
+                    # totals still count — an agent with a sticky
+                    # tolerated error must stay VISIBLE to quiescence
                 total += st["delivered"]
                 # missing field (older agent) degrades to the old
                 # idle+stability rule instead of never quiescing
                 total_sent += st.get("sent", st["delivered"])
                 all_idle = all_idle and st["idle"]
-            now = time.perf_counter()
             if now - last_sample >= best_sample_period:
                 _sample_best(total)
                 last_sample = now
@@ -640,10 +840,22 @@ def run_host_orchestrator(
             # frame also DELIVERED (nothing in flight on any TCP
             # link), and the totals stable across 3 polls — idle +
             # stability alone can declare quiescence mid-propagation
-            # on a slow link (advisor r3, medium)
-            if all_idle and total_sent == total and total == last_total:
+            # on a slow link (advisor r3, medium).  After a migration
+            # the ledger is void (see _handle_failures), so fall back
+            # to idle + stability over a DOUBLED window.
+            if ledger_void:
+                quiesced = all_idle and total == last_total
+                need = 6
+            else:
+                quiesced = (
+                    all_idle
+                    and total_sent == total
+                    and total == last_total
+                )
+                need = 3
+            if quiesced:
                 stable += 1
-                if stable >= 3:
+                if stable >= need:
                     break
             else:
                 stable = 0
@@ -694,6 +906,9 @@ def run_host_orchestrator(
             "trace_msgs": trace_msgs,  # exact delivered count per sample
             "agents": agent_names,
             "placement": {a: sorted(c) for a, c in placement.items()},
+            # replica migrations performed (k_target resilience):
+            # [{dead: [...], moved: {comp: new_agent}}, ...]
+            "migrations": migrations,
         }
     finally:
         if ui is not None:
@@ -751,11 +966,16 @@ def run_host_agent(
 
     # handler/transport errors surface through the next status reply
     # (a dead pump or dead peer link must never masquerade as
-    # quiescence) — shared by the agent pump and the async senders
-    errors: List[str] = []
+    # quiescence) — shared by the agent pump and the async senders.
+    # Entries are (kind, peer, text): the orchestrator's resilience
+    # mode tolerates kind='send' toward a dead peer (and the
+    # reconfigure that migrates its computations purges them), while
+    # kind='comp' (a handler raised) always fails the run.
+    errors: List[Tuple[str, str, str]] = []
+    dead_peers: set = set()  # agents known dead (reconfigure msgs)
     comm = TcpCommunicationLayer(
         on_send_error=lambda dest, e: errors.append(
-            f"send to {dest}: {e!r}"
+            ("send", str(dest), f"send to {dest}: {e!r}")
         )
     )
     _send(
@@ -803,9 +1023,17 @@ def run_host_agent(
         log = MessageLog(msg_log)
     agent = Agent(
         name, comm,
-        on_error=lambda comp, e: errors.append(f"{comp}: {e!r}"),
+        on_error=lambda comp, e: errors.append(
+            ("comp", str(comp), f"{comp}: {e!r}")
+        ),
         discovery=directory,
         msg_log=log,
+        # a send to a dead/unknown peer is a tolerated send-error (the
+        # peer's computations are being migrated), never a computation
+        # error that would fail the run
+        on_unreachable=lambda dest, e: errors.append(
+            ("send", str(dest), f"send to {dest}: {e!r}")
+        ),
     )
     if dep.get("accel") and hasattr(module, "build_island"):
         # compiled island: this agent's whole sub-graph runs on the
@@ -850,6 +1078,24 @@ def run_host_agent(
                 agent.start()
                 agent.start_computations()
             elif mtype == "status?":
+                # standing purge: the comm writer threads may append
+                # send-errors toward an already-migrated dead peer
+                # AFTER the reconfigure's one-shot purge (slow TCP
+                # timeout) — drop them at every report or a stale
+                # entry would mask later errors forever
+                if dead_peers:
+                    errors[:] = [
+                        e
+                        for e in errors
+                        if not (e[0] == "send" and e[1] in dead_peers)
+                    ]
+                # a computation error (handler raised) is ALWAYS
+                # fatal and must never be shadowed by a tolerable
+                # send entry that happens to sit at index 0
+                err = next(
+                    (e for e in errors if e[0] == "comp"),
+                    errors[0] if errors else None,
+                )
                 _send(
                     conn,
                     {
@@ -857,9 +1103,66 @@ def run_host_agent(
                         "idle": agent.is_idle,
                         "delivered": agent.messaging.count_msg,
                         "sent": comm.count_sent,
-                        "error": errors[0] if errors else None,
+                        "error": err[2] if err else None,
+                        "error_kind": err[0] if err else None,
+                        "error_peer": err[1] if err else None,
                     },
                 )
+            elif mtype == "reconfigure":
+                # replica migration (orchestrator k_target): deploy the
+                # computations chosen for THIS agent, re-route the
+                # migrated names, drop the dead peers, purge stale
+                # send-errors toward them, and nudge every local
+                # neighbor of a migrated computation to re-announce
+                migrated: Dict[str, str] = msg["migrated"]
+                init_vals = msg.get("initial_values", {})
+                my_new = sorted(
+                    c for c, a in migrated.items() if a == name
+                )
+                new_comps = []
+                for cname in my_new:
+                    comp = module.build_computation(
+                        ComputationDef(by_name[cname], algo_def),
+                        seed=dep["seed"],
+                    )
+                    if (
+                        isinstance(comp, VariableComputation)
+                        and cname in init_vals
+                    ):
+                        comp.restart_value = init_vals[cname]
+                    new_comps.append(comp)
+                # route the migrated names BEFORE unregistering the
+                # dead agents, so a concurrent pump send never hits
+                # an unregistration window
+                for cname, aname in migrated.items():
+                    directory.register_computation(cname, aname)
+                for d in msg["dead"]:
+                    directory.unregister_agent(d)
+                    comm.forget_agent(d)
+                dead_peers.update(msg["dead"])
+                mine.update(my_new)
+                comm.set_addresses(
+                    {a: tuple(x) for a, x in msg["directory"].items()}
+                )
+                # (stale send-errors toward dead_peers are purged at
+                # every status report — the only place they are read)
+                for comp in new_comps:
+                    agent.deploy_computation(comp)
+                    computations.append(comp)
+                    comp.start()
+                # re-announce: each LOCAL computation neighboring a
+                # migrated one re-sends its view, through the pump so
+                # the hook runs on the computation thread
+                for comp in computations:
+                    nbrs = getattr(comp, "neighbors", ())
+                    for m in migrated:
+                        if m != comp.name and m in nbrs:
+                            agent.messaging.deliver(
+                                "_system",
+                                comp.name,
+                                Message("_peer_restarted", m),
+                            )
+                _send(conn, {"type": "reconfigured", "n": len(my_new)})
             elif mtype == "collect":
                 values = {
                     c.variable.name: c.current_value

@@ -573,3 +573,116 @@ def test_host_runtime_ui_feed():
     final = events[-1]
     assert final["status"] == "finished"
     assert final["values"] == box["result"]["assignment"]
+
+
+@pytest.mark.parametrize(
+    "algo,params,k,n",
+    [
+        # DSA converges almost instantly on small local rings, so its
+        # case runs a 300-variable ring with a low move probability to
+        # guarantee the SIGKILL lands mid-solve (the UI gate below
+        # additionally proves the run was underway)
+        ("dsa", {"probability": 0.06}, 1, 300),
+        ("maxsum", {"damping": 0.5}, 2, 48),
+    ],
+)
+def test_host_runtime_sigkill_recovers_with_replicas(algo, params, k, n):
+    """k-resilience on the host runtime (VERDICT r4 next #4): a real
+    agent process is SIGKILLed mid-solve and the run RECOVERS — the
+    orchestrator solves the reparation DCOP over the live replica
+    holders, the orphaned computations migrate (with value restart),
+    neighbors re-announce through the on_peer_restarted hook, and the
+    run quiesces at the ring's optimum.  k=1 takes the single-candidate
+    fast path; k=2 exercises the reparation-DCOP spread across BOTH
+    survivors."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.infrastructure.hostnet import run_host_orchestrator
+
+    dcop = load_dcop(_ring_yaml(n).replace(
+        "agents: [" + ", ".join(f"a{i}" for i in range(n)) + "]",
+        "agents: [a1, a2, a3]",
+    ))
+    assert list(dcop.agents) == ["a1", "a2", "a3"]
+    port = 9250 + (os.getpid() % 150) + (4 if algo == "dsa" else 6)
+    uiport = port + 40
+    box = {}
+
+    def orch():
+        try:
+            box["result"] = run_host_orchestrator(
+                dcop, algo, params, nb_agents=3, port=port,
+                rounds=100_000, timeout=60, seed=2, k_target=k,
+                ui_port=uiport,
+            )
+        except Exception as e:  # surfaced by the asserts below
+            box["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=orch, daemon=True)
+    t.start()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYDCOP_TPU_PLATFORM"] = "cpu"
+    agents = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu", "agent",
+                "--names", f"a{i}", "--runtime", "host",
+                "--orchestrator", f"localhost:{port}",
+            ],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in (1, 2, 3)
+    ]
+    try:
+        # kill only once the run is DEMONSTRABLY underway (a first
+        # complete sample reached the UI feed) — killing during agent
+        # startup would just fail registration, not test recovery
+        deadline = time.monotonic() + 45
+        seen = False
+        while time.monotonic() < deadline:
+            if "error" in box or "result" in box:
+                break  # orchestrator ended early: surface it below
+            try:
+                st = _json.load(
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{uiport}/state", timeout=2
+                    )
+                )
+                if (
+                    st.get("events")
+                    or st.get("msgs")
+                    or st.get("cost") is not None
+                ):
+                    seen = True
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        assert "error" not in box, box["error"]
+        assert seen, f"run never produced a first sample ({box})"
+        agents[1].kill()  # SIGKILL a real mid-solve agent process
+        t.join(90)
+        assert not t.is_alive(), "orchestrator hung after SIGKILL"
+        assert "error" not in box, box["error"]
+        r = box["result"]
+        # RECOVERED, not failed-cleanly: quiesced at the optimum with
+        # the dead agent's computations re-hosted on survivors
+        assert r["status"] == "finished"
+        assert r["cost"] == 0.0
+        assert r["migrations"], "no migration recorded"
+        moved = r["migrations"][0]["moved"]
+        assert r["migrations"][0]["dead"] == ["a2"]
+        assert moved, "nothing migrated"
+        assert set(moved.values()) <= {"a1", "a3"}
+        # every computation is hosted by a SURVIVOR afterwards
+        assert set(r["placement"]) == {"a1", "a3"}
+    finally:
+        for proc in agents:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
