@@ -1,0 +1,93 @@
+"""Shared sub-problem construction for compiled islands.
+
+Every island (Max-Sum's, the DSA family's, MGM's) hosts one agent's
+placed variables as a compiled sub-DCOP in which each REMOTE scope
+variable is represented by one **shadow variable** ``__shadow__<name>``
+(shared across all boundary constraints that reference it).  This
+module owns that construction so the per-algorithm islands stay pure
+protocol + kernel logic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple
+
+import numpy as np
+
+SHADOW = "__shadow__{}"
+
+
+class IslandSubproblem(NamedTuple):
+    problem: Any  # CompiledProblem of the owned + shadow sub-DCOP
+    slot: Dict[str, int]  # sub-problem variable name -> slot index
+    labels: Dict[str, list]  # variable name -> domain label list
+    shadow_slot: Dict[str, int]  # REMOTE variable name -> its slot
+    remotes_of: Dict[str, List[str]]  # owned boundary var -> remotes
+    owned_names: set
+    base_unary: np.ndarray  # [n, d] unary costs (copy, mutable)
+    owned_slots: np.ndarray  # sorted i64 slots of the owned variables
+
+
+def build_subproblem(var_nodes: List[Any], dcop, name: str) -> IslandSubproblem:
+    """Compile one agent's constraints-hypergraph nodes + shadows."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.ops import compile_dcop
+
+    owned = {n.variable.name: n.variable for n in var_nodes}
+    sub = DCOP(name, objective=dcop.objective)
+    for v in owned.values():
+        sub.add_variable(v)
+    shadow_vars: Dict[str, Variable] = {}
+    shadow_real: Dict[str, str] = {}  # shadow name -> remote name
+    remotes_of: Dict[str, List[str]] = {}
+    seen_constraints: set = set()
+    for n in var_nodes:
+        vname = n.variable.name
+        remotes: set = set()
+        for c in n.constraints:
+            remotes |= {
+                d.name for d in c.dimensions if d.name not in owned
+            }
+            if c.name in seen_constraints:
+                continue
+            seen_constraints.add(c.name)
+            scope = []
+            for d in c.dimensions:
+                if d.name in owned:
+                    scope.append(d)
+                    continue
+                sname = SHADOW.format(d.name)
+                if sname not in shadow_vars:
+                    shadow_vars[sname] = Variable(sname, d.domain)
+                    shadow_real[sname] = d.name
+                    sub.add_variable(shadow_vars[sname])
+                scope.append(shadow_vars[sname])
+            sub.add_constraint(
+                NAryMatrixRelation(
+                    scope, c.as_matrix().matrix, name=c.name
+                )
+            )
+        remotes.discard(vname)
+        if remotes:
+            remotes_of[vname] = sorted(remotes)
+
+    problem = compile_dcop(sub)
+    slot = {nm: i for i, nm in enumerate(problem.var_names)}
+    labels = {
+        nm: list(problem.domain_labels[slot[nm]])
+        for nm in problem.var_names
+    }
+    return IslandSubproblem(
+        problem=problem,
+        slot=slot,
+        labels=labels,
+        shadow_slot={real: slot[s] for s, real in shadow_real.items()},
+        remotes_of=remotes_of,
+        owned_names=set(owned),
+        base_unary=np.asarray(problem.unary).copy(),
+        owned_slots=np.asarray(
+            sorted(slot[v] for v in owned), dtype=np.int64
+        ),
+    )

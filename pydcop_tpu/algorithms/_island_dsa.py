@@ -40,10 +40,11 @@ activation schedule of the same local-search semantics
 (``docs/algorithms.md``).
 
 This island is only built for DSA-family algorithms (dsa / adsa /
-dsatuto).  MGM/DBA/GDBA deliberately have no island: their gain
-phases coordinate with ALL neighbors per round, and a boundary that
-replays stale remote gains could let two adjacent variables move
-together — violating the guarantee the algorithms are built on.
+dsatuto).  MGM's gain phases coordinate with ALL neighbors per round,
+so a burst schedule that replays stale remote gains could let two
+adjacent variables move together — MGM instead uses the LOCKSTEP
+island (``_island_mgm.py``: one compiled step per global two-phase
+round), which preserves that guarantee.
 """
 
 from __future__ import annotations
@@ -53,12 +54,11 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from pydcop_tpu.algorithms._host_dsa import DsaValueMessage
+from pydcop_tpu.algorithms._island_common import SHADOW as _SHADOW
 from pydcop_tpu.infrastructure.computations import (
     VariableComputation,
     register,
 )
-
-_SHADOW = "__shadow__{}"
 
 # consecutive bursts that changed nothing (while a probability-gated
 # improving move exists) before the island stops self-re-firing: keeps
@@ -84,10 +84,7 @@ class DsaIsland:
         import jax
 
         from pydcop_tpu.algorithms import load_algorithm_module
-        from pydcop_tpu.dcop.dcop import DCOP
-        from pydcop_tpu.dcop.objects import Variable
-        from pydcop_tpu.dcop.relations import NAryMatrixRelation
-        from pydcop_tpu.ops import compile_dcop
+        from pydcop_tpu.algorithms._island_common import build_subproblem
 
         # the island steps the ACTUAL algorithm's batched kernel:
         # dsa's sweep, adsa's activation schedule, dsatuto's fixed rule
@@ -102,61 +99,15 @@ class DsaIsland:
             64 if start_rounds is None else int(start_rounds)
         )
 
-        owned = {n.variable.name: n.variable for n in var_nodes}
-        self.owned_names = set(owned)
-
-        sub = DCOP(f"dsa_island_{seed}", objective=dcop.objective)
-        for v in owned.values():
-            sub.add_variable(v)
-        shadow_vars: Dict[str, Variable] = {}
-        shadow_real: Dict[str, str] = {}  # shadow name -> remote name
-        self._remote_neighbors_of: Dict[str, List[str]] = {}
-        seen_constraints: set = set()
-        for n in var_nodes:
-            vname = n.variable.name
-            remotes: set = set()
-            for c in n.constraints:
-                remotes |= {
-                    d.name for d in c.dimensions if d.name not in owned
-                }
-                if c.name in seen_constraints:
-                    continue
-                seen_constraints.add(c.name)
-                scope = []
-                for d in c.dimensions:
-                    if d.name in owned:
-                        scope.append(d)
-                        continue
-                    sname = _SHADOW.format(d.name)
-                    if sname not in shadow_vars:
-                        shadow_vars[sname] = Variable(sname, d.domain)
-                        shadow_real[sname] = d.name
-                        sub.add_variable(shadow_vars[sname])
-                    scope.append(shadow_vars[sname])
-                sub.add_constraint(
-                    NAryMatrixRelation(
-                        scope, c.as_matrix().matrix, name=c.name
-                    )
-                )
-            remotes.discard(vname)
-            if remotes:
-                self._remote_neighbors_of[vname] = sorted(remotes)
-
-        self._problem = compile_dcop(sub)
-        p = self._problem
-        self._slot = {name: i for i, name in enumerate(p.var_names)}
-        self._labels = {
-            name: list(p.domain_labels[self._slot[name]])
-            for name in p.var_names
-        }
-        self._shadow_slot = {
-            real: self._slot[s] for s, real in shadow_real.items()
-        }
-        self._base_unary = np.asarray(p.unary).copy()
-        self._owned_slots = np.asarray(
-            sorted(self._slot[v] for v in self.owned_names),
-            dtype=np.int64,
-        )
+        sp = build_subproblem(var_nodes, dcop, f"dsa_island_{seed}")
+        self.owned_names = sp.owned_names
+        self._remote_neighbors_of = sp.remotes_of
+        self._problem = sp.problem
+        self._slot = sp.slot
+        self._labels = sp.labels
+        self._shadow_slot = sp.shadow_slot
+        self._base_unary = sp.base_unary
+        self._owned_slots = sp.owned_slots
 
         self._pin: Dict[str, int] = {}  # remote var -> pinned index
         self._heard: set = set()  # remote vars announced at least once
@@ -177,7 +128,9 @@ class DsaIsland:
         self._key = jax.random.PRNGKey(
             stable_seed(seed, "|".join(sorted(self.owned_names)))
         )
-        self._state = self._module.init_state(p, self._key, params)
+        self._state = self._module.init_state(
+            self._problem, self._key, params
+        )
         self._jit_step = jax.jit(self._make_step(), static_argnums=(3,))
 
     # -- wiring ----------------------------------------------------------
@@ -245,6 +198,16 @@ class DsaIsland:
         self._dirty = True
         if self._started and self._ready() and self._pending_fn() == 0:
             self._flush()
+
+    def peer_restarted(self, owner: str, peer: str) -> None:
+        """A migrated neighbor knows nothing this island ever said —
+        re-announce ``owner``'s current value to that one peer (a
+        quiescent island has no periodic traffic to re-sync it)."""
+        if owner not in self.owned_names:
+            return
+        values = np.asarray(self._state["values"])
+        label = self._labels[owner][int(values[self._slot[owner]])]
+        self._proxies[owner].post_msg(peer, DsaValueMessage(label))
 
     def _ready(self) -> bool:
         """Every boundary neighbor announced at least once?  Bursting
@@ -382,6 +345,9 @@ class IslandDsaProxy(VariableComputation):
     @register("dsa_tick")
     def _on_tick(self, sender: str, msg, t: float) -> None:
         self._island.tick()
+
+    def on_peer_restarted(self, peer: str) -> None:
+        self._island.peer_restarted(self.name, peer)
 
 
 def build_island(

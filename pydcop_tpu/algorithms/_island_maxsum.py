@@ -250,6 +250,16 @@ class MaxSumIsland:
         ):
             self._flush(self._rounds)
 
+    def peer_restarted(self, owner: str, peer: str) -> None:
+        """A migrated neighbor lost everything this island ever sent:
+        void the change-only send cache for that pair and re-flush, so
+        the next emit re-sends the current boundary message even at a
+        fixed point (where no periodic traffic would re-sync it)."""
+        self._last_sent.pop((owner, peer), None)
+        self._dirty = True
+        if self._flushed_once and self._pending_fn() == 0:
+            self._flush(self._rounds)
+
     # -- the compiled step ------------------------------------------------
 
     def _make_step(self):
@@ -386,6 +396,9 @@ class IslandVariableProxy(VariableComputation):
     def _on_costs(self, sender: str, msg: MaxSumCostMessage, t: float) -> None:
         self._island.receive(self.name, sender, msg.costs)
 
+    def on_peer_restarted(self, peer: str) -> None:
+        self._island.peer_restarted(self.name, peer)
+
 
 class IslandFactorProxy(DcopComputation):
     """Routing stand-in for one island-hosted factor."""
@@ -401,6 +414,9 @@ class IslandFactorProxy(DcopComputation):
     @register("maxsum_costs")
     def _on_costs(self, sender: str, msg: MaxSumCostMessage, t: float) -> None:
         self._island.receive(self.name, sender, msg.costs)
+
+    def on_peer_restarted(self, peer: str) -> None:
+        self._island.peer_restarted(self.name, peer)
 
 
 def build_island(

@@ -41,6 +41,13 @@ from pydcop_tpu.ops.costs import local_cost_sweep
 
 GRAPH_TYPE = "constraints_hypergraph"
 
+# replica migration (hostnet k_target) is safe: the host
+# computations terminate by QUIESCENCE and re-sync a migrated
+# neighbor via on_peer_restarted; phased round-barrier algorithms
+# (mgm/mgm2/dba/gdba) would deadlock at the cycle barrier instead
+# and are rejected at deploy time.
+MIGRATION_SAFE = True
+
 from pydcop_tpu.algorithms import AlgoParameterDef  # noqa: E402
 
 # the tutorial ALGORITHM is parameter-free (fixed variant A, p = 0.5);

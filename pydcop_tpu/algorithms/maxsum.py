@@ -58,6 +58,13 @@ from pydcop_tpu.ops.compile import CompiledProblem
 
 GRAPH_TYPE = "factor_graph"
 
+# replica migration (hostnet k_target) is safe: the host
+# computations terminate by QUIESCENCE and re-sync a migrated
+# neighbor via on_peer_restarted; phased round-barrier algorithms
+# (mgm/mgm2/dba/gdba) would deadlock at the cycle barrier instead
+# and are rejected at deploy time.
+MIGRATION_SAFE = True
+
 algo_params = [
     AlgoParameterDef("damping", "float", None, 0.5),
     # deterministic per-(variable, value) perturbation added to the unary

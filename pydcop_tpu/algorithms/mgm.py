@@ -116,3 +116,16 @@ def build_computation(comp_def, seed: int = 0):
     from pydcop_tpu.algorithms import _host_mgm
 
     return _host_mgm.build_computation(comp_def, seed=seed)
+
+
+def build_island(comp_defs, dcop, seed: int = 0, pending_fn=None):
+    """LOCKSTEP compiled island: one agent's placed variables step as
+    one batched sub-problem, once per GLOBAL two-phase round — the
+    only island schedule that preserves MGM's no-two-adjacent-movers
+    guarantee (``_island_mgm.py``; interior value/gain messages become
+    array ops, the per-round trajectory replays the all-host run)."""
+    from pydcop_tpu.algorithms import _island_mgm
+
+    return _island_mgm.build_island(
+        comp_defs, dcop, seed=seed, pending_fn=pending_fn
+    )

@@ -402,6 +402,17 @@ def run_host_orchestrator(
     accel_agents = set(accel_agents or ())
     if accel_agents:
         require_island_support(module, algo)
+    if k_target > 0 and not getattr(module, "MIGRATION_SAFE", False):
+        # phased round-barrier algorithms (mgm/mgm2/dba/gdba) and
+        # single-shot protocols (dpop/syncbb) would deadlock or wedge
+        # when a rebuilt computation rejoins at cycle 0: fail at
+        # deploy time, not silently mid-run
+        raise PlacementError(
+            f"{algo}: k_target migration needs a quiescence-"
+            "terminating algorithm that re-syncs migrated neighbors "
+            "(dsa/adsa/dsatuto, maxsum/amaxsum); round-barrier and "
+            "single-shot protocols would wedge at the cycle barrier"
+        )
     params = prepare_algo_params(params, module.algo_params)
     graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
         dcop
@@ -1078,23 +1089,27 @@ def run_host_agent(
                 agent.start()
                 agent.start_computations()
             elif mtype == "status?":
-                # standing purge: the comm writer threads may append
-                # send-errors toward an already-migrated dead peer
-                # AFTER the reconfigure's one-shot purge (slow TCP
-                # timeout) — drop them at every report or a stale
-                # entry would mask later errors forever
-                if dead_peers:
-                    errors[:] = [
-                        e
-                        for e in errors
-                        if not (e[0] == "send" and e[1] in dead_peers)
-                    ]
-                # a computation error (handler raised) is ALWAYS
-                # fatal and must never be shadowed by a tolerable
-                # send entry that happens to sit at index 0
+                # filter at READ time over a snapshot — never rewrite
+                # the shared list (writer/pump threads append to it
+                # concurrently, and a rewrite racing an append could
+                # silently drop a fatal entry).  Send-errors toward a
+                # migrated dead peer are expected noise; a computation
+                # error (handler raised) is ALWAYS fatal and must
+                # never be shadowed by a tolerable send entry that
+                # happens to sit at index 0.
+                snap = list(errors)
                 err = next(
-                    (e for e in errors if e[0] == "comp"),
-                    errors[0] if errors else None,
+                    (e for e in snap if e[0] == "comp"),
+                    next(
+                        (
+                            e
+                            for e in snap
+                            if not (
+                                e[0] == "send" and e[1] in dead_peers
+                            )
+                        ),
+                        None,
+                    ),
                 )
                 _send(
                     conn,

@@ -686,3 +686,21 @@ def test_host_runtime_sigkill_recovers_with_replicas(algo, params, k, n):
             if proc.poll() is None:
                 proc.kill()
                 proc.communicate()
+
+
+def test_ktarget_rejects_round_barrier_algorithms():
+    """k_target migration rebuilds computations at cycle 0, which a
+    phased round-barrier protocol would drop as stale and deadlock on
+    — the orchestrator rejects the combination at deploy time."""
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.infrastructure.hostnet import (
+        PlacementError,
+        run_host_orchestrator,
+    )
+
+    dcop = load_dcop(_ring_yaml(6))
+    with pytest.raises(PlacementError, match="k_target"):
+        run_host_orchestrator(
+            dcop, "mgm", {}, nb_agents=2, port=19321, k_target=1,
+            register_timeout=5.0,
+        )

@@ -288,11 +288,12 @@ def test_solve_accel_island_in_process_runtimes(mode):
             dcop, "maxsum", mode=mode, accel_agents=["nope"],
             timeout=30,
         )
-    # and a no-island algorithm is rejected up front (mgm has none by
-    # design: its gain phase coordinates with ALL neighbors per round)
+    # and a no-island algorithm is rejected up front (dba has none:
+    # its ok?/improve phases have no lockstep island yet — mgm grew
+    # one in round 5, so it no longer serves as the negative case)
     with pytest.raises(ValueError, match="compiled-island"):
         solve(
-            dcop, "mgm", mode=mode, accel_agents=["a0"], timeout=30
+            dcop, "dba", mode=mode, accel_agents=["a0"], timeout=30
         )
 
 
@@ -654,3 +655,80 @@ def test_hostnet_accel_island(tmp_path):
         if orch.poll() is None:
             orch.kill()
             orch.communicate(timeout=30)
+
+
+def test_mgm_island_pure():
+    """Whole chain on one lockstep MGM island: the interior-convergence
+    path alone must reach the tree's proper coloring (every 1-opt
+    fixed point of a chain with 3 colors is conflict-free), with zero
+    wire messages."""
+    from pydcop_tpu.algorithms import mgm
+
+    dcop = _chain_dcop(8)
+    module, defs = _graph_and_defs(dcop, algo="mgm")
+    comps = mgm.build_island(list(defs.values()), dcop, seed=1)
+    assert {c.name for c in comps} == set(defs)
+    sent = []
+    for c in comps:
+        c.message_sender = lambda s, d, m: sent.append((s, d))
+    for c in comps:
+        c.start()
+    cost, assignment = _cost(dcop, comps)
+    assert cost == 0.0, assignment
+    assert sent == []  # no boundary — nothing may leave the island
+
+
+def test_mgm_island_lockstep_exact_parity():
+    """Half the chain on a lockstep MGM island, half as plain host
+    computations: MGM with the lexic tie-break is DETERMINISTIC, so
+    the mixed deployment must replay the all-host run exactly — the
+    same per-variable value histories, the same final assignment —
+    while the interior value/gain messages become array ops (the
+    lockstep trade: fewer wire messages, never more rounds per
+    round)."""
+    from pydcop_tpu.algorithms import mgm
+    from pydcop_tpu.infrastructure.computations import (
+        VariableComputation,
+    )
+    from pydcop_tpu.infrastructure.runtime import _run_sim
+
+    dcop = _chain_dcop(10)
+    module, defs = _graph_and_defs(dcop, algo="mgm")
+    island_names = {f"v{i}" for i in range(5)}
+
+    comps_mixed = mgm.build_island(
+        [defs[n] for n in sorted(island_names)], dcop, seed=3
+    )
+    comps_mixed += [
+        module.build_computation(defs[n], seed=3)
+        for n in sorted(set(defs) - island_names)
+    ]
+    status, delivered_mixed, _ = _run_sim(
+        comps_mixed, timeout=60, max_msgs=4_000, seed=5,
+        t0=time.perf_counter(), snapshot=lambda *a: None,
+    )
+    cost_mixed, asg_mixed = _cost(dcop, comps_mixed)
+    hist_mixed = {
+        c.name: list(c.value_history)
+        for c in comps_mixed
+        if isinstance(c, VariableComputation)
+    }
+
+    comps_host = [
+        module.build_computation(defs[n], seed=3) for n in sorted(defs)
+    ]
+    status_h, delivered_host, _ = _run_sim(
+        comps_host, timeout=60, max_msgs=8_000, seed=5,
+        t0=time.perf_counter(), snapshot=lambda *a: None,
+    )
+    cost_host, asg_host = _cost(dcop, comps_host)
+    hist_host = {
+        c.name: list(c.value_history) for c in comps_host
+    }
+
+    assert cost_mixed == cost_host == 0.0, (asg_mixed, asg_host)
+    assert asg_mixed == asg_host
+    # bit-exact trajectory: every variable changed through the same
+    # value sequence in both deployments
+    assert hist_mixed == hist_host
+    assert delivered_mixed > 0  # real boundary traffic crossed
