@@ -1,0 +1,251 @@
+"""Compiled LOCKSTEP island for DBA (Distributed Breakout).
+
+Same schedule as MGM's lockstep island (`_island_lockstep.py`): one
+compiled step of the whole sub-problem per GLOBAL two-phase round,
+preserving the no-two-adjacent-movers invariant.  DBA adds the
+breakout machinery to the phase math, following the HOST protocol's
+timing exactly (`_host_dba.py`):
+
+- *phase 0 (ok?)*: payloads are ``(value, flags)`` — the flags name
+  the constraints the sender's variable flagged at the END of the
+  previous round.  The island merges remote flags with its own
+  pending per-constraint flags and raises each flagged constraint's
+  weight ONCE, then runs the WEIGHTED candidate sweep
+  (``algorithms.dba._weighted_sweep`` — the batched kernel's own
+  formula) and records the raw per-constraint violations under the
+  pre-move assignment.  Boundary improves go out.
+- *phase 1 (improve)*: remote improves inject at the shadow slots;
+  winners move (name-rank priority).  A quasi-local minimum —
+  violated incident constraint, nobody in the closed neighborhood
+  improves — is detected with the batched formulas
+  (``has_violation & stuck``); each owned QLM variable flags its
+  violated incident constraints: interior flags become next round's
+  pending weight increases, boundary variables' flags ride the next
+  ``(value, flags)`` payload so REMOTE endpoints raise their weight
+  copies too — endpoint weight tables stay equal, exactly as the
+  host engine's merge rule keeps them.
+
+Weights only steer search; reported costs stay raw.  GDBA's
+cell-targeted increase modes (E/R/C) are NOT islanded: their flags
+address individual table cells per increase mode and the payload
+protocol differs (``_host_gdba``) — lockstep GDBA would need that
+richer flag algebra and is left to a future round.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pydcop_tpu.algorithms._common import EPS
+from pydcop_tpu.algorithms._island_lockstep import (
+    LockstepIsland,
+    LockstepProxy,
+)
+
+
+class DbaIsland(LockstepIsland):
+    """Lockstep DBA phase math over the compiled sub-problem."""
+
+    def __init__(
+        self,
+        var_nodes: List[Any],
+        dcop,
+        algo_def,
+        seed: int,
+        pending_fn: Optional[Callable[[], int]] = None,
+    ):
+        import jax
+
+        super().__init__(
+            var_nodes, dcop, algo_def, seed,
+            f"dba_island_{seed}", pending_fn=pending_fn,
+        )
+        p = self._problem
+        self._increase = float(self._params.get("increase", 1.0))
+        self._weights = np.ones(p.n_cons, dtype=np.float32)
+        self._pending = np.zeros(p.n_cons, dtype=bool)  # my QLM flags
+        self._con_idx = {nm: i for i, nm in enumerate(p.con_names)}
+        # constraint names incident to each owned variable, and the
+        # owned-slot mask for the touch rule
+        cs = np.asarray(p.con_scopes)
+        mask = np.asarray(p.con_strides) > 0
+        self._incident: Dict[str, List[int]] = {v: [] for v in self.owned_names}
+        for c in range(p.n_cons):
+            for s, real in zip(cs[c], mask[c]):
+                if real:
+                    nm = p.var_names[int(s)]
+                    if nm in self._incident:
+                        self._incident[nm].append(c)
+        owned_mask = np.zeros(p.n_vars, dtype=bool)
+        owned_mask[self._owned_slots] = True
+        self._scope_owned = owned_mask[cs] & mask  # [C, k_max]
+
+        self._improve = None
+        self._candidate = None
+        self._violated = None  # bool[C] under the pre-move assignment
+        self._jit_sweep = jax.jit(self._make_sweep())
+        self._jit_decide = jax.jit(self._make_decide())
+
+    def _make_sweep(self):
+        # the batched kernel's OWN formulas (algorithms.dba), so the
+        # island can never drift from what the parity docs promise
+        from pydcop_tpu.algorithms.dba import candidate_metrics
+
+        problem = self._problem
+
+        def sweep(values, weights):
+            return candidate_metrics(
+                problem, values, weights, problem.edge_con, None
+            )
+
+        return sweep
+
+    def _make_decide(self):
+        import jax.numpy as jnp
+
+        from pydcop_tpu.algorithms._common import strict_winner
+        from pydcop_tpu.algorithms.dba import qlm_mask
+
+        problem, prio = self._problem, self._prio
+
+        def decide(improve, candidate, values, violated):
+            win = strict_winner(problem, improve, prio) & (improve > EPS)
+            new_values = jnp.where(win, candidate, values)
+            qlm = qlm_mask(
+                problem, improve, violated, problem.edge_con, None
+            )
+            return new_values, qlm
+
+        return decide
+
+    # -- lockstep hooks --------------------------------------------------
+
+    def value_payload_of(self, got_payload: Any) -> Any:
+        return got_payload[0]  # (value, flags)
+
+    def _raise_and_sweep(self, remote_flags) -> None:
+        """The shared round opening: merge flags (mine + the remote
+        endpoints'), raise each flagged constraint's weight ONCE, run
+        the weighted sweep, record the pre-move violations."""
+        import jax.numpy as jnp
+
+        flagged = self._pending.copy()
+        for names in remote_flags:
+            for nm in names:
+                c = self._con_idx.get(nm)
+                if c is not None:
+                    flagged[c] = True
+        self._weights[flagged] += self._increase
+        self._pending = np.zeros_like(self._pending)
+        improve, candidate, violated = self._jit_sweep(
+            jnp.asarray(self._values), jnp.asarray(self._weights)
+        )
+        self._improve = np.asarray(improve).astype(np.float64)
+        self._candidate = np.asarray(candidate)
+        self._violated = np.asarray(violated)
+
+    def _owned_pending_from(self, qlm: np.ndarray) -> np.ndarray:
+        """pending[c] = violated[c] & any owned QLM endpoint of c."""
+        return self._violated & np.any(
+            qlm[np.asarray(self._problem.con_scopes)]
+            & self._scope_owned,
+            axis=1,
+        )
+
+    def phase0_complete(
+        self, got: Dict[Tuple[str, str], Any]
+    ) -> Dict[str, Any]:
+        self._raise_and_sweep(payload[1] for payload in got.values())
+        return {
+            v: float(self._improve[self._slot[v]])
+            for v in self._remotes_of
+        }
+
+    def phase1_complete(
+        self, got: Dict[Tuple[str, str], Any]
+    ) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        improve = self._improve.copy()
+        for (_v, u), payload in got.items():
+            improve[self._shadow_slot[u]] = float(payload)
+        new_values, qlm = self._jit_decide(
+            jnp.asarray(improve),
+            jnp.asarray(self._candidate),
+            jnp.asarray(self._values),
+            jnp.asarray(self._violated),
+        )
+        new_values = np.asarray(new_values)
+        qlm = np.asarray(qlm)
+        self._values[self._owned_slots] = new_values[self._owned_slots]
+        # owned QLM variables flag their violated incident constraints:
+        # interior flags feed next round's weight increase directly...
+        self._pending = self._owned_pending_from(qlm)
+        # ...and boundary variables' own flags ride the payload so the
+        # REMOTE endpoints raise their weight copies too
+        p = self._problem
+        payloads = {}
+        for v in self._remotes_of:
+            flags: List[str] = []
+            if qlm[self._slot[v]]:
+                flags = [
+                    p.con_names[c]
+                    for c in self._incident[v]
+                    if self._violated[c]
+                ]
+            payloads[v] = (
+                self._labels[v][int(self._values[self._slot[v]])],
+                flags,
+            )
+        return payloads
+
+    def next_value_payloads(self) -> Dict[str, Any]:
+        # phase-0 payloads carry (value, flags); the opening round has
+        # no flags yet (the host initial_payload is (value, []))
+        return {
+            v: (self._labels[v][int(self._values[self._slot[v]])], [])
+            for v in self._remotes_of
+        }
+
+    def interior_round(self) -> bool:
+        import jax.numpy as jnp
+
+        self._raise_and_sweep(())  # no remote endpoints exist
+        new_values, qlm = self._jit_decide(
+            jnp.asarray(self._improve, dtype=jnp.float32),
+            jnp.asarray(self._candidate),
+            jnp.asarray(self._values),
+            jnp.asarray(self._violated),
+        )
+        self._values = np.asarray(new_values)
+        self._pending = self._owned_pending_from(np.asarray(qlm))
+        # continue while anything is violated or flagged (breakout may
+        # still reshape the landscape); a violation-free assignment is
+        # a fixed point for the raw problem
+        return bool(self._violated.any() or self._pending.any())
+
+
+class IslandDbaProxy(LockstepProxy):
+    pass
+
+
+def build_island(
+    comp_defs: List[Any],
+    dcop,
+    seed: int = 0,
+    pending_fn: Optional[Callable[[], int]] = None,
+) -> List[Any]:
+    """Build ONE lockstep island + per-variable proxies for an agent's
+    placed DBA computations."""
+    if not comp_defs:
+        return []
+    island = DbaIsland(
+        [cd.node for cd in comp_defs],
+        dcop,
+        comp_defs[0].algo,
+        seed,
+        pending_fn=pending_fn,
+    )
+    return [IslandDbaProxy(cd, island) for cd in comp_defs]

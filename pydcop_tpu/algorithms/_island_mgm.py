@@ -6,30 +6,23 @@ legal for uncoordinated local search, but fatal for MGM: its monotone
 guarantee rests on "no two adjacent movers per round", enforced by the
 gain comparison, and an island replaying stale remote gains across
 extra interior rounds could let two adjacent variables move together
-(docs/islands.md).  The lockstep island keeps the guarantee intact by
-participating in the exact two-phase protocol of
-``_host_phased.PhasedComputation`` — one island round per global
-round, NO interior multiplier:
+(docs/islands.md).  The lockstep schedule (`_island_lockstep.py`)
+keeps the guarantee intact:
 
-- *phase 0 (value)*: remotes broadcast values; once every boundary
-  proxy has its remote values for the round, the island pins the
-  shadows, evaluates ALL owned variables' candidate sweeps in one
-  ``local_cost_sweep`` call, and broadcasts each boundary variable's
-  gain.
-- *phase 1 (gain)*: remote gains arrive; the island injects them at
-  the shadow slots and decides winners for all owned variables with
-  the batched ``strict_winner`` under a NAME-RANK priority, so the
-  tie-break is bit-identical to the host rule (``name < name``).
-  Winners move; the island broadcasts the new boundary values,
-  opening the next round.
+- *phase 0 (value)*: remote values pin the shadows; ONE
+  ``local_cost_sweep`` evaluates every owned variable's candidates;
+  the boundary gains go out.
+- *phase 1 (gain)*: remote gains inject at the shadow slots; the
+  batched ``strict_winner`` under the NAME-RANK priority decides all
+  owned movers at once (bit-identical tie-break to the host rule).
 
-What it buys: the interior value/gain messages (the vast majority on
-a locality placement) become array ops — wire traffic shrinks to the
-boundary — while the per-round trajectory is IDENTICAL to the
-all-host run (MGM with lexic tie-break is deterministic, asserted
-exactly by ``tests/test_island.py``).  At an equal MESSAGE budget the
-deployment therefore executes more rounds; it cannot (by design)
-run more rounds per round — that is the lockstep trade.
+What it buys: interior value/gain messages become array ops — wire
+traffic shrinks to the boundary — while the per-round trajectory is
+IDENTICAL to the all-host run (MGM with lexic tie-break is
+deterministic; ``tests/test_island.py`` asserts exact per-variable
+value-history parity).  What it cannot buy, by the invariant itself:
+an interior round multiplier — one round per round is the lockstep
+trade.
 
 Remote agents run plain ``_host_mgm`` computations and cannot tell an
 island from per-variable Python computations.
@@ -37,25 +30,19 @@ island from per-variable Python computations.
 
 from __future__ import annotations
 
-import random
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from pydcop_tpu.algorithms._common import EPS
-from pydcop_tpu.algorithms._island_common import (
-    SHADOW,
-    build_subproblem,
-)
-from pydcop_tpu.infrastructure.computations import (
-    VariableComputation,
-    register,
-    stable_seed,
+from pydcop_tpu.algorithms._island_lockstep import (
+    LockstepIsland,
+    LockstepProxy,
 )
 
 
-class MgmIsland:
-    """Shared core behind one agent's lockstep MGM island proxies."""
+class MgmIsland(LockstepIsland):
+    """Lockstep MGM phase math over the compiled sub-problem."""
 
     def __init__(
         self,
@@ -63,73 +50,18 @@ class MgmIsland:
         dcop,
         algo_def,
         seed: int,
-        pending_fn: Optional[Callable[[], int]] = None,  # unused:
-        # phases are message-counted, not drain-triggered
+        pending_fn: Optional[Callable[[], int]] = None,
     ):
         import jax
 
-        params = dict(algo_def.params)
-        self._params = params
-        start_rounds = params.get("island_start_rounds")
-        self._start_rounds = (
-            64 if start_rounds is None else int(start_rounds)
+        super().__init__(
+            var_nodes, dcop, algo_def, seed,
+            f"mgm_island_{seed}", pending_fn=pending_fn,
         )
-
-        sp = build_subproblem(var_nodes, dcop, f"mgm_island_{seed}")
-        self.owned_names = sp.owned_names
-        self._remotes_of = sp.remotes_of
-        self._problem = sp.problem
-        self._slot = sp.slot
-        self._labels = sp.labels
-        self._shadow_slot = sp.shadow_slot
-        self._owned_slots = sp.owned_slots
-
-        # name-rank priority: the host winner rule breaks exact-gain
-        # ties by variable NAME (lower wins); the batched strict_winner
-        # breaks them by HIGHER prio — so prio = -rank(real name)
-        real_name = {i: nm for nm, i in self._slot.items()}
-        for real, s in self._shadow_slot.items():
-            real_name[s] = real
-        order = sorted(real_name, key=lambda s: real_name[s])
-        prio = np.empty(self._problem.n_vars, dtype=np.float32)
-        for rank, s in enumerate(order):
-            prio[s] = -float(rank)
-        import jax.numpy as jnp
-
-        self._prio = jnp.asarray(prio)
-
-        # initial values: EXACTLY the host draw (PhasedComputation.
-        # on_start) per owned variable, so a mixed run replays the
-        # all-host run bit for bit
-        initial = params.get("initial", "random")
-        values = np.zeros(self._problem.n_vars, dtype=np.int64)
-        for node in var_nodes:
-            var = node.variable
-            labels = self._labels[var.name]
-            if initial == "declared" and var.initial_value is not None:
-                val = var.initial_value
-            else:
-                rnd = random.Random(stable_seed(seed, var.name))
-                val = var.domain[rnd.randrange(len(var.domain))]
-            values[self._slot[var.name]] = labels.index(val)
-        self._values = values  # i64[n] current indices (host-side)
-
-        # two-phase bookkeeping
-        self._cycle = 0
-        self._phase = 0
-        self._buf: Dict[Tuple[int, int], Dict[Tuple[str, str], Any]] = {}
-        self._expected = {
-            (v, u) for v, us in self._remotes_of.items() for u in us
-        }
         self._gain = None  # np[n] gains after phase 0
         self._candidate = None  # np[n] argmin candidates after phase 0
-        self._proxies: Dict[str, "IslandMgmProxy"] = {}
-        self._n_started = 0
-
         self._jit_sweep = jax.jit(self._make_sweep())
         self._jit_decide = jax.jit(self._make_decide())
-
-    # -- compiled phase math --------------------------------------------
 
     def _make_sweep(self):
         import jax.numpy as jnp
@@ -162,145 +94,54 @@ class MgmIsland:
 
         return decide
 
-    # -- wiring ---------------------------------------------------------
+    # -- lockstep hooks --------------------------------------------------
 
-    def attach(self, proxy) -> None:
-        self._proxies[proxy.name] = proxy
-
-    def node_started(self) -> None:
-        self._n_started += 1
-        if self._n_started != len(self._proxies):
-            return
-        self._publish_values()
-        if not self._shadow_slot:
-            # the whole problem lives on this island: no phases will
-            # ever fire — run the monotone batched rounds to a fixed
-            # point now (island_start_rounds; MGM cost never worsens)
-            self._converge_interior()
-            return
-        self._emit(0, self._payloads_value())
-        self._advance()  # thread mode buffers pre-start messages
-
-    # -- inbound --------------------------------------------------------
-
-    def receive(self, dest: str, sender: str, msg) -> None:
-        cycle, phase = msg.cycle, msg.phase
-        if cycle < self._cycle or (
-            cycle == self._cycle and phase < self._phase
-        ):
-            return  # stale duplicate for a completed phase
-        self._buf.setdefault((cycle, phase), {})[(dest, sender)] = (
-            msg.payload
-        )
-        self._advance()
-
-    # -- the lockstep round ---------------------------------------------
-
-    def _advance(self) -> None:
+    def phase0_complete(
+        self, got: Dict[Tuple[str, str], Any]
+    ) -> Dict[str, Any]:
         import jax.numpy as jnp
 
-        while True:
-            got = self._buf.get((self._cycle, self._phase), {})
-            if set(got) != self._expected:
-                return
-            self._buf.pop((self._cycle, self._phase), None)
-            if self._phase == 0:
-                # pin shadows at the received values, sweep ALL owned
-                # variables at once, answer with the boundary gains
-                for (v, u), payload in got.items():
-                    labels = self._labels[SHADOW.format(u)]
-                    try:
-                        self._values[self._shadow_slot[u]] = (
-                            labels.index(payload)
-                        )
-                    except ValueError:
-                        pass  # out-of-domain: keep the previous pin
-                gain, candidate = self._jit_sweep(
-                    jnp.asarray(self._values)
-                )
-                self._gain = np.asarray(gain).astype(np.float64)
-                self._candidate = np.asarray(candidate)
-                self._phase = 1
-                self._emit(1, self._payloads_gain())
-            else:
-                # inject remote gains at the shadow slots and decide
-                # winners for every owned variable in one batched rule
-                gain = self._gain.copy()
-                for (v, u), payload in got.items():
-                    gain[self._shadow_slot[u]] = float(payload)
-                new_values = np.asarray(
-                    self._jit_decide(
-                        jnp.asarray(gain),
-                        jnp.asarray(self._candidate),
-                        jnp.asarray(self._values),
-                    )
-                )
-                # moves apply to OWNED slots only (shadows change only
-                # through next round's value messages)
-                self._values[self._owned_slots] = new_values[
-                    self._owned_slots
-                ]
-                self._publish_values()
-                self._cycle += 1
-                self._phase = 0
-                self._emit(0, self._payloads_value())
-
-    def _payloads_value(self) -> Dict[str, Any]:
-        return {
-            v: self._labels[v][int(self._values[self._slot[v]])]
-            for v in self._remotes_of
-        }
-
-    def _payloads_gain(self) -> Dict[str, Any]:
+        gain, candidate = self._jit_sweep(jnp.asarray(self._values))
+        self._gain = np.asarray(gain).astype(np.float64)
+        self._candidate = np.asarray(candidate)
         return {
             v: float(self._gain[self._slot[v]])
             for v in self._remotes_of
         }
 
-    def _emit(self, phase: int, payloads: Dict[str, Any]) -> None:
-        from pydcop_tpu.algorithms._host_phased import PhaseMessage
+    def phase1_complete(
+        self, got: Dict[Tuple[str, str], Any]
+    ) -> Dict[str, Any]:
+        import jax.numpy as jnp
 
-        for v, us in self._remotes_of.items():
-            msg = PhaseMessage(self._cycle, phase, payloads[v])
-            for u in us:
-                self._proxies[v].post_msg(u, msg)
-
-    def _publish_values(self) -> None:
-        for v in self.owned_names:
-            self._proxies[v].value_selection(
-                self._labels[v][int(self._values[self._slot[v]])]
+        gain = self._gain.copy()
+        for (_v, u), payload in got.items():
+            gain[self._shadow_slot[u]] = float(payload)
+        new_values = np.asarray(
+            self._jit_decide(
+                jnp.asarray(gain),
+                jnp.asarray(self._candidate),
+                jnp.asarray(self._values),
             )
+        )
+        # moves apply to OWNED slots only (shadows change only through
+        # next round's value messages)
+        self._values[self._owned_slots] = new_values[self._owned_slots]
+        return self.next_value_payloads()
 
-    def _converge_interior(self) -> None:
-        """No-boundary island: run the batched monotone rounds once."""
-        import jax
+    def interior_round(self) -> bool:
         import jax.numpy as jnp
 
         values = jnp.asarray(self._values)
-        for _ in range(self._start_rounds):
-            gain, candidate = self._jit_sweep(values)
-            new_values = self._jit_decide(gain, candidate, values)
-            if bool(jnp.all(new_values == values)):
-                break  # 1-opt fixed point: further rounds are no-ops
-            values = new_values
-        self._values = np.asarray(values)
-        self._publish_values()
+        gain, candidate = self._jit_sweep(values)
+        new_values = self._jit_decide(gain, candidate, values)
+        changed = bool(jnp.any(new_values != values))
+        self._values = np.asarray(new_values)
+        return changed  # 1-opt fixed point: further rounds are no-ops
 
 
-class IslandMgmProxy(VariableComputation):
-    """Routing/collect stand-in for one island-hosted MGM variable."""
-
-    def __init__(self, comp_def, island: MgmIsland):
-        super().__init__(comp_def.node.variable, comp_def)
-        self._island = island
-        island.attach(self)
-
-    def on_start(self) -> None:
-        self._island.node_started()
-
-    @register("np_phase")
-    def _on_phase(self, sender: str, msg, t: float) -> None:
-        self._island.receive(self.name, sender, msg)
+class IslandMgmProxy(LockstepProxy):
+    pass
 
 
 def build_island(

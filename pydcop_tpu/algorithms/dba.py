@@ -52,6 +52,12 @@ algo_params = [
     AlgoParameterDef("initial", "str", ["declared", "random"], "random"),
     # weight added to each violated constraint at a quasi-local minimum
     AlgoParameterDef("increase", "float", None, 1.0),
+    # lockstep-island interior cap (host runtime --accel agents only,
+    # _island_dba.py): a NO-boundary island runs at most this many
+    # interior rounds at start (it early-exits when nothing is
+    # violated or flagged); boundary islands step once per global
+    # round and never consult it
+    AlgoParameterDef("island_start_rounds", "int", None, 64),
 ]
 
 
@@ -93,37 +99,42 @@ def _weighted_sweep(
     return segment_sum_edges(problem, sweeps, axis_name) + problem.unary
 
 
-def step(
+def candidate_metrics(
     problem: CompiledProblem,
-    state: Dict[str, jax.Array],
-    key: jax.Array,
-    params: Dict[str, Any],
-    axis_name: Optional[str] = None,
-) -> Dict[str, jax.Array]:
-    values, weights = state["values"], state["weights"]
-    n = problem.n_vars
-    local_con = _local_con(problem, axis_name)
-
+    values: jax.Array,
+    weights: jax.Array,
+    local_con: jax.Array,
+    axis_name: Optional[str],
+):
+    """``(improve, candidate, violated)`` for one DBA round: the
+    weighted best-move sweep plus the raw per-constraint violation
+    mask under the CURRENT assignment.  Shared by :func:`step` and
+    the lockstep island (`_island_dba.py`) so the formulas can never
+    drift between them."""
     local = _weighted_sweep(problem, values, weights, local_con, axis_name)
     current = jnp.take_along_axis(local, values[:, None], axis=1)[:, 0]
     best = jnp.min(local, axis=1)
     candidate = jnp.argmin(local, axis=1).astype(values.dtype)
     improve = current - best  # >= 0
-
-    # improve exchange: strict neighborhood winner moves
-    prio = -jnp.arange(n, dtype=jnp.float32)
-    win = strict_winner(problem, improve, prio) & (improve > EPS)
-    new_values = jnp.where(win, candidate, values)
-
-    # -- quasi-local-minimum detection + weight increase ---------------
     # raw per-constraint cost under the CURRENT assignment (shard-local)
     scope_vals = values[problem.con_scopes]
     cell = problem.con_offset + jnp.sum(
         scope_vals * problem.con_strides, axis=1
     )
     violated = problem.tables_flat[cell] > EPS  # [C_local]
+    return improve, candidate, violated
 
-    # variable has a violated incident constraint (psum across shards)
+
+def qlm_mask(
+    problem: CompiledProblem,
+    improve: jax.Array,
+    violated: jax.Array,
+    local_con: jax.Array,
+    axis_name: Optional[str],
+) -> jax.Array:
+    """bool[n_vars]: at a quasi-local minimum — a violated incident
+    constraint, and nobody in the CLOSED neighborhood improves.
+    Shared by :func:`step` and the lockstep island."""
     has_violation = (
         segment_sum_edges(
             problem,
@@ -136,7 +147,31 @@ def step(
         neighbor_gather(problem, improve, fill=-jnp.inf), axis=1
     )
     stuck = jnp.maximum(improve, nbr_improve) <= EPS
-    qlm = has_violation & stuck  # [n_vars], replicated
+    return has_violation & stuck  # [n_vars], replicated
+
+
+def step(
+    problem: CompiledProblem,
+    state: Dict[str, jax.Array],
+    key: jax.Array,
+    params: Dict[str, Any],
+    axis_name: Optional[str] = None,
+) -> Dict[str, jax.Array]:
+    values, weights = state["values"], state["weights"]
+    n = problem.n_vars
+    local_con = _local_con(problem, axis_name)
+
+    improve, candidate, violated = candidate_metrics(
+        problem, values, weights, local_con, axis_name
+    )
+
+    # improve exchange: strict neighborhood winner moves
+    prio = -jnp.arange(n, dtype=jnp.float32)
+    win = strict_winner(problem, improve, prio) & (improve > EPS)
+    new_values = jnp.where(win, candidate, values)
+
+    # -- quasi-local-minimum detection + weight increase ---------------
+    qlm = qlm_mask(problem, improve, violated, local_con, axis_name)
 
     # weight += increase on violated constraints touching a QLM
     # variable.  Gather-dual of the per-edge segment_max: a
@@ -196,3 +231,16 @@ def build_computation(comp_def, seed: int = 0):
     from pydcop_tpu.algorithms import _host_dba
 
     return _host_dba.build_computation(comp_def, seed=seed)
+
+
+def build_island(comp_defs, dcop, seed: int = 0, pending_fn=None):
+    """LOCKSTEP compiled island (one batched step per global two-phase
+    round — ``_island_dba.py``): preserves the no-two-adjacent-movers
+    invariant while interior ok?/improve messages become array ops;
+    flags ride the boundary payloads so endpoint weight copies stay
+    equal across the seam."""
+    from pydcop_tpu.algorithms import _island_dba
+
+    return _island_dba.build_island(
+        comp_defs, dcop, seed=seed, pending_fn=pending_fn
+    )

@@ -42,6 +42,12 @@ algo_params = [
     # break_mode 'lexic': deterministic index tie-break (reference
     # default); 'random': random per-round priorities instead
     AlgoParameterDef("break_mode", "str", ["lexic", "random"], "lexic"),
+    # lockstep-island interior cap (host runtime --accel agents only,
+    # _island_mgm.py): a NO-boundary island runs at most this many
+    # interior rounds at start (it early-exits at the 1-opt fixed
+    # point); boundary islands step once per global round and never
+    # consult it
+    AlgoParameterDef("island_start_rounds", "int", None, 64),
 ]
 
 

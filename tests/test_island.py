@@ -288,12 +288,12 @@ def test_solve_accel_island_in_process_runtimes(mode):
             dcop, "maxsum", mode=mode, accel_agents=["nope"],
             timeout=30,
         )
-    # and a no-island algorithm is rejected up front (dba has none:
-    # its ok?/improve phases have no lockstep island yet — mgm grew
-    # one in round 5, so it no longer serves as the negative case)
+    # and a no-island algorithm is rejected up front (gdba has
+    # none: its cell-targeted E/R/C flag algebra has no lockstep
+    # island yet — mgm and dba grew lockstep islands in round 5)
     with pytest.raises(ValueError, match="compiled-island"):
         solve(
-            dcop, "dba", mode=mode, accel_agents=["a0"], timeout=30
+            dcop, "gdba", mode=mode, accel_agents=["a0"], timeout=30
         )
 
 
@@ -732,3 +732,85 @@ def test_mgm_island_lockstep_exact_parity():
     # value sequence in both deployments
     assert hist_mixed == hist_host
     assert delivered_mixed > 0  # real boundary traffic crossed
+
+
+def test_dba_island_lockstep_exact_parity():
+    """Lockstep DBA island vs all-host: DBA with the name tie-break is
+    deterministic, so the mixed deployment must replay the all-host
+    run exactly — same per-variable value histories, same final
+    assignment — including the breakout flags crossing the island
+    seam so endpoint weight copies stay equal."""
+    from pydcop_tpu.algorithms import dba
+    from pydcop_tpu.infrastructure.computations import (
+        VariableComputation,
+    )
+    from pydcop_tpu.infrastructure.runtime import _run_sim
+
+    dcop = _chain_dcop(10)
+    module, defs = _graph_and_defs(dcop, algo="dba")
+    island_names = {f"v{i}" for i in range(5)}
+
+    comps_mixed = dba.build_island(
+        [defs[n] for n in sorted(island_names)], dcop, seed=3
+    )
+    comps_mixed += [
+        module.build_computation(defs[n], seed=3)
+        for n in sorted(set(defs) - island_names)
+    ]
+    status, delivered_mixed, _ = _run_sim(
+        comps_mixed, timeout=60, max_msgs=4_000, seed=5,
+        t0=time.perf_counter(), snapshot=lambda *a: None,
+    )
+    cost_mixed, asg_mixed = _cost(dcop, comps_mixed)
+    hist_mixed = {
+        c.name: list(c.value_history)
+        for c in comps_mixed
+        if isinstance(c, VariableComputation)
+    }
+
+    comps_host = [
+        module.build_computation(defs[n], seed=3) for n in sorted(defs)
+    ]
+    _run_sim(
+        comps_host, timeout=60, max_msgs=8_000, seed=5,
+        t0=time.perf_counter(), snapshot=lambda *a: None,
+    )
+    cost_host, asg_host = _cost(dcop, comps_host)
+    hist_host = {c.name: list(c.value_history) for c in comps_host}
+
+    assert cost_mixed == cost_host == 0.0, (asg_mixed, asg_host)
+    assert asg_mixed == asg_host
+    assert hist_mixed == hist_host
+    assert delivered_mixed > 0
+
+
+def test_dba_island_breaks_out_of_local_minimum():
+    """The breakout machinery must survive islanding: an instance MGM
+    stays stuck on (cost > 1 at its 1-opt fixed point) is solved to
+    conflict-free by the DBA island + host mix — the weight increases
+    crossing the seam are what make it possible."""
+    import __graft_entry__ as g
+    from pydcop_tpu.algorithms import dba
+    from pydcop_tpu.infrastructure import solve_host
+    from pydcop_tpu.infrastructure.runtime import _run_sim
+
+    dcop = g._make_coloring_dcop(24, degree=2, seed=3)
+    r_mgm = solve_host(dcop, "mgm", {}, mode="sim", rounds=400, timeout=30)
+    assert r_mgm["cost"] > 1.0  # the stuck instance
+
+    module, defs = _graph_and_defs(dcop, algo="dba")
+    island_names = {f"v{i}" for i in range(0, 24, 2)}  # alternating:
+    # every second variable islanded -> many boundary constraints
+    comps = dba.build_island(
+        [defs[n] for n in sorted(island_names)], dcop, seed=0
+    )
+    comps += [
+        module.build_computation(defs[n], seed=0)
+        for n in sorted(set(defs) - island_names)
+    ]
+    _run_sim(
+        comps, timeout=60, max_msgs=40_000, seed=0,
+        t0=time.perf_counter(), snapshot=lambda *a: None,
+    )
+    cost, assignment = _cost(dcop, comps)
+    assert cost < 0.5, (cost, assignment)  # broke out: conflict-free
