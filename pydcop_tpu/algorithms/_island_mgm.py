@@ -60,6 +60,8 @@ class MgmIsland(LockstepIsland):
         )
         self._gain = None  # np[n] gains after phase 0
         self._candidate = None  # np[n] argmin candidates after phase 0
+        self._values_dev = None  # device copy threaded through the
+        # no-boundary interior loop (avoids an upload per round)
         self._jit_sweep = jax.jit(self._make_sweep())
         self._jit_decide = jax.jit(self._make_decide())
 
@@ -132,10 +134,18 @@ class MgmIsland(LockstepIsland):
     def interior_round(self) -> bool:
         import jax.numpy as jnp
 
-        values = jnp.asarray(self._values)
+        values = (
+            self._values_dev
+            if self._values_dev is not None
+            else jnp.asarray(self._values)
+        )
         gain, candidate = self._jit_sweep(values)
         new_values = self._jit_decide(gain, candidate, values)
+        # the changed check forces a device sync anyway; values stay
+        # device-resident across rounds (DBA's loop inherently round-
+        # trips: its flag algebra is host-side numpy)
         changed = bool(jnp.any(new_values != values))
+        self._values_dev = new_values
         self._values = np.asarray(new_values)
         return changed  # 1-opt fixed point: further rounds are no-ops
 

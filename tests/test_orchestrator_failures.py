@@ -87,7 +87,13 @@ def test_agent_sigkill_fails_orchestrator_fast(tmp_path):
             str(yaml_file), "-a", "maxsum", "--port", str(port),
             "--nb_agents", "1", "--rounds", "200000",
             "--chunk_size", "8", "--seed", "5",
-            "--heartbeat_timeout", "30", "--abort_grace", "4",
+            # heartbeat must outlast the FIRST chunk's XLA compile on
+            # a loaded box (ci_loaded: two suite halves + contention
+            # stretched it past 30 s, and the agent was declared dead
+            # before the kill even landed); SIGKILL detection is by
+            # connection EOF, not heartbeat, so the <20 s bound below
+            # is unaffected
+            "--heartbeat_timeout", "75", "--abort_grace", "4",
             "--uiport", str(ui_port),
         ],
         env=env, cwd=str(tmp_path),
