@@ -25,11 +25,9 @@ timing exactly (`_host_dba.py`):
   copies too — endpoint weight tables stay equal, exactly as the
   host engine's merge rule keeps them.
 
-Weights only steer search; reported costs stay raw.  GDBA's
-cell-targeted increase modes (E/R/C) are NOT islanded: their flags
-address individual table cells per increase mode and the payload
-protocol differs (``_host_gdba``) — lockstep GDBA would need that
-richer flag algebra and is left to a future round.
+Weights only steer search; reported costs stay raw.  GDBA's richer
+per-CELL flag algebra has its own lockstep island on the same
+skeleton (``_island_gdba.py``).
 """
 
 from __future__ import annotations

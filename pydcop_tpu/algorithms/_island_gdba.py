@@ -1,0 +1,358 @@
+"""Compiled LOCKSTEP island for GDBA (Generalized Distributed Breakout).
+
+Same schedule as the MGM/DBA lockstep islands
+(`_island_lockstep.py`): one compiled step of the whole sub-problem
+per GLOBAL two-phase round.  GDBA's breakout machinery is per-CELL —
+weight matrices over each constraint table, with the three
+generalization axes (modifier A/M, violation NZ/NM/MX, increase_mode
+E/R/C/T) — and its flags are ``(constraint, cells)`` lists whose
+cells are LABEL tuples in the constraint's dimension order
+(`_host_gdba.py`).  The island:
+
+- keeps one weight matrix per arity bucket (`w[k]: f32[m, d^k]`, the
+  batched state layout) and applies EVERY origin's flag list
+  additively at phase 0 — its own pending flags and the remote
+  endpoints' — through one label→cell-index mapping, so overlapping
+  masks stack exactly as in the batched kernel and endpoint weight
+  copies stay equal across the island seam;
+- runs the weighted sweep and violation detection with the batched
+  kernel's OWN formulas (``gdba.effective_metrics`` /
+  ``gdba.qlm_mask`` — shared, so the axes can never drift);
+- decides winners with the NAME-RANK priority (bit-identical to the
+  host tie-break), moves owned slots only;
+- for each owned variable at a quasi-local minimum, generates the
+  increase-mode cell lists from THAT round's assignment (E: the
+  current cell; T: the whole table; C: own axis pinned; R: co-axes
+  pinned — mirroring ``_host_gdba._mask_cells``), keeps them as its
+  pending flags, and ships the boundary variables' lists on the next
+  ``(value, flags)`` payload.
+
+Weights only steer search; reported costs stay raw.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pydcop_tpu.algorithms._common import EPS
+from pydcop_tpu.algorithms._island_lockstep import (
+    LockstepIsland,
+    LockstepProxy,
+)
+
+
+class GdbaIsland(LockstepIsland):
+    """Lockstep GDBA phase math over the compiled sub-problem."""
+
+    def __init__(
+        self,
+        var_nodes: List[Any],
+        dcop,
+        algo_def,
+        seed: int,
+        pending_fn: Optional[Callable[[], int]] = None,
+    ):
+        import jax
+
+        super().__init__(
+            var_nodes, dcop, algo_def, seed,
+            f"gdba_island_{seed}", pending_fn=pending_fn,
+        )
+        p = self._problem
+        init_w = 0.0 if self._params["modifier"] == "A" else 1.0
+        self._imode = str(self._params["increase_mode"])
+        self._weights = {
+            k: np.full(
+                (bucket.n_cons, p.d_max**k), init_w, dtype=np.float32
+            )
+            for k, bucket in sorted(p.buckets.items())
+        }
+
+        # constraint metadata: name -> (arity, bucket row, scope label
+        # lists in dimension order).  Bucket rows follow the global
+        # constraint order filtered by arity — VERIFIED against
+        # con_scopes below, so a future compile reorder fails loudly
+        # here instead of silently mis-addressing weight cells.
+        strides_np = np.asarray(p.con_strides)
+        scopes_np = np.asarray(p.con_scopes)
+        by_arity: Dict[int, int] = {}
+        self._con_meta: Dict[str, Tuple[int, int, List[List[Any]]]] = {}
+        for ci, nm in enumerate(p.con_names):
+            k = int((strides_np[ci] > 0).sum())
+            row = by_arity.get(k, 0)
+            by_arity[k] = row + 1
+            bucket_scope = np.asarray(p.buckets[k].scopes)[row]
+            assert list(bucket_scope) == list(scopes_np[ci][:k]), (
+                f"bucket row order diverged from con_names order for "
+                f"{nm!r} — the island's weight addressing would be "
+                "wrong"
+            )
+            scope_labels = [
+                self._labels[p.var_names[int(s)]] for s in bucket_scope
+            ]
+            self._con_meta[nm] = (k, row, scope_labels)
+        # incident constraint names per owned variable (flag emission)
+        self._incident: Dict[str, List[str]] = {
+            v: [] for v in self.owned_names
+        }
+        for nm, (k, row, _) in self._con_meta.items():
+            for s in np.asarray(p.buckets[k].scopes)[row]:
+                vn = p.var_names[int(s)]
+                if vn in self._incident:
+                    self._incident[vn].append(nm)
+
+        # pending flags, host format: [(cname, [cell label tuples])]
+        self._pending: List[Tuple[str, List[Tuple[Any, ...]]]] = []
+        self._improve = None
+        self._candidate = None
+        self._violated = {}  # (k, row) -> bool, pre-move assignment
+        self._jit_metrics = jax.jit(self._make_metrics())
+        self._jit_decide = jax.jit(self._make_decide())
+
+    def _make_metrics(self):
+        from pydcop_tpu.algorithms.gdba import effective_metrics
+
+        problem, params = self._problem, self._params
+
+        def metrics(values, weights):
+            improve, candidate, per_bucket, edge_violated = (
+                effective_metrics(problem, values, weights, params)
+            )
+            violated_by_k = {
+                k: per_bucket[k][2] for k in per_bucket
+            }
+            return improve, candidate, violated_by_k, edge_violated
+
+        return metrics
+
+    def _make_decide(self):
+        import jax.numpy as jnp
+
+        from pydcop_tpu.algorithms._common import strict_winner
+        from pydcop_tpu.algorithms.gdba import qlm_mask
+
+        problem, prio = self._problem, self._prio
+
+        def decide(improve, candidate, values, edge_violated):
+            win = strict_winner(problem, improve, prio) & (improve > EPS)
+            new_values = jnp.where(win, candidate, values)
+            qlm = qlm_mask(problem, improve, edge_violated)
+            return new_values, qlm
+
+        return decide
+
+    # -- flag algebra ----------------------------------------------------
+
+    def _apply_flags(self, flag_lists) -> None:
+        """Add 1 to every named cell (label tuples → flat indices)."""
+        d = self._problem.d_max
+        for cname, cells in flag_lists:
+            meta = self._con_meta.get(cname)
+            if meta is None:
+                continue
+            k, row, scope_labels = meta
+            w = self._weights[k]
+            for cell in cells:
+                cell = tuple(cell)
+                if len(cell) != k:
+                    continue
+                flat = 0
+                ok = True
+                for q, lab in enumerate(cell):
+                    try:
+                        flat += scope_labels[q].index(lab) * (
+                            d ** (k - 1 - q)
+                        )
+                    except ValueError:
+                        ok = False
+                        break
+                if ok:
+                    w[row, flat] += 1.0
+
+    def _mask_cells(
+        self, cname: str, var: str, assignment_idx: np.ndarray
+    ) -> List[Tuple[Any, ...]]:
+        """The increase-mode cells for ``var`` flagging ``cname``
+        under the round's assignment — label tuples, mirroring
+        ``_host_gdba._mask_cells``."""
+        k, row, scope_labels = self._con_meta[cname]
+        scope = np.asarray(self._problem.buckets[k].scopes)[row]
+        cur = [
+            scope_labels[q][int(assignment_idx[int(scope[q])])]
+            for q in range(k)
+        ]
+        my_pos = [
+            q
+            for q in range(k)
+            if self._problem.var_names[int(scope[q])] == var
+        ]
+        if self._imode == "E":
+            return [tuple(cur)]
+        if self._imode == "T":
+            return list(itertools.product(*scope_labels))
+        axes: List[List[Any]] = []
+        for q in range(k):
+            if self._imode == "C":
+                # own axis pinned at the current value, co-cells free
+                axes.append([cur[q]] if q in my_pos else scope_labels[q])
+            else:  # R: own axis free, co-vars at current values
+                axes.append(scope_labels[q] if q in my_pos else [cur[q]])
+        return list(itertools.product(*axes))
+
+    # -- lockstep hooks --------------------------------------------------
+
+    def value_payload_of(self, got_payload: Any) -> Any:
+        return got_payload[0]  # (value, flags)
+
+    def phase0_complete(
+        self, got: Dict[Tuple[str, str], Any]
+    ) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        self._apply_flags(self._pending)
+        # got is keyed by (boundary proxy, sender): a remote
+        # neighboring TWO island variables delivers its broadcast
+        # payload twice — apply each SENDER's flags once, as every
+        # host endpoint does, or the additive per-cell increases
+        # double and the seam weight copies diverge
+        seen = set()
+        for (_v, u), payload in got.items():
+            if u in seen:
+                continue
+            seen.add(u)
+            self._apply_flags(payload[1])
+        self._pending = []
+        improve, candidate, violated_by_k, edge_violated = (
+            self._jit_metrics(
+                jnp.asarray(self._values),
+                {
+                    k: jnp.asarray(w)
+                    for k, w in self._weights.items()
+                },
+            )
+        )
+        self._improve = np.asarray(improve).astype(np.float64)
+        self._candidate = np.asarray(candidate)
+        self._edge_violated = edge_violated
+        self._violated = {
+            k: np.asarray(v) for k, v in violated_by_k.items()
+        }
+        return {
+            v: float(self._improve[self._slot[v]])
+            for v in self._remotes_of
+        }
+
+    def phase1_complete(
+        self, got: Dict[Tuple[str, str], Any]
+    ) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        improve = self._improve.copy()
+        for (_v, u), payload in got.items():
+            improve[self._shadow_slot[u]] = float(payload)
+        pre_move = self._values.copy()
+        new_values, qlm = self._jit_decide(
+            jnp.asarray(improve),
+            jnp.asarray(self._candidate),
+            jnp.asarray(self._values),
+            self._edge_violated,
+        )
+        new_values = np.asarray(new_values)
+        qlm = np.asarray(qlm)
+        self._values[self._owned_slots] = new_values[self._owned_slots]
+        # each owned QLM variable flags its violated incident
+        # constraints with its increase-mode cells (the round's
+        # PRE-MOVE assignment, as the host does)
+        flags_by_var: Dict[str, List] = {}
+        for v in self.owned_names:
+            if not qlm[self._slot[v]]:
+                continue
+            entries = []
+            for cname in self._incident[v]:
+                k, row, _ = self._con_meta[cname]
+                if self._violated[k][row]:
+                    entries.append(
+                        (cname, self._mask_cells(cname, v, pre_move))
+                    )
+            if entries:
+                flags_by_var[v] = entries
+                self._pending.extend(entries)
+        payloads = {}
+        for v in self._remotes_of:
+            payloads[v] = (
+                self._labels[v][int(self._values[self._slot[v]])],
+                flags_by_var.get(v, []),
+            )
+        return payloads
+
+    def next_value_payloads(self) -> Dict[str, Any]:
+        return {
+            v: (self._labels[v][int(self._values[self._slot[v]])], [])
+            for v in self._remotes_of
+        }
+
+    def interior_round(self) -> bool:
+        import jax.numpy as jnp
+
+        self._apply_flags(self._pending)
+        self._pending = []
+        improve, candidate, violated_by_k, edge_violated = (
+            self._jit_metrics(
+                jnp.asarray(self._values),
+                {
+                    k: jnp.asarray(w)
+                    for k, w in self._weights.items()
+                },
+            )
+        )
+        self._improve = np.asarray(improve).astype(np.float64)
+        self._candidate = np.asarray(candidate)
+        self._violated = {
+            k: np.asarray(v) for k, v in violated_by_k.items()
+        }
+        pre_move = self._values.copy()
+        new_values, qlm = self._jit_decide(
+            improve, candidate, jnp.asarray(self._values), edge_violated
+        )
+        self._values = np.asarray(new_values)
+        qlm = np.asarray(qlm)
+        any_flag = False
+        for v in self.owned_names:
+            if not qlm[self._slot[v]]:
+                continue
+            for cname in self._incident[v]:
+                k, row, _ = self._con_meta[cname]
+                if self._violated[k][row]:
+                    self._pending.append(
+                        (cname, self._mask_cells(cname, v, pre_move))
+                    )
+                    any_flag = True
+        any_violated = any(v.any() for v in self._violated.values())
+        return bool(any_violated or any_flag)
+
+
+class IslandGdbaProxy(LockstepProxy):
+    pass
+
+
+def build_island(
+    comp_defs: List[Any],
+    dcop,
+    seed: int = 0,
+    pending_fn: Optional[Callable[[], int]] = None,
+) -> List[Any]:
+    """Build ONE lockstep island + per-variable proxies for an agent's
+    placed GDBA computations."""
+    if not comp_defs:
+        return []
+    island = GdbaIsland(
+        [cd.node for cd in comp_defs],
+        dcop,
+        comp_defs[0].algo,
+        seed,
+        pending_fn=pending_fn,
+    )
+    return [IslandGdbaProxy(cd, island) for cd in comp_defs]

@@ -79,18 +79,23 @@ def init_state(
     return state
 
 
-def step(
+def effective_metrics(
     problem: CompiledProblem,
-    state: Dict[str, jax.Array],
-    key: jax.Array,
+    values: jax.Array,
+    weights: Dict[int, jax.Array],
     params: Dict[str, Any],
     axis_name: Optional[str] = None,
-) -> Dict[str, jax.Array]:
-    values = state["values"]
-    n, d = problem.n_vars, problem.d_max
+):
+    """``(improve, candidate, per_bucket, edge_violated)`` for one
+    GDBA round under per-cell ``weights`` ({arity: f32[m, d^k]}):
+    the weighted candidate sweep plus per-bucket
+    ``(eff_flat, cur_cell, violated, vals)`` and the edge-projected
+    violation flags.  Shared by :func:`step` and the lockstep island
+    (`_island_gdba.py`) so the three generalization axes can never
+    drift between them."""
+    d = problem.d_max
     additive = params["modifier"] == "A"
     vmode = params["violation"]
-    imode = params["increase_mode"]
 
     # -- per-bucket: effective sweep rows + raw violation flags ---------
     per_bucket = {}  # k -> (eff_flat, cur_cell, violated, vals)
@@ -102,7 +107,7 @@ def step(
             bucket.tables.reshape(bucket.tables.shape[0], d**k),
             (m, d**k),
         )
-        w = state[f"w{k}"]
+        w = weights[k]
         eff_flat = base_flat + w if additive else base_flat * w
 
         vals = values[bucket.scopes]  # [m, k]
@@ -170,12 +175,18 @@ def step(
     best = jnp.min(local, axis=1)
     candidate = jnp.argmin(local, axis=1).astype(values.dtype)
     improve = current - best
+    return improve, candidate, per_bucket, edge_violated
 
-    prio = -jnp.arange(n, dtype=jnp.float32)
-    win = strict_winner(problem, improve, prio) & (improve > EPS)
-    new_values = jnp.where(win, candidate, values)
 
-    # -- quasi-local minimum + weight-matrix increase -------------------
+def qlm_mask(
+    problem: CompiledProblem,
+    improve: jax.Array,
+    edge_violated: jax.Array,
+    axis_name: Optional[str] = None,
+) -> jax.Array:
+    """bool[n_vars]: quasi-local minimum under the GDBA violation
+    flags (edge-projected).  Shared by :func:`step` and the lockstep
+    island."""
     has_violation = (
         segment_sum_edges(problem, edge_violated, axis_name) > 0.5
     )
@@ -183,7 +194,33 @@ def step(
         neighbor_gather(problem, improve, fill=-jnp.inf), axis=1
     )
     stuck = jnp.maximum(improve, nbr_improve) <= EPS
-    qlm = has_violation & stuck  # [n_vars], replicated
+    return has_violation & stuck  # [n_vars], replicated
+
+
+def step(
+    problem: CompiledProblem,
+    state: Dict[str, jax.Array],
+    key: jax.Array,
+    params: Dict[str, Any],
+    axis_name: Optional[str] = None,
+) -> Dict[str, jax.Array]:
+    values = state["values"]
+    n, d = problem.n_vars, problem.d_max
+    imode = params["increase_mode"]
+
+    weights = {
+        k: state[f"w{k}"] for k in sorted(problem.buckets)
+    }
+    improve, candidate, per_bucket, edge_violated = effective_metrics(
+        problem, values, weights, params, axis_name
+    )
+
+    prio = -jnp.arange(n, dtype=jnp.float32)
+    win = strict_winner(problem, improve, prio) & (improve > EPS)
+    new_values = jnp.where(win, candidate, values)
+
+    # -- quasi-local minimum + weight-matrix increase -------------------
+    qlm = qlm_mask(problem, improve, edge_violated, axis_name)
 
     new_state: Dict[str, jax.Array] = {"values": new_values}
     for k, bucket in sorted(problem.buckets.items()):
@@ -280,3 +317,16 @@ def build_computation(comp_def, seed: int = 0):
     from pydcop_tpu.algorithms import _host_gdba
 
     return _host_gdba.build_computation(comp_def, seed=seed)
+
+
+def build_island(comp_defs, dcop, seed: int = 0, pending_fn=None):
+    """LOCKSTEP compiled island (one batched step per global two-phase
+    round — ``_island_gdba.py``): per-cell weight matrices live on the
+    island, and ``(constraint, cells)`` flag lists cross the boundary
+    payloads so endpoint weight copies stay equal under every
+    modifier/violation/increase-mode combination."""
+    from pydcop_tpu.algorithms import _island_gdba
+
+    return _island_gdba.build_island(
+        comp_defs, dcop, seed=seed, pending_fn=pending_fn
+    )

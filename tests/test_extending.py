@@ -169,10 +169,10 @@ def test_solve_host_only_external_plugin_loads(tmp_path):
 
 
 def test_accel_agents_without_island_support_fails_prefork():
-    # gdba still has no island (cell-targeted E/R/C flag algebra);
-    # mgm/dba grew lockstep islands in round 5
+    # mgm2 has no island: its 5-phase offer/accept protocol has
+    # per-neighbor payloads the lockstep skeleton does not model
     with pytest.raises(ValueError, match="no compiled-island support"):
         solve(
-            ring(6, 3), "gdba", mode="process", nb_agents=2,
+            ring(6, 3), "mgm2", mode="process", nb_agents=2,
             accel_agents=["agent_0"], timeout=30,
         )
