@@ -24,7 +24,11 @@
 #              pinned to the chip, everyone else CPU processes)
 #
 # Usage: bash tools/tpu_watch.sh [max_probes] [queue...]
-#   default max_probes 70 ≈ 11 h; default queue = all stages
+#   default max_probes 70; default queue = all stages
+#   TPU_WATCH_SLEEP (seconds, default 540) sets the probe cadence —
+#   the known-good windows can be as short as ~3 minutes, so a
+#   capture campaign should run ~120 s cadence (a downed-tunnel probe
+#   HANGS to its 90 s bound, making the effective cycle ~3.5 min)
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 OUT=/tmp/tpu_watch
@@ -133,7 +137,7 @@ for i in $(seq 1 "$MAX"); do
       exit 0
     fi
   fi
-  sleep 540
+  sleep "${TPU_WATCH_SLEEP:-540}"
 done
 echo "[tpu_watch] probes exhausted; still pending: $QUEUE" \
   | tee -a "$OUT/watch.log"
