@@ -26,6 +26,10 @@ Layer map (mirrors the reference's public seams, replaces the internals):
   the reference's thread-per-agent runtime for the solve path.
 - ``pydcop_tpu.parallel``   — mesh/sharding helpers (shard_map over a
   ``jax.sharding.Mesh``, psum-combined neighbor exchange over ICI).
+- ``pydcop_tpu.faults``     — deterministic fault injection for the
+  message planes (seeded FaultPlan + ChaosCommunicationLayer wrapper;
+  ``docs/faults.md``) — the reproducibility harness behind the
+  resilience claims.
 - ``pydcop_tpu.infrastructure`` — host-side message-passing runtime
   (agents, messaging, discovery, orchestrator) for capability parity
   with the reference's dynamic/resilient runs, plus the embedding API

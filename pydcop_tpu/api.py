@@ -43,6 +43,8 @@ def solve(
     accel_agents: Optional[Sequence[str]] = None,
     distribution: Optional[Any] = None,
     k_target: int = 0,
+    chaos: Optional[str] = None,
+    chaos_seed: int = 0,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -71,6 +73,14 @@ def solve(
     ``docs/termination.md`` maps them to the reference's
     stable-message / cycle-limit semantics and defines what ``cycle``
     and ``msg_count`` mean in each.
+
+    ``chaos``/``chaos_seed`` inject deterministic message-plane faults
+    (drops, duplicates, reorders, delays, timed partitions, crash
+    schedules — ``pydcop_tpu.faults``, spec format in
+    ``docs/faults.md``) into the message-driven modes: ``'thread'``
+    wraps every agent's in-process sends, ``'process'`` ships the plan
+    to each agent OS process.  Same seed ⇒ identical fault sequence;
+    the plan is recorded in the result under ``"chaos"``.
 
     ``distribution`` (reference-parity) shapes the host runtimes'
     placement: a strategy name (``"adhoc"``, ``"heur_comhost"``, …), a
@@ -131,6 +141,7 @@ def solve(
             dcop, algo, algo_params, mode=mode, timeout=timeout,
             seed=seed, rounds=rounds, msg_log=msg_log,
             accel_agents=accel_agents, distribution=dist_obj,
+            chaos=chaos, chaos_seed=chaos_seed,
         )
     if mode == "process":
         if checkpoint_path is not None or resume or n_restarts != 1:
@@ -143,9 +154,17 @@ def solve(
             seed=seed, nb_agents=nb_agents, ui_port=ui_port,
             msg_log=msg_log, accel_agents=accel_agents,
             distribution=distribution, k_target=k_target,
+            chaos=chaos, chaos_seed=chaos_seed,
         )
     if mode != "batched":
         raise ValueError(f"solve: unknown mode {mode!r}")
+    if chaos:
+        raise ValueError(
+            "chaos fault injection targets the message planes — use "
+            "mode='thread' or 'process' (crash schedules against the "
+            "batched dynamic engine go through the `run` command's "
+            "--chaos, which scripts them as scenario events)"
+        )
     if k_target:
         raise ValueError(
             "k_target (replica-based migration) is a host-runtime "
@@ -275,6 +294,8 @@ def _solve_process(
     accel_agents: Optional[Sequence[str]] = None,
     distribution=None,
     k_target: int = 0,
+    chaos: Optional[str] = None,
+    chaos_seed: int = 0,
 ) -> Dict[str, Any]:
     """One-call multi-process solve (reference:
     ``pydcop/infrastructure/run.py:run_local_process_dcop``): spawn
@@ -296,6 +317,13 @@ def _solve_process(
     )
 
     algo_name, params_in = resolve_algo(algo, algo_params)
+
+    if chaos:
+        from pydcop_tpu.faults import FaultPlan
+
+        # fail fast on a malformed spec (FaultSpecError is a
+        # ValueError), before forking nb_agents interpreters
+        FaultPlan.from_spec(chaos, chaos_seed)
 
     # hostnet takes either a strategy NAME (computed over registered
     # agents at deploy time) or an explicit placement map; normalize
@@ -450,6 +478,7 @@ def _solve_process(
                 accel_agents=list(accel_agents or ()),
                 distribution=dist_name, placement=placement,
                 k_target=k_target,
+                chaos=chaos, chaos_seed=chaos_seed,
                 # the caller's timeout must also bound registration: a
                 # child crashing at startup must not stall a short-
                 # timeout call for the full default register window

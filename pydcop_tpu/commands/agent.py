@@ -36,6 +36,16 @@ def set_parser(subparsers) -> None:
         "per-message log; several --names get FILE.<agent> each",
     )
     p.add_argument(
+        "--chaos", default=None, metavar="SPEC",
+        help="(--runtime host) apply a local fault-injection plan to "
+        "THIS agent's outbound message plane (overrides any plan the "
+        "orchestrator ships; spec format: docs/faults.md)",
+    )
+    p.add_argument(
+        "--chaos_seed", type=int, default=0,
+        help="seed for the --chaos fault plan",
+    )
+    p.add_argument(
         "--runtime", choices=["spmd", "host"], default="spmd",
         help="must match the orchestrator's --runtime (spmd: sharded "
         "batched solve as a jax.distributed process; host: "
@@ -50,6 +60,11 @@ def run_cmd(args) -> int:
             "--msg_log records delivered message contents — only the "
             "host runtime has per-message delivery (--runtime host); "
             "the spmd runtime runs the fused batched engine"
+        )
+    if args.chaos and args.runtime != "host":
+        raise SystemExit(
+            "--chaos injects message-plane faults — only the host "
+            "runtime has a per-agent message plane (--runtime host)"
         )
     if len(args.names) > 1:
         # one OS process per agent: each is an independent
@@ -70,6 +85,14 @@ def run_cmd(args) -> int:
                     if args.msg_log
                     else []
                 )
+                + (
+                    [
+                        "--chaos", args.chaos,
+                        "--chaos_seed", str(args.chaos_seed),
+                    ]
+                    if args.chaos
+                    else []
+                )
             )
             for name in args.names
         ]
@@ -84,6 +107,7 @@ def run_cmd(args) -> int:
         result = run_host_agent(
             args.names[0], args.orchestrator, retry_for=args.retry_for,
             msg_log=args.msg_log,
+            chaos=args.chaos, chaos_seed=args.chaos_seed,
         )
         print(json.dumps(result))
         return 0

@@ -62,6 +62,13 @@ def set_parser(subparsers) -> None:
         "run is failed",
     )
     p.add_argument(
+        "--first_barrier_min", type=float, default=None,
+        help="--elastic: minimum budget (seconds) for the FIRST chunk "
+        "barrier of an epoch, which also covers jax import + cold XLA "
+        "compile on every worker (default 600, or the "
+        "PYDCOP_TPU_ELASTIC_FIRST_BARRIER_MIN env var)",
+    )
+    p.add_argument(
         "--abort_grace", type=float, default=5.0,
         help="seconds to wait for a clean unwind after a peer death "
         "before force-exiting a wedged process",
@@ -99,6 +106,26 @@ def set_parser(subparsers) -> None:
         "support in the algorithm (maxsum/amaxsum and the dsa family)",
     )
     p.add_argument(
+        "--chaos", default=None, metavar="SPEC",
+        help="--runtime host only: ship a deterministic fault-"
+        "injection plan to every agent's message plane (drop/dup/"
+        "reorder/delay probabilities, timed partitions, crash "
+        "schedules; spec format: docs/faults.md)",
+    )
+    p.add_argument(
+        "--chaos_seed", type=int, default=0,
+        help="seed for the --chaos fault plan (same seed => identical "
+        "fault sequence, recorded in the result for replay)",
+    )
+    p.add_argument(
+        "--grace_period", type=float, default=5.0,
+        help="--runtime host: transient-fault grace window (seconds) "
+        "— failed sends are retried with backoff for this long before "
+        "a link is declared dead; a permanent message-plane failure "
+        "then degrades the run to the anytime-best result "
+        "(status=degraded) instead of failing it",
+    )
+    p.add_argument(
         "--runtime", choices=["spmd", "host"], default="spmd",
         help="spmd (default): batched engine over a jax.distributed "
         "mesh, every process computes the whole sharded problem in "
@@ -127,6 +154,13 @@ def run_cmd(args) -> int:
         raise SystemExit(
             "orchestrator: --accel_agents applies to --runtime host "
             "(the SPMD runtime is all-accelerator already)"
+        )
+    if args.chaos and args.runtime != "host":
+        raise SystemExit(
+            "orchestrator: --chaos applies to --runtime host (the "
+            "SPMD runtime has no per-agent message plane; use "
+            "--elastic + real kills, or `run --chaos` for scripted "
+            "crashes on the batched engine)"
         )
     placement = None
     dist_name = None
@@ -236,6 +270,9 @@ def run_cmd(args) -> int:
                 ui_port=args.uiport,
                 accel_agents=args.accel_agents,
                 k_target=args.ktarget or 0,
+                chaos=args.chaos,
+                chaos_seed=args.chaos_seed,
+                grace_period=args.grace_period,
             )
         except PlacementError as e:  # usage errors: clean exit
             raise SystemExit(f"orchestrator: {e}")
@@ -273,6 +310,7 @@ def run_cmd(args) -> int:
             k_target=args.ktarget,
             ui_port=args.uiport,
             abort_grace=args.abort_grace,
+            first_barrier_min=args.first_barrier_min,
         )
         write_result(args, result)
         return 0

@@ -27,7 +27,20 @@ def set_parser(subparsers) -> None:
         metavar="NAME:VALUE", help="algorithm parameter (repeatable)",
     )
     p.add_argument(
-        "-s", "--scenario", required=True, help="scenario yaml file"
+        "-s", "--scenario", default=None,
+        help="scenario yaml file (or use --chaos crash schedules)",
+    )
+    p.add_argument(
+        "--chaos", default=None, metavar="SPEC",
+        help="generate the scenario from crash=AGENT@T clauses (spec "
+        "format: docs/faults.md) — each becomes a deterministic "
+        "remove_agent event at T seconds; message-plane fault clauses "
+        "are rejected here (the batched engine has no message plane)",
+    )
+    p.add_argument(
+        "--chaos_seed", type=int, default=0,
+        help="seed recorded with the --chaos plan (crash schedules "
+        "are explicit, so this only tags the replay record)",
     )
     p.add_argument(
         "-d", "--distribution", default="oneagent",
@@ -65,7 +78,67 @@ def run_cmd(args) -> int:
     dcop = load_dcop_from_file(
         args.dcop_files if len(args.dcop_files) > 1 else args.dcop_files[0]
     )
-    scenario = load_scenario_from_file(args.scenario)
+    chaos_plan = None
+    if args.chaos and args.scenario:
+        raise SystemExit(
+            "run: --scenario and --chaos are two sources of scripted "
+            "dynamics; use one"
+        )
+    if args.chaos:
+        from pydcop_tpu.dcop.scenario import (
+            EventAction,
+            Scenario,
+            ScenarioEvent,
+        )
+        from pydcop_tpu.faults import FaultPlan, FaultSpecError
+
+        try:
+            chaos_plan = FaultPlan.from_spec(args.chaos, args.chaos_seed)
+        except FaultSpecError as e:
+            raise SystemExit(f"run: {e}")
+        if chaos_plan.message_faults_configured:
+            raise SystemExit(
+                "run: the batched dynamic engine has no message plane "
+                "— only crash=AGENT@T clauses apply here; message-"
+                "plane faults (drop/dup/reorder/delay/partition) need "
+                "the host runtimes (solve --mode thread/process, "
+                "orchestrator --runtime host)"
+            )
+        if not chaos_plan.crashes:
+            raise SystemExit(
+                "run: --chaos without crash=AGENT@T clauses schedules "
+                "nothing for the batched engine"
+            )
+        unknown = set(chaos_plan.crashes) - set(dcop.agents)
+        if unknown:
+            raise SystemExit(
+                f"run: --chaos crashes unknown agent(s) "
+                f"{sorted(unknown)} (declared: {sorted(dcop.agents)})"
+            )
+        # crash schedules → deterministic remove_agent events, in
+        # (time, name) order so equal-time crashes replay identically
+        events = []
+        t_prev = 0.0
+        for name, t in sorted(
+            chaos_plan.crashes.items(), key=lambda kv: (kv[1], kv[0])
+        ):
+            if t > t_prev:
+                events.append(ScenarioEvent(delay=t - t_prev))
+                t_prev = t
+            events.append(
+                ScenarioEvent(
+                    id=f"chaos_crash_{name}",
+                    actions=[EventAction("remove_agent", agent=name)],
+                )
+            )
+        scenario = Scenario(events)
+    elif args.scenario:
+        scenario = load_scenario_from_file(args.scenario)
+    else:
+        raise SystemExit(
+            "run: a dynamics source is required — -s/--scenario FILE "
+            "or --chaos 'crash=AGENT@T,...'"
+        )
     params = parse_algo_params(args.algo_params)
     try:
         result = run_dynamic(
@@ -83,6 +156,8 @@ def run_cmd(args) -> int:
         )
     except (ValueError, ImpossibleDistributionException) as e:
         raise SystemExit(f"run: {e}")
+    if chaos_plan is not None:  # replay record: spec + seed
+        result["chaos"] = chaos_plan.to_meta()
     write_metrics(args, result)
     result.pop("cost_trace", None)
     result.pop("trace_subsampled", None)

@@ -91,6 +91,17 @@ def set_parser(subparsers) -> None:
         "(inspect with tensorboard or xprof)",
     )
     p.add_argument(
+        "--chaos", default=None, metavar="SPEC",
+        help="(thread/process modes) inject deterministic message-"
+        "plane faults: drop/dup/reorder/delay probabilities, timed "
+        "partitions, crash schedules (spec format: docs/faults.md); "
+        "same --chaos_seed => identical fault sequence",
+    )
+    p.add_argument(
+        "--chaos_seed", type=int, default=0,
+        help="seed for the --chaos fault plan (determinism/replay)",
+    )
+    p.add_argument(
         "--restarts", type=int, default=1,
         help="run this many independent solver instances batched in "
         "one device program (vmap) and report the best — parallel "
@@ -131,6 +142,8 @@ def run_cmd(args) -> int:
             msg_log=args.msg_log,
             accel_agents=args.accel_agents,
             distribution=args.distribution,
+            chaos=args.chaos,
+            chaos_seed=args.chaos_seed,
         )
     finally:
         # flush the trace even when the solve raises — a profile of a

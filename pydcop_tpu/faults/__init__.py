@@ -1,0 +1,38 @@
+"""Deterministic fault injection for the message planes.
+
+The source system's signature capability is *resilient* multi-agent
+solving — but resilience you cannot reproduce on demand is a claim,
+not a property.  This package is the robustness analogue of a perf
+harness:
+
+- :class:`~pydcop_tpu.faults.plan.FaultPlan` — a seeded, fully
+  deterministic plan: per-link drop/duplicate/reorder/delay
+  probabilities, timed link partitions with heal times, and
+  crash-agent schedules.  Same seed ⇒ byte-identical fault sequence
+  (decisions are a pure hash of ``(seed, link, message-seq)``, never
+  of wall-clock or thread timing).
+- :class:`~pydcop_tpu.faults.chaos.ChaosCommunicationLayer` — wraps
+  any :class:`~pydcop_tpu.infrastructure.communication.CommunicationLayer`
+  (in-process or TCP) and applies the plan to every outbound message.
+
+Wired through ``--chaos SPEC --chaos_seed N`` on the ``solve``,
+``run``, ``agent`` and ``orchestrator`` commands and through
+``api.solve(chaos=..., chaos_seed=...)``; the plan is recorded in the
+run's result metadata for replay.  See ``docs/faults.md``.
+"""
+
+from pydcop_tpu.faults.chaos import ChaosCommunicationLayer
+from pydcop_tpu.faults.plan import (
+    FaultPlan,
+    FaultSpecError,
+    LinkFaults,
+    Partition,
+)
+
+__all__ = [
+    "ChaosCommunicationLayer",
+    "FaultPlan",
+    "FaultSpecError",
+    "LinkFaults",
+    "Partition",
+]

@@ -1,0 +1,314 @@
+"""Seeded, fully deterministic fault plans.
+
+A :class:`FaultPlan` answers, for every outbound message on a directed
+agent link, "what happens to this one?" — and answers it identically
+on every run with the same seed: each decision is a pure hash of
+``(seed, link, per-link message sequence number, fault kind)``.  No
+wall-clock, no RNG stream shared across threads, no iteration-order
+dependence — the properties that make a fault sequence replayable.
+
+Plans are built programmatically or parsed from the compact
+``--chaos`` spec string (see :meth:`FaultPlan.from_spec`)::
+
+    drop=0.05,dup=0.02,reorder=0.1,delay=0.1:0.05,
+    a1>a2:drop=0.5,partition=a1-a2@0.5+2,crash=a3@1.5
+
+- bare ``key=value`` clauses set the DEFAULT probabilities for every
+  link; ``SRC>DST:key=value`` overrides one directed link and
+  ``A-B:key=value`` both directions;
+- ``delay=P:S`` delays a message by ``S`` seconds with probability
+  ``P``;
+- ``partition=A-B@START+DURATION`` blocks the link(s) between ``A``
+  and ``B`` (``A-*``: every link touching ``A``; ``A>B``: one
+  direction) from ``START`` seconds into the run for ``DURATION``
+  seconds — messages are HELD and released at heal time, unless the
+  outage outlives the tolerance grace window (then the link is
+  declared dead, the permanent-failure path);
+- ``crash=AGENT@T`` hard-kills the agent's process ``T`` seconds into
+  the run (the scripted analogue of SIGKILL, for exercising the
+  replication/repair machinery on demand).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``--chaos`` spec (a usage error, not a failure)."""
+
+
+@dataclass(frozen=True)
+class LinkFaults:
+    """Per-link fault probabilities (all default off)."""
+
+    drop: float = 0.0
+    dup: float = 0.0
+    reorder: float = 0.0
+    delay: float = 0.0  # probability
+    delay_s: float = 0.05  # applied delay, seconds
+
+
+@dataclass(frozen=True)
+class Partition:
+    """A timed outage between ``a`` and ``b`` (``b='*'``: every link
+    touching ``a``); ``directed`` limits it to the a→b direction."""
+
+    a: str
+    b: str
+    start: float
+    duration: float
+    directed: bool = False
+
+    @property
+    def end(self) -> float:
+        return self.start + self.duration
+
+    def covers(self, src: str, dst: str) -> bool:
+        if self.a == src and self.b in (dst, "*"):
+            return True
+        if self.directed:
+            return False
+        return self.a == dst and self.b in (src, "*")
+
+
+class Decision(NamedTuple):
+    """The fate of one message (at most one fault fires per message —
+    drop wins over dup over reorder over delay)."""
+
+    drop: bool = False
+    dup: bool = False
+    reorder: bool = False
+    delay: float = 0.0
+
+
+_CLAUSE = re.compile(
+    r"^(?:(?P<link>[^:=@]+):)?(?P<key>drop|dup|duplicate|reorder|delay)"
+    r"=(?P<val>[^=]+)$"
+)
+
+
+def _u(seed: int, link: str, seq: int, kind: str) -> float:
+    """Uniform [0, 1) from a keyed hash — the determinism core: the
+    value depends on nothing but its four arguments."""
+    h = hashlib.blake2b(
+        f"{seed}|{link}|{seq}|{kind}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(h, "big") / 2.0**64
+
+
+@dataclass
+class FaultPlan:
+    """A complete, serializable fault schedule for one run."""
+
+    seed: int = 0
+    default: LinkFaults = field(default_factory=LinkFaults)
+    links: Dict[Tuple[str, str], LinkFaults] = field(default_factory=dict)
+    partitions: List[Partition] = field(default_factory=list)
+    crashes: Dict[str, float] = field(default_factory=dict)
+    spec: Optional[str] = None  # the source text, for run metadata
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        """Parse the compact ``--chaos`` spec string (module doc)."""
+        plan = cls(seed=seed, spec=spec)
+        overrides: Dict[Tuple[str, str], Dict[str, float]] = {}
+        defaults: Dict[str, float] = {}
+        for raw in spec.split(","):
+            clause = raw.strip()
+            if not clause:
+                continue
+            if clause.startswith("partition="):
+                plan.partitions.append(_parse_partition(clause[10:]))
+                continue
+            if clause.startswith("crash="):
+                agent, t = _parse_at(clause[6:], "crash")
+                plan.crashes[agent] = t
+                continue
+            m = _CLAUSE.match(clause)
+            if not m:
+                raise FaultSpecError(
+                    f"chaos spec: cannot parse clause {clause!r} "
+                    "(expected key=value, LINK:key=value, "
+                    "partition=A-B@S+D or crash=AGENT@T)"
+                )
+            key = {"duplicate": "dup"}.get(m["key"], m["key"])
+            fields = _parse_fault_value(key, m["val"], clause)
+            if m["link"] is None:
+                defaults.update(fields)
+            else:
+                for lk in _parse_link(m["link"]):
+                    overrides.setdefault(lk, {}).update(fields)
+        plan.default = LinkFaults(**defaults)
+        for lk, fields in overrides.items():
+            plan.links[lk] = replace(plan.default, **fields)
+        plan.validate()
+        return plan
+
+    def validate(self) -> None:
+        for lf in [self.default, *self.links.values()]:
+            for name in ("drop", "dup", "reorder", "delay"):
+                p = getattr(lf, name)
+                if not 0.0 <= p <= 1.0:
+                    raise FaultSpecError(
+                        f"chaos spec: {name} probability {p} outside "
+                        "[0, 1]"
+                    )
+            if lf.delay_s < 0:
+                raise FaultSpecError(
+                    f"chaos spec: negative delay {lf.delay_s}s"
+                )
+        for p in self.partitions:
+            if p.start < 0 or p.duration <= 0:
+                raise FaultSpecError(
+                    f"chaos spec: partition window @{p.start}+"
+                    f"{p.duration} must have start >= 0, duration > 0"
+                )
+        for agent, t in self.crashes.items():
+            if t < 0:
+                raise FaultSpecError(
+                    f"chaos spec: crash={agent}@{t} in the past"
+                )
+
+    def referenced_agents(self) -> set:
+        """Every agent name the plan targets (crash schedules,
+        partition endpoints, per-link overrides; ``*`` wildcards
+        excluded).  Runtimes check these against their real roster —
+        a misspelled name would otherwise inject nothing while the
+        run still records the plan as applied."""
+        names = set(self.crashes)
+        for p in self.partitions:
+            names.add(p.a)
+            if p.b != "*":
+                names.add(p.b)
+        for src, dst in self.links:
+            names.update((src, dst))
+        return names
+
+    @property
+    def message_faults_configured(self) -> bool:
+        """True when anything beyond crash schedules is configured —
+        engines without a message plane accept crash-only plans."""
+        return bool(
+            self.partitions
+            or self.links
+            or self.default != LinkFaults()
+        )
+
+    # -- queries (all pure) ---------------------------------------------
+
+    def link_faults(self, src: str, dst: str) -> LinkFaults:
+        return self.links.get((src, dst), self.default)
+
+    def decide(self, src: str, dst: str, seq: int) -> Decision:
+        """The fate of message number ``seq`` (1-based, per directed
+        link).  Pure: (seed, link, seq) fully determine the result."""
+        lf = self.link_faults(src, dst)
+        link = f"{src}>{dst}"
+        if lf.drop and _u(self.seed, link, seq, "drop") < lf.drop:
+            return Decision(drop=True)
+        if lf.dup and _u(self.seed, link, seq, "dup") < lf.dup:
+            return Decision(dup=True)
+        if lf.reorder and _u(self.seed, link, seq, "reorder") < lf.reorder:
+            return Decision(reorder=True)
+        if lf.delay and _u(self.seed, link, seq, "delay") < lf.delay:
+            return Decision(delay=lf.delay_s)
+        return Decision()
+
+    def decisions(self, src: str, dst: str, n: int) -> List[Decision]:
+        """The first ``n`` decisions of a link — the replay/audit view
+        (two plans with equal seed+spec return identical lists)."""
+        return [self.decide(src, dst, i) for i in range(1, n + 1)]
+
+    def partition_heal(
+        self, src: str, dst: str, now: float
+    ) -> Optional[float]:
+        """If the link is partitioned at ``now`` (seconds into the
+        run), the time the LAST covering window heals; else None."""
+        ends = [
+            p.end
+            for p in self.partitions
+            if p.covers(src, dst) and p.start <= now < p.end
+        ]
+        return max(ends) if ends else None
+
+    def crash_at(self, agent: str) -> Optional[float]:
+        return self.crashes.get(agent)
+
+    def to_meta(self) -> Dict[str, object]:
+        """The replay record for run metadata: spec + seed reconstruct
+        the plan exactly (``FaultPlan.from_spec(spec, seed)``)."""
+        return {"spec": self.spec, "seed": self.seed}
+
+
+# -- spec parsing helpers ------------------------------------------------
+
+
+def _parse_fault_value(key: str, val: str, clause: str) -> Dict[str, float]:
+    try:
+        if key == "delay":
+            if ":" in val:
+                p, s = val.split(":", 1)
+                return {"delay": float(p), "delay_s": float(s)}
+            return {"delay": float(val)}
+        return {key: float(val)}
+    except ValueError:
+        raise FaultSpecError(
+            f"chaos spec: bad number in clause {clause!r}"
+        ) from None
+
+
+def _parse_link(text: str) -> List[Tuple[str, str]]:
+    if ">" in text:
+        src, dst = text.split(">", 1)
+        return [(src.strip(), dst.strip())]
+    if "-" in text:
+        a, b = (s.strip() for s in text.split("-", 1))
+        return [(a, b), (b, a)]
+    raise FaultSpecError(
+        f"chaos spec: link {text!r} must be SRC>DST or A-B"
+    )
+
+
+def _parse_partition(text: str) -> Partition:
+    try:
+        link, window = text.split("@", 1)
+        start, duration = window.split("+", 1)
+    except ValueError:
+        raise FaultSpecError(
+            f"chaos spec: partition {text!r} must be A-B@START+DURATION"
+        ) from None
+    directed = ">" in link
+    if directed:
+        a, b = link.split(">", 1)
+    elif "-" in link:
+        a, b = link.split("-", 1)
+    else:
+        raise FaultSpecError(
+            f"chaos spec: partition link {link!r} must be A-B, A>B "
+            "or A-*"
+        )
+    try:
+        return Partition(
+            a.strip(), b.strip(), float(start), float(duration),
+            directed=directed,
+        )
+    except ValueError:
+        raise FaultSpecError(
+            f"chaos spec: bad number in partition {text!r}"
+        ) from None
+
+
+def _parse_at(text: str, kind: str) -> Tuple[str, float]:
+    try:
+        name, t = text.split("@", 1)
+        return name.strip(), float(t)
+    except ValueError:
+        raise FaultSpecError(
+            f"chaos spec: {kind}={text!r} must be {kind}=NAME@SECONDS"
+        ) from None

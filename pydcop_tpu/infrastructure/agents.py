@@ -120,6 +120,11 @@ class Agent:
         for comp in self._computations.values():
             if comp.is_running:
                 comp.stop()
+        # the message log (when one is attached) is flushed — not
+        # closed: other agents may share the file — so the tail is on
+        # disk even if the process exits right after stop
+        if self.messaging.msg_log is not None:
+            self.messaging.msg_log.flush()
 
     def leave(self) -> None:
         """DEPART the system (the dynamic/resilience event): stop and

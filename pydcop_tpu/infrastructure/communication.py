@@ -118,6 +118,18 @@ class MessageLog:
         except Exception:
             pass  # a malformed message must not break delivery
 
+    def flush(self) -> None:
+        """Push buffered lines to the OS — called at agent shutdown so
+        the tail of a log survives even an abrupt process exit after
+        stop (close() also flushes, but a shared log may outlive one
+        agent's stop)."""
+        try:
+            with self._lock:
+                if not self._f.closed:
+                    self._f.flush()
+        except Exception:
+            pass
+
     def close(self) -> None:
         try:
             with self._lock:

@@ -56,12 +56,29 @@ from pydcop_tpu.infrastructure.orchestrator import (
     _send,
 )
 
-_HEARTBEAT = 120.0
+# both timing floors are env-overridable (deployment knobs that used
+# to be hardcoded): defaults unchanged, a bad value fails at import
+# with a clear message instead of deep inside a run
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a number (seconds expected)"
+        ) from None
+
+
+_HEARTBEAT = _env_float("PYDCOP_TPU_ELASTIC_HEARTBEAT", 120.0)
 
 # first barrier of an epoch additionally covers jax import +
 # compile_dcop + the cold XLA compile on every worker — give it at
 # least this much regardless of the configured heartbeat
-_FIRST_BARRIER_MIN = 600.0
+_FIRST_BARRIER_MIN = _env_float(
+    "PYDCOP_TPU_ELASTIC_FIRST_BARRIER_MIN", 600.0
+)
 
 
 def _spawn_worker(
@@ -113,10 +130,21 @@ def run_elastic_orchestrator(
     k_target: int = 0,
     ui_port: Optional[int] = None,
     abort_grace: float = 10.0,
+    first_barrier_min: Optional[float] = None,
 ) -> Dict[str, Any]:
     """Run an elastic cross-process solve; returns the result dict with
     an ``events`` log of reforms.  The run only fails outright if ALL
-    agents die or the orchestrator's own worker cannot run."""
+    agents die or the orchestrator's own worker cannot run.
+
+    ``heartbeat_timeout`` and ``first_barrier_min`` (the extra budget
+    the FIRST barrier of an epoch gets for jax import + cold XLA
+    compile) default to the module floors, themselves overridable via
+    ``PYDCOP_TPU_ELASTIC_HEARTBEAT`` /
+    ``PYDCOP_TPU_ELASTIC_FIRST_BARRIER_MIN`` — CI on slow shared
+    runners raises them, short-window tests lower them; defaults are
+    unchanged."""
+    if first_barrier_min is None:
+        first_barrier_min = _FIRST_BARRIER_MIN
     from pydcop_tpu.dcop.yamldcop import dcop_yaml as dump_yaml
     from pydcop_tpu.dcop.yamldcop import load_dcop
 
@@ -392,7 +420,7 @@ def run_elastic_orchestrator(
                 # the first barrier also covers jax import +
                 # compile_dcop + cold XLA compile on every worker
                 bd = time.monotonic() + (
-                    max(heartbeat_timeout, _FIRST_BARRIER_MIN)
+                    max(heartbeat_timeout, first_barrier_min)
                     if first_barrier
                     else heartbeat_timeout
                 )

@@ -66,14 +66,17 @@ MAX_QUEUED_FRAMES = 10_000
 class _DestChannel:
     """One destination's outbound state: pending frames, a condition
     sharing the layer lock (so only this destination's writer and
-    backpressured senders are woken), and the dead-link marker."""
+    backpressured senders are woken), the dead-link marker, and the
+    frame sequence counter (the receiver's dedupe key across
+    reconnect-resends)."""
 
-    __slots__ = ("frames", "cond", "dead")
+    __slots__ = ("frames", "cond", "dead", "seq")
 
     def __init__(self, lock: threading.Lock):
         self.frames: List[bytes] = []
         self.cond = threading.Condition(lock)
         self.dead: Optional[str] = None
+        self.seq = 0
 
 
 class TcpCommunicationLayer(CommunicationLayer):
@@ -91,9 +94,20 @@ class TcpCommunicationLayer(CommunicationLayer):
         bind_host: str = "127.0.0.1",
         port: int = 0,
         on_send_error=None,
+        retry_window: float = 5.0,
     ):
         super().__init__()
         self.addresses: Dict[str, Tuple[str, int]] = {}
+        # transient-fault tolerance: a failed connect/send is retried
+        # with exponential backoff + jitter for this many seconds (the
+        # grace window) before the link is declared dead — a short
+        # partition or peer restart is then a blip, not a run failure
+        self.retry_window = retry_window
+        # resend dedupe: highest frame seq delivered per sender id —
+        # a reconnect resends its whole batch, and replaying a frame
+        # into Messaging would double-count `delivered` and re-trigger
+        # handlers (guarded by _lock)
+        self._last_seq: Dict[str, int] = {}
         # outbound: one bounded FIFO queue + writer thread per
         # destination, so a slow or unresponsive peer (blocking
         # connect/sendall, up to 10s) only stalls ITS queue — the
@@ -123,6 +137,9 @@ class TcpCommunicationLayer(CommunicationLayer):
         self.address: Tuple[str, int] = (
             bind_host, self._server.getsockname()[1]
         )
+        # the id stamped on outbound frames ("sa"): unique per layer
+        # within a run — the receiver's dedupe namespace
+        self._sender_id = f"{self.address[0]}:{self.address[1]}"
         self._closing = False
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="hostnet-accept", daemon=True
@@ -176,6 +193,18 @@ class TcpCommunicationLayer(CommunicationLayer):
                 if not line:
                     return
                 frame = json.loads(line.decode(_ENC))
+                sender = frame.get("sa")
+                if sender is not None:
+                    # reconnect-resend dedupe: a writer that lost its
+                    # connection mid-batch resends the WHOLE batch;
+                    # frames from one sender arrive in seq order (one
+                    # writer thread, ordered TCP), so anything at or
+                    # below the high-water mark was already delivered
+                    sq = int(frame.get("sq", 0))
+                    with self._lock:
+                        if sq <= self._last_seq.get(sender, 0):
+                            continue
+                        self._last_seq[sender] = sq
                 messaging = self.discovery.get(frame["da"])
                 if messaging is None:
                     continue  # late frame for a stopped agent
@@ -212,18 +241,20 @@ class TcpCommunicationLayer(CommunicationLayer):
             raise UnreachableAgent(dest_agent)
         from pydcop_tpu.utils.simple_repr import simple_repr
 
-        frame = (
-            json.dumps(
-                {
-                    "da": dest_agent,
-                    "sc": src_comp,
-                    "dc": dest_comp,
-                    "p": priority,
-                    "m": simple_repr(msg),
-                }
-            )
-            + "\n"
-        ).encode(_ENC)
+        # serialized OUTSIDE the lock (the payload can be arbitrarily
+        # large and every destination shares this lock); only the
+        # per-channel seq is spliced in under the lock, where it is
+        # assigned — frames must enter the channel in seq order
+        prefix = json.dumps(
+            {
+                "da": dest_agent,
+                "sc": src_comp,
+                "dc": dest_comp,
+                "p": priority,
+                "m": simple_repr(msg),
+                "sa": self._sender_id,
+            }
+        )[:-1]  # strip the closing brace, "sq" is appended below
         with self._lock:
             ch = self._channels.get(addr)
             if ch is None:
@@ -245,9 +276,15 @@ class TcpCommunicationLayer(CommunicationLayer):
             if ch.dead is not None:
                 raise UnreachableAgent(f"{dest_agent}: {ch.dead}")
             # counted at ENQUEUE: a queued-but-unsent frame must keep
-            # sent > delivered so quiescence cannot fire mid-flight
+            # sent > delivered so quiescence cannot fire mid-flight.
+            # The seq is assigned under the same lock that appends, so
+            # frames enter the channel in seq order — the property the
+            # receiver's resend dedupe relies on.
             self.count_sent += 1
-            ch.frames.append(frame)
+            ch.seq += 1
+            ch.frames.append(
+                f'{prefix},"sq":{ch.seq}}}\n'.encode(_ENC)
+            )
             ch.cond.notify_all()
 
     def _writer_loop(
@@ -255,10 +292,36 @@ class TcpCommunicationLayer(CommunicationLayer):
     ) -> None:
         """Drain one destination's queue over a persistent connection.
 
-        A failure marks the destination dead and reports it through
-        ``on_send_error`` — the run is failed by the control plane
-        (the old synchronous path raised into the pump instead)."""
-        conn: Optional[socket.socket] = None
+        Transient failures (connection refused/reset, short partitions)
+        are retried — reconnect + resend with exponential backoff and
+        jitter, bounded by :attr:`retry_window` — through the shared
+        backoff helper.  A resend may replay frames the peer already
+        received before the connection died; the receiver drops those
+        by (sender id, frame seq), so retries are exactly-once at the
+        Messaging layer.  Only a retried-out failure (the permanent
+        case) marks the destination dead and reports it through
+        ``on_send_error`` — the run is then failed, repaired, or
+        degraded by the control plane."""
+        from pydcop_tpu.utils.backoff import call_with_backoff
+
+        conn_box: List[Optional[socket.socket]] = [None]
+
+        def _attempt(payload: bytes) -> None:
+            try:
+                if conn_box[0] is None:
+                    conn_box[0] = socket.create_connection(
+                        addr, timeout=10
+                    )
+                conn_box[0].sendall(payload)
+            except OSError:
+                c, conn_box[0] = conn_box[0], None
+                if c is not None:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+                raise
+
         try:
             while True:
                 with self._lock:
@@ -266,15 +329,22 @@ class TcpCommunicationLayer(CommunicationLayer):
                         ch.cond.wait()
                     if self._closing and not ch.frames:
                         return
+                    if ch.dead is not None:
+                        return  # peer forgotten (migration): stop
                     batch = ch.frames
                     ch.frames = []
                     ch.cond.notify_all()  # wake backpressured senders
-                if conn is None:
-                    conn = socket.create_connection(addr, timeout=10)
-                conn.sendall(b"".join(batch))
+                call_with_backoff(
+                    lambda payload=b"".join(batch): _attempt(payload),
+                    self.retry_window,
+                    base=0.05,
+                    max_delay=1.0,
+                    giving_up=lambda: self._closing
+                    or ch.dead is not None,
+                )
         except OSError as e:
             with self._lock:
-                ch.dead = str(e)
+                ch.dead = ch.dead or str(e)
                 ch.frames = []
                 ch.cond.notify_all()
             cb = self.on_send_error
@@ -288,9 +358,9 @@ class TcpCommunicationLayer(CommunicationLayer):
                     dest_agent, addr, e,
                 )
         finally:
-            if conn is not None:
+            if conn_box[0] is not None:
                 try:
-                    conn.close()
+                    conn_box[0].close()
                 except OSError:
                     pass
 
@@ -346,6 +416,10 @@ def run_host_orchestrator(
     server: Optional[socket.socket] = None,
     accel_agents: Optional[List[str]] = None,
     k_target: int = 0,
+    chaos: Optional[str] = None,
+    chaos_seed: int = 0,
+    grace_period: float = 5.0,
+    degraded_ok: bool = True,
 ) -> Dict[str, Any]:
     """Wait for ``nb_agents`` host agents, deploy, run to quiescence /
     budget / timeout, and return the assembled result dict.
@@ -383,6 +457,24 @@ def run_host_orchestrator(
     ``best_sample_period`` seconds and the best-cost sample is what
     ``cost``/``assignment`` report (``final_*`` is the last state) —
     the same semantics as the other engines.
+
+    Transient-fault tolerance: ``grace_period`` is the window that
+    separates blips from permanent death.  It is shipped to every
+    agent as the message plane's retry window (failed sends are
+    retried with backoff for that long before the link is declared
+    dead), and bounds how long the orchestrator tolerates a sticky
+    send failure before treating it as permanent.  A permanent
+    message-plane failure with no repair path then *degrades* the run
+    (``degraded_ok``, default on): the anytime-best assignment is
+    returned with ``status="degraded"`` and a ``degraded`` record,
+    instead of raising — control-plane agent death keeps its existing
+    fail/repair semantics.
+
+    Fault injection: ``chaos`` is a :class:`~pydcop_tpu.faults.FaultPlan`
+    spec applied by every agent to its outbound message plane with the
+    deterministic seed ``chaos_seed`` (``docs/faults.md``); the plan
+    and the per-kind injected-event counts are recorded in the result
+    under ``"chaos"`` for replay.
     """
     from pydcop_tpu.algorithms import (
         load_algorithm_module,
@@ -414,6 +506,14 @@ def run_host_orchestrator(
             "single-shot protocols would wedge at the cycle barrier"
         )
     params = prepare_algo_params(params, module.algo_params)
+    chaos_plan = None
+    if chaos:
+        from pydcop_tpu.faults import FaultPlan, FaultSpecError
+
+        try:  # fail fast on a malformed spec, before any registration
+            chaos_plan = FaultPlan.from_spec(chaos, chaos_seed)
+        except FaultSpecError as e:
+            raise PlacementError(str(e)) from e
     graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
         dcop
     )
@@ -477,10 +577,10 @@ def run_host_orchestrator(
                     )
                 newly_dead.append(name)
                 continue
-            if reply.get("error") and not resilient:
-                raise AgentFailureError(
-                    f"agent {name} failed: {reply['error']}"
-                )
+            # a reply-borne "error" field is NOT raised here: the run
+            # loop owns that decision (computation errors are fatal,
+            # send errors get the grace window / degraded path — for
+            # both the resilient and the static mode)
             replies[name] = reply
         return replies
 
@@ -530,6 +630,22 @@ def run_host_orchestrator(
             addresses[name] = (peer_addr[0], int(reg["msg_port"]))
 
         agent_names = sorted(peers)
+
+        # a chaos clause naming a nonexistent agent would silently
+        # inject NOTHING while the result still records the plan as
+        # applied — a resilience test that "passes" with zero faults;
+        # reject misspellings against the registered roster instead
+        if chaos_plan is not None:
+            unknown_chaos = chaos_plan.referenced_agents() - set(
+                agent_names
+            )
+            if unknown_chaos:
+                raise PlacementError(
+                    f"chaos spec names unregistered agent(s) "
+                    f"{sorted(unknown_chaos)} (registered: "
+                    f"{agent_names}) — those faults would never fire"
+                )
+
         # placement: explicit map > distribution strategy > round-robin
         from pydcop_tpu.distribution import Distribution
 
@@ -627,6 +743,12 @@ def run_host_orchestrator(
                     "directory": directory,
                     "seed": seed,
                     "accel": name in accel_agents,
+                    # robustness knobs: the message plane's transient-
+                    # fault grace window, and the (optional) fault-
+                    # injection plan every agent applies outbound
+                    "grace": grace_period,
+                    "chaos": chaos,
+                    "chaos_seed": chaos_seed,
                 },
             )
         for name in peers:
@@ -658,15 +780,22 @@ def run_host_orchestrator(
 
         resilient = k_target > 0
 
+        # per-agent CUMULATIVE injected-fault counts (collect replies
+        # carry the running totals; keeping the latest per agent makes
+        # repeated sampling idempotent)
+        chaos_by_agent: Dict[str, Dict[str, int]] = {}
+
         def _collect() -> Tuple[Dict[str, Any], int, int]:
             assignment: Dict[str, Any] = {}
             delivered = size = 0
-            for res in _ask_all(
+            for aname, res in _ask_all(
                 {"type": "collect"}, resilient=resilient
-            ).values():
+            ).items():
                 assignment.update(res["values"])
                 delivered += res["delivered"]
                 size += res["size"]
+                if res.get("chaos"):
+                    chaos_by_agent[aname] = res["chaos"]
             return assignment, delivered, size
 
         # anytime-best tracking (same semantics as the other engines:
@@ -792,6 +921,7 @@ def run_host_orchestrator(
         # run loop: poll status until quiescent / budget / timeout
         max_msgs = rounds * max(len(comp_names), 1)
         status = "finished"
+        degraded_info: Optional[Dict[str, Any]] = None
         stable = 0
         last_total = -1
         last_sample = 0.0
@@ -810,25 +940,39 @@ def run_host_orchestrator(
                 if st.get("error"):
                     kind = st.get("error_kind")
                     peer_name = st.get("error_peer")
-                    if not (resilient and kind == "send"):
+                    if kind != "send":
+                        # a computation handler raised (or a legacy
+                        # agent with no kind field): always fatal
                         raise AgentFailureError(
                             f"agent {name} failed: {st['error']}"
                         )
-                    if peer_name not in dead_ever:
+                    if not (resilient and peer_name in dead_ever):
                         # a send-error whose peer is NOT a known-dead
                         # agent (a live peer, or an unroutable
-                        # computation name): grace window for the
-                        # control plane to notice a death, then it is
-                        # a real fault — the pre-resilience semantics
+                        # computation name).  The agent's message
+                        # plane already spent its retry window before
+                        # surfacing this, so after the orchestrator's
+                        # own grace (time for the control plane to
+                        # notice a death / a heal to drain) it is
+                        # PERMANENT: degrade to the anytime-best when
+                        # allowed, else fail the run.
                         first = suspects.setdefault(
                             (name, peer_name), now
                         )
-                        if now - first > 5.0:
-                            raise AgentFailureError(
-                                f"agent {name} send failure toward "
-                                f"{peer_name!r} (not a dead agent): "
-                                f"{st['error']}"
-                            )
+                        if now - first > grace_period:
+                            if degraded_ok and best["assignment"]:
+                                degraded_info = {
+                                    "agent": name,
+                                    "peer": peer_name,
+                                    "error": st["error"],
+                                }
+                            else:
+                                raise AgentFailureError(
+                                    f"agent {name} send failure toward "
+                                    f"{peer_name!r} outlived the "
+                                    f"{grace_period:.1f}s grace "
+                                    f"window: {st['error']}"
+                                )
                         all_idle = False
                     # tolerated (dead peer / in-grace): the agent's
                     # totals still count — an agent with a sticky
@@ -841,6 +985,9 @@ def run_host_orchestrator(
             if now - last_sample >= best_sample_period:
                 _sample_best(total)
                 last_sample = now
+            if degraded_info is not None:
+                status = "degraded"
+                break
             if timeout is not None and now - t0 > timeout:
                 status = "timeout"
                 break
@@ -872,13 +1019,31 @@ def run_host_orchestrator(
                 stable = 0
             last_total = total
 
-        final_assignment, delivered, size = _collect()
+        if degraded_info is not None:
+            # graceful degradation: a permanent message-plane failure
+            # with no repair path.  The control plane is still healthy
+            # (a dead control connection raises AgentFailureError
+            # elsewhere) — collect once for the traffic counters, but
+            # the ASSIGNMENT is the anytime-best: post-partition agent
+            # values are a torn mix trusted less than the best
+            # complete sample.
+            try:
+                _, delivered, size = _collect()
+            except AgentFailureError:
+                delivered = trace_msgs[-1] if trace_msgs else 0
+                size = 0
+            final_assignment = dict(best["assignment"])
+            final_cost = sign * best["cost"]
+        else:
+            final_assignment, delivered, size = _collect()
         # same guard as _sample_best: under a very short timeout or
         # budget an agent may report values before its computations
         # started (None) — solution_cost would crash inside constraint
         # evaluation; fall back to the best sampled assignment, or
         # fail cleanly when no complete snapshot ever existed
-        if _complete(final_assignment):
+        if degraded_info is not None:
+            pass  # assignment/cost already pinned to the anytime-best
+        elif _complete(final_assignment):
             final_cost = dcop.solution_cost(final_assignment)
             trace.append(final_cost)  # the end state belongs in the
             # anytime stream too (a short run may never have hit a
@@ -902,6 +1067,10 @@ def run_host_orchestrator(
                 delivered, sign * best["cost"], sign * best["cost"],
                 values=best["assignment"], status=status,
             )
+        chaos_totals: Dict[str, int] = {}
+        for counts in chaos_by_agent.values():
+            for kind, n in counts.items():
+                chaos_totals[kind] = chaos_totals.get(kind, 0) + n
         return {
             "assignment": best["assignment"],
             "cost": sign * best["cost"],
@@ -920,6 +1089,24 @@ def run_host_orchestrator(
             # replica migrations performed (k_target resilience):
             # [{dead: [...], moved: {comp: new_agent}}, ...]
             "migrations": migrations,
+            # fault-injection replay record: the plan (spec + seed
+            # rebuild it exactly) and the per-kind injected counts
+            **(
+                {
+                    "chaos": {
+                        **chaos_plan.to_meta(),
+                        "events": chaos_totals,
+                    }
+                }
+                if chaos_plan is not None
+                else {}
+            ),
+            # permanent message-plane failure the run degraded over
+            **(
+                {"degraded": degraded_info}
+                if degraded_info is not None
+                else {}
+            ),
         }
     finally:
         if ui is not None:
@@ -941,12 +1128,20 @@ def run_host_agent(
     orchestrator: str,
     retry_for: float = 30.0,
     msg_log: Optional[str] = None,
+    chaos: Optional[str] = None,
+    chaos_seed: int = 0,
 ) -> Dict[str, Any]:
     """One host agent process: register, deploy, run until ``stop``.
 
     ``msg_log`` dumps every delivered message's full content to a
     JSONL file (the reference's per-message log option).  Returns a
-    summary dict (delivered count, values) for logging."""
+    summary dict (delivered count, values) for logging.
+
+    ``chaos``/``chaos_seed`` apply a local fault-injection plan to
+    this agent's outbound message plane (``docs/faults.md``); when
+    None, the plan the orchestrator shipped in the deploy message (if
+    any) is used — a local spec overrides it, so one agent of a fleet
+    can be singled out for faults."""
     from pydcop_tpu.algorithms import (
         AlgorithmDef,
         ComputationDef,
@@ -960,18 +1155,18 @@ def run_host_agent(
     )
     from pydcop_tpu.infrastructure.discovery import Discovery
 
+    from pydcop_tpu.utils.backoff import call_with_backoff
+
     ohost, _, oport = orchestrator.partition(":")
-    deadline = time.monotonic() + retry_for
-    while True:
-        try:
-            conn = socket.create_connection(
-                (ohost, int(oport)), timeout=5
-            )
-            break
-        except OSError:
-            if time.monotonic() >= deadline:
-                raise
-            time.sleep(0.3)
+    # control-plane connect: the same shared backoff-with-jitter
+    # helper every retry loop uses (the old fixed 0.3s sleep hammered
+    # a not-yet-listening orchestrator in lockstep across a fleet)
+    conn = call_with_backoff(
+        lambda: socket.create_connection((ohost, int(oport)), timeout=5),
+        retry_for,
+        base=0.1,
+        max_delay=2.0,
+    )
     conn.settimeout(None)
     reader = conn.makefile("rb")
 
@@ -1020,6 +1215,46 @@ def run_host_agent(
     comm.set_addresses(
         {a: tuple(addr) for a, addr in dep["directory"].items()}
     )
+    # transient-fault grace window: the orchestrator's single knob —
+    # the message plane retries failed sends with backoff for this
+    # long before a link is declared dead (permanent)
+    comm.retry_window = float(dep.get("grace", comm.retry_window))
+    # fault injection: a local --chaos spec overrides the plan the
+    # orchestrator shipped (so one agent of a fleet can be singled
+    # out); the wrapper applies it to every outbound message
+    chaos_spec = chaos if chaos is not None else dep.get("chaos")
+    plane = comm
+    chaos_layer = None
+    if chaos_spec:
+        import os as _os
+
+        from pydcop_tpu.faults import ChaosCommunicationLayer, FaultPlan
+
+        try:
+            plan = FaultPlan.from_spec(
+                chaos_spec,
+                chaos_seed
+                if chaos is not None
+                else int(dep.get("chaos_seed", 0)),
+            )
+        except Exception:
+            comm.close()  # a malformed LOCAL spec (the orchestrator
+            # validates its own before deploying)
+            raise
+        chaos_layer = ChaosCommunicationLayer(
+            comm,
+            plan,
+            name,
+            grace=comm.retry_window,
+            on_send_error=lambda dest, e: errors.append(
+                ("send", str(dest), f"send to {dest}: {e!r}")
+            ),
+            # a scheduled crash is the scripted SIGKILL: no cleanup,
+            # no goodbye on the control plane — exactly what the
+            # repair machinery must survive
+            on_crash=lambda: _os._exit(23),
+        )
+        plane = chaos_layer
     # computation → agent routing for the messaging layer
     directory = Discovery()
     for aname, comps in dep["placement"].items():
@@ -1033,7 +1268,7 @@ def run_host_agent(
 
         log = MessageLog(msg_log)
     agent = Agent(
-        name, comm,
+        name, plane,
         on_error=lambda comp, e: errors.append(
             ("comp", str(comp), f"{comp}: {e!r}")
         ),
@@ -1115,9 +1350,13 @@ def run_host_agent(
                     conn,
                     {
                         "type": "status",
+                        # held chaos frames count as sent-not-delivered
+                        # (plane is the chaos wrapper when one is on),
+                        # so injected delays/holds block quiescence
+                        # exactly like real in-flight TCP frames
                         "idle": agent.is_idle,
                         "delivered": agent.messaging.count_msg,
-                        "sent": comm.count_sent,
+                        "sent": plane.count_sent,
                         "error": err[2] if err else None,
                         "error_kind": err[0] if err else None,
                         "error_peer": err[1] if err else None,
@@ -1192,13 +1431,19 @@ def run_host_agent(
                         "values": values,
                         "delivered": delivered,
                         "size": agent.messaging.size_msg,
+                        **(
+                            {"chaos": chaos_layer.event_summary()}
+                            if chaos_layer is not None
+                            else {}
+                        ),
                     },
                 )
             elif mtype == "stop":
                 break
     finally:
         agent.stop()
-        comm.close()
+        plane.close()  # the chaos wrapper (when on) closes the inner
+        # transport after stopping its timer wheel
         if log is not None:
             log.close()
         try:

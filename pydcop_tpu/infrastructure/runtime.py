@@ -160,12 +160,20 @@ def solve_host(
     rounds: Optional[int] = None,
     msg_log: Optional[str] = None,
     accel_agents=None,
+    chaos: Optional[str] = None,
+    chaos_seed: int = 0,
 ) -> Dict[str, Any]:
     """Solve ``dcop`` with the host message-driven runtime.
 
     ``msg_log`` writes every delivered message's full content to a
     JSONL file (the reference's per-message log option — one line per
     message in ``simple_repr`` wire form).
+
+    ``chaos``/``chaos_seed`` (thread mode): apply a deterministic
+    fault-injection plan (``pydcop_tpu.faults``, ``docs/faults.md``)
+    to every agent's outbound messages.  Crash schedules need killable
+    OS processes (``mode='process'``); sim needs no chaos layer at all
+    — its event loop is already a seeded, controlled schedule.
 
     The budget is ``max_msgs`` delivered messages; when only ``rounds``
     is given it is converted as rounds × number of computations (one
@@ -184,6 +192,25 @@ def solve_host(
     algo_name, params_in = resolve_algo(algo, algo_params)
     module = load_algorithm_module(algo_name)
     params = prepare_algo_params(params_in, module.algo_params)
+
+    chaos_plan = None
+    if chaos:
+        if mode != "thread":
+            raise ValueError(
+                "chaos fault injection needs a communication layer to "
+                "wrap — use mode='thread' (in-process) or "
+                "mode='process' (TCP); the sim event loop is already "
+                "a seeded, fully controlled schedule"
+            )
+        from pydcop_tpu.faults import FaultPlan
+
+        chaos_plan = FaultPlan.from_spec(chaos, chaos_seed)
+        if chaos_plan.crashes:
+            raise ValueError(
+                "chaos crash=AGENT@T schedules hard-kill an agent OS "
+                "process — use mode='process' (thread-mode agents "
+                "share this interpreter)"
+            )
 
     # compiled islands (heterogeneous deployment, as in the hostnet
     # runtime): agents named in accel_agents host their placed
@@ -264,6 +291,7 @@ def solve_host(
         from pydcop_tpu.infrastructure.communication import MessageLog
 
         log = MessageLog(msg_log)
+    chaos_info: Dict[str, Any] = {}  # filled by _run_threads (events)
     try:
         if mode == "sim":
             status, delivered, size = _run_sim(
@@ -274,7 +302,8 @@ def solve_host(
             status, delivered, size = _run_threads(
                 dcop, computations, timeout, max_msgs, distribution, t0,
                 snapshot, msg_log=log, placement=placement,
-                pending_refs=pending_refs,
+                pending_refs=pending_refs, chaos_plan=chaos_plan,
+                chaos_info=chaos_info,
             )
         else:
             raise ValueError(f"solve_host: unknown mode {mode!r}")
@@ -310,6 +339,12 @@ def solve_host(
         # actual delivered count per snapshot, so the metrics CSVs can
         # label rows exactly instead of reconstructing proportionally
         "trace_msgs": trace_msgs,
+        # fault-injection replay record (spec + seed + event counts)
+        **(
+            {"chaos": {**chaos_plan.to_meta(), **chaos_info}}
+            if chaos_plan is not None
+            else {}
+        ),
     }
 
 
@@ -414,6 +449,8 @@ def _run_threads(
     msg_log=None,
     placement: Optional[Dict[str, List[str]]] = None,
     pending_refs: Optional[Dict[str, Dict[str, Any]]] = None,
+    chaos_plan=None,
+    chaos_info: Optional[Dict[str, Any]] = None,
 ) -> Tuple[str, int, int]:
     from pydcop_tpu.infrastructure.agents import Agent
     from pydcop_tpu.infrastructure.communication import (
@@ -444,9 +481,27 @@ def _run_threads(
     by_name = {c.name: c for c in computations}
     errors: List[Tuple[str, BaseException]] = []
     agents = []
+    # fault injection: each agent sends through its OWN chaos wrapper
+    # over the shared in-process layer (the plan keys faults by
+    # directed agent link, and the wrapper needs to know its sender)
+    if chaos_plan is not None:
+        unknown = chaos_plan.referenced_agents() - set(placement)
+        if unknown:
+            raise ValueError(
+                f"chaos spec names unknown agent(s) {sorted(unknown)} "
+                f"(this run's agents: {sorted(placement)}) — those "
+                "faults would never fire"
+            )
+    chaos_layers = []
     for aname, comp_names in placement.items():
+        plane = comm
+        if chaos_plan is not None:
+            from pydcop_tpu.faults import ChaosCommunicationLayer
+
+            plane = ChaosCommunicationLayer(comm, chaos_plan, aname)
+            chaos_layers.append(plane)
         agent = Agent(
-            aname, comm,
+            aname, plane,
             on_error=lambda comp, e: errors.append((comp, e)),
             discovery=discovery,
             msg_log=msg_log,
@@ -483,7 +538,12 @@ def _run_threads(
         if total >= max_msgs:
             status = "msg_budget"
             break
-        if all(a.is_idle for a in agents):
+        # a chaos-held message (delay / partition hold) is in flight
+        # but invisible to every Messaging queue — quiescence must
+        # wait for it or a delayed message would arrive after "done"
+        if all(a.is_idle for a in agents) and not any(
+            w.in_flight for w in chaos_layers
+        ):
             idle_checks += 1
             if idle_checks >= 3:
                 break
@@ -493,6 +553,14 @@ def _run_threads(
         a.stop()
     for a in agents:
         a.join(timeout=1.0)
+    for w in chaos_layers:
+        w.close()  # stop the timer wheels (inner layer has no close)
+    if chaos_info is not None and chaos_layers:
+        events: Dict[str, int] = {}
+        for w in chaos_layers:
+            for kind, n in w.event_summary().items():
+                events[kind] = events.get(kind, 0) + n
+        chaos_info["events"] = events
     if errors:
         comp, err = errors[0]
         raise RuntimeError(

@@ -1,0 +1,74 @@
+"""Shared retry/backoff helper: every reconnect loop in the codebase
+(host agent control-plane connect, TCP message-plane writer resend,
+chaos-layer probes) goes through this one implementation, so backoff
+policy — exponential growth, cap, jitter — is tuned in exactly one
+place.
+
+Jitter is seedable: the fault-injection harness (``pydcop_tpu.faults``)
+replays runs, so a retry schedule must be reproducible when a seed is
+given (and decorrelated across callers when it is not).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def backoff_delays(
+    base: float = 0.1,
+    factor: float = 2.0,
+    max_delay: float = 5.0,
+    jitter: float = 0.25,
+    seed: Optional[int] = None,
+) -> Iterator[float]:
+    """Yield an infinite stream of sleep delays: ``base`` growing by
+    ``factor`` up to ``max_delay``, each stretched by a random factor
+    in ``[1, 1 + jitter]`` (full-jitter would allow 0-sleeps, which
+    turn a retry loop into a busy spin against a dead peer)."""
+    rnd = random.Random(seed)
+    delay = base
+    while True:
+        yield delay * (1.0 + jitter * rnd.random())
+        delay = min(delay * factor, max_delay)
+
+
+def call_with_backoff(
+    fn: Callable[[], T],
+    retry_for: float,
+    exceptions: Tuple[Type[BaseException], ...] = (OSError,),
+    base: float = 0.1,
+    factor: float = 2.0,
+    max_delay: float = 5.0,
+    jitter: float = 0.25,
+    seed: Optional[int] = None,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+    giving_up: Optional[Callable[[], bool]] = None,
+) -> T:
+    """Call ``fn`` until it succeeds or ``retry_for`` seconds elapse.
+
+    The LAST failure is re-raised once the deadline passes (never a
+    synthetic timeout error: the caller diagnoses from the real one).
+    ``giving_up`` is polled before each sleep — a closing transport
+    aborts the retry loop early by returning True, re-raising the
+    current failure instead of sleeping toward a deadline nobody is
+    waiting on.  Sleeps never overshoot the deadline: the final attempt
+    happens AT the deadline, not ``max_delay`` past it.
+    """
+    deadline = clock() + retry_for
+    for delay in backoff_delays(
+        base=base, factor=factor, max_delay=max_delay, jitter=jitter,
+        seed=seed,
+    ):
+        try:
+            return fn()
+        except exceptions:
+            remaining = deadline - clock()
+            if remaining <= 0 or (giving_up is not None and giving_up()):
+                raise
+            sleep(min(delay, remaining))
+    raise AssertionError("unreachable")  # pragma: no cover

@@ -143,6 +143,38 @@ def test_solve_cli_checkpoint_resume(tmp_path):
     assert result["cycle"] == 40  # 20 restored + 20 new
 
 
+def test_checkpoint_static_keys_roundtrip(tmp_path):
+    """Direct save/load round-trip of the static_keys contract: save
+    SKIPS leaves under a static key (pure problem-derived index data,
+    wasted I/O), and load backfills them from the template — so the
+    file is smaller AND the restored pytree is complete."""
+    path = str(tmp_path / "ck.npz")
+    state = {
+        "values": np.arange(4, dtype=np.int32),
+        "idx": np.arange(12, dtype=np.int32).reshape(3, 4),
+    }
+    save_checkpoint(
+        path, state, 2.0, np.zeros(4, np.int32), 7, static_keys=("idx",)
+    )
+    with np.load(path) as data:
+        assert "state/values" in data.files
+        assert "state/idx" not in data.files  # skipped at save
+    template = {
+        "values": np.zeros(4, np.int32),
+        "idx": state["idx"] + 0,  # init_state rebuilds this
+    }
+    got, best_cost, _, rounds, _ = load_checkpoint(
+        path, template, static_keys=("idx",)
+    )
+    assert best_cost == 2.0 and rounds == 7
+    np.testing.assert_array_equal(got["values"], state["values"])
+    np.testing.assert_array_equal(got["idx"], state["idx"])  # backfilled
+    # without static_keys on the load side the missing leaf is a real
+    # error (a checkpoint from a different algorithm)
+    with pytest.raises(ValueError, match="misses"):
+        load_checkpoint(path, template)
+
+
 def test_resume_backfills_static_state_keys(tmp_path):
     """A checkpoint written before an algorithm grew a new STATIC
     state key (pure problem-derived index data) must stay resumable:

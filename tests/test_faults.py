@@ -1,0 +1,554 @@
+"""Deterministic fault injection (pydcop_tpu/faults) + the message
+planes' transient-fault tolerance: seeded FaultPlan determinism, the
+ChaosCommunicationLayer's injected-event replay guarantee, the TCP
+plane's bounded reconnect/resend with receiver dedupe, and the
+orchestrator's heal-vs-degrade split around the grace window
+(docs/faults.md)."""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ring_yaml(n=8, agents=("a1", "a2")):
+    lines = [
+        "name: ring",
+        "objective: min",
+        "domains:",
+        "  colors: {values: [0, 1, 2]}",
+        "variables:",
+    ]
+    for i in range(n):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for i in range(n):
+        j = (i + 1) % n
+        lines.append(f"  c{i}:")
+        lines.append("    type: intention")
+        lines.append(f"    function: 1 if v{i} == v{j} else 0")
+    lines.append(f"agents: [{', '.join(agents)}]")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: spec parsing + determinism
+# ---------------------------------------------------------------------------
+
+
+SPEC = (
+    "drop=0.2,dup=0.1,reorder=0.1,delay=0.2:0.01,"
+    "a1>a2:drop=0.5,partition=a1-a3@0.5+2,crash=a9@1.5"
+)
+
+
+@pytest.mark.chaos
+def test_fault_plan_same_seed_identical_decisions():
+    """The determinism core: two plans from the same (spec, seed) make
+    byte-identical per-link decision sequences; a different seed makes
+    a different sequence (the faults actually depend on the seed)."""
+    from pydcop_tpu.faults import FaultPlan
+
+    a = FaultPlan.from_spec(SPEC, 42)
+    b = FaultPlan.from_spec(SPEC, 42)
+    for link in (("a1", "a2"), ("x", "y"), ("a2", "a1")):
+        assert a.decisions(*link, 500) == b.decisions(*link, 500)
+    c = FaultPlan.from_spec(SPEC, 43)
+    assert a.decisions("x", "y", 500) != c.decisions("x", "y", 500)
+    # per-link override beats the default
+    n_over = sum(d.drop for d in a.decisions("a1", "a2", 400))
+    n_def = sum(d.drop for d in a.decisions("x", "y", 400))
+    assert n_over > n_def
+    # the replay record reconstructs the plan exactly
+    meta = a.to_meta()
+    r = FaultPlan.from_spec(meta["spec"], meta["seed"])
+    assert r.decisions("a1", "a2", 100) == a.decisions("a1", "a2", 100)
+    assert r.crashes == a.crashes == {"a9": 1.5}
+
+
+@pytest.mark.chaos
+def test_fault_plan_partitions_and_spec_errors():
+    from pydcop_tpu.faults import FaultPlan, FaultSpecError
+
+    p = FaultPlan.from_spec(SPEC, 0)
+    # bidirectional window, active only inside [start, end)
+    assert p.partition_heal("a1", "a3", 1.0) == 2.5
+    assert p.partition_heal("a3", "a1", 1.0) == 2.5
+    assert p.partition_heal("a1", "a3", 0.4) is None
+    assert p.partition_heal("a1", "a3", 2.6) is None
+    assert p.partition_heal("a1", "a2", 1.0) is None
+    # agent-wide and directed forms
+    q = FaultPlan.from_spec("partition=a1-*@0+1,partition=b1>b2@0+1", 0)
+    assert q.partition_heal("a1", "zz", 0.5) == 1.0
+    assert q.partition_heal("zz", "a1", 0.5) == 1.0
+    assert q.partition_heal("b1", "b2", 0.5) == 1.0
+    assert q.partition_heal("b2", "b1", 0.5) is None
+    for bad in (
+        "drop=1.5", "bogus=1", "delay=0.1:-2", "partition=a1@3",
+        "crash=a1", "a1:drop=0.1", "drop=x",
+    ):
+        with pytest.raises(FaultSpecError):
+            FaultPlan.from_spec(bad, 0)
+    # crash-only plans carry no message faults (the `run` command's
+    # eligibility check)
+    assert not FaultPlan.from_spec("crash=a1@2", 0).message_faults_configured
+    assert FaultPlan.from_spec("drop=0.1", 0).message_faults_configured
+
+
+@pytest.mark.chaos
+def test_chaos_layer_identical_event_sequence():
+    """Driving the SAME message sequence through two chaos layers with
+    the same plan yields the identical injected-event list AND the
+    identical delivered-message sequence (no delay clauses, so no
+    timing in play) — the end-to-end replay guarantee."""
+    from pydcop_tpu.faults import ChaosCommunicationLayer, FaultPlan
+    from pydcop_tpu.infrastructure.communication import (
+        InProcessCommunicationLayer,
+        Messaging,
+    )
+    from pydcop_tpu.infrastructure.computations import Message
+
+    def run_once():
+        inner = InProcessCommunicationLayer()
+        inbox = Messaging("a2")
+        inner.register("a2", inbox)
+        layer = ChaosCommunicationLayer(
+            inner,
+            FaultPlan.from_spec("drop=0.25,dup=0.15,reorder=0.2", 9),
+            "a1",
+        )
+        try:
+            for i in range(120):
+                layer.send_msg("a2", "c1", "c2", Message("m", i))
+            time.sleep(0.35)  # a trailing reorder hold releases by timer
+            delivered = []
+            while True:
+                item = inbox.next_msg(timeout=0.01)
+                if item is None:
+                    break
+                delivered.append(item[2].content)
+                inbox.task_done()
+            return list(layer.events), delivered
+        finally:
+            layer.close()
+
+    ev1, d1 = run_once()
+    ev2, d2 = run_once()
+    assert ev1 == ev2 and len(ev1) > 10
+    assert d1 == d2
+    kinds = {k for k, _, _ in ev1}
+    assert kinds >= {"drop", "dup", "reorder"}
+    # dup adds one delivery, drop removes one; reorder is count-neutral
+    n_drop = sum(1 for k, _, _ in ev1 if k == "drop")
+    n_dup = sum(1 for k, _, _ in ev1 if k == "dup")
+    assert len(d1) == 120 - n_drop + n_dup
+
+
+@pytest.mark.chaos
+def test_chaos_smoke_thread_mode():
+    """The tier-1 chaos smoke: a ring solved to its optimum THROUGH
+    injected drops/dups/delays in thread mode, twice — same final
+    cost, and the fault plan recorded in the result reproduces the
+    identical decision sequence (the acceptance determinism check)."""
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+    from pydcop_tpu.faults import FaultPlan
+
+    dcop = load_dcop(_ring_yaml(8, agents=("a1", "a2", "a3", "a4")))
+    spec = "drop=0.05,dup=0.05,delay=0.1:0.02"
+    runs = [
+        solve(
+            dcop, "maxsum", {"damping": 0.5}, mode="thread",
+            rounds=400, timeout=60, seed=1, chaos=spec, chaos_seed=7,
+        )
+        for _ in range(2)
+    ]
+    for r in runs:
+        assert r["cost"] == 0.0, r["cost"]
+        assert r["status"] == "finished"
+        assert r["chaos"]["spec"] == spec and r["chaos"]["seed"] == 7
+        assert sum(r["chaos"]["events"].values()) > 0
+    assert runs[0]["cost"] == runs[1]["cost"]
+    # the recorded metadata rebuilds a byte-identical plan
+    plans = [
+        FaultPlan.from_spec(r["chaos"]["spec"], r["chaos"]["seed"])
+        for r in runs
+    ]
+    assert plans[0].decisions("a1", "a2", 300) == plans[1].decisions(
+        "a1", "a2", 300
+    )
+
+
+# ---------------------------------------------------------------------------
+# shared backoff helper
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_helper_shapes_and_retry():
+    from pydcop_tpu.utils.backoff import backoff_delays, call_with_backoff
+
+    import itertools
+
+    a = list(itertools.islice(backoff_delays(seed=3), 8))
+    b = list(itertools.islice(backoff_delays(seed=3), 8))
+    assert a == b  # seeded jitter is reproducible
+    # exponential growth under the jitter envelope, capped
+    for i, d in enumerate(a):
+        base = min(0.1 * 2**i, 5.0)
+        assert base <= d <= base * 1.25
+
+    # retries until success, sleeping only simulated time
+    clock = [0.0]
+    sleeps = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise OSError("boom")
+        return "ok"
+
+    assert (
+        call_with_backoff(
+            flaky, 60.0, clock=lambda: clock[0],
+            sleep=lambda s: (sleeps.append(s), clock.__setitem__(0, clock[0] + s)),
+            seed=0,
+        )
+        == "ok"
+    )
+    assert len(calls) == 4 and len(sleeps) == 3
+
+    # the deadline re-raises the LAST real failure, never overshooting
+    sleeps.clear()
+
+    def always_down():
+        raise OSError("still down")
+
+    with pytest.raises(OSError, match="still down"):
+        call_with_backoff(
+            always_down, 0.5, clock=lambda: clock[0],
+            sleep=lambda s: (sleeps.append(s), clock.__setitem__(0, clock[0] + s)),
+            seed=0,
+        )
+    assert sum(sleeps) <= 0.5 + 1e-9
+
+    # giving_up aborts immediately
+    calls.clear()
+    with pytest.raises(OSError):
+        call_with_backoff(
+            flaky, 60.0, clock=lambda: clock[0], sleep=lambda s: None,
+            giving_up=lambda: True,
+        )
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# TCP plane: bounded reconnect/resend + receiver dedupe
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_tcp_writer_rides_out_transient_outage():
+    """A destination that is down when the first frames are sent but
+    comes up within the retry window receives them: the writer's
+    backoff retry turns the outage into a blip, and on_send_error
+    never fires (before this, the first failed connect killed the
+    link permanently)."""
+    from pydcop_tpu.infrastructure.communication import Messaging
+    from pydcop_tpu.infrastructure.computations import Message
+    from pydcop_tpu.infrastructure.hostnet import TcpCommunicationLayer
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    errors = []
+    sender = TcpCommunicationLayer(
+        on_send_error=lambda dest, e: errors.append((dest, e)),
+        retry_window=10.0,
+    )
+    receiver = None
+    try:
+        sender.set_addresses({"b": ("127.0.0.1", port)})
+        for i in range(3):
+            sender.send_msg("b", "c1", "c2", Message("m", i))
+        time.sleep(0.5)  # the outage: nothing listening yet
+        receiver = TcpCommunicationLayer(port=port)
+        inbox = Messaging("b")
+        receiver.register("b", inbox)
+        deadline = time.time() + 12
+        while inbox.count_msg < 3 and time.time() < deadline:
+            time.sleep(0.05)
+        assert inbox.count_msg == 3, inbox.count_msg
+        got = sorted(
+            inbox.next_msg(timeout=1)[2].content for _ in range(3)
+        )
+        assert got == [0, 1, 2]
+        assert not errors, errors
+    finally:
+        sender.close()
+        if receiver is not None:
+            receiver.close()
+
+
+@pytest.mark.chaos
+def test_tcp_receiver_dedupes_resent_frames():
+    """Reconnect-resend may replay frames the peer already received;
+    the receiver drops frames at or below the (sender, seq) high-water
+    mark so `delivered` never double-counts — the exactly-once
+    property the two-counter quiescence ledger needs."""
+    import json
+
+    from pydcop_tpu.infrastructure.communication import Messaging
+    from pydcop_tpu.infrastructure.computations import Message
+    from pydcop_tpu.infrastructure.hostnet import TcpCommunicationLayer
+    from pydcop_tpu.utils.simple_repr import simple_repr
+
+    receiver = TcpCommunicationLayer()
+    inbox = Messaging("b")
+    receiver.register("b", inbox)
+    try:
+        frames = []
+        for sq in (1, 2):
+            frames.append(
+                json.dumps(
+                    {
+                        "da": "b", "sc": "c1", "dc": "c2", "p": 20,
+                        "m": simple_repr(Message("m", sq)),
+                        "sa": "1.2.3.4:999", "sq": sq,
+                    }
+                ).encode() + b"\n"
+            )
+        with socket.create_connection(receiver.address) as c1:
+            c1.sendall(frames[0] + frames[1])
+            time.sleep(0.3)
+        # "reconnect": the whole batch replayed plus one new frame
+        new = frames[1].replace(b'"sq": 2', b'"sq": 3').replace(
+            b'"content": 2', b'"content": 3'
+        )
+        with socket.create_connection(receiver.address) as c2:
+            c2.sendall(frames[0] + frames[1] + new)
+            time.sleep(0.3)
+        deadline = time.time() + 5
+        while inbox.count_msg < 3 and time.time() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.2)  # would-be duplicates had time to land
+        assert inbox.count_msg == 3, inbox.count_msg
+        got = [inbox.next_msg(timeout=1)[2].content for _ in range(3)]
+        assert got == [1, 2, 3]
+    finally:
+        receiver.close()
+
+
+# ---------------------------------------------------------------------------
+# hostnet end-to-end: heal vs degrade around the grace window
+# ---------------------------------------------------------------------------
+
+
+def _run_chaos_orchestrator(dcop, algo, params, port, **kw):
+    """run_host_orchestrator in a thread + 2 real agent processes."""
+    from pydcop_tpu.infrastructure.hostnet import run_host_orchestrator
+
+    box = {}
+
+    def orch():
+        try:
+            box["result"] = run_host_orchestrator(
+                dcop, algo, params, nb_agents=2, port=port,
+                register_timeout=60.0, **kw,
+            )
+        except Exception as e:
+            box["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=orch, daemon=True)
+    t.start()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYDCOP_TPU_PLATFORM"] = "cpu"
+    agents = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu", "agent",
+                "--names", name, "--runtime", "host",
+                "--orchestrator", f"localhost:{port}",
+            ],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        )
+        for name in ("a1", "a2")
+    ]
+    try:
+        t.join(120)
+        assert not t.is_alive(), "orchestrator hung"
+        return box
+    finally:
+        for p in agents:
+            if p.poll() is None:
+                p.kill()
+            p.communicate()
+
+
+@pytest.mark.chaos
+def test_partition_shorter_than_grace_heals_identically():
+    """Acceptance: an injected link partition SHORTER than the grace
+    window only delays messages — the run completes with the same
+    final assignment as the fault-free run (dpop: deterministic exact
+    assignment, so 'same' is exact equality, not just equal cost)."""
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+
+    dcop = load_dcop(_ring_yaml(8))
+    base = solve(
+        dcop, "dpop", mode="process", nb_agents=2, rounds=400,
+        timeout=90, seed=1,
+    )
+    assert base["status"] == "finished"
+    healed = solve(
+        dcop, "dpop", mode="process", nb_agents=2, rounds=400,
+        timeout=90, seed=1, chaos="partition=a1-a2@0.0+2.0",
+        chaos_seed=1,
+    )
+    assert healed["status"] == "finished"
+    assert healed["assignment"] == base["assignment"]
+    assert healed["cost"] == base["cost"]
+    # the partition actually bit: holds were injected and recorded
+    assert healed["chaos"]["events"].get("hold", 0) > 0, healed["chaos"]
+
+
+@pytest.mark.chaos
+def test_partition_longer_than_grace_degrades():
+    """Acceptance: a partition OUTLIVING the grace window returns the
+    anytime-best assignment with status='degraded' (plus the degraded
+    record and the chaos replay metadata) instead of raising."""
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+
+    dcop = load_dcop(_ring_yaml(8))
+    port = 9621 + (os.getpid() % 120)
+    box = _run_chaos_orchestrator(
+        dcop, "maxsum", {"damping": 0.5}, port,
+        rounds=100_000, timeout=60, seed=2,
+        chaos="partition=a1-a2@0.0+60", chaos_seed=3,
+        grace_period=1.5,
+    )
+    assert "error" not in box, box.get("error")
+    r = box["result"]
+    assert r["status"] == "degraded"
+    assert r["degraded"]["peer"] in ("a1", "a2")
+    assert set(r["assignment"]) == {f"v{i}" for i in range(8)}
+    assert r["chaos"]["seed"] == 3
+    assert r["chaos"]["events"].get("partition", 0) > 0
+
+
+@pytest.mark.chaos
+def test_chaos_crash_schedule_triggers_repair():
+    """crash=AGENT@T is the scripted SIGKILL: under k_target the
+    orchestrator must repair (migrate the crashed agent's computations
+    to replica holders) and finish — fault-driven exercise of the
+    resilience path with no external kill choreography."""
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+
+    # a 400-variable ring with a low move probability keeps the run
+    # alive well past the crash time (the same sizing argument as the
+    # SIGKILL recovery tests in test_hostnet.py)
+    dcop = load_dcop(_ring_yaml(400, agents=("a1", "a2", "a3")))
+    port = 9741 + (os.getpid() % 120)
+    from pydcop_tpu.infrastructure.hostnet import run_host_orchestrator
+
+    box = {}
+
+    def orch():
+        try:
+            box["result"] = run_host_orchestrator(
+                dcop, "dsa", {"probability": 0.06}, nb_agents=3,
+                port=port, rounds=100_000, timeout=90, seed=2,
+                k_target=1, register_timeout=60.0,
+                chaos="crash=a2@0.8", chaos_seed=1,
+            )
+        except Exception as e:
+            box["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=orch, daemon=True)
+    t.start()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYDCOP_TPU_PLATFORM"] = "cpu"
+    agents = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "pydcop_tpu", "agent",
+                "--names", name, "--runtime", "host",
+                "--orchestrator", f"localhost:{port}",
+            ],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        )
+        for name in ("a1", "a2", "a3")
+    ]
+    try:
+        t.join(120)
+        assert not t.is_alive(), "orchestrator hung after crash"
+        assert "error" not in box, box.get("error")
+        r = box["result"]
+        assert r["status"] == "finished"
+        assert r["migrations"] and r["migrations"][0]["dead"] == ["a2"]
+        assert set(r["placement"]) == {"a1", "a3"}
+        assert set(r["assignment"]) == {f"v{i}" for i in range(400)}
+        # the crashed process really hard-exited with the chaos code
+        assert agents[1].wait(timeout=30) == 23
+    finally:
+        for p in agents:
+            if p.poll() is None:
+                p.kill()
+            p.communicate()
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_run_command_chaos_crash_schedule(tmp_path):
+    """`run --chaos crash=...` scripts deterministic remove_agent
+    events for the batched dynamic engine (and rejects message-plane
+    clauses, which need a message plane)."""
+    import json
+
+    yaml_file = tmp_path / "ring.yaml"
+    yaml_file.write_text(_ring_yaml(8, agents=("a1", "a2", "a3")))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYDCOP_TPU_PLATFORM"] = "cpu"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pydcop_tpu", "run", str(yaml_file),
+            "-a", "dsa", "--chaos", "crash=a2@0.5", "--chaos_seed", "4",
+            "--rounds_per_second", "40", "--final_rounds", "30",
+            "--seed", "1", "-k", "1", "-d", "adhoc",
+        ],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    result = json.loads(proc.stdout[proc.stdout.index("{"):])
+    assert result["chaos"] == {"spec": "crash=a2@0.5", "seed": 4}
+    assert any(
+        e.get("action") == "remove_agent" and e.get("agent") == "a2"
+        for e in result["events"]
+    ), result["events"]
+
+    bad = subprocess.run(
+        [
+            sys.executable, "-m", "pydcop_tpu", "run", str(yaml_file),
+            "-a", "dsa", "--chaos", "drop=0.5",
+        ],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=120,
+    )
+    assert bad.returncode != 0
+    assert "no message plane" in bad.stderr

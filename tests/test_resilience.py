@@ -137,6 +137,47 @@ def test_repair_lost_computation():
     assert placed == {"c1": "a1"}
 
 
+def test_repair_capacity_no_feasible_candidate():
+    """Hard-capacity projection when an orphan has NO feasible
+    candidate: it is dropped from the returned placement (lost — the
+    caller degrades), while feasible orphans still land and never
+    overfill an agent."""
+    agents = [
+        AgentDef("a1", default_hosting_cost=0.0),
+        AgentDef("a2", default_hosting_cost=0.1),
+    ]
+    # big's footprint (3.0) exceeds every agent's remaining capacity;
+    # small (1.0) fits exactly one agent
+    placed = repair_placement(
+        {"big": ["a1", "a2"], "small": ["a1", "a2"]},
+        agents,
+        remaining_capacity={"a1": 1.0, "a2": 0.0},
+        footprint=lambda c: 3.0 if c == "big" else 1.0,
+        seed=1,
+    )
+    assert placed == {"small": "a1"}
+
+    # zero capacity everywhere: nothing can be re-hosted at all
+    placed = repair_placement(
+        {"c1": ["a1", "a2"]},
+        agents,
+        remaining_capacity={"a1": 0.0, "a2": 0.0},
+        footprint=lambda c: 1.0,
+        seed=1,
+    )
+    assert placed == {}
+
+    # an agent missing from the capacity map counts as capacity 0,
+    # not unlimited (the conservative reading of "unknown")
+    placed = repair_placement(
+        {"c1": ["a3"]},
+        [AgentDef("a3")],
+        remaining_capacity={},
+        footprint=lambda c: 1.0,
+    )
+    assert placed == {}
+
+
 def test_repair_single_candidate_no_engine():
     # all-singleton candidate lists take the fast path (no solve)
     placed = repair_placement(
