@@ -83,8 +83,14 @@ class DbaIsland(LockstepIsland):
         self._improve = None
         self._candidate = None
         self._violated = None  # bool[C] under the pre-move assignment
-        self._jit_sweep = jax.jit(self._make_sweep())
-        self._jit_decide = jax.jit(self._make_decide())
+        from pydcop_tpu.telemetry.jit import profiled_jit
+
+        self._jit_sweep = profiled_jit(
+            self._make_sweep(), label="island-dba-sweep"
+        )
+        self._jit_decide = profiled_jit(
+            self._make_decide(), label="island-dba-decide"
+        )
 
     def _make_sweep(self):
         # the batched kernel's OWN formulas (algorithms.dba), so the

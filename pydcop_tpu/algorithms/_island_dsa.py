@@ -131,7 +131,12 @@ class DsaIsland:
         self._state = self._module.init_state(
             self._problem, self._key, params
         )
-        self._jit_step = jax.jit(self._make_step(), static_argnums=(3,))
+        from pydcop_tpu.telemetry.jit import profiled_jit
+
+        self._jit_step = profiled_jit(
+            self._make_step(), label="island-dsa-step",
+            static_argnums=(3,),
+        )
 
     # -- wiring ----------------------------------------------------------
 

@@ -109,8 +109,14 @@ class GdbaIsland(LockstepIsland):
         self._improve = None
         self._candidate = None
         self._violated = {}  # (k, row) -> bool, pre-move assignment
-        self._jit_metrics = jax.jit(self._make_metrics())
-        self._jit_decide = jax.jit(self._make_decide())
+        from pydcop_tpu.telemetry.jit import profiled_jit
+
+        self._jit_metrics = profiled_jit(
+            self._make_metrics(), label="island-gdba-metrics"
+        )
+        self._jit_decide = profiled_jit(
+            self._make_decide(), label="island-gdba-decide"
+        )
 
     def _make_metrics(self):
         from pydcop_tpu.algorithms.gdba import effective_metrics

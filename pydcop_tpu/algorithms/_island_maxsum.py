@@ -188,8 +188,11 @@ class MaxSumIsland:
         self._flushed_once = False
 
         # n_rounds static: two jit cache entries (start burst + steady)
-        self._jit_step = jax.jit(
-            self._make_step(), static_argnums=(3,)
+        from pydcop_tpu.telemetry.jit import profiled_jit
+
+        self._jit_step = profiled_jit(
+            self._make_step(), label="island-maxsum-step",
+            static_argnums=(3,),
         )
         self._key0 = jax.random.PRNGKey(0)
 

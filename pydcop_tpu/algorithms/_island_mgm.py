@@ -62,8 +62,14 @@ class MgmIsland(LockstepIsland):
         self._candidate = None  # np[n] argmin candidates after phase 0
         self._values_dev = None  # device copy threaded through the
         # no-boundary interior loop (avoids an upload per round)
-        self._jit_sweep = jax.jit(self._make_sweep())
-        self._jit_decide = jax.jit(self._make_decide())
+        from pydcop_tpu.telemetry.jit import profiled_jit
+
+        self._jit_sweep = profiled_jit(
+            self._make_sweep(), label="island-mgm-sweep"
+        )
+        self._jit_decide = profiled_jit(
+            self._make_decide(), label="island-mgm-decide"
+        )
 
     def _make_sweep(self):
         import jax.numpy as jnp

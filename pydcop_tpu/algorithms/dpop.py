@@ -162,6 +162,10 @@ def solve_host(
     if use_device == "always":
         device_min_cells = 0
 
+    from pydcop_tpu.telemetry import get_tracer
+
+    tracer = get_tracer()
+
     def one_pass(domains_p, owned_p):
         """One full UTIL+VALUE run (device path w/ host fallback).
         Returns (assignment, stats dict) or None on timeout."""
@@ -193,6 +197,11 @@ def solve_host(
         if util_stats is None:
             return None
         best_choice, util_cells, device_nodes, host_nodes = util_stats
+        t_value = time.perf_counter()
+        tracer.add_span(
+            "util-phase", "phase", t_util, t_value - t_util,
+            algo="dpop", backend=util_backend, cells=util_cells,
+        )
 
         # VALUE phase: pre-order
         assignment: Dict[str, Any] = {}
@@ -203,6 +212,10 @@ def solve_host(
                 best = int(amin[tuple(idx[d] for d in sep)])
                 idx[name] = best
                 assignment[name] = domains_p[name][best]
+        tracer.add_span(
+            "value-phase", "phase", t_value,
+            time.perf_counter() - t_value, algo="dpop",
+        )
         return assignment, {
             "util_time": time.perf_counter() - t_util,
             "util_backend": util_backend,
@@ -654,7 +667,11 @@ def _join_kernel(
         # back would be dead transfer
         return amin, margins
 
-    fn = jax.jit(jax.vmap(join) if batched else join)
+    from pydcop_tpu.telemetry.jit import profiled_jit
+
+    fn = profiled_jit(
+        jax.vmap(join) if batched else join, label="dpop-join"
+    )
     _JOIN_KERNELS[key] = fn
     return fn
 

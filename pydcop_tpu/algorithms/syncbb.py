@@ -121,6 +121,7 @@ def solve_host(
     order_stack[0] = np.argsort(rows[0], kind="stable")
     cursor[0] = 0
     status = "finished"
+    t_search = time.perf_counter()
     while i >= 0:
         if timeout is not None and time.perf_counter() - t0 > timeout:
             status = "timeout"
@@ -148,6 +149,12 @@ def solve_host(
         order_stack[i] = np.argsort(rows[i], kind="stable")
         cursor[i] = 0
 
+    from pydcop_tpu.telemetry import get_tracer
+
+    get_tracer().add_span(
+        "search", "phase", t_search, time.perf_counter() - t_search,
+        algo="syncbb", token_moves=token_moves,
+    )
     if best_idx is None:
         return {
             "assignment": {},

@@ -45,8 +45,19 @@ def solve(
     k_target: int = 0,
     chaos: Optional[str] = None,
     chaos_seed: int = 0,
+    trace: Optional[str] = None,
+    trace_format: str = "jsonl",
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
+
+    Every call runs inside a telemetry session
+    (``pydcop_tpu.telemetry``, ``docs/observability.md``): per-phase
+    span totals, jit compile stats, and message-plane counters land in
+    ``result["telemetry"]`` uniformly across engines.  ``trace`` also
+    writes the full span/event timeline to that file —
+    ``trace_format`` picks ``"jsonl"`` (one record per line) or
+    ``"chrome"`` (open in chrome://tracing / Perfetto) — including
+    per-message and injected-fault events.
 
     Parameters mirror the reference ``solve()``: the dcop (object or
     yaml path), the algorithm name (or AlgorithmDef carrying params),
@@ -94,6 +105,49 @@ def solve(
     >>> result = solve(my_dcop, "dsa", {"variant": "B"}, rounds=100)
     >>> result["assignment"], result["cost"]
     """
+    from pydcop_tpu.telemetry import session
+
+    with session(trace, trace_format) as tel:
+        result = _solve_dispatch(
+            dcop, algo, algo_params, rounds=rounds, timeout=timeout,
+            seed=seed, convergence_chunks=convergence_chunks,
+            chunk_size=chunk_size, checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every, resume=resume,
+            mode=mode, ui_port=ui_port, n_restarts=n_restarts,
+            nb_agents=nb_agents, msg_log=msg_log,
+            accel_agents=accel_agents, distribution=distribution,
+            k_target=k_target, chaos=chaos, chaos_seed=chaos_seed,
+        )
+        result["telemetry"] = tel.summary()
+    return result
+
+
+def _solve_dispatch(
+    dcop,
+    algo,
+    algo_params,
+    *,
+    rounds,
+    timeout,
+    seed,
+    convergence_chunks,
+    chunk_size,
+    checkpoint_path,
+    checkpoint_every,
+    resume,
+    mode,
+    ui_port,
+    n_restarts,
+    nb_agents,
+    msg_log,
+    accel_agents,
+    distribution,
+    k_target,
+    chaos,
+    chaos_seed,
+) -> Dict[str, Any]:
+    """Mode dispatch behind :func:`solve` (which owns the telemetry
+    session and the ``result["telemetry"]`` attach)."""
     if isinstance(dcop, (str, list, tuple)):
         dcop = load_dcop_from_file(dcop)
 

@@ -3,7 +3,8 @@
 ``python -m pydcop_tpu <command> ...`` with one module per subcommand
 under ``pydcop_tpu/commands/`` — the same layout as the reference CLI:
 solve, run, graph, distribute, generate, batch, consolidate,
-replica_dist, orchestrator, agent.
+replica_dist, orchestrator, agent; plus trace-summary (telemetry
+trace aggregation, ``docs/observability.md``).
 """
 
 from __future__ import annotations
@@ -27,6 +28,9 @@ COMMANDS = [
     "orchestrator",
     "agent",
     "worker",
+    # telemetry trace aggregation (module trace_summary registers the
+    # subcommand as `trace-summary`)
+    "trace_summary",
 ]
 
 

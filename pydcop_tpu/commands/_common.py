@@ -44,6 +44,23 @@ def parse_algo_params(items: List[str]) -> Dict[str, str]:
     return out
 
 
+def add_trace_arguments(parser) -> None:
+    """``--trace``/``--trace_format``: structured telemetry trace
+    output (``pydcop_tpu.telemetry``, ``docs/observability.md``)."""
+    parser.add_argument(
+        "--trace", type=str, default=None, metavar="FILE",
+        help="write a structured telemetry trace (cycle/phase spans, "
+        "jit compiles, message + injected-fault events) to FILE; "
+        "inspect with `pydcop_tpu trace-summary FILE`",
+    )
+    parser.add_argument(
+        "--trace_format", choices=["jsonl", "chrome"], default="jsonl",
+        help="trace file format: jsonl (one record per line, the "
+        "trace-summary input) or chrome (trace_event JSON for "
+        "chrome://tracing / Perfetto)",
+    )
+
+
 def add_collect_arguments(parser) -> None:
     parser.add_argument(
         "--collect_on",
@@ -145,10 +162,20 @@ def write_metrics(args, result: Dict[str, Any]) -> None:
     if getattr(args, "end_metrics", None):
         import os
 
-        exists = os.path.exists(args.end_metrics)
+        # NOTE the run/end asymmetry (documented in docs/cli.md):
+        # --run_metrics describes ONE run and truncates ("w"); in
+        # contrast --end_metrics accumulates one row per run across
+        # invocations ("a").  The header goes in only when the file is
+        # being created (or is empty) — never into the middle of an
+        # existing file, so legacy header-less files keep appending
+        # data rows instead of getting a header wedged mid-stream.
+        needs_header = (
+            not os.path.exists(args.end_metrics)
+            or os.path.getsize(args.end_metrics) == 0
+        )
         with open(args.end_metrics, "a", newline="") as f:
             w = csv.writer(f)
-            if not exists:
+            if needs_header:
                 w.writerow(
                     ["status", "cost", "cycle", "msg_count", "time"]
                 )

@@ -51,6 +51,9 @@ def set_parser(subparsers) -> None:
         "batched solve as a jax.distributed process; host: "
         "message-driven computations over TCP)",
     )
+    from pydcop_tpu.commands._common import add_trace_arguments
+
+    add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
 
 
@@ -93,6 +96,14 @@ def run_cmd(args) -> int:
                     if args.chaos
                     else []
                 )
+                + (
+                    [
+                        "--trace", f"{args.trace}.{name}",
+                        "--trace_format", args.trace_format,
+                    ]
+                    if args.trace
+                    else []
+                )
             )
             for name in args.names
         ]
@@ -101,22 +112,29 @@ def run_cmd(args) -> int:
             rc = rc or p.wait()
         return rc
 
+    # each agent process traces into its own file (the telemetry
+    # session is process-local by design, docs/observability.md)
+    from pydcop_tpu.telemetry import session
+
     if args.runtime == "host":
         from pydcop_tpu.infrastructure.hostnet import run_host_agent
 
-        result = run_host_agent(
-            args.names[0], args.orchestrator, retry_for=args.retry_for,
-            msg_log=args.msg_log,
-            chaos=args.chaos, chaos_seed=args.chaos_seed,
-        )
+        with session(args.trace, args.trace_format):
+            result = run_host_agent(
+                args.names[0], args.orchestrator,
+                retry_for=args.retry_for,
+                msg_log=args.msg_log,
+                chaos=args.chaos, chaos_seed=args.chaos_seed,
+            )
         print(json.dumps(result))
         return 0
 
     from pydcop_tpu.infrastructure.orchestrator import run_agent
 
-    result = run_agent(
-        args.orchestrator, args.names[0], retry_for=args.retry_for
-    )
+    with session(args.trace, args.trace_format):
+        result = run_agent(
+            args.orchestrator, args.names[0], retry_for=args.retry_for
+        )
     print(
         json.dumps(
             {

@@ -16,10 +16,12 @@ from __future__ import annotations
 
 from pydcop_tpu.commands._common import (
     add_collect_arguments,
+    add_trace_arguments,
     parse_algo_params,
     write_metrics,
     write_result,
 )
+from pydcop_tpu.telemetry import session as telemetry_session
 
 
 def set_parser(subparsers) -> None:
@@ -135,6 +137,7 @@ def set_parser(subparsers) -> None:
         "agents need no accelerator)",
     )
     add_collect_arguments(p)
+    add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
 
 
@@ -255,25 +258,27 @@ def run_cmd(args) -> int:
         except ValueError as e:
             raise SystemExit(f"orchestrator: {e}")
         try:
-            result = run_host_orchestrator(
-                dcop,
-                args.algo,
-                parse_algo_params(args.algo_params),
-                nb_agents=args.nb_agents,
-                port=args.port,
-                rounds=args.rounds,
-                timeout=args.timeout,
-                seed=args.seed,
-                register_timeout=args.register_timeout,
-                distribution=dist_name,
-                placement=placement,
-                ui_port=args.uiport,
-                accel_agents=args.accel_agents,
-                k_target=args.ktarget or 0,
-                chaos=args.chaos,
-                chaos_seed=args.chaos_seed,
-                grace_period=args.grace_period,
-            )
+            with telemetry_session(args.trace, args.trace_format) as tel:
+                result = run_host_orchestrator(
+                    dcop,
+                    args.algo,
+                    parse_algo_params(args.algo_params),
+                    nb_agents=args.nb_agents,
+                    port=args.port,
+                    rounds=args.rounds,
+                    timeout=args.timeout,
+                    seed=args.seed,
+                    register_timeout=args.register_timeout,
+                    distribution=dist_name,
+                    placement=placement,
+                    ui_port=args.uiport,
+                    accel_agents=args.accel_agents,
+                    k_target=args.ktarget or 0,
+                    chaos=args.chaos,
+                    chaos_seed=args.chaos_seed,
+                    grace_period=args.grace_period,
+                )
+                result["telemetry"] = tel.summary()
         except PlacementError as e:  # usage errors: clean exit
             raise SystemExit(f"orchestrator: {e}")
         write_metrics(args, result)
@@ -295,7 +300,30 @@ def run_cmd(args) -> int:
                 "orchestrator: --elastic and --scenario are separate "
                 "dynamics modes (reactive vs scripted); use one"
             )
-        result = run_elastic_orchestrator(
+        with telemetry_session(args.trace, args.trace_format) as tel:
+            result = run_elastic_orchestrator(
+                dcop_yaml,
+                args.algo,
+                parse_algo_params(args.algo_params),
+                port=args.port,
+                nb_agents=args.nb_agents,
+                rounds=args.rounds,
+                seed=args.seed,
+                chunk_size=args.chunk_size,
+                timeout=args.timeout,
+                advertise_host=args.advertise_host,
+                heartbeat_timeout=args.heartbeat_timeout,
+                k_target=args.ktarget,
+                ui_port=args.uiport,
+                abort_grace=args.abort_grace,
+                first_barrier_min=args.first_barrier_min,
+            )
+            result["telemetry"] = tel.summary()
+        write_result(args, result)
+        return 0
+
+    with telemetry_session(args.trace, args.trace_format) as tel:
+        result = run_orchestrator(
             dcop_yaml,
             args.algo,
             parse_algo_params(args.algo_params),
@@ -307,31 +335,12 @@ def run_cmd(args) -> int:
             timeout=args.timeout,
             advertise_host=args.advertise_host,
             heartbeat_timeout=args.heartbeat_timeout,
+            abort_grace=args.abort_grace,
+            scenario_yaml=scenario_yaml,
             k_target=args.ktarget,
             ui_port=args.uiport,
-            abort_grace=args.abort_grace,
-            first_barrier_min=args.first_barrier_min,
         )
-        write_result(args, result)
-        return 0
-
-    result = run_orchestrator(
-        dcop_yaml,
-        args.algo,
-        parse_algo_params(args.algo_params),
-        port=args.port,
-        nb_agents=args.nb_agents,
-        rounds=args.rounds,
-        seed=args.seed,
-        chunk_size=args.chunk_size,
-        timeout=args.timeout,
-        advertise_host=args.advertise_host,
-        heartbeat_timeout=args.heartbeat_timeout,
-        abort_grace=args.abort_grace,
-        scenario_yaml=scenario_yaml,
-        k_target=args.ktarget,
-        ui_port=args.uiport,
-    )
+        result["telemetry"] = tel.summary()
     write_metrics(args, result)
     result.pop("cost_trace", None)  # keep the printed JSON compact
     result.pop("trace_subsampled", None)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from pydcop_tpu.commands._common import (
     add_collect_arguments,
+    add_trace_arguments,
     parse_algo_params,
     write_metrics,
     write_result,
@@ -64,6 +65,7 @@ def set_parser(subparsers) -> None:
     )
     p.add_argument("--seed", type=int, default=0)
     add_collect_arguments(p)
+    add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
 
 
@@ -140,20 +142,24 @@ def run_cmd(args) -> int:
             "or --chaos 'crash=AGENT@T,...'"
         )
     params = parse_algo_params(args.algo_params)
+    from pydcop_tpu.telemetry import session
+
     try:
-        result = run_dynamic(
-            dcop,
-            args.algo,
-            params,
-            scenario=scenario,
-            distribution=args.distribution,
-            k_target=args.ktarget,
-            rounds_per_second=args.rounds_per_second,
-            final_rounds=args.final_rounds,
-            seed=args.seed,
-            timeout=args.timeout,
-            repair_algo=args.repair_algo,
-        )
+        with session(args.trace, args.trace_format) as tel:
+            result = run_dynamic(
+                dcop,
+                args.algo,
+                params,
+                scenario=scenario,
+                distribution=args.distribution,
+                k_target=args.ktarget,
+                rounds_per_second=args.rounds_per_second,
+                final_rounds=args.final_rounds,
+                seed=args.seed,
+                timeout=args.timeout,
+                repair_algo=args.repair_algo,
+            )
+            result["telemetry"] = tel.summary()
     except (ValueError, ImpossibleDistributionException) as e:
         raise SystemExit(f"run: {e}")
     if chaos_plan is not None:  # replay record: spec + seed

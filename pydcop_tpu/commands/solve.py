@@ -11,6 +11,7 @@ import sys
 
 from pydcop_tpu.commands._common import (
     add_collect_arguments,
+    add_trace_arguments,
     parse_algo_params,
     write_metrics,
     write_result,
@@ -108,6 +109,7 @@ def set_parser(subparsers) -> None:
         "restarts for stochastic algorithms",
     )
     add_collect_arguments(p)
+    add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
 
 
@@ -144,6 +146,8 @@ def run_cmd(args) -> int:
             distribution=args.distribution,
             chaos=args.chaos,
             chaos_seed=args.chaos_seed,
+            trace=args.trace,
+            trace_format=args.trace_format,
         )
     finally:
         # flush the trace even when the solve raises — a profile of a

@@ -1,0 +1,49 @@
+"""``pydcop_tpu trace-summary`` — aggregate a telemetry trace.
+
+Reads a ``--trace`` file (JSONL or Chrome ``trace_event`` format,
+auto-detected) and prints per-phase span totals, event counts,
+injected-fault counts, per-agent activity, and the embedded metrics
+snapshot.  See ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "trace-summary",
+        help="aggregate a --trace telemetry file (per-phase / "
+        "per-agent totals)",
+    )
+    p.add_argument("trace_file", help="trace file (jsonl or chrome)")
+    p.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="print the aggregates as JSON instead of a table",
+    )
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    from pydcop_tpu.telemetry.summary import (
+        format_summary,
+        load_trace,
+        summarize,
+    )
+
+    try:
+        records = load_trace(args.trace_file)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"trace-summary: {e}")
+    s = summarize(records)
+    out = (
+        json.dumps(s, indent=2, default=str)
+        if args.as_json
+        else format_summary(s)
+    )
+    print(out)
+    if getattr(args, "output", None):
+        with open(args.output, "w") as f:
+            f.write(out + "\n")
+    return 0

@@ -27,6 +27,8 @@ from jax.sharding import PartitionSpec as P
 
 from pydcop_tpu.ops.compile import CompiledProblem, decode_assignment
 from pydcop_tpu.ops.costs import total_cost
+from pydcop_tpu.telemetry import get_metrics, get_tracer
+from pydcop_tpu.telemetry.jit import profiled_jit
 
 
 @dataclasses.dataclass
@@ -487,13 +489,20 @@ def run_batched(
             is_leaf=lambda x: isinstance(x, P),
         )
 
+    met = get_metrics()
+
     def make_runner(n: int):
         cache_key = cache_key_base + (n,)
         if cache_key in _RUNNER_CACHE:
+            if met.enabled:
+                met.inc("engine.runner_cache_hits")
             return _RUNNER_CACHE[cache_key]
+        if met.enabled:
+            met.inc("engine.runner_cache_misses")
         fn = _chunk_runner(algo_step, n, axis_name, cost_every, cost_fn)
+        label = f"chunk[{algo_module.__name__.rsplit('.', 1)[-1]}:{n}]"
         if mesh is None:
-            runner = jax.jit(fn)
+            runner = profiled_jit(fn, label=label)
         else:
             from pydcop_tpu.parallel.mesh import problem_pspecs, state_pspecs
 
@@ -507,7 +516,7 @@ def run_batched(
                 out_specs=(sspecs, P(), P(), P()),
                 check_vma=False,
             )
-            runner = jax.jit(sharded)
+            runner = profiled_jit(sharded, label=label)
         _RUNNER_CACHE[cache_key] = runner
         return runner
 
@@ -529,6 +538,7 @@ def run_batched(
     chunks_since_save = 0
     prev_best = _best_scalar(best_cost)
     prev_values = np.asarray(best_values)
+    tr = get_tracer()
     while done < rounds:
         this_chunk = min(chunk_size, rounds - done)
         if this_chunk == min(chunk_size, rounds):
@@ -538,10 +548,16 @@ def run_batched(
                 small_runner = (this_chunk, make_runner(this_chunk))
             r = small_runner[1]
         k_chunk = jax.random.fold_in(k_run, done)
-        state, best_cost, best_values, costs = r(
-            problem, state, k_chunk, dyn_params, best_cost, best_values
-        )
-        costs_np = np.asarray(costs)
+        # the cycle span covers dispatch AND the host sync on the cost
+        # trace — the wall-clock a chunk of rounds actually costs
+        with tr.span("cycle", cat="cycle", first=done, rounds=this_chunk):
+            state, best_cost, best_values, costs = r(
+                problem, state, k_chunk, dyn_params, best_cost, best_values
+            )
+            costs_np = np.asarray(costs)
+        if met.enabled:
+            met.inc("engine.chunks")
+            met.inc("engine.rounds", this_chunk)
         if batched_restarts:
             costs_np = costs_np.min(axis=-1)
         traces.append(costs_np)
@@ -551,20 +567,23 @@ def run_batched(
             if chunks_since_save >= max(1, checkpoint_every):
                 from pydcop_tpu.engine.checkpoint import save_checkpoint
 
-                save_checkpoint(
-                    checkpoint_path, state, best_cost, best_values,
-                    done,
-                    {
-                        "algo": algo_module.__name__,
-                        "seed": seed,
-                        "chunk_size": chunk_size,
-                        "problem": fingerprint,
-                        "n_restarts": n_restarts,
-                    },
-                    static_keys=getattr(
-                        algo_module, "STATIC_STATE_KEYS", ()
-                    ),
-                )
+                with tr.span("checkpoint", cat="checkpoint", round=done):
+                    save_checkpoint(
+                        checkpoint_path, state, best_cost, best_values,
+                        done,
+                        {
+                            "algo": algo_module.__name__,
+                            "seed": seed,
+                            "chunk_size": chunk_size,
+                            "problem": fingerprint,
+                            "n_restarts": n_restarts,
+                        },
+                        static_keys=getattr(
+                            algo_module, "STATIC_STATE_KEYS", ()
+                        ),
+                    )
+                if met.enabled:
+                    met.inc("engine.checkpoints")
                 chunks_since_save = 0
         if chunk_callback is not None and done < rounds:
             # callbacks marked wants_values also receive the CURRENT

@@ -188,6 +188,9 @@ def run_dynamic(
             problem_fingerprint,
         )
 
+        from pydcop_tpu.telemetry import get_tracer
+
+        t_seg = time.perf_counter()
         ad = active_dcop()
         if not ad.variables:
             return False  # everything frozen/lost
@@ -232,6 +235,10 @@ def run_dynamic(
             state_transfers += 1
         if result.status == "timeout":
             status = "timeout"
+        get_tracer().add_span(
+            "segment", "cycle", t_seg, time.perf_counter() - t_seg,
+            rounds=result.cycles, state_carried=carried,
+        )
         return carried
 
     def remove_agent(name: str) -> Dict[str, Any]:
@@ -257,14 +264,17 @@ def run_dynamic(
             - sum(footprint(c) for c in dist.computations_hosted(a))
             for a in live_agents
         }
-        placed = repair_placement(
-            candidates,
-            live_agents.values(),
-            remaining_capacity=remaining_cap,
-            footprint=footprint,
-            algo=repair_algo,
-            seed=seed,
-        )
+        from pydcop_tpu.telemetry import get_tracer
+
+        with get_tracer().span("repair", cat="repair", agent=name):
+            placed = repair_placement(
+                candidates,
+                live_agents.values(),
+                remaining_capacity=remaining_cap,
+                footprint=footprint,
+                algo=repair_algo,
+                seed=seed,
+            )
         lost = []
         for comp in orphans:
             if comp in placed:

@@ -35,6 +35,7 @@ from pydcop_tpu.infrastructure.communication import (
     UnreachableAgent,
 )
 from pydcop_tpu.infrastructure.computations import Message
+from pydcop_tpu.telemetry import get_metrics, get_tracer
 
 logger = logging.getLogger(__name__)
 
@@ -153,8 +154,21 @@ class ChaosCommunicationLayer(CommunicationLayer):
     # -- event record ---------------------------------------------------
 
     def _record(self, kind: str, dest: str, seq: int) -> None:
+        link = f"{self.src_agent}>{dest}"
         with self._lock:
-            self.events.append((kind, f"{self.src_agent}>{dest}", seq))
+            self.events.append((kind, link, seq))
+        # injected faults land on the run's telemetry timeline (same
+        # trace as cycle/message events) — only when a session is
+        # active, and always outside the lock
+        met = get_metrics()
+        if met.enabled:
+            met.inc(f"fault.{kind}")
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event(
+                kind, cat="fault", link=link, seq=seq,
+                seed=self.plan.seed,
+            )
 
     def event_summary(self) -> Dict[str, int]:
         with self._lock:

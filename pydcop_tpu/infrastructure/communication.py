@@ -20,6 +20,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from pydcop_tpu.infrastructure.computations import Message
+from pydcop_tpu.telemetry import get_metrics, get_tracer
 
 # priority classes: lower value = delivered first
 MSG_MGT = 10
@@ -183,6 +184,18 @@ class Messaging:
                 self._heap, (priority, self._seq, src_comp, dest_comp, msg)
             )
             self._cond.notify()
+        # telemetry outside the lock: one attribute check when disabled
+        # (docs/observability.md overhead notes)
+        met = get_metrics()
+        if met.enabled:
+            met.inc("msg.delivered")
+            met.inc("msg.size", msg.size)
+        tr = get_tracer()
+        if tr.detailed:
+            tr.event(
+                "deliver", cat="message", agent=self.agent_name,
+                src=src_comp, dest=dest_comp, type=msg.type,
+            )
         if self.msg_log is not None:  # outside the lock: file IO
             self.msg_log.log(self.agent_name, src_comp, dest_comp, msg)
 

@@ -54,6 +54,7 @@ from pydcop_tpu.infrastructure.communication import (
     UnreachableAgent,
 )
 from pydcop_tpu.infrastructure.computations import Message
+from pydcop_tpu.telemetry import get_metrics, get_tracer
 
 _ENC = "utf-8"
 
@@ -193,6 +194,9 @@ class TcpCommunicationLayer(CommunicationLayer):
                 if not line:
                     return
                 frame = json.loads(line.decode(_ENC))
+                met = get_metrics()
+                if met.enabled:
+                    met.inc("hostnet.recv_frames")
                 sender = frame.get("sa")
                 if sender is not None:
                     # reconnect-resend dedupe: a writer that lost its
@@ -202,9 +206,19 @@ class TcpCommunicationLayer(CommunicationLayer):
                     # below the high-water mark was already delivered
                     sq = int(frame.get("sq", 0))
                     with self._lock:
-                        if sq <= self._last_seq.get(sender, 0):
-                            continue
-                        self._last_seq[sender] = sq
+                        duplicate = sq <= self._last_seq.get(sender, 0)
+                        if not duplicate:
+                            self._last_seq[sender] = sq
+                    if duplicate:
+                        if met.enabled:
+                            met.inc("hostnet.dedupe_dropped")
+                        tr = get_tracer()
+                        if tr.enabled:
+                            tr.event(
+                                "dedupe-drop", cat="message",
+                                sender=sender, seq=sq,
+                            )
+                        continue
                 messaging = self.discovery.get(frame["da"])
                 if messaging is None:
                     continue  # late frame for a stopped agent
@@ -230,6 +244,9 @@ class TcpCommunicationLayer(CommunicationLayer):
         msg: Message,
         priority: int = MSG_ALGO,
     ) -> None:
+        met = get_metrics()
+        if met.enabled:
+            met.inc("hostnet.sent")
         local = self.discovery.get(dest_agent)
         if local is not None:  # same process: no serialization
             local.deliver(src_comp, dest_comp, msg, priority)
@@ -314,6 +331,12 @@ class TcpCommunicationLayer(CommunicationLayer):
                     )
                 conn_box[0].sendall(payload)
             except OSError:
+                met = get_metrics()
+                if met.enabled:
+                    # every failed attempt becomes a backoff retry
+                    # (unless the window is spent — the dead-link
+                    # counter below records that outcome)
+                    met.inc("hostnet.retries")
                 c, conn_box[0] = conn_box[0], None
                 if c is not None:
                     try:
@@ -347,6 +370,15 @@ class TcpCommunicationLayer(CommunicationLayer):
                 ch.dead = ch.dead or str(e)
                 ch.frames = []
                 ch.cond.notify_all()
+            met = get_metrics()
+            if met.enabled:
+                met.inc("hostnet.dead_links")
+            tr = get_tracer()
+            if tr.enabled:
+                tr.event(
+                    "link-dead", cat="message", peer=dest_agent,
+                    error=str(e),
+                )
             cb = self.on_send_error
             if cb is not None:
                 cb(dest_agent, e)
@@ -485,6 +517,7 @@ def run_host_orchestrator(
     from pydcop_tpu.graphs import load_graph_module
 
     t0 = time.perf_counter()
+    tracer = get_tracer()
     module = load_algorithm_module(algo)
     if not hasattr(module, "build_computation"):
         raise ValueError(
@@ -514,6 +547,10 @@ def run_host_orchestrator(
             chaos_plan = FaultPlan.from_spec(chaos, chaos_seed)
         except FaultSpecError as e:
             raise PlacementError(str(e)) from e
+        if tracer.enabled:
+            tracer.event(
+                "chaos-plan", cat="fault", spec=chaos, seed=chaos_seed
+            )
     graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
         dcop
     )
@@ -590,6 +627,7 @@ def run_host_orchestrator(
     newly_dead: List[str] = []
 
     try:
+        t_reg = time.perf_counter()
         while len(peers) < nb_agents:
             try:
                 conn, peer_addr = server.accept()
@@ -629,6 +667,10 @@ def run_host_orchestrator(
             # the IP its control connection came from
             addresses[name] = (peer_addr[0], int(reg["msg_port"]))
 
+        tracer.add_span(
+            "register", "phase", t_reg,
+            time.perf_counter() - t_reg, agents=len(peers),
+        )
         agent_names = sorted(peers)
 
         # a chaos clause naming a nonexistent agent would silently
@@ -728,6 +770,7 @@ def run_host_orchestrator(
                 _Dist(placement), agent_defs.values(), k_target
             )
 
+        t_dep = time.perf_counter()
         yaml_text = dcop_yaml(dcop)
         directory = {a: list(addresses[a]) for a in agent_names}
         for name, (conn, _) in peers.items():
@@ -769,6 +812,10 @@ def run_host_orchestrator(
                 conn.settimeout(poll_timeout)
             if not ack or ack.get("type") != "deployed":
                 raise AgentFailureError(f"agent {name} failed to deploy")
+        tracer.add_span(
+            "deploy", "phase", t_dep, time.perf_counter() - t_dep,
+            agents=len(peers),
+        )
 
         for name in peers:
             try:
@@ -856,6 +903,7 @@ def run_host_orchestrator(
             newly_dead.clear()
             if not dead:
                 return
+            t_rep = time.perf_counter()
             dead_ever.update(dead)
             from pydcop_tpu.dcop.objects import AgentDef
             from pydcop_tpu.replication.repair import repair_placement
@@ -915,10 +963,16 @@ def run_host_orchestrator(
             # a second failure DURING migration lands in newly_dead
             # and the next sweep handles it against the updated state
             migrations.append({"dead": dead, "moved": dict(chosen)})
+            tracer.add_span(
+                "repair", "repair", t_rep,
+                time.perf_counter() - t_rep,
+                dead=",".join(dead), moved=len(chosen),
+            )
             suspects.clear()
             ledger_void = True
 
         # run loop: poll status until quiescent / budget / timeout
+        t_run = time.perf_counter()
         max_msgs = rounds * max(len(comp_names), 1)
         status = "finished"
         degraded_info: Optional[Dict[str, Any]] = None
@@ -1018,6 +1072,10 @@ def run_host_orchestrator(
             else:
                 stable = 0
             last_total = total
+        tracer.add_span(
+            "deliver-loop", "phase", t_run,
+            time.perf_counter() - t_run, status=status,
+        )
 
         if degraded_info is not None:
             # graceful degradation: a permanent message-plane failure
@@ -1204,6 +1262,8 @@ def run_host_agent(
             f"agent {name}: expected deploy, got {dep!r}"
         )
 
+    tracer = get_tracer()
+    t_dep = time.perf_counter()
     dcop = load_dcop(dep["dcop_yaml"])
     module = load_algorithm_module(dep["algo"])
     graph = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
@@ -1241,6 +1301,11 @@ def run_host_agent(
             comm.close()  # a malformed LOCAL spec (the orchestrator
             # validates its own before deploying)
             raise
+        if tracer.enabled:
+            tracer.event(
+                "chaos-plan", cat="fault",
+                spec=plan.spec, seed=plan.seed, agent=name,
+            )
         chaos_layer = ChaosCommunicationLayer(
             comm,
             plan,
@@ -1307,6 +1372,10 @@ def run_host_agent(
         ]
     for comp in computations:
         agent.deploy_computation(comp)
+    tracer.add_span(
+        "deploy", "phase", t_dep, time.perf_counter() - t_dep,
+        agent=name, computations=len(computations),
+    )
     _send(conn, {"type": "deployed", "n": len(computations)})
 
     delivered = 0

@@ -188,7 +188,9 @@ def solve_host(
     """
     t0 = time.perf_counter()
     from pydcop_tpu.algorithms import resolve_algo
+    from pydcop_tpu.telemetry import get_tracer
 
+    tracer = get_tracer()
     algo_name, params_in = resolve_algo(algo, algo_params)
     module = load_algorithm_module(algo_name)
     params = prepare_algo_params(params_in, module.algo_params)
@@ -205,6 +207,12 @@ def solve_host(
         from pydcop_tpu.faults import FaultPlan
 
         chaos_plan = FaultPlan.from_spec(chaos, chaos_seed)
+        if tracer.enabled:
+            # the plan lands on the trace timeline so injected-fault
+            # events downstream carry their seed/spec provenance
+            tracer.event(
+                "chaos-plan", cat="fault", spec=chaos, seed=chaos_seed
+            )
         if chaos_plan.crashes:
             raise ValueError(
                 "chaos crash=AGENT@T schedules hard-kill an agent OS "
@@ -253,11 +261,12 @@ def solve_host(
             hints=dcop.dist_hints, algo_module=module,
         )
 
-    computations, placement = _build_computations(
-        dcop, algo_name, params, seed,
-        distribution=distribution, accel=accel,
-        pending_refs=pending_refs, graph=graph,
-    )
+    with tracer.span("build-computations", cat="phase", algo=algo_name):
+        computations, placement = _build_computations(
+            dcop, algo_name, params, seed,
+            distribution=distribution, accel=accel,
+            pending_refs=pending_refs, graph=graph,
+        )
 
     if max_msgs is None:
         max_msgs = (
@@ -282,6 +291,10 @@ def solve_host(
         cost = dcop.solution_cost(assignment)
         trace.append(cost)
         trace_msgs.append(delivered)
+        if tracer.enabled:
+            tracer.event(
+                "snapshot", cat="cycle", cost=cost, delivered=delivered
+            )
         if sign * cost < best["cost"]:
             best["cost"] = sign * cost
             best["assignment"] = assignment
@@ -293,20 +306,21 @@ def solve_host(
         log = MessageLog(msg_log)
     chaos_info: Dict[str, Any] = {}  # filled by _run_threads (events)
     try:
-        if mode == "sim":
-            status, delivered, size = _run_sim(
-                computations, timeout, max_msgs, seed, t0, snapshot,
-                msg_log=log, pending_refs=pending_refs,
-            )
-        elif mode == "thread":
-            status, delivered, size = _run_threads(
-                dcop, computations, timeout, max_msgs, distribution, t0,
-                snapshot, msg_log=log, placement=placement,
-                pending_refs=pending_refs, chaos_plan=chaos_plan,
-                chaos_info=chaos_info,
-            )
-        else:
-            raise ValueError(f"solve_host: unknown mode {mode!r}")
+        with tracer.span("deliver-loop", cat="phase", mode=mode):
+            if mode == "sim":
+                status, delivered, size = _run_sim(
+                    computations, timeout, max_msgs, seed, t0, snapshot,
+                    msg_log=log, pending_refs=pending_refs,
+                )
+            elif mode == "thread":
+                status, delivered, size = _run_threads(
+                    dcop, computations, timeout, max_msgs, distribution,
+                    t0, snapshot, msg_log=log, placement=placement,
+                    pending_refs=pending_refs, chaos_plan=chaos_plan,
+                    chaos_info=chaos_info,
+                )
+            else:
+                raise ValueError(f"solve_host: unknown mode {mode!r}")
     finally:
         if log is not None:
             log.close()
@@ -404,6 +418,13 @@ def _run_sim(
     for c in order:
         c.start()
 
+    # sim delivers straight off its channels (no Messaging router), so
+    # the message-plane telemetry hooks live here; guards are one
+    # attribute check each (docs/observability.md overhead notes)
+    from pydcop_tpu.telemetry import get_metrics, get_tracer
+
+    met = get_metrics()
+    tr = get_tracer()
     delivered = 0
     size = 0
     status = "finished"  # quiescence
@@ -430,6 +451,14 @@ def _run_sim(
             r["queued"] -= 1
         delivered += 1
         size += msg.size
+        if met.enabled:
+            met.inc("msg.delivered")
+            met.inc("msg.size", msg.size)
+        if tr.detailed:
+            tr.event(
+                "deliver", cat="message", agent="_sim",
+                src=src, dest=dest, type=msg.type,
+            )
         if msg_log is not None:
             msg_log.log("_sim", src, dest, msg)
         by_name[dest].on_message(src, msg)

@@ -183,6 +183,29 @@ class CompiledProblem:
 def compile_dcop(
     dcop: DCOP, dtype=jnp.float32, n_shards: int = 1
 ) -> CompiledProblem:
+    """Tabulate and pack a DCOP into a :class:`CompiledProblem` (see
+    :func:`_compile_dcop`); records a ``compile-problem`` span when a
+    telemetry session is active (``docs/observability.md``)."""
+    import time as _time
+
+    from pydcop_tpu.telemetry import get_tracer
+
+    tr = get_tracer()
+    if not tr.enabled:
+        return _compile_dcop(dcop, dtype, n_shards)
+    t0 = _time.perf_counter()
+    problem = _compile_dcop(dcop, dtype, n_shards)
+    tr.add_span(
+        "compile-problem", "compile", t0, _time.perf_counter() - t0,
+        n_vars=int(problem.n_vars), n_edges=int(problem.n_edges),
+        n_shards=n_shards,
+    )
+    return problem
+
+
+def _compile_dcop(
+    dcop: DCOP, dtype=jnp.float32, n_shards: int = 1
+) -> CompiledProblem:
     """Tabulate and pack a DCOP into a :class:`CompiledProblem`.
 
     ``max`` objectives are compiled by negating all costs (solvers always

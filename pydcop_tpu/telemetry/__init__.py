@@ -1,0 +1,160 @@
+"""Unified telemetry: structured tracing, metrics registry, and
+compile/runtime profiling hooks (``docs/observability.md``).
+
+Three pieces, one session:
+
+- :class:`~pydcop_tpu.telemetry.tracer.Tracer` — span/event records on
+  one process-local timeline, written as JSONL or Chrome
+  ``trace_event`` (chrome://tracing / Perfetto).
+- :class:`~pydcop_tpu.telemetry.metrics.MetricsRegistry` — counters,
+  gauges, fixed-bucket histograms the hot paths (message planes,
+  engine) update with a single attribute-check guard.
+- :mod:`~pydcop_tpu.telemetry.jit` — ``profiled_jit`` wrappers around
+  every ``jax.jit`` entry point recording compile count/wall-time and
+  cache hits, so recompile storms are visible.
+
+Producers never hold a session: they call :func:`get_tracer` /
+:func:`get_metrics`, which return no-op singletons (``enabled`` False)
+unless a :func:`session` is active.  ``api.solve`` opens a session
+around every run (in-memory only, or writing a trace file when
+``trace=``/``--trace`` is given) and attaches the aggregate to
+``result["telemetry"]``.
+
+The globals are process-local by design: agent OS processes each open
+their own session (``pydcop_tpu agent --trace``), matching the
+one-file-per-process trace model.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+from pydcop_tpu.telemetry.metrics import (  # noqa: F401 (re-exports)
+    DEFAULT_BUCKETS,
+    Histogram,
+    MetricsRegistry,
+    NULL_METRICS,
+)
+from pydcop_tpu.telemetry.tracer import (  # noqa: F401 (re-exports)
+    NULL_TRACER,
+    Tracer,
+)
+
+import threading as _threading
+
+_tracer = NULL_TRACER
+_metrics = NULL_METRICS
+_active: Optional["TelemetrySession"] = None
+_install_lock = _threading.Lock()
+
+
+def get_tracer():
+    """The active session's tracer, or the no-op singleton."""
+    return _tracer
+
+
+def get_metrics():
+    """The active session's metrics registry, or the no-op singleton."""
+    return _metrics
+
+
+def active_session() -> Optional["TelemetrySession"]:
+    return _active
+
+
+class TelemetrySession:
+    """One run's tracer + metrics pair."""
+
+    def __init__(self, tracer: Tracer, metrics: MetricsRegistry):
+        self.tracer = tracer
+        self.metrics = metrics
+        self.closed = False
+
+    def summary(self) -> dict:
+        """The ``result["telemetry"]`` payload: per-phase span totals,
+        event counts, and the metrics snapshot."""
+        snap = self.metrics.snapshot()
+        out = {
+            "phases": self.tracer.span_summary(),
+            "events": self.tracer.event_counts(),
+            **snap,
+        }
+        dropped = getattr(self.tracer, "dropped", 0)
+        if dropped:
+            # the record cap bit: phases/events above under-count
+            out["dropped_records"] = dropped
+        return out
+
+    def close(self) -> None:
+        """Append the metrics snapshot to the trace and write it."""
+        self.closed = True
+        snap = self.metrics.snapshot()
+        if any(snap.values()):
+            self.tracer.add_record({"kind": "metrics", **snap})
+        self.tracer.close()
+
+
+@contextlib.contextmanager
+def session(
+    trace_path: Optional[str] = None,
+    trace_format: str = "jsonl",
+) -> Iterator[TelemetrySession]:
+    """Install a telemetry session for the duration of the block.
+
+    With ``trace_path`` set, the tracer writes the trace file (in
+    ``trace_format``: ``jsonl`` or ``chrome``) when the block exits —
+    including per-message ``detailed`` events.  Without a path the
+    session still collects spans/counters in memory for
+    ``result["telemetry"]``.
+
+    Nesting: entering with no ``trace_path`` while a session is already
+    active REUSES the active session (records flow to the outer run's
+    timeline — an embedding app can wrap several ``solve`` calls in one
+    trace).  A ``trace_path`` always opens a fresh session; the outer
+    one is restored on exit.
+
+    The install/restore is process-global: ONE traced run per process
+    is the model (agent OS processes each open their own session),
+    matching the one-file-per-process trace format.  Concurrent
+    ``solve`` calls from several threads of one process are safe but
+    share a session — per-run attribution in ``result["telemetry"]``
+    then reflects the union of the overlapping runs, and a run that
+    outlives the session owner records its tail into an
+    already-closed (never-written) tracer.  The restore below is
+    guarded so a concurrent newer session is never clobbered and a
+    closed one is never reinstalled.
+    """
+    global _tracer, _metrics, _active
+    with _install_lock:
+        if trace_path is None and _active is not None:
+            reuse = _active
+        else:
+            reuse = None
+            tracer = Tracer(path=trace_path, fmt=trace_format)
+            metrics = MetricsRegistry()
+            sess = TelemetrySession(tracer, metrics)
+            prev = (_tracer, _metrics, _active)
+            _tracer, _metrics, _active = tracer, metrics, sess
+    if reuse is not None:
+        yield reuse
+        return
+    # mirror XLA backend-compile durations into this session (no-op on
+    # jax versions without jax.monitoring, or when jax is absent)
+    from pydcop_tpu.telemetry.jit import ensure_backend_compile_listener
+
+    ensure_backend_compile_listener()
+    try:
+        yield sess
+    finally:
+        with _install_lock:
+            if _active is sess:
+                # never reinstall a session another thread already
+                # closed — fall back to the disabled singletons
+                if prev[2] is not None and prev[2].closed:
+                    _tracer, _metrics, _active = (
+                        NULL_TRACER, NULL_METRICS, None
+                    )
+                else:
+                    _tracer, _metrics, _active = prev
+        sess.close()

@@ -1,0 +1,166 @@
+"""Trace readers + aggregation shared by ``pydcop_tpu trace-summary``
+and ``tools/trace_summary.py``.
+
+Both trace formats (JSONL and Chrome ``trace_event``) normalize back to
+the JSONL record schema (``tracer.py``); aggregation is format-blind.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+
+def load_trace(path: str) -> List[Dict[str, Any]]:
+    """Read a trace file in either format into normalized records."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if not stripped:
+        raise ValueError(f"{path}: empty trace file")
+    if stripped.startswith("{") and '"traceEvents"' in stripped[:2000]:
+        return _from_chrome(json.loads(stripped), path)
+    records = []
+    for i, line in enumerate(text.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError as e:
+            raise ValueError(f"{path}:{i + 1}: not a JSONL trace: {e}")
+    return records
+
+
+def _from_chrome(doc: Dict[str, Any], path: str) -> List[Dict[str, Any]]:
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: no traceEvents array")
+    records: List[Dict[str, Any]] = []
+    meta = doc.get("metadata")
+    if isinstance(meta, dict):
+        records.append(meta)
+    for e in events:
+        ph = e.get("ph")
+        if ph == "X":
+            records.append(
+                {
+                    "kind": "span",
+                    "name": e.get("name", "?"),
+                    "cat": e.get("cat", ""),
+                    "t": e.get("ts", 0.0) / 1e6,
+                    "dur": e.get("dur", 0.0) / 1e6,
+                    "tid": e.get("tid", 0),
+                    "args": e.get("args", {}),
+                }
+            )
+        elif ph == "i":
+            if e.get("cat") == "metrics":
+                records.append({"kind": "metrics", **e.get("args", {})})
+            else:
+                records.append(
+                    {
+                        "kind": "event",
+                        "name": e.get("name", "?"),
+                        "cat": e.get("cat", ""),
+                        "t": e.get("ts", 0.0) / 1e6,
+                        "tid": e.get("tid", 0),
+                        "args": e.get("args", {}),
+                    }
+                )
+    return records
+
+
+def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate a trace: per-phase span totals, per-category event
+    counts, per-agent message/fault activity, and the embedded metrics
+    snapshot (when the session wrote one)."""
+    phases: Dict[str, Dict[str, float]] = {}
+    events: Dict[str, int] = {}
+    agents: Dict[str, Dict[str, int]] = {}
+    faults: Dict[str, int] = {}
+    metrics: Dict[str, Any] = {}
+    meta: Dict[str, Any] = {}
+    for r in records:
+        kind = r.get("kind")
+        if kind == "meta":
+            meta = {k: v for k, v in r.items() if k != "kind"}
+        elif kind == "metrics":
+            metrics = {k: v for k, v in r.items() if k != "kind"}
+        elif kind == "span":
+            s = phases.setdefault(
+                r.get("name", "?"),
+                {"count": 0, "total_s": 0.0, "max_s": 0.0},
+            )
+            dur = float(r.get("dur", 0.0))
+            s["count"] += 1
+            s["total_s"] += dur
+            s["max_s"] = max(s["max_s"], dur)
+        elif kind == "event":
+            name = r.get("name", "?")
+            events[name] = events.get(name, 0) + 1
+            args = r.get("args") or {}
+            # chaos-plan announces the spec/seed; it is provenance,
+            # not an injected fault
+            if r.get("cat") == "fault" and name != "chaos-plan":
+                faults[name] = faults.get(name, 0) + 1
+            agent = args.get("agent")
+            if agent is None and isinstance(args.get("link"), str):
+                agent = args["link"].split(">", 1)[0]
+            if agent is not None:
+                a = agents.setdefault(str(agent), {})
+                a[name] = a.get(name, 0) + 1
+    return {
+        "meta": meta,
+        "phases": phases,
+        "events": events,
+        "agents": agents,
+        "faults": faults,
+        "metrics": metrics,
+    }
+
+
+def format_summary(s: Dict[str, Any]) -> str:
+    """Human-readable per-phase / per-agent report."""
+    lines: List[str] = []
+    phases = s.get("phases", {})
+    if phases:
+        lines.append("phase                         count    total_s      max_s")
+        for name in sorted(
+            phases, key=lambda n: -phases[n]["total_s"]
+        ):
+            p = phases[name]
+            lines.append(
+                f"{name:<28} {p['count']:>6} {p['total_s']:>10.4f} "
+                f"{p['max_s']:>10.4f}"
+            )
+    events = s.get("events", {})
+    if events:
+        lines.append("")
+        lines.append("event                          count")
+        for name in sorted(events, key=lambda n: -events[n]):
+            lines.append(f"{name:<28} {events[name]:>7}")
+    faults = s.get("faults", {})
+    if faults:
+        lines.append("")
+        lines.append("injected faults:")
+        for name in sorted(faults):
+            lines.append(f"  {name:<26} {faults[name]:>7}")
+    agents = s.get("agents", {})
+    if agents:
+        lines.append("")
+        lines.append("per-agent activity:")
+        for agent in sorted(agents):
+            parts = ", ".join(
+                f"{k}={v}" for k, v in sorted(agents[agent].items())
+            )
+            lines.append(f"  {agent:<12} {parts}")
+    counters = (s.get("metrics") or {}).get("counters") or {}
+    if counters:
+        lines.append("")
+        lines.append("counters:")
+        for name in sorted(counters):
+            lines.append(f"  {name:<34} {counters[name]}")
+    if not lines:
+        lines.append("(empty trace: no spans or events)")
+    return "\n".join(lines)

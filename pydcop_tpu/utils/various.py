@@ -41,8 +41,24 @@ def number_format(n, precision: int = 3) -> str:
 
 
 def elapsed_str(seconds: float) -> str:
-    """``1h 02m 03s`` style duration formatting for logs/metrics."""
-    seconds = max(0.0, float(seconds))
+    """``1h 02m 03s`` style duration formatting for logs/metrics.
+
+    Sub-second durations render as milliseconds (``123ms``) — the old
+    seconds form printed telemetry-scale spans as ``0h 00m 00s``-style
+    noise.  Negative durations raise: a caller holding one has a clock
+    bug (mixed epochs, reversed subtraction) that silent clamping to
+    ``0ms`` would bury.
+    """
+    seconds = float(seconds)
+    if seconds < 0:
+        raise ValueError(
+            f"elapsed_str: negative duration {seconds!r} (mixed clock "
+            "epochs or a reversed subtraction?)"
+        )
+    if seconds < 1.0:
+        ms = round(seconds * 1000)
+        if ms < 1000:  # 0.9996 rounds to 1000ms — report as seconds
+            return f"{ms}ms"
     h, rem = divmod(int(seconds), 3600)
     m, s = divmod(rem, 60)
     if h:

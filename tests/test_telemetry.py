@@ -1,0 +1,392 @@
+"""Unified telemetry (pydcop_tpu/telemetry, docs/observability.md):
+tracer span/event schema in both formats, the metrics registry, the
+profiled_jit compile/cache-hit detection, chaos faults landing on the
+trace timeline with their seed, the trace-summary command, the
+--trace CLI smoke, and the --run_metrics/--end_metrics CSV round-trip
+(including the end-metrics header guard)."""
+
+import csv
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from pydcop_tpu.dcop.yamldcop import load_dcop
+
+pytestmark = pytest.mark.telemetry
+
+
+def _ring_yaml(n=6, agents=("a1", "a2")):
+    lines = [
+        "name: ring",
+        "objective: min",
+        "domains:",
+        "  colors: {values: [R, G, B]}",
+        "variables:",
+    ]
+    for i in range(n):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for i in range(n):
+        j = (i + 1) % n
+        lines.append(f"  c{i}:")
+        lines.append("    type: intention")
+        lines.append(f"    function: 1 if v{i} == v{j} else 0")
+    lines.append(f"agents: [{', '.join(agents)}]")
+    return "\n".join(lines) + "\n"
+
+
+@pytest.fixture(scope="module")
+def ring_dcop():
+    return load_dcop(_ring_yaml())
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_registry_counters_gauges_histograms():
+    from pydcop_tpu.telemetry import NULL_METRICS, MetricsRegistry
+
+    m = MetricsRegistry()
+    m.inc("a")
+    m.inc("a", 2)
+    m.inc("b", 0.5)
+    m.gauge("g", 7)
+    m.observe("h", 0.0005)
+    m.observe("h", 100.0)
+    snap = m.snapshot()
+    assert snap["counters"] == {"a": 3, "b": 0.5}
+    assert snap["gauges"] == {"g": 7}
+    h = snap["histograms"]["h"]
+    assert h["count"] == 2 and h["sum"] == pytest.approx(100.0005)
+    # one observation below the first bound, one in the +inf overflow
+    assert h["counts"][0] == 1 and h["counts"][-1] == 1
+    assert len(h["counts"]) == len(h["buckets"]) + 1
+    # the snapshot is JSON-safe
+    json.dumps(snap)
+
+    # disabled singleton: no-ops behind one attribute check
+    assert NULL_METRICS.enabled is False
+    NULL_METRICS.inc("x")
+    NULL_METRICS.gauge("x", 1)
+    NULL_METRICS.observe("x", 1.0)
+    assert NULL_METRICS.snapshot()["counters"] == {}
+
+
+def test_no_session_means_null_singletons():
+    from pydcop_tpu import telemetry
+
+    assert telemetry.get_tracer().enabled is False
+    assert telemetry.get_metrics().enabled is False
+    with telemetry.session() as tel:
+        assert telemetry.get_tracer().enabled is True
+        telemetry.get_metrics().inc("k")
+        # nested no-path session reuses the active one
+        with telemetry.session() as inner:
+            assert inner is tel
+    assert telemetry.get_metrics().enabled is False
+    assert tel.summary()["counters"] == {"k": 1}
+
+
+# ---------------------------------------------------------------------------
+# profiled_jit: compile vs cache-hit detection
+# ---------------------------------------------------------------------------
+
+
+def test_profiled_jit_compile_and_cache_hit_counts():
+    import jax.numpy as jnp
+
+    from pydcop_tpu import telemetry
+    from pydcop_tpu.telemetry.jit import profiled_jit
+
+    with telemetry.session() as tel:
+        f = profiled_jit(lambda x: x * 2, label="tele-test-f")
+        f(jnp.ones(3))
+        f(jnp.ones(3))  # same shape: cache hit
+        f(jnp.ones(5))  # new shape: recompile
+        counters = tel.summary()["counters"]
+    assert counters["jit.compiles"] == 2
+    assert counters["jit.cache_hits"] == 1
+    assert counters["jit.compile_seconds_total"] > 0
+    phases = tel.summary()["phases"]
+    assert phases["jit-compile"]["count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# tracer: JSONL schema + chrome format, via a real batched solve
+# ---------------------------------------------------------------------------
+
+
+def test_solve_trace_jsonl_schema(ring_dcop, tmp_path):
+    from pydcop_tpu.api import solve
+
+    path = tmp_path / "t.jsonl"
+    # chunk_size chosen to be unique in this process so the runner
+    # cache misses and at least one jit-compile span is recorded
+    result = solve(
+        ring_dcop, "dsa", {"variant": "B"}, rounds=40, chunk_size=19,
+        trace=str(path),
+    )
+    assert result["status"] in ("finished", "converged")
+    records = [json.loads(line) for line in path.read_text().splitlines()]
+    assert records[0]["kind"] == "meta" and records[0]["version"] == 1
+    by_kind = {}
+    for r in records:
+        by_kind.setdefault(r["kind"], []).append(r)
+    spans = by_kind["span"]
+    for r in spans:  # schema: every span has the full field set
+        assert set(r) >= {"kind", "name", "cat", "t", "dur", "tid", "args"}
+        assert r["dur"] >= 0
+    names = {r["name"] for r in spans}
+    assert "cycle" in names, "batched chunk spans missing"
+    assert "jit-compile" in names, "jit compile span missing"
+    assert "compile-problem" in names
+    # the metrics snapshot rides in the same file
+    metrics = by_kind["metrics"][0]
+    assert metrics["counters"]["engine.rounds"] == 40
+    assert metrics["counters"]["jit.compiles"] >= 1
+    # ... and in the result dict, uniformly
+    tel = result["telemetry"]
+    assert tel["phases"]["cycle"]["count"] >= 1
+    assert tel["counters"]["engine.rounds"] == 40
+
+
+def test_solve_trace_chrome_format(ring_dcop, tmp_path):
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.telemetry.summary import load_trace, summarize
+
+    path = tmp_path / "t.json"
+    solve(
+        ring_dcop, "dsa", {}, rounds=20, chunk_size=11,
+        trace=str(path), trace_format="chrome",
+    )
+    doc = json.loads(path.read_text())
+    events = doc["traceEvents"]
+    assert events, "no traceEvents"
+    complete = [e for e in events if e["ph"] == "X"]
+    assert any(e["name"] == "cycle" for e in complete)
+    for e in complete:
+        assert {"name", "cat", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+    # the chrome reader normalizes back to the same aggregates
+    s = summarize(load_trace(str(path)))
+    assert s["phases"]["cycle"]["count"] >= 1
+    assert s["metrics"]["counters"]["engine.rounds"] == 20
+
+
+def test_host_mode_telemetry_uniform(ring_dcop):
+    """Host (sim) runs land per-phase timings in result["telemetry"]
+    through the same session — no trace file needed."""
+    from pydcop_tpu.api import solve
+
+    result = solve(
+        ring_dcop, "maxsum", {"damping": 0.5}, rounds=50, mode="sim",
+        timeout=20,
+    )
+    tel = result["telemetry"]
+    assert tel["phases"]["deliver-loop"]["count"] == 1
+    assert tel["phases"]["build-computations"]["count"] == 1
+    assert tel["counters"]["msg.delivered"] == result["msg_count"]
+
+
+def test_exact_algorithms_phase_spans(ring_dcop):
+    """DPOP/SyncBB replace their ad-hoc perf_counter blocks with
+    tracer spans: util/value/search phases show up uniformly."""
+    from pydcop_tpu.api import solve
+
+    r = solve(ring_dcop, "dpop", {})
+    assert r["telemetry"]["phases"]["util-phase"]["count"] == 1
+    assert r["telemetry"]["phases"]["value-phase"]["count"] == 1
+    r = solve(ring_dcop, "syncbb", {})
+    assert r["telemetry"]["phases"]["search"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos faults on the trace timeline
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_run_faults_in_trace_with_seed(ring_dcop, tmp_path):
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.telemetry.summary import load_trace, summarize
+
+    path = tmp_path / "chaos.jsonl"
+    result = solve(
+        ring_dcop, "maxsum", {"damping": 0.5}, rounds=60,
+        mode="thread", chaos="drop=0.4", chaos_seed=3, timeout=30,
+        trace=str(path),
+    )
+    # the replay record and the trace agree on the seed
+    assert result["chaos"]["seed"] == 3
+    records = load_trace(str(path))
+    plan = [r for r in records if r.get("name") == "chaos-plan"]
+    assert plan and plan[0]["args"]["seed"] == 3
+    drops = [
+        r
+        for r in records
+        if r.get("cat") == "fault" and r.get("name") == "drop"
+    ]
+    assert drops, "no injected-fault events in the trace"
+    for r in drops:  # each event carries link, per-link seq, and seed
+        assert r["args"]["seed"] == 3
+        assert ">" in r["args"]["link"] and r["args"]["seq"] >= 1
+    # trace count matches the chaos layers' own event record
+    assert len(drops) == result["chaos"]["events"]["drop"]
+    assert result["telemetry"]["counters"]["fault.drop"] == len(drops)
+    # per-message deliver events are on (trace file => detailed)
+    assert any(r.get("name") == "deliver" for r in records)
+    s = summarize(records)
+    assert s["faults"].get("drop") == len(drops)
+    assert "chaos-plan" not in s["faults"]
+
+
+# ---------------------------------------------------------------------------
+# trace-summary command + CLI --trace smoke (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_summary_command(ring_dcop, tmp_path, capsys):
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.cli import main
+
+    path = tmp_path / "t.jsonl"
+    solve(ring_dcop, "dsa", {}, rounds=20, chunk_size=13, trace=str(path))
+    assert main(["trace-summary", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "cycle" in out and "total_s" in out
+    # --json form parses
+    assert main(["trace-summary", str(path), "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["phases"]["cycle"]["count"] >= 1
+    # a bogus file exits cleanly
+    bad = tmp_path / "bad.trace"
+    bad.write_text("this is not a trace\n")
+    with pytest.raises(SystemExit):
+        main(["trace-summary", str(bad)])
+
+
+def test_cli_solve_trace_smoke(ring_dcop, tmp_path, capsys):
+    """Tier-1 smoke: `solve --trace` on a tiny problem produces a
+    parseable trace and the result JSON carries telemetry."""
+    from pydcop_tpu.cli import main
+
+    yaml_path = tmp_path / "ring.yaml"
+    yaml_path.write_text(_ring_yaml())
+    trace_path = tmp_path / "smoke.jsonl"
+    rc = main(
+        [
+            "solve", "--algo", "dsa", "--rounds", "20",
+            "--trace", str(trace_path), str(yaml_path),
+        ]
+    )
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert "telemetry" in result and "phases" in result["telemetry"]
+    records = [
+        json.loads(line) for line in trace_path.read_text().splitlines()
+    ]
+    assert records[0]["kind"] == "meta"
+    assert any(
+        r.get("kind") == "span" and r.get("name") == "cycle"
+        for r in records
+    )
+
+
+def test_tools_trace_summary_entry(ring_dcop, tmp_path, capsys):
+    import tools.trace_summary as tts
+    from pydcop_tpu.api import solve
+
+    path = tmp_path / "t.jsonl"
+    solve(ring_dcop, "dsa", {}, rounds=10, chunk_size=7, trace=str(path))
+    assert tts.main([str(path)]) == 0
+    assert "cycle" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# write_metrics CSV round-trip (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _metrics_args(**kw):
+    base = dict(
+        run_metrics=None, end_metrics=None,
+        collect_on="cycle_change", period=None,
+    )
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+def _result(trace=(3.0, 2.0, 2.0, 1.0)):
+    return {
+        "status": "finished",
+        "cost": trace[-1],
+        "cycle": len(trace),
+        "msg_count": 4 * len(trace),
+        "time": 0.8,
+        "cost_trace": list(trace),
+    }
+
+
+def test_write_metrics_run_csv_round_trip(tmp_path):
+    from pydcop_tpu.commands._common import write_metrics
+
+    run = tmp_path / "run.csv"
+    write_metrics(_metrics_args(run_metrics=str(run)), _result())
+    with open(run, newline="") as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["time", "cycle", "cost", "msg_count"]
+    assert len(rows) == 5  # header + one row per trace entry
+    assert [r[2] for r in rows[1:]] == ["3.0", "2.0", "2.0", "1.0"]
+    assert [int(r[1]) for r in rows[1:]] == [1, 2, 3, 4]
+    # documented asymmetry: a rerun TRUNCATES (one run per file)
+    write_metrics(_metrics_args(run_metrics=str(run)), _result((5.0,)))
+    with open(run, newline="") as f:
+        rows = list(csv.reader(f))
+    assert len(rows) == 2
+
+
+def test_write_metrics_end_csv_append_and_header_guard(tmp_path):
+    from pydcop_tpu.commands._common import write_metrics
+
+    end = tmp_path / "end.csv"
+    args = _metrics_args(end_metrics=str(end))
+    write_metrics(args, _result())
+    write_metrics(args, _result((9.0, 7.0)))
+    with open(end, newline="") as f:
+        rows = list(csv.reader(f))
+    # appended across runs, with exactly ONE header row at creation
+    assert rows[0] == ["status", "cost", "cycle", "msg_count", "time"]
+    assert len(rows) == 3
+    assert rows[1][0] == rows[2][0] == "finished"
+
+    # an existing EMPTY file gets the header (it is being "created")
+    empty = tmp_path / "empty.csv"
+    empty.write_text("")
+    write_metrics(_metrics_args(end_metrics=str(empty)), _result())
+    with open(empty, newline="") as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["status", "cost", "cycle", "msg_count", "time"]
+
+    # legacy header-less file: rows append, NO header mid-stream
+    legacy = tmp_path / "legacy.csv"
+    legacy.write_text("finished,1.0,10,40,0.5\r\n")
+    write_metrics(_metrics_args(end_metrics=str(legacy)), _result())
+    with open(legacy, newline="") as f:
+        rows = list(csv.reader(f))
+    assert len(rows) == 2
+    assert rows[0][0] == "finished" and rows[0][1] == "1.0"
+    assert "status" not in {r[0] for r in rows}
+
+
+def test_end_metrics_csv_parses_with_dictreader(tmp_path):
+    from pydcop_tpu.commands._common import write_metrics
+
+    end = tmp_path / "end.csv"
+    write_metrics(_metrics_args(end_metrics=str(end)), _result())
+    with open(end, newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert rows[0]["status"] == "finished"
+    assert float(rows[0]["cost"]) == 1.0
+    assert int(rows[0]["cycle"]) == 4

@@ -1,0 +1,36 @@
+"""utils/various.py: duration formatting edge cases (telemetry
+satellite: sub-second spans used to print as `0h 00m 00s`-style
+noise; negatives indicated a clock bug and were silently clamped)."""
+
+import pytest
+
+from pydcop_tpu.utils.various import elapsed_str, number_format
+
+
+def test_elapsed_str_sub_second_is_milliseconds():
+    assert elapsed_str(0.123) == "123ms"
+    assert elapsed_str(0.9994) == "999ms"
+    assert elapsed_str(0.0005) == "0ms"
+    assert elapsed_str(0) == "0ms"
+    # the rounding boundary never prints "1000ms"
+    assert elapsed_str(0.9996) == "1s"
+
+
+def test_elapsed_str_seconds_and_up_unchanged():
+    assert elapsed_str(1.5) == "1.5s"
+    assert elapsed_str(59) == "59s"
+    assert elapsed_str(65) == "1m 05s"
+    assert elapsed_str(3723) == "1h 02m 03s"
+
+
+def test_elapsed_str_negative_raises():
+    with pytest.raises(ValueError):
+        elapsed_str(-0.001)
+    with pytest.raises(ValueError):
+        elapsed_str(-60)
+
+
+def test_number_format_still_compact():
+    # neighbor helper sanity (unchanged behavior)
+    assert number_format(1500) == "1.5k"
+    assert number_format(True) == "True"

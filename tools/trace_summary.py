@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Standalone telemetry-trace summarizer (tools/ entry for
+``pydcop_tpu trace-summary``): per-phase span totals, event counts,
+injected-fault counts and per-agent activity from a ``--trace`` file
+(JSONL or Chrome ``trace_event``, auto-detected).
+
+Usage::
+
+    python tools/trace_summary.py t.jsonl [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("trace_file", help="trace file (jsonl or chrome)")
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the aggregates as JSON instead of a table",
+    )
+    args = p.parse_args(argv)
+
+    from pydcop_tpu.telemetry.summary import (
+        format_summary,
+        load_trace,
+        summarize,
+    )
+
+    try:
+        s = summarize(load_trace(args.trace_file))
+    except (OSError, ValueError) as e:
+        print(f"trace-summary: {e}", file=sys.stderr)
+        return 2
+    print(
+        json.dumps(s, indent=2, default=str)
+        if args.json
+        else format_summary(s)
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
