@@ -24,10 +24,17 @@ subprocess probes**:
   the breaking scale and give a stronger headline than ``small``.
 
 Attribution inside a stage: the inner process prints ``BENCH_PHASE:``
-markers (imports → problem_built → host_compiled → xla_compiled →
-measured).  On a timeout the parent reads the partial stdout captured
-so far and reports the LAST phase reached, so "timed out" always says
-*where* (e.g. ``at phase=host_compiled`` means XLA compile hung).
+markers (``import:jax`` → ``backend_init`` | ``import:pydcop`` →
+problem_built → host_compiled → xla_compiled → measured).  Imports are
+STAGED AND LAZY — jax first, the repo only for stages that need it —
+and each import/init phase is additionally timeboxed in-process with
+``SIGALRM`` (``_bounded_phase``): when a phase stalls, the child
+prints ``BENCH_PHASE_TIMEOUT:<phase>`` and exits immediately instead
+of silently eating the whole stage budget (BENCH_r05: ``init`` burned
+2×90 s reporting only "last phase: imports"; the hang was the axon
+backend init, now attributed as ``backend_init``).  On a hard timeout
+the parent still reads the partial stdout and reports the LAST phase
+reached, so "timed out" always says *where*.
 
 Every stage reports ``{stage, ok, seconds, ...}`` into the final JSON
 line's ``stages`` list.  The headline value comes from the deepest
@@ -256,32 +263,84 @@ def _phase(name: str) -> None:
     )
 
 
-def _measure(n_vars: int, rounds: int, chunk: int) -> dict:
-    """Run the workload on whatever backend JAX picks; return metrics."""
-    import jax
+import contextlib
 
-    import __graft_entry__ as g
-    from pydcop_tpu.algorithms import (
-        load_algorithm_module,
-        prepare_algo_params,
-    )
-    from pydcop_tpu.engine.batched import run_batched
-    from pydcop_tpu.ops import compile_dcop
 
-    _phase("imports")
+@contextlib.contextmanager
+def _bounded_phase(name: str, budget: float):
+    """Mark a phase AND timebox it in-process.
+
+    If the body stalls past ``budget`` seconds, SIGALRM fires, the
+    child prints ``BENCH_PHASE_TIMEOUT:<name>`` and exits(3) — so the
+    parent learns exactly which import/init stalled within seconds of
+    the stall, instead of burning the whole stage budget to report a
+    bare timeout.  No-op timebox on platforms without SIGALRM.
+    """
+    import signal
+
+    _phase(name)
+    if budget <= 0 or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+
+    def _alarm(signum, frame):
+        print(
+            f"BENCH_PHASE_TIMEOUT:{name} budget={budget:.0f}s "
+            f"t={time.perf_counter() - _PHASE_T0:.1f}",
+            flush=True,
+        )
+        os._exit(3)
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.setitimer(signal.ITIMER_REAL, budget)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _measure(
+    n_vars: int, rounds: int, chunk: int, phase_budget: float = 0.0
+) -> dict:
+    """Run the workload on whatever backend JAX picks; return metrics.
+
+    Imports are staged and lazy: ``jax`` first (its own timeboxed
+    phase), the repo modules only for stages that actually run the
+    engine — the init probe never touches them, so an init-stage
+    failure is always attributed to jax import or backend init.
+
+    ``phase_budget`` bounds each import/init phase in-process (the
+    parent derives it from the STAGE budget, so a phase can never be
+    preempted earlier than the stage's own kill would have fired —
+    it only converts "bare timeout" into "phase X stalled").  0
+    disables the timeboxes.
+    """
+    with _bounded_phase("import:jax", phase_budget):
+        import jax
 
     if n_vars == 0:  # init probe: backend up + one tiny device op
-        import jax.numpy as jnp
+        with _bounded_phase("backend_init", phase_budget):
+            import jax.numpy as jnp
 
-        t0 = time.perf_counter()
-        platform = jax.devices()[0].platform
-        x = jnp.ones((256, 256))
-        float((x @ x).sum().block_until_ready())
+            t0 = time.perf_counter()
+            platform = jax.devices()[0].platform
+            x = jnp.ones((256, 256))
+            float((x @ x).sum().block_until_ready())
         return {
             "platform": platform,
             "init_seconds": time.perf_counter() - t0,
             "n_devices": jax.device_count(),
         }
+
+    with _bounded_phase("import:pydcop", phase_budget):
+        import __graft_entry__ as g
+        from pydcop_tpu.algorithms import (
+            load_algorithm_module,
+            prepare_algo_params,
+        )
+        from pydcop_tpu.engine.batched import run_batched
+        from pydcop_tpu.ops import compile_dcop
 
     if n_vars < 0:  # reference-class probe: the HOST message-driven
         # runtime (thread-per-agent architecture like pyDcop's) on
@@ -361,6 +420,7 @@ def _inner_main() -> None:
     p.add_argument("--vars", type=int, default=N_VARS)
     p.add_argument("--rounds", type=int, default=ROUNDS)
     p.add_argument("--chunk", type=int, default=CHUNK)
+    p.add_argument("--phase_budget", type=float, default=0.0)
     a = p.parse_args()
     import jax
 
@@ -375,7 +435,12 @@ def _inner_main() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # older jax: cache flags absent — correctness unaffected
-    print("BENCH_JSON:" + json.dumps(_measure(a.vars, a.rounds, a.chunk)))
+    print(
+        "BENCH_JSON:"
+        + json.dumps(
+            _measure(a.vars, a.rounds, a.chunk, a.phase_budget)
+        )
+    )
 
 
 def _run_sub(
@@ -384,7 +449,15 @@ def _run_sub(
     """Run ``bench.py --inner`` in a subprocess; parse its JSON line.
 
     Returns the metrics dict, or {"error": ...} on failure/timeout.
+    The child's per-phase timebox is the stage budget minus a small
+    margin (<= 5 s and <= 10%), so the attribution line lands in the
+    captured stdout before the parent's kill.  A phase finishing
+    inside that final margin is preempted a few seconds early — but
+    such a stage would blow its budget in the phases that follow
+    anyway; every other stall is upgraded from "bare timeout" to
+    "phase X stalled" with seconds-level attribution.
     """
+    phase_budget = timeout - min(5.0, 0.1 * timeout)
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if pin_cpu:
@@ -398,6 +471,7 @@ def _run_sub(
             [
                 sys.executable, os.path.join(REPO, "bench.py"), "--inner",
                 "--vars", str(n_vars), "--rounds", str(rounds),
+                "--phase_budget", f"{phase_budget:.1f}",
             ],
             env=env,
             cwd=REPO,
@@ -411,7 +485,7 @@ def _run_sub(
         partial = exc.stdout or b""
         if isinstance(partial, bytes):
             partial = partial.decode("utf-8", "replace")
-        last = "none (backend init)"
+        last = "none (interpreter startup)"
         for line in partial.splitlines():
             if line.startswith("BENCH_PHASE:"):
                 last = line[len("BENCH_PHASE:"):]
@@ -425,6 +499,15 @@ def _run_sub(
     for line in reversed(proc.stdout.splitlines()):
         if line.startswith("BENCH_JSON:"):
             out.update(json.loads(line[len("BENCH_JSON:"):]))
+            return out
+    # in-process phase timebox fired (exit 3): the child already said
+    # exactly which import/init phase stalled — surface it verbatim
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("BENCH_PHASE_TIMEOUT:"):
+            out["error"] = (
+                "phase stalled (in-process timebox): "
+                + line[len("BENCH_PHASE_TIMEOUT:"):]
+            )
             return out
     out["error"] = (
         f"rc={proc.returncode}, no BENCH_JSON line; stderr tail: "

@@ -47,6 +47,8 @@ def solve(
     chaos_seed: int = 0,
     trace: Optional[str] = None,
     trace_format: str = "jsonl",
+    pad_policy: str = "none",
+    compile_cache: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -102,10 +104,25 @@ def solve(
     hostnet orchestrator; the batched engine accepts and ignores it
     (one device program solves regardless of placement).
 
+    ``pad_policy`` (batched engine only) buckets the compiled
+    problem's array shapes (``"pow2"``/``"pow2:<floor>"``,
+    ``ops/padding.py``) so similarly-sized problems share jitted
+    executables; ``compile_cache`` points jax's persistent compilation
+    cache at a directory so repeated PROCESSES skip XLA compilation of
+    programs they have built before.  Both are covered in
+    ``docs/performance.md``.
+
     >>> result = solve(my_dcop, "dsa", {"variant": "B"}, rounds=100)
     >>> result["assignment"], result["cost"]
     """
     from pydcop_tpu.telemetry import session
+
+    if compile_cache is not None:
+        from pydcop_tpu.ops.compile import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache(compile_cache)
 
     with session(trace, trace_format) as tel:
         result = _solve_dispatch(
@@ -117,6 +134,7 @@ def solve(
             nb_agents=nb_agents, msg_log=msg_log,
             accel_agents=accel_agents, distribution=distribution,
             k_target=k_target, chaos=chaos, chaos_seed=chaos_seed,
+            pad_policy=pad_policy,
         )
         result["telemetry"] = tel.summary()
     return result
@@ -145,11 +163,20 @@ def _solve_dispatch(
     k_target,
     chaos,
     chaos_seed,
+    pad_policy="none",
 ) -> Dict[str, Any]:
     """Mode dispatch behind :func:`solve` (which owns the telemetry
     session and the ``result["telemetry"]`` attach)."""
     if isinstance(dcop, (str, list, tuple)):
         dcop = load_dcop_from_file(dcop)
+
+    from pydcop_tpu.ops.padding import as_pad_policy
+
+    if as_pad_policy(pad_policy).enabled and mode != "batched":
+        raise ValueError(
+            "pad_policy shapes the batched engine's compiled arrays; "
+            f"mode={mode!r} does not compile the whole problem"
+        )
 
     if mode in ("thread", "sim"):
         if checkpoint_path is not None or resume:
@@ -265,9 +292,15 @@ def _solve_dispatch(
                 "n_restarts (best-of-K for stochastic solvers) does "
                 "not apply"
             )
+        if as_pad_policy(pad_policy).enabled:
+            raise ValueError(
+                f"{algo_name} runs on the host path and never "
+                "compiles the whole problem — pad_policy does not "
+                "apply"
+            )
         return module.solve_host(dcop, params, timeout=timeout)
 
-    problem = compile_dcop(dcop)
+    problem = compile_dcop(dcop, pad_policy=pad_policy)
     return _run_compiled(
         problem, module, params, rounds=rounds, seed=seed,
         timeout=timeout, chunk_size=chunk_size,

@@ -64,6 +64,20 @@ def set_parser(subparsers) -> None:
         help="algorithm solving the reparation DCOP",
     )
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--pad_policy", default="none", metavar="POLICY",
+        help="bucket each segment's compiled array shapes ('pow2' or "
+        "'pow2:<floor>'): segments whose size changed within a bucket "
+        "(e.g. one lost variable) reuse the previous segment's "
+        "compiled executables instead of paying an XLA compile "
+        "(docs/performance.md); default: none",
+    )
+    p.add_argument(
+        "--compile_cache", default=None, metavar="DIR",
+        help="persist XLA executables to DIR (jax compilation cache): "
+        "repeated runs skip backend compilation across processes "
+        "(docs/performance.md)",
+    )
     add_collect_arguments(p)
     add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
@@ -144,6 +158,13 @@ def run_cmd(args) -> int:
     params = parse_algo_params(args.algo_params)
     from pydcop_tpu.telemetry import session
 
+    if args.compile_cache:
+        from pydcop_tpu.ops.compile import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache(args.compile_cache)
+
     try:
         with session(args.trace, args.trace_format) as tel:
             result = run_dynamic(
@@ -158,6 +179,7 @@ def run_cmd(args) -> int:
                 seed=args.seed,
                 timeout=args.timeout,
                 repair_algo=args.repair_algo,
+                pad_policy=args.pad_policy,
             )
             result["telemetry"] = tel.summary()
     except (ValueError, ImpossibleDistributionException) as e:

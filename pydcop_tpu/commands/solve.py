@@ -108,6 +108,19 @@ def set_parser(subparsers) -> None:
         "one device program (vmap) and report the best — parallel "
         "restarts for stochastic algorithms",
     )
+    p.add_argument(
+        "--pad_policy", default="none", metavar="POLICY",
+        help="bucket the compiled problem's array shapes ('pow2' or "
+        "'pow2:<floor>') so similarly-sized problems reuse jitted "
+        "executables instead of recompiling (docs/performance.md); "
+        "default: none",
+    )
+    p.add_argument(
+        "--compile_cache", default=None, metavar="DIR",
+        help="persist XLA executables to DIR (jax compilation cache): "
+        "repeated runs of the same program skip backend compilation "
+        "entirely, across processes (docs/performance.md)",
+    )
     add_collect_arguments(p)
     add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
@@ -148,6 +161,8 @@ def run_cmd(args) -> int:
             chaos_seed=args.chaos_seed,
             trace=args.trace,
             trace_format=args.trace_format,
+            pad_policy=args.pad_policy,
+            compile_cache=args.compile_cache,
         )
     finally:
         # flush the trace even when the solve raises — a profile of a

@@ -16,7 +16,9 @@ final and the best solution, plus the per-round cost trace (the
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -25,7 +27,11 @@ import numpy as np
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from pydcop_tpu.ops.compile import CompiledProblem, decode_assignment
+from pydcop_tpu.ops.compile import (
+    CompiledProblem,
+    canonical_execution_problem,
+    decode_assignment,
+)
 from pydcop_tpu.ops.costs import total_cost
 from pydcop_tpu.telemetry import get_metrics, get_tracer
 from pydcop_tpu.telemetry.jit import profiled_jit
@@ -55,11 +61,54 @@ class RunResult:
 # Compiled chunk runners, reused across run_batched calls so repeated
 # runs (warmup/measure, parameter sweeps, chunked loops) don't re-trace.
 # Key: (algo module, axis_name, static params, dyn-param names, mesh id,
-# bucket arities, n_shards, chunk len).  Unbounded by design: entries
+# bucket arities, n_shards, chunk len).  Unbounded by default: entries
 # pin their executable + mesh for the process lifetime, which is the
 # desired behavior for benchmark loops; call _RUNNER_CACHE.clear() to
-# release.
-_RUNNER_CACHE: Dict[Tuple, Callable] = {}
+# release, or cap it with :func:`set_runner_cache_limit` (LRU
+# eviction, counted as ``engine.runner_cache_evictions``) for
+# long-lived processes sweeping many (algo, chunk, shape) combinations.
+_RUNNER_CACHE: "OrderedDict[Tuple, Callable]" = OrderedDict()
+_RUNNER_CACHE_MAX: Optional[int] = None
+
+# env override for embedders/sweep drivers that never call the setter;
+# 0 (and any value <= 0) means unbounded, matching the None default
+_env_cap = os.environ.get("PYDCOP_TPU_RUNNER_CACHE_MAX")
+if _env_cap:
+    try:
+        _parsed_cap = int(_env_cap)
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ignoring non-integer PYDCOP_TPU_RUNNER_CACHE_MAX=%r",
+            _env_cap,
+        )
+    else:
+        _RUNNER_CACHE_MAX = _parsed_cap if _parsed_cap > 0 else None
+
+
+def set_runner_cache_limit(max_entries: Optional[int]) -> None:
+    """Cap the chunk-runner cache at ``max_entries`` (LRU eviction;
+    ``None`` restores the unbounded default).  Evicts immediately if
+    the cache is already over the new cap."""
+    global _RUNNER_CACHE_MAX
+    if max_entries is not None and max_entries < 1:
+        raise ValueError(
+            f"max_entries must be >= 1 or None, got {max_entries}"
+        )
+    _RUNNER_CACHE_MAX = max_entries
+    _evict_runners()
+
+
+def _evict_runners() -> None:
+    met = get_metrics()
+    while (
+        _RUNNER_CACHE_MAX is not None
+        and len(_RUNNER_CACHE) > _RUNNER_CACHE_MAX
+    ):
+        _RUNNER_CACHE.popitem(last=False)
+        if met.enabled:
+            met.inc("engine.runner_cache_evictions")
 
 
 def _default_unroll() -> int:
@@ -276,6 +325,18 @@ def run_batched(
 
         fingerprint = problem_fingerprint(problem)
 
+    # The jitted path runs on a metadata-canonicalized copy: the jit
+    # trace cache keys on every static pytree field, so variable/
+    # constraint NAMES (host-only decode data) would otherwise force a
+    # re-trace + XLA compile for each new problem object even when all
+    # shapes match.  With the names stripped, any two problems that
+    # agree on shapes and traced statics share one executable — the
+    # reuse behind shape-bucketed dynamic segments (pad_policy) and
+    # generated-instance sweeps.  The original is kept for decoding
+    # and message accounting.
+    host_problem = problem
+    problem = canonical_execution_problem(problem)
+
     static_params = {
         k: v for k, v in params.items() if isinstance(v, (str, bool))
     }
@@ -283,6 +344,16 @@ def run_batched(
         k: jnp.asarray(v)
         for k, v in params.items()
         if not isinstance(v, (str, bool)) and v is not None
+    }
+    # params that only shape init_state (never the jitted step) stay
+    # out of the runner closure and its cache key: a dynamic-run
+    # segment switching to initial='declared' must not re-trace the
+    # round loop it just compiled
+    init_only = frozenset(
+        getattr(algo_module, "INIT_ONLY_PARAMS", ("initial",))
+    )
+    step_statics = {
+        k: v for k, v in static_params.items() if k not in init_only
     }
 
     axis_name = None
@@ -304,7 +375,7 @@ def run_batched(
             )(restart_ids)
             return jax.vmap(
                 lambda s, k: algo_module.step(
-                    problem, s, k, {**static_params, **dyn},
+                    problem, s, k, {**step_statics, **dyn},
                     axis_name=axis_name,
                 ),
                 in_axes=(0, 0),
@@ -319,14 +390,14 @@ def run_batched(
 
         def algo_step(problem, state, key, dyn):
             return algo_module.step(
-                problem, state, key, {**static_params, **dyn},
+                problem, state, key, {**step_statics, **dyn},
                 axis_name=axis_name,
             )
 
     cache_key_base = (
         algo_module.__name__,
         axis_name,
-        tuple(sorted(static_params.items())),
+        tuple(sorted(step_statics.items())),
         tuple(sorted(dyn_params)),
         id(mesh) if mesh is not None else None,
         tuple(sorted(problem.buckets)),  # pspecs structure
@@ -496,6 +567,7 @@ def run_batched(
         if cache_key in _RUNNER_CACHE:
             if met.enabled:
                 met.inc("engine.runner_cache_hits")
+            _RUNNER_CACHE.move_to_end(cache_key)
             return _RUNNER_CACHE[cache_key]
         if met.enabled:
             met.inc("engine.runner_cache_misses")
@@ -518,6 +590,7 @@ def run_batched(
             )
             runner = profiled_jit(sharded, label=label)
         _RUNNER_CACHE[cache_key] = runner
+        _evict_runners()
         return runner
 
     if mesh is not None:
@@ -662,7 +735,9 @@ def run_batched(
         best_cost_f = float(best_cost)
     elapsed = time.perf_counter() - t0
     msgs = (
-        algo_module.messages_per_round(problem, params) * done * n_restarts
+        algo_module.messages_per_round(host_problem, params)
+        * done
+        * n_restarts
     )
     trace = np.concatenate(traces) if traces else np.zeros(0)
     out_state = None
@@ -679,9 +754,9 @@ def run_batched(
 
         out_state = jax.tree_util.tree_map(_to_host, state)
     return RunResult(
-        assignment=decode_assignment(problem, final_values),
+        assignment=decode_assignment(host_problem, final_values),
         cost=sign * final_cost,
-        best_assignment=decode_assignment(problem, best_values),
+        best_assignment=decode_assignment(host_problem, best_values),
         best_cost=sign * best_cost_f,
         cycles=done,
         messages=msgs,

@@ -37,7 +37,7 @@ from typing import Any, Dict, List, Optional, Union
 import numpy as np
 
 from pydcop_tpu.dcop.dcop import DCOP
-from pydcop_tpu.dcop.objects import AgentDef, ExternalVariable
+from pydcop_tpu.dcop.objects import AgentDef
 from pydcop_tpu.dcop.scenario import Scenario
 
 
@@ -57,6 +57,7 @@ def run_dynamic(
     n_shards: int = 1,
     chunk_size: int = 64,
     chunk_callback=None,
+    pad_policy="none",
 ) -> Dict[str, Any]:
     """Play a scenario against a DCOP and return the result dict
     (reference ``pydcop run`` JSON shape + ``events`` log).
@@ -69,6 +70,17 @@ def run_dynamic(
     segments because the segment schedule (budgets, seeds, event
     ordering) is a deterministic function of (dcop, scenario, seed)
     and therefore identical in every SPMD process.
+
+    Segment compiles go through
+    :class:`~pydcop_tpu.engine.incremental.IncrementalCompiler`: delay
+    events reuse the cached compiled problem outright, ``set_value``
+    events delta-update the affected device tables in place, and only
+    structure-changing events (a variable freezing) pay a full host
+    recompile.  ``pad_policy`` (``"pow2"``/``"pow2:<floor>"``,
+    ``ops/padding.py``) additionally buckets array shapes so even
+    structure changes reuse the previously compiled XLA executables
+    when the new size lands in the same bucket — see
+    ``docs/performance.md``.
     """
     from pydcop_tpu.algorithms import (
         load_algorithm_module,
@@ -152,28 +164,14 @@ def run_dynamic(
     carry_fp: Optional[str] = None
     state_transfers = 0
 
-    def active_dcop() -> DCOP:
-        """The current solvable problem: frozen variables become
-        external (constant at their last value), external overrides
-        applied, only live agents."""
-        d = DCOP(dcop.name, objective=dcop.objective)
-        for v in dcop.variables.values():
-            if v.name in frozen:
-                d.add_variable(
-                    ExternalVariable(v.name, v.domain, frozen[v.name])
-                )
-            else:
-                d.add_variable(v)
-        for ev in dcop.external_variables.values():
-            d.add_variable(
-                ExternalVariable(
-                    ev.name, ev.domain, ext_overrides.get(ev.name, ev.value)
-                )
-            )
-        for c in dcop.constraints.values():
-            d.add_constraint(c)
-        d.add_agents(live_agents.values())
-        return d
+    # segment compiler: caches the compiled problem across segments,
+    # delta-updates it on set_value events, full-recompiles only on
+    # structure changes (see engine/incremental.py)
+    from pydcop_tpu.engine.incremental import IncrementalCompiler
+
+    compiler = IncrementalCompiler(
+        dcop, n_shards=n_shards, pad_policy=pad_policy
+    )
 
     def run_segment(n_rounds: int, seg_seed: int) -> bool:
         """One solve segment; returns whether full state carried."""
@@ -182,29 +180,26 @@ def run_dynamic(
         import dataclasses as dc
 
         from pydcop_tpu.engine.batched import run_batched
-        from pydcop_tpu.ops.compile import (
-            compile_dcop,
-            encode_assignment,
-            problem_fingerprint,
-        )
+        from pydcop_tpu.ops.compile import encode_assignment
 
         from pydcop_tpu.telemetry import get_tracer
 
         t_seg = time.perf_counter()
-        ad = active_dcop()
-        if not ad.variables:
+        problem, fp = compiler.compile(frozen, ext_overrides)
+        if problem is None:
             return False  # everything frozen/lost
-        problem = compile_dcop(ad, n_shards=n_shards)
-        fp = problem_fingerprint(problem)
         carried = carry_state is not None and fp == carry_fp
         seg_params = dict(params)
         if not carried and current_values:
+            real_names = tuple(
+                problem.var_names[: problem.n_real_vars]
+            )
             known = {
                 name: current_values[name]
-                for name in problem.var_names
+                for name in real_names
                 if name in current_values
             }
-            if len(known) == len(problem.var_names):
+            if len(known) == len(real_names):
                 problem = dc.replace(
                     problem, init_idx=encode_assignment(problem, known)
                 )

@@ -1,0 +1,385 @@
+"""Incremental problem recompilation for dynamic runs.
+
+The dynamic engine (``engine/dynamic.py``) solves a *sequence* of
+closely-related problems: each scenario event perturbs the active DCOP
+(an external variable changes value, a lost variable freezes into an
+external) and the next segment solves the perturbed problem.  The naive
+path re-tabulates every constraint and rebuilds the whole
+:class:`~pydcop_tpu.ops.compile.CompiledProblem` on the host per
+segment — ~seconds of Python/numpy work per event on large problems,
+plus a device→host pull to fingerprint the result.
+
+:class:`IncrementalCompiler` removes that cost for the common cases:
+
+- **Nothing changed** (delay events): the cached compiled problem and
+  fingerprint are returned as-is — zero host work, zero transfers.
+- **Only external VALUES changed** (``set_value`` events): the problem
+  STRUCTURE (variables, scopes, shapes, static metadata) is unchanged,
+  so only the constraints whose scope touches a changed external are
+  re-tabulated, and their slices of ``tables_flat`` / the arity-bucket
+  tables / the folded ``unary`` rows are delta-updated ON DEVICE with
+  ``.at[].set``/``.add``.  The resulting problem shares every static
+  field with its predecessor, so the engine's jitted chunk runners hit
+  the trace cache — a segment transition costs a few small device
+  updates instead of a host rebuild + trace + XLA compile.
+- **Structure changed** (a variable froze, the frozen set changed): a
+  full recompile, after which the edit plan is rebuilt.  With a
+  ``pad_policy`` the recompiled arrays usually land in the same shape
+  buckets, so even this path reuses the compiled executables (see
+  ``ops/padding.py`` and ``docs/performance.md``).
+
+Fingerprints: full compiles hash the compiled arrays
+(:func:`~pydcop_tpu.ops.compile.problem_fingerprint`); incremental
+updates derive the fingerprint from the base hash + the *effective*
+external values (those actually read by some constraint), so delay
+segments and no-op ``set_value`` events keep the fingerprint stable and
+the engine's full-state carry intact.
+
+Telemetry counters (``docs/observability.md``): ``compile.full``,
+``compile.incremental``, ``compile.reused``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import ExternalVariable
+from pydcop_tpu.ops.compile import (
+    CompiledProblem,
+    _tabulate_padded,
+    compile_dcop,
+    problem_fingerprint,
+)
+
+
+class IncrementalCompiler:
+    """Compile the active problem of a dynamic run, reusing work
+    across segments (see module docstring).
+
+    ``compile(frozen, ext_overrides)`` returns ``(problem,
+    fingerprint)`` for the current run state, or ``(None, None)`` when
+    every variable is frozen/external.  The returned problem must be
+    treated as immutable (the engine's ``dataclasses.replace`` for
+    initial values is fine — it never mutates the cached arrays).
+    """
+
+    def __init__(
+        self,
+        dcop: DCOP,
+        n_shards: int = 1,
+        pad_policy="none",
+        dtype=jnp.float32,
+    ):
+        self.dcop = dcop
+        self.n_shards = n_shards
+        self.pad_policy = pad_policy
+        self.dtype = dtype
+        self._sign = -1.0 if dcop.objective == "max" else 1.0
+        self._frozen_key: Optional[frozenset] = None
+        self._problem: Optional[CompiledProblem] = None
+        self._base_fp: Optional[str] = None
+        self._fp: Optional[str] = None
+        self._ext_state: Dict[str, Any] = {}
+        # edit plan: per tracked constraint name, how its current
+        # realization lands in the compiled arrays
+        self._plan: Dict[str, Dict[str, Any]] = {}
+        self._ext_to_cons: Dict[str, List[str]] = {}
+        # incremental updates need the single-shard arity-major layout
+        # and per-constraint (non-shared) tables
+        self._incremental_ok = False
+
+    # -- public --------------------------------------------------------
+
+    def compile(
+        self,
+        frozen: Mapping[str, Any],
+        ext_overrides: Mapping[str, Any],
+    ) -> Tuple[Optional[CompiledProblem], Optional[str]]:
+        from pydcop_tpu.telemetry import get_metrics, get_tracer
+
+        met = get_metrics()
+        ext_values = {
+            name: ext_overrides.get(name, ev.value)
+            for name, ev in self.dcop.external_variables.items()
+        }
+        fkey = frozenset(frozen.items())
+        if self._problem is not None and fkey == self._frozen_key:
+            changed = {
+                n
+                for n, v in ext_values.items()
+                if self._ext_state.get(n) != v
+            }
+            if not changed:
+                if met.enabled:
+                    met.inc("compile.reused")
+                return self._problem, self._fp
+            if self._incremental_ok:
+                affected = sorted(
+                    {
+                        cn
+                        for e in changed
+                        for cn in self._ext_to_cons.get(e, ())
+                    }
+                )
+                if not affected:
+                    # the changed externals feed no compiled
+                    # constraint (fully-external ones are dropped by
+                    # the compiler): arrays and fingerprint are
+                    # untouched — a pure reuse, and the state carry
+                    # survives
+                    self._ext_state = ext_values
+                    if met.enabled:
+                        met.inc("compile.reused")
+                    return self._problem, self._fp
+                t0 = time.perf_counter()
+                n_updates = self._apply_updates(
+                    affected, {**ext_values, **frozen}
+                )
+                self._ext_state = ext_values
+                self._fp = self._fingerprint(ext_values)
+                if met.enabled:
+                    met.inc("compile.incremental")
+                tr = get_tracer()
+                if tr.enabled:
+                    tr.add_span(
+                        "incremental-update", "compile", t0,
+                        time.perf_counter() - t0,
+                        constraints=n_updates,
+                    )
+                return self._problem, self._fp
+        # structure changed (or first call, or incremental unsupported):
+        # full rebuild
+        problem = self._full_compile(frozen, ext_values)
+        if problem is None:
+            return None, None
+        if met.enabled:
+            met.inc("compile.full")
+        return self._problem, self._fp
+
+    # -- full compile + plan build -------------------------------------
+
+    def _active_dcop(
+        self, frozen: Mapping[str, Any], ext_values: Mapping[str, Any]
+    ) -> DCOP:
+        """The currently-solvable problem: frozen variables become
+        external (constant at their last value), external overrides
+        applied."""
+        d = DCOP(self.dcop.name, objective=self.dcop.objective)
+        for v in self.dcop.variables.values():
+            if v.name in frozen:
+                d.add_variable(
+                    ExternalVariable(v.name, v.domain, frozen[v.name])
+                )
+            else:
+                d.add_variable(v)
+        for ev in self.dcop.external_variables.values():
+            d.add_variable(
+                ExternalVariable(ev.name, ev.domain, ext_values[ev.name])
+            )
+        for c in self.dcop.constraints.values():
+            d.add_constraint(c)
+        return d
+
+    def _full_compile(
+        self, frozen: Mapping[str, Any], ext_values: Dict[str, Any]
+    ) -> Optional[CompiledProblem]:
+        ad = self._active_dcop(frozen, ext_values)
+        if not ad.variables:
+            # everything frozen/external: nothing to solve
+            self._problem = None
+            self._frozen_key = None
+            return None
+        problem = compile_dcop(
+            ad,
+            dtype=self.dtype,
+            n_shards=self.n_shards,
+            pad_policy=self.pad_policy,
+        )
+        self._problem = problem
+        self._frozen_key = frozenset(frozen.items())
+        self._ext_state = dict(ext_values)
+        self._base_fp = problem_fingerprint(problem)
+        self._build_plan(problem, frozen, ext_values)
+        self._fp = self._fingerprint(ext_values)
+        return problem
+
+    def _build_plan(
+        self,
+        problem: CompiledProblem,
+        frozen: Mapping[str, Any],
+        ext_values: Mapping[str, Any],
+    ) -> None:
+        """Record, for every constraint touching a DECLARED external
+        variable, where its current realization lives in the compiled
+        arrays.  Frozen variables never change value within a
+        structure, so frozen-only constraints are static here."""
+        self._plan = {}
+        self._ext_to_cons = {}
+        self._incremental_ok = self.n_shards <= 1 and not any(
+            b.shared_table for b in problem.buckets.values()
+        )
+        if not self._incremental_ok:
+            return
+        declared = set(self.dcop.external_variables)
+        full_ext = {**ext_values, **frozen}
+        d_max = problem.d_max
+        con_idx = {name: i for i, name in enumerate(problem.con_names)}
+        # arity-major layout: bucket row of constraint ci with arity k
+        # is ci - (index of the first arity-k constraint)
+        arity_base: Dict[int, int] = {}
+        base = 0
+        for k in sorted(problem.buckets):
+            arity_base[k] = base
+            base += problem.buckets[k].n_cons
+        con_offset = np.asarray(problem.con_offset)
+        var_slot = {
+            name: i
+            for i, name in enumerate(
+                problem.var_names[: problem.n_real_vars]
+            )
+        }
+        domain_sizes = np.asarray(problem.domain_sizes)
+
+        for cname, c in self.dcop.constraints.items():
+            scope = list(c.scope_names)
+            hot = [n for n in scope if n in declared]
+            if not hot:
+                continue
+            scope_ext = [n for n in scope if n in full_ext]
+            live = [n for n in scope if n not in full_ext]
+            entry: Dict[str, Any] = {"ext": scope_ext}
+            if not live:
+                # fully-external constraint: the compiler drops it, so
+                # its externals never touch the compiled arrays — keep
+                # it OUT of _ext_to_cons or a set_value on one would
+                # churn the fingerprint (and drop the state carry)
+                # over byte-identical arrays
+                continue
+            elif len(live) == 1:
+                slot = var_slot[live[0]]
+                entry["kind"] = "unary"
+                entry["slot"] = slot
+                entry["dlen"] = int(domain_sizes[slot])
+                entry["table"] = self._tabulate(c, scope_ext, full_ext, d_max)
+            else:
+                ci = con_idx[cname]
+                k = len(live)
+                entry["kind"] = "multi"
+                entry["ci"] = ci
+                entry["k"] = k
+                entry["offset"] = int(con_offset[ci])
+                entry["row"] = ci - arity_base[k]
+            self._plan[cname] = entry
+            for n in hot:
+                self._ext_to_cons.setdefault(n, []).append(cname)
+
+    # -- incremental update --------------------------------------------
+
+    def _tabulate(
+        self, c, scope_ext, full_ext: Mapping[str, Any], d_max: int
+    ) -> np.ndarray:
+        sliced = c.slice({n: full_ext[n] for n in scope_ext})
+        return _tabulate_padded(sliced, d_max) * self._sign
+
+    def _apply_updates(
+        self, names: List[str], full_ext: Dict[str, Any]
+    ) -> int:
+        p = self._problem
+        d_max = p.d_max
+        # accumulate all edits on host, then issue ONE batched update
+        # per device array — eager per-constraint .at ops would copy
+        # each (potentially huge) array once per touched constraint
+        flat_idx: List[np.ndarray] = []
+        flat_val: List[np.ndarray] = []
+        unary_slots: List[int] = []
+        unary_deltas: List[np.ndarray] = []
+        brow_updates: Dict[int, Tuple[List[int], List[np.ndarray]]] = {}
+        n_updates = 0
+        for cname in names:
+            entry = self._plan[cname]
+            c = self.dcop.constraints[cname]
+            tbl = self._tabulate(c, entry["ext"], full_ext, d_max)
+            n_updates += 1
+            if entry["kind"] == "unary":
+                dlen = entry["dlen"]
+                delta = np.zeros(d_max, dtype=np.float32)
+                delta[:dlen] = tbl[:dlen] - entry["table"][:dlen]
+                unary_slots.append(entry["slot"])
+                unary_deltas.append(delta)
+                entry["table"] = tbl
+            else:
+                size = d_max ** entry["k"]
+                flat_idx.append(
+                    np.arange(
+                        entry["offset"],
+                        entry["offset"] + size,
+                        dtype=np.int32,
+                    )
+                )
+                flat_val.append(tbl.reshape(-1))
+                rows, tbls = brow_updates.setdefault(
+                    entry["k"], ([], [])
+                )
+                rows.append(entry["row"])
+                tbls.append(tbl)
+
+        tables_flat = p.tables_flat
+        unary = p.unary
+        if flat_idx:
+            tables_flat = tables_flat.at[
+                jnp.asarray(np.concatenate(flat_idx))
+            ].set(
+                jnp.asarray(
+                    np.concatenate(flat_val), dtype=tables_flat.dtype
+                )
+            )
+        if unary_slots:
+            # .add with duplicate slot indices accumulates, so several
+            # updated constraints folding into one variable compose
+            unary = unary.at[jnp.asarray(unary_slots)].add(
+                jnp.asarray(np.stack(unary_deltas), dtype=unary.dtype)
+            )
+        buckets = dict(p.buckets)
+        for k, (rows, tbls) in brow_updates.items():
+            b = buckets[k]
+            stack = np.stack(tbls)
+            rows_a = jnp.asarray(np.asarray(rows, dtype=np.int32))
+            buckets[k] = dataclasses.replace(
+                b,
+                tables=b.tables.at[rows_a].set(
+                    jnp.asarray(stack, dtype=b.tables.dtype)
+                ),
+                tables_t=b.tables_t.at[..., rows_a].set(
+                    jnp.asarray(
+                        np.moveaxis(stack, 0, -1),
+                        dtype=b.tables_t.dtype,
+                    )
+                ),
+            )
+        self._problem = dataclasses.replace(
+            p, unary=unary, tables_flat=tables_flat, buckets=buckets
+        )
+        return n_updates
+
+    # -- fingerprint ---------------------------------------------------
+
+    def _fingerprint(self, ext_values: Mapping[str, Any]) -> str:
+        """Stable id of the current problem CONTENT: the base compile's
+        array hash + the effective external values.  Externals no
+        constraint reads are excluded, so changing them never breaks
+        the engine's full-state carry."""
+        effective = sorted(
+            (n, v)
+            for n, v in ext_values.items()
+            if n in self._ext_to_cons
+        )
+        h = hashlib.sha256(self._base_fp.encode())
+        h.update(repr(effective).encode())
+        return h.hexdigest()[:16]

@@ -2,9 +2,11 @@ from pydcop_tpu.ops.compile import (
     BIG,
     ArityBucket,
     CompiledProblem,
+    canonical_execution_problem,
     compile_dcop,
     compile_from_arrays,
     decode_assignment,
+    enable_persistent_compilation_cache,
     encode_assignment,
 )
 from pydcop_tpu.ops.costs import (
@@ -13,14 +15,19 @@ from pydcop_tpu.ops.costs import (
     segment_sum_edges,
     total_cost,
 )
+from pydcop_tpu.ops.padding import PadPolicy, as_pad_policy
 
 __all__ = [
     "BIG",
     "ArityBucket",
     "CompiledProblem",
+    "PadPolicy",
+    "as_pad_policy",
+    "canonical_execution_problem",
     "compile_dcop",
     "compile_from_arrays",
     "decode_assignment",
+    "enable_persistent_compilation_cache",
     "encode_assignment",
     "local_cost_sweep",
     "neighbor_gather",

@@ -52,10 +52,14 @@ import numpy as np
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.dcop.objects import Variable
 from pydcop_tpu.dcop.relations import RelationProtocol
-
-# Cost assigned to padded (invalid) domain values; large enough to never
-# be selected, small enough to leave f32 headroom when summed.
-BIG = 1e9
+from pydcop_tpu.ops.padding import (
+    BIG,  # noqa: F401 (canonical home: ops.padding; re-exported here)
+    NO_PADDING,
+    PadPolicy,
+    as_pad_policy,
+    ghost_scopes,
+    ghost_unary,
+)
 
 # Guard: dense tabulation over padded domains is d_max**arity cells.
 MAX_ARITY = 6
@@ -153,12 +157,24 @@ class CompiledProblem:
     var_slot_counts: Tuple[int, ...] = dataclasses.field(
         metadata={"static": True}, default=()
     )
+    # trailing ghost variables added by a pad policy (shape bucketing,
+    # ops/padding.py): excluded from assignments in/out, pinned to a
+    # 1-value domain at zero cost
+    n_pad_vars: int = dataclasses.field(
+        metadata={"static": True}, default=0
+    )
 
     # -- derived sizes (host-side helpers, not traced) ------------------
 
     @property
     def n_vars(self) -> int:
         return self.unary.shape[0]
+
+    @property
+    def n_real_vars(self) -> int:
+        """Variables that exist in the source problem (ghost padding
+        excluded) — the prefix of every per-variable array."""
+        return self.n_vars - self.n_pad_vars
 
     @property
     def d_max(self) -> int:
@@ -181,20 +197,26 @@ class CompiledProblem:
 
 
 def compile_dcop(
-    dcop: DCOP, dtype=jnp.float32, n_shards: int = 1
+    dcop: DCOP, dtype=jnp.float32, n_shards: int = 1, pad_policy="none"
 ) -> CompiledProblem:
     """Tabulate and pack a DCOP into a :class:`CompiledProblem` (see
     :func:`_compile_dcop`); records a ``compile-problem`` span when a
-    telemetry session is active (``docs/observability.md``)."""
+    telemetry session is active (``docs/observability.md``).
+
+    ``pad_policy`` (``"none"`` | ``"pow2"`` | ``"pow2:<floor>"`` | a
+    :class:`~pydcop_tpu.ops.padding.PadPolicy`) buckets every array
+    dimension so similarly-sized problems share compiled executables —
+    see ``ops/padding.py`` and ``docs/performance.md``.
+    """
     import time as _time
 
     from pydcop_tpu.telemetry import get_tracer
 
     tr = get_tracer()
     if not tr.enabled:
-        return _compile_dcop(dcop, dtype, n_shards)
+        return _compile_dcop(dcop, dtype, n_shards, pad_policy)
     t0 = _time.perf_counter()
-    problem = _compile_dcop(dcop, dtype, n_shards)
+    problem = _compile_dcop(dcop, dtype, n_shards, pad_policy)
     tr.add_span(
         "compile-problem", "compile", t0, _time.perf_counter() - t0,
         n_vars=int(problem.n_vars), n_edges=int(problem.n_edges),
@@ -204,7 +226,7 @@ def compile_dcop(
 
 
 def _compile_dcop(
-    dcop: DCOP, dtype=jnp.float32, n_shards: int = 1
+    dcop: DCOP, dtype=jnp.float32, n_shards: int = 1, pad_policy="none"
 ) -> CompiledProblem:
     """Tabulate and pack a DCOP into a :class:`CompiledProblem`.
 
@@ -296,8 +318,35 @@ def _compile_dcop(
             )
 
     n_real_edges = sum(len(scope) for _, scope, _ in multi_cons)
+
+    # shape bucketing (ops/padding.py): ghost variables first — ghost
+    # constraints below scope THEM, keeping real variables' adjacency
+    # untouched.  Ghosts pin to value 0 (1-value domain, BIG on the
+    # rest) at zero cost.
+    pol = as_pad_policy(pad_policy)
+    n_pad_vars = 0
+    ghost_vars: List[int] = []
+    if pol.enabled:
+        n_pad_vars = pol.bucket(n_vars) - n_vars
+        if n_pad_vars:
+            ghost_vars = list(range(n_vars, n_vars + n_pad_vars))
+            domain_sizes = np.concatenate(
+                [domain_sizes, np.ones(n_pad_vars, dtype=np.int32)]
+            )
+            unary = np.concatenate([unary, ghost_unary(n_pad_vars, d_max)])
+            init_idx = np.concatenate(
+                [init_idx, np.zeros(n_pad_vars, dtype=np.int32)]
+            )
+            var_names = var_names + tuple(
+                f"__pad_v{i}" for i in range(n_pad_vars)
+            )
+            domain_labels = domain_labels + ((0,),) * n_pad_vars
+            n_vars += n_pad_vars
+
     if n_shards > 1:
-        multi_cons = _shard_major_layout(multi_cons, n_shards, d_max)
+        multi_cons = _shard_major_layout(
+            multi_cons, n_shards, d_max, policy=pol, ghost_vars=ghost_vars
+        )
     else:
         # arity-major (stable) order: every arity bucket's constraints —
         # and therefore its edges (emitted constraint-major below) —
@@ -306,6 +355,10 @@ def _compile_dcop(
         # slices and write r as stacked blocks (no scatter/gather).
         # The shard-major branch already guarantees it per shard.
         multi_cons = sorted(multi_cons, key=lambda it: len(it[1]))
+        if pol.enabled:
+            multi_cons = _pad_arity_groups(
+                multi_cons, pol, d_max, ghost_vars
+            )
 
     con_names = tuple(name for name, _, _ in multi_cons)
     n_cons = len(multi_cons)
@@ -330,8 +383,10 @@ def _compile_dcop(
             i = j
 
     # per-run scope matrices + table stacks (the one remaining
-    # per-constraint pass)
-    runs: List[Tuple[int, np.ndarray, np.ndarray]] = []
+    # per-constraint pass); trailing ghost constraints (pad/shard
+    # padding, always appended at group tails) are counted per run so
+    # packing can keep their edges out of the per-variable edge lists
+    runs: List[Tuple[int, np.ndarray, np.ndarray, int]] = []
     for i, j, k in run_bounds:
         sc = np.asarray(
             [multi_cons[ci][1] for ci in range(i, j)], dtype=np.int32
@@ -341,9 +396,15 @@ def _compile_dcop(
             if j > i
             else np.zeros((0,) + (d_max,) * k, dtype=np.float32)
         )
-        runs.append((k, sc, tb))
+        tail = 0
+        while tail < j - i and _is_ghost_name(multi_cons[j - 1 - tail][0]):
+            tail += 1
+        runs.append((k, sc, tb, tail))
 
-    packed = _pack_runs(runs, n_vars, d_max, dtype)
+    packed = _pack_runs(
+        runs, n_vars, d_max, dtype,
+        policy=pol, drop_ghost_edges=pol.enabled,
+    )
 
     return CompiledProblem(
         domain_sizes=jnp.asarray(domain_sizes),
@@ -355,34 +416,91 @@ def _compile_dcop(
         maximize=dcop.objective == "max",
         n_shards=n_shards,
         n_real_edges=n_real_edges,
+        n_pad_vars=n_pad_vars,
         **packed,
     )
 
 
+def _is_ghost_name(name: str) -> bool:
+    """Ghost constraints: shard-divisibility padding (``__ghost_``) and
+    pad-policy bucketing (``__pad_c``)."""
+    return name.startswith("__ghost_") or name.startswith("__pad_c")
+
+
+def _pad_arity_groups(
+    multi_cons: List[Tuple[str, List[int], np.ndarray]],
+    policy: PadPolicy,
+    d_max: int,
+    ghost_vars: Sequence[int],
+) -> List[Tuple[str, List[int], np.ndarray]]:
+    """Pad each arity group of an arity-sorted constraint list up to
+    the policy's bucket with zero-table ghost constraints scoped on
+    ghost variables (cycled; variable 0 when the problem's variable
+    count already sat on a bucket boundary — harmless either way, the
+    tables are all-zero and the edges never enter ``var_edges``)."""
+    out: List[Tuple[str, List[int], np.ndarray]] = []
+    i = 0
+    gi = 0
+    while i < len(multi_cons):
+        k = len(multi_cons[i][1])
+        j = i
+        while j < len(multi_cons) and len(multi_cons[j][1]) == k:
+            j += 1
+        group = multi_cons[i:j]
+        m = len(group)
+        need = policy.bucket(m) - m
+        scopes = ghost_scopes(ghost_vars, need, k, start=gi)
+        gi += need
+        for t in range(need):
+            group.append(
+                (
+                    f"__pad_c{k}_{t}",
+                    list(scopes[t]),
+                    np.zeros((d_max,) * k, dtype=np.float32),
+                )
+            )
+        out.extend(group)
+        i = j
+    return out
+
+
 def _pack_runs(
-    runs: Sequence[Tuple[int, np.ndarray, np.ndarray]],
+    runs: Sequence[Tuple[int, np.ndarray, np.ndarray, int]],
     n_vars: int,
     d_max: int,
     dtype,
+    policy: PadPolicy = NO_PADDING,
+    drop_ghost_edges: bool = False,
 ) -> Dict[str, Any]:
     """Vectorized packing of constraint runs into the flat + edge +
     bucket arrays of :class:`CompiledProblem`.
 
     ``runs`` is the constraint list in its final (segment-major,
     arity-sorted-within-segment) order, as contiguous same-arity runs:
-    ``(k, scopes i32[m, k], tables f32[m, d_max^k])`` — one run per
-    (shard segment, arity).  A run whose tables have leading dim 1
-    while its scopes have m > 1 is a **shared-table run**: all m
-    constraints use the one table.  Its flat form stores the table
-    ONCE (every constraint's offset points at it) and its arity bucket
-    keeps the [1, ...] shape (broadcast by consumers) — at 1M
-    variables this removes ~d²·m floats of memory and per-round HBM
-    traffic from the Max-Sum factor phase.  Returns the keyword dict
-    of every constraint-derived CompiledProblem field.
+    ``(k, scopes i32[m, k], tables f32[m, d_max^k], ghost_tail)`` —
+    one run per (shard segment, arity); ``ghost_tail`` counts the
+    zero-table ghost constraints padded onto the run's end.  A run
+    whose tables have leading dim 1 while its scopes have m > 1 is a
+    **shared-table run**: all m constraints use the one table.  Its
+    flat form stores the table ONCE (every constraint's offset points
+    at it) and its arity bucket keeps the [1, ...] shape (broadcast by
+    consumers) — at 1M variables this removes ~d²·m floats of memory
+    and per-round HBM traffic from the Max-Sum factor phase.  Returns
+    the keyword dict of every constraint-derived CompiledProblem field.
+
+    With ``drop_ghost_edges`` (pad-policy compiles), ghost constraints'
+    edges are kept out of the per-variable ``var_edges`` lists so pad
+    counts never widen ``max_var_deg`` — their zero tables already make
+    them inert everywhere else.  ``policy`` additionally quantizes the
+    adjacency widths, ``var_slot_counts`` prefixes, and the flat-table
+    length, so problems that differ only within a bucket produce
+    byte-compatible array SHAPES (see ``ops/padding.py``).
     """
-    k_max = max((k for k, _, _ in runs), default=2)
+    # tolerate legacy 3-tuple runs (no ghost tail) from direct callers
+    runs = [r if len(r) == 4 else (*r, 0) for r in runs]
+    k_max = max((k for k, _, _, _ in runs), default=2)
     k_max = max(k_max, 2)
-    n_cons = sum(sc.shape[0] for _, sc, _ in runs)
+    n_cons = sum(sc.shape[0] for _, sc, _, _ in runs)
 
     def _is_shared(sc: np.ndarray, tb: np.ndarray) -> bool:
         return tb.shape[0] == 1 and sc.shape[0] > 1
@@ -392,7 +510,7 @@ def _pack_runs(
     # wrap — corrupt offsets, wrong costs, no error.  Refuse up front.
     total_cells = sum(
         (1 if _is_shared(sc, tb) else sc.shape[0]) * d_max**k
-        for k, sc, tb in runs
+        for k, sc, tb, _ in runs
     )
     if total_cells > np.iinfo(np.int32).max:
         raise ValueError(
@@ -409,7 +527,7 @@ def _pack_runs(
     total = 0
     ci = 0
     run_con_base = []
-    for k, sc, tb in runs:
+    for k, sc, tb, _ in runs:
         m = sc.shape[0]
         size = d_max**k
         run_con_base.append(ci)
@@ -428,10 +546,23 @@ def _pack_runs(
         con_strides[ci : ci + m, :k] = strides
         ci += m
     tables_flat = (
-        np.concatenate([tb.reshape(-1) for _, _, tb in runs])
+        np.concatenate([tb.reshape(-1) for _, _, tb, _ in runs])
         if runs
         else np.zeros(1, dtype=np.float32)
     )
+    if policy.enabled:
+        # quantize the flat pool's length (block multiples, not pow2 —
+        # the pool can be huge); no offset ever points at the padding
+        tgt_cells = policy.bucket_cells(tables_flat.size)
+        if tgt_cells > tables_flat.size:
+            tables_flat = np.concatenate(
+                [
+                    tables_flat,
+                    np.zeros(
+                        tgt_cells - tables_flat.size, dtype=np.float32
+                    ),
+                ]
+            )
 
     # Edge ids are POSITION-MAJOR within each (shard segment, arity)
     # run: all position-0 edges of the run's constraints, then all
@@ -439,16 +570,17 @@ def _pack_runs(
     # contiguous slice and writes r as concatenated blocks — zero
     # scatters/gathers on the factor side (n_shards=1: whole list is
     # one segment; shard-major: each shard's sublist is arity-sorted).
-    n_edges = sum(sc.shape[0] * k for k, sc, _ in runs)
+    n_edges = sum(sc.shape[0] * k for k, sc, _, _ in runs)
     edge_var = np.zeros(max(n_edges, 1), dtype=np.int32)
     edge_con = np.zeros(max(n_edges, 1), dtype=np.int32)
     edge_offset = np.zeros(max(n_edges, 1), dtype=np.int32)
     edge_stride = np.zeros(max(n_edges, 1), dtype=np.int32)
     edge_covars = np.zeros((max(n_edges, 1), k_max - 1), dtype=np.int32)
     edge_costrides = np.zeros((max(n_edges, 1), k_max - 1), dtype=np.int32)
+    edge_ghost = np.zeros(max(n_edges, 1), dtype=bool)
     run_edge_base = []
     edge_base = 0
-    for ri, (k, sc, _) in enumerate(runs):
+    for ri, (k, sc, _, gtail) in enumerate(runs):
         m = sc.shape[0]
         i = run_con_base[ri]
         strides = np.array(
@@ -464,13 +596,23 @@ def _pack_runs(
             other = [q for q in range(k) if q != p]
             edge_covars[sl, : k - 1] = sc[:, other]
             edge_costrides[sl, : k - 1] = strides[other]
+            if gtail:
+                edge_ghost[
+                    edge_base + p * m + (m - gtail) : edge_base + (p + 1) * m
+                ] = True
         edge_base += m * k
 
     # per-variable incoming edge lists (sentinel-padded with n_edges):
     # stable sort by owner variable = the ascending edge ids the old
-    # append loop produced
-    if n_edges:
-        ev = edge_var[:n_edges]
+    # append loop produced.  Pad-policy compiles keep GHOST edges out
+    # of these lists (their contribution is zero everywhere), so the
+    # list width stays the real max degree and never varies with the
+    # amount of padding.
+    sel = np.arange(n_edges, dtype=np.int64)
+    if drop_ghost_edges and n_edges:
+        sel = sel[~edge_ghost[:n_edges]]
+    if sel.size:
+        ev = edge_var[sel]
         counts = np.bincount(ev, minlength=n_vars)
         max_var_deg = max(int(counts.max(initial=0)), 1)
         var_edges = np.full((n_vars, max_var_deg), n_edges, dtype=np.int32)
@@ -478,8 +620,8 @@ def _pack_runs(
         ev_sorted = ev[order]
         starts = np.zeros(n_vars, dtype=np.int64)
         starts[1:] = np.cumsum(counts)[:-1]
-        rank = np.arange(n_edges, dtype=np.int64) - starts[ev_sorted]
-        var_edges[ev_sorted, rank] = order.astype(np.int32)
+        rank = np.arange(sel.size, dtype=np.int64) - starts[ev_sorted]
+        var_edges[ev_sorted, rank] = sel[order].astype(np.int32)
     else:
         max_var_deg = 1
         var_edges = np.full((n_vars, 1), n_edges, dtype=np.int32)
@@ -500,12 +642,39 @@ def _pack_runs(
             "prefix-gather optimization disabled for this problem"
         )
         var_slot_counts = ()
+    if policy.enabled:
+        # quantize the adjacency width and the per-slot prefix counts:
+        # both are jit-static (the counts bound the belief prefix
+        # gathers), so problems in the same bucket must agree on them
+        # exactly.  Over-approximated counts are safe — the extra rows
+        # are sentinels gathering the callers' zero pad row.
+        w = policy.bucket_dim(max_var_deg)
+        if w > var_edges.shape[1]:
+            var_edges = np.concatenate(
+                [
+                    var_edges,
+                    np.full(
+                        (n_vars, w - var_edges.shape[1]),
+                        n_edges,
+                        dtype=np.int32,
+                    ),
+                ],
+                axis=1,
+            )
+        if var_slot_counts:
+            var_slot_counts = var_slot_counts + (0,) * (
+                w - len(var_slot_counts)
+            )
+            var_slot_counts = tuple(
+                0 if c == 0 else min(policy.bucket(c), n_vars)
+                for c in var_slot_counts
+            )
 
     # primal neighbors (padded): directed in-scope pairs, value-deduped
     # (ghost constraints self-reference a variable → dropped by the
     # a != b value test, as before)
     pair_parts = []
-    for k, sc, _ in runs:
+    for k, sc, _, _ in runs:
         for a in range(k):
             for b in range(k):
                 if a != b:
@@ -520,6 +689,8 @@ def _pack_runs(
         pairs = np.zeros((0, 2), dtype=np.int32)
     ncounts = np.bincount(pairs[:, 0], minlength=n_vars)
     max_deg = max(int(ncounts.max(initial=0)), 1)
+    if policy.enabled:
+        max_deg = policy.bucket_dim(max_deg)
     neighbors = np.zeros((n_vars, max_deg), dtype=np.int32)
     neighbor_mask = np.zeros((n_vars, max_deg), dtype=bool)
     if len(pairs):
@@ -532,7 +703,7 @@ def _pack_runs(
     # arity buckets: concatenate each arity's runs in run order; edge
     # slots are pure arithmetic on the run layout
     by_arity: Dict[int, List[int]] = {}
-    for ri, (k, _, _) in enumerate(runs):
+    for ri, (k, _, _, _) in enumerate(runs):
         by_arity.setdefault(k, []).append(ri)
     buckets: Dict[int, ArityBucket] = {}
     for k, run_ids in sorted(by_arity.items()):
@@ -546,7 +717,7 @@ def _pack_runs(
                 "arity (materialize before shard-major layout)"
             )
         for ri in run_ids:
-            _, sc, tb = runs[ri]
+            _, sc, tb, _ = runs[ri]
             m = sc.shape[0]
             tparts.append(tb)
             sparts.append(sc)
@@ -712,6 +883,7 @@ def compile_from_arrays(
     var_prefix: str = "v",
     con_prefix: str = "c",
     dtype=jnp.float32,
+    pad_policy="none",
 ) -> CompiledProblem:
     """Array-level problem construction — the fast path for big
     generated instances.
@@ -750,6 +922,13 @@ def compile_from_arrays(
         Shard-major layout over this many mesh shards (ghost-padded
         per arity, round-robin balanced — same layout contract as
         :func:`compile_dcop`).
+    pad_policy:
+        Shape bucketing (``ops/padding.py``): quantize every array
+        dimension so similar problem sizes share compiled
+        executables.  NOTE: an enabled policy materializes a
+        shared-table group when ghosts must be appended to it (ghost
+        padding cannot share a nonzero table); a group already on a
+        bucket boundary keeps the shared-table memory win.
 
     Variable ``i`` is named ``f"{var_prefix}{i}"``; assignments in and
     out are keyed by those names exactly as with :func:`compile_dcop`.
@@ -786,6 +965,13 @@ def compile_from_arrays(
             f"n_values={d}"
         )
     sign = -1.0 if maximize else 1.0
+
+    pol = as_pad_policy(pad_policy)
+    n_real_vars = n_vars
+    n_pad_vars = 0
+    if pol.enabled:
+        n_pad_vars = pol.bucket(n_vars) - n_vars
+        n_vars += n_pad_vars
 
     # normalize tables: shared ``f32[(d,)*k]`` stays ONE copy (leading
     # dim 1 — the packer stores it once and consumers broadcast);
@@ -838,6 +1024,11 @@ def compile_from_arrays(
             and ts[0].shape[0] == 1
             and sc.shape[0] > 1
             and n_shards <= 1
+            # a pad policy appends zero-table ghosts to the group, so
+            # the one table cannot be shared by all rows — materialize
+            # only when ghosts will actually be appended (a group
+            # already on a bucket boundary keeps the shared table)
+            and pol.bucket(sc.shape[0]) == sc.shape[0]
         ):
             return sc, ts[0]
         mats = [
@@ -858,12 +1049,27 @@ def compile_from_arrays(
     ]
     scopes = [sc for sc, _ in merged]
     norm_tables = [tb for _, tb in merged]
-    runs: List[Tuple[int, np.ndarray, np.ndarray]] = []
+
+    # pad-policy ghost constraints scope the ghost variable SLOTS
+    # (the tail of the slot order — ghosts have degree 0)
+    ghost_slots = list(range(n_real_vars, n_vars))
+
+    def _ghost_rows(g: int, k: int) -> np.ndarray:
+        return ghost_scopes(ghost_slots, g, k)
+
+    runs: List[Tuple[int, np.ndarray, np.ndarray, int]] = []
     auto_con_ids: List[np.ndarray] = []
     cid_base = 0
     if n_shards <= 1:
         for s, t in zip(scopes, norm_tables):
-            runs.append((s.shape[1], s, t))
+            m, k = s.shape
+            gtail = pol.bucket(m) - m if pol.enabled else 0
+            if gtail:
+                s = np.concatenate([s, _ghost_rows(gtail, k)])
+                t = np.concatenate(
+                    [t, np.zeros((gtail,) + (d,) * k, dtype=np.float32)]
+                )
+            runs.append((k, s, t, gtail))
             auto_con_ids.append(
                 np.arange(cid_base, cid_base + s.shape[0], dtype=np.int64)
             )
@@ -871,46 +1077,76 @@ def compile_from_arrays(
     else:
         import math
 
-        per_shard_parts: List[List[Tuple[np.ndarray, np.ndarray, np.ndarray]]] = [
-            [] for _ in range(n_shards)
-        ]
+        per_shard_parts: List[
+            List[Tuple[np.ndarray, np.ndarray, np.ndarray, int]]
+        ] = [[] for _ in range(n_shards)]
         for s, t in zip(scopes, norm_tables):
             m, k = s.shape
-            tgt = math.ceil(m / n_shards) * n_shards
-            if tgt > m:  # ghost constraints: scope 0s, zero table
-                s = np.concatenate(
-                    [s, np.zeros((tgt - m, k), dtype=np.int32)]
+            per_shard = math.ceil(m / n_shards)
+            if pol.enabled:
+                per_shard = pol.bucket(per_shard)
+            tgt = per_shard * n_shards
+            if tgt > m:  # ghost constraints: zero tables
+                gs = (
+                    _ghost_rows(tgt - m, k)
+                    if pol.enabled
+                    else np.zeros((tgt - m, k), dtype=np.int32)
                 )
+                s = np.concatenate([s, gs])
                 t = np.concatenate(
                     [t, np.zeros((tgt - m,) + (d,) * k, dtype=np.float32)]
                 )
             ids = np.arange(cid_base, cid_base + tgt, dtype=np.int64)
             cid_base += tgt
+            # ghosts occupy indices [m, tgt): ascending strided slices
+            # keep them tail-contiguous per shard
+            ghost_mark = np.arange(tgt) >= m
             for sh in range(n_shards):
                 per_shard_parts[sh].append(
-                    (s[sh::n_shards], t[sh::n_shards], ids[sh::n_shards])
+                    (
+                        s[sh::n_shards],
+                        t[sh::n_shards],
+                        ids[sh::n_shards],
+                        int(ghost_mark[sh::n_shards].sum()),
+                    )
                 )
         for sh in range(n_shards):
-            for s, t, ids in per_shard_parts[sh]:
-                runs.append((s.shape[1], s, t))
+            for s, t, ids, gcount in per_shard_parts[sh]:
+                runs.append((s.shape[1], s, t, gcount))
                 auto_con_ids.append(ids)
 
-    packed = _pack_runs(runs, n_vars, d, dtype)
+    packed = _pack_runs(
+        runs, n_vars, d, dtype,
+        policy=pol, drop_ghost_edges=pol.enabled,
+    )
 
-    # unary / init in original id order -> slot order
+    # unary / init in original id order -> slot order.  Ghost variables
+    # (original ids [n_real_vars, n_vars), slots at the tail) pin to
+    # value 0: zero cost there, BIG everywhere else.
     if unary is None:
-        unary_np = np.zeros((n_vars, d), dtype=np.float32)
+        unary_np = np.zeros((n_real_vars, d), dtype=np.float32)
     else:
         unary_np = np.asarray(unary, dtype=np.float32) * sign
-        if unary_np.shape != (n_vars, d):
+        if unary_np.shape != (n_real_vars, d):
             raise ValueError(
-                f"unary shape {unary_np.shape} != {(n_vars, d)}"
+                f"unary shape {unary_np.shape} != {(n_real_vars, d)}"
             )
-        unary_np = unary_np[perm]
+    if n_pad_vars:
+        unary_np = np.concatenate([unary_np, ghost_unary(n_pad_vars, d)])
+    unary_np = unary_np[perm]
     if init_idx is None:
         init_np = np.zeros(n_vars, dtype=np.int32)
     else:
-        init_np = np.asarray(init_idx, dtype=np.int32)[perm]
+        init_np = np.asarray(init_idx, dtype=np.int32)
+        if n_pad_vars:
+            init_np = np.concatenate(
+                [init_np, np.zeros(n_pad_vars, dtype=np.int32)]
+            )
+        init_np = init_np[perm]
+
+    domain_sizes_np = np.full(n_vars, d, dtype=np.int32)
+    if n_pad_vars:  # ghost slots are the tail of the slot order
+        domain_sizes_np[n_real_vars:] = 1
 
     labels = tuple(
         domain_values if domain_values is not None else range(d)
@@ -921,7 +1157,7 @@ def compile_from_arrays(
         else np.zeros(0, dtype=np.int64)
     )
     return CompiledProblem(
-        domain_sizes=jnp.full(n_vars, d, dtype=jnp.int32),
+        domain_sizes=jnp.asarray(domain_sizes_np),
         unary=jnp.asarray(unary_np, dtype=dtype),
         init_idx=jnp.asarray(init_np),
         var_names=AutoNames(var_prefix, perm),
@@ -930,19 +1166,32 @@ def compile_from_arrays(
         maximize=maximize,
         n_shards=n_shards,
         n_real_edges=n_real_edges,
+        n_pad_vars=n_pad_vars,
         **packed,
     )
 
 
-def _shard_major_layout(multi_cons, n_shards: int, d_max: int):
+def _shard_major_layout(
+    multi_cons,
+    n_shards: int,
+    d_max: int,
+    policy: PadPolicy = NO_PADDING,
+    ghost_vars: Sequence[int] = (),
+):
     """Reorder constraints shard-major with equal per-shard, per-arity
     bucket sizes (padding with zero ghost constraints).
 
     Guarantees after reordering: for every arity k, shard s owns bucket
     rows [s·m_k, (s+1)·m_k); edges (emitted in constraint order) are
     contiguous per shard with equal counts.
+
+    With an enabled ``policy`` the per-shard bucket size is quantized
+    up to the policy's bucket and the ghosts scope the pad-policy
+    ghost variables (cycled) instead of variable 0.
     """
     import math
+
+    ghost_targets = list(ghost_vars) or [0]
 
     by_arity: Dict[int, List[Tuple[str, List[int], np.ndarray]]] = {}
     for item in multi_cons:
@@ -964,10 +1213,15 @@ def _shard_major_layout(multi_cons, n_shards: int, d_max: int):
     for k in sorted(by_arity):
         items = by_arity[k]
         per_shard = max(1, math.ceil(len(items) / n_shards))
+        if policy.enabled:
+            per_shard = policy.bucket(per_shard)
         target = per_shard * n_shards
+        gscopes = ghost_scopes(ghost_targets, target - len(items), k)
         for i in range(target - len(items)):
             ghost_table = np.zeros((d_max,) * k, dtype=np.float32)
-            items.append((f"__ghost_{k}_{i}", [0] * k, ghost_table))
+            items.append(
+                (f"__ghost_{k}_{i}", list(gscopes[i]), ghost_table)
+            )
         # round-robin keeps real constraints balanced across shards
         for i, item in enumerate(items):
             shards[i % n_shards].append(item)
@@ -1013,9 +1267,10 @@ def problem_fingerprint(problem: CompiledProblem) -> str:
 def encode_assignment(
     problem: CompiledProblem, assignment: Mapping[str, Any]
 ) -> jnp.ndarray:
-    """Assignment dict → i32[n_vars] of domain indices."""
+    """Assignment dict → i32[n_vars] of domain indices (ghost padding
+    slots stay at 0, their only value)."""
     idx = np.zeros(problem.n_vars, dtype=np.int32)
-    for i, name in enumerate(problem.var_names):
+    for i, name in enumerate(problem.var_names[: problem.n_real_vars]):
         labels = problem.domain_labels[i]
         val = assignment[name]
         try:
@@ -1029,9 +1284,85 @@ def encode_assignment(
 def decode_assignment(
     problem: CompiledProblem, values: jax.Array
 ) -> Dict[str, Any]:
-    """i32[n_vars] of domain indices → assignment dict."""
+    """i32[n_vars] of domain indices → assignment dict (ghost padding
+    variables excluded)."""
     vals = np.asarray(values)
     return {
         name: problem.domain_labels[i][int(vals[i])]
-        for i, name in enumerate(problem.var_names)
+        for i, name in enumerate(
+            problem.var_names[: problem.n_real_vars]
+        )
     }
+
+
+def canonical_execution_problem(
+    problem: CompiledProblem,
+) -> CompiledProblem:
+    """A copy of ``problem`` whose HOST-ONLY static metadata (names,
+    labels, accounting counts) is replaced by shape-derived
+    placeholders.
+
+    The jit trace cache keys on the pytree structure — including every
+    static field — so two problems with identical array shapes but
+    different variable names would re-trace *and* re-compile the same
+    XLA program.  None of those fields feed traced code (they exist
+    for decode/accounting), so the engine runs its jitted chunk
+    runners on this canonical copy and keeps the original for
+    decoding: any two problems that agree on shapes, dtypes and the
+    traced statics (``var_slot_counts``, ``n_shards``, bucket arities)
+    then share one compiled executable.  This is what makes
+    shape-bucketed dynamic-run segments (``pad_policy`` +
+    ``engine/dynamic.py``) resume without a single new compile.
+
+    Array leaves are passed through UNTOUCHED (same device buffers).
+    """
+    n = problem.n_vars
+    return dataclasses.replace(
+        problem,
+        var_names=("__anon_vars__", n),
+        domain_labels=("__anon_labels__", n, problem.d_max),
+        con_names=("__anon_cons__", problem.n_cons),
+        n_real_edges=problem.n_edges,
+        n_pad_vars=0,
+    )
+
+
+def enable_persistent_compilation_cache(
+    cache_dir: str, min_compile_seconds: float = 0.0
+) -> bool:
+    """Route XLA executables through jax's on-disk compilation cache.
+
+    Repeated processes (benchmark rounds, orchestrated sweeps, CI)
+    then skip backend compilation entirely for programs they have
+    compiled before — the third cache layer of
+    ``docs/performance.md`` (runner cache → jit trace cache → this).
+    Returns ``False`` (and changes nothing) on jax versions without
+    the cache config; telemetry sessions count hits/misses as
+    ``jit.persistent_cache_hits`` / ``jit.persistent_cache_misses``.
+    """
+    import os
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception as e:
+        # the caller asked for a cache explicitly — a silent no-op
+        # would let every run keep compiling from scratch unnoticed
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "persistent compilation cache DISABLED: cannot use %r "
+            "(%s: %s)",
+            cache_dir,
+            type(e).__name__,
+            e,
+        )
+        return False
+    try:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(min_compile_seconds),
+        )
+    except Exception:
+        pass  # older jax: threshold flag absent, cache still works
+    return True

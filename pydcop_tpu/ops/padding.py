@@ -1,0 +1,157 @@
+"""Shape-bucketing pad policies for the problem compiler.
+
+A :class:`PadPolicy` quantizes every shape-bearing dimension of a
+:class:`~pydcop_tpu.ops.compile.CompiledProblem` — variable count,
+per-arity constraint count, adjacency widths, flat-table length — up to
+a small lattice of buckets (powers of two above a floor).  Two problems
+whose true sizes differ slightly then compile to ARRAYS OF IDENTICAL
+SHAPES, so they share one jitted executable instead of each paying an
+XLA compile: the lever behind fast dynamic-run segment transitions
+(``engine/dynamic.py``) and cheap parameter sweeps over instance sizes
+(``docs/performance.md``).
+
+Correctness contract: padding is invisible in COSTS, and invisible in
+results for deterministic algorithms.  Padded (ghost) variables get a
+1-value domain with ``BIG`` unary cost on every other value, so they
+pin to value 0 at zero cost; ghost constraints carry all-zero tables
+over ghost variables, so they contribute nothing to any cost or
+message that reaches a real variable.  Ghost variables are excluded
+from assignments in/out (``CompiledProblem.n_pad_vars``).  Caveat for
+STOCHASTIC algorithms (dsa, noise-enabled maxsum, ...): per-round
+random draws are shaped ``[padded n_vars]``, so padding changes the
+real variables' random streams — same cost distribution, different
+individual trajectories.  Deterministic runs (maxsum with ``noise=0``,
+any algorithm resumed from carried state) are bit-identical padded vs
+unpadded (tested).
+
+Spec strings (``pad_policy=`` / ``--pad_policy``):
+
+- ``"none"`` — no padding (the default everywhere).
+- ``"pow2"`` — bucket to powers of two, floor 16.
+- ``"pow2:<floor>"`` — same with an explicit floor, e.g. ``pow2:64``.
+
+Memory trade: pow-2 bucketing can nearly double table/edge memory in
+the worst case — it is an opt-in for recompile-bound workloads, not a
+default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Union
+
+import numpy as np
+
+# Cost assigned to padded (invalid) domain values; large enough to never
+# be selected, small enough to leave f32 headroom when summed.
+# (Re-exported by ops.compile — the compiler and every consumer read it
+# from there; it lives here so the ghost-construction helpers below and
+# the compiler share one definition without a circular import.)
+BIG = 1e9
+
+
+@dataclasses.dataclass(frozen=True)
+class PadPolicy:
+    """Bucket quantization for compiled-problem dimensions.
+
+    ``floor`` bounds the variable/constraint-count buckets from below;
+    ``deg_floor`` bounds the (much smaller) adjacency-width buckets
+    (``var_edges`` / ``neighbors`` columns).  ``flat_block`` is the
+    cell-count multiple ``tables_flat`` is padded to — a fixed block,
+    not a power of two, so the flat pool never doubles.
+    """
+
+    kind: str = "none"  # "none" | "pow2"
+    floor: int = 16
+    deg_floor: int = 4
+    flat_block: int = 1024
+
+    @property
+    def enabled(self) -> bool:
+        return self.kind != "none"
+
+    def bucket(self, n: int, floor: int | None = None) -> int:
+        """Smallest power of two >= ``n``, clamped up to the floor."""
+        if not self.enabled or n <= 0:
+            return n
+        b = 1
+        while b < n:
+            b <<= 1
+        return max(b, floor if floor is not None else self.floor)
+
+    def bucket_dim(self, n: int) -> int:
+        """Bucket for adjacency widths (per-variable degree columns)."""
+        return self.bucket(n, self.deg_floor)
+
+    def bucket_cells(self, n: int) -> int:
+        """Flat-table length rounded up to a ``flat_block`` multiple."""
+        if not self.enabled or n <= 0:
+            return n
+        blk = self.flat_block
+        return ((n + blk - 1) // blk) * blk
+
+
+NO_PADDING = PadPolicy()
+
+
+# -- ghost construction (the ONE definition of the padding contract) ---
+#
+# Ghost variables pin to value 0: zero cost there, BIG everywhere else.
+# Ghost constraints carry all-zero tables scoped on ghost variables
+# (cycled).  Every compile path builds its ghosts through these two
+# helpers so the results-match-unpadded invariant cannot drift between
+# paths.
+
+
+def ghost_unary(n_pad: int, d_max: int) -> np.ndarray:
+    """f32[n_pad, d_max] unary rows for ghost variables."""
+    rows = np.full((n_pad, d_max), BIG, dtype=np.float32)
+    rows[:, 0] = 0.0
+    return rows
+
+
+def ghost_scopes(
+    targets: Sequence[int], count: int, k: int, start: int = 0
+) -> np.ndarray:
+    """i32[count, k] ghost-constraint scopes: row q repeats
+    ``targets[(start + q) % len(targets)]`` k times (self-scoped, so
+    the neighbor builder's a != b test drops the pairs)."""
+    tg = list(targets) or [0]
+    return np.asarray(
+        [[tg[(start + q) % len(tg)]] * k for q in range(count)],
+        dtype=np.int32,
+    ).reshape(count, k)
+
+
+def as_pad_policy(spec: Union[str, PadPolicy, None]) -> PadPolicy:
+    """Normalize a ``pad_policy`` argument: a :class:`PadPolicy` passes
+    through; ``None``/``"none"`` disable; ``"pow2"``/``"pow2:<floor>"``
+    parse.  Raises ``ValueError`` on anything else."""
+    if isinstance(spec, PadPolicy):
+        return spec
+    if spec is None:
+        return NO_PADDING
+    if not isinstance(spec, str):
+        raise ValueError(
+            f"pad_policy must be a string or PadPolicy, got {spec!r}"
+        )
+    s = spec.strip().lower()
+    if s in ("", "none"):
+        return NO_PADDING
+    if s == "pow2":
+        return PadPolicy(kind="pow2")
+    if s.startswith("pow2:"):
+        try:
+            floor = int(s[len("pow2:"):])
+        except ValueError:
+            floor = -1
+        if floor < 1:
+            raise ValueError(
+                f"pad_policy {spec!r}: floor must be a positive "
+                "integer (e.g. 'pow2:64')"
+            )
+        return PadPolicy(kind="pow2", floor=floor)
+    raise ValueError(
+        f"unknown pad_policy {spec!r} (expected 'none', 'pow2' or "
+        "'pow2:<floor>')"
+    )

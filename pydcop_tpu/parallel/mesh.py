@@ -82,6 +82,7 @@ def problem_pspecs(problem: CompiledProblem) -> CompiledProblem:
         n_shards=problem.n_shards,
         n_real_edges=problem.n_real_edges,
         var_slot_counts=problem.var_slot_counts,
+        n_pad_vars=problem.n_pad_vars,
     )
 
 

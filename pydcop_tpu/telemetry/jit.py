@@ -93,10 +93,18 @@ _listener_registered = False
 
 
 def ensure_backend_compile_listener() -> None:
-    """Register a ``jax.monitoring`` duration listener (once per
-    process) that mirrors backend-compile durations into the active
-    session.  A no-op when jax or the monitoring API is absent; the
-    listener itself is inert while no session is active."""
+    """Register ``jax.monitoring`` listeners (once per process) that
+    mirror backend-compile durations AND persistent-compilation-cache
+    hit/miss events into the active session.  A no-op when jax or the
+    monitoring API is absent; the listeners are inert while no session
+    is active.
+
+    The cache events complete the three-layer compile telemetry
+    (``docs/performance.md``): ``jit.cache_hits`` = in-process trace
+    cache, ``jit.persistent_cache_hits``/``_misses`` = jax's on-disk
+    XLA cache (``enable_persistent_compilation_cache``),
+    ``jit.compiles``/``jit.backend_compiles`` = true compilations.
+    """
     global _listener_registered
     if _listener_registered:
         return
@@ -123,8 +131,31 @@ def ensure_backend_compile_listener() -> None:
                 event=event, seconds=duration,
             )
 
+    def _on_event(event: str, *a, **kw) -> None:
+        # persistent (on-disk) XLA cache traffic: jax records one
+        # event per executable looked up with the cache enabled
+        if not event.startswith("/jax/compilation_cache/"):
+            return
+        kind = event.rsplit("/", 1)[-1]
+        if kind not in ("cache_hits", "cache_misses"):
+            return
+        met = get_metrics()
+        if met.enabled:
+            met.inc(
+                "jit.persistent_cache_hits"
+                if kind == "cache_hits"
+                else "jit.persistent_cache_misses"
+            )
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event("persistent-cache", cat="jit", event=event)
+
     try:
         monitoring.register_event_duration_secs_listener(_on_duration)
     except Exception:
         return
+    try:
+        monitoring.register_event_listener(_on_event)
+    except Exception:
+        pass  # older jax: duration listener alone still registered
     _listener_registered = True

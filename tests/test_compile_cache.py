@@ -1,0 +1,398 @@
+"""Tests for the compile-reuse layer: shape-bucketed padding
+(``ops/padding.py`` + ``compile_dcop(pad_policy=...)``), incremental
+problem recompilation (``engine/incremental.py``), execution-problem
+canonicalization, and the runner-cache LRU cap.
+
+Covers the PR-3 acceptance criteria: a two-segment dynamic run with a
+``set_value`` event performs zero new XLA compiles after segment 1,
+``n_vars`` changes within one bucket carry the compiled executables
+across segments, and padded runs match unpadded ``best_cost`` exactly.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import (
+    AgentDef,
+    Domain,
+    ExternalVariable,
+    Variable,
+)
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.dcop.scenario import EventAction, Scenario, ScenarioEvent
+from pydcop_tpu.engine import batched
+from pydcop_tpu.engine.dynamic import run_dynamic
+from pydcop_tpu.engine.incremental import IncrementalCompiler
+from pydcop_tpu.ops.compile import (
+    canonical_execution_problem,
+    compile_dcop,
+    decode_assignment,
+    encode_assignment,
+    problem_fingerprint,
+)
+from pydcop_tpu.ops.costs import total_cost
+from pydcop_tpu.ops.padding import as_pad_policy
+from pydcop_tpu.telemetry import session
+
+D = Domain("d", "", [0, 1, 2])
+
+
+def ring_dcop(n=6):
+    dcop = DCOP("ring")
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}_{j}", f"1 if v{i} == v{j} else 0", vs)
+        )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n)])
+    return dcop
+
+
+def sensor_dcop():
+    """One chain + an external 'sensor' variable driving v0."""
+    dcop = DCOP("ext")
+    vs = [Variable(f"v{i}", D) for i in range(3)]
+    for v in vs:
+        dcop.add_variable(v)
+    sensor = ExternalVariable("sensor", D, value=0)
+    dcop.add_variable(sensor)
+    for i in range(2):
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}", f"1 if v{i} == v{i + 1} else 0", vs
+            )
+        )
+    dcop.add_constraint(
+        constraint_from_str(
+            "track", "0 if v0 == sensor else 1", [vs[0], sensor]
+        )
+    )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(3)])
+    return dcop
+
+
+# -- pad policy parsing ------------------------------------------------
+
+
+def test_pad_policy_parse():
+    assert not as_pad_policy("none").enabled
+    assert not as_pad_policy(None).enabled
+    pol = as_pad_policy("pow2")
+    assert pol.enabled and pol.floor == 16
+    assert as_pad_policy("pow2:64").floor == 64
+    assert pol.bucket(5) == 16
+    assert pol.bucket(17) == 32
+    assert pol.bucket(0) == 0
+    with pytest.raises(ValueError):
+        as_pad_policy("pow3")
+    with pytest.raises(ValueError):
+        as_pad_policy("pow2:0")
+
+
+# -- padded compiles ---------------------------------------------------
+
+
+def test_padded_shapes_are_bucketed_and_costs_match():
+    dcop = ring_dcop(6)
+    p0 = compile_dcop(dcop)
+    p1 = compile_dcop(dcop, pad_policy="pow2:16")
+    assert p0.n_vars == 6 and p1.n_vars == 16
+    assert p1.n_real_vars == 6 and p1.n_pad_vars == 10
+    # ghost constraints pad the arity-2 group to the bucket
+    assert p1.n_cons == 16 and p1.n_edges == 32
+    # identical cost for the same (real) assignment
+    vals0 = p0.init_idx
+    vals1 = p1.init_idx
+    assert float(total_cost(p0, vals0)) == float(total_cost(p1, vals1))
+    # assignments in/out ignore ghost variables
+    a = decode_assignment(p1, p1.init_idx)
+    assert sorted(a) == [f"v{i}" for i in range(6)]
+    enc = np.asarray(encode_assignment(p1, a))
+    assert enc.shape == (16,) and (enc[6:] == 0).all()
+
+
+def test_same_bucket_same_shapes_after_structure_change():
+    """A ring losing one variable must land in the SAME shape bucket:
+    every array shape and every traced static must match, so the jit
+    trace cache can reuse the compiled executables."""
+    full = compile_dcop(ring_dcop(6), pad_policy="pow2:16")
+    # v0 frozen: its two constraints slice to unary
+    dcop = ring_dcop(6)
+    inc = IncrementalCompiler(dcop, pad_policy="pow2:16")
+    shrunk, _ = inc.compile({"v0": 0}, {})
+    a = canonical_execution_problem(full)
+    b = canonical_execution_problem(shrunk)
+    import jax
+
+    ta = jax.tree_util.tree_structure(a)
+    tb = jax.tree_util.tree_structure(b)
+    assert ta == tb, f"{ta}\n!=\n{tb}"
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    ):
+        assert la.shape == lb.shape and la.dtype == lb.dtype
+
+
+def test_padded_best_cost_matches_unpadded_exactly():
+    """Acceptance: padded runs match unpadded best_cost exactly on the
+    coloring fixture (maxsum with noise=0 is deterministic)."""
+    import __graft_entry__ as g
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_batched
+
+    dcop = g._make_coloring_dcop(40, seed=2)
+    module = load_algorithm_module("maxsum")
+    params = prepare_algo_params(
+        {"damping": 0.5, "noise": 0.0}, module.algo_params
+    )
+    r0 = run_batched(
+        compile_dcop(dcop), module, params,
+        rounds=64, seed=0, chunk_size=32,
+    )
+    r1 = run_batched(
+        compile_dcop(dcop, pad_policy="pow2:16"), module, params,
+        rounds=64, seed=0, chunk_size=32,
+    )
+    assert r1.best_cost == r0.best_cost
+    assert r1.best_assignment == r0.best_assignment
+    assert r1.cost == r0.cost
+
+
+# -- incremental recompilation -----------------------------------------
+
+
+def test_incremental_update_matches_full_recompile():
+    """A set_value delta-update must produce byte-identical arrays to
+    a from-scratch compile of the perturbed problem."""
+    dcop = sensor_dcop()
+    inc = IncrementalCompiler(dcop)
+    p0, fp0 = inc.compile({}, {})
+    p1, fp1 = inc.compile({}, {"sensor": 2})
+    assert fp1 != fp0
+    fresh = compile_dcop(inc._active_dcop({}, {"sensor": 2}))
+    np.testing.assert_array_equal(
+        np.asarray(p1.tables_flat), np.asarray(fresh.tables_flat)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(p1.unary), np.asarray(fresh.unary)
+    )
+    for k in fresh.buckets:
+        np.testing.assert_array_equal(
+            np.asarray(p1.buckets[k].tables),
+            np.asarray(fresh.buckets[k].tables),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p1.buckets[k].tables_t),
+            np.asarray(fresh.buckets[k].tables_t),
+        )
+    # reverting the external restores the original content AND fp
+    p2, fp2 = inc.compile({}, {"sensor": 0})
+    assert fp2 == fp0
+    np.testing.assert_array_equal(
+        np.asarray(p2.unary), np.asarray(p0.unary)
+    )
+    # static metadata objects are shared — the jit trace cache key
+    # cannot drift across incremental updates
+    assert p1.var_names is p0.var_names
+    assert p1.con_names is p0.con_names
+
+
+def test_incremental_delay_reuses_problem_object():
+    dcop = sensor_dcop()
+    inc = IncrementalCompiler(dcop)
+    p0, fp0 = inc.compile({}, {})
+    p1, fp1 = inc.compile({}, {})
+    assert p1 is p0 and fp1 == fp0
+
+
+def test_const_external_change_keeps_fingerprint():
+    """A set_value on an external read ONLY by fully-external
+    constraints (compiler drops them) must not change the fingerprint
+    — the compiled arrays are byte-identical and full-state carry
+    must survive."""
+    dcop = sensor_dcop()
+    inc = IncrementalCompiler(dcop)
+    # freeze v0: 'track' (v0, sensor) becomes fully external
+    p0, fp0 = inc.compile({"v0": 0}, {})
+    p1, fp1 = inc.compile({"v0": 0}, {"sensor": 2})
+    assert fp1 == fp0
+    np.testing.assert_array_equal(
+        np.asarray(p1.unary), np.asarray(p0.unary)
+    )
+
+
+def test_persistent_cache_unwritable_dir_returns_false():
+    from pydcop_tpu.ops.compile import (
+        enable_persistent_compilation_cache,
+    )
+
+    assert not enable_persistent_compilation_cache(
+        "/proc/definitely/not/writable"
+    )
+
+
+def test_incremental_structure_change_full_recompile():
+    dcop = ring_dcop(4)
+    inc = IncrementalCompiler(dcop)
+    p0, _ = inc.compile({}, {})
+    p1, _ = inc.compile({"v0": 1}, {})
+    assert p1.n_real_vars == 3
+    # frozen value baked in: the fingerprint distinguishes freezes
+    p2, fp2 = inc.compile({"v0": 2}, {})
+    _, fp1 = inc.compile({"v0": 1}, {})
+    assert fp2 != fp1
+
+
+# -- dynamic runs: zero recompiles after segment 1 ---------------------
+
+
+def _jit_counters(tel):
+    return tel.summary()["counters"]
+
+
+def test_dynamic_set_value_zero_new_compiles():
+    """Acceptance: a two-segment dynamic run with a set_value event
+    performs 0 new XLA compiles after segment 1."""
+    scenario = Scenario(
+        [
+            ScenarioEvent(
+                "e1",
+                actions=[
+                    EventAction("set_value", variable="sensor", value=2)
+                ],
+            ),
+        ]
+    )
+    batched._RUNNER_CACHE.clear()
+    with session() as tel:
+        r = run_dynamic(
+            sensor_dcop(), "dsa", {"variant": "B"},
+            scenario=scenario, final_rounds=48, chunk_size=48, seed=7,
+        )
+    c = _jit_counters(tel)
+    assert r["assignment"]["v0"] == 2
+    assert c["jit.compiles"] == 1, c
+    assert c.get("compile.incremental", 0) >= 1, c
+    assert c.get("jit.cache_hits", 0) >= 1, c
+
+
+def test_dynamic_bucketed_nvars_change_zero_new_compiles():
+    """Satellite: n_vars changes within one bucket (a variable freezes
+    after remove_agent) → zero new jit_compiles after segment 1."""
+    scenario = Scenario(
+        [
+            ScenarioEvent(
+                "e1", actions=[EventAction("remove_agent", agent="a0")]
+            ),
+            ScenarioEvent(delay=2.4),  # 48 rounds at 20 rps
+        ]
+    )
+    batched._RUNNER_CACHE.clear()
+    with session() as tel:
+        r = run_dynamic(
+            ring_dcop(6), "maxsum", {"noise": 0.0},
+            scenario=scenario, distribution="adhoc", k_target=0,
+            final_rounds=48, chunk_size=48, seed=3,
+            pad_policy="pow2:16",
+        )
+    c = _jit_counters(tel)
+    assert r["lost_computations"], r  # a variable actually froze
+    assert len(r["assignment"]) == 6
+    assert c["jit.compiles"] == 1, c
+    assert c.get("jit.cache_hits", 0) >= 2, c
+    # sanity: without padding the same scenario recompiles on the
+    # freeze — the bucket is what carries the executable across
+    batched._RUNNER_CACHE.clear()
+    with session() as tel2:
+        run_dynamic(
+            ring_dcop(6), "maxsum", {"noise": 0.0},
+            scenario=scenario, distribution="adhoc", k_target=0,
+            final_rounds=48, chunk_size=48, seed=3,
+        )
+    assert _jit_counters(tel2)["jit.compiles"] == 2
+
+
+def test_dynamic_padded_state_carry_across_delays():
+    """Full-state carry still works across bucketed segments (delays
+    keep the fingerprint stable under padding)."""
+    scenario = Scenario(
+        [ScenarioEvent(delay=2.4), ScenarioEvent(delay=2.4)]
+    )
+    r = run_dynamic(
+        ring_dcop(6), "maxsum", {"noise": 0.0},
+        scenario=scenario, distribution="adhoc", k_target=0,
+        final_rounds=48, chunk_size=48, seed=5, pad_policy="pow2:16",
+    )
+    delays = [e for e in r["events"] if e["type"] == "delay"]
+    assert [e["state_carried"] for e in delays] == [True, True]
+    assert r["state_transfers"] == 3  # 2 delays + final settle
+
+
+# -- canonical execution problem ---------------------------------------
+
+
+def test_canonical_execution_problem_shares_arrays():
+    p = compile_dcop(ring_dcop(4))
+    c = canonical_execution_problem(p)
+    assert c.unary is p.unary and c.tables_flat is p.tables_flat
+    assert c.var_names != p.var_names
+    # fingerprint of the ORIGINAL is unaffected
+    assert problem_fingerprint(p) == problem_fingerprint(p)
+    # two differently-named but same-shaped problems canonicalize to
+    # equal treedefs
+    import jax
+
+    q = compile_dcop(ring_dcop(4))
+    q = dataclasses.replace(q, var_names=tuple(f"w{i}" for i in range(4)))
+    assert jax.tree_util.tree_structure(
+        canonical_execution_problem(q)
+    ) == jax.tree_util.tree_structure(c)
+
+
+# -- runner cache LRU --------------------------------------------------
+
+
+def test_runner_cache_lru_eviction():
+    import __graft_entry__ as g
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import (
+        run_batched,
+        set_runner_cache_limit,
+    )
+
+    problem = compile_dcop(g._make_coloring_dcop(12, seed=4))
+    module = load_algorithm_module("dsa")
+    params = prepare_algo_params({"variant": "A"}, module.algo_params)
+    batched._RUNNER_CACHE.clear()
+    try:
+        set_runner_cache_limit(2)
+        with session() as tel:
+            for chunk in (7, 9, 11):  # three distinct runner keys
+                run_batched(
+                    problem, module, params,
+                    rounds=chunk, seed=0, chunk_size=chunk,
+                )
+        assert len(batched._RUNNER_CACHE) <= 2
+        counters = tel.summary()["counters"]
+        assert counters.get("engine.runner_cache_evictions", 0) >= 1
+        with pytest.raises(ValueError):
+            set_runner_cache_limit(0)
+    finally:
+        set_runner_cache_limit(None)
+        batched._RUNNER_CACHE.clear()
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

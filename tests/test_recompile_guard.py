@@ -1,0 +1,50 @@
+"""Tier-1 hook for ``tools/recompile_guard.py``: compile-count
+regressions on the dynamic-run path fail CI like any other test.
+
+The guard runs a canned two-segment dynamic solve (one ``set_value``
+event) and checks the telemetry ``jit.compiles`` counter against the
+recorded budget — see the tool's docstring for what a failure means.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+_TOOL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools",
+    "recompile_guard.py",
+)
+
+
+def _load_guard():
+    spec = importlib.util.spec_from_file_location(
+        "recompile_guard", _TOOL
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_recompile_guard_within_budget():
+    guard = _load_guard()
+    report = guard.run_guard()
+    assert report["ok"], report
+    assert report["jit_compiles"] <= guard.BUDGET, report
+    # the guard must exercise BOTH reuse mechanisms it protects
+    assert report["compile_incremental"] >= 1, report
+    assert report["jit_cache_hits"] >= 1, report
+
+
+def test_recompile_guard_detects_overrun(monkeypatch):
+    """The guard actually fails when the budget is exceeded (guards
+    that cannot fail are decoration)."""
+    guard = _load_guard()
+    monkeypatch.setattr(guard, "BUDGET", -1)
+    report = guard.run_guard()
+    assert not report["ok"]
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -1,0 +1,160 @@
+#!/usr/bin/env python3
+"""Recompile guard: a canned two-segment dynamic solve must stay
+within its recorded jit-compile budget.
+
+The compile-reuse layer (incremental recompilation in
+``engine/incremental.py`` + metadata canonicalization and the
+init-only-param split in ``engine/batched.py``) guarantees that a
+dynamic run whose segments share one shape bucket compiles its chunk
+runner EXACTLY ONCE: segment 2+ transitions are device delta-updates
+plus jit trace-cache hits.  A regression anywhere in that chain
+(cache-key churn, a static field leaking into the runner pytree, the
+incremental path falling back to full rebuilds with changed statics)
+shows up as extra ``jit.compiles`` — this guard turns that into a
+test failure, the same way tests/test_perf_guard.py pins HLO shapes.
+
+Run standalone (prints one JSON line, exit 1 when over budget):
+
+    python tools/recompile_guard.py
+
+or via the tier-1 suite: ``tests/test_recompile_guard.py`` imports
+:func:`run_guard` directly.
+
+``BUDGET`` is the recorded compile count of the canned scenario: one
+chunk-runner compile in segment 1, zero afterwards.  Raise it only
+with a written justification — it IS the regression budget.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# one chunk-runner compile in segment 1; segments 2+ must hit caches
+BUDGET = 1
+
+# every segment runs exactly one chunk of this many rounds, so a single
+# runner serves the whole scenario; distinctive size to avoid sharing
+# warm cache entries with unrelated runs in the same process
+ROUNDS = 56
+
+
+def _build_dcop():
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import (
+        AgentDef,
+        Domain,
+        ExternalVariable,
+        Variable,
+    )
+    from pydcop_tpu.dcop.relations import constraint_from_str
+
+    dom = Domain("d", "", [0, 1, 2])
+    dcop = DCOP("recompile_guard")
+    vs = [Variable(f"v{i}", dom) for i in range(5)]
+    for v in vs:
+        dcop.add_variable(v)
+    sensor = ExternalVariable("sensor", dom, value=0)
+    dcop.add_variable(sensor)
+    for i in range(4):
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}", f"1 if v{i} == v{i + 1} else 0", vs
+            )
+        )
+    # the external drives v0: set_value re-slices exactly this one
+    dcop.add_constraint(
+        constraint_from_str(
+            "track", "0 if v0 == sensor else 1", [vs[0], sensor]
+        )
+    )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(5)])
+    return dcop
+
+
+def run_guard() -> dict:
+    """Run the canned scenario; return the verdict dict."""
+    from pydcop_tpu.dcop.scenario import (
+        EventAction,
+        Scenario,
+        ScenarioEvent,
+    )
+    from pydcop_tpu.engine import batched
+    from pydcop_tpu.engine.dynamic import run_dynamic
+    from pydcop_tpu.telemetry import session
+
+    # a warm runner cache from earlier runs in this process would hide
+    # (or fake) compiles — the guard measures a cold start
+    batched._RUNNER_CACHE.clear()
+
+    scenario = Scenario(
+        [
+            ScenarioEvent(
+                "e1",
+                actions=[
+                    EventAction("set_value", variable="sensor", value=2)
+                ],
+            ),
+        ]
+    )
+    with session() as tel:
+        result = run_dynamic(
+            _build_dcop(),
+            "dsa",
+            {"variant": "B"},
+            scenario=scenario,
+            k_target=0,
+            final_rounds=ROUNDS,
+            chunk_size=ROUNDS,
+            seed=11,
+            pad_policy="pow2:16",
+        )
+    counters = tel.summary()["counters"]
+    jit_compiles = int(counters.get("jit.compiles", 0))
+    report = {
+        "jit_compiles": jit_compiles,
+        "budget": BUDGET,
+        "ok": jit_compiles <= BUDGET,
+        "compile_full": int(counters.get("compile.full", 0)),
+        "compile_incremental": int(
+            counters.get("compile.incremental", 0)
+        ),
+        "jit_cache_hits": int(counters.get("jit.cache_hits", 0)),
+        "cost": result["cost"],
+        "status": result["status"],
+    }
+    # the scenario must actually exercise the incremental path — a
+    # guard that silently stopped covering it would be worthless
+    if report["compile_incremental"] < 1:
+        report["ok"] = False
+        report["error"] = (
+            "set_value event did not take the incremental-update path"
+        )
+    # and the solve must still be CORRECT (v0 tracks the sensor)
+    if result["assignment"].get("v0") != 2:
+        report["ok"] = False
+        report["error"] = (
+            f"wrong answer: v0={result['assignment'].get('v0')!r}, "
+            "expected 2 — compile reuse corrupted the problem update"
+        )
+    return report
+
+
+def main() -> int:
+    import jax
+
+    # compile-count guard: backend-independent, so pin the CPU platform
+    # (the axon TPU plugin ignores JAX_PLATFORMS; jax.config wins)
+    jax.config.update("jax_platforms", "cpu")
+    report = run_guard()
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
