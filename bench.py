@@ -78,6 +78,21 @@ STAGES = [
     ("north_star", N_VARS, ROUNDS, 300.0),
 ]
 
+# multi-instance (cross-instance batching) stage: K same-bucket
+# graph-coloring instances through api.solve_many vs K sequential
+# api.solve calls — instances/sec either way (docs/performance.md,
+# "Cross-instance batching").  The instance set is sweep-shaped
+# (batch.py's cells): MANY_PROBLEMS distinct graphs x iterations with
+# per-instance seeds, sizes spread inside one pow2 shape bucket.  CPU
+# is an acceptable measurement platform for this ratio (the win is
+# per-solve fixed-cost amortization: problem compiles, program
+# launches, host round trips).
+MANY_KS = (1, 8, 32)
+MANY_PROBLEMS = 4
+MANY_VARS = 32  # sizes MANY_VARS-6 .. MANY_VARS: one pow2 bucket
+MANY_ROUNDS = 256
+MANY_CHUNK = 64
+
 
 def _git_sha() -> str:
     try:
@@ -414,6 +429,85 @@ def _measure(
     }
 
 
+def _measure_many(phase_budget: float = 0.0) -> dict:
+    """instances/sec: solve_many vs sequential solve at K in MANY_KS.
+
+    The instance list is a sweep: MANY_PROBLEMS distinct coloring
+    graphs cycled over K slots with seed = slot index (exactly the
+    rows `pydcop_tpu batch --vmap_cells` turns into one group).  Both
+    paths run END TO END through the api — the sequential loop pays a
+    problem compile + program launches + host round trips PER
+    INSTANCE, solve_many compiles each distinct problem once, stacks
+    the group, and launches one vmapped program per chunk.  XLA
+    compiles are warmed out of both sides first (they are shared via
+    the runner cache and guarded separately by
+    tools/recompile_guard.py).
+    """
+    with _bounded_phase("import:jax", phase_budget):
+        import jax
+
+    with _bounded_phase("import:pydcop", phase_budget):
+        import __graft_entry__ as g
+        from pydcop_tpu.api import solve, solve_many
+        from pydcop_tpu.telemetry import session as _tel_session
+
+    _phase("problem_built")
+    base = [
+        g._make_coloring_dcop(
+            MANY_VARS - 2 * i, degree=DEGREE, seed=100 + i
+        )
+        for i in range(MANY_PROBLEMS)
+    ]
+    algo, params = "dsa", {"variant": "B", "probability": 0.7}
+    kw = dict(rounds=MANY_ROUNDS, chunk_size=MANY_CHUNK)
+    out = {
+        "platform": jax.devices()[0].platform,
+        "n_vars": MANY_VARS,
+        "n_problems": MANY_PROBLEMS,
+        "rounds": MANY_ROUNDS,
+        "algo": algo,
+        "ks": {},
+    }
+    # warm the XLA side of both paths (each K is its own vmapped
+    # program; the sequential runner is one shared cache entry)
+    with _bounded_phase("xla_compile", phase_budget):
+        for d in base:
+            solve(d, algo, params, pad_policy="pow2", seed=0, **kw)
+        groups = 0
+        for K in MANY_KS:
+            with _tel_session() as tel:
+                solve_many(
+                    [base[i % MANY_PROBLEMS] for i in range(K)],
+                    algo, params, pad_policy="pow2", seed=0, **kw
+                )
+            groups = int(
+                tel.summary()["counters"].get(
+                    "engine.batch_groups", 0
+                )
+            )
+    for K in MANY_KS:
+        batch = [base[i % MANY_PROBLEMS] for i in range(K)]
+        seeds = list(range(K))
+        _phase(f"measure:many_{K}")
+        t0 = time.perf_counter()
+        solve_many(
+            batch, algo, params, pad_policy="pow2", seed=seeds, **kw
+        )
+        dt_many = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i, d in enumerate(batch):
+            solve(d, algo, params, pad_policy="pow2", seed=i, **kw)
+        dt_seq = time.perf_counter() - t0
+        out["ks"][str(K)] = {
+            "instances_per_sec_batched": round(K / dt_many, 2),
+            "instances_per_sec_sequential": round(K / dt_seq, 2),
+            "speedup": round(dt_seq / dt_many, 2),
+            "batch_groups": groups,
+        }
+    _phase("measured")
+    return out
+
+
 def _inner_main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--inner", action="store_true")
@@ -421,6 +515,7 @@ def _inner_main() -> None:
     p.add_argument("--rounds", type=int, default=ROUNDS)
     p.add_argument("--chunk", type=int, default=CHUNK)
     p.add_argument("--phase_budget", type=float, default=0.0)
+    p.add_argument("--many_stage", action="store_true")
     a = p.parse_args()
     import jax
 
@@ -438,13 +533,16 @@ def _inner_main() -> None:
     print(
         "BENCH_JSON:"
         + json.dumps(
-            _measure(a.vars, a.rounds, a.chunk, a.phase_budget)
+            _measure_many(a.phase_budget)
+            if a.many_stage
+            else _measure(a.vars, a.rounds, a.chunk, a.phase_budget)
         )
     )
 
 
 def _run_sub(
-    pin_cpu: bool, timeout: float, n_vars: int, rounds: int
+    pin_cpu: bool, timeout: float, n_vars: int, rounds: int,
+    many: bool = False,
 ) -> dict:
     """Run ``bench.py --inner`` in a subprocess; parse its JSON line.
 
@@ -472,7 +570,8 @@ def _run_sub(
                 sys.executable, os.path.join(REPO, "bench.py"), "--inner",
                 "--vars", str(n_vars), "--rounds", str(rounds),
                 "--phase_budget", f"{phase_budget:.1f}",
-            ],
+            ]
+            + (["--many_stage"] if many else []),
             env=env,
             cwd=REPO,
             capture_output=True,
@@ -658,6 +757,19 @@ def main() -> None:
         if "error" in host:
             errors.append(f"host-runtime baseline: {host['error']}")
 
+    # multi-instance (cross-instance batching) throughput: solve_many
+    # vs sequential solve at K in MANY_KS.  Runs on the default
+    # backend; falls back to the CPU pin (an acceptable measurement
+    # platform for this launch-amortization ratio) when that fails.
+    many = _run_sub(pin_cpu=False, timeout=300.0, n_vars=0, rounds=0,
+                    many=True)
+    if "error" in many:
+        many = _run_sub(pin_cpu=True, timeout=300.0, n_vars=0,
+                        rounds=0, many=True)
+    if "error" in many:
+        errors.append(f"multi_instance stage: {many['error']}")
+        many = None
+
     out = {
         "metric": "maxsum_msgs_per_sec_10k_coloring",
         "value": round(headline["msgs_per_sec"]) if headline else 0,
@@ -692,6 +804,12 @@ def main() -> None:
             headline["msgs_per_sec"] / host["msgs_per_sec"], 1
         )
     out["stages"] = stages
+    if many is not None:
+        out["multi_instance"] = {
+            k: many[k]
+            for k in ("platform", "n_vars", "rounds", "algo", "ks")
+            if k in many
+        }
     if (
         headline is None
         or headline.get("platform") != "tpu"

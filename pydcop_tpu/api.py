@@ -622,6 +622,230 @@ def _solve_process(
                 pass
 
 
+def solve_many(
+    dcops: Sequence[Union[DCOP, str]],
+    algo: Union[str, AlgorithmDef],
+    algo_params: Union[
+        Mapping[str, Any], Sequence[Mapping[str, Any]], None
+    ] = None,
+    *,
+    rounds: int = 200,
+    timeout: Optional[float] = None,
+    seed: Union[int, Sequence[int]] = 0,
+    chunk_size: int = 64,
+    convergence_chunks: int = 0,
+    n_restarts: int = 1,
+    pad_policy: str = "pow2",
+    trace: Optional[str] = None,
+    trace_format: str = "jsonl",
+    compile_cache: Optional[str] = None,
+) -> list:
+    """Solve MANY DCOP instances, batching same-shaped ones into one
+    device program each (cross-instance batching,
+    ``docs/performance.md``).
+
+    Every instance is compiled with ``pad_policy`` (default ``"pow2"``
+    — shape bucketing is what makes similarly-sized instances land on
+    identical array shapes), grouped by
+    :func:`~pydcop_tpu.ops.compile.stack_problems` bucket key plus
+    static (str/bool) algorithm params, and each group runs as ONE
+    ``jax.vmap``-ed chunk runner over the instance axis
+    (:func:`~pydcop_tpu.engine.batched.run_many_batched`): a 50-
+    instance sweep becomes a handful of XLA programs instead of 50.
+    Numeric algorithm params may differ per instance within a group.
+
+    ``algo_params`` is one mapping shared by all instances or a
+    sequence of one mapping per instance; ``seed`` likewise an int or
+    a per-instance sequence.  Instance ``i`` consumes exactly the RNG
+    stream ``solve(dcops[i], seed=seed_i, pad_policy=pad_policy)``
+    would, so deterministic algorithms return bit-identical results
+    either way (``tests/test_solve_many.py``).  ``n_restarts``
+    composes: each instance runs K independent restarts inside the
+    same program (axes ``[instance, restart, ...]``).
+
+    Host-path (exact) algorithms — DPOP, SyncBB — never compile the
+    whole problem: they fall back to one sequential host solve per
+    instance (``pad_policy`` does not apply there).
+
+    ``timeout`` bounds the WHOLE call: groups share the budget, and a
+    group that hits the remaining budget stops all its instances at a
+    chunk boundary with ``status="timeout"``.
+
+    Returns one result dict per input, in input order, with the same
+    keys as :func:`solve` plus ``instances_batched`` (the size of the
+    group the instance rode in — 1 when nothing else shared its
+    bucket).  The ``time`` field is the instance's group wall-clock
+    divided evenly across the group; telemetry is the aggregate of
+    the whole call.
+    """
+    import time as _time
+
+    from pydcop_tpu.telemetry import session
+
+    dcops = list(dcops)
+    n = len(dcops)
+    if n == 0:
+        return []
+    if n_restarts < 1:
+        raise ValueError(f"n_restarts must be >= 1, got {n_restarts}")
+
+    if compile_cache is not None:
+        from pydcop_tpu.ops.compile import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache(compile_cache)
+
+    # per-instance algorithm params (resolve AlgorithmDef-carried
+    # params once, merge per-instance overrides)
+    if algo_params is None or isinstance(algo_params, Mapping):
+        algo_name, params_in = resolve_algo(algo, algo_params)
+        params_in_list = [params_in] * n
+    else:
+        algo_params = list(algo_params)
+        if len(algo_params) != n:
+            raise ValueError(
+                f"algo_params: got {len(algo_params)} mappings for "
+                f"{n} dcops"
+            )
+        algo_name = None
+        params_in_list = []
+        for p in algo_params:
+            algo_name, merged = resolve_algo(algo, p)
+            params_in_list.append(merged)
+
+    if isinstance(seed, (list, tuple, range)):
+        seeds = [int(s) for s in seed]
+        if len(seeds) != n:
+            raise ValueError(
+                f"seed: got {len(seeds)} seeds for {n} dcops"
+            )
+    else:
+        seeds = [int(seed)] * n
+
+    from pydcop_tpu.ops.padding import as_pad_policy
+
+    as_pad_policy(pad_policy)  # fail fast on a malformed spec
+
+    module = load_algorithm_module(algo_name)
+    prepared = [
+        prepare_algo_params(p, module.algo_params)
+        for p in params_in_list
+    ]
+
+    # load yaml paths once per distinct path; DCOP objects pass through
+    loaded: Dict[str, DCOP] = {}
+
+    def _load(d):
+        if isinstance(d, (str, list, tuple)):
+            key = d if isinstance(d, str) else tuple(d)
+            if key not in loaded:
+                loaded[key] = load_dcop_from_file(d)
+            return loaded[key]
+        return d
+
+    with session(trace, trace_format) as tel:
+        deadline = (
+            _time.perf_counter() + timeout if timeout is not None else None
+        )
+        results: list = [None] * n
+        if hasattr(module, "solve_host"):
+            # exact host-path algorithms: no compiled problem, no
+            # instance batching — one sequential host solve each
+            if n_restarts != 1:
+                raise ValueError(
+                    f"{algo_name} is an exact host-path algorithm — "
+                    "n_restarts (best-of-K for stochastic solvers) "
+                    "does not apply"
+                )
+            for i, d in enumerate(dcops):
+                remaining = (
+                    None
+                    if deadline is None
+                    else max(deadline - _time.perf_counter(), 0.01)
+                )
+                res = module.solve_host(
+                    _load(d), prepared[i], timeout=remaining
+                )
+                res["instances_batched"] = 1
+                results[i] = res
+        else:
+            from pydcop_tpu.engine.batched import run_many_batched
+            from pydcop_tpu.ops.compile import stack_problems
+
+            # compile each distinct dcop once (repeated paths/objects
+            # reuse the compiled arrays at several stack positions)
+            compiled_by_id: Dict[int, Any] = {}
+            problems = []
+            for d in dcops:
+                obj = _load(d)
+                if id(obj) not in compiled_by_id:
+                    compiled_by_id[id(obj)] = compile_dcop(
+                        obj, pad_policy=pad_policy
+                    )
+                problems.append(compiled_by_id[id(obj)])
+
+            # partition by static (str/bool) param signature — statics
+            # are baked into the compiled step, so instances can only
+            # share a runner when they agree on them
+            def _statics_sig(p):
+                return (
+                    tuple(
+                        sorted(
+                            (k, v)
+                            for k, v in p.items()
+                            if isinstance(v, (str, bool))
+                        )
+                    ),
+                    tuple(
+                        sorted(
+                            k
+                            for k, v in p.items()
+                            if not isinstance(v, (str, bool))
+                            and v is not None
+                        )
+                    ),
+                )
+
+            partitions: Dict[Any, list] = {}
+            for i, p in enumerate(prepared):
+                partitions.setdefault(_statics_sig(p), []).append(i)
+
+            for part in partitions.values():
+                for stacked in stack_problems(
+                    [problems[i] for i in part]
+                ):
+                    group = [part[j] for j in stacked.indices]
+                    remaining = (
+                        None
+                        if deadline is None
+                        else max(deadline - _time.perf_counter(), 0.01)
+                    )
+                    group_results = run_many_batched(
+                        stacked,
+                        module,
+                        [prepared[i] for i in group],
+                        rounds=rounds,
+                        seeds=[seeds[i] for i in group],
+                        timeout=remaining,
+                        chunk_size=chunk_size,
+                        convergence_chunks=convergence_chunks,
+                        n_restarts=n_restarts,
+                    )
+                    for i, rr in zip(group, group_results):
+                        out = _result_dict(rr)
+                        out["instances_batched"] = len(group)
+                        # an even share of the group's wall-clock:
+                        # summing per-instance times over a sweep then
+                        # reflects the real cost of the batched call
+                        out["time"] = rr.time / len(group)
+                        results[i] = out
+        summary = tel.summary()
+    for r in results:
+        r["telemetry"] = summary
+    return results
+
+
 def solve_compiled(
     problem,
     algo: Union[str, AlgorithmDef],
@@ -717,6 +941,12 @@ def _run_compiled(
     finally:
         if ui is not None:
             ui.close()
+    return _result_dict(result)
+
+
+def _result_dict(result) -> Dict[str, Any]:
+    """RunResult → the public result-dict schema shared by
+    :func:`solve` (batched mode) and :func:`solve_many`."""
     return {
         "assignment": result.best_assignment,
         "cost": result.best_cost,

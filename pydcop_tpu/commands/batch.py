@@ -48,6 +48,8 @@ _SOLVE_OPTIONS = {
     "timeout",
     "chunk_size",
     "convergence_chunks",
+    "n_restarts",
+    "pad_policy",
 }
 
 CSV_FIELDS = [
@@ -91,6 +93,20 @@ def set_parser(subparsers) -> None:
         "would truncate non-best restarts), partially-done cells, "
         "host-path algorithms, single-iteration cells, and cells "
         "whose vmapped solve fails all fall back to sequential runs",
+    )
+    p.add_argument(
+        "--vmap_cells", action="store_true",
+        help="collapse WHOLE same-bucket groups of (problem x params "
+        "x iteration) cells into one vmapped device call each "
+        "(api.solve_many): every pending run becomes one instance "
+        "with seed=iteration, instances whose compiled problems share "
+        "a shape bucket (spec option pad_policy, default pow2 here) "
+        "and static params solve in one XLA program.  Rows are "
+        "bit-identical to sequential runs for deterministic "
+        "algorithms.  Cells with timeout/convergence_chunks (early "
+        "stops act on a whole group at once) and host-path "
+        "algorithms fall back to sequential runs; supersedes "
+        "--vmap_iterations for the runs it covers",
     )
     p.set_defaults(func=run_cmd)
 
@@ -194,6 +210,85 @@ def _write_row(writer, run, result, base_dir) -> None:
     )
 
 
+def _vmappable(algo: str) -> bool:
+    from pydcop_tpu.algorithms import load_algorithm_module
+
+    try:
+        return not hasattr(load_algorithm_module(algo), "solve_host")
+    except Exception:
+        return False
+
+
+def _vmap_cells_pass(writer, fobj, runs, done, base_dir):
+    """``--vmap_cells``: execute every eligible pending run through
+    :func:`pydcop_tpu.api.solve_many`, grouped per batch (options are
+    uniform within a batch, so rounds/chunk_size agree).
+
+    Each run becomes one problem instance with ``seed=iteration`` —
+    the exact seed the sequential loop would use, so rows are
+    bit-identical to sequential execution for deterministic
+    algorithms.  ``solve_many`` splits each batch's instances into
+    same-bucket, same-static-params groups internally and solves each
+    group in one vmapped device program; a batch whose batched solve
+    fails falls back (untouched) to the sequential loop.
+
+    Eligible: vmappable (non-host-path) algorithm, no ``timeout`` and
+    no ``convergence_chunks`` in the batch options — early stops act
+    on a whole fused group at once, which would diverge from the
+    per-run semantics the rows claim.
+
+    Returns ``(handled_keys, executed, failed)``.
+    """
+    from pydcop_tpu.api import solve_many
+
+    handled = set()
+    executed = failed = 0
+    by_batch: Dict[str, List[Tuple[Tuple, Tuple]]] = {}
+    for run in runs:
+        batch, set_, problem, it, algo, params, options = run
+        key = _run_key(batch, set_, problem, it, algo, params, base_dir)
+        if key in done:
+            continue
+        if options.get("timeout") is not None:
+            continue
+        if int(options.get("convergence_chunks", 0)):
+            continue
+        if not _vmappable(algo):
+            continue
+        by_batch.setdefault(batch, []).append((run, key))
+    for batch, pairs in sorted(by_batch.items()):
+        _, _, _, _, algo, _, options = pairs[0][0]
+        try:
+            results = solve_many(
+                [run[2] for run, _ in pairs],
+                algo,
+                [run[5] for run, _ in pairs],
+                rounds=int(options.get("rounds", 200)),
+                chunk_size=int(options.get("chunk_size", 64)),
+                n_restarts=int(options.get("n_restarts", 1)),
+                pad_policy=options.get("pad_policy", "pow2"),
+                seed=[run[3] for run, _ in pairs],
+            )
+        except Exception:
+            # e.g. the stacked state OOMs where single runs fit — the
+            # whole batch falls back to the sequential per-run loop
+            continue
+        for (run, key), result in zip(pairs, results):
+            # result["time"] is already the instance's even share of
+            # its group's wall-clock (api.solve_many)
+            _write_row(writer, run, {
+                "status": result["status"],
+                "cost": result["cost"],
+                "cycle": result["cycle"],
+                "msg_count": result["msg_count"],
+                "time": round(result["time"], 6),
+            }, base_dir)
+            handled.add(key)
+            executed += 1
+        fobj.flush()
+    return handled, executed, failed
+
+
 def run_cmd(args) -> int:
     import yaml
 
@@ -265,29 +360,31 @@ def run_cmd(args) -> int:
         else:
             cells.append([run])
 
-    def _vmappable(algo: str) -> bool:
-        from pydcop_tpu.algorithms import load_algorithm_module
-
-        try:
-            return not hasattr(load_algorithm_module(algo), "solve_host")
-        except Exception:
-            return False
-
     executed = skipped = failed = 0
+    handled: set = set()
     with open(args.result_file, "a", newline="") as f:
         writer = csv.DictWriter(f, fieldnames=CSV_FIELDS)
         if not exists:
             writer.writeheader()
+        if args.vmap_cells:
+            handled, cells_executed, _ = _vmap_cells_pass(
+                writer, f, runs, done, base_dir
+            )
+            executed += cells_executed
         for cell in cells:
             batch, set_, problem, _, algo, params, options = cell[0]
-            pending = [
-                run for run in cell
-                if _run_key(
+            keys = [
+                _run_key(
                     run[0], run[1], run[2], run[3], run[4], run[5],
                     base_dir,
-                ) not in done
+                )
+                for run in cell
             ]
-            skipped += len(cell) - len(pending)
+            skipped += sum(1 for k in keys if k in done)
+            pending = [
+                run for run, k in zip(cell, keys)
+                if k not in done and k not in handled
+            ]
             if not pending:
                 continue
             common = dict(
@@ -297,23 +394,27 @@ def run_cmd(args) -> int:
                 convergence_chunks=int(
                     options.get("convergence_chunks", 0)
                 ),
+                n_restarts=int(options.get("n_restarts", 1)),
+                pad_policy=options.get("pad_policy", "none"),
             )
             # vmap only plain fixed-round cells: a shared timeout or a
             # best-judged convergence stop would truncate the non-best
             # restarts mid-descent, biasing their cost rows vs what
-            # the same spec records sequentially
+            # the same spec records sequentially; an n_restarts option
+            # already claims the restart axis for best-of-K rows
             if (
                 args.vmap_iterations
                 and len(pending) == len(cell)  # whole cell fresh
                 and len(cell) > 1
                 and common["timeout"] is None
                 and common["convergence_chunks"] == 0
+                and common["n_restarts"] == 1
                 and _vmappable(algo)
             ):
                 try:
                     result = solve(
                         problem, algo, params, seed=0,
-                        n_restarts=len(cell), **common,
+                        **{**common, "n_restarts": len(cell)},
                     )
                     for i, run in enumerate(cell):
                         _write_row(writer, run, {

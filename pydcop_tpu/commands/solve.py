@@ -116,6 +116,16 @@ def set_parser(subparsers) -> None:
         "default: none",
     )
     p.add_argument(
+        "--many", action="store_true",
+        help="treat each DCOP FILE as a SEPARATE problem instance and "
+        "solve them together (api.solve_many): same-shaped instances "
+        "batch into one vmapped device program — pass --pad_policy "
+        "pow2 so similarly-sized files land in the same shape bucket "
+        "(docs/performance.md, 'Cross-instance batching').  Prints a "
+        "JSON array of per-instance results.  Batched-engine (tpu) "
+        "mode only",
+    )
+    p.add_argument(
         "--compile_cache", default=None, metavar="DIR",
         help="persist XLA executables to DIR (jax compilation cache): "
         "repeated runs of the same program skip backend compilation "
@@ -130,6 +140,8 @@ def run_cmd(args) -> int:
     from pydcop_tpu.api import solve
 
     params = parse_algo_params(args.algo_params)
+    if args.many:
+        return _run_many_cmd(args, params)
     profile_ctx = None
     if args.profile:
         import jax
@@ -174,4 +186,51 @@ def run_cmd(args) -> int:
     result.pop("trace_subsampled", None)
     result.pop("trace_msgs", None)
     write_result(args, result)
+    return 0
+
+
+def _run_many_cmd(args, params) -> int:
+    """``solve --many``: each file is one instance, solved through
+    :func:`pydcop_tpu.api.solve_many` (cross-instance batching)."""
+    from pydcop_tpu.api import solve_many
+
+    if args.mode != "tpu":
+        raise SystemExit(
+            "--many batches instances on the batched engine; "
+            f"--mode {args.mode} does not apply"
+        )
+    for flag, name in (
+        (args.checkpoint, "--checkpoint"),
+        (args.resume, "--resume"),
+        (args.uiport, "--uiport"),
+        (args.msg_log, "--msg_log"),
+        (args.accel_agents, "--accel_agents"),
+        (args.chaos, "--chaos"),
+        (args.distribution, "--distribution"),
+        (args.nb_agents, "--nb_agents"),
+        (args.profile, "--profile"),
+    ):
+        if flag:
+            raise SystemExit(
+                f"{name} is a single-run option; it does not compose "
+                "with --many (solve the instances individually for it)"
+            )
+    results = solve_many(
+        list(args.dcop_files),
+        args.algo,
+        params,
+        rounds=args.rounds,
+        timeout=args.timeout,
+        seed=args.seed,
+        convergence_chunks=args.convergence_chunks,
+        n_restarts=args.restarts,
+        pad_policy=args.pad_policy,
+        trace=args.trace,
+        trace_format=args.trace_format,
+        compile_cache=args.compile_cache,
+    )
+    for r in results:
+        r.pop("cost_trace", None)  # keep the printed JSON compact
+        r.pop("telemetry", None)
+    write_result(args, results)
     return 0

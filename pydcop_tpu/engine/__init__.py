@@ -1,1 +1,5 @@
-from pydcop_tpu.engine.batched import RunResult, run_batched
+from pydcop_tpu.engine.batched import (
+    RunResult,
+    run_batched,
+    run_many_batched,
+)

@@ -19,7 +19,17 @@ import dataclasses
 import os
 import time
 from collections import OrderedDict
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+    Union,
+)
 
 import jax
 import jax.numpy as jnp
@@ -224,6 +234,50 @@ def _chunk_runner(
     return run_chunk
 
 
+def _build_step(algo_module, step_statics, axis_name, n_restarts):
+    """Build the per-round ``(algo_step, cost_fn)`` pair for ONE
+    problem instance: the step closure the chunk runner scans, with
+    the restart ``vmap`` applied when ``n_restarts > 1`` (``cost_fn``
+    then evaluates the ``[R, n]`` restart stack and returns ``[R]``).
+
+    Shared by :func:`run_batched` and :func:`run_many_batched` — the
+    latter vmaps this pair once more over the instance axis, so the
+    two vmaps compose orthogonally as ``[instance, restart, ...]``.
+    Under a mesh the returned step runs INSIDE ``shard_map``: the
+    step's psum still reduces over the shard axis per restart (vmap
+    and the named axis are orthogonal).
+    """
+    if n_restarts > 1:
+        restart_ids = jnp.arange(n_restarts)
+
+        def algo_step(problem, state, key, dyn):
+            keys = jax.vmap(
+                lambda i: jax.random.fold_in(key, i)
+            )(restart_ids)
+            return jax.vmap(
+                lambda s, k: algo_module.step(
+                    problem, s, k, {**step_statics, **dyn},
+                    axis_name=axis_name,
+                ),
+                in_axes=(0, 0),
+            )(state, keys)
+
+        def cost_fn(problem, values):
+            return jax.vmap(
+                lambda v: total_cost(problem, v, axis_name)
+            )(values)
+
+        return algo_step, cost_fn
+
+    def algo_step(problem, state, key, dyn):
+        return algo_module.step(
+            problem, state, key, {**step_statics, **dyn},
+            axis_name=axis_name,
+        )
+
+    return algo_step, None
+
+
 def run_batched(
     problem: CompiledProblem,
     algo_module,
@@ -363,36 +417,9 @@ def run_batched(
         axis_name = SHARD_AXIS
         problem = shard_problem(problem, mesh)
 
-    if batched_restarts:
-        restart_ids = jnp.arange(n_restarts)
-
-        # vmap over the restart stack; under a mesh this runs INSIDE
-        # shard_map, so the step's psum still reduces over the shard
-        # axis per restart (vmap and the named axis are orthogonal)
-        def algo_step(problem, state, key, dyn):
-            keys = jax.vmap(
-                lambda i: jax.random.fold_in(key, i)
-            )(restart_ids)
-            return jax.vmap(
-                lambda s, k: algo_module.step(
-                    problem, s, k, {**step_statics, **dyn},
-                    axis_name=axis_name,
-                ),
-                in_axes=(0, 0),
-            )(state, keys)
-
-        def cost_fn(problem, values):
-            return jax.vmap(
-                lambda v: total_cost(problem, v, axis_name)
-            )(values)
-    else:
-        cost_fn = None
-
-        def algo_step(problem, state, key, dyn):
-            return algo_module.step(
-                problem, state, key, {**step_statics, **dyn},
-                axis_name=axis_name,
-            )
+    algo_step, cost_fn = _build_step(
+        algo_module, step_statics, axis_name, n_restarts
+    )
 
     cache_key_base = (
         algo_module.__name__,
@@ -410,6 +437,10 @@ def run_batched(
         # otherwise reuse one runner and fail with a treedef mismatch
         # (dynamic runs recompile per segment and hit exactly this)
         jax.tree_util.tree_structure(problem) if mesh is not None else None,
+        # instance-axis arity: 0 = this single-instance path; the
+        # cross-instance path (run_many_batched) keys (K, donate) here
+        # so a K-stacked vmapped runner can never serve a plain run
+        0,
     )
 
     key = jax.random.PRNGKey(seed)
@@ -766,3 +797,371 @@ def run_batched(
         restart_costs=restart_costs,
         state=out_state,
     )
+
+
+def run_many_batched(
+    stacked,
+    algo_module,
+    params: Union[Mapping[str, Any], Sequence[Mapping[str, Any]]],
+    *,
+    rounds: int = 100,
+    seeds: Union[int, Sequence[int]] = 0,
+    timeout: Optional[float] = None,
+    chunk_size: int = 64,
+    convergence_chunks: int = 0,
+    cost_every: int = 1,
+    n_restarts: int = 1,
+    mesh=None,
+    donate: bool = True,
+) -> List[RunResult]:
+    """Solve K same-bucket problem instances in ONE device program.
+
+    ``stacked`` is a :class:`~pydcop_tpu.ops.compile.StackedProblem`
+    (from :func:`~pydcop_tpu.ops.compile.stack_problems`): K problems
+    whose canonical forms share shapes and traced statics, stacked
+    along a leading ``instance`` axis.  The chunk runner is the SAME
+    scan :func:`run_batched` compiles, ``jax.vmap``-ed over that axis
+    — so K instances cost one XLA compile and one device-program
+    launch per chunk instead of K, and the per-round math vectorizes
+    across instances.  The instance axis composes orthogonally with
+    the restart axis (``n_restarts > 1`` ⇒ carries are
+    ``[K, R, ...]``) and with a ``mesh`` (the vmap wraps the
+    ``shard_map``-ed runner; constraint/edge arrays shard per
+    instance, the instance axis stays replicated).
+
+    Per-instance RNG parity: instance ``i`` consumes EXACTLY the key
+    stream a sequential ``run_batched(problems[i], seed=seeds[i])``
+    would (``PRNGKey → split → per-chunk fold_in``), so deterministic
+    algorithms return bit-identical results either way (tested;
+    ``seeds`` an int applies to every instance).  ``params`` may be a
+    single mapping (shared) or one mapping per instance — numeric
+    params may differ per instance (they ride the vmap as stacked
+    arrays); str/bool params are baked into the step and must agree
+    across the stack (group by them upstream).
+
+    ``donate=True`` donates the chunk carries (state, best cost/values)
+    to the jitted runner (``donate_argnums``) so the K-instance state
+    ping-pongs between two buffers instead of reallocating per chunk —
+    the memory-pressure lever at large K.  Donation changes the cache
+    key (a donated executable aliases its buffers).
+
+    ``timeout`` and ``convergence_chunks`` act on the whole stack at
+    chunk boundaries: the run stops for ALL instances together —
+    converged only when no instance's best improved (and, without
+    restarts, no instance's values changed) for that many consecutive
+    chunks.  Per-instance early exit does not compose with one fused
+    program; callers needing it should solve sequentially.
+
+    Returns one :class:`RunResult` per instance in STACK order
+    (``stacked.indices`` maps back to the caller's input order).
+    Each result's ``time`` is the whole group's wall-clock — divide by
+    ``stacked.n_instances`` for a per-instance share.
+    """
+    t0 = time.perf_counter()
+    K = stacked.n_instances
+    template = stacked.template
+    sign = -1.0 if template.maximize else 1.0  # uniform per group key
+
+    if n_restarts < 1:
+        raise ValueError(f"n_restarts must be >= 1, got {n_restarts}")
+    batched_restarts = n_restarts > 1
+
+    if isinstance(params, Mapping):
+        params_list = [dict(params)] * K
+    else:
+        params_list = [dict(p) for p in params]
+    if len(params_list) != K:
+        raise ValueError(
+            f"params: got {len(params_list)} dicts for "
+            f"{K} instances"
+        )
+
+    def _split(p):
+        statics = {
+            k: v for k, v in p.items() if isinstance(v, (str, bool))
+        }
+        dyn = {
+            k: v
+            for k, v in p.items()
+            if not isinstance(v, (str, bool)) and v is not None
+        }
+        return statics, dyn
+
+    static_params, _dyn0 = _split(params_list[0])
+    dyn_keys = tuple(sorted(_dyn0))
+    for i, p in enumerate(params_list[1:], 1):
+        s, d = _split(p)
+        if s != static_params or tuple(sorted(d)) != dyn_keys:
+            raise ValueError(
+                f"run_many_batched: instance {i} differs from "
+                "instance 0 in static (str/bool) params or param "
+                "structure — statics are baked into the compiled "
+                "step; group instances by them upstream"
+            )
+    dyn_params = {
+        k: jnp.stack([jnp.asarray(p[k]) for p in params_list])
+        for k in dyn_keys
+    }
+    init_only = frozenset(
+        getattr(algo_module, "INIT_ONLY_PARAMS", ("initial",))
+    )
+    step_statics = {
+        k: v for k, v in static_params.items() if k not in init_only
+    }
+
+    if isinstance(seeds, (int, np.integer)):
+        seeds = [int(seeds)] * K
+    else:
+        seeds = [int(s) for s in seeds]
+    if len(seeds) != K:
+        raise ValueError(
+            f"seeds: got {len(seeds)} for {K} instances"
+        )
+
+    problem = stacked.problem
+    axis_name = None
+    if mesh is not None:
+        from pydcop_tpu.parallel.mesh import SHARD_AXIS, problem_pspecs
+
+        axis_name = SHARD_AXIS
+        # shard each instance's constraint/edge arrays over the mesh;
+        # the INSTANCE axis is vmapped, not mesh-mapped, so it stays
+        # replicated (a None prepended to every pspec)
+        pspecs = problem_pspecs(template)
+        problem = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(
+                x, NamedSharding(mesh, P(*((None,) + tuple(s))))
+            ),
+            problem,
+            pspecs,
+        )
+
+    algo_step, cost_fn = _build_step(
+        algo_module, step_statics, axis_name, n_restarts
+    )
+
+    cache_key_base = (
+        algo_module.__name__,
+        axis_name,
+        tuple(sorted(step_statics.items())),
+        dyn_keys,
+        id(mesh) if mesh is not None else None,
+        tuple(sorted(template.buckets)),
+        template.n_shards,
+        cost_every,
+        n_restarts,
+        jax.tree_util.tree_structure(problem) if mesh is not None else None,
+        # instance-axis arity + donation (a donated executable aliases
+        # its carry buffers — it must never serve a non-donating call)
+        K,
+        bool(donate),
+    )
+
+    # per-instance key streams, EXACTLY as K sequential run_batched
+    # calls would derive them: PRNGKey(seed) → split → fold_in(chunk)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds))
+    ks = jax.vmap(jax.random.split)(keys)  # [K, 2, 2]
+    k_init, k_run = ks[:, 0], ks[:, 1]
+
+    def _init_one(p, k, dyn):
+        ip = {**static_params, **dyn}
+        if batched_restarts:
+            return jax.vmap(
+                lambda kk: algo_module.init_state(p, kk, ip)
+            )(jax.random.split(k, n_restarts))
+        return algo_module.init_state(p, k, ip)
+
+    state = jax.vmap(_init_one)(problem, k_init, dyn_params)
+    # copy: 'values' is about to be donated as BOTH a state leaf and
+    # the best_values carry — aliased donated inputs are not allowed
+    best_values = jnp.array(state["values"], copy=True)
+    if batched_restarts:
+        best_cost = jax.vmap(
+            lambda p, vs: jax.vmap(lambda v: total_cost(p, v))(vs)
+        )(problem, best_values)  # [K, R]
+    else:
+        best_cost = jax.vmap(total_cost)(problem, best_values)  # [K]
+
+    def _sspecs(instance_axis: bool):
+        """State pspecs completed with replicated P() for undeclared
+        leaves, with the restart axis (always, when enabled) and
+        optionally the instance axis prepended as replicated."""
+        from pydcop_tpu.parallel.mesh import state_pspecs
+
+        declared = state_pspecs(algo_module, template)
+        specs = {k: declared.get(k, P()) for k in state}
+        prefix = (None,) * (
+            (1 if instance_axis else 0) + (1 if batched_restarts else 0)
+        )
+        return jax.tree_util.tree_map(
+            lambda s: P(*(prefix + tuple(s))),
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    if mesh is not None:
+        state = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            state,
+            _sspecs(instance_axis=True),
+        )
+
+    met = get_metrics()
+    if met.enabled:
+        met.inc("engine.batch_groups")
+        met.inc("engine.instances_batched", K)
+
+    def make_runner(n: int):
+        cache_key = cache_key_base + (n,)
+        if cache_key in _RUNNER_CACHE:
+            if met.enabled:
+                met.inc("engine.runner_cache_hits")
+            _RUNNER_CACHE.move_to_end(cache_key)
+            return _RUNNER_CACHE[cache_key]
+        if met.enabled:
+            met.inc("engine.runner_cache_misses")
+        fn = _chunk_runner(algo_step, n, axis_name, cost_every, cost_fn)
+        label = (
+            f"chunk[{algo_module.__name__.rsplit('.', 1)[-1]}:{n}x{K}]"
+        )
+        if mesh is not None:
+            from pydcop_tpu.parallel.mesh import problem_pspecs
+
+            pspecs = problem_pspecs(template)
+            sspecs = _sspecs(instance_axis=False)
+            dyn_specs = {k: P() for k in dyn_params}
+            fn = jax.shard_map(
+                fn,
+                mesh=mesh,
+                in_specs=(pspecs, sspecs, P(), dyn_specs, P(), P()),
+                out_specs=(sspecs, P(), P(), P()),
+                check_vma=False,
+            )
+        # the instance vmap: every argument — problem data, carries,
+        # keys AND numeric params — maps over its leading axis
+        vfn = jax.vmap(fn, in_axes=(0, 0, 0, 0, 0, 0))
+        runner = profiled_jit(
+            vfn,
+            label=label,
+            **({"donate_argnums": (1, 4, 5)} if donate else {}),
+        )
+        _RUNNER_CACHE[cache_key] = runner
+        _evict_runners()
+        return runner
+
+    runner = make_runner(min(chunk_size, rounds))
+    small_runner = None
+
+    def _per_instance_best(bc: np.ndarray) -> np.ndarray:
+        return bc.min(axis=-1) if batched_restarts else bc
+
+    traces: List[np.ndarray] = []
+    done = 0
+    status = "finished"
+    stall = 0
+    prev_best = _per_instance_best(np.asarray(best_cost))
+    prev_values = np.asarray(best_values)
+    tr = get_tracer()
+    while done < rounds:
+        this_chunk = min(chunk_size, rounds - done)
+        if this_chunk == min(chunk_size, rounds):
+            r = runner
+        else:
+            if small_runner is None or small_runner[0] != this_chunk:
+                small_runner = (this_chunk, make_runner(this_chunk))
+            r = small_runner[1]
+        k_chunk = jax.vmap(
+            lambda k: jax.random.fold_in(k, done)
+        )(k_run)
+        with tr.span(
+            "cycle", cat="cycle", first=done, rounds=this_chunk,
+            instances=K,
+        ):
+            state, best_cost, best_values, costs = r(
+                problem, state, k_chunk, dyn_params, best_cost,
+                best_values,
+            )
+            costs_np = np.asarray(costs)  # [K, samples(, R)]
+        if met.enabled:
+            met.inc("engine.chunks")
+            met.inc("engine.rounds", this_chunk)
+        if batched_restarts:
+            costs_np = costs_np.min(axis=-1)
+        traces.append(costs_np)
+        done += this_chunk
+        if timeout is not None and time.perf_counter() - t0 > timeout:
+            status = "timeout"
+            break
+        if convergence_chunks:
+            bc_np = _per_instance_best(np.asarray(best_cost))
+            if batched_restarts:
+                frozen = True
+                cur_values = prev_values
+            else:
+                cur_values = np.asarray(state["values"])
+                frozen = np.array_equal(cur_values, prev_values)
+            if np.all(bc_np >= prev_best - 1e-9) and frozen:
+                stall += 1
+                if stall >= convergence_chunks:
+                    status = "converged"
+                    break
+            else:
+                stall = 0
+            prev_best = bc_np
+            prev_values = cur_values
+
+    # unstack: per-instance final/best selection on the host
+    final_values = np.asarray(state["values"])  # [K(, R), n]
+    best_values_np = np.asarray(best_values)
+    best_cost_np = np.asarray(best_cost)
+    restart_costs_np = None
+    if batched_restarts:
+        final_costs = np.asarray(
+            jax.vmap(
+                lambda p, vs: jax.vmap(lambda v: total_cost(p, v))(vs)
+            )(problem, state["values"])
+        )  # [K, R]
+        i_fin = final_costs.argmin(axis=1)
+        rows = np.arange(K)
+        fv = final_values[rows, i_fin]
+        fc = final_costs[rows, i_fin]
+        i_best = best_cost_np.argmin(axis=1)
+        restart_costs_np = sign * best_cost_np  # [K, R]
+        bv = best_values_np[rows, i_best]
+        bc = best_cost_np[rows, i_best]
+    else:
+        fc = np.asarray(
+            jax.vmap(total_cost)(problem, state["values"])
+        )
+        fv = final_values
+        bv, bc = best_values_np, best_cost_np
+    elapsed = time.perf_counter() - t0
+    trace = (
+        np.concatenate(traces, axis=1)
+        if traces
+        else np.zeros((K, 0))
+    )
+    results: List[RunResult] = []
+    for i, hp in enumerate(stacked.host_problems):
+        msgs = (
+            algo_module.messages_per_round(hp, params_list[i])
+            * done
+            * n_restarts
+        )
+        results.append(
+            RunResult(
+                assignment=decode_assignment(hp, fv[i]),
+                cost=sign * float(fc[i]),
+                best_assignment=decode_assignment(hp, bv[i]),
+                best_cost=sign * float(bc[i]),
+                cycles=done,
+                messages=msgs,
+                time=elapsed,
+                status=status,
+                cost_trace=sign * trace[i],
+                restart_costs=(
+                    restart_costs_np[i] if batched_restarts else None
+                ),
+            )
+        )
+    return results

@@ -2,12 +2,15 @@ from pydcop_tpu.ops.compile import (
     BIG,
     ArityBucket,
     CompiledProblem,
+    StackedProblem,
     canonical_execution_problem,
     compile_dcop,
     compile_from_arrays,
     decode_assignment,
     enable_persistent_compilation_cache,
     encode_assignment,
+    problem_group_key,
+    stack_problems,
 )
 from pydcop_tpu.ops.costs import (
     local_cost_sweep,
@@ -21,6 +24,7 @@ __all__ = [
     "BIG",
     "ArityBucket",
     "CompiledProblem",
+    "StackedProblem",
     "PadPolicy",
     "as_pad_policy",
     "canonical_execution_problem",
@@ -31,6 +35,8 @@ __all__ = [
     "encode_assignment",
     "local_cost_sweep",
     "neighbor_gather",
+    "problem_group_key",
     "segment_sum_edges",
+    "stack_problems",
     "total_cost",
 ]

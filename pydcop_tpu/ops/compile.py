@@ -1327,6 +1327,107 @@ def canonical_execution_problem(
     )
 
 
+@dataclasses.dataclass
+class StackedProblem:
+    """K same-bucket problems stacked along a leading instance axis.
+
+    ``problem`` is a :class:`CompiledProblem` PYTREE whose array leaves
+    carry an extra leading ``[K, ...]`` instance dimension and whose
+    static metadata is the shared canonical form
+    (:func:`canonical_execution_problem`) — it is NOT a valid
+    single-instance problem (``n_vars`` etc. would read the instance
+    count); it exists to ride through ``jax.vmap`` in one piece.
+    ``template`` is the canonical single-instance member for host-side
+    shape/static access, and ``host_problems`` keeps the original
+    (named) problems for decode and message accounting, in stack
+    order.  ``indices`` maps stack position -> position in the input
+    sequence :func:`stack_problems` grouped.
+    """
+
+    problem: CompiledProblem  # stacked leaves [K, ...]
+    template: CompiledProblem  # canonical single-instance member
+    host_problems: List[CompiledProblem]  # originals, stack order
+    indices: List[int]  # stack position -> input position
+
+    @property
+    def n_instances(self) -> int:
+        return len(self.host_problems)
+
+
+def problem_group_key(problem: CompiledProblem):
+    """Hashable batching-bucket key: two problems with equal keys have
+    byte-compatible array shapes/dtypes AND equal traced statics
+    (``var_slot_counts``, ``n_shards``, ``maximize``, bucket arities),
+    so their canonical forms share one jitted executable — the
+    grouping predicate of :func:`stack_problems`.
+
+    Computed on the metadata-canonicalized copy: host-only names never
+    split a group.  A ``pad_policy`` (``ops/padding.py``) is what
+    steers similarly-sized problems onto equal keys.
+    """
+    canon = canonical_execution_problem(problem)
+    leaves, treedef = jax.tree_util.tree_flatten(canon)
+    return (
+        treedef,
+        tuple(
+            (tuple(leaf.shape), jnp.result_type(leaf).name)
+            for leaf in leaves
+        ),
+    )
+
+
+def stack_problems(
+    problems: Sequence[CompiledProblem],
+) -> List[StackedProblem]:
+    """Group same-bucket problems and stack each group's per-problem
+    data arrays along a new leading ``instance`` axis.
+
+    Returns one :class:`StackedProblem` per group, in order of first
+    appearance; ``indices`` records which input positions landed in
+    each group (a group of size 1 still stacks, with ``K = 1``).  Two
+    problems group iff :func:`problem_group_key` agrees — identical
+    array shapes/dtypes and traced statics — which is exactly the
+    condition for the batched engine to run all of them under one
+    ``jax.vmap``-ed chunk runner compiled once
+    (``engine.run_many_batched``).
+    """
+    groups: Dict[Any, List[int]] = {}
+    order: List[Any] = []
+    for i, p in enumerate(problems):
+        key = problem_group_key(p)
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(i)
+    out: List[StackedProblem] = []
+    for key in order:
+        idxs = groups[key]
+        canon = [
+            canonical_execution_problem(problems[i]) for i in idxs
+        ]
+        # stack on the HOST (numpy), one device put per leaf: an eager
+        # per-leaf jnp.stack dispatches a K-way concat program per
+        # array (~0.9 s for K=32 on CPU, measured) where the memcpy
+        # path costs ~10 ms.  On accelerators this is one host round
+        # trip per group — paid once per group, amortized over the
+        # group's whole run.
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.asarray(
+                np.stack([np.asarray(x) for x in xs])
+            ),
+            *canon,
+        )
+        out.append(
+            StackedProblem(
+                problem=stacked,
+                template=canon[0],
+                host_problems=[problems[i] for i in idxs],
+                indices=list(idxs),
+            )
+        )
+    return out
+
+
 def enable_persistent_compilation_cache(
     cache_dir: str, min_compile_seconds: float = 0.0
 ) -> bool:

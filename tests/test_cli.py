@@ -125,6 +125,51 @@ def test_solve_multiple_files(ring_yaml, tmp_path):
     assert result["status"] == "finished"
 
 
+def test_solve_many_files(ring_yaml, tmp_path):
+    """--many: each file is its own instance; the output is a JSON
+    array of per-instance results, same-bucket files batched."""
+    # a second, slightly smaller ring — same pow2:16 bucket
+    other = tmp_path / "ring5.yaml"
+    lines = [
+        "name: ring5",
+        "objective: min",
+        "domains:",
+        "  colors: {values: [R, G, B]}",
+        "variables:",
+    ]
+    for i in range(5):
+        lines.append(f"  v{i}: {{domain: colors}}")
+    lines.append("constraints:")
+    for i in range(5):
+        j = (i + 1) % 5
+        lines.append(f"  c{i}:")
+        lines.append("    type: intention")
+        lines.append(f"    function: 1 if v{i} == v{j} else 0")
+    lines.append("agents: [a0, a1, a2, a3, a4]")
+    other.write_text("\n".join(lines) + "\n")
+    r = run_cli(
+        "solve", "--many", "--algo", "mgm", "--rounds", "24",
+        "--seed", "2", "--pad_policy", "pow2:16",
+        ring_yaml, str(other),
+    )
+    assert r.returncode == 0, r.stderr
+    results = json.loads(r.stdout)
+    assert isinstance(results, list) and len(results) == 2
+    assert [res["instances_batched"] for res in results] == [2, 2]
+    assert set(results[0]["assignment"]) == {f"v{i}" for i in range(6)}
+    assert set(results[1]["assignment"]) == {f"v{i}" for i in range(5)}
+    assert all(res["status"] == "finished" for res in results)
+
+
+def test_solve_many_rejects_single_run_options(ring_yaml):
+    r = run_cli(
+        "solve", "--many", "--algo", "mgm", "--uiport", "18123",
+        ring_yaml,
+    )
+    assert r.returncode != 0
+    assert "--uiport" in r.stderr
+
+
 def test_run_command_with_scenario(ring_yaml, tmp_path):
     scenario = tmp_path / "scenario.yaml"
     scenario.write_text(

@@ -37,6 +37,21 @@ def test_recompile_guard_within_budget():
     assert report["jit_cache_hits"] >= 1, report
 
 
+def test_solve_many_guard_within_budget():
+    """Overrun detection is NOT re-tested here (the scenario is
+    expensive and the verdict machinery is the same ``ok``-vs-budget
+    pattern the dynamic overrun test below exercises)."""
+    guard = _load_guard()
+    report = guard.run_many_guard()
+    assert report["ok"], report
+    assert report["jit_compiles"] <= guard.MANY_BUDGET, report
+    assert report["jit_compiles"] >= 1, report  # guard actually ran
+    # one vmapped group covering every instance — K compiles (or K
+    # groups) is the silent-de-batching regression this exists for
+    assert report["batch_groups"] == 1, report
+    assert report["instances_batched"] == guard.MANY_K, report
+
+
 def test_recompile_guard_detects_overrun(monkeypatch):
     """The guard actually fails when the budget is exceeded (guards
     that cannot fail are decoration)."""

@@ -1,0 +1,306 @@
+"""Cross-instance batching tests (``ops.compile.stack_problems`` +
+``engine.run_many_batched`` + ``api.solve_many``).
+
+Covers the PR-4 acceptance criteria: K same-bucket instances group
+into ONE vmapped device program (one runner compile — enforced in
+tier-1 by ``tools/recompile_guard.py:run_many_guard``), results are
+bit-identical to K sequential ``solve`` calls for deterministic
+algorithms, mixed-bucket inputs split into the correct groups, and the
+instance axis composes with the restart axis
+(``[instance, restart, ...]``).
+"""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.api import solve, solve_many
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.ops.compile import (
+    compile_dcop,
+    problem_group_key,
+    stack_problems,
+)
+from pydcop_tpu.telemetry import session
+
+D = Domain("d", "", [0, 1, 2])
+
+
+def ring_dcop(n=6, maximize=False):
+    dcop = DCOP("ring%d" % n, objective="max" if maximize else "min")
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}_{j}", f"1 if v{i} == v{j} else 0", vs
+            )
+        )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n)])
+    return dcop
+
+
+# -- grouping ----------------------------------------------------------
+
+
+def test_group_key_same_bucket():
+    """Ring sizes 5..8 under pow2:16 land on one bucket key; names
+    never split a group."""
+    keys = {
+        problem_group_key(compile_dcop(ring_dcop(n), pad_policy="pow2:16"))
+        for n in (5, 6, 7, 8)
+    }
+    assert len(keys) == 1
+
+
+def test_group_key_splits_on_shape_and_objective():
+    k5 = problem_group_key(
+        compile_dcop(ring_dcop(5), pad_policy="pow2:16")
+    )
+    k40 = problem_group_key(
+        compile_dcop(ring_dcop(40), pad_policy="pow2:16")
+    )
+    kmax = problem_group_key(
+        compile_dcop(ring_dcop(5, maximize=True), pad_policy="pow2:16")
+    )
+    assert k5 != k40  # different bucket (16 vs 64 variables)
+    assert k5 != kmax  # maximize is a traced static
+
+
+def test_stack_problems_groups_and_indices():
+    problems = [
+        compile_dcop(ring_dcop(n), pad_policy="pow2:16")
+        for n in (5, 40, 6, 48, 7)
+    ]
+    groups = stack_problems(problems)
+    assert [g.indices for g in groups] == [[0, 2, 4], [1, 3]]
+    small, big = groups
+    assert small.n_instances == 3 and big.n_instances == 2
+    # leaves carry the instance axis over the template's shape
+    assert small.problem.unary.shape == (3,) + small.template.unary.shape
+    # host problems keep the original (named) metadata, stack order
+    assert small.host_problems[1].var_names == problems[2].var_names
+
+
+def test_stack_single_problem_still_stacks():
+    [g] = stack_problems([compile_dcop(ring_dcop(5))])
+    assert g.n_instances == 1
+    assert g.problem.unary.shape[0] == 1
+
+
+# -- solve_many parity -------------------------------------------------
+
+
+def test_solve_many_matches_sequential_mgm():
+    """Deterministic algorithm (mgm, fixed seed): bit-identical to
+    per-instance solve calls under the same pad policy."""
+    dcops = [ring_dcop(n) for n in (5, 6, 8)]
+    with session() as tel:
+        many = solve_many(
+            dcops, "mgm", rounds=24, chunk_size=24,
+            pad_policy="pow2:16", seed=7,
+        )
+    counters = tel.summary()["counters"]
+    assert counters.get("engine.batch_groups") == 1
+    assert counters.get("engine.instances_batched") == 3
+    for i, dcop in enumerate(dcops):
+        seq = solve(
+            dcop, "mgm", rounds=24, chunk_size=24,
+            pad_policy="pow2:16", seed=7,
+        )
+        assert many[i]["assignment"] == seq["assignment"]
+        assert many[i]["cost"] == seq["cost"]
+        assert many[i]["final_cost"] == seq["final_cost"]
+        assert many[i]["cost_trace"] == seq["cost_trace"]
+        assert many[i]["msg_count"] == seq["msg_count"]
+        assert many[i]["instances_batched"] == 3
+
+
+def test_solve_many_mixed_buckets_split_correctly():
+    """40-var rings bucket apart from 5/6-var rings: two groups, each
+    instance still solved against its own problem."""
+    dcops = [ring_dcop(5), ring_dcop(40), ring_dcop(6)]
+    with session() as tel:
+        many = solve_many(
+            dcops, "mgm", rounds=16, chunk_size=16,
+            pad_policy="pow2:16", seed=2,
+        )
+    counters = tel.summary()["counters"]
+    assert counters.get("engine.batch_groups") == 2
+    assert counters.get("engine.instances_batched") == 3
+    assert [r["instances_batched"] for r in many] == [2, 1, 2]
+    for i, dcop in enumerate(dcops):
+        seq = solve(
+            dcop, "mgm", rounds=16, chunk_size=16,
+            pad_policy="pow2:16", seed=2,
+        )
+        assert many[i]["assignment"] == seq["assignment"]
+        # every real variable of the right problem is decoded
+        assert len(many[i]["assignment"]) == len(dcop.variables)
+
+
+def test_solve_many_instance_times_restart_axis():
+    """n_restarts composes with the instance axis: per-instance
+    restart_costs are bit-identical to the sequential restart runs
+    (same per-instance seed => same [K, R] RNG streams)."""
+    dcops = [ring_dcop(5), ring_dcop(7)]
+    seeds = [3, 11]
+    many = solve_many(
+        dcops, "dsa", {"variant": "B", "probability": 0.7},
+        rounds=24, chunk_size=24, pad_policy="pow2:16",
+        seed=seeds, n_restarts=4,
+    )
+    for i, dcop in enumerate(dcops):
+        seq = solve(
+            dcop, "dsa", {"variant": "B", "probability": 0.7},
+            rounds=24, chunk_size=24, pad_policy="pow2:16",
+            seed=seeds[i], n_restarts=4,
+        )
+        assert many[i]["restart_costs"] == seq["restart_costs"]
+        assert many[i]["assignment"] == seq["assignment"]
+        assert many[i]["cost"] == seq["cost"]
+
+
+def test_solve_many_per_instance_numeric_params_share_group():
+    """Numeric params may differ per instance inside one group (they
+    ride the vmap as stacked arrays); statics must agree."""
+    dcops = [ring_dcop(5), ring_dcop(6)]
+    plist = [
+        {"variant": "B", "probability": 0.5},
+        {"variant": "B", "probability": 0.9},
+    ]
+    many = solve_many(
+        dcops, "dsa", plist, rounds=16, chunk_size=16,
+        pad_policy="pow2:16", seed=0,
+    )
+    assert [r["instances_batched"] for r in many] == [2, 2]
+    for i, dcop in enumerate(dcops):
+        seq = solve(
+            dcop, "dsa", plist[i], rounds=16, chunk_size=16,
+            pad_policy="pow2:16", seed=0,
+        )
+        assert many[i]["assignment"] == seq["assignment"]
+
+
+def test_solve_many_static_params_split_groups():
+    """Different static (str) params cannot share a compiled step —
+    they partition into separate groups even in one shape bucket."""
+    dcops = [ring_dcop(5), ring_dcop(6)]
+    with session() as tel:
+        many = solve_many(
+            dcops, "dsa",
+            [
+                {"variant": "A", "probability": 0.7},
+                {"variant": "B", "probability": 0.7},
+            ],
+            rounds=8, chunk_size=8, pad_policy="pow2:16",
+        )
+    assert tel.summary()["counters"].get("engine.batch_groups") == 2
+    assert [r["instances_batched"] for r in many] == [1, 1]
+
+
+def test_solve_many_host_path_fallback_dpop():
+    """Exact host-path algorithms run sequentially but keep the
+    per-instance result contract (bit-identical to solve)."""
+    dcops = [ring_dcop(4), ring_dcop(5)]
+    many = solve_many(dcops, "dpop")
+    for i, dcop in enumerate(dcops):
+        seq = solve(dcop, "dpop")
+        assert many[i]["assignment"] == seq["assignment"]
+        assert many[i]["cost"] == seq["cost"]
+        assert many[i]["instances_batched"] == 1
+
+
+def test_solve_many_input_validation():
+    assert solve_many([], "mgm") == []
+    with pytest.raises(ValueError, match="seeds|seed"):
+        solve_many([ring_dcop(5)], "mgm", seed=[1, 2], rounds=4)
+    with pytest.raises(ValueError, match="algo_params"):
+        solve_many(
+            [ring_dcop(5)], "mgm", [{}, {}], rounds=4
+        )
+    with pytest.raises(ValueError, match="n_restarts"):
+        solve_many([ring_dcop(5)], "dpop", n_restarts=3)
+
+
+# -- engine level ------------------------------------------------------
+
+
+def test_run_many_donation_off_matches_on():
+    """donate=False is the same math (donation only changes buffer
+    reuse, never results)."""
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_many_batched
+
+    problems = [
+        compile_dcop(ring_dcop(n), pad_policy="pow2:16")
+        for n in (5, 6)
+    ]
+    [stacked] = stack_problems(problems)
+    module = load_algorithm_module("mgm")
+    params = prepare_algo_params({}, module.algo_params)
+    kw = dict(rounds=16, seeds=[1, 2], chunk_size=16)
+    on = run_many_batched(stacked, module, params, donate=True, **kw)
+    off = run_many_batched(stacked, module, params, donate=False, **kw)
+    for a, b in zip(on, off):
+        assert a.best_cost == b.best_cost
+        assert a.best_assignment == b.best_assignment
+        assert np.array_equal(a.cost_trace, b.cost_trace)
+
+
+def test_run_many_convergence_stops_whole_group():
+    """convergence_chunks acts at group level: mgm on tiny rings
+    freezes, and the whole stack stops early together."""
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_many_batched
+
+    problems = [
+        compile_dcop(ring_dcop(n), pad_policy="pow2:16")
+        for n in (5, 6)
+    ]
+    [stacked] = stack_problems(problems)
+    module = load_algorithm_module("mgm")
+    params = prepare_algo_params({}, module.algo_params)
+    results = run_many_batched(
+        stacked, module, params, rounds=400, seeds=0, chunk_size=8,
+        convergence_chunks=2,
+    )
+    assert all(r.status == "converged" for r in results)
+    assert results[0].cycles < 400
+    assert results[0].cycles == results[1].cycles
+
+
+def test_run_many_rejects_mismatched_statics():
+    from pydcop_tpu.algorithms import (
+        load_algorithm_module,
+        prepare_algo_params,
+    )
+    from pydcop_tpu.engine.batched import run_many_batched
+
+    problems = [
+        compile_dcop(ring_dcop(n), pad_policy="pow2:16")
+        for n in (5, 6)
+    ]
+    [stacked] = stack_problems(problems)
+    module = load_algorithm_module("dsa")
+    plist = [
+        prepare_algo_params(
+            {"variant": v, "probability": 0.7}, module.algo_params
+        )
+        for v in ("A", "B")
+    ]
+    with pytest.raises(ValueError, match="static"):
+        run_many_batched(stacked, module, plist, rounds=4)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -43,6 +43,13 @@ BUDGET = 1
 # warm cache entries with unrelated runs in the same process
 ROUNDS = 56
 
+# solve_many over K same-bucket instances: ONE vmapped chunk-runner
+# compile for the whole group.  K compiles = the de-batching regression
+# this guards (one program per instance — grouping silently broken).
+MANY_BUDGET = 1
+MANY_ROUNDS = 48
+MANY_K = 4
+
 
 def _build_dcop():
     from pydcop_tpu.dcop.dcop import DCOP
@@ -145,6 +152,93 @@ def run_guard() -> dict:
     return report
 
 
+def _build_ring(n: int):
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import constraint_from_str
+
+    dom = Domain("d", "", [0, 1, 2])
+    dcop = DCOP(f"ring{n}")
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}_{j}", f"1 if v{i} == v{j} else 0", vs
+            )
+        )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n)])
+    return dcop
+
+
+def run_many_guard() -> dict:
+    """Compile budget for the cross-instance batching path: K
+    same-bucket instances through ``api.solve_many`` must compile the
+    vmapped chunk runner EXACTLY ONCE and group into ONE batch.  A
+    regression that silently de-batches — a group-key split, a cache
+    key churning per instance, pad-policy shapes drifting apart —
+    shows up as extra ``jit.compiles`` or extra ``engine.batch_groups``
+    and fails tier-1 (``tests/test_recompile_guard.py``)."""
+    from pydcop_tpu.api import solve, solve_many
+    from pydcop_tpu.engine import batched
+    from pydcop_tpu.telemetry import session
+
+    # cold start: warm runners from earlier runs in this process would
+    # hide (or fake) compiles
+    batched._RUNNER_CACHE.clear()
+
+    # ring sizes 5..8 share the pow2:16 bucket on every dimension
+    # (n_vars -> 16, binary constraints -> 16, degree widths -> 4)
+    dcops = [_build_ring(5 + i) for i in range(MANY_K)]
+    with session() as tel:
+        results = solve_many(
+            dcops, "mgm", {}, rounds=MANY_ROUNDS,
+            chunk_size=MANY_ROUNDS, pad_policy="pow2:16", seed=3,
+        )
+    counters = tel.summary()["counters"]
+    jit_compiles = int(counters.get("jit.compiles", 0))
+    groups = int(counters.get("engine.batch_groups", 0))
+    instances = int(counters.get("engine.instances_batched", 0))
+    report = {
+        "jit_compiles": jit_compiles,
+        "budget": MANY_BUDGET,
+        "ok": jit_compiles <= MANY_BUDGET,
+        "batch_groups": groups,
+        "instances_batched": instances,
+        "costs": [r["cost"] for r in results],
+        "status": results[0]["status"],
+    }
+    if groups != 1 or instances != MANY_K:
+        report["ok"] = False
+        report["error"] = (
+            f"expected 1 group of {MANY_K} instances, got {groups} "
+            f"group(s) / {instances} instance(s) — batching silently "
+            "degraded"
+        )
+    # the batched answers must still be CORRECT: bit-identical to the
+    # sequential per-instance solves (deterministic given the seed)
+    for i, d in enumerate(dcops):
+        seq = solve(
+            d, "mgm", {}, rounds=MANY_ROUNDS, chunk_size=MANY_ROUNDS,
+            pad_policy="pow2:16", seed=3,
+        )
+        if (
+            seq["cost"] != results[i]["cost"]
+            or seq["assignment"] != results[i]["assignment"]
+        ):
+            report["ok"] = False
+            report["error"] = (
+                f"instance {i}: batched result diverges from the "
+                f"sequential solve (cost {results[i]['cost']} vs "
+                f"{seq['cost']}) — the vmapped path corrupted the "
+                "per-instance math"
+            )
+            break
+    return report
+
+
 def main() -> int:
     import jax
 
@@ -152,8 +246,9 @@ def main() -> int:
     # (the axon TPU plugin ignores JAX_PLATFORMS; jax.config wins)
     jax.config.update("jax_platforms", "cpu")
     report = run_guard()
-    print(json.dumps(report))
-    return 0 if report["ok"] else 1
+    report_many = run_many_guard()
+    print(json.dumps({"dynamic": report, "solve_many": report_many}))
+    return 0 if report["ok"] and report_many["ok"] else 1
 
 
 if __name__ == "__main__":
