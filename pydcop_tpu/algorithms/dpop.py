@@ -18,6 +18,25 @@ sequential in tree depth and runs once).  Each join/projection runs
   dispatch overhead;
 - **on host (f64 numpy)** otherwise.
 
+The UTIL phase is LEVEL-SYNCHRONOUS: nodes at equal tree depth never
+depend on each other, so each level's device-eligible joins are
+grouped by *level-pack key* — the ``(joined shape, aligned part
+shapes)`` pair, quantized to the pow-2 lattice of an optional
+``pad_policy`` (``ops/padding.py:util_level_key``) — and executed as
+ONE vmapped jitted dispatch per bucket instead of one dispatch per
+node.  A wide shallow tree (the SECP shape: many leaves over shared
+hubs) pays one dispatch + one transfer for all its leaves.  With a
+pow-2 policy, near-miss shapes share buckets (ghost cells are
+zero-padded and sliced away; a ``+inf`` own-axis mask keeps argmins
+inside the real domain), so a whole tree — or a ``solve_many`` group
+of K instances, whose UTIL sweeps merge into the same waves via
+:func:`solve_host_many` — compiles a handful of join kernels instead
+of one per exact shape.  ``util_batch='node'`` keeps the same joins
+but dispatches per node: the measured baseline of the ``dpop_secp``
+bench stage.  Telemetry: ``dpop.level_dispatches``,
+``dpop.cert_fallbacks``, ``dpop.instances_batched``
+(``docs/observability.md``).
+
 DPOP is an *exact* algorithm, so the f32 path carries a certificate —
 and stays exact at ANY tree depth.  The device computes only the
 ARGMIN over the own axis plus each cell's decision margin (second
@@ -31,9 +50,16 @@ then *evaluated on host in f64 at the certified argmin* — so every
 stored UTIL table is exact no matter how it was computed, children
 contribute zero error to their parents, and a hub with hundreds of
 device children certifies against the same eps-level bound as a
-leaf.  Only genuinely tie-heavy tables (symmetric problems, >10% of
-cells uncertifiable) fall back — the whole UTIL phase restarts on
-the host f64 path, which is about economy, not soundness.
+leaf.  Level-pack padding never weakens the certificate: zero ghost
+cells lie outside the certified region and the mask adds an exact
+``0.0`` to every real cell, so the error bound is computed from the
+real parts alone.  Only genuinely tie-heavy tables (symmetric
+problems, >10% of cells uncertifiable — per-cell repair would
+dominate) fall back, and the fallback is per NODE: that one join is
+redone wholesale on host f64 and the sweep keeps going, so a few
+tie-heavy hubs (common in SECP models) never drag a whole tree — or
+a whole ``solve_many`` group — off the device.  This is about
+economy, not soundness.
 
 The VALUE phase only needs each node's argmin over its own axis, so
 the UTIL phase retains just that (int) table per node, not the full
@@ -54,12 +80,19 @@ the tree height — the number of parallel message waves per phase.
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.graphs import pseudotree as _pt
+from pydcop_tpu.ops.padding import (
+    NO_PADDING,
+    PadPolicy,
+    as_pad_policy,
+    pad_util_parts,
+    util_level_key,
+)
 
 GRAPH_TYPE = "pseudotree"
 
@@ -72,6 +105,11 @@ algo_params: list = [
     ),
     # smallest joined-table size worth a device dispatch
     AlgoParameterDef("device_min_cells", "int", None, 1 << 14),
+    # 'level' (default): one vmapped dispatch per level-pack bucket
+    # per tree level; 'node': one dispatch per device node — the
+    # pre-level-sync behavior, kept as the bench baseline
+    # (bench.py dpop_secp reports util-cells/sec for both)
+    AlgoParameterDef("util_batch", "str", ["level", "node"], "level"),
     # bounded-memory exact mode: cap every UTIL table at this many
     # cells by CONDITIONING a cut set of variables (enumerate their
     # assignments, best-of over bounded passes).  0 = off (reject
@@ -102,14 +140,11 @@ def build_computation(comp_def, seed: int = 0):
 from pydcop_tpu.algorithms._tables import align_table as _align  # noqa: E402
 
 
-def solve_host(
-    dcop: DCOP,
-    params: Dict[str, Any],
-    timeout: Optional[float] = None,
-    max_util_size: int = 1 << 26,
-) -> Dict[str, Any]:
-    """Run DPOP to optimality.  Returns the reference-shaped result dict."""
-    t0 = time.perf_counter()
+def _prepare_instance(dcop: DCOP):
+    """Host-side problem setup shared by :func:`solve_host` and
+    :func:`solve_host_many`: the pseudo-tree, per-variable domains and
+    depths, and constraint ownership (each constraint owned by the
+    deepest variable of its scope; external variables sliced out)."""
     sign = -1.0 if dcop.objective == "max" else 1.0
 
     graph = _pt.build_computation_graph(dcop)
@@ -147,6 +182,34 @@ def solve_host(
         table = sign * np.asarray(m.matrix, dtype=np.float64)
         owner = max(scope, key=lambda n: depth[n])
         owned[owner].append((scope, table))
+    return graph, domains, depth, owned
+
+
+def _resolve_device_min_cells(params: Dict[str, Any]) -> Optional[int]:
+    """``util_device``/``device_min_cells`` → the per-instance device
+    threshold: None disables the device path entirely."""
+    use_device = params.get("util_device", "auto")
+    if use_device == "never":
+        return None
+    if use_device == "always":
+        return 0
+    return int(params.get("device_min_cells", 1 << 14))
+
+
+def solve_host(
+    dcop: DCOP,
+    params: Dict[str, Any],
+    timeout: Optional[float] = None,
+    max_util_size: int = 1 << 26,
+    pad_policy: Any = None,
+) -> Dict[str, Any]:
+    """Run DPOP to optimality.  Returns the reference-shaped result
+    dict.  ``pad_policy`` (str spec or :class:`PadPolicy`) buckets the
+    UTIL level dispatches on the pow-2 lattice — results are
+    bit-identical with or without it (module docstring)."""
+    t0 = time.perf_counter()
+    pad = as_pad_policy(pad_policy)
+    graph, domains, depth, owned = _prepare_instance(dcop)
 
     # -- bounded-memory planning (memory_bound > 0): pick a cut set
     # whose conditioning keeps every UTIL table under the bound
@@ -157,10 +220,8 @@ def solve_host(
         cut = _plan_conditioning(graph, domains, depth, owned, bound)
         max_util_size = bound
 
-    use_device = params.get("util_device", "auto")
-    device_min_cells = int(params.get("device_min_cells", 1 << 14))
-    if use_device == "always":
-        device_min_cells = 0
+    device_min_cells = _resolve_device_min_cells(params)
+    level_sync = params.get("util_batch", "level") != "node"
 
     from pydcop_tpu.telemetry import get_tracer
 
@@ -170,48 +231,24 @@ def solve_host(
         """One full UTIL+VALUE run (device path w/ host fallback).
         Returns (assignment, stats dict) or None on timeout."""
         t_util = time.perf_counter()
-        try:
-            if use_device == "never":
-                raise _PrecisionFallback(None, 0.0, 0.0)
-            util_stats = _util_phase(
-                dcop, graph, domains_p, depth, owned_p, t0, timeout,
-                device_min_cells=device_min_cells,
-                max_util_size=max_util_size,
-            )
-            util_backend = "device"
-        except _PrecisionFallback as fb:
-            if fb.node is not None:  # an actual failed margin
-                import logging
-
-                logging.getLogger(__name__).info(
-                    "DPOP UTIL f32 margin %.3g below error bound %.3g "
-                    "at node %s; restarting UTIL on the host f64 path",
-                    fb.margin, fb.bound, fb.node,
-                )
-            util_stats = _util_phase(
-                dcop, graph, domains_p, depth, owned_p, t0, timeout,
-                device_min_cells=None,
-                max_util_size=max_util_size,
-            )
-            util_backend = "host"
+        util_backend = "host" if device_min_cells is None else "device"
+        util_stats = _util_phase(
+            graph, domains_p, depth, owned_p, t0, timeout,
+            device_min_cells=device_min_cells,
+            max_util_size=max_util_size,
+            pad=pad, level_sync=level_sync,
+        )
         if util_stats is None:
             return None
-        best_choice, util_cells, device_nodes, host_nodes = util_stats
+        (best_choice, util_cells, device_nodes, host_nodes,
+         dispatches) = util_stats
         t_value = time.perf_counter()
         tracer.add_span(
             "util-phase", "phase", t_util, t_value - t_util,
             algo="dpop", backend=util_backend, cells=util_cells,
         )
 
-        # VALUE phase: pre-order
-        assignment: Dict[str, Any] = {}
-        idx: Dict[str, int] = {}
-        for root in graph.roots:
-            for name in graph.depth_first_order(root):
-                sep, amin = best_choice[name]
-                best = int(amin[tuple(idx[d] for d in sep)])
-                idx[name] = best
-                assignment[name] = domains_p[name][best]
+        assignment = _value_phase(graph, domains_p, best_choice)
         tracer.add_span(
             "value-phase", "phase", t_value,
             time.perf_counter() - t_value, algo="dpop",
@@ -222,6 +259,7 @@ def solve_host(
             "util_cells": util_cells,
             "util_device_nodes": device_nodes,
             "util_host_nodes": host_nodes,
+            "util_dispatches": dispatches,
         }
 
     if not cut:
@@ -236,12 +274,13 @@ def solve_host(
         # values, and the enumeration covers the cut's whole space)
         from itertools import product as _product
 
+        sign = -1.0 if dcop.objective == "max" else 1.0
         sign_best = float("inf")
         assignment = None
         stats = {
             "util_time": 0.0, "util_backend": "device",
             "util_cells": 0, "util_device_nodes": 0,
-            "util_host_nodes": 0,
+            "util_host_nodes": 0, "util_dispatches": 0,
         }
         n_passes = 0
         exhausted = True
@@ -269,6 +308,7 @@ def solve_host(
             stats["util_cells"] += s["util_cells"]
             stats["util_device_nodes"] += s["util_device_nodes"]
             stats["util_host_nodes"] += s["util_host_nodes"]
+            stats["util_dispatches"] += s["util_dispatches"]
             if s["util_backend"] == "host":
                 stats["util_backend"] = "host"
             c = sign * dcop.solution_cost(a)
@@ -288,12 +328,158 @@ def solve_host(
             r["conditioning_passes"] = n_passes
             return r
 
+    result = _assemble_result(
+        dcop, graph, domains, depth, assignment, stats, t0, n_passes
+    )
+    if cut:
+        result["conditioned_vars"] = list(cut)
+        result["conditioning_passes"] = n_passes
+    return result
+
+
+def solve_host_many(
+    dcops: Sequence[DCOP],
+    params_list: Sequence[Dict[str, Any]],
+    timeout: Optional[float] = None,
+    max_util_size: int = 1 << 26,
+    pad_policy: Any = None,
+) -> List[Dict[str, Any]]:
+    """Solve K DPOP instances with their UTIL phases MERGED into one
+    level-synchronous device sweep.
+
+    Wave ``w`` of the sweep holds every instance's nodes ``w`` levels
+    above that instance's deepest level; same-level-pack-bucket joins
+    from DIFFERENT instances stack into the same vmapped dispatch and
+    share one compiled executable, so K same-bucket instances pay the
+    dispatch/compile cost of roughly one (``api.solve_many`` routes
+    same-``problem_group_key`` DPOP instances here — the replacement
+    for the old sequential host fallback).
+
+    Exactness parity: each result is bit-identical to the sequential
+    ``solve_host(dcops[i], params_list[i])`` — the merged sweep runs
+    the same joins in the same part order; stacking only changes which
+    rows ride one dispatch, certified argmins are unique true argmins
+    regardless of batching, and uncertified cells are repaired by the
+    same exact host recomputation (``tests/test_dpop_level.py``,
+    ``tools/recompile_guard.py:run_dpop_guard``).
+
+    Tie-heavy NODES that fail their certificate are redone on host
+    f64 individually without disturbing the rest of the sweep;
+    instances with ``memory_bound`` conditioning run sequentially
+    (their UTIL phase is a dependent pass sequence).  ``timeout``
+    bounds the whole call; the merged sweep times out as a group.
+    """
+    t0 = time.perf_counter()
+    pad = as_pad_policy(pad_policy)
+    K = len(dcops)
+    results: List[Optional[Dict[str, Any]]] = [None] * K
+
+    def _remaining():
+        if timeout is None:
+            return None
+        return max(timeout - (time.perf_counter() - t0), 0.01)
+
+    merged_idx = [
+        i for i in range(K)
+        if not int(params_list[i].get("memory_bound", 0) or 0)
+    ]
+    for i in range(K):
+        if i not in merged_idx:
+            results[i] = solve_host(
+                dcops[i], params_list[i], timeout=_remaining(),
+                max_util_size=max_util_size, pad_policy=pad,
+            )
+    if not merged_idx:
+        return results  # type: ignore[return-value]
+
+    from pydcop_tpu.telemetry import get_metrics, get_tracer
+
+    tracer = get_tracer()
+    met = get_metrics()
+    if met.enabled:
+        met.inc("dpop.instances_batched", len(merged_idx))
+
+    preps = {i: _prepare_instance(dcops[i]) for i in merged_idx}
+    insts = [
+        _UtilInstance(*preps[i], _resolve_device_min_cells(params_list[i]))
+        for i in merged_idx
+    ]
+    # 'node' on ANY instance de-batches the whole merged sweep — the
+    # statics partition upstream keeps mixed groups apart in practice
+    level_sync = all(
+        params_list[i].get("util_batch", "level") != "node"
+        for i in merged_idx
+    )
+
+    t_util = time.perf_counter()
+    outs = _util_phase_multi(
+        insts, t0, timeout, max_util_size=max_util_size,
+        pad=pad, level_sync=level_sync,
+    )
+    if outs is None:
+        for i in merged_idx:
+            results[i] = _timeout_result(dcops[i], t0)
+        return results  # type: ignore[return-value]
+    tracer.add_span(
+        "util-phase", "phase", t_util, time.perf_counter() - t_util,
+        algo="dpop", backend="merged", instances=len(merged_idx),
+    )
+    # an even share per instance, the same convention run_many_host
+    # applies to the result's "time": per-instance util_cells /
+    # util_time throughput stays meaningful, and summing util_time
+    # over a group reflects the one merged sweep, not K copies of it
+    util_time = (time.perf_counter() - t_util) / max(len(merged_idx), 1)
+
+    for i, inst, out in zip(merged_idx, insts, outs):
+        graph, domains, depth, _ = preps[i]
+        backend = "device" if inst.device_min_cells is not None else "host"
+        best_choice, cells, dev_nodes, host_nodes, dispatches = out
+        assignment = _value_phase(graph, domains, best_choice)
+        results[i] = _assemble_result(
+            dcops[i], graph, domains, depth, assignment,
+            {
+                "util_time": util_time,
+                "util_backend": backend,
+                "util_cells": cells,
+                "util_device_nodes": dev_nodes,
+                "util_host_nodes": host_nodes,
+                "util_dispatches": dispatches,
+            },
+            t0, 1,
+        )
+    return results  # type: ignore[return-value]
+
+
+def _value_phase(graph, domains, best_choice) -> Dict[str, Any]:
+    """Top-down VALUE wave (pre-order): condition each node's retained
+    argmin table on the accumulated ancestor assignment."""
+    assignment: Dict[str, Any] = {}
+    idx: Dict[str, int] = {}
+    for root in graph.roots:
+        for name in graph.depth_first_order(root):
+            sep, amin = best_choice[name]
+            best = int(amin[tuple(idx[d] for d in sep)])
+            idx[name] = best
+            assignment[name] = domains[name][best]
+    return assignment
+
+
+def _assemble_result(
+    dcop: DCOP,
+    graph,
+    domains,
+    depth,
+    assignment: Dict[str, Any],
+    stats: Dict[str, Any],
+    t0: float,
+    n_passes: int,
+) -> Dict[str, Any]:
     cost = dcop.solution_cost(assignment)
     n_msgs = sum(
         1 for n in domains if graph.node(n).parent is not None
     )
     height = max(depth.values(), default=0)
-    result = {
+    return {
         "assignment": assignment,
         "cost": cost,
         "final_assignment": assignment,
@@ -306,16 +492,15 @@ def solve_host(
         "status": "finished",
         "time": time.perf_counter() - t0,
         "cost_trace": [cost],
-        # UTIL-phase observability (BASELINE config #4 reports these)
+        # UTIL-phase observability (BASELINE config #4 reports these;
+        # bench.py's dpop_secp stage derives util-cells/sec from them)
         "util_time": stats["util_time"],
         "util_backend": stats["util_backend"],
+        "util_cells": stats["util_cells"],
         "util_device_nodes": stats["util_device_nodes"],
         "util_host_nodes": stats["util_host_nodes"],
+        "util_dispatches": stats["util_dispatches"],
     }
-    if cut:
-        result["conditioned_vars"] = list(cut)
-        result["conditioning_passes"] = n_passes
-    return result
 
 
 def _condition_part(
@@ -402,8 +587,17 @@ class _PrecisionFallback(Exception):
         self.bound = bound
 
 
+class _UtilInstance(NamedTuple):
+    """One instance's UTIL-phase inputs for the merged sweep."""
+
+    graph: Any
+    domains: Dict[str, list]
+    depth: Dict[str, int]
+    owned: Dict[str, List[Tuple[List[str], np.ndarray]]]
+    device_min_cells: Optional[int]  # None = host-only instance
+
+
 def _util_phase(
-    dcop: DCOP,
     graph,
     domains: Dict[str, list],
     depth: Dict[str, int],
@@ -412,129 +606,176 @@ def _util_phase(
     timeout: Optional[float],
     device_min_cells: Optional[int],
     max_util_size: int = 1 << 26,
+    pad: PadPolicy = NO_PADDING,
+    level_sync: bool = True,
 ):
-    """Bottom-up joins.  ``device_min_cells=None`` forces the pure host
-    f64 path; otherwise joins of >= that many cells run on device in
-    f32 under the error-certificate scheme (module docstring), raising
-    :class:`_PrecisionFallback` when the table is too tie-heavy for
-    the device path to be worthwhile.
+    """Single-instance UTIL phase: the K=1 case of
+    :func:`_util_phase_multi`.  Returns ``(best_choice, util_cells,
+    device_nodes, host_nodes, dispatches)`` or None on timeout."""
+    outs = _util_phase_multi(
+        [_UtilInstance(graph, domains, depth, owned, device_min_cells)],
+        t0, timeout, max_util_size=max_util_size,
+        pad=pad, level_sync=level_sync,
+    )
+    return None if outs is None else outs[0]
 
-    The device produces only the ARGMIN (certified cell-wise against
-    the local f32 rounding error; uncertifiable cells repaired exactly
-    on host); the projected ``u`` values are then evaluated on host in
-    exact f64 at the chosen argmin.  Children's stored tables are
-    therefore exact regardless of how they were computed, so NO error
-    accumulates across tree depth — a node with hundreds of device
-    children certifies against the same eps-level bound as a leaf.
 
-    Device nodes are processed in LEVEL WAVES: nodes at equal tree
-    depth never depend on each other, so each wave's device-eligible
-    nodes are grouped by (joined shape, aligned part shapes) bucket
-    and executed as ONE vmapped jitted join per bucket — a wide
-    shallow tree (the SECP shape: many leaves over shared hubs) pays
-    one dispatch + one transfer for all its leaves instead of one per
-    node (VERDICT r2 item 7).
+def _util_phase_multi(
+    insts: Sequence[_UtilInstance],
+    t0: float,
+    timeout: Optional[float],
+    max_util_size: int = 1 << 26,
+    pad: PadPolicy = NO_PADDING,
+    level_sync: bool = True,
+):
+    """Merged bottom-up UTIL sweep over one or many instances.
 
-    Returns ``(best_choice, util_cells, device_nodes, host_nodes)`` or
-    None on timeout.
+    Wave ``w`` holds, for every instance, the nodes ``w`` levels above
+    that instance's deepest level — a node (depth d) always lands one
+    wave after its children (depth d+1), and nodes of different
+    instances never depend on each other, so each wave's
+    device-eligible joins bucket by level-pack key
+    (:func:`~pydcop_tpu.ops.padding.util_level_key`: the
+    pow-2-quantized ``(joined shape, part shapes)`` pair under
+    ``pad``) ACROSS instances and execute as ONE vmapped jitted
+    join+project+argmin+margin dispatch per bucket.  Ghost cells from
+    the padding are zero-filled, kept out of the certificate's error
+    bound, guarded by a ``+inf`` own-axis mask, and sliced away before
+    certification — real cells compute bit-identically to the
+    unpadded join.  ``level_sync=False`` runs the same joins one
+    dispatch per node (the measured per-node baseline).
+
+    Per-instance ``device_min_cells=None`` forces that instance's pure
+    host f64 path; otherwise joins of >= that many cells run on
+    device in f32 under the error-certificate scheme (module
+    docstring).  The device produces only the ARGMIN (certified
+    cell-wise against the local f32 rounding error; uncertifiable
+    cells repaired exactly on host); the projected ``u`` values are
+    then evaluated on host in exact f64 at the chosen argmin, so NO
+    error accumulates across tree depth.  A tie-heavy table (>10% of
+    cells uncertifiable — per-cell repair would dominate) is redone
+    WHOLESALE on host f64, per NODE: the sweep keeps going, the other
+    nodes keep their device results, and exactness is untouched
+    (children's stored tables are exact either way) — tie-heavy hubs
+    in an otherwise healthy tree (the SECP shape) no longer drag the
+    whole phase back to host.
+
+    Returns one stats tuple ``(best_choice, util_cells, device_nodes,
+    host_nodes, dispatches)`` per instance, or None for the whole
+    call on timeout.  Counters: ``dpop.level_dispatches`` per device
+    dispatch, ``dpop.cert_fallbacks`` per tie-heavy node redone on
+    host.
     """
-    from collections import defaultdict
-    from itertools import groupby
+    from pydcop_tpu.telemetry import get_metrics
 
-    util: Dict[str, Tuple[List[str], np.ndarray]] = {}
-    # per node: (separator order, argmin over own axis) — all the VALUE
-    # phase needs, at 1/d the cells and int dtype vs the full joint
-    best_choice: Dict[str, Tuple[List[str], np.ndarray]] = {}
-    util_cells = 0
-    device_nodes = host_nodes = 0
+    met = get_metrics()
+    K = len(insts)
+    utils: List[Dict[str, Tuple[List[str], np.ndarray]]] = [
+        {} for _ in range(K)
+    ]
+    best_choice: List[Dict[str, Tuple[List[str], np.ndarray]]] = [
+        {} for _ in range(K)
+    ]
+    util_cells = [0] * K
+    device_nodes = [0] * K
+    host_nodes = [0] * K
+    dispatches = [0] * K
+    _key_memo: Dict[tuple, tuple] = {}  # per-call: pad is fixed here
 
-    def finish(name, node, sep, u, amin):
-        nonlocal util_cells
+    def finish(k, name, node, sep, u, amin):
         # min-normalize the outgoing table (either path): argmin
         # decisions are shift-invariant, the final cost comes from
         # solution_cost(assignment), and keeping UTIL values at the
         # local cost scale keeps the per-node f32 rounding bounds
-        # (which scale with max|J|) small up the whole tree
-        if node.parent is not None and u.size:
-            u = u - u.min()
-        best_choice[name] = (sep, amin)
-        util[name] = (sep, u)
-        util_cells += u.size if node.parent is not None else 0
+        # (which scale with max|J|) small up the whole tree.  The
+        # normalized table is >= 0, so its max IS its abs-max — carry
+        # it so the parent's certificate bound needs no re-reduction
+        best_choice[k][name] = (sep, amin)
+        if node.parent is not None:
+            if u.size:
+                u = u - u.min()
+            utils[k][name] = (sep, u, float(u.max(initial=0.0)))
+            util_cells[k] += u.size
 
-    def certify_and_repair(name, parts, target, shape,
-                           amin, margins, sum_max_abs):
-        """f32 argmin certificate + exact host repair of near-ties.
-
-        Inputs to the f32 join are exact (children's utils are exact
-        f64, see _exact_u_at), so |J32 − J| ≤ local_err and a margin
-        ≥ 2·local_err proves the f32 argmin is the true argmin.  The
-        bound scales with Σ_i max|part_i| (NOT max|J|): parts of
-        mixed sign can cancel in J while each carries rounding error
-        at its own magnitude.  Uncertifiable cells get their row
-        recomputed exactly.  Raises _PrecisionFallback only when the
-        table is so tie-heavy that per-cell repair would dominate
-        (symmetric problems — the device path is pointless there,
-        not unsound).
-        """
-        local_err = _EPS32 * (len(parts) + 1) * sum_max_abs
-        bad = np.argwhere(margins < 2.0 * local_err)
-        if len(bad) * 10 > margins.size:
-            raise _PrecisionFallback(
-                name, float(margins.min(initial=np.inf)),
-                2.0 * local_err,
+    # wave plan: wave index = node HEIGHT (longest path down to a
+    # leaf), not depth — a node's children have strictly smaller
+    # height, so dependencies still resolve wave by wave, and ragged
+    # trees batch far better: EVERY leaf of every instance lands in
+    # wave 0 regardless of its depth (a zone-local SECP band puts
+    # leaves at all depths; depth-classes would scatter them across
+    # waves and shrink every bucket)
+    waves: List[List[Tuple[int, str]]] = []
+    for k, inst in enumerate(insts):
+        names = [
+            n
+            for root in inst.graph.roots
+            for n in inst.graph.depth_first_order(root)
+        ]
+        height: Dict[str, int] = {}
+        for n in reversed(names):  # children before parents
+            height[n] = 1 + max(
+                (height[c] for c in inst.graph.node(n).children),
+                default=-1,
             )
-        for cell in map(tuple, bad):
-            row = np.zeros(shape[-1], dtype=np.float64)
-            for dims, table in parts:
-                row += _cell_slice(table, dims, target, cell)
-            amin[cell] = int(row.argmin())
+        for n in names:
+            w = height[n]
+            while len(waves) <= w:
+                waves.append([])
+            waves[w].append((k, n))
 
-    def _exact_u_at(parts, target, shape, amin):
-        """Exact f64 u: evaluate the join only AT the chosen argmin,
-        u[cell] = Σ_parts part[cell, amin[cell]] — O(cells·parts)
-        instead of the full O(cells·d·parts) join, and exact because
-        every part (child utils included) is exact f64."""
-        own = target[-1]
-        grids = np.indices(shape[:-1], dtype=np.intp)
-        u = np.zeros(shape[:-1], dtype=np.float64)
-        for dims, table in parts:
-            idx = []
-            for d in dims:
-                if d == own:
-                    idx.append(amin)
-                else:
-                    idx.append(grids[target.index(d)])
-            u += np.asarray(table, dtype=np.float64)[tuple(idx)]
-        return u
-
-    names = [
-        n for root in graph.roots for n in graph.depth_first_order(root)
-    ]
-    for _, level in groupby(
-        sorted(names, key=lambda n: -depth[n]), key=lambda n: -depth[n]
-    ):
-        # -- prepare every node of this level ------------------------
-        prepared = []
-        for name in level:
+    for wave in waves:
+        # -- prepare the wave: host joins run immediately, device
+        # joins bucket by level-pack key across instances
+        buckets: Dict[tuple, list] = {}
+        order: List[tuple] = []
+        for k, name in wave:
             if timeout is not None and time.perf_counter() - t0 > timeout:
                 return None
-            node = graph.node(name)
+            inst = insts[k]
+            domains = inst.domains
+            node = inst.graph.node(name)
             # effective separator: ancestors referenced by own
-            # relations or children's separators
+            # relations or children's separators.  Owned relations
+            # are PRE-SUMMED into one exact f64 part: bitwise the
+            # same join (left-associated order preserved, zeros+x is
+            # exact), but the device-join signature collapses — every
+            # leaf becomes a one-part bucket whatever mix of
+            # unary/rule/model tables it owns, and the f32 error
+            # bound tightens (fewer parts, one rounding of the sum)
             sep: List[str] = []
             parts: List[Tuple[List[str], np.ndarray]] = []
-            for dims, table in owned[name]:
+            parts_max = 0.0  # Σ max|part| for the certificate bound
+            own_parts = inst.owned[name]
+            if len(own_parts) > 1:
+                odims: List[str] = []
+                for dims, _ in own_parts:
+                    odims.extend(d for d in dims if d not in odims)
+                o = np.zeros(
+                    [len(domains[d]) for d in odims], dtype=np.float64
+                )
+                for dims, table in own_parts:
+                    o = o + _align(table, dims, odims)
+                own_parts = [(odims, o)]
+            for dims, table in own_parts:
                 parts.append((dims, table))
+                parts_max += float(
+                    max(
+                        table.max(initial=0.0),
+                        -table.min(initial=0.0),
+                    )
+                )
                 sep.extend(d for d in dims if d != name)
             for child in node.children:
-                cdims, ctable = util[child]
+                cdims, ctable, cmax = utils[k][child]
                 parts.append((cdims, ctable))
+                parts_max += cmax
                 sep.extend(d for d in cdims if d != name)
-            sep = sorted(set(sep), key=lambda n: depth[n])
+            sep = sorted(set(sep), key=lambda n: inst.depth[n])
             target = sep + [name]
-            size = int(
-                np.prod([len(domains[d]) for d in target], dtype=np.int64)
-            )
+            shape = [len(domains[d]) for d in target]
+            size = 1
+            for s in shape:
+                size *= s
             if size > max_util_size:
                 raise ValueError(
                     f"DPOP UTIL table for {name!r} needs {size} cells "
@@ -543,81 +784,316 @@ def _util_phase(
                     f"for exact DPOP — use a local-search or message-"
                     f"passing algorithm instead."
                 )
-            shape = [len(domains[d]) for d in target]
-            on_device = (
-                device_min_cells is not None and size >= device_min_cells
-            )
-            prepared.append(
-                (name, node, sep, target, shape, parts, on_device)
-            )
-
-        # -- host nodes: immediate f64 joins -------------------------
-        buckets = defaultdict(list)
-        for item in prepared:
-            name, node, sep, target, shape, parts, on_dev = item
-            if not on_dev:
+            dmc = inst.device_min_cells
+            if dmc is None or size < dmc:
                 j = np.zeros(shape, dtype=np.float64)
                 for dims, table in parts:
                     j = j + _align(table, dims, target)
                 u = j.min(axis=-1)
                 amin = np.argmin(j, axis=-1)
                 del j
-                host_nodes += 1
-                finish(name, node, sep, u, amin)
+                host_nodes[k] += 1
+                finish(k, name, node, sep, u, amin)
                 continue
-            aligned = [
-                _align(np.asarray(t, dtype=np.float32), dims, target)
-                for dims, t in parts
-            ]
-            key = (tuple(shape), tuple(a.shape for a in aligned))
-            buckets[key].append((item, aligned))
+            # aligned in exact f64: the batched path casts the whole
+            # stack to f32 in one pass per part position; the
+            # per-node path casts per part just before its dispatch
+            aligned = [_align(t, dims, target) for dims, t in parts]
+            # certificate bound scale: Σ max|part| over the REAL f64
+            # parts (child maxes carried from finish, owned reduced
+            # above) — padding ghosts / the inf mask never inflate
+            # it.  The f32 copies can exceed the f64 maxes by at most
+            # one ulp of relative rounding, noise against the bound's
+            # (#parts+1) slack.
+            sum_max_abs = parts_max
+            raw = (tuple(shape), tuple(a.shape for a in aligned))
+            key = _key_memo.get(raw)
+            if key is None:  # UTIL trees repeat shapes heavily —
+                # memoize the lattice quantization per raw signature
+                key = _key_memo[raw] = util_level_key(
+                    raw[0], raw[1], pad
+                )
+            if key not in buckets:
+                buckets[key] = []
+                order.append(key)
+            buckets[key].append(
+                ((k, name, node, sep, target, shape, parts,
+                  sum_max_abs), aligned)
+            )
 
-        # -- device nodes: one vmapped join per shape bucket ---------
-        for key, entries in buckets.items():
+        # -- device joins: one vmapped dispatch per level-pack bucket.
+        # The host-side glue is vectorized per BUCKET too — pad/stack
+        # buffers are filled by slice-assignment into one zeros
+        # allocation per part position, and certification runs one
+        # argwhere over the whole stack — so python/numpy call
+        # overhead amortizes across the rows exactly like the
+        # dispatch does (the second half of the level-sync win; the
+        # per-node path below keeps per-node costs, which is what the
+        # dpop_secp bench measures against)
+        for key in order:
+            entries = buckets[key]
             if timeout is not None and time.perf_counter() - t0 > timeout:
                 return None
-            shape_t, part_shapes = key
-            if len(entries) == 1:
-                (item, aligned) = entries[0]
-                fn = _join_kernel(shape_t, part_shapes)
-                amin_d, marg_d = fn(*aligned)
-                per_node = [
-                    (np.array(amin_d), np.asarray(marg_d))
+            pshape, part_shapes = key
+            n_rows = len(entries)
+            shape0 = entries[0][0][5]
+            uniform = all(it[5] == shape0 for it, _ in entries)
+            if level_sync and n_rows > 1 and uniform:
+                # stack height bucketed pow-2 under a pad policy
+                # (ghost rows stay zero, discarded below): the
+                # vmapped kernel retraces per distinct leading dim,
+                # so raw stack sizes — which vary per wave and per
+                # solve_many group composition — would recompile the
+                # same bucket over and over
+                stack_h = (
+                    _stack_bucket(n_rows) if pad.enabled else n_rows
+                )
+                # f64 stack buffers: exact values for the batched
+                # exact-u gather below; ONE vectorized f32 cast per
+                # part position feeds the kernel (instead of a cast
+                # per part per node)
+                bufs = [
+                    np.zeros((stack_h,) + ps, dtype=np.float64)
+                    for ps in part_shapes
                 ]
-            else:
-                fn = _join_kernel(shape_t, part_shapes, batched=True)
-                stacked = [
-                    np.stack([al[i] for _, al in entries])
-                    for i in range(len(part_shapes))
-                ]
-                aminb, margb = fn(*stacked)
-                aminb = np.array(aminb)
+                for r, (item, aligned) in enumerate(entries):
+                    for i, a in enumerate(aligned):
+                        bufs[i][r][
+                            tuple(slice(0, s) for s in a.shape)
+                        ] = a
+                    if pad.enabled:  # own-axis ghost guard (mask)
+                        bufs[-1][r][..., shape0[-1]:] = np.inf
+                fn = _join_kernel(pshape, part_shapes, batched=True)
+                aminb, margb = fn(
+                    *[b.astype(np.float32) for b in bufs]
+                )
+                # pull BOTH outputs to host numpy in one transfer
+                # each before any slicing — indexing the jax arrays
+                # directly would dispatch a device slice per access
+                aminb = np.asarray(aminb)
                 margb = np.asarray(margb)
-                per_node = [
-                    (aminb[i], margb[i]) for i in range(len(entries))
-                ]
-            for (item, aligned), (amin, margins) in zip(
-                entries, per_node
-            ):
+                if met.enabled:
+                    met.inc("dpop.level_dispatches")
+                for k in sorted({item[0] for item, _ in entries}):
+                    dispatches[k] += 1
+                # certification, vectorized over the stack: slice the
+                # real region once, one argwhere against the per-row
+                # error bounds, repairs grouped by row
+                region = (slice(0, n_rows),) + tuple(
+                    slice(0, s) for s in shape0[:-1]
+                )
+                amin_b = np.array(aminb[region])  # writable (repair)
+                marg_b = np.asarray(margb[region], dtype=np.float64)
+                errs = np.array(
+                    [
+                        2.0 * _EPS32 * (len(it[6]) + 1) * it[7]
+                        for it, _ in entries
+                    ]
+                )
+                bad = np.argwhere(
+                    marg_b
+                    < errs.reshape(
+                        (n_rows,) + (1,) * (marg_b.ndim - 1)
+                    )
+                )
+                n_bad = np.bincount(bad[:, 0], minlength=n_rows)
+                bad_by_row: Dict[int, list] = {}
+                for cell in bad:
+                    bad_by_row.setdefault(int(cell[0]), []).append(
+                        tuple(int(c) for c in cell[1:])
+                    )
+                sep_cells = int(marg_b.size // n_rows)
+                grids = (
+                    np.indices(shape0[:-1], dtype=np.intp)
+                    if len(shape0) > 1
+                    else None
+                )
+                # tie-heavy rows go to the host redo FIRST; everyone
+                # else's near-tie cells are repaired in amin_b before
+                # the batched exact-u gather reads it
+                redone = set()
+                for r, (item, aligned) in enumerate(entries):
+                    if int(n_bad[r]) * 10 > sep_cells:
+                        _host_redo(met, host_nodes, finish, item)
+                        redone.add(r)
+                        continue
+                    (_, _, _, _, target, shape, parts, _) = item
+                    amin_r = amin_b[r:r + 1].reshape(
+                        tuple(shape[:-1])
+                    )
+                    for cell in bad_by_row.get(r, ()):
+                        row = np.zeros(shape[-1], dtype=np.float64)
+                        for dims, table in parts:
+                            row += _cell_slice(
+                                table, dims, target, cell
+                            )
+                        amin_r[cell] = int(row.argmin())
+                # exact u, vectorized over the whole stack: gather
+                # each f64 part buffer AT the certified argmin — one
+                # fancy-index per part position instead of one
+                # exact-u evaluation per node; summation order is
+                # the parts order, so values are bit-identical to
+                # the per-node _exact_u_at
+                n_raw = len(entries[0][1])
+                rows_ix = np.arange(n_rows).reshape(
+                    (n_rows,) + (1,) * (len(shape0) - 1)
+                )
+                u_b = np.zeros((n_rows,) + tuple(shape0[:-1]))
+                for i in range(n_raw):
+                    ps = part_shapes[i]
+                    idx: list = [rows_ix]
+                    for j in range(len(shape0) - 1):
+                        idx.append(grids[j] if ps[j] != 1 else 0)
+                    idx.append(amin_b if ps[-1] != 1 else 0)
+                    u_b += bufs[i][tuple(idx)]
+                for r, (item, aligned) in enumerate(entries):
+                    if r in redone:
+                        continue
+                    (k, name, node, sep, target, shape, parts,
+                     sum_max_abs) = item
+                    amin_r = amin_b[r:r + 1].reshape(
+                        tuple(shape[:-1])
+                    )
+                    device_nodes[k] += 1
+                    finish(k, name, node, sep, u_b[r], amin_r)
+                continue
+
+            # per-node dispatches: util_batch='node', singleton
+            # buckets, or (rare) mixed real shapes under one padded
+            # key
+            fn = _join_kernel(pshape, part_shapes)
+            for item, aligned in entries:
+                (k, name, node, sep, target, shape, parts,
+                 sum_max_abs) = item
                 if (
                     timeout is not None
                     and time.perf_counter() - t0 > timeout
                 ):
                     return None
-                name, node, sep, target, shape, parts, _ = item
-                amin = np.array(amin)  # writable (repair writes cells)
-                margins = np.asarray(margins, dtype=np.float64)
-                sum_max_abs = float(
-                    sum(np.abs(a).max(initial=0.0) for a in aligned)
+                if met.enabled:
+                    # per dispatch, not n_rows up front: a timeout
+                    # aborting this loop must not count dispatches
+                    # that were never issued
+                    met.inc("dpop.level_dispatches")
+                if pad.enabled:
+                    aligned = pad_util_parts(aligned, shape, pshape)
+                else:
+                    aligned = [
+                        np.asarray(a, dtype=np.float32)
+                        for a in aligned
+                    ]
+                amin, margins = fn(*aligned)
+                amin = np.asarray(amin)  # host pull before slicing
+                margins = np.asarray(margins)
+                dispatches[k] += 1
+                # slice the level-pack ghost cells away before
+                # certification: only the real region is decided here
+                region = tuple(slice(0, s) for s in shape[:-1])
+                amin = np.array(amin[region])  # writable (repair)
+                margins = np.asarray(
+                    margins[region], dtype=np.float64
                 )
-                certify_and_repair(
-                    name, parts, target, shape,
-                    amin, margins, sum_max_abs,
-                )
+                try:
+                    _certify_and_repair(
+                        name, parts, target, shape,
+                        amin, margins, sum_max_abs,
+                    )
+                except _PrecisionFallback:
+                    _host_redo(met, host_nodes, finish, item)
+                    continue
                 u = _exact_u_at(parts, target, shape, amin)
-                device_nodes += 1
-                finish(name, node, sep, u, amin)
-    return best_choice, util_cells, device_nodes, host_nodes
+                device_nodes[k] += 1
+                finish(k, name, node, sep, u, amin)
+    return [
+        (
+            best_choice[k], util_cells[k], device_nodes[k],
+            host_nodes[k], dispatches[k],
+        )
+        for k in range(K)
+    ]
+
+
+def _certify_and_repair(name, parts, target, shape,
+                        amin, margins, sum_max_abs):
+    """f32 argmin certificate + exact host repair of near-ties.
+
+    Inputs to the f32 join are exact (children's utils are exact
+    f64, see _exact_u_at), so |J32 − J| ≤ local_err and a margin
+    ≥ 2·local_err proves the f32 argmin is the true argmin.  The
+    bound scales with Σ_i max|part_i| (NOT max|J|): parts of
+    mixed sign can cancel in J while each carries rounding error
+    at its own magnitude.  Uncertifiable cells get their row
+    recomputed exactly.  Raises _PrecisionFallback only when the
+    table is so tie-heavy that per-cell repair would dominate
+    (symmetric problems — the device path is pointless there,
+    not unsound).
+    """
+    local_err = _EPS32 * (len(parts) + 1) * sum_max_abs
+    bad = np.argwhere(margins < 2.0 * local_err)
+    if len(bad) * 10 > margins.size:
+        raise _PrecisionFallback(
+            name, float(margins.min(initial=np.inf)),
+            2.0 * local_err,
+        )
+    for cell in map(tuple, bad):
+        row = np.zeros(shape[-1], dtype=np.float64)
+        for dims, table in parts:
+            row += _cell_slice(table, dims, target, cell)
+        amin[cell] = int(row.argmin())
+
+
+def _stack_bucket(n: int) -> int:
+    """Stack-height lattice for the vmapped level dispatches: pow-2 up
+    to 32, multiples of 32 above.  Pure pow-2 wastes up to 2x device
+    compute on ghost rows at large stacks (a K=8 solve_many group
+    stacks hundreds of leaves); the multiple-of-32 tail caps the
+    waste at one row block while keeping the number of distinct
+    leading dims — and so of kernel retraces — small and stable."""
+    if n <= 32:
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+    return -(-n // 32) * 32
+
+
+def _host_redo(met, host_nodes, finish, item):
+    """Tie-heavy table (>10% of cells uncertifiable — per-cell repair
+    would dominate): redo THIS node wholesale on host f64, the same
+    join the pure host path runs, and keep the sweep going.  Still
+    exact; the rest of the tree keeps its device results."""
+    k, name, node, sep, target, shape, parts, _ = item
+    if met.enabled:
+        met.inc("dpop.cert_fallbacks")
+    j = np.zeros(shape, dtype=np.float64)
+    for dims, table in parts:
+        j = j + _align(table, dims, target)
+    u = j.min(axis=-1)
+    amin = np.argmin(j, axis=-1)
+    host_nodes[k] += 1
+    finish(k, name, node, sep, u, amin)
+
+
+def _exact_u_at(parts, target, shape, amin, grids=None):
+    """Exact f64 u: evaluate the join only AT the chosen argmin,
+    u[cell] = Σ_parts part[cell, amin[cell]] — O(cells·parts)
+    instead of the full O(cells·d·parts) join, and exact because
+    every part (child utils included) is exact f64.  ``grids`` lets a
+    bucket-vectorized caller hoist the np.indices allocation (same
+    separator shape for every row of a stack)."""
+    own = target[-1]
+    if grids is None:
+        grids = np.indices(shape[:-1], dtype=np.intp)
+    u = np.zeros(shape[:-1], dtype=np.float64)
+    for dims, table in parts:
+        idx = []
+        for d in dims:
+            if d == own:
+                idx.append(amin)
+            else:
+                idx.append(grids[target.index(d)])
+        u += np.asarray(table, dtype=np.float64)[tuple(idx)]
+    return u
 
 
 # LRU-bounded: long-lived processes solving many DCOPs with varying
@@ -636,9 +1112,13 @@ def _join_kernel(
     part shapes) bucket; ``batched=True`` vmaps it over a leading
     node axis.  UTIL trees reuse structures heavily (every chain
     level, every leaf of a star), so each distinct bucket compiles
-    once, and a level's same-bucket nodes execute as one vmapped call
-    instead of the former per-node chain of eager jnp ops (VERDICT r2
-    weak #5 / item 7).
+    once, and a level's same-bucket nodes — from one instance or a
+    whole ``solve_many`` group — execute as one vmapped call instead
+    of a per-node dispatch chain.  With a level-pack ``pad_policy``
+    the shapes arriving here are already pow-2-quantized, so the
+    bucket count (= compile count, guarded by
+    ``tools/recompile_guard.py:run_dpop_guard``) stays small however
+    ragged the real separator shapes are.
     """
     key = (shape, part_shapes, batched)
     fn = _JOIN_KERNELS.get(key)

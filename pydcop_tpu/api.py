@@ -20,7 +20,11 @@ from pydcop_tpu.algorithms import (
 )
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
-from pydcop_tpu.ops.compile import compile_dcop
+
+# NOTE: ops.compile (and with it jax) is imported lazily inside the
+# functions that compile problems — importing pydcop_tpu.api must stay
+# light so CLI/bench cold starts don't pay the jax import before they
+# know they need a device (tests/test_import_time.py pins this).
 
 
 def solve(
@@ -292,6 +296,15 @@ def _solve_dispatch(
                 "n_restarts (best-of-K for stochastic solvers) does "
                 "not apply"
             )
+        if hasattr(module, "solve_host_many"):
+            # the level-batching capability marker (same check
+            # run_many_host uses): pad_policy buckets DPOP's UTIL
+            # level dispatches on the pow-2 lattice (level-pack keys,
+            # docs/performance.md "Level-synchronous DPOP") —
+            # results bit-identical
+            return module.solve_host(
+                dcop, params, timeout=timeout, pad_policy=pad_policy
+            )
         if as_pad_policy(pad_policy).enabled:
             raise ValueError(
                 f"{algo_name} runs on the host path and never "
@@ -299,6 +312,8 @@ def _solve_dispatch(
                 "apply"
             )
         return module.solve_host(dcop, params, timeout=timeout)
+
+    from pydcop_tpu.ops.compile import compile_dcop
 
     problem = compile_dcop(dcop, pad_policy=pad_policy)
     return _run_compiled(
@@ -663,9 +678,14 @@ def solve_many(
     composes: each instance runs K independent restarts inside the
     same program (axes ``[instance, restart, ...]``).
 
-    Host-path (exact) algorithms — DPOP, SyncBB — never compile the
-    whole problem: they fall back to one sequential host solve per
-    instance (``pad_policy`` does not apply there).
+    Host-path (exact) algorithms batch too when they support it: DPOP
+    instances sharing a bucket key merge their UTIL phases into ONE
+    level-synchronous device sweep (one vmapped join dispatch per
+    level-pack bucket, one compiled executable per bucket for the
+    whole group — ``engine.host_batch.run_many_host`` /
+    ``algorithms/dpop.py:solve_host_many``), with per-instance
+    results bit-identical to sequential solves.  SyncBB stays
+    sequential.
 
     ``timeout`` bounds the WHOLE call: groups share the budget, and a
     group that hits the remaining budget stops all its instances at a
@@ -750,28 +770,40 @@ def solve_many(
         )
         results: list = [None] * n
         if hasattr(module, "solve_host"):
-            # exact host-path algorithms: no compiled problem, no
-            # instance batching — one sequential host solve each
+            # exact host-path algorithms: same-bucket groups merge
+            # into one level-synchronous sweep when the algorithm
+            # supports it (DPOP solve_host_many); the rest solve
+            # sequentially.  host_batch is the jax-free split of
+            # engine.batched — a pure host run must not pay the jax
+            # import chain.
             if n_restarts != 1:
                 raise ValueError(
                     f"{algo_name} is an exact host-path algorithm — "
                     "n_restarts (best-of-K for stochastic solvers) "
                     "does not apply"
                 )
-            for i, d in enumerate(dcops):
-                remaining = (
+            from pydcop_tpu.engine.host_batch import run_many_host
+
+            host_dcops = [_load(d) for d in dcops]
+            # the deadline covers the WHOLE call, including the yaml
+            # loads above — hand run_many_host only what is left
+            results = run_many_host(
+                host_dcops,
+                module,
+                prepared,
+                timeout=(
                     None
                     if deadline is None
                     else max(deadline - _time.perf_counter(), 0.01)
-                )
-                res = module.solve_host(
-                    _load(d), prepared[i], timeout=remaining
-                )
-                res["instances_batched"] = 1
-                results[i] = res
+                ),
+                pad_policy=pad_policy,
+            )
         else:
             from pydcop_tpu.engine.batched import run_many_batched
-            from pydcop_tpu.ops.compile import stack_problems
+            from pydcop_tpu.ops.compile import (
+                compile_dcop,
+                stack_problems,
+            )
 
             # compile each distinct dcop once (repeated paths/objects
             # reuse the compiled arrays at several stack positions)
@@ -787,29 +819,13 @@ def solve_many(
 
             # partition by static (str/bool) param signature — statics
             # are baked into the compiled step, so instances can only
-            # share a runner when they agree on them
-            def _statics_sig(p):
-                return (
-                    tuple(
-                        sorted(
-                            (k, v)
-                            for k, v in p.items()
-                            if isinstance(v, (str, bool))
-                        )
-                    ),
-                    tuple(
-                        sorted(
-                            k
-                            for k, v in p.items()
-                            if not isinstance(v, (str, bool))
-                            and v is not None
-                        )
-                    ),
-                )
+            # share a runner when they agree on them (shared helper
+            # with the host path: engine.host_batch.statics_signature)
+            from pydcop_tpu.engine.host_batch import statics_signature
 
             partitions: Dict[Any, list] = {}
             for i, p in enumerate(prepared):
-                partitions.setdefault(_statics_sig(p), []).append(i)
+                partitions.setdefault(statics_signature(p), []).append(i)
 
             for part in partitions.values():
                 for stacked in stack_problems(

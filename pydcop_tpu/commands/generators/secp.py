@@ -42,6 +42,16 @@ def set_parser(subparsers) -> None:
         "rooms do — required for exact DPOP at scale",
     )
     p.add_argument(
+        "--zone_layout", choices=["random", "tiled"],
+        default="random",
+        help="'random': zone windows start anywhere (overlapping "
+        "windows chain the whole building into one deep band); "
+        "'tiled': windows align to disjoint zone_size blocks — "
+        "independent rooms, giving the wide shallow pseudo-forest "
+        "that DPOP's level-synchronous UTIL batching exploits "
+        "(docs/performance.md, 'Level-synchronous DPOP')",
+    )
+    p.add_argument(
         "--efficiency_weight", type=float, default=0.1,
         help="unary cost per emitted light level",
     )
@@ -85,7 +95,15 @@ def generate(args):
     for m in range(args.nb_models):
         arity = rnd.randint(1, min(args.model_arity, args.nb_lights))
         if zone and zone < args.nb_lights:
-            start = rnd.randrange(args.nb_lights - zone + 1)
+            if getattr(args, "zone_layout", "random") == "tiled":
+                # disjoint rooms: windows snap to zone_size blocks;
+                # ceil so a non-divisible nb_lights puts the tail
+                # lights in a final short room instead of leaving
+                # them model-free
+                n_blocks = -(-args.nb_lights // zone)
+                start = rnd.randrange(n_blocks) * zone
+            else:
+                start = rnd.randrange(args.nb_lights - zone + 1)
             pool = lights[start : start + zone]
             scope = rnd.sample(pool, min(arity, len(pool)))
         else:

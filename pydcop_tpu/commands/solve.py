@@ -112,14 +112,16 @@ def set_parser(subparsers) -> None:
         "--pad_policy", default="none", metavar="POLICY",
         help="bucket the compiled problem's array shapes ('pow2' or "
         "'pow2:<floor>') so similarly-sized problems reuse jitted "
-        "executables instead of recompiling (docs/performance.md); "
-        "default: none",
+        "executables instead of recompiling; for dpop it buckets the "
+        "UTIL level dispatches instead (level-pack keys — results "
+        "bit-identical, docs/performance.md); default: none",
     )
     p.add_argument(
         "--many", action="store_true",
         help="treat each DCOP FILE as a SEPARATE problem instance and "
         "solve them together (api.solve_many): same-shaped instances "
-        "batch into one vmapped device program — pass --pad_policy "
+        "batch into one vmapped device program — or, for dpop, one "
+        "merged level-synchronous UTIL sweep — pass --pad_policy "
         "pow2 so similarly-sized files land in the same shape bucket "
         "(docs/performance.md, 'Cross-instance batching').  Prints a "
         "JSON array of per-instance results.  Batched-engine (tpu) "

@@ -1,5 +1,31 @@
-from pydcop_tpu.engine.batched import (
-    RunResult,
-    run_batched,
-    run_many_batched,
-)
+"""``pydcop_tpu.engine`` — the execution engines.
+
+Re-exports are LAZY (PEP 562, same pattern as ``pydcop_tpu.ops``):
+:mod:`pydcop_tpu.engine.batched` imports jax at module level, and an
+eager re-export here would force that chain onto every consumer of
+the package — including the deliberately jax-free
+:mod:`pydcop_tpu.engine.host_batch` that ``api.solve_many`` uses for
+pure host-path runs (DPOP ``util_device="never"``, SyncBB).
+"""
+
+_BATCHED_EXPORTS = {
+    "RunResult",
+    "run_batched",
+    "run_many_batched",
+}
+
+__all__ = sorted(_BATCHED_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _BATCHED_EXPORTS:
+        import pydcop_tpu.engine.batched as _batched
+
+        return getattr(_batched, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
+
+def __dir__():
+    return sorted(__all__)

@@ -1165,3 +1165,13 @@ def run_many_batched(
             )
         )
     return results
+
+
+# statics_signature / run_many_host live in the jax-free
+# engine.host_batch module (api.solve_many's host branch must not pay
+# this module's jax import chain); re-exported here for the
+# engine.batched.* names used before the split.
+from pydcop_tpu.engine.host_batch import (  # noqa: E402
+    run_many_host,
+    statics_signature,
+)

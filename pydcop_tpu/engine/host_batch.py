@@ -1,0 +1,133 @@
+"""Cross-instance batching for the exact HOST-path algorithms.
+
+This module is deliberately jax-free: ``api.solve_many`` dispatches
+host-path algorithms (DPOP, SyncBB) here, and a pure host run — DPOP
+with ``util_device="never"``, or any SyncBB solve — must not pay the
+jax import chain that :mod:`pydcop_tpu.engine.batched` pulls at
+module level (~1.2s on CPU, far worse on a cold TPU image; the same
+budget ``tests/test_import_time.py`` pins for the API surface).  DPOP
+imports jax lazily only when its UTIL sweep actually goes to the
+device, so the whole host path stays light through this module.
+
+:mod:`pydcop_tpu.engine.batched` re-exports both names so existing
+``engine.batched.run_many_host`` references keep working.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import (
+    Any,
+    Dict,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+from pydcop_tpu.telemetry import get_metrics
+
+
+def statics_signature(params: Mapping[str, Any]) -> Tuple:
+    """Hashable grouping signature of an algorithm-params mapping for
+    cross-instance batching: the str/bool params (baked into compiled
+    steps — and into DPOP's merged level sweep — as statics) with
+    their values, plus the NAMES of the numeric params (which may
+    differ per instance inside a group: they ride the vmap as stacked
+    arrays on the device path, and per-instance thresholds on the
+    DPOP host path).  Instances may share a runner/sweep only when
+    their signatures agree — the partition predicate of
+    ``api.solve_many`` and :func:`run_many_host`."""
+    return (
+        tuple(
+            sorted(
+                (k, v)
+                for k, v in params.items()
+                if isinstance(v, (str, bool))
+            )
+        ),
+        tuple(
+            sorted(
+                k
+                for k, v in params.items()
+                if not isinstance(v, (str, bool)) and v is not None
+            )
+        ),
+    )
+
+
+def run_many_host(
+    dcops: Sequence[Any],
+    algo_module,
+    params_list: Sequence[Dict[str, Any]],
+    *,
+    timeout: Optional[float] = None,
+    pad_policy: Any = "none",
+) -> List[Dict[str, Any]]:
+    """``solve_many`` for the exact host-path algorithms.
+
+    Algorithms that publish ``solve_host_many`` (DPOP) get
+    cross-instance batching: instances partition by
+    :func:`statics_signature` and each partition runs ONE merged
+    level-synchronous sweep (``algorithms/dpop.py:solve_host_many``).
+    Executable sharing inside the sweep is by LEVEL-PACK key
+    (:func:`~pydcop_tpu.ops.padding.util_level_key`, the UTIL-phase
+    analogue of ``problem_group_key``): same-bucket joins — from one
+    instance or several — ride one vmapped dispatch and one compiled
+    kernel, and structurally different instances simply keep their
+    own buckets, so no pre-grouping pass is needed.  (An earlier
+    design grouped by ``problem_group_key`` over a throwaway
+    ``compile_dcop``; measured at K=8 x 512-var SECP that compile
+    cost ~0.4s — more than the grouping saved — so the sweep now
+    merges partitions directly.)  This replaces the old
+    one-sequential-solve-per-instance fallback.
+
+    Algorithms without ``solve_host_many`` (SyncBB) keep the
+    sequential path.  ``timeout`` bounds the whole call; each result
+    carries ``instances_batched`` (its merged-sweep size) and
+    ``time`` as an even share of its sweep's wall-clock, matching the
+    device path's contract.
+    """
+    t0 = time.perf_counter()
+    n = len(dcops)
+    results: List[Optional[Dict[str, Any]]] = [None] * n
+
+    def _remaining():
+        if timeout is None:
+            return None
+        return max(timeout - (time.perf_counter() - t0), 0.01)
+
+    if not hasattr(algo_module, "solve_host_many"):
+        for i, d in enumerate(dcops):
+            res = algo_module.solve_host(
+                d, params_list[i], timeout=_remaining()
+            )
+            res["instances_batched"] = 1
+            results[i] = res
+        return results  # type: ignore[return-value]
+
+    partitions: Dict[Tuple, List[int]] = {}
+    for i, p in enumerate(params_list):
+        partitions.setdefault(statics_signature(p), []).append(i)
+
+    met = get_metrics()
+    for group in partitions.values():
+        t_group = time.perf_counter()
+        group_results = algo_module.solve_host_many(
+            [dcops[i] for i in group],
+            [params_list[i] for i in group],
+            timeout=_remaining(),
+            pad_policy=pad_policy,
+        )
+        share = (time.perf_counter() - t_group) / len(group)
+        if met.enabled:
+            met.inc("engine.batch_groups")
+        for i, res in zip(group, group_results):
+            res["instances_batched"] = len(group)
+            # an even share of the sweep's wall-clock, like the
+            # device path: summing per-instance times over a sweep
+            # reflects the real cost of the merged call
+            res["time"] = share
+            results[i] = res
+    return results  # type: ignore[return-value]

@@ -1,42 +1,70 @@
-from pydcop_tpu.ops.compile import (
-    BIG,
-    ArityBucket,
-    CompiledProblem,
-    StackedProblem,
-    canonical_execution_problem,
-    compile_dcop,
-    compile_from_arrays,
-    decode_assignment,
-    enable_persistent_compilation_cache,
-    encode_assignment,
-    problem_group_key,
-    stack_problems,
-)
-from pydcop_tpu.ops.costs import (
-    local_cost_sweep,
-    neighbor_gather,
-    segment_sum_edges,
-    total_cost,
-)
-from pydcop_tpu.ops.padding import PadPolicy, as_pad_policy
+"""``pydcop_tpu.ops`` — the TPU compute path.
 
-__all__ = [
-    "BIG",
+Re-exports are LAZY (PEP 562): ``pydcop_tpu.ops.compile`` and
+``pydcop_tpu.ops.costs`` import jax at module level, and pulling them
+eagerly here put ~1.2s of jax import on every CLI/API cold start —
+the BENCH_r05 ``init`` stage burned its 90s budget "stuck in imports"
+on exactly this chain.  Importing :mod:`pydcop_tpu.ops` (or the
+jax-free :mod:`pydcop_tpu.ops.padding` submodule) now costs nothing;
+jax loads the first time a compile/cost symbol is actually touched.
+``tests/test_import_time.py`` pins this budget.
+
+``BIG`` and ``util_level_key`` are re-exported from
+:mod:`pydcop_tpu.ops.padding` directly (their canonical home) so
+reading them never forces the jax-heavy compiler module — DPOP's
+host path keys its level buckets without touching jax.
+"""
+
+from pydcop_tpu.ops.padding import (
+    BIG,
+    PadPolicy,
+    as_pad_policy,
+    util_level_key,
+)
+
+_COMPILE_EXPORTS = {
     "ArityBucket",
     "CompiledProblem",
     "StackedProblem",
-    "PadPolicy",
-    "as_pad_policy",
     "canonical_execution_problem",
     "compile_dcop",
     "compile_from_arrays",
     "decode_assignment",
     "enable_persistent_compilation_cache",
     "encode_assignment",
+    "problem_group_key",
+    "stack_problems",
+}
+_COSTS_EXPORTS = {
     "local_cost_sweep",
     "neighbor_gather",
-    "problem_group_key",
     "segment_sum_edges",
-    "stack_problems",
     "total_cost",
+}
+
+__all__ = [
+    "BIG",
+    "PadPolicy",
+    "as_pad_policy",
+    "util_level_key",
+    *sorted(_COMPILE_EXPORTS),
+    *sorted(_COSTS_EXPORTS),
 ]
+
+
+def __getattr__(name):
+    if name in _COMPILE_EXPORTS:
+        import pydcop_tpu.ops.compile as _compile
+
+        return getattr(_compile, name)
+    if name in _COSTS_EXPORTS:
+        import pydcop_tpu.ops.costs as _costs
+
+        return getattr(_costs, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
+
+def __dir__():
+    return sorted(__all__)

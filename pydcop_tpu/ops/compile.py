@@ -49,6 +49,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# jax is now definitely loaded: attach the telemetry backend-compile
+# listener before any compile can run.  session() itself skips the
+# registration while jax is absent so host-only runs never import it.
+from pydcop_tpu.telemetry.jit import ensure_backend_compile_listener
+
+ensure_backend_compile_listener()
+
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.dcop.objects import Variable
 from pydcop_tpu.dcop.relations import RelationProtocol
@@ -1352,6 +1359,18 @@ class StackedProblem:
     @property
     def n_instances(self) -> int:
         return len(self.host_problems)
+
+
+# Level-pack keys: the DPOP level-synchronous UTIL sweep buckets each
+# pseudo-tree level's joined-table shapes on the same pow-2 lattice the
+# problem compiler uses for whole-problem arrays (ops/padding.py).  The
+# key function itself is numpy-only and lives in ops.padding so the
+# host-path DPOP engines stay importable without jax; it is re-exported
+# here because it is the UTIL-phase analogue of
+# :func:`problem_group_key`: equal keys <=> one compiled join
+# executable (``algorithms/dpop.py:_join_kernel``), the same
+# key-equality-is-cache-identity contract the runner cache keys follow.
+from pydcop_tpu.ops.padding import util_level_key  # noqa: E402,F401
 
 
 def problem_group_key(problem: CompiledProblem):

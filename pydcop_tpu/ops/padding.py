@@ -93,6 +93,99 @@ class PadPolicy:
 
 NO_PADDING = PadPolicy()
 
+# UTIL-table axes are DOMAIN-sized (a handful of values), not
+# problem-sized: bucketing them against ``PadPolicy.floor`` (16) would
+# inflate a d=5 axis 3x per dimension.  Level-pack keys therefore
+# quantize axes against this much smaller floor — the bucket lattice
+# for a d=5 domain is 5 -> 8, a ~1.6x per-axis pad that buys shape
+# sharing across every level of the pseudo-tree (and across
+# instances) instead of one compiled join kernel per exact shape.
+UTIL_AXIS_FLOOR = 2
+
+
+def bucket_util_shape(
+    shape: Sequence[int], policy: PadPolicy
+) -> tuple:
+    """Quantize a UTIL joined-table shape axis-wise to the policy's
+    pow-2 lattice (floor :data:`UTIL_AXIS_FLOOR`).  Identity under
+    ``NO_PADDING``."""
+    if not policy.enabled:
+        return tuple(shape)
+    return tuple(policy.bucket(s, UTIL_AXIS_FLOOR) for s in shape)
+
+
+def util_level_key(
+    shape: Sequence[int],
+    part_shapes: Sequence[Sequence[int]],
+    policy: PadPolicy,
+) -> tuple:
+    """Level-pack bucket key for one DPOP UTIL join: the PADDED
+    ``(joined shape, aligned part shapes)`` pair.
+
+    Two nodes (of one pseudo-tree level or of different instances in a
+    ``solve_many`` group) with equal keys execute as rows of ONE
+    vmapped join dispatch and share one compiled executable
+    (``algorithms/dpop.py:_join_kernel``).  Under ``NO_PADDING`` the
+    key is the exact shapes — today's one-bucket-per-shape behavior;
+    with a pow-2 policy, near-miss shapes land on the same lattice
+    point so a level needs far fewer distinct kernels.
+
+    Part axes of size 1 are broadcast axes and stay 1; real axes pad
+    to the joined shape's bucket.  When the policy is enabled the key
+    appends the shape of the ghost-guard MASK part (a row over the own
+    axis: 0 on real values, +inf on padded ones) that
+    :func:`pad_util_parts` adds so no argmin can land in a ghost cell
+    — the mask is part of the kernel signature.
+    """
+    pshape = bucket_util_shape(shape, policy)
+    pparts = tuple(
+        tuple(
+            1 if s == 1 else pshape[i] for i, s in enumerate(ps)
+        )
+        for ps in part_shapes
+    )
+    if policy.enabled:
+        mask_shape = (1,) * (len(pshape) - 1) + (pshape[-1],)
+        pparts = pparts + (mask_shape,)
+    return (pshape, pparts)
+
+
+def pad_util_parts(
+    aligned: Sequence[np.ndarray],
+    shape: Sequence[int],
+    pshape: Sequence[int],
+) -> list:
+    """Zero-pad aligned f32 UTIL parts up to the level-pack bucket and
+    append the own-axis ghost mask (0 on real values, +inf on padded
+    ones).
+
+    Real cells compute BIT-IDENTICALLY to the unpadded join: zero
+    pads only fill cells outside the real region (sliced away by the
+    caller), and adding the mask's exact 0.0 to a finite f32 is
+    exact, so the certificate's error bound is unchanged.  The +inf
+    own-axis guard keeps every argmin/second-best inside the real
+    domain."""
+    out = []
+    for a in aligned:
+        target = tuple(
+            1 if s == 1 else pshape[i] for i, s in enumerate(a.shape)
+        )
+        if target == a.shape:
+            # f64 inputs cast here so every returned part is kernel-
+            # ready f32 (callers pass exact f64 aligned parts)
+            out.append(np.asarray(a, dtype=np.float32))
+        else:  # zeros + slice-assign: ~5x cheaper than np.pad,
+            # and the assignment casts f64 -> f32 in the same pass
+            b = np.zeros(target, dtype=np.float32)
+            b[tuple(slice(0, s) for s in a.shape)] = a
+            out.append(b)
+    mask = np.zeros(
+        (1,) * (len(pshape) - 1) + (pshape[-1],), dtype=np.float32
+    )
+    mask[..., shape[-1]:] = np.inf
+    out.append(mask)
+    return out
+
 
 # -- ghost construction (the ONE definition of the padding contract) ---
 #

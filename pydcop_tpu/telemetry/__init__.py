@@ -140,10 +140,19 @@ def session(
         yield reuse
         return
     # mirror XLA backend-compile durations into this session (no-op on
-    # jax versions without jax.monitoring, or when jax is absent)
-    from pydcop_tpu.telemetry.jit import ensure_backend_compile_listener
+    # jax versions without jax.monitoring).  Only when jax is ALREADY
+    # loaded: a session must not be the thing that pays the jax import
+    # — pure host-path runs (DPOP util_device="never", SyncBB) stay
+    # jax-free.  The device path loses nothing: ops.compile registers
+    # the listener itself at import, before any compile can happen.
+    import sys as _sys
 
-    ensure_backend_compile_listener()
+    if "jax" in _sys.modules:
+        from pydcop_tpu.telemetry.jit import (
+            ensure_backend_compile_listener,
+        )
+
+        ensure_backend_compile_listener()
     try:
         yield sess
     finally:

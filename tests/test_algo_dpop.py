@@ -232,7 +232,9 @@ def test_dpop_device_util_matches_host(seed):
 
 def test_dpop_device_util_falls_back_on_exact_ties():
     """Symmetric problems have zero decision margins: the certificate
-    fails and the whole UTIL phase must restart on host f64."""
+    fails and each tie-heavy node is redone wholesale on host f64 —
+    per NODE, so the sweep (and any healthy node's device result)
+    keeps going instead of restarting the whole phase."""
     dom = Domain("c", "", [0, 1, 2])
     dcop = DCOP("sym")
     ws = [Variable(f"w{i}", dom) for i in range(6)]
@@ -243,7 +245,7 @@ def test_dpop_device_util_falls_back_on_exact_ties():
             NAryMatrixRelation([ws[i - 1], ws[i]], np.eye(3), name=f"e{i}")
         )
     r = solve(dcop, "dpop", {"util_device": "always"})
-    assert r["util_backend"] == "host"  # fell back
+    assert r["util_host_nodes"] > 0  # the tie-heavy joins fell back
     assert r["cost"] == 0  # and stayed exact
 
 

@@ -1,0 +1,319 @@
+"""Level-synchronous DPOP: exactness parity of the batched device
+UTIL path against the per-node host f64 oracle, with and without
+level-pack padding, single-instance and through ``solve_many``.
+
+The contract under test is BIT-IDENTITY, not approximate equality:
+DPOP is exact, the device path is certificate-guarded, and level
+batching / pow-2 padding / cross-instance merging only change which
+rows ride one dispatch — never a decided value (see
+``algorithms/dpop.py`` module docstring).
+"""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.api import solve, solve_many
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.ops.padding import (
+    NO_PADDING,
+    as_pad_policy,
+    pad_util_parts,
+    util_level_key,
+)
+
+pytestmark = pytest.mark.dpop
+
+# every joined table goes through the device path (and its
+# certificate), however small — the batching logic is what's under
+# test, not the auto threshold
+DEVICE = {"util_device": "always"}
+HOST = {"util_device": "never"}
+
+
+def random_tree_dcop(n, d, seed, extra_edges=0):
+    """Random tree + a few back edges (keeps induced width small but
+    exercises pseudo-parents and ragged separator shapes)."""
+    rng = np.random.RandomState(seed)
+    dom = Domain("dom", "", list(range(d)))
+    dcop = DCOP(f"tree{seed}")
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(1, n):
+        j = rng.randint(0, i)
+        m = rng.uniform(0, 10, (d, d)).round(3)
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[j], vs[i]], m, name=f"t{j}_{i}")
+        )
+    for k in range(extra_edges):
+        i, j = rng.choice(n, size=2, replace=False)
+        m = rng.uniform(0, 5, (d, d)).round(3)
+        dcop.add_constraint(
+            NAryMatrixRelation(
+                [vs[min(i, j)], vs[max(i, j)]], m, name=f"x{k}"
+            )
+        )
+    return dcop
+
+
+def mixed_arity_dcop(seed):
+    """Unary + binary + ternary constraints over mixed domain sizes."""
+    rng = np.random.RandomState(seed)
+    d2 = Domain("d2", "", [0, 1])
+    d3 = Domain("d3", "", [0, 1, 2])
+    d4 = Domain("d4", "", [0, 1, 2, 3])
+    dcop = DCOP(f"mixed{seed}")
+    vs = [
+        Variable("a", d3), Variable("b", d2), Variable("c", d4),
+        Variable("e", d3), Variable("f", d2), Variable("g", d3),
+    ]
+    for v in vs:
+        dcop.add_variable(v)
+
+    def rel(name, scope):
+        shape = tuple(len(v.domain) for v in scope)
+        dcop.add_constraint(
+            NAryMatrixRelation(
+                scope, rng.uniform(0, 8, shape).round(3), name=name
+            )
+        )
+
+    rel("u0", [vs[0]])
+    rel("p0", [vs[0], vs[1]])
+    rel("p1", [vs[1], vs[2]])
+    rel("p2", [vs[3], vs[4]])
+    rel("t0", [vs[0], vs[1], vs[2]])
+    rel("t1", [vs[3], vs[4], vs[5]])
+    rel("p3", [vs[0], vs[3]])
+    return dcop
+
+
+def assert_identical(r1, r2):
+    """Bit-identical solve results: same assignment, same cost."""
+    assert r1["assignment"] == r2["assignment"]
+    assert r1["cost"] == r2["cost"]
+    assert r1["status"] == r2["status"] == "finished"
+
+
+# -- single instance: device level path vs host f64 oracle -------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_level_batched_matches_host_f64_random_trees(seed):
+    dcop = random_tree_dcop(12, 3, seed, extra_edges=2)
+    r_host = solve(dcop, "dpop", HOST)
+    r_level = solve(dcop, "dpop", DEVICE)
+    r_padded = solve(dcop, "dpop", DEVICE, pad_policy="pow2")
+    assert_identical(r_level, r_host)
+    assert_identical(r_padded, r_host)
+    assert r_level["util_backend"] == "device"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_per_node_dispatch_matches_level_batched(seed):
+    """util_batch='node' (the bench baseline) is the same math as the
+    level-synchronous default — only the dispatch granularity
+    differs, visible in util_dispatches."""
+    dcop = random_tree_dcop(14, 3, seed, extra_edges=1)
+    r_node = solve(dcop, "dpop", dict(DEVICE, util_batch="node"))
+    r_level = solve(dcop, "dpop", dict(DEVICE, util_batch="level"))
+    assert_identical(r_node, r_level)
+    assert r_node["util_dispatches"] >= r_level["util_dispatches"]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_mixed_arity_parity(seed):
+    dcop = mixed_arity_dcop(seed)
+    r_host = solve(dcop, "dpop", HOST)
+    for params, pad in (
+        (DEVICE, "none"),
+        (DEVICE, "pow2"),
+        (dict(DEVICE, util_batch="node"), "pow2:4"),
+    ):
+        r = solve(dcop, "dpop", params, pad_policy=pad)
+        assert_identical(r, r_host)
+
+
+def test_tie_heavy_symmetric_falls_back_exact():
+    """A fully symmetric problem has margin-0 everywhere: the
+    certificate refuses the device result (>10% uncertifiable) and
+    each tie-heavy node is redone wholesale on host f64 — per node,
+    still exact, counted in dpop.cert_fallbacks, identical under
+    padding."""
+    from pydcop_tpu.telemetry import session
+
+    dom = Domain("d", "", [0, 1, 2])
+    dcop = DCOP("sym")
+    vs = [Variable(f"v{i}", dom) for i in range(6)]
+    for v in vs:
+        dcop.add_variable(v)
+    flat = np.ones((3, 3))  # every row constant: margin 0 everywhere
+    for i in range(5):
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[i], vs[i + 1]], flat, name=f"c{i}")
+        )
+    r_host = solve(dcop, "dpop", HOST)
+    with session() as tel:
+        r_dev = solve(dcop, "dpop", DEVICE, pad_policy="pow2")
+    assert_identical(r_dev, r_host)
+    assert r_dev["util_host_nodes"] > 0  # tie-heavy joins fell back
+    assert (
+        tel.summary()["counters"].get("dpop.cert_fallbacks", 0) >= 1
+    )
+
+
+# -- solve_many: merged level sweep vs K sequential solves -------------
+
+
+def chain_dcop(n, d, seed):
+    """Identical structure across seeds (a path), random tables — the
+    canonical one-bucket ``solve_many`` group."""
+    rng = np.random.RandomState(seed)
+    dom = Domain("dom", "", list(range(d)))
+    dcop = DCOP(f"chain{seed}")
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(1, n):
+        m = rng.uniform(0, 10, (d, d)).round(3)
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[i - 1], vs[i]], m, name=f"t{i}")
+        )
+    return dcop
+
+
+def test_solve_many_matches_sequential_same_bucket():
+    """K same-bucket instances merge into one sweep with bit-identical
+    per-instance results; the telemetry counters record the merge."""
+    from pydcop_tpu.telemetry import session
+
+    dcops = [chain_dcop(10, 3, 100 + s) for s in range(5)]
+    with session() as tel:
+        many = solve_many(dcops, "dpop", DEVICE)
+    counters = tel.summary()["counters"]
+    assert counters.get("dpop.instances_batched") == 5
+    assert counters.get("engine.batch_groups") == 1
+    assert counters.get("dpop.level_dispatches", 0) >= 1
+    for i, d in enumerate(dcops):
+        seq = solve(d, "dpop", DEVICE)
+        assert many[i]["assignment"] == seq["assignment"]
+        assert many[i]["cost"] == seq["cost"]
+        assert many[i]["instances_batched"] == 5
+
+
+def test_solve_many_mixed_buckets_split_groups():
+    """Structurally different instances split into separate merged
+    groups (problem_group_key), each still exact."""
+    dcops = [
+        random_tree_dcop(8, 3, 1),
+        mixed_arity_dcop(2),
+        random_tree_dcop(8, 3, 3),
+    ]
+    many = solve_many(dcops, "dpop", DEVICE, pad_policy="none")
+    for i, d in enumerate(dcops):
+        seq = solve(d, "dpop", DEVICE)
+        assert many[i]["assignment"] == seq["assignment"]
+        assert many[i]["cost"] == seq["cost"]
+
+
+def test_solve_many_tie_heavy_instance_rides_alone():
+    """A tie-heavy instance in a group has its uncertifiable nodes
+    redone on host f64 without disturbing the other instances'
+    merged device results."""
+    dom = Domain("d", "", [0, 1, 2])
+    sym = DCOP("sym")
+    vs = [Variable(f"v{i}", dom) for i in range(10)]
+    for v in vs:
+        sym.add_variable(v)
+    flat = np.ones((3, 3))  # margin 0 everywhere: certificate refuses
+    for i in range(9):
+        sym.add_constraint(
+            NAryMatrixRelation([vs[i], vs[i + 1]], flat, name=f"c{i}")
+        )
+    rnd = chain_dcop(10, 3, 7)
+    many = solve_many([sym, rnd], "dpop", DEVICE)
+    for i, d in enumerate([sym, rnd]):
+        seq = solve(d, "dpop", DEVICE)
+        assert many[i]["assignment"] == seq["assignment"]
+        assert many[i]["cost"] == seq["cost"]
+    assert many[0]["util_host_nodes"] > 0  # tie-heavy joins redone
+    assert many[1]["util_host_nodes"] == 0  # healthy instance all-device
+
+
+def test_solve_many_memory_bound_instance_solves_sequentially():
+    """memory_bound (MB-DPOP conditioning) instances can't ride the
+    merged sweep — they run the sequential path inside the same call,
+    exact either way."""
+    dcops = [
+        random_tree_dcop(9, 3, 11),
+        random_tree_dcop(9, 3, 12),
+    ]
+    many = solve_many(
+        dcops, "dpop",
+        [dict(DEVICE), dict(DEVICE, memory_bound=27)],
+    )
+    for i, (d, p) in enumerate(
+        zip(dcops, [dict(DEVICE), dict(DEVICE, memory_bound=27)])
+    ):
+        seq = solve(d, "dpop", p)
+        assert many[i]["assignment"] == seq["assignment"]
+        assert many[i]["cost"] == seq["cost"]
+
+
+# -- level-pack keys / padding helpers ---------------------------------
+
+
+def test_util_level_key_identity_without_padding():
+    key = util_level_key((5, 3), ((5, 3), (1, 3)), NO_PADDING)
+    assert key == ((5, 3), ((5, 3), (1, 3)))
+
+
+def test_util_level_key_quantizes_near_miss_shapes():
+    pol = as_pad_policy("pow2")
+    k1 = util_level_key((5, 5), ((5, 5), (1, 5)), pol)
+    k2 = util_level_key((6, 7), ((6, 7), (1, 7)), pol)
+    assert k1 == k2  # both land on the (8, 8) lattice point
+    # broadcast axes stay 1; the own-axis mask is part of the key
+    pshape, pparts = k1
+    assert pshape == (8, 8)
+    assert pparts == ((8, 8), (1, 8), (1, 8))
+
+
+def test_pad_util_parts_mask_guards_ghost_cells():
+    pol = as_pad_policy("pow2")
+    aligned = [
+        np.ones((5, 5), dtype=np.float32),
+        np.ones((1, 5), dtype=np.float32),
+    ]
+    pshape, _ = util_level_key((5, 5), [a.shape for a in aligned], pol)
+    padded = pad_util_parts(aligned, (5, 5), pshape)
+    assert [p.shape for p in padded] == [(8, 8), (1, 8), (1, 8)]
+    # real region untouched, ghost cells zero
+    assert np.array_equal(padded[0][:5, :5], aligned[0])
+    assert np.all(padded[0][5:, :] == 0) and np.all(
+        padded[0][:, 5:] == 0
+    )
+    # mask: exact 0 on real own values, +inf on padded ones
+    mask = padded[-1]
+    assert np.all(mask[..., :5] == 0.0)
+    assert np.all(np.isinf(mask[..., 5:]))
+
+
+def test_dpop_counters_absent_without_session():
+    """No telemetry session ⇒ the counters are a no-op (the hot-path
+    contract of the metrics registry)."""
+    dcop = random_tree_dcop(8, 3, 42)
+    r = solve(dcop, "dpop", DEVICE)  # must not raise
+    assert r["status"] == "finished"
+
+
+def test_dpop_agents_unaffected():
+    """Agent declarations ride along untouched (solve ignores them on
+    the DPOP path; regression for result-schema drift)."""
+    dcop = random_tree_dcop(6, 3, 5)
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(6)])
+    r = solve(dcop, "dpop", DEVICE, pad_policy="pow2")
+    assert set(r["assignment"]) == {f"v{i}" for i in range(6)}
+    assert r["util_dispatches"] >= 1

@@ -1,0 +1,97 @@
+"""Cold-start budget: ``import pydcop_tpu`` (and the embedding/CLI
+surfaces) must stay light.
+
+BENCH_r05 lost its entire ``init`` stage (2 x 90s) "stuck in imports":
+on the TPU image, pulling jax costs tens of seconds, and the package
+used to pull it eagerly through ``pydcop_tpu.ops``.  The import chain
+is now lazy — ``pydcop_tpu``, ``pydcop_tpu.api`` and the CLI parser
+import without jax (it loads on first compile/solve) — and these
+tests pin that property plus a generous wall-clock budget so a stray
+module-level import fails CI instead of the next bench round.
+
+Budgets are wall-clock in a fresh subprocess.  Recorded on this CPU
+image: ``import pydcop_tpu`` ~0.2s, ``import pydcop_tpu.api`` ~0.35s
+(both jax-free).  The budget is ~10x the recording — it exists to
+catch "somebody re-imported jax at module level" (an order-of-
+magnitude regression), not scheduler noise.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+# ~10x the recorded cold-start on this image; a jax pull blows well
+# past this on any hardware this repo targets
+IMPORT_BUDGET_SECONDS = 3.0
+
+
+def _run(code: str) -> str:
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout.strip()
+
+
+def test_package_import_within_budget_and_jax_free():
+    dt = float(
+        _run(
+            "import sys, time; t0 = time.perf_counter(); "
+            "import pydcop_tpu; "
+            "assert 'jax' not in sys.modules, 'package pulls jax'; "
+            "assert 'numpy' not in sys.modules, 'package pulls numpy'; "
+            "print(time.perf_counter() - t0)"
+        )
+    )
+    assert dt < IMPORT_BUDGET_SECONDS, (
+        f"import pydcop_tpu took {dt:.2f}s (budget "
+        f"{IMPORT_BUDGET_SECONDS}s) — a heavy module-level import "
+        "crept back in; see -X importtime"
+    )
+
+
+def test_api_import_defers_jax():
+    """The embedding surface (api.solve & co) compiles lazily — the
+    jax import must not run until a problem is actually compiled."""
+    _run(
+        "import sys; import pydcop_tpu.api; "
+        "assert 'jax' not in sys.modules, "
+        "'pydcop_tpu.api pulls jax at import time'"
+    )
+
+
+def test_cli_parser_defers_jax():
+    """``pydcop_tpu --help`` class startup: building the full parser
+    (which imports every commands/ module) must stay jax-free."""
+    _run(
+        "import sys; from pydcop_tpu.cli import build_parser; "
+        "build_parser(); "
+        "assert 'jax' not in sys.modules, "
+        "'a commands/ module pulls jax at import time'"
+    )
+
+
+def test_ops_padding_is_jax_free():
+    """The host-path DPOP engines import ops.padding (level-pack
+    keys) at module level — it must never grow a jax dependency."""
+    _run(
+        "import sys; from pydcop_tpu.ops.padding import "
+        "util_level_key, pad_util_parts, as_pad_policy; "
+        "assert 'jax' not in sys.modules"
+    )
+
+
+def test_ops_lazy_reexports_still_resolve():
+    """PEP 562 laziness must not break the public ``pydcop_tpu.ops``
+    surface: every advertised symbol resolves (pulling jax is fine
+    HERE — this is the moment it's supposed to load)."""
+    import pydcop_tpu.ops as ops
+
+    for name in ops.__all__:
+        assert getattr(ops, name) is not None, name
+    with pytest.raises(AttributeError):
+        ops.definitely_not_a_symbol

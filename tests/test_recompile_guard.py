@@ -61,5 +61,24 @@ def test_recompile_guard_detects_overrun(monkeypatch):
     assert not report["ok"]
 
 
+@pytest.mark.dpop
+def test_dpop_guard_within_budget():
+    """Level-batched DPOP through solve_many: one merged group, each
+    level-bucket join executable compiled exactly once (zero compiles
+    on an identical second call), results bit-identical to sequential
+    solves — see tools/recompile_guard.py:run_dpop_guard."""
+    guard = _load_guard()
+    report = guard.run_dpop_guard()
+    assert report["ok"], report
+    assert report["jit_compiles"] <= guard.DPOP_BUDGET, report
+    assert report["jit_compiles"] >= 1, report  # guard actually ran
+    assert report["second_call_compiles"] == 0, report
+    assert report["batch_groups"] == 1, report
+    assert report["instances_batched"] == guard.DPOP_K, report
+    # the merged sweep must actually batch: far fewer dispatches than
+    # the K * n_nodes a per-node walk would pay
+    assert report["level_dispatches"] < guard.DPOP_K * 10, report
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

@@ -202,13 +202,33 @@ def test_solve_many_static_params_split_groups():
     assert [r["instances_batched"] for r in many] == [1, 1]
 
 
-def test_solve_many_host_path_fallback_dpop():
-    """Exact host-path algorithms run sequentially but keep the
-    per-instance result contract (bit-identical to solve)."""
+def test_solve_many_host_path_dpop_batches():
+    """DPOP rides solve_many too now: same-bucket instances merge
+    their UTIL phases into one level-synchronous sweep
+    (``engine.run_many_host`` → ``dpop.solve_host_many``), keeping the
+    per-instance result contract bit-identical to solve.  Deeper
+    coverage in tests/test_dpop_level.py and the tier-1 dpop
+    recompile guard."""
     dcops = [ring_dcop(4), ring_dcop(5)]
-    many = solve_many(dcops, "dpop")
+    with session() as tel:
+        many = solve_many(dcops, "dpop")
     for i, dcop in enumerate(dcops):
         seq = solve(dcop, "dpop")
+        assert many[i]["assignment"] == seq["assignment"]
+        assert many[i]["cost"] == seq["cost"]
+    # pow2 padding (the solve_many default) buckets both rings onto
+    # one group key, so the two instances merged
+    assert [r["instances_batched"] for r in many] == [2, 2]
+    assert tel.summary()["counters"].get("dpop.instances_batched") == 2
+
+
+def test_solve_many_host_path_fallback_syncbb():
+    """Host-path algorithms WITHOUT a merged sweep (SyncBB) keep the
+    sequential per-instance path."""
+    dcops = [ring_dcop(4), ring_dcop(4)]
+    many = solve_many(dcops, "syncbb")
+    for i, dcop in enumerate(dcops):
+        seq = solve(dcop, "syncbb")
         assert many[i]["assignment"] == seq["assignment"]
         assert many[i]["cost"] == seq["cost"]
         assert many[i]["instances_batched"] == 1

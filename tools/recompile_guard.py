@@ -18,7 +18,9 @@ Run standalone (prints one JSON line, exit 1 when over budget):
     python tools/recompile_guard.py
 
 or via the tier-1 suite: ``tests/test_recompile_guard.py`` imports
-:func:`run_guard` directly.
+:func:`run_guard` (dynamic solve), :func:`run_many_guard`
+(cross-instance vmap batching) and :func:`run_dpop_guard`
+(level-batched DPOP through ``solve_many``) directly.
 
 ``BUDGET`` is the recorded compile count of the canned scenario: one
 chunk-runner compile in segment 1, zero afterwards.  Raise it only
@@ -49,6 +51,17 @@ ROUNDS = 56
 MANY_BUDGET = 1
 MANY_ROUNDS = 48
 MANY_K = 4
+
+# level-batched DPOP through solve_many: K same-bucket SECP instances
+# merge their UTIL phases into one level-synchronous sweep, and each
+# distinct level-pack bucket (padded joined/part shapes, ops.padding.
+# util_level_key) compiles its join executable EXACTLY ONCE for the
+# whole group.  DPOP_BUDGET is the recorded distinct-bucket compile
+# count of the canned scenario; the zero-recompile second call is the
+# "exactly once" half of the property.  K compiles-per-instance (or K
+# groups) = the de-batching regression this guards.
+DPOP_K = 8
+DPOP_BUDGET = 5
 
 
 def _build_dcop():
@@ -239,6 +252,130 @@ def run_many_guard() -> dict:
     return report
 
 
+def _build_secp(n_lights: int, n_models: int, levels: int, seed: int):
+    """A fixed-STRUCTURE smart-lighting SECP: deterministic model
+    scopes (consecutive 3-light windows) so every seed compiles to
+    byte-identical array shapes — one ``problem_group_key`` bucket —
+    while targets/rules vary per seed (the data genuinely differs, so
+    parity below is not comparing identical solves)."""
+    import itertools
+    import random
+
+    import numpy as np
+
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rnd = random.Random(seed)
+    dcop = DCOP(f"secp_guard_{n_lights}_{seed}")
+    lum = Domain("lum", "", list(range(levels)))
+    lights = [Variable(f"l{i}", lum) for i in range(n_lights)]
+    for i, v in enumerate(lights):
+        dcop.add_variable(v)
+        dcop.add_constraint(
+            NAryMatrixRelation(
+                [v],
+                np.arange(levels, dtype=np.float64)
+                * rnd.uniform(0.05, 0.2),
+                name=f"eff_{i}",
+            )
+        )
+    for m in range(n_models):
+        scope = lights[m % (n_lights - 2):][:3]
+        target = rnd.uniform(0.3, 1.0) * 3 * (levels - 1)
+        matrix = np.zeros((levels,) * 3, dtype=np.float64)
+        for idx in itertools.product(range(levels), repeat=3):
+            matrix[idx] = abs(sum(idx) - target)
+        dcop.add_constraint(
+            NAryMatrixRelation(scope, matrix, name=f"mod{m}")
+        )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n_lights)])
+    return dcop
+
+
+def run_dpop_guard() -> dict:
+    """Compile budget for level-batched DPOP through ``solve_many``:
+    K same-bucket SECP instances must (1) group into ONE merged
+    level-synchronous sweep, (2) compile at most ``DPOP_BUDGET``
+    distinct level-bucket join executables, (3) compile each bucket
+    EXACTLY ONCE — a second identical call does ZERO new compiles —
+    and (4) return per-instance results bit-identical to sequential
+    solves.  Regressions this catches: a group-key split silently
+    de-batching to K sweeps, level-pack keys churning per instance or
+    per call (compile storm), and any batching-induced result drift
+    in the exact solver."""
+    from pydcop_tpu.algorithms import dpop
+    from pydcop_tpu.api import solve, solve_many
+    from pydcop_tpu.telemetry import session
+
+    # cold start for the join-kernel cache, same reason as the chunk
+    # runner guards: warm kernels would hide (or fake) compiles
+    dpop._JOIN_KERNELS.clear()
+
+    dcops = [
+        _build_secp(10, 8, 3, seed=20 + i) for i in range(DPOP_K)
+    ]
+    params = {"util_device": "always"}
+    with session() as tel:
+        results = solve_many(
+            dcops, "dpop", params, pad_policy="pow2:16"
+        )
+    counters = tel.summary()["counters"]
+    with session() as tel2:
+        solve_many(dcops, "dpop", params, pad_policy="pow2:16")
+    recompiles = int(tel2.summary()["counters"].get("jit.compiles", 0))
+
+    jit_compiles = int(counters.get("jit.compiles", 0))
+    groups = int(counters.get("engine.batch_groups", 0))
+    instances = int(counters.get("dpop.instances_batched", 0))
+    report = {
+        "jit_compiles": jit_compiles,
+        "budget": DPOP_BUDGET,
+        "ok": jit_compiles <= DPOP_BUDGET,
+        "second_call_compiles": recompiles,
+        "batch_groups": groups,
+        "instances_batched": instances,
+        "level_dispatches": int(
+            counters.get("dpop.level_dispatches", 0)
+        ),
+        "cert_fallbacks": int(counters.get("dpop.cert_fallbacks", 0)),
+        "costs": [r["cost"] for r in results],
+    }
+    if recompiles != 0:
+        report["ok"] = False
+        report["error"] = (
+            f"{recompiles} new compile(s) on an identical second "
+            "solve_many — level-pack keys are churning instead of "
+            "compiling each bucket exactly once"
+        )
+    if groups != 1 or instances != DPOP_K:
+        report["ok"] = False
+        report["error"] = (
+            f"expected 1 merged group of {DPOP_K} instances, got "
+            f"{groups} group(s) / {instances} instance(s) — DPOP "
+            "cross-instance batching silently degraded"
+        )
+    # exactness: the merged sweep must be bit-identical to the
+    # sequential per-instance solves (DPOP is an exact algorithm —
+    # ANY divergence is a correctness bug, not noise)
+    for i, d in enumerate(dcops):
+        seq = solve(d, "dpop", params, pad_policy="pow2:16")
+        if (
+            seq["cost"] != results[i]["cost"]
+            or seq["assignment"] != results[i]["assignment"]
+        ):
+            report["ok"] = False
+            report["error"] = (
+                f"instance {i}: merged-sweep result diverges from "
+                f"the sequential solve (cost {results[i]['cost']} vs "
+                f"{seq['cost']}) — level batching corrupted the "
+                "exact UTIL math"
+            )
+            break
+    return report
+
+
 def main() -> int:
     import jax
 
@@ -247,8 +384,21 @@ def main() -> int:
     jax.config.update("jax_platforms", "cpu")
     report = run_guard()
     report_many = run_many_guard()
-    print(json.dumps({"dynamic": report, "solve_many": report_many}))
-    return 0 if report["ok"] and report_many["ok"] else 1
+    report_dpop = run_dpop_guard()
+    print(
+        json.dumps(
+            {
+                "dynamic": report,
+                "solve_many": report_many,
+                "dpop": report_dpop,
+            }
+        )
+    )
+    return (
+        0
+        if report["ok"] and report_many["ok"] and report_dpop["ok"]
+        else 1
+    )
 
 
 if __name__ == "__main__":
