@@ -93,7 +93,21 @@ MANY_VARS = 32  # sizes MANY_VARS-6 .. MANY_VARS: one pow2 bucket
 MANY_ROUNDS = 256
 MANY_CHUNK = 64
 
-# dpop_secp stage (BASELINE.md config 4, evidence row
+# supervised_overhead stage (ISSUE 6 acceptance): the supervised
+# device-dispatch layer (engine/supervisor.py) wraps EVERY chunk
+# dispatch of the hot loops — closure + per-scope seq + the NaN screen
+# on the host-side cost trace.  This stage measures that no-fault tax
+# on the dsa/maxsum hot loops: median msgs/sec under the default
+# supervisor vs the UNSUPERVISED baseline (bare dispatch, no
+# screening), interleaved reps.  Bound: < 2% overhead.  Sized so the
+# per-chunk supervisor cost is measured against a realistic chunk
+# runtime, not drowned by it (smaller than north-star => the reported
+# overhead is an upper bound for the 10k workload).
+SUP_VARS = 2_048
+SUP_ROUNDS = 512
+SUP_CHUNK = 128
+SUP_REPS = 5  # interleaved; medians reported
+SUP_BOUND_PCT = 2.0
 # config4_dpop_secp): exact DPOP on a tiled-zone SECP — disjoint
 # rooms give the wide shallow pseudo-forest the level-synchronous
 # UTIL batching exploits.  util-cells/sec per-node dispatch
@@ -199,10 +213,15 @@ def last_good_tpu(workload: str | None = None) -> dict | None:
             # never headline evidence — excluded on the fallback
             # path too, not just by the alias set
             continue
-        if aliases is None and ("_restarts" in w or w.startswith("config")):
-            # K-restart aggregates (bench_restarts) and pinned-restart
-            # config cells (bench_configs) report aggregate-over-K
-            # throughput — comparable only under their own row's
+        if aliases is None and (
+            "_restarts" in w
+            or w.startswith("config")
+            or w.startswith("supervised_overhead")
+        ):
+            # K-restart aggregates (bench_restarts), pinned-restart
+            # config cells (bench_configs) and the supervised-overhead
+            # A/B (2k vars, overhead-measurement conventions) report
+            # throughput comparable only under their own row's
             # conventions, never as the single-instance headline
             continue
         if aliases is None or w in aliases:
@@ -228,6 +247,7 @@ EVIDENCE_ROWS = [
     ("config4_dpop_secp", ["config4_*"]),
     ("config5_maxsum_meeting10k", ["maxsum_meeting_10000"]),
     ("restart_sweep_10k", ["maxsum_coloring_10000_restarts*"]),
+    ("supervised_overhead", ["supervised_overhead_*"]),
 ]
 
 
@@ -643,6 +663,87 @@ def _measure_dpop(phase_budget: float = 0.0) -> dict:
     return out
 
 
+def _measure_supervised(phase_budget: float = 0.0) -> dict:
+    """Supervisor no-fault overhead on the dsa/maxsum hot loops.
+
+    Runs the same ``run_batched`` hot loop under the ambient default
+    supervisor (what every ``api.solve`` call pays) and under
+    ``UNSUPERVISED`` (bare dispatch — no classification, no retry
+    bookkeeping, no NaN screen), interleaved so load noise hits both
+    sides, and reports the median msgs/sec ratio per algorithm.  The
+    acceptance bound is ``overhead_pct < SUP_BOUND_PCT`` for both
+    algorithms (``ok`` in the stage JSON).
+    """
+    import statistics
+
+    with _bounded_phase("import:jax", phase_budget):
+        import jax
+
+    with _bounded_phase("import:pydcop", phase_budget):
+        import __graft_entry__ as g
+        from pydcop_tpu.algorithms import (
+            load_algorithm_module,
+            prepare_algo_params,
+        )
+        from pydcop_tpu.engine.batched import run_batched
+        from pydcop_tpu.engine.supervisor import (
+            UNSUPERVISED,
+            supervision,
+        )
+        from pydcop_tpu.ops import compile_dcop
+
+    _phase("problem_built")
+    dcop = g._make_coloring_dcop(SUP_VARS, degree=DEGREE, seed=1)
+    problem = compile_dcop(dcop)
+    out = {
+        "platform": jax.devices()[0].platform,
+        "n_vars": SUP_VARS,
+        "rounds": SUP_ROUNDS,
+        "reps": SUP_REPS,
+        "bound_pct": SUP_BOUND_PCT,
+        "algos": {},
+        "ok": True,
+    }
+    for algo, algo_params in (
+        ("maxsum", {"damping": 0.5}),
+        ("dsa", {"variant": "B", "probability": 0.7}),
+    ):
+        module = load_algorithm_module(algo)
+        params = prepare_algo_params(algo_params, module.algo_params)
+        kw = dict(
+            rounds=SUP_ROUNDS, seed=0, chunk_size=SUP_CHUNK,
+            cost_every=8,
+        )
+        with _bounded_phase(f"xla_compile:{algo}", phase_budget):
+            run_batched(problem, module, params, **kw)  # warm
+
+        def _timed():
+            t0 = time.perf_counter()
+            r = run_batched(problem, module, params, **kw)
+            dt = time.perf_counter() - t0
+            msgs = module.messages_per_round(problem, params) * r.cycles
+            return msgs / dt
+
+        _phase(f"measure:supervised_{algo}")
+        sup_rates, bare_rates = [], []
+        for _ in range(SUP_REPS):  # interleaved: load noise hits both
+            sup_rates.append(_timed())  # ambient default supervisor
+            with supervision(UNSUPERVISED):
+                bare_rates.append(_timed())
+        sup_med = statistics.median(sup_rates)
+        bare_med = statistics.median(bare_rates)
+        overhead_pct = round((1.0 - sup_med / bare_med) * 100.0, 2)
+        out["algos"][algo] = {
+            "msgs_per_sec_supervised": round(sup_med),
+            "msgs_per_sec_unsupervised": round(bare_med),
+            "overhead_pct": overhead_pct,
+        }
+        if overhead_pct >= SUP_BOUND_PCT:
+            out["ok"] = False
+    _phase("measured")
+    return out
+
+
 def _inner_main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--inner", action="store_true")
@@ -652,6 +753,7 @@ def _inner_main() -> None:
     p.add_argument("--phase_budget", type=float, default=0.0)
     p.add_argument("--many_stage", action="store_true")
     p.add_argument("--dpop_stage", action="store_true")
+    p.add_argument("--supervised_stage", action="store_true")
     a = p.parse_args()
     import jax
 
@@ -666,7 +768,9 @@ def _inner_main() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # older jax: cache flags absent — correctness unaffected
-    if a.dpop_stage:
+    if a.supervised_stage:
+        metrics = _measure_supervised(a.phase_budget)
+    elif a.dpop_stage:
         metrics = _measure_dpop(a.phase_budget)
     elif a.many_stage:
         metrics = _measure_many(a.phase_budget)
@@ -677,7 +781,7 @@ def _inner_main() -> None:
 
 def _run_sub(
     pin_cpu: bool, timeout: float, n_vars: int, rounds: int,
-    many: bool = False, dpop: bool = False,
+    many: bool = False, dpop: bool = False, supervised: bool = False,
 ) -> dict:
     """Run ``bench.py --inner`` in a subprocess; parse its JSON line.
 
@@ -707,7 +811,8 @@ def _run_sub(
                 "--phase_budget", f"{phase_budget:.1f}",
             ]
             + (["--many_stage"] if many else [])
-            + (["--dpop_stage"] if dpop else []),
+            + (["--dpop_stage"] if dpop else [])
+            + (["--supervised_stage"] if supervised else []),
             env=env,
             cwd=REPO,
             capture_output=True,
@@ -932,6 +1037,42 @@ def main() -> None:
             speedup_level_vs_node=dpop.get("speedup_level_vs_node"),
         )
 
+    # supervised-dispatch no-fault overhead (engine/supervisor.py):
+    # dsa/maxsum hot loops under the default supervisor vs bare
+    # dispatch — the <2% acceptance bound of the robustness layer.
+    # Same platform policy as the stages above.
+    supervised = _run_sub(pin_cpu=False, timeout=300.0, n_vars=0,
+                          rounds=0, supervised=True)
+    if "error" in supervised:
+        supervised = _run_sub(pin_cpu=True, timeout=300.0, n_vars=0,
+                              rounds=0, supervised=True)
+    if "error" in supervised:
+        errors.append(f"supervised_overhead stage: {supervised['error']}")
+        supervised = None
+    elif not supervised.get("ok", False):
+        errors.append(
+            "supervised_overhead over bound: "
+            + json.dumps(supervised.get("algos", {}))
+        )
+    elif supervised.get("platform") == "tpu":
+        # durable evidence row: the supervised maxsum rate IS a
+        # msgs/sec measurement of the hot loop (with the overhead and
+        # baseline attached for the <2% claim)
+        ms = supervised["algos"].get("maxsum", {})
+        if ms:
+            append_tpu_log(
+                f"supervised_overhead_{SUP_VARS}",
+                ms.get("msgs_per_sec_supervised"),
+                source="bench_stage_supervised_overhead",
+                msgs_per_sec_unsupervised=ms.get(
+                    "msgs_per_sec_unsupervised"
+                ),
+                overhead_pct=ms.get("overhead_pct"),
+                overhead_pct_dsa=supervised["algos"]
+                .get("dsa", {})
+                .get("overhead_pct"),
+            )
+
     out = {
         "metric": "maxsum_msgs_per_sec_10k_coloring",
         "value": round(headline["msgs_per_sec"]) if headline else 0,
@@ -971,6 +1112,15 @@ def main() -> None:
             k: many[k]
             for k in ("platform", "n_vars", "rounds", "algo", "ks")
             if k in many
+        }
+    if supervised is not None:
+        out["supervised_overhead"] = {
+            k: supervised[k]
+            for k in (
+                "platform", "n_vars", "rounds", "reps", "bound_pct",
+                "algos", "ok",
+            )
+            if k in supervised
         }
     if dpop is not None:
         out["dpop_secp"] = {

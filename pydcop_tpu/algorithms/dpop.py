@@ -666,9 +666,14 @@ def _util_phase_multi(
     dispatch, ``dpop.cert_fallbacks`` per tie-heavy node redone on
     host.
     """
+    from pydcop_tpu.engine.supervisor import (
+        DeviceOOMError,
+        get_supervisor,
+    )
     from pydcop_tpu.telemetry import get_metrics
 
     met = get_metrics()
+    sup = get_supervisor()
     K = len(insts)
     utils: List[Dict[str, Tuple[List[str], np.ndarray]]] = [
         {} for _ in range(K)
@@ -838,6 +843,7 @@ def _util_phase_multi(
             n_rows = len(entries)
             shape0 = entries[0][0][5]
             uniform = all(it[5] == shape0 for it, _ in entries)
+            level_batched = False
             if level_sync and n_rows > 1 and uniform:
                 # stack height bucketed pow-2 under a pad policy
                 # (ghost rows stay zero, discarded below): the
@@ -864,14 +870,32 @@ def _util_phase_multi(
                     if pad.enabled:  # own-axis ghost guard (mask)
                         bufs[-1][r][..., shape0[-1]:] = np.inf
                 fn = _join_kernel(pshape, part_shapes, batched=True)
-                aminb, margb = fn(
-                    *[b.astype(np.float32) for b in bufs]
-                )
-                # pull BOTH outputs to host numpy in one transfer
-                # each before any slicing — indexing the jax arrays
-                # directly would dispatch a device slice per access
-                aminb = np.asarray(aminb)
-                margb = np.asarray(margb)
+                casts = [b.astype(np.float32) for b in bufs]
+                try:
+                    # pull BOTH outputs to host numpy INSIDE the
+                    # supervised call, in one transfer each before
+                    # any slicing — a per-access device slice would
+                    # cost a dispatch each, and with async dispatch a
+                    # runtime failure only surfaces at the sync
+                    # point, which must be where the supervisor
+                    # classifies it
+                    aminb, margb = sup.dispatch(
+                        lambda: tuple(
+                            np.asarray(x) for x in fn(*casts)
+                        ),
+                        scope="dpop.level", width=stack_h,
+                    )
+                    level_batched = True
+                except DeviceOOMError:
+                    # OOM degradation ladder: a level stack that does
+                    # not fit splits down to its smallest pieces —
+                    # one dispatch per node (the per-node path
+                    # below); a node whose single join still OOMs
+                    # falls back to the exact host f64 join there.
+                    # Exactness is untouched either way.
+                    if met.enabled:
+                        met.inc("engine.oom_splits")
+            if level_batched:
                 if met.enabled:
                     met.inc("dpop.level_dispatches")
                 for k in sorted({item[0] for item, _ in entries}):
@@ -970,11 +994,6 @@ def _util_phase_multi(
                     and time.perf_counter() - t0 > timeout
                 ):
                     return None
-                if met.enabled:
-                    # per dispatch, not n_rows up front: a timeout
-                    # aborting this loop must not count dispatches
-                    # that were never issued
-                    met.inc("dpop.level_dispatches")
                 if pad.enabled:
                     aligned = pad_util_parts(aligned, shape, pshape)
                 else:
@@ -982,9 +1001,27 @@ def _util_phase_multi(
                         np.asarray(a, dtype=np.float32)
                         for a in aligned
                     ]
-                amin, margins = fn(*aligned)
-                amin = np.asarray(amin)  # host pull before slicing
-                margins = np.asarray(margins)
+                try:
+                    # host pull inside the supervised call (same
+                    # sync-point reasoning as the batched branch)
+                    amin, margins = sup.dispatch(
+                        lambda a=aligned: tuple(
+                            np.asarray(x) for x in fn(*a)
+                        ),
+                        scope="dpop.node", width=1,
+                    )
+                except DeviceOOMError:
+                    # bottom of the OOM ladder: this single join does
+                    # not fit on the device even alone — redo it
+                    # wholesale on host f64 (exact) and keep sweeping
+                    _host_redo(met, host_nodes, finish, item)
+                    continue
+                if met.enabled:
+                    # per EXECUTED dispatch, not n_rows up front: a
+                    # timeout aborting this loop (or an OOM degrading
+                    # to host) must not count dispatches that never
+                    # ran on the device
+                    met.inc("dpop.level_dispatches")
                 dispatches[k] += 1
                 # slice the level-pack ghost cells away before
                 # certification: only the real region is decided here

@@ -53,6 +53,9 @@ def solve(
     trace_format: str = "jsonl",
     pad_policy: str = "none",
     compile_cache: Optional[str] = None,
+    retry_budget: Optional[int] = None,
+    chunk_floor: Optional[int] = None,
+    on_numeric_fault: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -116,6 +119,20 @@ def solve(
     programs they have built before.  Both are covered in
     ``docs/performance.md``.
 
+    ``retry_budget``/``chunk_floor``/``on_numeric_fault`` (batched
+    engine only) configure the supervised device-dispatch layer
+    (``engine/supervisor.py``, ``docs/faults.md``): transient runtime
+    errors retry up to ``retry_budget`` times per dispatch (default
+    2), device OOM degrades adaptively — chunk halving down to
+    ``chunk_floor`` rounds (default 8), instance-group splits for
+    ``solve_many`` — and a NaN-poisoned run either degrades to its
+    last-finite anytime best (``on_numeric_fault="quarantine"``, the
+    default) or fails the call (``"raise"``).  In batched mode
+    ``chaos`` accepts the DEVICE-layer fault kinds (``device_oom``,
+    ``device_transient``, ``nan_inject``) injected at that seam,
+    under the same seeded-determinism contract as the message-plane
+    kinds.
+
     >>> result = solve(my_dcop, "dsa", {"variant": "B"}, rounds=100)
     >>> result["assignment"], result["cost"]
     """
@@ -138,7 +155,8 @@ def solve(
             nb_agents=nb_agents, msg_log=msg_log,
             accel_agents=accel_agents, distribution=distribution,
             k_target=k_target, chaos=chaos, chaos_seed=chaos_seed,
-            pad_policy=pad_policy,
+            pad_policy=pad_policy, retry_budget=retry_budget,
+            chunk_floor=chunk_floor, on_numeric_fault=on_numeric_fault,
         )
         result["telemetry"] = tel.summary()
     return result
@@ -168,6 +186,9 @@ def _solve_dispatch(
     chaos,
     chaos_seed,
     pad_policy="none",
+    retry_budget=None,
+    chunk_floor=None,
+    on_numeric_fault=None,
 ) -> Dict[str, Any]:
     """Mode dispatch behind :func:`solve` (which owns the telemetry
     session and the ``result["telemetry"]`` attach)."""
@@ -181,6 +202,34 @@ def _solve_dispatch(
             "pad_policy shapes the batched engine's compiled arrays; "
             f"mode={mode!r} does not compile the whole problem"
         )
+
+    if mode != "batched" and (
+        retry_budget is not None
+        or chunk_floor is not None
+        or on_numeric_fault is not None
+    ):
+        raise ValueError(
+            "retry_budget/chunk_floor/on_numeric_fault configure the "
+            "batched engine's supervised device dispatch "
+            f"(engine/supervisor.py); mode={mode!r} has no device "
+            "dispatch to supervise"
+        )
+
+    if mode != "batched" and chaos:
+        # the mirror of the batched branch's message-kind rejection
+        # below: a device-layer clause on a host runtime would no-op
+        # silently (the chaos layer only reads message-plane fields)
+        # and the caller would believe the recovery path was exercised
+        from pydcop_tpu.faults import FaultPlan
+
+        if FaultPlan.from_spec(chaos, chaos_seed).device_faults_configured:
+            raise ValueError(
+                "device-layer chaos kinds (device_oom/"
+                "device_transient/nan_inject) inject at the batched "
+                "engine's supervised device dispatch "
+                f"(engine/supervisor.py); mode={mode!r} has no device "
+                "dispatch — use mode='batched' (docs/faults.md)"
+            )
 
     if mode in ("thread", "sim"):
         if checkpoint_path is not None or resume:
@@ -243,13 +292,22 @@ def _solve_dispatch(
         )
     if mode != "batched":
         raise ValueError(f"solve: unknown mode {mode!r}")
+    plan = None
     if chaos:
-        raise ValueError(
-            "chaos fault injection targets the message planes — use "
-            "mode='thread' or 'process' (crash schedules against the "
-            "batched dynamic engine go through the `run` command's "
-            "--chaos, which scripts them as scenario events)"
-        )
+        from pydcop_tpu.faults import FaultPlan
+
+        plan = FaultPlan.from_spec(chaos, chaos_seed)
+        if plan.message_faults_configured or plan.crashes:
+            raise ValueError(
+                "chaos message-plane faults and crash schedules "
+                "target the message-driven runtimes — use "
+                "mode='thread' or 'process' (crash schedules against "
+                "the batched dynamic engine go through the `run` "
+                "command's --chaos, which scripts them as scenario "
+                "events).  The batched engine accepts the "
+                "DEVICE-layer kinds only: device_oom, "
+                "device_transient, nan_inject (docs/faults.md)"
+            )
     if k_target:
         raise ValueError(
             "k_target (replica-based migration) is a host-runtime "
@@ -283,6 +341,17 @@ def _solve_dispatch(
     module = load_algorithm_module(algo_name)
     params = prepare_algo_params(params_in, module.algo_params)
 
+    # every batched-mode call runs under a per-call supervisor
+    # (engine/supervisor.py): retries/degradation knobs, the
+    # device-layer chaos plan, and per-call dispatch sequence
+    # numbering (what makes the injected fault schedule replayable)
+    from pydcop_tpu.engine.supervisor import make_supervisor, supervision
+
+    sup = make_supervisor(
+        retry_budget=retry_budget, chunk_floor=chunk_floor,
+        on_numeric_fault=on_numeric_fault, plan=plan,
+    )
+
     if hasattr(module, "solve_host"):
         # exact / sequential algorithms (DPOP, SyncBB)
         if checkpoint_path is not None or resume:
@@ -302,28 +371,39 @@ def _solve_dispatch(
             # level dispatches on the pow-2 lattice (level-pack keys,
             # docs/performance.md "Level-synchronous DPOP") —
             # results bit-identical
-            return module.solve_host(
-                dcop, params, timeout=timeout, pad_policy=pad_policy
-            )
-        if as_pad_policy(pad_policy).enabled:
-            raise ValueError(
-                f"{algo_name} runs on the host path and never "
-                "compiles the whole problem — pad_policy does not "
-                "apply"
-            )
-        return module.solve_host(dcop, params, timeout=timeout)
+            with supervision(sup):
+                result = module.solve_host(
+                    dcop, params, timeout=timeout,
+                    pad_policy=pad_policy,
+                )
+        else:
+            if as_pad_policy(pad_policy).enabled:
+                raise ValueError(
+                    f"{algo_name} runs on the host path and never "
+                    "compiles the whole problem — pad_policy does "
+                    "not apply"
+                )
+            with supervision(sup):
+                result = module.solve_host(
+                    dcop, params, timeout=timeout
+                )
+    else:
+        from pydcop_tpu.ops.compile import compile_dcop
 
-    from pydcop_tpu.ops.compile import compile_dcop
-
-    problem = compile_dcop(dcop, pad_policy=pad_policy)
-    return _run_compiled(
-        problem, module, params, rounds=rounds, seed=seed,
-        timeout=timeout, chunk_size=chunk_size,
-        convergence_chunks=convergence_chunks,
-        checkpoint_path=checkpoint_path,
-        checkpoint_every=checkpoint_every, resume=resume,
-        ui_port=ui_port, n_restarts=n_restarts,
-    )
+        problem = compile_dcop(dcop, pad_policy=pad_policy)
+        with supervision(sup):
+            result = _run_compiled(
+                problem, module, params, rounds=rounds, seed=seed,
+                timeout=timeout, chunk_size=chunk_size,
+                convergence_chunks=convergence_chunks,
+                checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every, resume=resume,
+                ui_port=ui_port, n_restarts=n_restarts,
+            )
+    if plan is not None:
+        # replay record, same as the message-plane chaos runs
+        result["chaos"] = plan.to_meta()
+    return result
 
 
 def _is_strategy_name(distribution) -> bool:
@@ -654,6 +734,11 @@ def solve_many(
     trace: Optional[str] = None,
     trace_format: str = "jsonl",
     compile_cache: Optional[str] = None,
+    chaos: Optional[str] = None,
+    chaos_seed: int = 0,
+    retry_budget: Optional[int] = None,
+    chunk_floor: Optional[int] = None,
+    on_numeric_fault: Optional[str] = None,
 ) -> list:
     """Solve MANY DCOP instances, batching same-shaped ones into one
     device program each (cross-instance batching,
@@ -697,6 +782,19 @@ def solve_many(
     bucket).  The ``time`` field is the instance's group wall-clock
     divided evenly across the group; telemetry is the aggregate of
     the whole call.
+
+    The whole call runs under one supervised-dispatch layer
+    (``engine/supervisor.py``, knobs ``retry_budget``/``chunk_floor``/
+    ``on_numeric_fault`` as in :func:`solve`): a group that exhausts
+    device memory SPLITS — each half re-dispatches with its own
+    (smaller) vmapped program, stream-preserving, so per-instance
+    results stay bit-identical to the fault-free run — and a
+    NaN-poisoned instance is QUARANTINED out of its group alone
+    (``status="degraded"`` with its last-finite anytime best) while
+    the other K-1 instances finish untouched.  ``chaos``/
+    ``chaos_seed`` accept the device-layer fault kinds
+    (``device_oom``, ``device_transient``, ``nan_inject`` —
+    ``docs/faults.md``) to exercise exactly those paths on demand.
     """
     import time as _time
 
@@ -708,6 +806,19 @@ def solve_many(
         return []
     if n_restarts < 1:
         raise ValueError(f"n_restarts must be >= 1, got {n_restarts}")
+
+    plan = None
+    if chaos:
+        from pydcop_tpu.faults import FaultPlan
+
+        plan = FaultPlan.from_spec(chaos, chaos_seed)
+        if plan.message_faults_configured or plan.crashes:
+            raise ValueError(
+                "solve_many runs on the batched engine, which has no "
+                "message plane — chaos accepts the DEVICE-layer "
+                "kinds only: device_oom, device_transient, "
+                "nan_inject (docs/faults.md)"
+            )
 
     if compile_cache is not None:
         from pydcop_tpu.ops.compile import (
@@ -753,6 +864,16 @@ def solve_many(
         for p in params_in_list
     ]
 
+    # one supervised-dispatch layer for the whole call: every group's
+    # device dispatches (and the merged DPOP sweeps on the host path)
+    # share the retry/degradation knobs and the device chaos plan
+    from pydcop_tpu.engine.supervisor import make_supervisor, supervision
+
+    sup = make_supervisor(
+        retry_budget=retry_budget, chunk_floor=chunk_floor,
+        on_numeric_fault=on_numeric_fault, plan=plan,
+    )
+
     # load yaml paths once per distinct path; DCOP objects pass through
     loaded: Dict[str, DCOP] = {}
 
@@ -764,7 +885,7 @@ def solve_many(
             return loaded[key]
         return d
 
-    with session(trace, trace_format) as tel:
+    with session(trace, trace_format) as tel, supervision(sup):
         deadline = (
             _time.perf_counter() + timeout if timeout is not None else None
         )
@@ -859,6 +980,9 @@ def solve_many(
         summary = tel.summary()
     for r in results:
         r["telemetry"] = summary
+        if plan is not None:
+            # replay record, same as the message-plane chaos runs
+            r["chaos"] = plan.to_meta()
     return results
 
 

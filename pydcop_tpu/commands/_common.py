@@ -61,6 +61,33 @@ def add_trace_arguments(parser) -> None:
     )
 
 
+def add_supervisor_arguments(parser) -> None:
+    """``--retry_budget``/``--chunk_floor``/``--on_numeric_fault``:
+    the supervised device-dispatch knobs of the batched engine
+    (``engine/supervisor.py``, ``docs/faults.md``)."""
+    parser.add_argument(
+        "--retry_budget", type=int, default=None, metavar="N",
+        help="transient device failures retry up to N times per "
+        "dispatch (seeded deterministic backoff; default 2, 0 turns "
+        "retries off) — batched engine only",
+    )
+    parser.add_argument(
+        "--chunk_floor", type=int, default=None, metavar="ROUNDS",
+        help="smallest chunk size the device-OOM degradation ladder "
+        "may halve down to before the run is declared over capacity "
+        "(default 8) — batched engine only",
+    )
+    parser.add_argument(
+        "--on_numeric_fault", choices=["quarantine", "raise"],
+        default=None,
+        help="NaN-poisoned run/instance handling: quarantine (report "
+        "the last-finite anytime best with status=degraded — for "
+        "solve --many only the poisoned instance degrades, the rest "
+        "of its group finishes untouched; default) or raise (fail "
+        "the call) — batched engine only",
+    )
+
+
 def add_collect_arguments(parser) -> None:
     parser.add_argument(
         "--collect_on",

@@ -50,7 +50,27 @@ _SOLVE_OPTIONS = {
     "convergence_chunks",
     "n_restarts",
     "pad_policy",
+    # supervised device dispatch (engine/supervisor.py): a sweep can
+    # tune retry/degradation policy per batch — useful on busy shared
+    # accelerators where transient failures and HBM pressure are real
+    "retry_budget",
+    "chunk_floor",
+    "on_numeric_fault",
 }
+
+
+def _supervisor_options(options: Dict[str, Any]) -> Dict[str, Any]:
+    """The supervised-dispatch knobs of a batch spec, typed for
+    ``api.solve``/``api.solve_many`` (absent keys stay None = the
+    supervisor defaults)."""
+    out: Dict[str, Any] = {}
+    if options.get("retry_budget") is not None:
+        out["retry_budget"] = int(options["retry_budget"])
+    if options.get("chunk_floor") is not None:
+        out["chunk_floor"] = int(options["chunk_floor"])
+    if options.get("on_numeric_fault") is not None:
+        out["on_numeric_fault"] = str(options["on_numeric_fault"])
+    return out
 
 CSV_FIELDS = [
     "batch",
@@ -268,6 +288,7 @@ def _vmap_cells_pass(writer, fobj, runs, done, base_dir):
                 n_restarts=int(options.get("n_restarts", 1)),
                 pad_policy=options.get("pad_policy", "pow2"),
                 seed=[run[3] for run, _ in pairs],
+                **_supervisor_options(options),
             )
         except Exception:
             # e.g. the stacked state OOMs where single runs fit — the
@@ -396,6 +417,7 @@ def run_cmd(args) -> int:
                 ),
                 n_restarts=int(options.get("n_restarts", 1)),
                 pad_policy=options.get("pad_policy", "none"),
+                **_supervisor_options(options),
             )
             # vmap only plain fixed-round cells: a shared timeout or a
             # best-judged convergence stop would truncate the non-best

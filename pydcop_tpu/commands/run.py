@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from pydcop_tpu.commands._common import (
     add_collect_arguments,
+    add_supervisor_arguments,
     add_trace_arguments,
     parse_algo_params,
     write_metrics,
@@ -33,10 +34,15 @@ def set_parser(subparsers) -> None:
     )
     p.add_argument(
         "--chaos", default=None, metavar="SPEC",
-        help="generate the scenario from crash=AGENT@T clauses (spec "
-        "format: docs/faults.md) — each becomes a deterministic "
-        "remove_agent event at T seconds; message-plane fault clauses "
-        "are rejected here (the batched engine has no message plane)",
+        help="inject deterministic faults (spec format: "
+        "docs/faults.md): crash=AGENT@T clauses generate the "
+        "scenario — each becomes a deterministic remove_agent event "
+        "at T seconds — and the device-layer kinds (device_oom, "
+        "device_transient, nan_inject) inject at the supervised "
+        "device-dispatch seam of every segment "
+        "(engine/supervisor.py); message-plane fault clauses are "
+        "rejected here (the batched engine has no message plane).  "
+        "Device-only specs compose with -s/--scenario",
     )
     p.add_argument(
         "--chaos_seed", type=int, default=0,
@@ -78,6 +84,7 @@ def set_parser(subparsers) -> None:
         "repeated runs skip backend compilation across processes "
         "(docs/performance.md)",
     )
+    add_supervisor_arguments(p)
     add_collect_arguments(p)
     add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
@@ -95,11 +102,6 @@ def run_cmd(args) -> int:
         args.dcop_files if len(args.dcop_files) > 1 else args.dcop_files[0]
     )
     chaos_plan = None
-    if args.chaos and args.scenario:
-        raise SystemExit(
-            "run: --scenario and --chaos are two sources of scripted "
-            "dynamics; use one"
-        )
     if args.chaos:
         from pydcop_tpu.dcop.scenario import (
             EventAction,
@@ -115,15 +117,22 @@ def run_cmd(args) -> int:
         if chaos_plan.message_faults_configured:
             raise SystemExit(
                 "run: the batched dynamic engine has no message plane "
-                "— only crash=AGENT@T clauses apply here; message-"
-                "plane faults (drop/dup/reorder/delay/partition) need "
-                "the host runtimes (solve --mode thread/process, "
-                "orchestrator --runtime host)"
+                "— only crash=AGENT@T clauses and the device-layer "
+                "kinds (device_oom/device_transient/nan_inject) apply "
+                "here; message-plane faults (drop/dup/reorder/delay/"
+                "partition) need the host runtimes (solve --mode "
+                "thread/process, orchestrator --runtime host)"
             )
-        if not chaos_plan.crashes:
+        if not chaos_plan.crashes and not chaos_plan.device_faults_configured:
             raise SystemExit(
-                "run: --chaos without crash=AGENT@T clauses schedules "
-                "nothing for the batched engine"
+                "run: --chaos without crash=AGENT@T or device-layer "
+                "clauses schedules nothing for the batched engine"
+            )
+        if chaos_plan.crashes and args.scenario:
+            raise SystemExit(
+                "run: --scenario and --chaos crash schedules are two "
+                "sources of scripted dynamics; use one (device-only "
+                "--chaos specs DO compose with --scenario)"
             )
         unknown = set(chaos_plan.crashes) - set(dcop.agents)
         if unknown:
@@ -147,7 +156,16 @@ def run_cmd(args) -> int:
                     actions=[EventAction("remove_agent", agent=name)],
                 )
             )
-        scenario = Scenario(events)
+        if chaos_plan.crashes:
+            scenario = Scenario(events)
+        elif args.scenario:
+            scenario = load_scenario_from_file(args.scenario)
+        else:
+            raise SystemExit(
+                "run: a dynamics source is required — -s/--scenario "
+                "FILE or --chaos 'crash=AGENT@T,...' (a device-only "
+                "--chaos spec injects faults but scripts no dynamics)"
+            )
     elif args.scenario:
         scenario = load_scenario_from_file(args.scenario)
     else:
@@ -165,8 +183,25 @@ def run_cmd(args) -> int:
 
         enable_persistent_compilation_cache(args.compile_cache)
 
+    # per-call supervisor: retry/degradation knobs + the plan's
+    # device-layer fault kinds inject at every segment's supervised
+    # chunk dispatches (engine/supervisor.py)
+    from pydcop_tpu.engine.supervisor import make_supervisor, supervision
+
+    sup = make_supervisor(
+        retry_budget=args.retry_budget,
+        chunk_floor=args.chunk_floor,
+        on_numeric_fault=args.on_numeric_fault,
+        plan=(
+            chaos_plan
+            if chaos_plan is not None
+            and chaos_plan.device_faults_configured
+            else None
+        ),
+    )
+
     try:
-        with session(args.trace, args.trace_format) as tel:
+        with session(args.trace, args.trace_format) as tel, supervision(sup):
             result = run_dynamic(
                 dcop,
                 args.algo,

@@ -11,6 +11,7 @@ import sys
 
 from pydcop_tpu.commands._common import (
     add_collect_arguments,
+    add_supervisor_arguments,
     add_trace_arguments,
     parse_algo_params,
     write_metrics,
@@ -93,10 +94,14 @@ def set_parser(subparsers) -> None:
     )
     p.add_argument(
         "--chaos", default=None, metavar="SPEC",
-        help="(thread/process modes) inject deterministic message-"
-        "plane faults: drop/dup/reorder/delay probabilities, timed "
-        "partitions, crash schedules (spec format: docs/faults.md); "
-        "same --chaos_seed => identical fault sequence",
+        help="inject deterministic faults (spec format: "
+        "docs/faults.md; same --chaos_seed => identical fault "
+        "sequence).  thread/process modes: message-plane kinds — "
+        "drop/dup/reorder/delay probabilities, timed partitions, "
+        "crash schedules.  tpu mode (incl. --many): device-layer "
+        "kinds — device_oom=W[:R], device_transient=P[:AFTER], "
+        "nan_inject=P[:I] — injected at the supervised device-"
+        "dispatch seam (engine/supervisor.py)",
     )
     p.add_argument(
         "--chaos_seed", type=int, default=0,
@@ -133,6 +138,7 @@ def set_parser(subparsers) -> None:
         "repeated runs of the same program skip backend compilation "
         "entirely, across processes (docs/performance.md)",
     )
+    add_supervisor_arguments(p)
     add_collect_arguments(p)
     add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
@@ -177,6 +183,9 @@ def run_cmd(args) -> int:
             trace_format=args.trace_format,
             pad_policy=args.pad_policy,
             compile_cache=args.compile_cache,
+            retry_budget=args.retry_budget,
+            chunk_floor=args.chunk_floor,
+            on_numeric_fault=args.on_numeric_fault,
         )
     finally:
         # flush the trace even when the solve raises — a profile of a
@@ -207,7 +216,6 @@ def _run_many_cmd(args, params) -> int:
         (args.uiport, "--uiport"),
         (args.msg_log, "--msg_log"),
         (args.accel_agents, "--accel_agents"),
-        (args.chaos, "--chaos"),
         (args.distribution, "--distribution"),
         (args.nb_agents, "--nb_agents"),
         (args.profile, "--profile"),
@@ -230,6 +238,11 @@ def _run_many_cmd(args, params) -> int:
         trace=args.trace,
         trace_format=args.trace_format,
         compile_cache=args.compile_cache,
+        chaos=args.chaos,
+        chaos_seed=args.chaos_seed,
+        retry_budget=args.retry_budget,
+        chunk_floor=args.chunk_floor,
+        on_numeric_fault=args.on_numeric_fault,
     )
     for r in results:
         r.pop("cost_trace", None)  # keep the printed JSON compact

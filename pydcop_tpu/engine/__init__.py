@@ -5,7 +5,9 @@ Re-exports are LAZY (PEP 562, same pattern as ``pydcop_tpu.ops``):
 eager re-export here would force that chain onto every consumer of
 the package — including the deliberately jax-free
 :mod:`pydcop_tpu.engine.host_batch` that ``api.solve_many`` uses for
-pure host-path runs (DPOP ``util_device="never"``, SyncBB).
+pure host-path runs (DPOP ``util_device="never"``, SyncBB) and
+:mod:`pydcop_tpu.engine.supervisor`, the (also jax-free) supervised
+device-dispatch layer.
 """
 
 _BATCHED_EXPORTS = {
@@ -14,7 +16,17 @@ _BATCHED_EXPORTS = {
     "run_many_batched",
 }
 
-__all__ = sorted(_BATCHED_EXPORTS)
+_SUPERVISOR_EXPORTS = {
+    "DeviceOOMError",
+    "Supervisor",
+    "SupervisorConfig",
+    "UnrecoverableDeviceError",
+    "get_supervisor",
+    "make_supervisor",
+    "supervision",
+}
+
+__all__ = sorted(_BATCHED_EXPORTS | _SUPERVISOR_EXPORTS)
 
 
 def __getattr__(name):
@@ -22,6 +34,10 @@ def __getattr__(name):
         import pydcop_tpu.engine.batched as _batched
 
         return getattr(_batched, name)
+    if name in _SUPERVISOR_EXPORTS:
+        import pydcop_tpu.engine.supervisor as _supervisor
+
+        return getattr(_supervisor, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )

@@ -37,6 +37,13 @@ import numpy as np
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from pydcop_tpu.engine.supervisor import (
+    DeviceOOMError,
+    DeviceTransientError,
+    UnrecoverableDeviceError,
+    get_supervisor,
+)
+from pydcop_tpu.utils.backoff import backoff_delays
 from pydcop_tpu.ops.compile import (
     CompiledProblem,
     canonical_execution_problem,
@@ -635,6 +642,35 @@ def run_batched(
     runner = make_runner(min(chunk_size, rounds))
     small_runner = None  # for the tail chunk, compiled lazily
 
+    sup = get_supervisor()
+
+    def _save_final_checkpoint():
+        """Best-effort final checkpoint before an unrecoverable error
+        surfaces: the postmortem (and a later ``resume=True`` retry)
+        gets the last healthy carry instead of nothing."""
+        if checkpoint_path is None:
+            return
+        from pydcop_tpu.engine.checkpoint import save_checkpoint
+
+        try:
+            save_checkpoint(
+                checkpoint_path, state, best_cost, best_values, done,
+                {
+                    "algo": algo_module.__name__,
+                    "seed": seed,
+                    "chunk_size": chunk_size,
+                    "problem": fingerprint,
+                    "n_restarts": n_restarts,
+                },
+                static_keys=getattr(
+                    algo_module, "STATIC_STATE_KEYS", ()
+                ),
+            )
+            if met.enabled:
+                met.inc("engine.checkpoints")
+        except Exception:
+            pass  # the original failure is the report, not this write
+
     traces = []
     done = resumed_rounds
     status = "finished"
@@ -652,20 +688,97 @@ def run_batched(
                 small_runner = (this_chunk, make_runner(this_chunk))
             r = small_runner[1]
         k_chunk = jax.random.fold_in(k_run, done)
+
+        def _run_chunk(r=r, k_chunk=k_chunk):
+            # force the cost trace to host INSIDE the supervised call:
+            # with async dispatch, a runtime failure only surfaces at
+            # this sync point, and it must surface where the
+            # supervisor can classify it
+            s, bc, bv, costs = r(
+                problem, state, k_chunk, dyn_params, best_cost,
+                best_values,
+            )
+            return s, bc, bv, np.asarray(costs)
+
         # the cycle span covers dispatch AND the host sync on the cost
         # trace — the wall-clock a chunk of rounds actually costs
-        with tr.span("cycle", cat="cycle", first=done, rounds=this_chunk):
-            state, best_cost, best_values, costs = r(
-                problem, state, k_chunk, dyn_params, best_cost, best_values
-            )
-            costs_np = np.asarray(costs)
+        try:
+            with tr.span(
+                "cycle", cat="cycle", first=done, rounds=this_chunk
+            ):
+                state, best_cost, best_values, costs_np = sup.dispatch(
+                    _run_chunk, scope="engine.chunk",
+                    width=n_restarts, rounds=this_chunk,
+                )
+        except DeviceOOMError as e:
+            # degradation ladder: halve the chunk down to the floor —
+            # a shorter scan shrinks the live round-loop footprint.
+            # The carries are untouched (this path never donates), so
+            # the run resumes at the same boundary; per-round keys
+            # derive from chunk boundaries, so stochastic RNG streams
+            # differ from the fault-free run past this point (same
+            # caveat as resuming with a different chunk_size).
+            new_chunk = max(sup.chunk_floor, this_chunk // 2)
+            if new_chunk >= this_chunk:
+                _save_final_checkpoint()
+                raise UnrecoverableDeviceError(
+                    f"device OOM with the chunk already at the floor "
+                    f"({this_chunk} rounds, chunk_floor="
+                    f"{sup.chunk_floor}): {e}",
+                    scope="engine.chunk", kind="oom",
+                ) from e
+            chunk_size = new_chunk
+            if met.enabled:
+                met.inc("engine.oom_chunk_halvings")
+            if tr.enabled:
+                tr.event(
+                    "oom-halve", cat="supervisor", chunk=new_chunk,
+                    round=done,
+                )
+            runner = make_runner(min(chunk_size, rounds))
+            small_runner = None
+            continue
+        except UnrecoverableDeviceError:
+            _save_final_checkpoint()
+            raise
         if met.enabled:
             met.inc("engine.chunks")
             met.inc("engine.rounds", this_chunk)
         if batched_restarts:
             costs_np = costs_np.min(axis=-1)
+        # numeric-fault screen at the chunk boundary (the nan_inject
+        # seam): the cost trace is already on host, so the isnan scan
+        # is free of device traffic.  NaN is poison, ±inf is a
+        # legitimate hard-constraint cost.  The anytime best is
+        # immune by construction (cost < best compares False for
+        # NaN), so the degraded result carries the last finite best.
+        poisoned = False
+        if sup.active:
+            if sup.nan_lanes(1, scope="engine.chunk"):
+                costs_np = np.array(costs_np)  # device view: CoW
+                costs_np[-1] = np.nan
+            poisoned = bool(np.isnan(costs_np).any())
         traces.append(costs_np)
         done += this_chunk
+        if poisoned:
+            if met.enabled:
+                met.inc("engine.numeric_faults")
+            if sup.on_numeric_fault == "raise":
+                _save_final_checkpoint()
+                raise UnrecoverableDeviceError(
+                    "NaN cost at a chunk boundary "
+                    f"(round {done}) under on_numeric_fault='raise'",
+                    scope="engine.chunk", kind="numeric",
+                )
+            if met.enabled:
+                met.inc("engine.quarantined_instances")
+            if tr.enabled:
+                tr.event(
+                    "quarantine", cat="supervisor",
+                    scope="engine.chunk", round=done,
+                )
+            status = "degraded"
+            break
         if checkpoint_path is not None:
             chunks_since_save += 1
             if chunks_since_save >= max(1, checkpoint_every):
@@ -729,7 +842,13 @@ def run_batched(
             prev_best = _best_scalar(best_cost)
             prev_values = cur_values
 
-    if checkpoint_path is not None and chunks_since_save:
+    # a degraded (NaN-poisoned) state must never land in a checkpoint:
+    # resuming from it would continue the poisoned trajectory
+    if (
+        checkpoint_path is not None
+        and chunks_since_save
+        and status != "degraded"
+    ):
         from pydcop_tpu.engine.checkpoint import save_checkpoint
 
         save_checkpoint(
@@ -764,6 +883,12 @@ def run_batched(
     else:
         final_cost = float(total_cost(problem, final_values))
         best_cost_f = float(best_cost)
+    if status == "degraded":
+        # the post-poison final values are not trusted — report the
+        # anytime best for both, the same contract as the message
+        # plane's degraded results (docs/faults.md)
+        final_values = best_values
+        final_cost = best_cost_f
     elapsed = time.perf_counter() - t0
     msgs = (
         algo_module.messages_per_round(host_problem, params)
@@ -772,7 +897,9 @@ def run_batched(
     )
     trace = np.concatenate(traces) if traces else np.zeros(0)
     out_state = None
-    if return_state:
+    # a degraded run's state pytree is (potentially) NaN-poisoned —
+    # never hand it out as a carry for a next segment
+    if return_state and status != "degraded":
         def _to_host(x):
             try:
                 return np.asarray(x)
@@ -813,6 +940,7 @@ def run_many_batched(
     n_restarts: int = 1,
     mesh=None,
     donate: bool = True,
+    _attempt: int = 0,
 ) -> List[RunResult]:
     """Solve K same-bucket problem instances in ONE device program.
 
@@ -1007,9 +1135,10 @@ def run_many_batched(
         )
 
     met = get_metrics()
-    if met.enabled:
-        met.inc("engine.batch_groups")
-        met.inc("engine.instances_batched", K)
+    # counted on the FIRST successful dispatch: a group that OOMs
+    # before running any chunk re-enters as two half-groups, and only
+    # the groups that actually executed should land on the counters
+    group_counted = False
 
     def make_runner(n: int):
         cache_key = cache_key_base + (n,)
@@ -1052,6 +1181,94 @@ def run_many_batched(
     runner = make_runner(min(chunk_size, rounds))
     small_runner = None
 
+    sup = get_supervisor()
+
+    def _split_and_rerun(cause: BaseException) -> List[RunResult]:
+        """OOM degradation for a stacked group: split the instance
+        stack in half and re-dispatch each half as its own (recursive)
+        ``run_many_batched`` call from round 0.
+
+        Stream-preserving by construction — every instance keeps its
+        own seed and the same chunk schedule, so the halves' results
+        are bit-identical to the fault-free group run.  Equal-sized
+        halves also share ONE vmapped runner cache entry (the cache
+        keys on K), so a split costs at most one extra compile per
+        distinct half size (``tools/recompile_guard.py:
+        run_supervisor_guard`` pins this).  Restarting from round 0
+        discards at most the chunks already run — real OOM almost
+        always fires on the FIRST dispatch of an over-wide group, and
+        the injected capacity model always does."""
+        if met.enabled:
+            met.inc("engine.oom_splits")
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event(
+                "oom-split", cat="supervisor", scope="engine.group",
+                instances=K, error=str(cause)[:200],
+            )
+        from pydcop_tpu.ops.compile import stack_problems
+
+        mid = (K + 1) // 2
+        out: List[RunResult] = []
+        for lo, hi in ((0, mid), (mid, K)):
+            halves = stack_problems(stacked.host_problems[lo:hi])
+            # same bucket by construction: one group comes back
+            half = halves[0]
+            remaining = (
+                None
+                if timeout is None
+                else max(timeout - (time.perf_counter() - t0), 0.01)
+            )
+            out.extend(
+                run_many_batched(
+                    half,
+                    algo_module,
+                    params_list[lo:hi],
+                    rounds=rounds,
+                    seeds=seeds[lo:hi],
+                    timeout=remaining,
+                    chunk_size=chunk_size,
+                    convergence_chunks=convergence_chunks,
+                    cost_every=cost_every,
+                    n_restarts=n_restarts,
+                    mesh=mesh,
+                    donate=donate,
+                )
+            )
+        return out
+
+    def _restart_group(
+        new_chunk: Optional[int] = None, attempt: int = 0
+    ) -> List[RunResult]:
+        """Caller-level recovery when the donated carries are gone: a
+        REAL failure surfaces at the sync point, AFTER the donated
+        dispatch consumed its input buffers, so re-dispatching in
+        place would touch deleted arrays.  Re-enter the WHOLE group
+        from round 0 instead — the host-side stacks are intact, the
+        runner cache is warm (zero recompiles), and the replay is
+        stream-preserving (same seeds, same chunk schedule unless
+        ``new_chunk`` shrinks it)."""
+        remaining = (
+            None
+            if timeout is None
+            else max(timeout - (time.perf_counter() - t0), 0.01)
+        )
+        return run_many_batched(
+            stacked,
+            algo_module,
+            params_list,
+            rounds=rounds,
+            seeds=seeds,
+            timeout=remaining,
+            chunk_size=new_chunk or chunk_size,
+            convergence_chunks=convergence_chunks,
+            cost_every=cost_every,
+            n_restarts=n_restarts,
+            mesh=mesh,
+            donate=donate,
+            _attempt=attempt,
+        )
+
     def _per_instance_best(bc: np.ndarray) -> np.ndarray:
         return bc.min(axis=-1) if batched_restarts else bc
 
@@ -1059,6 +1276,11 @@ def run_many_batched(
     done = 0
     status = "finished"
     stall = 0
+    # lane -> (best_cost row, best_values row) snapshot at the
+    # boundary the lane went numerically poisoned: the group keeps
+    # running for the healthy K-1 lanes, the quarantined lane reports
+    # this last-finite anytime best with status='degraded'
+    quarantined: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
     prev_best = _per_instance_best(np.asarray(best_cost))
     prev_values = np.asarray(best_values)
     tr = get_tracer()
@@ -1073,22 +1295,156 @@ def run_many_batched(
         k_chunk = jax.vmap(
             lambda k: jax.random.fold_in(k, done)
         )(k_run)
-        with tr.span(
-            "cycle", cat="cycle", first=done, rounds=this_chunk,
-            instances=K,
-        ):
-            state, best_cost, best_values, costs = r(
+
+        def _run_chunk(r=r, k_chunk=k_chunk):
+            s, bc, bv, costs = r(
                 problem, state, k_chunk, dyn_params, best_cost,
                 best_values,
             )
-            costs_np = np.asarray(costs)  # [K, samples(, R)]
+            return s, bc, bv, np.asarray(costs)
+
+        try:
+            with tr.span(
+                "cycle", cat="cycle", first=done, rounds=this_chunk,
+                instances=K,
+            ):
+                state, best_cost, best_values, costs_np = sup.dispatch(
+                    _run_chunk, scope="engine.group",
+                    width=K * n_restarts, rounds=this_chunk,
+                    # donated carries are consumed AT dispatch, so a
+                    # real failure surfacing at the sync point cannot
+                    # be replayed in place — the supervisor hands it
+                    # back (DeviceTransientError) for the group
+                    # restart below instead
+                    retryable=not donate,
+                )  # costs_np: [K, samples(, R)]
+        except DeviceTransientError as e:
+            # real transient after the donated carries were consumed:
+            # the retry is a whole-group restart from round 0 —
+            # bit-identical to an uninterrupted run, warm-cache cheap
+            if _attempt >= sup.config.retry_budget:
+                raise UnrecoverableDeviceError(
+                    "engine.group: transient device failure "
+                    "persisted through the retry budget "
+                    f"({sup.config.retry_budget}) across group "
+                    f"restarts: {e}",
+                    scope="engine.group", kind="transient",
+                    attempts=_attempt,
+                ) from e
+            if met.enabled:
+                met.inc("engine.retries")
+            if tr.enabled:
+                tr.event(
+                    "group-restart", cat="supervisor",
+                    scope="engine.group", attempt=_attempt + 1,
+                    error=str(e)[:200],
+                )
+            delays = backoff_delays(
+                base=sup.config.backoff_base,
+                factor=sup.config.backoff_factor,
+                max_delay=sup.config.backoff_max,
+                jitter=sup.config.backoff_jitter,
+                seed=(
+                    sup.config.plan.seed
+                    if sup.config.plan is not None
+                    else 0
+                ),
+                key="supervisor:engine.group.restart",
+            )
+            for _ in range(_attempt):  # pure keyed stream: skip to
+                next(delays)  # this restart's attempt position
+            sup.config.sleep(next(delays))
+            return _restart_group(attempt=_attempt + 1)
+        except DeviceOOMError as e:
+            if K > 1:
+                return _split_and_rerun(e)
+            # single-lane group: the same chunk-halving ladder as
+            # run_batched, then genuinely over capacity
+            new_chunk = max(sup.chunk_floor, this_chunk // 2)
+            if new_chunk >= this_chunk:
+                raise UnrecoverableDeviceError(
+                    f"device OOM on a single-instance group with the "
+                    f"chunk already at the floor ({this_chunk} "
+                    f"rounds, chunk_floor={sup.chunk_floor}): {e}",
+                    scope="engine.group", kind="oom",
+                ) from e
+            if met.enabled:
+                met.inc("engine.oom_chunk_halvings")
+            if tr.enabled:
+                tr.event(
+                    "oom-halve", cat="supervisor", chunk=new_chunk,
+                    round=done,
+                )
+            if donate and not e.injected:
+                # real allocation failure after the donated carries
+                # were consumed: the in-place continue below would
+                # touch deleted buffers — restart from round 0 at the
+                # halved chunk instead (injected OOM fires BEFORE
+                # dispatch, so its carries are intact)
+                return _restart_group(
+                    new_chunk=new_chunk, attempt=_attempt
+                )
+            chunk_size = new_chunk
+            runner = make_runner(min(chunk_size, rounds))
+            small_runner = None
+            continue
+        if not group_counted:
+            group_counted = True
+            if met.enabled:
+                met.inc("engine.batch_groups")
+                met.inc("engine.instances_batched", K)
         if met.enabled:
             met.inc("engine.chunks")
             met.inc("engine.rounds", this_chunk)
         if batched_restarts:
             costs_np = costs_np.min(axis=-1)
+        # per-lane numeric-fault screen (and the nan_inject seam):
+        # one isnan scan over the already-on-host cost trace.  A
+        # poisoned lane is quarantined — snapshotted and reported
+        # degraded — while the other K-1 lanes keep running
+        # bit-identically (vmap lanes never exchange data)
+        if sup.active:
+            lanes = sup.nan_lanes(K, scope="engine.group")
+            if lanes:
+                costs_np = np.array(costs_np)  # device view: CoW
+                for lane in lanes:
+                    costs_np[lane, -1] = np.nan
+            bad = np.isnan(costs_np).any(axis=1)
+            new_bad = [
+                int(i)
+                for i in np.nonzero(bad)[0]
+                if int(i) not in quarantined
+            ]
+            if new_bad:
+                if met.enabled:
+                    met.inc("engine.numeric_faults", len(new_bad))
+                if sup.on_numeric_fault == "raise":
+                    raise UnrecoverableDeviceError(
+                        f"NaN cost in instance lane(s) {new_bad} at "
+                        f"round {done + this_chunk} under "
+                        "on_numeric_fault='raise'",
+                        scope="engine.group", kind="numeric",
+                    )
+                bc_np = np.asarray(best_cost)
+                bv_np = np.asarray(best_values)
+                for i in new_bad:
+                    quarantined[i] = (
+                        np.array(bc_np[i]), np.array(bv_np[i]),
+                    )
+                    if met.enabled:
+                        met.inc("engine.quarantined_instances")
+                    if tr.enabled:
+                        tr.event(
+                            "quarantine", cat="supervisor",
+                            scope="engine.group", lane=i,
+                            round=done + this_chunk,
+                        )
         traces.append(costs_np)
         done += this_chunk
+        if len(quarantined) == K:
+            # nothing healthy left to run rounds for
+            status = "degraded"
+            break
         if timeout is not None and time.perf_counter() - t0 > timeout:
             status = "timeout"
             break
@@ -1133,8 +1489,26 @@ def run_many_batched(
         fc = np.asarray(
             jax.vmap(total_cost)(problem, state["values"])
         )
-        fv = final_values
-        bv, bc = best_values_np, best_cost_np
+        fv = np.array(final_values)
+        bv, bc = np.array(best_values_np), best_cost_np
+    fc = np.array(fc, dtype=np.float64)
+    bc = np.array(bc, dtype=np.float64)
+    statuses = [status] * K
+    for i, (q_bc, q_bv) in quarantined.items():
+        # the lane's post-poison device values are not trusted:
+        # report its snapshot (last-finite anytime best) as BOTH
+        # final and best, the message-plane degraded contract
+        statuses[i] = "degraded"
+        if batched_restarts:
+            j = int(np.argmin(q_bc))
+            restart_costs_np[i] = sign * q_bc
+            lane_bv, lane_bc = q_bv[j], float(q_bc[j])
+        else:
+            lane_bv, lane_bc = q_bv, float(q_bc)
+        fv[i] = lane_bv
+        bv[i] = lane_bv
+        fc[i] = lane_bc
+        bc[i] = lane_bc
     elapsed = time.perf_counter() - t0
     trace = (
         np.concatenate(traces, axis=1)
@@ -1157,7 +1531,7 @@ def run_many_batched(
                 cycles=done,
                 messages=msgs,
                 time=elapsed,
-                status=status,
+                status=statuses[i],
                 cost_trace=sign * trace[i],
                 restart_costs=(
                     restart_costs_np[i] if batched_restarts else None

@@ -230,6 +230,13 @@ def run_dynamic(
             state_transfers += 1
         if result.status == "timeout":
             status = "timeout"
+        elif result.status == "degraded" and status != "timeout":
+            # a NaN-quarantined segment (docs/faults.md recovery
+            # matrix) reported its last-finite anytime best; later
+            # segments restart from that trusted snapshot (the
+            # poisoned carry was dropped above), but the run must
+            # still SAY it degraded — sticky, like timeout
+            status = "degraded"
         get_tracer().add_span(
             "segment", "cycle", t_seg, time.perf_counter() - t_seg,
             rounds=result.cycles, state_carried=carried,

@@ -1,0 +1,503 @@
+"""Supervised device execution: the failure-classification and
+recovery seam every engine dispatch routes through.
+
+PR 1 made the *message planes* fault-tolerant; the device engine that
+carries almost all the work — the ``engine/batched.py`` chunk
+runners, ``run_many_batched`` vmapped instance groups, the DPOP
+level-synchronous UTIL sweeps — still failed whole calls on the first
+transient XLA error, HBM exhaustion, or a single NaN-poisoned
+instance.  This module is the device analogue of the message plane's
+chaos/backoff stack: one :class:`Supervisor` wraps every device
+dispatch and
+
+- **classifies failures** (:func:`classify_failure`): transient
+  runtime errors retry in place with the shared deterministic
+  keyed-jitter backoff (``utils/backoff.py``) under a per-call
+  ``retry_budget``; ``RESOURCE_EXHAUSTED``/OOM surfaces as
+  :class:`DeviceOOMError` so the *caller* can degrade adaptively
+  (``run_batched`` halves its chunk size down to ``chunk_floor``;
+  ``run_many_batched`` splits the vmapped instance group and
+  re-dispatches the halves — stream-preserving, so results stay
+  bit-identical — and DPOP splits a level stack, falling back to the
+  exact host f64 join when even a single row won't fit); everything
+  else is unrecoverable and surfaces with full telemetry context
+  (engines write a final checkpoint first when one is configured);
+- **hosts the injection seam** for the seeded device-layer fault
+  kinds (``device_oom``, ``device_transient``, ``nan_inject`` —
+  ``pydcop_tpu.faults.plan.DeviceFaults``): injected faults fire
+  BEFORE the wrapped call, deterministically per ``(plan seed, scope,
+  sequence number)``, under the same ``--chaos SPEC --chaos_seed N``
+  contract as the message-plane chaos layer — so every recovery path
+  above is testable on demand (``tests/test_supervisor.py``,
+  ``tools/recompile_guard.py:run_supervisor_guard``);
+- **screens numeric faults**: engines hand each chunk boundary's cost
+  samples to :meth:`Supervisor.nan_lanes`/``numpy.isnan`` screens and
+  quarantine only the poisoned instances out of a ``solve_many``
+  group (``on_numeric_fault='quarantine'``: the lane finishes with
+  ``status="degraded"`` carrying its last-finite anytime best, the
+  other K−1 lanes are untouched and bit-identical;
+  ``'raise'``: the whole call fails).  Only NaN is treated as poison:
+  ``±inf`` is a legitimate cost for hard-constraint tables, NaN never
+  is.
+
+Telemetry: counters ``engine.retries``, ``engine.oom_splits``,
+``engine.oom_chunk_halvings``, ``engine.quarantined_instances``,
+``engine.numeric_faults`` plus ``fault.device_oom`` /
+``fault.device_transient`` / ``fault.nan_inject`` per injected fault,
+and ``supervisor``-category trace events for every recovery action —
+all landing in ``result["telemetry"]`` (``docs/faults.md`` has the
+fault → action → status/counter recovery matrix).
+
+This module is deliberately jax-free (classification is by exception
+type name + status-code markers), so the host-path engines
+(``engine/host_batch.py``, pure-host DPOP/SyncBB) stay importable
+without the jax import chain.
+
+The active supervisor is ambient (:func:`get_supervisor` /
+:func:`supervision`), like the telemetry session: ``api.solve`` /
+``api.solve_many`` install one per call from the ``retry_budget``,
+``chunk_floor``, ``on_numeric_fault`` and ``chaos`` knobs, and every
+engine layer underneath — including DPOP level sweeps reached through
+``solve_host_many`` and dynamic-run segments reached through
+``run_batched`` — picks it up without signature plumbing.  With no
+session-scoped supervisor installed, a process-default one (retries
+on, no injection) supervises every dispatch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from pydcop_tpu.telemetry import get_metrics, get_tracer
+from pydcop_tpu.utils.backoff import backoff_delays
+
+
+class DeviceOOMError(RuntimeError):
+    """A device dispatch exhausted accelerator memory (real
+    ``RESOURCE_EXHAUSTED`` or injected ``device_oom``).  Engines catch
+    this and degrade — halve the chunk, split the group — instead of
+    failing the call.
+
+    ``injected`` distinguishes the chaos plan's capacity model (fires
+    BEFORE the wrapped call, so the caller's carry buffers are
+    untouched) from a real allocation failure surfacing at the sync
+    point (a donated dispatch has already consumed its carries —
+    in-place re-dispatch would touch deleted buffers)."""
+
+    def __init__(self, message: str, *, injected: bool = False):
+        super().__init__(message)
+        self.injected = injected
+
+
+class DeviceTransientError(RuntimeError):
+    """An injected transient device failure (``device_transient``) —
+    the scripted analogue of a flaky XLA ``UNAVAILABLE``/``INTERNAL``
+    runtime error."""
+
+
+class UnrecoverableDeviceError(RuntimeError):
+    """A supervised dispatch that could not be saved: the transient
+    retry budget is exhausted, the OOM degradation ladder bottomed
+    out (chunk at floor / single-lane dispatch still over capacity),
+    or an instance went numerically poisoned under
+    ``on_numeric_fault='raise'``.  Carries the dispatch context the
+    postmortem needs; engines write a final checkpoint before letting
+    it surface when one is configured."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        scope: Optional[str] = None,
+        kind: str = "fatal",
+        attempts: int = 0,
+    ):
+        super().__init__(message)
+        self.scope = scope
+        self.kind = kind  # 'transient' | 'oom' | 'numeric' | 'fatal'
+        self.attempts = attempts
+
+
+# status-code / message markers for classification.  OOM is checked
+# first: an XLA allocation failure often carries both RESOURCE_
+# EXHAUSTED and INTERNAL-looking text, and retrying an OOM verbatim
+# is pointless — degradation is the only move that changes anything.
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "RESOURCE EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "OOM",
+    "Failed to allocate",
+    "failed to allocate",
+)
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "CANCELLED",
+    "DATA_LOSS",
+    "INTERNAL",
+    "Socket closed",
+    "connection reset",
+)
+
+
+def classify_failure(exc: BaseException) -> str:
+    """``'oom'`` | ``'transient'`` | ``'fatal'``.
+
+    Classification is by exception type NAME plus status-code markers
+    in the message — never by importing jax types, so this module
+    stays importable on the jax-free host paths.  Python-level usage
+    errors (``ValueError``, ``TypeError``, shape mismatches raised at
+    trace time) classify fatal: retrying a bug never fixes it."""
+    if isinstance(exc, DeviceOOMError):
+        return "oom"
+    if isinstance(exc, DeviceTransientError):
+        return "transient"
+    if isinstance(exc, MemoryError):
+        return "oom"
+    text = f"{type(exc).__name__}: {exc}"
+    if any(m in text for m in _OOM_MARKERS):
+        return "oom"
+    if any(m in text for m in _TRANSIENT_MARKERS):
+        return "transient"
+    return "fatal"
+
+
+@dataclass
+class SupervisorConfig:
+    """Knobs of one supervised call (``api.solve(retry_budget=...,
+    chunk_floor=..., on_numeric_fault=...)`` / the solve/run/batch
+    CLI flags).
+
+    ``retry_budget`` bounds transient retries PER DISPATCH (0 turns
+    retry off).  ``chunk_floor`` is the smallest chunk size the OOM
+    degradation ladder may halve down to — the ``max_util_bytes``-
+    style floor below which a run is declared genuinely over
+    capacity.  ``on_numeric_fault`` picks quarantine (degrade only
+    the poisoned instances) or raise (fail the call).  ``plan`` is a
+    :class:`~pydcop_tpu.faults.plan.FaultPlan` whose device-layer
+    kinds inject at this seam; its seed also keys the deterministic
+    retry-backoff jitter so chaos replays reproduce retry timing
+    exactly."""
+
+    retry_budget: int = 2
+    chunk_floor: int = 8
+    on_numeric_fault: str = "quarantine"  # 'quarantine' | 'raise'
+    backoff_base: float = 0.02
+    backoff_factor: float = 2.0
+    backoff_max: float = 0.25
+    backoff_jitter: float = 0.25
+    plan: Optional[Any] = None  # FaultPlan (device-layer kinds)
+    sleep: Callable[[float], None] = field(default=time.sleep)
+
+    def __post_init__(self) -> None:
+        if self.retry_budget < 0:
+            raise ValueError(
+                f"retry_budget must be >= 0, got {self.retry_budget}"
+            )
+        if self.chunk_floor < 1:
+            raise ValueError(
+                f"chunk_floor must be >= 1, got {self.chunk_floor}"
+            )
+        if self.on_numeric_fault not in ("quarantine", "raise"):
+            raise ValueError(
+                "on_numeric_fault must be 'quarantine' or 'raise', "
+                f"got {self.on_numeric_fault!r}"
+            )
+
+
+class Supervisor:
+    """Supervised dispatch wrapper (module docstring).
+
+    Dispatch sequence numbers are per-scope and deterministic (device
+    calls are issued in a deterministic order by every engine), which
+    is what makes the injected fault schedule replayable: fault
+    decisions are pure in ``(plan seed, scope, seq)``.
+    """
+
+    #: real supervisor — engines run injection + numeric screening.
+    #: (:data:`UNSUPERVISED` flips this off for the bench baseline.)
+    active = True
+
+    def __init__(self, config: Optional[SupervisorConfig] = None):
+        self.config = config or SupervisorConfig()
+        self._seq: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- knob accessors the engines read --------------------------------
+
+    @property
+    def plan(self):
+        return self.config.plan
+
+    @property
+    def chunk_floor(self) -> int:
+        return self.config.chunk_floor
+
+    @property
+    def on_numeric_fault(self) -> str:
+        return self.config.on_numeric_fault
+
+    # -- internals -------------------------------------------------------
+
+    def _next_seq(self, scope: str) -> int:
+        with self._lock:
+            s = self._seq[scope] = self._seq.get(scope, 0) + 1
+        return s
+
+    def _record_fault(self, kind: str, scope: str, seq: int) -> None:
+        """Injected faults land on the run's telemetry exactly like
+        the message-plane chaos layer's: ``fault.<kind>`` counters and
+        ``fault``-category events carrying scope/seq/seed."""
+        met = get_metrics()
+        if met.enabled:
+            met.inc(f"fault.{kind}")
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event(
+                kind, cat="fault", link=scope, seq=seq,
+                seed=self.config.plan.seed if self.config.plan else None,
+            )
+
+    def _inject(
+        self, scope: str, seq: int, width: int, rounds: Optional[int]
+    ) -> None:
+        plan = self.config.plan
+        if plan is None or not plan.device_faults_configured:
+            return
+        if plan.oom_injected(width, rounds):
+            self._record_fault("device_oom", scope, seq)
+            raise DeviceOOMError(
+                f"injected device OOM: dispatch {scope}#{seq} "
+                f"(width={width}, rounds={rounds}) exceeds the chaos "
+                "plan's capacity",
+                injected=True,
+            )
+        if plan.decide_device_transient(scope, seq):
+            self._record_fault("device_transient", scope, seq)
+            raise DeviceTransientError(
+                f"injected transient device failure: {scope}#{seq}"
+            )
+
+    # -- the dispatch seam -----------------------------------------------
+
+    def dispatch(
+        self,
+        fn: Callable[[], Any],
+        *,
+        scope: str = "engine.chunk",
+        width: int = 1,
+        rounds: Optional[int] = None,
+        retryable: bool = True,
+    ):
+        """Run one device dispatch under supervision and return its
+        result.
+
+        ``fn`` must be a zero-arg closure that runs the device call
+        AND forces its outputs to host (``np.asarray``) — with jax's
+        async dispatch, a runtime failure only surfaces at the sync
+        point, and it must surface HERE to be classified.  ``width``
+        is the dispatch's vmapped lane count (instances × restarts,
+        or a DPOP stack height) and ``rounds`` its scanned round
+        count — the quantities the injected capacity model and the
+        callers' degradation moves operate on.
+
+        Transient failures retry in place (seeded keyed-jitter
+        backoff, ``engine.retries``) up to ``retry_budget`` times,
+        then surface as :class:`UnrecoverableDeviceError`.  OOM —
+        real or injected — always surfaces as
+        :class:`DeviceOOMError` for the caller's degradation ladder:
+        retrying the identical dispatch cannot un-exhaust memory.
+        Fatal failures re-raise UNWRAPPED (the original type is the
+        diagnosis) after a telemetry event.
+
+        ``retryable=False`` says ``fn`` must NOT be called again
+        after a REAL failure: a dispatch that donates its carry
+        buffers (``run_many_batched`` with ``donate=True``) has
+        already consumed its inputs by the time the failure surfaces
+        at the sync point, so an in-place replay would touch deleted
+        buffers.  Real transients then surface as
+        :class:`DeviceTransientError` for a caller-level restart
+        (which owns the retry budget for that path).  Injected
+        faults fire BEFORE the wrapped call runs — carries untouched
+        — so they retry in place regardless.
+        """
+        cfg = self.config
+        met = get_metrics()
+        tr = get_tracer()
+        attempts = 0
+        delays: Optional[Iterator[float]] = None
+
+        def _backoff(seq: int) -> None:
+            nonlocal attempts, delays
+            attempts += 1
+            if met.enabled:
+                met.inc("engine.retries")
+            if tr.enabled:
+                tr.event(
+                    "retry", cat="supervisor", scope=scope,
+                    seq=seq, attempt=attempts,
+                )
+            if delays is None:
+                delays = backoff_delays(
+                    base=cfg.backoff_base,
+                    factor=cfg.backoff_factor,
+                    max_delay=cfg.backoff_max,
+                    jitter=cfg.backoff_jitter,
+                    seed=(
+                        cfg.plan.seed if cfg.plan is not None else 0
+                    ),
+                    key=f"supervisor:{scope}",
+                )
+            cfg.sleep(next(delays))
+
+        def _exhausted(seq: int, e: BaseException) -> None:
+            if tr.enabled:
+                tr.event(
+                    "retry-exhausted", cat="supervisor",
+                    scope=scope, seq=seq, attempts=attempts,
+                )
+            raise UnrecoverableDeviceError(
+                f"{scope}: transient device failure persisted "
+                f"through the retry budget "
+                f"({cfg.retry_budget}): {e}",
+                scope=scope, kind="transient", attempts=attempts,
+            ) from e
+
+        while True:
+            seq = self._next_seq(scope)
+            try:
+                self._inject(scope, seq, width, rounds)
+            except DeviceTransientError as e:
+                # injected BEFORE fn ran: in-place retry is sound
+                # even for donated dispatches
+                if attempts < cfg.retry_budget:
+                    _backoff(seq)
+                    continue
+                _exhausted(seq, e)
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — classified below
+                kind = classify_failure(e)
+                if kind == "oom":
+                    if isinstance(e, DeviceOOMError):
+                        raise
+                    raise DeviceOOMError(f"{scope}: {e}") from e
+                if kind == "transient":
+                    if retryable and attempts < cfg.retry_budget:
+                        _backoff(seq)
+                        continue
+                    if not retryable:
+                        # hand the transient back for a caller-level
+                        # restart — fn's inputs may be consumed
+                        if isinstance(e, DeviceTransientError):
+                            raise
+                        raise DeviceTransientError(
+                            f"{scope}: {e}"
+                        ) from e
+                    _exhausted(seq, e)
+                if tr.enabled:
+                    tr.event(
+                        "fatal", cat="supervisor", scope=scope,
+                        seq=seq, error=str(e)[:200],
+                    )
+                raise  # fatal: the original exception IS the report
+
+    # -- numeric-fault injection (the nan_inject seam) -------------------
+
+    def nan_lanes(self, n_lanes: int, scope: str = "engine.chunk") -> List[int]:
+        """Stack lanes whose carry the chaos plan poisons at this
+        chunk boundary (empty without a plan).  Boundary sequence
+        numbers are per-scope, so the schedule is replayable."""
+        plan = self.config.plan
+        if plan is None or not plan.device.nan:
+            return []
+        seq = self._next_seq(f"nan:{scope}")
+        lanes = [
+            i for i in range(n_lanes) if plan.decide_nan_inject(i, seq)
+        ]
+        for i in lanes:
+            self._record_fault("nan_inject", f"{scope}[{i}]", seq)
+        return lanes
+
+
+class _Unsupervised:
+    """Bare dispatch — no classification, no retry, no injection, no
+    numeric screening.  The measured baseline of the bench's
+    ``supervised_overhead`` stage; never the default."""
+
+    active = False
+    plan = None
+    chunk_floor = 1
+    on_numeric_fault = "quarantine"
+
+    def dispatch(self, fn, **_kw):
+        return fn()
+
+    def nan_lanes(self, n_lanes, scope="engine.chunk"):
+        return []
+
+
+UNSUPERVISED = _Unsupervised()
+
+_ACTIVE: Optional[Supervisor] = None
+_DEFAULT: Optional[Supervisor] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def make_supervisor(
+    retry_budget: Optional[int] = None,
+    chunk_floor: Optional[int] = None,
+    on_numeric_fault: Optional[str] = None,
+    plan: Optional[Any] = None,
+) -> Supervisor:
+    """Build a per-call :class:`Supervisor` from optional knobs —
+    ``None`` means "use the :class:`SupervisorConfig` default", so the
+    dataclass stays the single place those defaults live (the api /
+    CLI entry points all construct through here)."""
+    knobs = {
+        "retry_budget": retry_budget,
+        "chunk_floor": chunk_floor,
+        "on_numeric_fault": on_numeric_fault,
+    }
+    return Supervisor(
+        SupervisorConfig(
+            plan=plan,
+            **{k: v for k, v in knobs.items() if v is not None},
+        )
+    )
+
+
+def get_supervisor() -> Supervisor:
+    """The ambient supervisor: the one :func:`supervision` installed,
+    else a process-default (retries on, no injection)."""
+    sup = _ACTIVE
+    if sup is not None:
+        return sup
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = Supervisor()
+    return _DEFAULT
+
+
+@contextlib.contextmanager
+def supervision(sup: Supervisor) -> Iterator[Supervisor]:
+    """Install ``sup`` as the ambient supervisor for the block (the
+    telemetry-session model: one supervised call per process at a
+    time; concurrent calls share the installed supervisor, which only
+    blurs per-call sequence numbering, not correctness)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = sup
+    try:
+        yield sup
+    finally:
+        _ACTIVE = prev

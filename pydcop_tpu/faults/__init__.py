@@ -14,6 +14,12 @@ harness:
 - :class:`~pydcop_tpu.faults.chaos.ChaosCommunicationLayer` — wraps
   any :class:`~pydcop_tpu.infrastructure.communication.CommunicationLayer`
   (in-process or TCP) and applies the plan to every outbound message.
+- **Device-layer fault kinds** (``device_oom``, ``device_transient``,
+  ``nan_inject``) extend the same seeded contract BELOW the message
+  plane: they are injected at the supervised device-dispatch seam
+  (:mod:`pydcop_tpu.engine.supervisor`) so the batched engine's
+  recovery paths — transient retry, OOM chunk-halving and group
+  splits, per-instance NaN quarantine — are exercised on demand.
 
 Wired through ``--chaos SPEC --chaos_seed N`` on the ``solve``,
 ``run``, ``agent`` and ``orchestrator`` commands and through
@@ -23,6 +29,7 @@ run's result metadata for replay.  See ``docs/faults.md``.
 
 from pydcop_tpu.faults.chaos import ChaosCommunicationLayer
 from pydcop_tpu.faults.plan import (
+    DeviceFaults,
     FaultPlan,
     FaultSpecError,
     LinkFaults,
@@ -31,6 +38,7 @@ from pydcop_tpu.faults.plan import (
 
 __all__ = [
     "ChaosCommunicationLayer",
+    "DeviceFaults",
     "FaultPlan",
     "FaultSpecError",
     "LinkFaults",

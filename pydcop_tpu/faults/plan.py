@@ -27,6 +27,30 @@ Plans are built programmatically or parsed from the compact
 - ``crash=AGENT@T`` hard-kills the agent's process ``T`` seconds into
   the run (the scripted analogue of SIGKILL, for exercising the
   replication/repair machinery on demand).
+
+Device-layer fault kinds (below the message plane; injected at the
+supervised-dispatch seam of ``engine/supervisor.py``, same
+``--chaos SPEC --chaos_seed N`` contract):
+
+- ``device_oom=W`` / ``device_oom=W:R`` — every device dispatch whose
+  *width* (vmapped instance lanes × restarts, or a DPOP level-stack
+  height) exceeds ``W`` raises ``RESOURCE_EXHAUSTED`` (``W='-'``: no
+  width cap); with ``:R``, dispatches covering more than ``R`` rounds
+  OOM too.  A capacity model, not a coin flip: it is what makes the
+  supervisor's degradation ladder (halve chunks, split groups)
+  *converge* — once a dispatch fits the injected capacity it succeeds,
+  exactly like real HBM.
+- ``device_transient=P`` / ``device_transient=P:AFTER`` — each
+  dispatch *attempt* fails with a transient runtime error with
+  probability ``P``, hashed on ``(seed, dispatch scope, attempt
+  seq)``; retries draw fresh seqs, so ``P < 1`` eventually succeeds.
+  With ``:AFTER``, only attempts with seq > ``AFTER`` can fail — the
+  deterministic "run fine for N dispatches, then die" schedule the
+  crash-resume tests use.
+- ``nan_inject=P`` / ``nan_inject=P:I`` — at each chunk boundary,
+  poison an instance's carry with NaN with probability ``P`` (hashed
+  on ``(seed, instance, boundary seq)``); ``:I`` restricts the
+  injection to stack lane ``I`` of a ``solve_many`` group.
 """
 
 from __future__ import annotations
@@ -75,6 +99,39 @@ class Partition:
         return self.a == dst and self.b in (src, "*")
 
 
+@dataclass(frozen=True)
+class DeviceFaults:
+    """Device-layer fault injection parameters (all default off).
+
+    ``oom_width_cap``/``oom_rounds_cap`` model an HBM capacity: any
+    supervised dispatch wider (more vmapped lanes) or longer (more
+    scanned rounds) than the cap raises ``RESOURCE_EXHAUSTED`` —
+    deterministically, so the supervisor's degradation ladder
+    converges the moment a re-dispatch fits.  ``transient`` is a
+    per-attempt failure probability (hashed, so retries with fresh
+    sequence numbers can succeed); ``transient_after`` exempts the
+    first N attempts of every scope (the deterministic
+    "die mid-run" schedule).  ``nan`` poisons instance carries at
+    chunk boundaries; ``nan_instance`` restricts it to one stack
+    lane."""
+
+    oom_width_cap: Optional[int] = None
+    oom_rounds_cap: Optional[int] = None
+    transient: float = 0.0
+    transient_after: int = 0
+    nan: float = 0.0
+    nan_instance: Optional[int] = None
+
+    @property
+    def configured(self) -> bool:
+        return (
+            self.oom_width_cap is not None
+            or self.oom_rounds_cap is not None
+            or self.transient > 0.0
+            or self.nan > 0.0
+        )
+
+
 class Decision(NamedTuple):
     """The fate of one message (at most one fault fires per message —
     drop wins over dup over reorder over delay)."""
@@ -109,6 +166,7 @@ class FaultPlan:
     links: Dict[Tuple[str, str], LinkFaults] = field(default_factory=dict)
     partitions: List[Partition] = field(default_factory=list)
     crashes: Dict[str, float] = field(default_factory=dict)
+    device: DeviceFaults = field(default_factory=DeviceFaults)
     spec: Optional[str] = None  # the source text, for run metadata
 
     # -- construction ---------------------------------------------------
@@ -119,6 +177,7 @@ class FaultPlan:
         plan = cls(seed=seed, spec=spec)
         overrides: Dict[Tuple[str, str], Dict[str, float]] = {}
         defaults: Dict[str, float] = {}
+        device_fields: Dict[str, object] = {}
         for raw in spec.split(","):
             clause = raw.strip()
             if not clause:
@@ -129,6 +188,14 @@ class FaultPlan:
             if clause.startswith("crash="):
                 agent, t = _parse_at(clause[6:], "crash")
                 plan.crashes[agent] = t
+                continue
+            if clause.startswith(
+                ("device_oom=", "device_transient=", "nan_inject=")
+            ):
+                key, val = clause.split("=", 1)
+                device_fields.update(
+                    _parse_device_value(key, val, clause)
+                )
                 continue
             m = _CLAUSE.match(clause)
             if not m:
@@ -147,6 +214,8 @@ class FaultPlan:
         plan.default = LinkFaults(**defaults)
         for lk, fields in overrides.items():
             plan.links[lk] = replace(plan.default, **fields)
+        if device_fields:
+            plan.device = DeviceFaults(**device_fields)
         plan.validate()
         return plan
 
@@ -174,6 +243,25 @@ class FaultPlan:
                 raise FaultSpecError(
                     f"chaos spec: crash={agent}@{t} in the past"
                 )
+        d = self.device
+        for name in ("transient", "nan"):
+            p = getattr(d, name)
+            if not 0.0 <= p <= 1.0:
+                raise FaultSpecError(
+                    f"chaos spec: device {name} probability {p} "
+                    "outside [0, 1]"
+                )
+        for name in ("oom_width_cap", "oom_rounds_cap", "nan_instance"):
+            v = getattr(d, name)
+            if v is not None and v < 0:
+                raise FaultSpecError(
+                    f"chaos spec: device {name}={v} must be >= 0"
+                )
+        if d.transient_after < 0:
+            raise FaultSpecError(
+                f"chaos spec: device_transient AFTER="
+                f"{d.transient_after} must be >= 0"
+            )
 
     def referenced_agents(self) -> set:
         """Every agent name the plan targets (crash schedules,
@@ -193,12 +281,21 @@ class FaultPlan:
     @property
     def message_faults_configured(self) -> bool:
         """True when anything beyond crash schedules is configured —
-        engines without a message plane accept crash-only plans."""
+        engines without a message plane accept crash-only plans.
+        Device-layer fault kinds are deliberately NOT message faults:
+        they target the supervised device dispatch of the batched
+        engine (``engine/supervisor.py``)."""
         return bool(
             self.partitions
             or self.links
             or self.default != LinkFaults()
         )
+
+    @property
+    def device_faults_configured(self) -> bool:
+        """True when any device-layer fault kind (``device_oom``,
+        ``device_transient``, ``nan_inject``) is configured."""
+        return self.device.configured
 
     # -- queries (all pure) ---------------------------------------------
 
@@ -240,6 +337,52 @@ class FaultPlan:
     def crash_at(self, agent: str) -> Optional[float]:
         return self.crashes.get(agent)
 
+    # -- device-layer queries (all pure, engine/supervisor.py seam) ------
+
+    def oom_injected(
+        self, width: int, rounds: Optional[int] = None
+    ) -> bool:
+        """Whether a device dispatch of ``width`` vmapped lanes
+        covering ``rounds`` scanned rounds exceeds the injected
+        capacity — a deterministic capacity model (no hashing), so a
+        degraded re-dispatch that fits always succeeds."""
+        d = self.device
+        if d.oom_width_cap is not None and width > d.oom_width_cap:
+            return True
+        return (
+            d.oom_rounds_cap is not None
+            and rounds is not None
+            and rounds > d.oom_rounds_cap
+        )
+
+    def decide_device_transient(self, scope: str, seq: int) -> bool:
+        """Whether dispatch attempt number ``seq`` (1-based, per
+        supervisor scope) fails transiently.  Pure in
+        ``(seed, scope, seq)``; retry attempts draw fresh seqs, so
+        probabilities < 1 eventually let a retry through."""
+        d = self.device
+        if not d.transient or seq <= d.transient_after:
+            return False
+        if d.transient >= 1.0:
+            return True
+        return (
+            _u(self.seed, scope, seq, "device_transient") < d.transient
+        )
+
+    def decide_nan_inject(self, instance: int, seq: int) -> bool:
+        """Whether stack lane ``instance`` gets its carry poisoned at
+        chunk boundary ``seq``.  Pure in ``(seed, instance, seq)``."""
+        d = self.device
+        if not d.nan:
+            return False
+        if d.nan_instance is not None and instance != d.nan_instance:
+            return False
+        if d.nan >= 1.0:
+            return True
+        return (
+            _u(self.seed, f"lane{instance}", seq, "nan_inject") < d.nan
+        )
+
     def to_meta(self) -> Dict[str, object]:
         """The replay record for run metadata: spec + seed reconstruct
         the plan exactly (``FaultPlan.from_spec(spec, seed)``)."""
@@ -260,6 +403,41 @@ def _parse_fault_value(key: str, val: str, clause: str) -> Dict[str, float]:
     except ValueError:
         raise FaultSpecError(
             f"chaos spec: bad number in clause {clause!r}"
+        ) from None
+
+
+def _parse_device_value(
+    key: str, val: str, clause: str
+) -> Dict[str, object]:
+    """Parse one device-layer clause into :class:`DeviceFaults`
+    fields (``device_oom=W[:R]``, ``device_transient=P[:AFTER]``,
+    ``nan_inject=P[:I]`` — module docstring)."""
+    head, _, tail = val.partition(":")
+    try:
+        if key == "device_oom":
+            out: Dict[str, object] = {}
+            if head.strip() not in ("-", "*", ""):
+                out["oom_width_cap"] = int(head)
+            if tail:
+                out["oom_rounds_cap"] = int(tail)
+            if not out:
+                raise ValueError("empty device_oom clause")
+            return out
+        if key == "device_transient":
+            out = {"transient": float(head)}
+            if tail:
+                out["transient_after"] = int(tail)
+            return out
+        # nan_inject
+        out = {"nan": float(head)}
+        if tail:
+            out["nan_instance"] = int(tail)
+        return out
+    except ValueError:
+        raise FaultSpecError(
+            f"chaos spec: bad number in clause {clause!r} (expected "
+            "device_oom=W[:R], device_transient=P[:AFTER] or "
+            "nan_inject=P[:INSTANCE])"
         ) from None
 
 

@@ -104,6 +104,13 @@ class TcpCommunicationLayer(CommunicationLayer):
         # grace window) before the link is declared dead — a short
         # partition or peer restart is then a blip, not a run failure
         self.retry_window = retry_window
+        # retry-timing determinism: writer-loop backoff jitter is the
+        # keyed-hash variant (utils/backoff.py) — pure in (seed, dest,
+        # attempt) — so a chaos replay reproduces every link's retry
+        # schedule bit-for-bit regardless of thread interleaving.
+        # run_agent points this at the fault plan's seed; distinct
+        # destination keys keep links decorrelated from each other.
+        self.backoff_seed = 0
         # resend dedupe: highest frame seq delivered per sender id —
         # a reconnect resends its whole batch, and replaying a frame
         # into Messaging would double-count `delivered` and re-trigger
@@ -362,6 +369,8 @@ class TcpCommunicationLayer(CommunicationLayer):
                     self.retry_window,
                     base=0.05,
                     max_delay=1.0,
+                    seed=self.backoff_seed,
+                    key=f"hostnet:{dest_agent}",
                     giving_up=lambda: self._closing
                     or ch.dead is not None,
                 )
@@ -1224,6 +1233,12 @@ def run_host_agent(
         retry_for,
         base=0.1,
         max_delay=2.0,
+        # keyed deterministic jitter (utils/backoff.py): per-agent
+        # keys keep a fleet's connect storms decorrelated, while a
+        # chaos replay (same chaos_seed) reproduces each agent's
+        # connect timing exactly
+        seed=chaos_seed,
+        key=f"agent:{name}:connect",
     )
     conn.settimeout(None)
     reader = conn.makefile("rb")
@@ -1306,6 +1321,9 @@ def run_host_agent(
                 "chaos-plan", cat="fault",
                 spec=plan.spec, seed=plan.seed, agent=name,
             )
+        # the plan's seed also keys the message plane's retry-backoff
+        # jitter, so the whole retry schedule replays with the faults
+        comm.backoff_seed = plan.seed
         chaos_layer = ChaosCommunicationLayer(
             comm,
             plan,

@@ -1,21 +1,44 @@
 """Shared retry/backoff helper: every reconnect loop in the codebase
 (host agent control-plane connect, TCP message-plane writer resend,
-chaos-layer probes) goes through this one implementation, so backoff
-policy — exponential growth, cap, jitter — is tuned in exactly one
-place.
+the supervised device-dispatch retry path) goes through this one
+implementation, so backoff policy — exponential growth, cap, jitter —
+is tuned in exactly one place.
 
-Jitter is seedable: the fault-injection harness (``pydcop_tpu.faults``)
-replays runs, so a retry schedule must be reproducible when a seed is
-given (and decorrelated across callers when it is not).
+Jitter is deterministic on demand, two ways:
+
+- ``seed=`` alone draws the jitter stream from a private
+  ``random.Random(seed)`` — reproducible for a single caller, but two
+  loops sharing one seed perturb each other's schedules the moment
+  their draws interleave.
+- ``key=`` (with an optional ``seed``) switches to the *keyed hash*
+  variant: the jitter of attempt ``k`` is a pure blake2b hash of
+  ``(seed, key, k)`` — the exact determinism contract of
+  ``pydcop_tpu.faults.plan.FaultPlan`` decisions.  No shared stream,
+  no iteration-order dependence: the host-agent connect loop, every
+  TCP writer, and the device supervisor each pass their own key, so a
+  chaos replay reproduces every loop's retry timing bit-for-bit no
+  matter how the threads interleave.
 """
 
 from __future__ import annotations
 
+import hashlib
 import random
 import time
 from typing import Callable, Iterator, Optional, Tuple, Type, TypeVar
 
 T = TypeVar("T")
+
+
+def _hashed_unit(seed: int, key: str, attempt: int) -> float:
+    """Uniform [0, 1) from a keyed hash — same construction as
+    ``faults.plan._u``: the value depends on nothing but its
+    arguments, so schedules replay exactly and distinct keys are
+    decorrelated."""
+    h = hashlib.blake2b(
+        f"{seed}|{key}|{attempt}|backoff".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(h, "big") / 2.0**64
 
 
 def backoff_delays(
@@ -24,13 +47,27 @@ def backoff_delays(
     max_delay: float = 5.0,
     jitter: float = 0.25,
     seed: Optional[int] = None,
+    key: Optional[str] = None,
 ) -> Iterator[float]:
     """Yield an infinite stream of sleep delays: ``base`` growing by
-    ``factor`` up to ``max_delay``, each stretched by a random factor
+    ``factor`` up to ``max_delay``, each stretched by a jitter factor
     in ``[1, 1 + jitter]`` (full-jitter would allow 0-sleeps, which
-    turn a retry loop into a busy spin against a dead peer)."""
-    rnd = random.Random(seed)
+    turn a retry loop into a busy spin against a dead peer).
+
+    With ``key`` given, attempt ``k``'s jitter is the pure hash of
+    ``(seed or 0, key, k)`` (module docstring) — stateless and
+    per-caller reproducible; without it, jitter comes from a private
+    ``random.Random(seed)`` stream (decorrelated across callers when
+    ``seed`` is None)."""
     delay = base
+    if key is not None:
+        s = 0 if seed is None else seed
+        attempt = 0
+        while True:
+            attempt += 1
+            yield delay * (1.0 + jitter * _hashed_unit(s, key, attempt))
+            delay = min(delay * factor, max_delay)
+    rnd = random.Random(seed)
     while True:
         yield delay * (1.0 + jitter * rnd.random())
         delay = min(delay * factor, max_delay)
@@ -45,6 +82,7 @@ def call_with_backoff(
     max_delay: float = 5.0,
     jitter: float = 0.25,
     seed: Optional[int] = None,
+    key: Optional[str] = None,
     clock: Callable[[], float] = time.monotonic,
     sleep: Callable[[float], None] = time.sleep,
     giving_up: Optional[Callable[[], bool]] = None,
@@ -57,12 +95,13 @@ def call_with_backoff(
     aborts the retry loop early by returning True, re-raising the
     current failure instead of sleeping toward a deadline nobody is
     waiting on.  Sleeps never overshoot the deadline: the final attempt
-    happens AT the deadline, not ``max_delay`` past it.
+    happens AT the deadline, not ``max_delay`` past it.  ``key``
+    selects the keyed deterministic jitter (module docstring).
     """
     deadline = clock() + retry_for
     for delay in backoff_delays(
         base=base, factor=factor, max_delay=max_delay, jitter=jitter,
-        seed=seed,
+        seed=seed, key=key,
     ):
         try:
             return fn()

@@ -80,5 +80,23 @@ def test_dpop_guard_within_budget():
     assert report["level_dispatches"] < guard.DPOP_K * 10, report
 
 
+@pytest.mark.supervisor
+def test_supervisor_guard_within_budget():
+    """Supervised recovery must not hide a compile storm: transient
+    retries re-dispatch the already-compiled runner (ZERO new
+    compiles), an OOM group-split adds at most the one runner compile
+    its equal halves share, and both recovered runs stay bit-identical
+    to the fault-free baseline — see
+    tools/recompile_guard.py:run_supervisor_guard."""
+    guard = _load_guard()
+    report = guard.run_supervisor_guard()
+    assert report["ok"], report
+    assert report["base_compiles"] >= 1, report  # guard actually ran
+    assert report["retry_compiles"] == 0, report
+    assert report["retries"] >= 1, report
+    assert report["split_compiles"] <= guard.SUP_SPLIT_BUDGET, report
+    assert report["oom_splits"] == 1, report
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

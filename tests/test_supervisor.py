@@ -1,0 +1,609 @@
+"""Supervised device execution (``engine/supervisor.py``).
+
+PR-6 acceptance criteria:
+
+- under chaos ``device_oom`` injection, a K=8 ``solve_many`` group
+  completes via group-split with results bit-identical to the
+  fault-free run;
+- under ``nan_inject`` on one instance, the other K−1 results are
+  bit-identical and only the poisoned instance reports
+  ``status="degraded"``;
+- counters ``engine.oom_splits`` / ``engine.quarantined_instances``
+  land in ``result["telemetry"]``;
+- a run killed mid-way by ``device_transient`` with an exhausted
+  retry budget writes a final checkpoint, and resuming from it gives
+  bit-identical final costs vs. an uninterrupted run (crash-resume).
+
+Plus units for the fault-plan device clauses, failure classification,
+the keyed deterministic backoff, and the dispatch retry machinery.
+"""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.api import solve, solve_many
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.engine.supervisor import (
+    UNSUPERVISED,
+    DeviceOOMError,
+    DeviceTransientError,
+    Supervisor,
+    SupervisorConfig,
+    UnrecoverableDeviceError,
+    classify_failure,
+    get_supervisor,
+    supervision,
+)
+from pydcop_tpu.faults.plan import FaultPlan, FaultSpecError
+from pydcop_tpu.utils.backoff import backoff_delays
+
+pytestmark = pytest.mark.supervisor
+
+D = Domain("d", "", [0, 1, 2])
+
+
+def ring_dcop(n=6):
+    dcop = DCOP("ring%d" % n, objective="min")
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}_{j}", f"1 if v{i} == v{j} else 0", vs
+            )
+        )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n)])
+    return dcop
+
+
+# -- fault-plan device clauses ----------------------------------------
+
+
+def test_device_spec_parses():
+    plan = FaultPlan.from_spec(
+        "device_oom=16:256,device_transient=0.25:3,nan_inject=0.5:2",
+        seed=7,
+    )
+    d = plan.device
+    assert d.oom_width_cap == 16 and d.oom_rounds_cap == 256
+    assert d.transient == 0.25 and d.transient_after == 3
+    assert d.nan == 0.5 and d.nan_instance == 2
+    assert plan.device_faults_configured
+    # device kinds are NOT message faults: the host runtimes must not
+    # reject a device-only plan as needing a message plane, and vice
+    # versa the batched engine must see nothing message-shaped here
+    assert not plan.message_faults_configured
+
+
+def test_device_spec_rounds_only_oom():
+    plan = FaultPlan.from_spec("device_oom=-:128", seed=0)
+    assert plan.device.oom_width_cap is None
+    assert plan.device.oom_rounds_cap == 128
+    assert plan.oom_injected(10_000, 64) is False
+    assert plan.oom_injected(1, 129) is True
+
+
+def test_device_spec_rejects_bad_values():
+    with pytest.raises(FaultSpecError):
+        FaultPlan.from_spec("device_transient=1.5", seed=0)
+    with pytest.raises(FaultSpecError):
+        FaultPlan.from_spec("nan_inject=x", seed=0)
+    with pytest.raises(FaultSpecError):
+        FaultPlan.from_spec("device_oom=", seed=0)
+
+
+def test_device_spec_composes_with_message_clauses():
+    plan = FaultPlan.from_spec("drop=0.1,device_oom=8", seed=1)
+    assert plan.message_faults_configured
+    assert plan.device_faults_configured
+    assert plan.to_meta()["spec"] == "drop=0.1,device_oom=8"
+
+
+def test_oom_capacity_model_is_deterministic():
+    """OOM is a capacity model, not a coin flip: the degradation
+    ladder converges the moment a re-dispatch fits."""
+    plan = FaultPlan.from_spec("device_oom=4", seed=3)
+    assert plan.oom_injected(8) and plan.oom_injected(5)
+    assert not plan.oom_injected(4) and not plan.oom_injected(1)
+
+
+def test_transient_decisions_pure_and_seeded():
+    a = FaultPlan.from_spec("device_transient=0.5", seed=11)
+    b = FaultPlan.from_spec("device_transient=0.5", seed=11)
+    c = FaultPlan.from_spec("device_transient=0.5", seed=12)
+    seq_a = [a.decide_device_transient("s", i) for i in range(1, 40)]
+    seq_b = [b.decide_device_transient("s", i) for i in range(1, 40)]
+    seq_c = [c.decide_device_transient("s", i) for i in range(1, 40)]
+    assert seq_a == seq_b  # pure in (seed, scope, seq)
+    assert seq_a != seq_c
+    # AFTER exempts the head of every scope: the "die mid-run" knob
+    late = FaultPlan.from_spec("device_transient=1:3", seed=0)
+    assert [
+        late.decide_device_transient("s", i) for i in range(1, 6)
+    ] == [False, False, False, True, True]
+
+
+# -- failure classification -------------------------------------------
+
+
+def test_classify_failure():
+    assert classify_failure(DeviceOOMError("x")) == "oom"
+    assert classify_failure(DeviceTransientError("x")) == "transient"
+    assert classify_failure(MemoryError()) == "oom"
+    assert (
+        classify_failure(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+        == "oom"
+    )
+    assert (
+        classify_failure(RuntimeError("UNAVAILABLE: socket closed"))
+        == "transient"
+    )
+    # usage errors are fatal — retrying a bug never fixes it
+    assert classify_failure(ValueError("bad shape")) == "fatal"
+
+
+# -- keyed deterministic backoff --------------------------------------
+
+
+def test_backoff_keyed_is_pure_and_decorrelated():
+    take = lambda it, n: [next(it) for _ in range(n)]
+    a = take(backoff_delays(seed=5, key="k1"), 6)
+    b = take(backoff_delays(seed=5, key="k1"), 6)
+    c = take(backoff_delays(seed=5, key="k2"), 6)
+    d = take(backoff_delays(seed=6, key="k1"), 6)
+    assert a == b  # pure in (seed, key, attempt)
+    assert a != c  # distinct keys decorrelate
+    assert a != d  # seed matters
+    # exponential growth capped at max_delay still holds
+    delays = take(
+        backoff_delays(base=0.1, factor=2.0, max_delay=0.5,
+                       jitter=0.0, seed=0, key="k"), 5,
+    )
+    assert delays == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+
+def test_backoff_keyed_interleaving_independence():
+    """Two keyed streams give identical schedules no matter how their
+    draws interleave — the property the shared-Random variant lacks."""
+    s1 = backoff_delays(seed=1, key="a")
+    s2 = backoff_delays(seed=1, key="b")
+    interleaved_a = []
+    interleaved_b = []
+    for _ in range(4):  # alternate draws
+        interleaved_a.append(next(s1))
+        interleaved_b.append(next(s2))
+    solo_a = [next(backoff_delays(seed=1, key="a")) for _ in range(1)]
+    fresh_a = backoff_delays(seed=1, key="a")
+    fresh_b = backoff_delays(seed=1, key="b")
+    assert interleaved_a == [next(fresh_a) for _ in range(4)]
+    assert interleaved_b == [next(fresh_b) for _ in range(4)]
+    assert solo_a[0] == interleaved_a[0]
+
+
+# -- Supervisor.dispatch ----------------------------------------------
+
+
+def _sup(spec=None, seed=0, **kw):
+    kw.setdefault("sleep", lambda _t: None)  # no real sleeping in tests
+    plan = FaultPlan.from_spec(spec, seed) if spec else None
+    return Supervisor(SupervisorConfig(plan=plan, **kw))
+
+
+def test_dispatch_retries_transient_then_succeeds():
+    sup = _sup(retry_budget=3)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise DeviceTransientError("blip")
+        return "ok"
+
+    assert sup.dispatch(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_dispatch_exhausts_budget():
+    sup = _sup(retry_budget=2)
+    with pytest.raises(UnrecoverableDeviceError) as ei:
+        sup.dispatch(lambda: (_ for _ in ()).throw(
+            DeviceTransientError("always")
+        ))
+    assert ei.value.kind == "transient"
+    assert ei.value.attempts == 2
+
+
+def test_dispatch_oom_always_surfaces():
+    """OOM never retries in place — degradation is the caller's move."""
+    sup = _sup(retry_budget=5)
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise RuntimeError("RESOURCE_EXHAUSTED: could not allocate")
+
+    with pytest.raises(DeviceOOMError):
+        sup.dispatch(boom)
+    assert len(calls) == 1
+
+
+def test_dispatch_fatal_reraises_original():
+    sup = _sup(retry_budget=5)
+    with pytest.raises(ValueError, match="shape"):
+        sup.dispatch(lambda: (_ for _ in ()).throw(ValueError("shape")))
+
+
+def test_dispatch_injects_from_plan():
+    sup = _sup("device_oom=4", seed=1)
+    with pytest.raises(DeviceOOMError):
+        sup.dispatch(lambda: "ran", width=8)
+    assert sup.dispatch(lambda: "ran", width=4) == "ran"
+
+
+def test_injected_transient_retry_draws_fresh_seq():
+    """Retries draw fresh sequence numbers, so P<1 lets one through
+    (seed 0: seq1 fails, seq2 passes for this scope)."""
+    plan = FaultPlan.from_spec("device_transient=0.5", 0)
+    decisions = [
+        plan.decide_device_transient("engine.chunk", s)
+        for s in range(1, 6)
+    ]
+    assert True in decisions and False in decisions
+    sup = _sup("device_transient=0.5", seed=0, retry_budget=5)
+    assert sup.dispatch(lambda: "ok", scope="engine.chunk") == "ok"
+
+
+def test_supervision_context_and_default():
+    default = get_supervisor()
+    assert default.active and default.plan is None
+    mine = _sup(retry_budget=9)
+    with supervision(mine):
+        assert get_supervisor() is mine
+    assert get_supervisor() is default
+    assert UNSUPERVISED.dispatch(lambda: 42) == 42
+    assert UNSUPERVISED.nan_lanes(8) == []
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        SupervisorConfig(retry_budget=-1)
+    with pytest.raises(ValueError):
+        SupervisorConfig(chunk_floor=0)
+    with pytest.raises(ValueError):
+        SupervisorConfig(on_numeric_fault="explode")
+
+
+# -- engine recovery paths (the acceptance criteria) -------------------
+
+
+def test_solve_many_oom_group_split_bit_identical():
+    """K=8 group under device_oom: completes via group-split, results
+    bit-identical to the fault-free run, engine.oom_splits counted."""
+    dcops = [ring_dcop(5 + i % 3) for i in range(8)]
+    kw = dict(rounds=24, chunk_size=12, pad_policy="pow2:16", seed=7)
+    base = solve_many(dcops, "mgm", **kw)
+    oom = solve_many(
+        dcops, "mgm", chaos="device_oom=4", chaos_seed=3, **kw
+    )
+    for b, o in zip(base, oom):
+        assert o["status"] == "finished"
+        assert b["assignment"] == o["assignment"]
+        assert b["cost"] == o["cost"]
+        assert b["cost_trace"] == o["cost_trace"]
+    counters = oom[0]["telemetry"]["counters"]
+    assert counters["engine.oom_splits"] >= 1
+    assert counters["fault.device_oom"] >= 1
+    assert counters["engine.instances_batched"] == 8
+    assert oom[0]["chaos"] == {"spec": "device_oom=4", "seed": 3}
+
+
+def test_solve_many_oom_recursive_split_to_singles():
+    """A width cap of 1 forces splits all the way down to single-lane
+    groups — still bit-identical, one split per level of the tree."""
+    dcops = [ring_dcop(6) for _ in range(4)]
+    kw = dict(rounds=12, chunk_size=12, pad_policy="pow2:16", seed=1)
+    base = solve_many(dcops, "mgm", **kw)
+    oom = solve_many(
+        dcops, "mgm", chaos="device_oom=1", chaos_seed=0, **kw
+    )
+    for b, o in zip(base, oom):
+        assert b["cost"] == o["cost"]
+        assert b["assignment"] == o["assignment"]
+    counters = oom[0]["telemetry"]["counters"]
+    assert counters["engine.oom_splits"] == 3  # 4 -> 2+2 -> 1+1+1+1
+
+
+def test_solve_many_nan_quarantine_spares_the_group():
+    """nan_inject on lane 2: the other K-1 results bit-identical, only
+    the poisoned instance degraded, counter in result telemetry."""
+    dcops = [ring_dcop(5 + i % 3) for i in range(8)]
+    kw = dict(rounds=24, chunk_size=12, pad_policy="pow2:16", seed=7)
+    base = solve_many(dcops, "mgm", **kw)
+    nan = solve_many(
+        dcops, "mgm", chaos="nan_inject=1:2", chaos_seed=3, **kw
+    )
+    statuses = [r["status"] for r in nan]
+    assert statuses.count("degraded") == 1 and statuses[2] == "degraded"
+    for i, (b, o) in enumerate(zip(base, nan)):
+        if i != 2:
+            assert b["assignment"] == o["assignment"]
+            assert b["cost"] == o["cost"]
+            assert b["cost_trace"] == o["cost_trace"]
+    # the degraded lane reports its last-finite anytime best, finite
+    assert np.isfinite(nan[2]["cost"])
+    counters = nan[0]["telemetry"]["counters"]
+    assert counters["engine.quarantined_instances"] == 1
+    assert counters["fault.nan_inject"] >= 1
+
+
+def test_solve_many_numeric_fault_raise_mode():
+    dcops = [ring_dcop(6) for _ in range(3)]
+    with pytest.raises(UnrecoverableDeviceError) as ei:
+        solve_many(
+            dcops, "mgm", rounds=12, chunk_size=12,
+            pad_policy="pow2:16", chaos="nan_inject=1:1",
+            chaos_seed=0, on_numeric_fault="raise",
+        )
+    assert ei.value.kind == "numeric"
+
+
+def test_solve_transient_retry_parity():
+    """Transient blips under the retry budget leave the result
+    bit-identical (the retry fast path re-dispatches the same chunk)."""
+    base = solve(
+        ring_dcop(), "dsa", rounds=48, chunk_size=12, seed=3,
+        mode="batched",
+    )
+    r = solve(
+        ring_dcop(), "dsa", rounds=48, chunk_size=12, seed=3,
+        mode="batched", chaos="device_transient=0.5", chaos_seed=3,
+        retry_budget=4,
+    )
+    assert r["status"] == base["status"]
+    assert r["cost"] == base["cost"]
+    assert r["assignment"] == base["assignment"]
+    assert r["cost_trace"] == base["cost_trace"]
+    assert r["telemetry"]["counters"]["engine.retries"] >= 1
+
+
+def test_solve_oom_chunk_halving():
+    """A rounds-cap OOM halves the chunk until dispatches fit; a
+    deterministic algorithm's result is unchanged."""
+    base = solve(
+        ring_dcop(), "mgm", rounds=48, chunk_size=48, seed=3,
+        mode="batched",
+    )
+    r = solve(
+        ring_dcop(), "mgm", rounds=48, chunk_size=48, seed=3,
+        mode="batched", chaos="device_oom=-:16", chaos_seed=0,
+        chunk_floor=4,
+    )
+    assert r["status"] == "finished"
+    assert r["cost"] == base["cost"]
+    assert r["assignment"] == base["assignment"]
+    assert (
+        r["telemetry"]["counters"]["engine.oom_chunk_halvings"] >= 1
+    )
+
+
+def test_solve_oom_below_floor_unrecoverable(tmp_path):
+    """chunk_floor stops the ladder: a capacity no chunk fits is a
+    genuine over-capacity failure — with a final checkpoint written."""
+    ck = str(tmp_path / "final.npz")
+    with pytest.raises(UnrecoverableDeviceError) as ei:
+        solve(
+            ring_dcop(), "mgm", rounds=48, chunk_size=16, seed=3,
+            mode="batched", chaos="device_oom=-:1", chaos_seed=0,
+            chunk_floor=8, checkpoint_path=ck, checkpoint_every=999,
+        )
+    assert ei.value.kind == "oom"
+    import os
+
+    assert os.path.exists(ck)  # the supervisor's final checkpoint
+
+
+def test_solve_nan_quarantine_single_run_degrades():
+    r = solve(
+        ring_dcop(), "dsa", rounds=48, chunk_size=12, seed=3,
+        mode="batched", chaos="nan_inject=1", chaos_seed=0,
+    )
+    assert r["status"] == "degraded"
+    assert np.isfinite(r["cost"])
+    assert r["telemetry"]["counters"]["engine.numeric_faults"] >= 1
+
+
+def test_dpop_level_oom_falls_back_exactly():
+    """DPOP level sweeps under a width cap degrade to per-node (and
+    per-node OOM to host f64) with bit-identical exact results."""
+    base = solve(ring_dcop(8), "dpop", mode="batched")
+    oom = solve(
+        ring_dcop(8), "dpop", mode="batched", chaos="device_oom=1",
+        chaos_seed=0,
+    )
+    assert oom["cost"] == base["cost"]
+    assert oom["assignment"] == base["assignment"]
+
+
+def test_supervisor_knobs_rejected_off_batched():
+    with pytest.raises(ValueError, match="supervised"):
+        solve(ring_dcop(3), "mgm", mode="thread", retry_budget=1)
+
+
+def test_solve_many_rejects_message_plane_chaos():
+    with pytest.raises(ValueError, match="DEVICE-layer"):
+        solve_many([ring_dcop(3)], "mgm", chaos="drop=0.5")
+
+
+# -- donated dispatches: real (post-sync) failures --------------------
+#
+# Injected faults fire BEFORE the wrapped call, so the carry buffers
+# are intact and in-place retry is sound.  A REAL failure surfaces at
+# the sync point — after a donate=True dispatch consumed its carries —
+# so recovery must never re-call the closure; it restarts the group
+# from round 0 off the intact host-side stacks instead.  Simulated by
+# poisoning the warm runner cache to fail once with a real-looking
+# runtime error.
+
+
+def _poison_runner_cache_once(error_text):
+    """Wrap every cached runner to raise ``error_text`` on its first
+    call, then delegate.  Returns a restore() callable."""
+    from pydcop_tpu.engine import batched
+
+    saved = dict(batched._RUNNER_CACHE)
+
+    def _wrap(runner):
+        fired = []
+
+        def inner(*a, **k):
+            if not fired:
+                fired.append(1)
+                raise RuntimeError(error_text)
+            return runner(*a, **k)
+
+        return inner
+
+    for key, runner in list(batched._RUNNER_CACHE.items()):
+        batched._RUNNER_CACHE[key] = _wrap(runner)
+
+    def restore():
+        batched._RUNNER_CACHE.clear()
+        batched._RUNNER_CACHE.update(saved)
+
+    return restore
+
+
+def test_dispatch_not_retryable_hands_back_transient():
+    """retryable=False: a real transient must NOT re-call fn (its
+    donated inputs are consumed) — it surfaces for a caller restart."""
+    sup = _sup(retry_budget=3)
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise RuntimeError("UNAVAILABLE: socket closed")
+
+    with pytest.raises(DeviceTransientError):
+        sup.dispatch(boom, retryable=False)
+    assert len(calls) == 1
+
+
+def test_injected_transient_retries_in_place_when_not_retryable():
+    """Injected transients fire BEFORE fn runs, so they retry in
+    place even for donated (retryable=False) dispatches — and fn
+    still runs exactly once."""
+    sup = _sup("device_transient=0.5", seed=0, retry_budget=5)
+    calls = []
+
+    def ok():
+        calls.append(1)
+        return "ok"
+
+    assert (
+        sup.dispatch(ok, scope="engine.chunk", retryable=False) == "ok"
+    )
+    assert len(calls) == 1
+
+
+def test_solve_many_real_transient_with_donation_restarts():
+    """A real transient on a donated group dispatch recovers via
+    whole-group restart, bit-identical to the fault-free run."""
+    dcops = [ring_dcop(5 + i % 3) for i in range(4)]
+    kw = dict(rounds=24, chunk_size=12, pad_policy="pow2:16", seed=7)
+    base = solve_many(dcops, "mgm", **kw)  # also warms the cache
+    restore = _poison_runner_cache_once("UNAVAILABLE: link blipped")
+    try:
+        r = solve_many(dcops, "mgm", **kw)
+    finally:
+        restore()
+    for b, o in zip(base, r):
+        assert o["status"] == "finished"
+        assert b["cost"] == o["cost"]
+        assert b["assignment"] == o["assignment"]
+        assert b["cost_trace"] == o["cost_trace"]
+    assert r[0]["telemetry"]["counters"]["engine.retries"] >= 1
+
+
+def test_solve_many_real_oom_single_lane_restarts_halved():
+    """A real OOM on a donated single-lane group restarts from round
+    0 at the halved chunk instead of reusing the consumed carries."""
+    dcops = [ring_dcop(6)]
+    kw = dict(rounds=24, chunk_size=24, pad_policy="pow2:16", seed=7)
+    base = solve_many(dcops, "mgm", **kw)
+    restore = _poison_runner_cache_once(
+        "RESOURCE_EXHAUSTED: out of memory allocating"
+    )
+    try:
+        r = solve_many(dcops, "mgm", **kw)
+    finally:
+        restore()
+    assert r[0]["status"] == "finished"
+    assert r[0]["cost"] == base[0]["cost"]
+    assert r[0]["assignment"] == base[0]["assignment"]
+    counters = r[0]["telemetry"]["counters"]
+    assert counters["engine.oom_chunk_halvings"] >= 1
+
+
+def test_run_dynamic_propagates_degraded():
+    """A NaN-quarantined segment must mark the WHOLE dynamic run
+    degraded (sticky), not report status='finished'."""
+    from pydcop_tpu.dcop.scenario import Scenario
+    from pydcop_tpu.engine.dynamic import run_dynamic
+
+    plan = FaultPlan.from_spec("nan_inject=1", 0)
+    sup = Supervisor(
+        SupervisorConfig(plan=plan, sleep=lambda _t: None)
+    )
+    with supervision(sup):
+        r = run_dynamic(
+            ring_dcop(), "dsa", {"variant": "B"},
+            scenario=Scenario([]), k_target=0, final_rounds=24,
+            chunk_size=12, seed=3,
+        )
+    assert r["status"] == "degraded"
+
+
+def test_host_mode_rejects_device_chaos():
+    """Device-layer chaos on a host runtime would silently no-op —
+    it must be rejected, mirroring the batched engine's rejection of
+    message-plane kinds."""
+    with pytest.raises(ValueError, match="device dispatch"):
+        solve(ring_dcop(3), "mgm", mode="thread", chaos="device_oom=4")
+
+
+# -- crash-resume (satellite) -----------------------------------------
+
+
+def test_crash_resume_bit_identical(tmp_path):
+    """Kill a run mid-way (device_transient with exhausted budget),
+    resume from the supervisor's final checkpoint, and the final
+    costs are bit-identical to an uninterrupted run."""
+    ck = str(tmp_path / "crash.npz")
+    kw = dict(rounds=48, chunk_size=12, seed=3, mode="batched")
+    base = solve(ring_dcop(), "dsa", **kw)
+    # P=1 after the 2nd dispatch: chunks 1-2 run, chunk 3 dies on
+    # every attempt; checkpoint_every is huge so the ONLY checkpoint
+    # is the supervisor's final write before surfacing the error
+    with pytest.raises(UnrecoverableDeviceError):
+        solve(
+            ring_dcop(), "dsa", checkpoint_path=ck,
+            checkpoint_every=999, chaos="device_transient=1:2",
+            chaos_seed=0, retry_budget=1, **kw,
+        )
+    resumed = solve(
+        ring_dcop(), "dsa", checkpoint_path=ck, resume=True, **kw
+    )
+    assert resumed["status"] == "finished"
+    assert resumed["cycle"] == base["cycle"] == 48
+    assert resumed["cost"] == base["cost"]
+    assert resumed["final_cost"] == base["final_cost"]
+    assert resumed["assignment"] == base["assignment"]
+    # the resumed trace covers rounds 24..48; it must equal the tail
+    # of the uninterrupted run's trace bit-for-bit (same fold_in-by-
+    # absolute-round RNG stream)
+    n = len(resumed["cost_trace"])
+    assert resumed["cost_trace"] == base["cost_trace"][-n:]

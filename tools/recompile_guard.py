@@ -19,8 +19,10 @@ Run standalone (prints one JSON line, exit 1 when over budget):
 
 or via the tier-1 suite: ``tests/test_recompile_guard.py`` imports
 :func:`run_guard` (dynamic solve), :func:`run_many_guard`
-(cross-instance vmap batching) and :func:`run_dpop_guard`
-(level-batched DPOP through ``solve_many``) directly.
+(cross-instance vmap batching), :func:`run_dpop_guard`
+(level-batched DPOP through ``solve_many``) and
+:func:`run_supervisor_guard` (supervised recovery: zero-compile
+transient retries, bounded-compile OOM group splits) directly.
 
 ``BUDGET`` is the recorded compile count of the canned scenario: one
 chunk-runner compile in segment 1, zero afterwards.  Raise it only
@@ -51,6 +53,19 @@ ROUNDS = 56
 MANY_BUDGET = 1
 MANY_ROUNDS = 48
 MANY_K = 4
+
+# supervised-recovery compile budgets (engine/supervisor.py): the
+# transient-retry fast path re-dispatches the SAME compiled runner, so
+# a retried run adds ZERO compiles; an OOM group-split re-dispatches
+# the K-instance group as two equal K/2 halves, which share ONE new
+# vmapped-runner cache entry (the cache keys on K) — so a split costs
+# at most SUP_SPLIT_BUDGET compiles.  A regression either way is a
+# compile storm hiding inside the recovery path: recovery would still
+# be correct but pay tracing+XLA per retry/split, exactly the
+# failure-amplifies-latency spiral the supervisor exists to prevent.
+SUP_K = 8
+SUP_ROUNDS = 48
+SUP_SPLIT_BUDGET = 1
 
 # level-batched DPOP through solve_many: K same-bucket SECP instances
 # merge their UTIL phases into one level-synchronous sweep, and each
@@ -252,6 +267,115 @@ def run_many_guard() -> dict:
     return report
 
 
+def run_supervisor_guard() -> dict:
+    """Compile budget for the supervised recovery paths
+    (``engine/supervisor.py``): on a K same-bucket ``solve_many``
+    group, (1) a run whose dispatches suffer injected transient
+    failures (``device_transient`` chaos) must retry to completion
+    with ZERO new compiles — the retry fast path re-dispatches the
+    already-compiled runner — and (2) a run whose full-width group
+    OOMs (``device_oom`` chaos) must complete via group-split with at
+    most ``SUP_SPLIT_BUDGET`` new compiles (the two equal halves share
+    one vmapped-runner cache entry).  Both recovered runs must stay
+    bit-identical to the fault-free baseline — recovery that changes
+    answers is worse than failure."""
+    from pydcop_tpu.api import solve_many
+    from pydcop_tpu.engine import batched
+    from pydcop_tpu.telemetry import session
+
+    # cold start, same reason as the other guards: warm runners would
+    # hide (or fake) compiles
+    batched._RUNNER_CACHE.clear()
+
+    # sizes 5..8 cycled over K slots: one pow2:16 bucket, one group
+    dcops = [_build_ring(5 + i % 4) for i in range(SUP_K)]
+    kw = dict(
+        rounds=SUP_ROUNDS, chunk_size=SUP_ROUNDS // 2,
+        pad_policy="pow2:16", seed=3,
+    )
+    with session() as tel:
+        base = solve_many(dcops, "mgm", {}, **kw)
+    base_compiles = int(
+        tel.summary()["counters"].get("jit.compiles", 0)
+    )
+
+    # retry fast path: every dispatch flips a seeded 50/50 coin; the
+    # budget is generous so the deterministic schedule always gets
+    # through.  Zero compiles: the K=8 runner is warm from the
+    # baseline, and a retry re-enters it with identical shapes.
+    with session() as tel_r:
+        retried = solve_many(
+            dcops, "mgm", {}, chaos="device_transient=0.5",
+            chaos_seed=3, retry_budget=8, **kw,
+        )
+    r_counters = tel_r.summary()["counters"]
+    retry_compiles = int(r_counters.get("jit.compiles", 0))
+    retries = int(r_counters.get("engine.retries", 0))
+
+    # OOM split: width cap 7 < group width 8, so the full group OOMs
+    # on its first dispatch and splits into two K=4 halves (which
+    # fit).  Equal halves share one runner cache entry -> one compile.
+    with session() as tel_o:
+        split = solve_many(
+            dcops, "mgm", {}, chaos=f"device_oom={SUP_K - 1}",
+            chaos_seed=3, **kw,
+        )
+    o_counters = tel_o.summary()["counters"]
+    split_compiles = int(o_counters.get("jit.compiles", 0))
+    oom_splits = int(o_counters.get("engine.oom_splits", 0))
+
+    report = {
+        "base_compiles": base_compiles,
+        "retry_compiles": retry_compiles,
+        "retries": retries,
+        "split_compiles": split_compiles,
+        "split_budget": SUP_SPLIT_BUDGET,
+        "oom_splits": oom_splits,
+        "ok": True,
+        "costs": [r["cost"] for r in base],
+    }
+    if retry_compiles != 0:
+        report["ok"] = False
+        report["error"] = (
+            f"{retry_compiles} compile(s) on the transient-retry "
+            "path — retries must re-dispatch the already-compiled "
+            "runner, never re-trace"
+        )
+    elif retries < 1:
+        report["ok"] = False
+        report["error"] = (
+            "no retries recorded — the injected transient schedule "
+            "stopped exercising the fast path (guard is vacuous)"
+        )
+    elif split_compiles > SUP_SPLIT_BUDGET or oom_splits != 1:
+        report["ok"] = False
+        report["error"] = (
+            f"OOM split cost {split_compiles} compile(s) / "
+            f"{oom_splits} split(s); expected <= {SUP_SPLIT_BUDGET} "
+            "compile (equal halves share one runner cache entry) "
+            "from exactly 1 split"
+        )
+    else:
+        # recovered results must be bit-identical to the baseline
+        for name, res in (("retry", retried), ("oom-split", split)):
+            for i, (b, r) in enumerate(zip(base, res)):
+                if (
+                    b["cost"] != r["cost"]
+                    or b["assignment"] != r["assignment"]
+                ):
+                    report["ok"] = False
+                    report["error"] = (
+                        f"instance {i}: {name} recovery diverges "
+                        f"from the fault-free run (cost {r['cost']} "
+                        f"vs {b['cost']}) — recovery must be "
+                        "stream-preserving"
+                    )
+                    break
+            if not report["ok"]:
+                break
+    return report
+
+
 def _build_secp(n_lights: int, n_models: int, levels: int, seed: int):
     """A fixed-STRUCTURE smart-lighting SECP: deterministic model
     scopes (consecutive 3-light windows) so every seed compiles to
@@ -385,18 +509,23 @@ def main() -> int:
     report = run_guard()
     report_many = run_many_guard()
     report_dpop = run_dpop_guard()
+    report_sup = run_supervisor_guard()
     print(
         json.dumps(
             {
                 "dynamic": report,
                 "solve_many": report_many,
                 "dpop": report_dpop,
+                "supervisor": report_sup,
             }
         )
     )
     return (
         0
-        if report["ok"] and report_many["ok"] and report_dpop["ok"]
+        if report["ok"]
+        and report_many["ok"]
+        and report_dpop["ok"]
+        and report_sup["ok"]
         else 1
     )
 
