@@ -124,6 +124,31 @@ DPOP_ZONE = 8
 DPOP_REPS = 7  # interleaved; medians reported
 DPOP_MANY_K = 8
 
+# solver_service stage (ISSUE 7 acceptance): SVC_N concurrent clients
+# against a live continuous-batching service (engine/service.py, TCP
+# wire protocol) vs SVC_N sequential api.solve calls on the same
+# dsa/coloring workload.  Both paths are end to end from yaml: the
+# sequential loop pays load + problem compile + a solo dispatch PER
+# CALL, the service caches the compiled problem by content hash and
+# coalesces the burst into a couple of vmapped group dispatches per
+# tick.  Reps are INTERLEAVED (sequential loop, then burst, x
+# SVC_REPS) — this box has 2 shared, cgroup-throttled vCPUs whose
+# speed swings ~2x between runs, so each burst is judged against the
+# temporally-adjacent sequential measurement, not a one-shot
+# baseline.  Bounds: median throughput ratio >= SVC_RATIO_BOUND at
+# client p99 <= SVC_P99_FACTOR x the sequential per-call latency
+# (medians across reps), results bit-identical; zero steady-state XLA
+# compiles is guarded separately by
+# tools/recompile_guard.py:run_service_guard.
+SVC_N = 32
+SVC_PROBLEMS = 4  # distinct graphs cycled over the SVC_N clients
+SVC_VARS = 64  # sizes SVC_VARS-6 .. SVC_VARS: one pow2 shape bucket
+SVC_ROUNDS = 32
+SVC_CHUNK = 32
+SVC_REPS = 3  # interleaved (sequential, burst) pairs; medians
+SVC_RATIO_BOUND = 5.0
+SVC_P99_FACTOR = 3.0
+
 
 def _git_sha() -> str:
     try:
@@ -744,6 +769,189 @@ def _measure_supervised(phase_budget: float = 0.0) -> dict:
     return out
 
 
+def _measure_service(phase_budget: float = 0.0) -> dict:
+    """Continuous-batching service throughput vs sequential api.solve.
+
+    SVC_N client threads, each on its OWN TCP connection to a live
+    :class:`~pydcop_tpu.engine.service.ServiceServer`, fire
+    barrier-synchronized request bursts (the ship-yaml-text wire path,
+    so the server pays admission + coalesce + dispatch + decode per
+    burst); the baseline is SVC_N sequential ``api.solve(path)`` calls
+    over the same yaml files with the same per-request seeds.  Two
+    warm bursts absorb the cold vmapped-runner compiles (guarded
+    separately by ``run_service_guard``), then SVC_REPS INTERLEAVED
+    (sequential loop, burst) pairs report the median wall-clock
+    ratio, client-observed latency percentiles, batch occupancy, and
+    bit-parity of every result against the sequential run.  ``ok`` is
+    the ISSUE 7 acceptance: ratio >= SVC_RATIO_BOUND, p99 <=
+    SVC_P99_FACTOR x the sequential per-call latency, results
+    bit-identical, and zero XLA compiles across the measured bursts.
+    """
+    import statistics
+    import tempfile
+    import threading
+
+    with _bounded_phase("import:jax", phase_budget):
+        import jax
+
+    with _bounded_phase("import:pydcop", phase_budget):
+        import __graft_entry__ as g
+        from pydcop_tpu.api import solve
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+        from pydcop_tpu.engine.service import (
+            ServiceClient,
+            ServiceServer,
+            SolverService,
+        )
+        from pydcop_tpu.telemetry import session as _tel_session
+
+    _phase("problem_built")
+    base = [
+        g._make_coloring_dcop(
+            SVC_VARS - 2 * i, degree=DEGREE, seed=100 + i
+        )
+        for i in range(SVC_PROBLEMS)
+    ]
+    tmp = tempfile.mkdtemp(prefix="bench_service_")
+    paths = []
+    for i, d in enumerate(base):
+        path = os.path.join(tmp, f"p{i}.yaml")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(dcop_yaml(d))
+        paths.append(path)
+    algo, params = "dsa", {"variant": "B", "probability": 0.7}
+    kw = dict(rounds=SVC_ROUNDS, chunk_size=SVC_CHUNK)
+
+    with _bounded_phase("xla_compile", phase_budget):
+        for path in paths:
+            solve(path, algo, params, pad_policy="pow2", seed=0, **kw)
+
+    def sequential():
+        t0 = time.perf_counter()
+        res = [
+            solve(
+                paths[i % SVC_PROBLEMS], algo, params,
+                pad_policy="pow2", seed=i, **kw
+            )
+            for i in range(SVC_N)
+        ]
+        return res, time.perf_counter() - t0
+
+    _phase("measure:service")
+    ratios, seq_dts, burst_dts = [], [], []
+    p50s, p99s, lats_all = [], [], []
+    seq = results = None
+    with _tel_session() as tel:
+        with SolverService(
+            pad_policy="pow2", max_batch=SVC_N, max_wait=0.25
+        ) as svc:
+            with ServiceServer(svc, port=0) as server:
+                clients = [
+                    ServiceClient(server.address) for _ in range(SVC_N)
+                ]
+
+                def burst():
+                    res, lats = [None] * SVC_N, [0.0] * SVC_N
+                    bar = threading.Barrier(SVC_N)
+
+                    def req(i):
+                        bar.wait()
+                        t = time.perf_counter()
+                        res[i] = clients[i].solve(
+                            paths[i % SVC_PROBLEMS], algo, params,
+                            seed=i, **kw
+                        )
+                        lats[i] = time.perf_counter() - t
+
+                    threads = [
+                        threading.Thread(target=req, args=(i,))
+                        for i in range(SVC_N)
+                    ]
+                    t0 = time.perf_counter()
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    return res, time.perf_counter() - t0, lats
+
+                burst()  # cold: vmapped-runner compiles land here
+                burst()  # warm settle
+                compiles_before = int(
+                    tel.summary()["counters"].get("jit.compiles", 0)
+                )
+                # interleaved pairs: each burst is judged against the
+                # sequential loop that ran right next to it, so a
+                # machine-wide slowdown (shared throttled vCPUs) hits
+                # both sides of the ratio and of the p99 bound
+                for _ in range(SVC_REPS):
+                    seq, dt_seq = sequential()
+                    results, dt_b, lats = burst()
+                    seq_dts.append(dt_seq)
+                    burst_dts.append(dt_b)
+                    ratios.append(dt_seq / dt_b)
+                    p50s.append(_svc_percentile(lats, 50))
+                    p99s.append(_svc_percentile(lats, 99))
+                    lats_all.extend(lats)
+                steady_compiles = (
+                    int(
+                        tel.summary()["counters"].get("jit.compiles", 0)
+                    )
+                    - compiles_before
+                )
+                for c in clients:
+                    c.close()
+        stats = svc.stats()
+
+    dt_seq = statistics.median(seq_dts)
+    dt_svc = statistics.median(burst_dts)
+    per_call = dt_seq / SVC_N
+    p99 = statistics.median(p99s)
+    results_match = all(
+        r["cost"] == s["cost"] and r["assignment"] == s["assignment"]
+        for r, s in zip(results, seq)
+    )
+    ratio = round(statistics.median(ratios), 2)
+    out = {
+        "platform": jax.devices()[0].platform,
+        "n_clients": SVC_N,
+        "n_problems": SVC_PROBLEMS,
+        "n_vars": SVC_VARS,
+        "rounds": SVC_ROUNDS,
+        "reps": SVC_REPS,
+        "algo": algo,
+        "throughput_ratio": ratio,
+        "requests_per_sec_service": round(SVC_N / dt_svc, 2),
+        "requests_per_sec_sequential": round(SVC_N / dt_seq, 2),
+        "sequential_per_call_s": round(per_call, 4),
+        "latency_s": {
+            "p50": round(statistics.median(p50s), 4),
+            "p99": round(p99, 4),
+            "bound": round(SVC_P99_FACTOR * per_call, 4),
+        },
+        "batch_occupancy": stats["batch_occupancy"],
+        "coalesce_ratio": stats["coalesce_ratio"],
+        "steady_state_jit_compiles": steady_compiles,
+        "results_match": results_match,
+        "ok": (
+            ratio >= SVC_RATIO_BOUND
+            and p99 <= SVC_P99_FACTOR * per_call
+            and results_match
+            and steady_compiles == 0
+        ),
+    }
+    _phase("measured")
+    return out
+
+
+def _svc_percentile(values: list, q: float) -> float:
+    """Nearest-rank percentile of an unsorted sample."""
+    if not values:
+        return 0.0
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return float(s[idx])
+
+
 def _inner_main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--inner", action="store_true")
@@ -754,6 +962,7 @@ def _inner_main() -> None:
     p.add_argument("--many_stage", action="store_true")
     p.add_argument("--dpop_stage", action="store_true")
     p.add_argument("--supervised_stage", action="store_true")
+    p.add_argument("--service_stage", action="store_true")
     a = p.parse_args()
     import jax
 
@@ -768,7 +977,9 @@ def _inner_main() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # older jax: cache flags absent — correctness unaffected
-    if a.supervised_stage:
+    if a.service_stage:
+        metrics = _measure_service(a.phase_budget)
+    elif a.supervised_stage:
         metrics = _measure_supervised(a.phase_budget)
     elif a.dpop_stage:
         metrics = _measure_dpop(a.phase_budget)
@@ -782,6 +993,7 @@ def _inner_main() -> None:
 def _run_sub(
     pin_cpu: bool, timeout: float, n_vars: int, rounds: int,
     many: bool = False, dpop: bool = False, supervised: bool = False,
+    service: bool = False,
 ) -> dict:
     """Run ``bench.py --inner`` in a subprocess; parse its JSON line.
 
@@ -812,7 +1024,8 @@ def _run_sub(
             ]
             + (["--many_stage"] if many else [])
             + (["--dpop_stage"] if dpop else [])
-            + (["--supervised_stage"] if supervised else []),
+            + (["--supervised_stage"] if supervised else [])
+            + (["--service_stage"] if service else []),
             env=env,
             cwd=REPO,
             capture_output=True,
@@ -1037,6 +1250,44 @@ def main() -> None:
             speedup_level_vs_node=dpop.get("speedup_level_vs_node"),
         )
 
+    # continuous-batching solver service (engine/service.py): N
+    # concurrent TCP clients vs N sequential api.solve calls — the
+    # ISSUE 7 serving-throughput evidence row.  Same platform policy
+    # as the stages above (the ratio is fixed-cost amortization plus
+    # coalesced dispatch, measurable on either backend).
+    service = _run_sub(pin_cpu=False, timeout=300.0, n_vars=0,
+                       rounds=0, service=True)
+    if "error" in service:
+        service = _run_sub(pin_cpu=True, timeout=300.0, n_vars=0,
+                           rounds=0, service=True)
+    if "error" in service:
+        errors.append(f"solver_service stage: {service['error']}")
+        service = None
+    elif not service.get("ok", False):
+        errors.append(
+            "solver_service below acceptance: "
+            + json.dumps(
+                {
+                    k: service.get(k)
+                    for k in (
+                        "throughput_ratio", "latency_s",
+                        "results_match", "steady_state_jit_compiles",
+                    )
+                }
+            )
+        )
+    elif service.get("platform") == "tpu":
+        # durable evidence row (msgs_per_sec=None: the service stage
+        # reports a request-throughput ratio, not a message rate)
+        append_tpu_log(
+            f"solver_service_{SVC_N}clients",
+            None,
+            source="bench_stage_solver_service",
+            throughput_ratio=service.get("throughput_ratio"),
+            requests_per_sec=service.get("requests_per_sec_service"),
+            latency_p99_s=service.get("latency_s", {}).get("p99"),
+        )
+
     # supervised-dispatch no-fault overhead (engine/supervisor.py):
     # dsa/maxsum hot loops under the default supervisor vs bare
     # dispatch — the <2% acceptance bound of the robustness layer.
@@ -1112,6 +1363,20 @@ def main() -> None:
             k: many[k]
             for k in ("platform", "n_vars", "rounds", "algo", "ks")
             if k in many
+        }
+    if service is not None:
+        out["solver_service"] = {
+            k: service[k]
+            for k in (
+                "platform", "n_clients", "n_problems", "n_vars",
+                "rounds", "algo", "throughput_ratio",
+                "requests_per_sec_service",
+                "requests_per_sec_sequential",
+                "sequential_per_call_s", "latency_s",
+                "batch_occupancy", "coalesce_ratio",
+                "steady_state_jit_compiles", "results_match", "ok",
+            )
+            if k in service
         }
     if supervised is not None:
         out["supervised_overhead"] = {

@@ -26,6 +26,21 @@ from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
 # light so CLI/bench cold starts don't pay the jax import before they
 # know they need a device (tests/test_import_time.py pins this).
 
+# The solver-service surface (docs/serving.md) re-exports lazily for
+# the same reason: ``api.ServiceClient`` is a pure-socket client a
+# jax-free process can use against a remote `pydcop_tpu serve`.
+_SERVICE_EXPORTS = ("ServiceClient", "ServiceError", "SolverService")
+
+
+def __getattr__(name):
+    if name in _SERVICE_EXPORTS:
+        from pydcop_tpu.engine import service as _service
+
+        return getattr(_service, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
 
 def solve(
     dcop: Union[DCOP, str],

@@ -3,8 +3,10 @@
 ``python -m pydcop_tpu <command> ...`` with one module per subcommand
 under ``pydcop_tpu/commands/`` — the same layout as the reference CLI:
 solve, run, graph, distribute, generate, batch, consolidate,
-replica_dist, orchestrator, agent; plus trace-summary (telemetry
-trace aggregation, ``docs/observability.md``).
+replica_dist, orchestrator, agent; plus serve (the resident
+continuous-batching solver service, ``docs/serving.md``) and
+trace-summary (telemetry trace aggregation,
+``docs/observability.md``).
 """
 
 from __future__ import annotations
@@ -28,6 +30,8 @@ COMMANDS = [
     "orchestrator",
     "agent",
     "worker",
+    # resident continuous-batching solver service (docs/serving.md)
+    "serve",
     # telemetry trace aggregation (module trace_summary registers the
     # subcommand as `trace-summary`)
     "trace_summary",

@@ -1,0 +1,136 @@
+"""``pydcop_tpu serve`` — the continuous-batching solver service.
+
+Starts a resident :class:`~pydcop_tpu.engine.service.SolverService`
+behind a TCP :class:`~pydcop_tpu.engine.service.ServiceServer`
+(newline-JSON frames, ``docs/serving.md``) and serves until a client
+sends ``shutdown``, the global ``-t/--timeout`` elapses, or Ctrl-C.
+
+Prints one JSON line ``{"serving": "host:port", "pid": N}`` once the
+socket is bound (a parent process can parse it to find an ephemeral
+``--port 0``), and a final JSON stats report on exit.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from pydcop_tpu.commands._common import (
+    add_supervisor_arguments,
+    add_trace_arguments,
+)
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "serve",
+        help="run a resident continuous-batching solver service: an "
+        "admission queue coalesces concurrent solve requests into "
+        "shape buckets and dispatches merged groups per tick on warm "
+        "compiled executables (docs/serving.md)",
+    )
+    p.add_argument(
+        "--host", default="127.0.0.1",
+        help="bind address (default 127.0.0.1 — loopback only)",
+    )
+    p.add_argument(
+        "--port", type=int, default=9010,
+        help="listen port (0 = ephemeral; the bound port is printed "
+        "on the serving line)",
+    )
+    p.add_argument(
+        "--pad_policy", default="pow2", metavar="POLICY",
+        help="shape-bucketing policy applied to every request "
+        "('pow2' / 'pow2:<floor>' / 'none'): what steers "
+        "similarly-sized problems into shared executables and "
+        "coalesced dispatches (docs/performance.md); default: pow2",
+    )
+    p.add_argument(
+        "--max_batch", type=int, default=32, metavar="K",
+        help="tick policy: dispatch as soon as K requests are "
+        "pending (also the per-tick drain cap); default 32",
+    )
+    p.add_argument(
+        "--max_wait", type=float, default=0.01, metavar="SECONDS",
+        help="tick policy: never hold the oldest pending request "
+        "longer than this before dispatching (the queue-wait bound "
+        "behind the service's p99); default 0.01",
+    )
+    p.add_argument(
+        "--instance_bucket", choices=["pow2", "none"], default="pow2",
+        help="pad coalesced groups to power-of-two occupancy so the "
+        "vmapped runner cache converges on a handful of executables "
+        "(steady-state ticks then do ZERO XLA compiles); default pow2",
+    )
+    p.add_argument(
+        "--compile_cache", default=None, metavar="DIR",
+        help="persist XLA executables to DIR (jax compilation "
+        "cache): a restarted service skips backend compilation of "
+        "programs any previous process built (docs/performance.md)",
+    )
+    p.add_argument(
+        "--chaos", default=None, metavar="SPEC",
+        help="inject deterministic DEVICE-layer faults into every "
+        "dispatch (device_oom=W[:R], device_transient=P[:AFTER], "
+        "nan_inject=P[:I] — docs/faults.md): a poisoned or OOM-ing "
+        "request degrades/splits under the supervisor while its "
+        "batchmates return bit-identical results",
+    )
+    p.add_argument(
+        "--chaos_seed", type=int, default=0,
+        help="seed for the --chaos fault plan (determinism/replay)",
+    )
+    add_supervisor_arguments(p)
+    add_trace_arguments(p)
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    from pydcop_tpu.engine.service import ServiceServer, SolverService
+    from pydcop_tpu.telemetry import session
+
+    if args.compile_cache is not None:
+        from pydcop_tpu.ops.compile import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache(args.compile_cache)
+
+    with session(args.trace, args.trace_format):
+        service = SolverService(
+            pad_policy=args.pad_policy,
+            max_batch=args.max_batch,
+            max_wait=args.max_wait,
+            instance_bucket=args.instance_bucket,
+            chaos=args.chaos,
+            chaos_seed=args.chaos_seed,
+            retry_budget=args.retry_budget,
+            chunk_floor=args.chunk_floor,
+            on_numeric_fault=args.on_numeric_fault,
+        )
+        try:
+            with ServiceServer(
+                service, host=args.host, port=args.port
+            ) as server:
+                import os
+
+                print(
+                    json.dumps(
+                        {
+                            "serving": "%s:%d" % server.address,
+                            "pid": os.getpid(),
+                        }
+                    ),
+                    flush=True,
+                )
+                try:
+                    # the global -t/--timeout doubles as a serve
+                    # duration bound (handy for scripted benches/tests)
+                    server.wait(args.timeout)
+                except KeyboardInterrupt:
+                    pass
+        finally:
+            service.close()
+            stats = service.stats()
+    print(json.dumps({"stats": stats}, default=str), file=sys.stderr)
+    return 0

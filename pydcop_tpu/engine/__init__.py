@@ -26,7 +26,17 @@ _SUPERVISOR_EXPORTS = {
     "supervision",
 }
 
-__all__ = sorted(_BATCHED_EXPORTS | _SUPERVISOR_EXPORTS)
+_SERVICE_EXPORTS = {
+    "ServiceClient",
+    "ServiceError",
+    "ServiceServer",
+    "SolverService",
+    "TickPolicy",
+}
+
+__all__ = sorted(
+    _BATCHED_EXPORTS | _SUPERVISOR_EXPORTS | _SERVICE_EXPORTS
+)
 
 
 def __getattr__(name):
@@ -38,6 +48,10 @@ def __getattr__(name):
         import pydcop_tpu.engine.supervisor as _supervisor
 
         return getattr(_supervisor, name)
+    if name in _SERVICE_EXPORTS:
+        import pydcop_tpu.engine.service as _service
+
+        return getattr(_service, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )

@@ -1,0 +1,1194 @@
+"""Continuous-batching solver service: the resident process that turns
+one-shot solve calls into a serving loop (ROADMAP item 4, the
+"millions of users" path).
+
+``api.solve_many`` (PR 4/5) batches instances *within one call*; this
+module batches *across concurrent callers*, the way LLM serving does:
+
+- an **admission queue** collects requests from any number of client
+  threads / connections;
+- a **tick policy** (:class:`TickPolicy`) bounds latency: a tick fires
+  as soon as ``max_batch`` requests are pending OR the oldest request
+  has waited ``max_wait`` seconds — so a lone request never waits more
+  than one ``max_wait`` and a burst rides the vmap;
+- each tick **coalesces** its requests into
+  :func:`~pydcop_tpu.ops.compile.problem_group_key` buckets (after the
+  same static-params partition ``api.solve_many`` uses) and dispatches
+  every group as ONE ``run_many_batched`` device program — requests
+  that share a bucket are the same executable with different data, so
+  coalescing them is a memcpy-stack plus one warm dispatch;
+- **occupancy bucketing** pads each group to a power-of-two instance
+  count by repeating its last member (results discarded), so the
+  vmapped runner cache — which keys on K — converges on a handful of
+  executables and steady-state ticks perform ZERO XLA compiles no
+  matter how ragged the traffic is
+  (``tools/recompile_guard.py:run_service_guard`` pins this);
+- **warm state is the point**: the chunk-runner cache
+  (``engine/batched.py``), the compiled-problem cache (keyed on the
+  request's dcop identity), and per-session
+  :class:`~pydcop_tpu.engine.incremental.IncrementalCompiler` pins all
+  persist across requests, so after the cold tick a request costs
+  dispatch + memcpy, never tracing or XLA;
+- **session affinity**: a client that names a ``session`` gets its
+  problem pinned to an IncrementalCompiler; streaming ``set_values``
+  deltas (external-variable updates) re-tabulates only the touched
+  constraints on device (``compile.incremental``) — zero full
+  recompiles after the first segment;
+- every dispatch runs under the service's
+  :class:`~pydcop_tpu.engine.supervisor.Supervisor` (PR 6), so a
+  poisoned or OOM-ing request quarantines / splits instead of failing
+  its batchmates, and the device-layer chaos kinds (``device_oom``,
+  ``device_transient``, ``nan_inject``) exercise exactly those paths
+  against a live service.
+
+Coalesced results are bit-identical to per-request sequential
+``api.solve`` calls with the same ``pad_policy`` — the per-instance
+RNG-parity contract of ``run_many_batched`` (``docs/performance.md``)
+— and a request that shares a tick with a poisoned batchmate still
+returns the exact fault-free answer.
+
+Wire protocol (:class:`ServiceServer` / :class:`ServiceClient`):
+newline-JSON frames over TCP, the same framing as the hostnet control
+plane (``infrastructure/hostnet.py``).  One request in flight per
+connection; concurrency is connections — N clients on N sockets
+coalesce into shared ticks.  ``pydcop_tpu serve`` is the CLI front
+(``docs/serving.md`` covers the tick policy, affinity, and failure
+semantics under the PR 6 recovery matrix).
+
+Telemetry (``docs/observability.md``): counters ``service.requests``/
+``service.ticks``/``service.dispatches``/``service.coalesced``/
+``service.pad_instances``, histograms ``service.queue_wait_s``/
+``service.latency_s``/``service.batch_occupancy``, and per-request
+``service.queue-wait`` + ``service.request`` spans / per-group
+``service.dispatch`` spans that ``pydcop_tpu trace-summary`` folds
+into queue-wait / occupancy / latency percentiles.
+
+This module is import-light by design: jax (and the batched engine)
+load on first dispatch, not at import, so ``api.ServiceClient`` stays
+usable from jax-free client processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import socket
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Mapping, Optional, Tuple, Union
+
+from pydcop_tpu.telemetry import get_metrics, get_tracer
+from pydcop_tpu.telemetry.summary import _percentile
+
+#: queue-wait / latency histogram buckets (seconds) — service
+#: latencies live in the 1ms..10s band, below the metrics module's
+#: generic defaults
+_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+#: bounded stats windows (per-service): enough for stable p99 at the
+#: bench's request counts without growing forever in a resident process
+_STATS_WINDOW = 8192
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclasses.dataclass
+class TickPolicy:
+    """When a tick fires: as soon as ``max_batch`` requests are
+    pending, or as soon as the OLDEST pending request has waited
+    ``max_wait`` seconds — whichever comes first.  ``max_batch`` also
+    caps how many requests one tick drains (a burst beyond it rolls
+    into the immediately-following tick), so dispatch width — and with
+    it HBM footprint and per-tick latency — stays bounded."""
+
+    max_batch: int = 32
+    max_wait: float = 0.01
+
+    def __post_init__(self) -> None:
+        if self.max_batch < 1:
+            raise ValueError(
+                f"max_batch must be >= 1, got {self.max_batch}"
+            )
+        if self.max_wait < 0:
+            raise ValueError(
+                f"max_wait must be >= 0, got {self.max_wait}"
+            )
+
+
+class PendingResult:
+    """Handle for a submitted request: :meth:`result` blocks until the
+    service tick that carried the request completes (or raises what
+    the dispatch raised)."""
+
+    def __init__(self) -> None:
+        self._done = threading.Event()
+        self._result: Optional[Dict[str, Any]] = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                "service request still pending after "
+                f"{timeout}s (is the service running?)"
+            )
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+    # -- service side ----------------------------------------------------
+
+    def _set_result(self, result: Dict[str, Any]) -> None:
+        self._result = result
+        self._done.set()
+
+    def _set_error(self, error: BaseException) -> None:
+        self._error = error
+        self._done.set()
+
+
+@dataclasses.dataclass
+class _Request:
+    """One admitted solve request (internal)."""
+
+    dcop: Any  # DCOP object (loaded at admission)
+    dcop_key: Tuple  # compiled-problem cache key
+    algo: str
+    params: Dict[str, Any]  # prepared algo params
+    rounds: int
+    seed: int
+    chunk_size: int
+    convergence_chunks: int
+    n_restarts: int
+    timeout: Optional[float]
+    session: Optional[str]
+    set_values: Optional[Dict[str, Any]]
+    pending: PendingResult
+    enqueue_t: float = 0.0
+    queue_wait: float = 0.0
+
+
+class _Session:
+    """One client's pinned incremental-compile state: the
+    :class:`~pydcop_tpu.engine.incremental.IncrementalCompiler` built
+    on the session's FIRST request plus the accumulated external
+    values its ``set_values`` deltas stream in.  Segment 2+ costs a
+    device delta-update (``compile.incremental``) or nothing
+    (``compile.reused``) — never a host rebuild or an XLA compile."""
+
+    def __init__(self, compiler, dcop, dcop_key: Tuple) -> None:
+        self.compiler = compiler
+        self.dcop = dcop
+        self.dcop_key = dcop_key  # admission identity of segment 1
+        self.ext_values: Dict[str, Any] = {}
+        self.segments = 0
+
+
+class ServiceError(RuntimeError):
+    """A request the service could not solve (bad algo/params/dcop, or
+    an unrecoverable dispatch failure); the message is the client-side
+    report."""
+
+
+class SolverService:
+    """The resident continuous-batching solver (module docstring).
+
+    In-process use::
+
+        with session() as tel, SolverService(pad_policy="pow2") as svc:
+            pendings = [svc.submit(d, "dsa", {}) for d in dcops]
+            results = [p.result() for p in pendings]
+
+    ``submit`` is thread-safe: N client threads submitting
+    concurrently coalesce into shared ticks.  :class:`ServiceServer`
+    puts the same object behind a TCP socket for out-of-process
+    clients (:class:`ServiceClient`).
+
+    The service does not open a telemetry session of its own —
+    counters/spans land in whatever session is active (the ``serve``
+    command opens one for the server's lifetime; in-process embedders
+    wrap the service in ``telemetry.session()``), and the always-on
+    :meth:`stats` aggregates stay available without one.
+    """
+
+    def __init__(
+        self,
+        pad_policy: str = "pow2",
+        tick: Optional[TickPolicy] = None,
+        *,
+        max_batch: Optional[int] = None,
+        max_wait: Optional[float] = None,
+        instance_bucket: str = "pow2",
+        chaos: Optional[str] = None,
+        chaos_seed: int = 0,
+        retry_budget: Optional[int] = None,
+        chunk_floor: Optional[int] = None,
+        on_numeric_fault: Optional[str] = None,
+        compile_cache_max: int = 256,
+        autostart: bool = True,
+    ):
+        from pydcop_tpu.ops.padding import as_pad_policy
+
+        as_pad_policy(pad_policy)  # fail fast on a malformed spec
+        self.pad_policy = pad_policy
+        if tick is None:
+            tick = TickPolicy()
+        if max_batch is not None:
+            tick = dataclasses.replace(tick, max_batch=max_batch)
+        if max_wait is not None:
+            tick = dataclasses.replace(tick, max_wait=max_wait)
+        self.tick = tick
+        if instance_bucket not in ("pow2", "none"):
+            raise ValueError(
+                "instance_bucket must be 'pow2' or 'none', got "
+                f"{instance_bucket!r}"
+            )
+        self.instance_bucket = instance_bucket
+
+        plan = None
+        if chaos:
+            from pydcop_tpu.faults import FaultPlan
+
+            plan = FaultPlan.from_spec(chaos, chaos_seed)
+            if plan.message_faults_configured or plan.crashes:
+                raise ValueError(
+                    "the solver service dispatches on the batched "
+                    "engine, which has no message plane — chaos "
+                    "accepts the DEVICE-layer kinds only: device_oom, "
+                    "device_transient, nan_inject (docs/faults.md)"
+                )
+        self.chaos_plan = plan
+        from pydcop_tpu.engine.supervisor import make_supervisor
+
+        self._sup = make_supervisor(
+            retry_budget=retry_budget, chunk_floor=chunk_floor,
+            on_numeric_fault=on_numeric_fault, plan=plan,
+        )
+
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._closing = False
+        self._worker: Optional[threading.Thread] = None
+        self._sessions: Dict[str, _Session] = {}
+        # compiled-problem cache: dcop identity -> CompiledProblem
+        # (LRU; the value also pins the DCOP object so an id-keyed
+        # entry can never alias a new object at a recycled address)
+        self._compiled: "OrderedDict[Tuple, Tuple[Any, Any]]" = (
+            OrderedDict()
+        )
+        self._compile_cache_max = compile_cache_max
+
+        # always-on aggregates (stats()); bounded windows
+        self._stats_lock = threading.Lock()
+        self._n_requests = 0
+        self._n_ticks = 0
+        self._n_dispatches = 0
+        self._n_coalesced = 0  # requests that shared a group with >= 1 other
+        self._n_pad_instances = 0
+        self._n_errors = 0
+        self._queue_waits: deque = deque(maxlen=_STATS_WINDOW)
+        self._latencies: deque = deque(maxlen=_STATS_WINDOW)
+        self._occupancies: deque = deque(maxlen=_STATS_WINDOW)
+
+        if autostart:
+            self.start()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the tick worker (idempotent)."""
+        with self._cond:
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._closing = False
+            self._worker = threading.Thread(
+                target=self._run, name="solver-service-tick", daemon=True
+            )
+            self._worker.start()
+
+    def close(self) -> None:
+        """Stop admitting, drain the queue, join the worker."""
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
+
+    def __enter__(self) -> "SolverService":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- admission -------------------------------------------------------
+
+    def submit(
+        self,
+        dcop: Any = None,
+        algo: Optional[str] = None,
+        algo_params: Optional[Mapping[str, Any]] = None,
+        *,
+        rounds: int = 200,
+        seed: int = 0,
+        chunk_size: int = 64,
+        convergence_chunks: int = 0,
+        n_restarts: int = 1,
+        timeout: Optional[float] = None,
+        session: Optional[str] = None,
+        set_values: Optional[Mapping[str, Any]] = None,
+    ) -> PendingResult:
+        """Admit one solve request; returns a :class:`PendingResult`.
+
+        ``dcop`` is a DCOP object, a yaml file path, or yaml TEXT (any
+        string containing a newline is treated as text — the wire
+        protocol's form).  ``session`` names a session: its first
+        request must carry the dcop and pins an incremental compiler;
+        later requests may omit ``dcop`` and stream ``set_values``
+        deltas ({external variable: value}) instead.  Validation
+        errors raise HERE (before admission); dispatch errors surface
+        from ``PendingResult.result()``.
+        """
+        with self._cond:
+            if self._closing:
+                raise ServiceError("service is closed")
+        if n_restarts < 1:
+            raise ValueError(
+                f"n_restarts must be >= 1, got {n_restarts}"
+            )
+        if set_values is not None and session is None:
+            raise ValueError(
+                "set_values streams external-variable deltas into a "
+                "pinned session — pass session=<name> (docs/serving.md)"
+            )
+
+        sess = self._sessions.get(session) if session else None
+        if sess is not None:
+            if dcop is not None:
+                # a follow-up may resend the SAME dcop (a reconnecting
+                # wire client naturally re-ships its yaml text); a
+                # DIFFERENT one would silently solve the pinned
+                # problem under the new problem's name — reject it
+                _, key = self._load_dcop(dcop)
+                if key != sess.dcop_key:
+                    raise ServiceError(
+                        f"session {session!r} is pinned to a "
+                        "different dcop — close_session first, or "
+                        "use a new session name (docs/serving.md)"
+                    )
+            dcop_obj, dcop_key = sess.dcop, sess.dcop_key
+        else:
+            if dcop is None:
+                raise ValueError(
+                    "dcop is required (only follow-up requests of an "
+                    "open session may omit it)"
+                )
+            dcop_obj, dcop_key = self._load_dcop(dcop)
+        if algo is None:
+            raise ValueError("algo is required")
+
+        from pydcop_tpu.algorithms import (
+            load_algorithm_module,
+            prepare_algo_params,
+            resolve_algo,
+        )
+
+        algo_name, params_in = resolve_algo(algo, algo_params)
+        module = load_algorithm_module(algo_name)
+        params = prepare_algo_params(params_in, module.algo_params)
+
+        req = _Request(
+            dcop=dcop_obj, dcop_key=dcop_key, algo=algo_name,
+            params=params, rounds=rounds, seed=seed,
+            chunk_size=chunk_size,
+            convergence_chunks=convergence_chunks,
+            n_restarts=n_restarts, timeout=timeout, session=session,
+            set_values=dict(set_values) if set_values else None,
+            pending=PendingResult(),
+        )
+        met = get_metrics()
+        if met.enabled:
+            met.inc("service.requests")
+        with self._cond:
+            if self._closing:
+                raise ServiceError("service is closed")
+            req.enqueue_t = time.perf_counter()
+            self._queue.append(req)
+            self._cond.notify_all()
+        with self._stats_lock:
+            self._n_requests += 1
+        return req.pending
+
+    def solve(self, *args, **kwargs) -> Dict[str, Any]:
+        """Blocking convenience: ``submit(...).result()``."""
+        return self.submit(*args, **kwargs).result()
+
+    def close_session(self, name: str) -> bool:
+        """Drop a pinned session (frees its compiled state); returns
+        whether it existed."""
+        with self._cond:
+            return self._sessions.pop(name, None) is not None
+
+    # -- stats -----------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Always-on serving aggregates: request/tick/dispatch counts,
+        coalesce ratio, occupancy and queue-wait/latency percentiles
+        over a bounded recent window."""
+        with self._stats_lock:
+            waits = list(self._queue_waits)
+            lats = list(self._latencies)
+            occs = [float(o) for o in self._occupancies]
+            out = {
+                "requests": self._n_requests,
+                "ticks": self._n_ticks,
+                "dispatches": self._n_dispatches,
+                "coalesced_requests": self._n_coalesced,
+                "pad_instances": self._n_pad_instances,
+                "errors": self._n_errors,
+                "sessions": len(self._sessions),
+            }
+        out["coalesce_ratio"] = (
+            round(len(lats) and sum(occs) / max(1, len(occs)), 4)
+            if occs
+            else 0.0
+        )
+        out["queue_wait_s"] = {
+            "p50": _percentile(waits, 50),
+            "p99": _percentile(waits, 99),
+            "max": max(waits) if waits else 0.0,
+        }
+        out["latency_s"] = {
+            "p50": _percentile(lats, 50),
+            "p99": _percentile(lats, 99),
+            "max": max(lats) if lats else 0.0,
+        }
+        out["batch_occupancy"] = {
+            "p50": _percentile(occs, 50),
+            "max": max(occs) if occs else 0.0,
+        }
+        return out
+
+    # -- dcop loading + compiled-problem cache ---------------------------
+
+    def _load_dcop(self, dcop: Any) -> Tuple[Any, Tuple]:
+        """Normalize a request's dcop to (DCOP object, cache key).
+
+        yaml TEXT keys by content hash (repeat submissions of the same
+        text share one compile), paths by (realpath, mtime, size),
+        objects by identity (the cache entry pins the object, so the
+        id can never be recycled under the key)."""
+        from pydcop_tpu.dcop.dcop import DCOP
+
+        if isinstance(dcop, DCOP):
+            return dcop, ("obj", id(dcop))
+        if isinstance(dcop, str) and "\n" in dcop:
+            key = (
+                "yaml",
+                hashlib.sha256(dcop.encode("utf-8")).hexdigest(),
+            )
+            with self._cond:
+                cached = self._compiled.get(key)
+            if cached is not None:
+                return cached[0], key
+            from pydcop_tpu.dcop.yamldcop import load_dcop
+
+            return load_dcop(dcop), key
+        if isinstance(dcop, (str, list, tuple)):
+            from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+
+            if isinstance(dcop, str):
+                path = os.path.realpath(dcop)
+                st = os.stat(path)
+                key = ("path", path, st.st_mtime_ns, st.st_size)
+                with self._cond:
+                    cached = self._compiled.get(key)
+                if cached is not None:
+                    return cached[0], key
+            else:
+                key = ("paths", tuple(dcop))
+            return load_dcop_from_file(dcop), key
+        raise ValueError(
+            f"dcop must be a DCOP object, a yaml path, or yaml text — "
+            f"got {type(dcop).__name__}"
+        )
+
+    def _compiled_problem(self, req: _Request):
+        """The request's CompiledProblem, from the LRU cache when the
+        dcop identity was seen before (the host-side analogue of the
+        runner cache: repeated requests skip the numpy re-tabulation,
+        not just the XLA compile)."""
+        key = req.dcop_key
+        with self._cond:
+            hit = self._compiled.get(key)
+            if hit is not None and (
+                key[0] != "obj" or hit[0] is req.dcop
+            ):
+                self._compiled.move_to_end(key)
+                return hit[1]
+        from pydcop_tpu.ops.compile import compile_dcop
+
+        problem = compile_dcop(req.dcop, pad_policy=self.pad_policy)
+        with self._cond:
+            self._compiled[key] = (req.dcop, problem)
+            while len(self._compiled) > self._compile_cache_max:
+                self._compiled.popitem(last=False)
+        return problem
+
+    # -- the tick loop ---------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closing:
+                    self._cond.wait()
+                if not self._queue:
+                    return  # closing, drained
+                # tick policy: fire on max_batch pending, or when the
+                # oldest request has waited max_wait
+                while (
+                    len(self._queue) < self.tick.max_batch
+                    and not self._closing
+                ):
+                    left = self.tick.max_wait - (
+                        time.perf_counter() - self._queue[0].enqueue_t
+                    )
+                    if left <= 0:
+                        break
+                    self._cond.wait(timeout=left)
+                batch = [
+                    self._queue.popleft()
+                    for _ in range(
+                        min(len(self._queue), self.tick.max_batch)
+                    )
+                ]
+            try:
+                self._dispatch_tick(batch)
+            except Exception as e:  # noqa: BLE001 — the worker must
+                # outlive ANY tick (an escaped telemetry/bookkeeping
+                # error would otherwise kill the thread silently and
+                # leave every future request queued forever): fail
+                # the batch's undelivered requests, keep ticking
+                try:
+                    self._fail(batch, e)
+                except Exception:  # noqa: BLE001 — even the failure
+                    # path (tracer/metrics) can be what's broken;
+                    # unblocking the clients is the one hard duty left
+                    for req in batch:
+                        if not req.pending.done():
+                            req.pending._set_error(
+                                ServiceError(
+                                    f"tick dispatch failed: "
+                                    f"{type(e).__name__}: {e}"
+                                )
+                            )
+
+    def _dispatch_tick(self, batch: List[_Request]) -> None:
+        from pydcop_tpu.engine.supervisor import supervision
+
+        met = get_metrics()
+        tr = get_tracer()
+        tick_t = time.perf_counter()
+        for req in batch:
+            req.queue_wait = tick_t - req.enqueue_t
+            if met.enabled:
+                met.observe(
+                    "service.queue_wait_s", req.queue_wait,
+                    buckets=_LATENCY_BUCKETS,
+                )
+            if tr.enabled:
+                tr.add_span(
+                    "service.queue-wait", "service", req.enqueue_t,
+                    req.queue_wait, algo=req.algo,
+                )
+        with self._stats_lock:
+            self._n_ticks += 1
+            self._queue_waits.extend(r.queue_wait for r in batch)
+        if met.enabled:
+            met.inc("service.ticks")
+            met.gauge("service.queue_depth", len(self._queue))
+
+        # session requests keep FIFO order per session; stateless
+        # requests coalesce into groups
+        with supervision(self._sup):
+            stateless: List[_Request] = []
+            for req in batch:
+                if req.session is not None:
+                    self._dispatch_session(req)
+                else:
+                    stateless.append(req)
+            if stateless:
+                self._dispatch_groups(stateless)
+
+    # -- dispatch: coalesced stateless groups ----------------------------
+
+    def _group_key(self, req: _Request) -> Tuple:
+        from pydcop_tpu.engine.host_batch import statics_signature
+
+        return (
+            req.algo,
+            statics_signature(req.params),
+            req.rounds,
+            req.chunk_size,
+            req.convergence_chunks,
+            req.n_restarts,
+            # timeouts act GROUP-wide at chunk boundaries
+            # (run_many_batched), so a request carrying one may only
+            # coalesce with requests carrying the same one — a tight
+            # deadline must never truncate a batchmate's solve
+            req.timeout,
+        )
+
+    def _dispatch_groups(self, reqs: List[_Request]) -> None:
+        partitions: "OrderedDict[Tuple, List[_Request]]" = OrderedDict()
+        for req in reqs:
+            partitions.setdefault(self._group_key(req), []).append(req)
+        for part in partitions.values():
+            from pydcop_tpu.algorithms import load_algorithm_module
+
+            module = load_algorithm_module(part[0].algo)
+            try:
+                if hasattr(module, "solve_host"):
+                    self._dispatch_host(part, module)
+                else:
+                    self._dispatch_device(part, module)
+            except Exception as e:  # noqa: BLE001 — fail this
+                # partition's requests, keep serving the others
+                self._fail(part, e)
+
+    def _finish(
+        self, req: _Request, result: Dict[str, Any], group_n: int
+    ) -> None:
+        met = get_metrics()
+        tr = get_tracer()
+        latency = time.perf_counter() - req.enqueue_t
+        result["queue_wait"] = req.queue_wait
+        result["instances_batched"] = group_n
+        result.pop("telemetry", None)  # service-level, not per-request
+        if met.enabled:
+            met.observe(
+                "service.latency_s", latency, buckets=_LATENCY_BUCKETS
+            )
+            if group_n > 1:
+                met.inc("service.coalesced")
+        if tr.enabled:
+            tr.add_span(
+                "service.request", "service", req.enqueue_t, latency,
+                algo=req.algo, instances=group_n, status=result.get("status"),
+            )
+        with self._stats_lock:
+            self._latencies.append(latency)
+            if group_n > 1:
+                self._n_coalesced += 1
+        req.pending._set_result(result)
+
+    def _fail(self, reqs: List[_Request], error: BaseException) -> None:
+        # a partition can span several stacked groups; groups that
+        # already delivered must keep their results when a LATER
+        # group's dispatch raises
+        reqs = [r for r in reqs if not r.pending.done()]
+        if not reqs:
+            return
+        met = get_metrics()
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event(
+                "service-error", cat="service",
+                error=f"{type(error).__name__}: {error}"[:300],
+                requests=len(reqs),
+            )
+        if met.enabled:
+            met.inc("service.errors", len(reqs))
+        with self._stats_lock:
+            self._n_errors += len(reqs)
+        for req in reqs:
+            req.pending._set_error(
+                ServiceError(
+                    f"dispatch failed for algo={req.algo!r}: "
+                    f"{type(error).__name__}: {error}"
+                )
+            )
+
+    def _record_dispatch(self, k: int, padded: int) -> None:
+        met = get_metrics()
+        if met.enabled:
+            met.inc("service.dispatches")
+            met.observe(
+                "service.batch_occupancy", k,
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+            )
+            if padded:
+                met.inc("service.pad_instances", padded)
+        with self._stats_lock:
+            self._n_dispatches += 1
+            self._occupancies.append(k)
+            self._n_pad_instances += padded
+
+    def _dispatch_device(self, part: List[_Request], module) -> None:
+        from pydcop_tpu.api import _result_dict
+        from pydcop_tpu.engine.batched import run_many_batched
+        from pydcop_tpu.ops.compile import stack_problems
+
+        tr = get_tracer()
+        r0 = part[0]
+        problems = [self._compiled_problem(r) for r in part]
+        for stacked in stack_problems(problems):
+            group = [part[i] for i in stacked.indices]
+            k = len(group)
+            # occupancy bucketing: pad the group to a pow-2 instance
+            # count by repeating the last member so the vmapped runner
+            # cache (keyed on K) converges on log2 executables instead
+            # of one per distinct tick size; pad lanes re-solve a real
+            # instance and are discarded below
+            padded = 0
+            if self.instance_bucket == "pow2" and k > 1:
+                k_pad = _next_pow2(k)
+                if k_pad != k:
+                    padded = k_pad - k
+                    stacked = stack_problems(
+                        stacked.host_problems
+                        + [stacked.host_problems[-1]] * padded
+                    )[0]
+            # the group key pins one shared timeout per partition
+            run_timeout = None
+            if r0.timeout is not None:
+                run_timeout = max(
+                    r0.timeout
+                    - (time.perf_counter() - r0.enqueue_t),
+                    0.01,
+                )
+            self._record_dispatch(k, padded)
+            params_list = [g.params for g in group]
+            seeds = [g.seed for g in group]
+            if padded:
+                params_list = params_list + [params_list[-1]] * padded
+                seeds = seeds + [seeds[-1]] * padded
+            with tr.span(
+                "service.dispatch", cat="service", instances=k,
+                padded=padded, algo=r0.algo,
+            ):
+                results = run_many_batched(
+                    stacked,
+                    module,
+                    params_list,
+                    rounds=r0.rounds,
+                    seeds=seeds,
+                    timeout=run_timeout,
+                    chunk_size=r0.chunk_size,
+                    convergence_chunks=r0.convergence_chunks,
+                    n_restarts=r0.n_restarts,
+                )
+            for req, rr in zip(group, results):  # pads fall off zip
+                out = _result_dict(rr)
+                out["time"] = rr.time / k
+                self._finish(req, out, k)
+
+    def _dispatch_host(self, part: List[_Request], module) -> None:
+        """Exact host-path algorithms (DPOP, SyncBB): one
+        ``run_many_host`` call per partition — DPOP requests merge
+        their UTIL sweeps exactly as ``api.solve_many`` merges them."""
+        from pydcop_tpu.engine.host_batch import run_many_host
+
+        tr = get_tracer()
+        r0 = part[0]
+        k = len(part)
+        # the group key pins one shared timeout per partition
+        run_timeout = None
+        if r0.timeout is not None:
+            run_timeout = max(
+                r0.timeout - (time.perf_counter() - r0.enqueue_t),
+                0.01,
+            )
+        self._record_dispatch(k, 0)
+        with tr.span(
+            "service.dispatch", cat="service", instances=k,
+            padded=0, algo=r0.algo,
+        ):
+            results = run_many_host(
+                [g.dcop for g in part],
+                module,
+                [g.params for g in part],
+                timeout=run_timeout,
+                pad_policy=self.pad_policy,
+            )
+        for req, out in zip(part, results):
+            self._finish(req, out, out.get("instances_batched", k))
+
+    # -- dispatch: session-affine requests -------------------------------
+
+    def _dispatch_session(self, req: _Request) -> None:
+        try:
+            result = self._solve_session(req)
+        except Exception as e:  # noqa: BLE001 — per-request failure
+            self._fail([req], e)
+            return
+        self._finish(req, result, 1)
+
+    def _solve_session(self, req: _Request) -> Dict[str, Any]:
+        from pydcop_tpu.api import _result_dict
+        from pydcop_tpu.engine.batched import run_batched
+
+        tr = get_tracer()
+        sess = self._sessions.get(req.session)
+        if sess is None:
+            from pydcop_tpu.engine.incremental import (
+                IncrementalCompiler,
+            )
+
+            sess = _Session(
+                IncrementalCompiler(
+                    req.dcop, pad_policy=self.pad_policy
+                ),
+                req.dcop,
+                req.dcop_key,
+            )
+            self._sessions[req.session] = sess
+            met = get_metrics()
+            if met.enabled:
+                met.inc("service.sessions_opened")
+        if req.set_values:
+            unknown = set(req.set_values) - set(
+                sess.dcop.external_variables
+            )
+            if unknown:
+                raise ServiceError(
+                    f"set_values names {sorted(unknown)}, not external "
+                    "variables of the session's dcop — session deltas "
+                    "update externals only (structure changes need a "
+                    "new session, docs/serving.md)"
+                )
+            sess.ext_values.update(req.set_values)
+        problem, _fp = sess.compiler.compile({}, sess.ext_values)
+        if problem is None:
+            raise ServiceError(
+                "session dcop has no live variables to solve"
+            )
+        sess.segments += 1
+        run_timeout = None
+        if req.timeout is not None:
+            run_timeout = max(
+                req.timeout - (time.perf_counter() - req.enqueue_t),
+                0.01,
+            )
+        self._record_dispatch(1, 0)
+        with tr.span(
+            "service.dispatch", cat="service", instances=1, padded=0,
+            algo=req.algo, session=req.session,
+            segment=sess.segments,
+        ):
+            result = run_batched(
+                problem,
+                _load_module(req.algo),
+                req.params,
+                rounds=req.rounds,
+                seed=req.seed,
+                timeout=run_timeout,
+                chunk_size=req.chunk_size,
+                convergence_chunks=req.convergence_chunks,
+                n_restarts=req.n_restarts,
+            )
+        out = _result_dict(result)
+        out["session"] = req.session
+        out["segment"] = sess.segments
+        return out
+
+
+def _load_module(algo_name: str):
+    from pydcop_tpu.algorithms import load_algorithm_module
+
+    return load_algorithm_module(algo_name)
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: newline-JSON frames (the hostnet control-plane framing)
+# ---------------------------------------------------------------------------
+#
+# request:  {"op": "solve", "id": N, "algo": ..., "dcop": yaml-text |
+#            path, "params": {...}, "rounds": ..., "seed": ...,
+#            "session": ..., "set_values": {...}, ...}
+#           {"op": "stats" | "ping" | "close_session" | "shutdown",
+#            "id": N, ...}
+# response: {"id": N, "ok": true, "result"|"stats"|...: ...}
+#           {"id": N, "ok": false, "error": "..."}
+#
+# One request in flight per connection (a client wanting concurrency
+# opens more connections — that is exactly what makes requests
+# coalesce); responses carry the request id regardless.
+
+_SOLVE_FIELDS = (
+    "rounds", "seed", "chunk_size", "convergence_chunks",
+    "n_restarts", "timeout", "session", "set_values",
+)
+
+#: results are trimmed for the wire: the per-round cost trace can be
+#: orders of magnitude bigger than the answer
+_WIRE_DROP = ("cost_trace", "restart_costs")
+
+
+class ServiceServer:
+    """TCP front for a :class:`SolverService`: accepts connections,
+    one handler thread per connection, newline-JSON frames."""
+
+    def __init__(
+        self,
+        service: SolverService,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.service = service
+        self._server = socket.create_server((host, port))
+        self.address: Tuple[str, int] = (
+            host, self._server.getsockname()[1]
+        )
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._accept = threading.Thread(
+            target=self._accept_loop, name="solver-service-accept",
+            daemon=True,
+        )
+        self._accept.start()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until :meth:`close` / a ``shutdown`` op (or the
+        timeout); returns True when shut down."""
+        return self._shutdown.wait(timeout)
+
+    def close(self) -> None:
+        self._shutdown.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+            threads = list(self._threads)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in threads:
+            t.join(timeout=5)
+
+    def __enter__(self) -> "ServiceServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _addr = self._server.accept()
+            except OSError:
+                return  # closed
+            with self._lock:
+                self._conns.append(conn)
+            t = threading.Thread(
+                target=self._handle, args=(conn,),
+                name="solver-service-conn", daemon=True,
+            )
+            with self._lock:
+                self._threads.append(t)
+            t.start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        from pydcop_tpu.infrastructure.hostnet import _recv, _send
+
+        reader = conn.makefile("rb")
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    msg = _recv(reader)
+                except (OSError, ValueError):
+                    return
+                if msg is None:
+                    return
+                rid = msg.get("id")
+                try:
+                    reply = self._serve_op(msg)
+                except Exception as e:  # noqa: BLE001 — per-request
+                    reply = {
+                        "ok": False,
+                        "error": f"{type(e).__name__}: {e}",
+                    }
+                reply["id"] = rid
+                try:
+                    _send(conn, reply)
+                except OSError:
+                    return
+                if msg.get("op") == "shutdown":
+                    self._shutdown.set()
+                    return
+        finally:
+            try:
+                reader.close()
+                conn.close()
+            except OSError:
+                pass
+            # "concurrency is connections" means a resident server
+            # sees millions of short-lived ones: drop this handler's
+            # bookkeeping or _conns/_threads grow without bound
+            with self._lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
+                try:
+                    self._threads.remove(threading.current_thread())
+                except ValueError:
+                    pass
+
+    def _serve_op(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        op = msg.get("op")
+        if op == "ping":
+            return {"ok": True, "pong": True}
+        if op == "stats":
+            return {"ok": True, "stats": self.service.stats()}
+        if op == "close_session":
+            return {
+                "ok": True,
+                "closed": self.service.close_session(
+                    msg.get("session", "")
+                ),
+            }
+        if op == "shutdown":
+            return {"ok": True, "stopping": True}
+        if op == "solve":
+            kwargs = {
+                k: msg[k] for k in _SOLVE_FIELDS if msg.get(k) is not None
+            }
+            result = self.service.solve(
+                msg.get("dcop"),
+                msg.get("algo"),
+                msg.get("params") or None,
+                **kwargs,
+            )
+            result = {
+                k: v for k, v in result.items() if k not in _WIRE_DROP
+            }
+            return {"ok": True, "result": result}
+        raise ServiceError(f"unknown op {op!r}")
+
+
+class ServiceClient:
+    """Thin blocking client for a :class:`ServiceServer` (also
+    exported as ``pydcop_tpu.api.ServiceClient``).
+
+    One request in flight at a time per client; open more clients for
+    concurrency — concurrent clients are exactly what the service
+    coalesces.  ``dcop`` arguments that name an existing file are
+    read and shipped as yaml text, so the server needs no shared
+    filesystem.
+    """
+
+    def __init__(
+        self,
+        address: Union[str, Tuple[str, int]],
+        timeout: Optional[float] = None,
+    ):
+        if isinstance(address, str):
+            host, _, port = address.rpartition(":")
+            address = (host or "127.0.0.1", int(port))
+        self._sock = socket.create_connection(
+            address, timeout=timeout
+        )
+        self._reader = self._sock.makefile("rb")
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def _call(self, op: str, **fields) -> Dict[str, Any]:
+        from pydcop_tpu.infrastructure.hostnet import _recv, _send
+
+        with self._lock:
+            self._next_id += 1
+            rid = self._next_id
+            _send(self._sock, {"op": op, "id": rid, **fields})
+            while True:
+                reply = _recv(self._reader)
+                if reply is None:
+                    raise ServiceError(
+                        "service connection closed mid-request"
+                    )
+                if reply.get("id") == rid:
+                    break
+        if not reply.get("ok"):
+            raise ServiceError(
+                reply.get("error", "service request failed")
+            )
+        return reply
+
+    def solve(
+        self,
+        dcop: Optional[str] = None,
+        algo: Optional[str] = None,
+        params: Optional[Mapping[str, Any]] = None,
+        **kwargs,
+    ) -> Dict[str, Any]:
+        """Solve over the wire; kwargs mirror
+        :meth:`SolverService.submit` (rounds, seed, chunk_size,
+        convergence_chunks, n_restarts, timeout, session,
+        set_values)."""
+        unknown = set(kwargs) - set(_SOLVE_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown solve field(s) {sorted(unknown)}; the wire "
+                f"protocol accepts {_SOLVE_FIELDS}"
+            )
+        if (
+            isinstance(dcop, str)
+            and "\n" not in dcop
+            and os.path.isfile(dcop)
+        ):
+            with open(dcop, encoding="utf-8") as f:
+                dcop = f.read()
+        reply = self._call(
+            "solve", dcop=dcop, algo=algo,
+            params=dict(params) if params else None, **kwargs,
+        )
+        return reply["result"]
+
+    def stats(self) -> Dict[str, Any]:
+        return self._call("stats")["stats"]
+
+    def ping(self) -> bool:
+        return bool(self._call("ping").get("pong"))
+
+    def close_session(self, name: str) -> bool:
+        return bool(
+            self._call("close_session", session=name).get("closed")
+        )
+
+    def shutdown(self) -> None:
+        """Ask the server process to stop serving."""
+        self._call("shutdown")
+
+    def close(self) -> None:
+        try:
+            self._reader.close()
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ServiceClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -71,16 +71,59 @@ def _from_chrome(doc: Dict[str, Any], path: str) -> List[Dict[str, Any]]:
     return records
 
 
+def _percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile of an unsorted sample (0 <= q <= 100)."""
+    if not values:
+        return 0.0
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return float(s[idx])
+
+
+def _service_summary(
+    waits: List[float], lats: List[float], occs: List[float]
+) -> Dict[str, Any]:
+    """Serving aggregates from the solver service's spans
+    (``engine/service.py``, ``docs/serving.md``): queue-wait /
+    request-latency / batch-occupancy percentiles plus the coalesce
+    ratio (requests per dispatch) — the numbers that say whether the
+    tick policy is batching without blowing the latency SLO."""
+    out: Dict[str, Any] = {
+        "requests": len(lats),
+        "dispatches": len(occs),
+    }
+    if occs:
+        out["coalesce_ratio"] = round(sum(occs) / len(occs), 3)
+    for label, values in (
+        ("queue_wait_s", waits),
+        ("latency_s", lats),
+        ("batch_occupancy", occs),
+    ):
+        if values:
+            out[label] = {
+                "p50": _percentile(values, 50),
+                "p90": _percentile(values, 90),
+                "p99": _percentile(values, 99),
+                "max": max(values),
+            }
+    return out
+
+
 def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Aggregate a trace: per-phase span totals, per-category event
-    counts, per-agent message/fault activity, and the embedded metrics
-    snapshot (when the session wrote one)."""
+    counts, per-agent message/fault activity, the embedded metrics
+    snapshot (when the session wrote one), and — for traces from a
+    solver service (``pydcop_tpu serve``) — queue-wait / occupancy /
+    latency percentiles under ``service``."""
     phases: Dict[str, Dict[str, float]] = {}
     events: Dict[str, int] = {}
     agents: Dict[str, Dict[str, int]] = {}
     faults: Dict[str, int] = {}
     metrics: Dict[str, Any] = {}
     meta: Dict[str, Any] = {}
+    svc_waits: List[float] = []
+    svc_lats: List[float] = []
+    svc_occs: List[float] = []
     for r in records:
         kind = r.get("kind")
         if kind == "meta":
@@ -88,14 +131,23 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         elif kind == "metrics":
             metrics = {k: v for k, v in r.items() if k != "kind"}
         elif kind == "span":
+            name = r.get("name", "?")
             s = phases.setdefault(
-                r.get("name", "?"),
+                name,
                 {"count": 0, "total_s": 0.0, "max_s": 0.0},
             )
             dur = float(r.get("dur", 0.0))
             s["count"] += 1
             s["total_s"] += dur
             s["max_s"] = max(s["max_s"], dur)
+            if name == "service.queue-wait":
+                svc_waits.append(dur)
+            elif name == "service.request":
+                svc_lats.append(dur)
+            elif name == "service.dispatch":
+                occ = (r.get("args") or {}).get("instances")
+                if occ is not None:
+                    svc_occs.append(float(occ))
         elif kind == "event":
             name = r.get("name", "?")
             events[name] = events.get(name, 0) + 1
@@ -110,7 +162,7 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             if agent is not None:
                 a = agents.setdefault(str(agent), {})
                 a[name] = a.get(name, 0) + 1
-    return {
+    out = {
         "meta": meta,
         "phases": phases,
         "events": events,
@@ -118,6 +170,9 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "faults": faults,
         "metrics": metrics,
     }
+    if svc_waits or svc_lats or svc_occs:
+        out["service"] = _service_summary(svc_waits, svc_lats, svc_occs)
+    return out
 
 
 def format_summary(s: Dict[str, Any]) -> str:
@@ -140,6 +195,32 @@ def format_summary(s: Dict[str, Any]) -> str:
         lines.append("event                          count")
         for name in sorted(events, key=lambda n: -events[n]):
             lines.append(f"{name:<28} {events[name]:>7}")
+    svc = s.get("service")
+    if svc:
+        lines.append("")
+        lines.append(
+            f"service: {svc.get('requests', 0)} requests / "
+            f"{svc.get('dispatches', 0)} dispatches"
+            + (
+                f", coalesce ratio {svc['coalesce_ratio']}"
+                if "coalesce_ratio" in svc
+                else ""
+            )
+        )
+        lines.append(
+            "                                  p50        p90"
+            "        p99        max"
+        )
+        for label in ("queue_wait_s", "latency_s", "batch_occupancy"):
+            if label in svc:
+                v = svc[label]
+                lines.append(
+                    f"  {label:<28}"
+                    + "".join(
+                        f" {v[q]:>10.4f}"
+                        for q in ("p50", "p90", "p99", "max")
+                    )
+                )
     faults = s.get("faults", {})
     if faults:
         lines.append("")

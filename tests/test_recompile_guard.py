@@ -98,5 +98,27 @@ def test_supervisor_guard_within_budget():
     assert report["oom_splits"] == 1, report
 
 
+@pytest.mark.service
+def test_service_guard_steady_state_zero_compiles():
+    """The serving-path acceptance criterion: waves of concurrent
+    requests in two shape buckets through a live SolverService compile
+    exactly one vmapped runner per bucket on the COLD tick and ZERO on
+    every steady-state tick, each wave coalesces into one tick of two
+    groups, and coalesced results are bit-identical to sequential
+    api.solve calls — see tools/recompile_guard.py:run_service_guard."""
+    guard = _load_guard()
+    report = guard.run_service_guard()
+    assert report["ok"], report
+    assert report["wave_compiles"][0] == guard.SERVICE_BUDGET, report
+    assert all(c == 0 for c in report["wave_compiles"][1:]), report
+    assert report["ticks"] == guard.SERVICE_WAVES, report
+    assert report["dispatches"] == 2 * guard.SERVICE_WAVES, report
+    # every request shared its group with >= 1 other
+    assert (
+        report["coalesced_requests"]
+        == guard.SERVICE_WAVES * guard.SERVICE_WAVE_K
+    ), report
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

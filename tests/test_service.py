@@ -1,0 +1,614 @@
+"""The continuous-batching solver service (``engine/service.py``,
+``docs/serving.md``): admission/tick policy, coalesced dispatch parity
+with sequential ``api.solve``, session-affine incremental solves (the
+zero-recompile acceptance criterion), device-chaos quarantine on the
+serving path, and the newline-JSON wire protocol
+(:class:`ServiceServer` / :class:`ServiceClient`).
+
+Timing discipline: tests that need a deterministic tick use
+``max_batch == number of submitted requests`` with a long ``max_wait``
+— the tick fires exactly when the last submit lands, never on a clock.
+"""
+
+import threading
+
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import (
+    AgentDef,
+    Domain,
+    ExternalVariable,
+    Variable,
+)
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.engine.service import (
+    ServiceClient,
+    ServiceError,
+    ServiceServer,
+    SolverService,
+    TickPolicy,
+)
+from pydcop_tpu.telemetry import session
+
+pytestmark = pytest.mark.service
+
+D = Domain("d", "", [0, 1, 2])
+
+
+def ring_dcop(n=6, name="ring"):
+    dcop = DCOP(name)
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        j = (i + 1) % n
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}_{j}", f"1 if v{i} == v{j} else 0", vs
+            )
+        )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n)])
+    return dcop
+
+
+def sensor_dcop():
+    """One chain + an external 'sensor' variable driving v0 (the
+    session-affinity workload: ``set_values`` deltas re-tabulate only
+    the 'track' constraint)."""
+    dcop = DCOP("ext")
+    vs = [Variable(f"v{i}", D) for i in range(3)]
+    for v in vs:
+        dcop.add_variable(v)
+    sensor = ExternalVariable("sensor", D, value=0)
+    dcop.add_variable(sensor)
+    for i in range(2):
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}", f"1 if v{i} == v{i + 1} else 0", vs
+            )
+        )
+    dcop.add_constraint(
+        constraint_from_str(
+            "track", "0 if v0 == sensor else 1", [vs[0], sensor]
+        )
+    )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(3)])
+    return dcop
+
+
+RING_YAML = (
+    "name: ring\n"
+    "objective: min\n"
+    "domains:\n"
+    "  colors: {values: [0, 1, 2]}\n"
+    "variables:\n"
+    + "".join(f"  v{i}: {{domain: colors}}\n" for i in range(6))
+    + "constraints:\n"
+    + "".join(
+        f"  c{i}: {{type: intention, "
+        f"function: '1 if v{i} == v{(i + 1) % 6} else 0'}}\n"
+        for i in range(6)
+    )
+    + "agents: [a1]\n"
+)
+
+
+# -- admission / validation (no device work) ---------------------------
+
+
+def test_tick_policy_and_constructor_validation():
+    with pytest.raises(ValueError, match="max_batch"):
+        TickPolicy(max_batch=0)
+    with pytest.raises(ValueError, match="max_wait"):
+        TickPolicy(max_wait=-1)
+    with pytest.raises(ValueError, match="instance_bucket"):
+        SolverService(instance_bucket="pow3", autostart=False)
+    with pytest.raises(ValueError):  # malformed pad policy fails fast
+        SolverService(pad_policy="pow3", autostart=False)
+    # message-plane chaos kinds are rejected: the service dispatches
+    # on the batched engine, which has no message plane
+    with pytest.raises(ValueError, match="DEVICE-layer"):
+        SolverService(chaos="drop=0.5", autostart=False)
+    with pytest.raises(ValueError, match="DEVICE-layer"):
+        SolverService(chaos="crash=a1@1", autostart=False)
+    svc = SolverService(
+        max_batch=4, max_wait=0.25, autostart=False
+    )
+    assert svc.tick.max_batch == 4 and svc.tick.max_wait == 0.25
+
+
+def test_submit_validation_errors_raise_before_admission():
+    svc = SolverService(autostart=False)
+    with pytest.raises(ValueError, match="dcop is required"):
+        svc.submit(None, "dsa")
+    with pytest.raises(ValueError, match="algo is required"):
+        svc.submit(ring_dcop())
+    with pytest.raises(ValueError, match="n_restarts"):
+        svc.submit(ring_dcop(), "dsa", n_restarts=0)
+    with pytest.raises(ValueError, match="session"):
+        svc.submit(ring_dcop(), "dsa", set_values={"sensor": 1})
+    with pytest.raises(ValueError, match="DCOP object"):
+        svc.submit(123, "dsa")
+    assert svc.stats()["requests"] == 0  # nothing was admitted
+    svc.close()
+    with pytest.raises(ServiceError, match="closed"):
+        svc.submit(ring_dcop(), "dsa")
+
+
+# -- coalesced dispatch: parity with sequential api.solve --------------
+
+
+def test_coalesced_results_bit_identical_to_sequential():
+    """Acceptance: requests coalesced into one tick return results
+    bit-identical to per-request sequential ``api.solve`` calls with
+    the same pad_policy (including an odd group that exercises the
+    pow-2 occupancy padding: 3 requests ride a 4-lane dispatch)."""
+    from pydcop_tpu.api import solve
+
+    dcops = [ring_dcop(5 + i, name=f"r{i}") for i in range(3)]
+    kw = dict(rounds=24, chunk_size=24)
+    with SolverService(
+        pad_policy="pow2:16", max_batch=3, max_wait=30.0,
+        autostart=False,
+    ) as svc:
+        pendings = [
+            svc.submit(d, "mgm", {}, seed=i, **kw)
+            for i, d in enumerate(dcops)
+        ]
+        got = [p.result(timeout=300) for p in pendings]
+        stats = svc.stats()
+    assert stats["ticks"] == 1 and stats["dispatches"] == 1
+    assert stats["coalesced_requests"] == 3
+    assert stats["pad_instances"] == 1  # 3 -> 4-lane pow2 dispatch
+    for i, (d, r) in enumerate(zip(dcops, got)):
+        seq = solve(
+            d, "mgm", {}, pad_policy="pow2:16", seed=i, **kw
+        )
+        assert r["cost"] == seq["cost"]
+        assert r["assignment"] == seq["assignment"]
+        assert r["cost_trace"] == seq["cost_trace"]
+        assert r["instances_batched"] == 3
+        assert r["queue_wait"] >= 0.0
+
+
+def test_mixed_param_partitions_in_one_tick():
+    """Requests whose STATIC params differ land in separate dispatch
+    groups within the same tick — and each still matches its own
+    sequential solve."""
+    from pydcop_tpu.api import solve
+
+    kw = dict(rounds=24, chunk_size=24, seed=5)
+    with SolverService(
+        pad_policy="pow2:16", max_batch=2, max_wait=30.0,
+        autostart=False,
+    ) as svc:
+        p1 = svc.submit(ring_dcop(6), "dsa", {"variant": "A"}, **kw)
+        p2 = svc.submit(ring_dcop(6), "dsa", {"variant": "B"}, **kw)
+        r1, r2 = p1.result(timeout=300), p2.result(timeout=300)
+        stats = svc.stats()
+    assert stats["ticks"] == 1 and stats["dispatches"] == 2
+    for variant, r in (("A", r1), ("B", r2)):
+        seq = solve(
+            ring_dcop(6), "dsa", {"variant": variant},
+            pad_policy="pow2:16", **kw,
+        )
+        assert r["cost"] == seq["cost"]
+        assert r["assignment"] == seq["assignment"]
+
+
+def test_dispatch_error_fails_only_its_partition():
+    """A request the engine cannot solve surfaces as ServiceError from
+    ITS pending result; batchmates in other partitions still finish,
+    and the service keeps serving.  (Bad algo PARAMS never get this
+    far — they raise at submit, before admission.)"""
+    with pytest.raises(Exception, match="not in allowed values"):
+        SolverService(autostart=False).submit(
+            ring_dcop(6), "dsa", {"variant": "nope"}
+        )
+    with SolverService(
+        max_batch=2, max_wait=30.0, autostart=False
+    ) as svc:
+        good = svc.submit(
+            ring_dcop(6), "dsa", {}, rounds=24, chunk_size=24
+        )
+        # an empty DCOP passes admission but fails compile at dispatch
+        bad = svc.submit(DCOP("empty"), "dsa", {}, rounds=24)
+        with pytest.raises(ServiceError, match="dispatch failed"):
+            bad.result(timeout=300)
+        assert good.result(timeout=300)["status"] == "finished"
+        assert svc.stats()["errors"] == 1
+
+
+def test_host_path_algorithms_dispatch_through_run_many_host():
+    """Exact host-path algos (DPOP) serve through the service too —
+    same cost as the direct api.solve call."""
+    from pydcop_tpu.api import solve
+
+    with SolverService(
+        max_batch=2, max_wait=30.0, autostart=False
+    ) as svc:
+        pendings = [
+            svc.submit(ring_dcop(5), "dpop", {}) for _ in range(2)
+        ]
+        got = [p.result(timeout=300) for p in pendings]
+    seq = solve(ring_dcop(5), "dpop", {})
+    for r in got:
+        assert r["cost"] == seq["cost"]
+
+
+def test_timeout_in_group_key_never_truncates_batchmates():
+    """A request carrying a deadline may only coalesce with requests
+    carrying the SAME deadline (the run_many_batched timeout acts
+    group-wide at chunk boundaries) — so a tight timeout splits off
+    into its own dispatch instead of truncating a batchmate's solve."""
+    kw = dict(rounds=24, chunk_size=24, seed=3)
+    with SolverService(
+        pad_policy="pow2:16", max_batch=2, max_wait=30.0,
+        autostart=False,
+    ) as svc:
+        p1 = svc.submit(ring_dcop(6, name="a"), "mgm", {}, **kw)
+        p2 = svc.submit(
+            ring_dcop(6, name="b"), "mgm", {}, timeout=120.0, **kw
+        )
+        r1, r2 = p1.result(timeout=300), p2.result(timeout=300)
+        stats = svc.stats()
+    # one tick, but two dispatches: the deadline split the group
+    assert stats["ticks"] == 1 and stats["dispatches"] == 2
+    assert r1["instances_batched"] == 1
+    assert r2["instances_batched"] == 1
+    assert r1["status"] == "finished" and r2["status"] == "finished"
+
+
+def test_group_failure_keeps_earlier_groups_results(monkeypatch):
+    """A partition can span several shape-bucket groups; when a LATER
+    group's dispatch raises, requests of an already-delivered earlier
+    group keep their results (only the failed group's clients see the
+    ServiceError)."""
+    from pydcop_tpu.engine import batched
+
+    real = batched.run_many_batched
+
+    def poisoned(stacked, *args, **kwargs):
+        # under pow2:16 the small rings stack at 16 padded vars, the
+        # big ones at 32 — poison only the big bucket
+        if stacked.template.n_real_vars > 16:
+            raise RuntimeError("big-bucket dispatch exploded")
+        return real(stacked, *args, **kwargs)
+
+    monkeypatch.setattr(batched, "run_many_batched", poisoned)
+    kw = dict(rounds=16, chunk_size=16)
+    with SolverService(
+        pad_policy="pow2:16", max_batch=4, max_wait=30.0,
+        autostart=False,
+    ) as svc:
+        # same partition (identical params), two shape buckets: the
+        # small group dispatches (and delivers) first, then the big
+        # group raises
+        smalls = [
+            svc.submit(ring_dcop(5 + i, name=f"s{i}"), "mgm", {}, **kw)
+            for i in range(2)
+        ]
+        bigs = [
+            svc.submit(
+                ring_dcop(17 + i, name=f"b{i}"), "mgm", {}, **kw
+            )
+            for i in range(2)
+        ]
+        for p in smalls:
+            assert p.result(timeout=300)["status"] == "finished"
+        for p in bigs:
+            with pytest.raises(ServiceError, match="big-bucket"):
+                p.result(timeout=300)
+        assert svc.stats()["errors"] == 2  # only the failed group
+
+
+def test_worker_survives_a_poisoned_tick(monkeypatch):
+    """The tick worker outlives an exception that escapes dispatch
+    entirely (e.g. a broken telemetry sink): the batch's clients get a
+    ServiceError instead of blocking forever, and the NEXT request is
+    served normally."""
+    calls = {"n": 0}
+    orig = SolverService._dispatch_tick
+
+    def flaky(self, batch):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("telemetry sink exploded")
+        return orig(self, batch)
+
+    monkeypatch.setattr(SolverService, "_dispatch_tick", flaky)
+    with SolverService(
+        max_batch=1, max_wait=30.0, autostart=False
+    ) as svc:
+        p1 = svc.submit(ring_dcop(6), "dsa", {}, rounds=8, chunk_size=8)
+        with pytest.raises(ServiceError, match="telemetry sink"):
+            p1.result(timeout=300)
+        p2 = svc.submit(ring_dcop(6), "dsa", {}, rounds=8, chunk_size=8)
+        assert p2.result(timeout=300)["status"] == "finished"
+
+
+# -- session affinity: the zero-recompile satellite --------------------
+
+
+def test_session_set_values_zero_full_recompiles_after_segment_1():
+    """Satellite acceptance: a client streaming ``set_values`` deltas
+    through its pinned session hits ``compile.reused`` /
+    ``compile.incremental`` ONLY after segment 1 — zero full
+    recompiles, zero XLA compiles (counter-asserted)."""
+    from pydcop_tpu.engine import batched
+
+    batched._RUNNER_CACHE.clear()
+    kw = dict(rounds=48, chunk_size=48, seed=7)
+    with session() as tel:
+        with SolverService(
+            max_batch=1, max_wait=0.0, autostart=False
+        ) as svc:
+            r1 = svc.solve(
+                sensor_dcop(), "dsa", {"variant": "B"},
+                session="client-1", **kw,
+            )
+            assert r1["segment"] == 1
+            c1 = dict(tel.summary()["counters"])
+            # segment 2: delta on the external -> incremental update
+            r2 = svc.solve(
+                None, "dsa", {"variant": "B"},
+                session="client-1", set_values={"sensor": 2}, **kw,
+            )
+            assert r2["segment"] == 2
+            assert r2["assignment"]["v0"] == 2  # the delta took
+            # segment 3: same externals -> pure reuse
+            r3 = svc.solve(
+                None, "dsa", {"variant": "B"}, session="client-1",
+                **kw,
+            )
+            assert r3["segment"] == 3
+            c3 = dict(tel.summary()["counters"])
+            assert svc.close_session("client-1")
+            assert not svc.close_session("client-1")
+    assert c1.get("compile.full", 0) == 1
+    assert c3.get("compile.full", 0) == 1  # never recompiled
+    assert c3.get("compile.incremental", 0) >= 1
+    assert c3.get("compile.reused", 0) >= 1
+    # zero NEW XLA compiles after segment 1
+    assert c3["jit.compiles"] == c1["jit.compiles"], (c1, c3)
+
+
+def test_session_rejects_unknown_externals_and_keeps_serving():
+    with SolverService(
+        max_batch=1, max_wait=0.0, autostart=False
+    ) as svc:
+        r = svc.solve(
+            sensor_dcop(), "dsa", {}, rounds=24, chunk_size=24,
+            session="s",
+        )
+        assert r["session"] == "s"
+        with pytest.raises(ServiceError, match="external"):
+            svc.solve(
+                None, "dsa", {}, rounds=24, chunk_size=24,
+                session="s", set_values={"nope": 1},
+            )
+        # the session survives the bad delta
+        assert svc.solve(
+            None, "dsa", {}, rounds=24, chunk_size=24, session="s"
+        )["segment"] == 2
+
+
+def test_session_follow_up_with_different_dcop_is_rejected():
+    """A follow-up naming an open session may resend the SAME dcop (a
+    reconnecting wire client re-ships its yaml) but a DIFFERENT one is
+    rejected at admission — silently solving the pinned problem under
+    the new problem's name would be a wrong answer."""
+    kw = dict(rounds=16, chunk_size=16)
+    with SolverService(
+        max_batch=1, max_wait=0.0, autostart=False
+    ) as svc:
+        d = sensor_dcop()
+        assert svc.solve(d, "dsa", {}, session="s", **kw)["segment"] == 1
+        # resending the SAME object is a normal follow-up
+        assert svc.solve(d, "dsa", {}, session="s", **kw)["segment"] == 2
+        with pytest.raises(ServiceError, match="pinned to a different"):
+            svc.submit(ring_dcop(6), "dsa", {}, session="s", **kw)
+        # the session survives the rejected mismatch
+        assert svc.solve(
+            None, "dsa", {}, session="s", **kw
+        )["segment"] == 3
+        # ... and the same yaml TEXT re-keys identically over the wire
+        assert svc.solve(
+            RING_YAML, "dsa", {}, session="wire", **kw
+        )["segment"] == 1
+        assert svc.solve(
+            RING_YAML, "dsa", {}, session="wire", **kw
+        )["segment"] == 2
+
+
+# -- device chaos on the serving path ----------------------------------
+
+
+def test_service_nan_inject_degrades_only_the_poisoned_request():
+    """Acceptance: a ``nan_inject`` chaos spec against the service
+    degrades only the affected request while its batchmates return
+    results bit-identical to a fault-free service."""
+    dcops = [ring_dcop(5 + i % 3, name=f"q{i}") for i in range(8)]
+    kw = dict(rounds=24, chunk_size=12)
+
+    def serve_all(**svc_kw):
+        with SolverService(
+            pad_policy="pow2:16", max_batch=8, max_wait=30.0,
+            autostart=False, **svc_kw,
+        ) as svc:
+            pendings = [
+                svc.submit(d, "mgm", {}, seed=7, **kw) for d in dcops
+            ]
+            return [p.result(timeout=300) for p in pendings]
+
+    base = serve_all()
+    nan = serve_all(chaos="nan_inject=1:2", chaos_seed=3)
+    statuses = [r["status"] for r in nan]
+    assert statuses.count("degraded") == 1
+    poisoned = statuses.index("degraded")
+    for i, (b, o) in enumerate(zip(base, nan)):
+        if i != poisoned:
+            assert b["cost"] == o["cost"]
+            assert b["assignment"] == o["assignment"]
+            assert b["cost_trace"] == o["cost_trace"]
+
+
+def test_service_device_oom_splits_and_stays_bit_identical():
+    """Acceptance: ``device_oom`` against the service completes via
+    supervised group-split with every request bit-identical to the
+    fault-free service run (no request fails, none degrade)."""
+    dcops = [ring_dcop(5 + i % 3, name=f"q{i}") for i in range(8)]
+    kw = dict(rounds=24, chunk_size=12)
+
+    def serve_all(**svc_kw):
+        with SolverService(
+            pad_policy="pow2:16", max_batch=8, max_wait=30.0,
+            autostart=False, **svc_kw,
+        ) as svc:
+            pendings = [
+                svc.submit(d, "mgm", {}, seed=7, **kw) for d in dcops
+            ]
+            return [p.result(timeout=300) for p in pendings]
+
+    base = serve_all()
+    oom = serve_all(chaos="device_oom=4", chaos_seed=3)
+    for b, o in zip(base, oom):
+        assert o["status"] == "finished"
+        assert b["cost"] == o["cost"]
+        assert b["assignment"] == o["assignment"]
+        assert b["cost_trace"] == o["cost_trace"]
+
+
+# -- the wire protocol -------------------------------------------------
+
+
+def test_wire_protocol_round_trip_and_concurrent_clients():
+    """ServiceServer/ServiceClient over a real socket: ping, yaml-text
+    solve (cost_trace trimmed for the wire), per-request errors that
+    don't kill the connection, stats, and N concurrent clients
+    coalescing into shared ticks."""
+    with SolverService(
+        pad_policy="pow2:16", max_batch=4, max_wait=0.25,
+        autostart=False,
+    ) as svc:
+        with ServiceServer(svc, port=0) as server:
+            with ServiceClient(server.address) as cli:
+                assert cli.ping()
+                r = cli.solve(RING_YAML, "dsa", rounds=24, seed=1)
+                assert r["status"] == "finished"
+                assert "cost_trace" not in r  # trimmed for the wire
+                # a bad request errors THIS call, not the connection
+                with pytest.raises(ServiceError, match="algo"):
+                    cli.solve(RING_YAML, None)
+                with pytest.raises(ValueError, match="unknown solve"):
+                    cli.solve(RING_YAML, "dsa", bogus=1)
+                assert cli.ping()  # connection still live
+
+            # 4 concurrent clients coalesce into shared ticks
+            results = [None] * 4
+
+            def one(i):
+                with ServiceClient(server.address) as c:
+                    results[i] = c.solve(
+                        RING_YAML, "dsa", rounds=24, seed=9
+                    )
+
+            threads = [
+                threading.Thread(target=one, args=(i,))
+                for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(300)
+            assert all(r is not None for r in results)
+            assert len({r["cost"] for r in results}) == 1
+
+            with ServiceClient(server.address) as cli:
+                stats = cli.stats()
+                # 5 admitted solves (the algo-less one was rejected
+                # at validation, before admission)
+                assert stats["requests"] == 5
+                # the burst actually shared ticks
+                assert stats["coalesced_requests"] >= 2
+                assert stats["latency_s"]["p99"] > 0
+
+
+def test_trace_summary_reports_service_percentiles(tmp_path, capsys):
+    """A trace written while serving folds into a serving report:
+    ``summarize`` gains a ``service`` block (queue-wait / latency /
+    batch-occupancy percentiles + coalesce ratio) and the
+    ``trace-summary`` command renders it — a trace from ``serve`` is
+    readable without custom scripts."""
+    import json
+
+    from pydcop_tpu.cli import main
+    from pydcop_tpu.telemetry.summary import load_trace, summarize
+
+    path = tmp_path / "serve.jsonl"
+    with session(str(path)):
+        with SolverService(
+            pad_policy="pow2:16", max_batch=4, max_wait=10.0,
+            autostart=False,
+        ) as svc:
+            pendings = [
+                svc.submit(
+                    ring_dcop(name=f"r{i}"), "dsa", {},
+                    rounds=16, chunk_size=16, seed=i,
+                )
+                for i in range(4)
+            ]
+            for p in pendings:
+                p.result(timeout=300)
+    s = summarize(load_trace(str(path)))
+    svc_s = s["service"]
+    assert svc_s["requests"] == 4
+    assert svc_s["dispatches"] == 1  # one tick, one coalesced group
+    assert svc_s["coalesce_ratio"] == 4.0
+    assert svc_s["batch_occupancy"]["max"] == 4.0
+    for block in ("queue_wait_s", "latency_s"):
+        v = svc_s[block]
+        assert 0 <= v["p50"] <= v["p90"] <= v["p99"] <= v["max"]
+    # request latency covers the queue wait plus the dispatch
+    assert svc_s["latency_s"]["max"] >= svc_s["queue_wait_s"]["p50"]
+    # the command renders the serving block (text and --json forms)
+    assert main(["trace-summary", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "service: 4 requests / 1 dispatches" in out
+    assert "batch_occupancy" in out
+    assert main(["trace-summary", str(path), "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["service"]["requests"] == 4
+
+
+def test_server_prunes_closed_connections():
+    """'Concurrency is connections' means a resident server sees an
+    unbounded stream of short-lived ones — handler bookkeeping must
+    drain as they close, not accumulate forever."""
+    import time
+
+    with SolverService(max_batch=1, autostart=False) as svc:
+        with ServiceServer(svc, port=0) as server:
+            for _ in range(3):
+                with ServiceClient(server.address) as cli:
+                    assert cli.ping()
+            deadline = time.time() + 10
+            while (
+                server._threads or server._conns
+            ) and time.time() < deadline:
+                time.sleep(0.05)
+            assert not server._threads and not server._conns
+
+
+def test_wire_shutdown_op_stops_the_server():
+    with SolverService(max_batch=1, autostart=False) as svc:
+        server = ServiceServer(svc, port=0)
+        with ServiceClient(server.address) as cli:
+            cli.shutdown()
+        assert server.wait(timeout=10)
+        server.close()
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -67,6 +67,20 @@ SUP_K = 8
 SUP_ROUNDS = 48
 SUP_SPLIT_BUDGET = 1
 
+# solver service (engine/service.py): WAVES identical waves of
+# SERVICE_WAVE_K concurrent requests in TWO shape buckets (4 small
+# rings -> pow2:16 bucket, 4 big rings -> the 32 bucket) through a
+# live service.  The cold tick compiles EXACTLY one vmapped runner per
+# bucket (SERVICE_BUDGET); every steady-state tick after it performs
+# ZERO XLA compiles — the serving-path acceptance criterion.  Extra
+# compiles on later waves = the runner cache churning per tick
+# (occupancy drift, group-key instability) — the compile storm that
+# turns a serving process back into one-shot CLI costs.
+SERVICE_WAVE_K = 8
+SERVICE_WAVES = 3
+SERVICE_BUDGET = 2
+SERVICE_ROUNDS = 48
+
 # level-batched DPOP through solve_many: K same-bucket SECP instances
 # merge their UTIL phases into one level-synchronous sweep, and each
 # distinct level-pack bucket (padded joined/part shapes, ops.padding.
@@ -376,6 +390,133 @@ def run_supervisor_guard() -> dict:
     return report
 
 
+def run_service_guard() -> dict:
+    """Compile budget for the serving path (``engine/service.py``):
+    ``SERVICE_WAVES`` identical waves of ``SERVICE_WAVE_K`` concurrent
+    requests in TWO shape buckets through a live
+    :class:`~pydcop_tpu.engine.service.SolverService` must (1) compile
+    exactly ``SERVICE_BUDGET`` vmapped runners on the COLD tick (one
+    per bucket), (2) perform ZERO XLA compiles on every steady-state
+    tick, (3) coalesce each wave into one tick of two groups, and (4)
+    return results bit-identical to per-request sequential
+    ``api.solve`` calls.  Regressions this catches: per-tick runner
+    churn (occupancy drift defeating the pow-2 instance bucketing),
+    group-key instability de-batching the queue, and any
+    coalescing-induced result drift."""
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.engine import batched
+    from pydcop_tpu.engine.service import SolverService
+    from pydcop_tpu.telemetry import session
+
+    # cold start: warm runners from earlier runs in this process would
+    # hide (or fake) compiles
+    batched._RUNNER_CACHE.clear()
+
+    # two shape buckets under pow2:16: ring sizes 5..8 -> the 16
+    # bucket, 17..20 -> the 32 bucket; 4 requests each per wave
+    small = [_build_ring(5 + i) for i in range(4)]
+    big = [_build_ring(17 + i) for i in range(4)]
+    wave = small + big
+    kw = dict(rounds=SERVICE_ROUNDS, chunk_size=SERVICE_ROUNDS, seed=3)
+
+    wave_compiles = []
+    wave_results = []
+    with session() as tel:
+        # max_batch == wave size + a long max_wait: each wave lands in
+        # exactly one tick, deterministically
+        with SolverService(
+            pad_policy="pow2:16", max_batch=SERVICE_WAVE_K,
+            max_wait=10.0, autostart=False,
+        ) as svc:
+            prev = 0
+            for _ in range(SERVICE_WAVES):
+                pendings = [
+                    svc.submit(d, "mgm", {}, **kw) for d in wave
+                ]
+                wave_results.append(
+                    [p.result(timeout=300) for p in pendings]
+                )
+                now = int(
+                    tel.summary()["counters"].get("jit.compiles", 0)
+                )
+                wave_compiles.append(now - prev)
+                prev = now
+        stats = svc.stats()
+
+    report = {
+        "wave_compiles": wave_compiles,
+        "budget": SERVICE_BUDGET,
+        "ticks": stats["ticks"],
+        "dispatches": stats["dispatches"],
+        "coalesced_requests": stats["coalesced_requests"],
+        "ok": True,
+        "costs": [r["cost"] for r in wave_results[0]],
+    }
+    if wave_compiles[0] != SERVICE_BUDGET:
+        report["ok"] = False
+        report["error"] = (
+            f"cold tick compiled {wave_compiles[0]} runner(s), "
+            f"expected exactly {SERVICE_BUDGET} (one per shape "
+            "bucket) — grouping or occupancy bucketing drifted"
+        )
+    elif any(c != 0 for c in wave_compiles[1:]):
+        report["ok"] = False
+        report["error"] = (
+            f"steady-state ticks compiled {wave_compiles[1:]} — "
+            "serving must re-dispatch warm executables, never "
+            "re-trace (the runner cache is churning per tick)"
+        )
+    elif (
+        stats["ticks"] != SERVICE_WAVES
+        or stats["dispatches"] != 2 * SERVICE_WAVES
+    ):
+        report["ok"] = False
+        report["error"] = (
+            f"expected {SERVICE_WAVES} ticks of 2 coalesced groups, "
+            f"got {stats['ticks']} tick(s) / "
+            f"{stats['dispatches']} dispatch(es) — admission "
+            "coalescing silently degraded"
+        )
+    else:
+        # wave results must agree across waves AND be bit-identical
+        # to sequential per-request solves (the serving analogue of
+        # run_many_guard's parity clause)
+        for w, results in enumerate(wave_results[1:], 2):
+            for i, (a, b) in enumerate(zip(wave_results[0], results)):
+                if (
+                    a["cost"] != b["cost"]
+                    or a["assignment"] != b["assignment"]
+                ):
+                    report["ok"] = False
+                    report["error"] = (
+                        f"instance {i}: wave {w} diverged from wave 1 "
+                        "— warm-cache serving changed the math"
+                    )
+                    break
+            if not report["ok"]:
+                break
+        if report["ok"]:
+            for i, d in enumerate(wave):
+                seq = solve(
+                    d, "mgm", {}, pad_policy="pow2:16", **kw
+                )
+                got = wave_results[0][i]
+                if (
+                    seq["cost"] != got["cost"]
+                    or seq["assignment"] != got["assignment"]
+                ):
+                    report["ok"] = False
+                    report["error"] = (
+                        f"instance {i}: coalesced service result "
+                        f"diverges from the sequential solve (cost "
+                        f"{got['cost']} vs {seq['cost']}) — "
+                        "continuous batching corrupted the "
+                        "per-request math"
+                    )
+                    break
+    return report
+
+
 def _build_secp(n_lights: int, n_models: int, levels: int, seed: int):
     """A fixed-STRUCTURE smart-lighting SECP: deterministic model
     scopes (consecutive 3-light windows) so every seed compiles to
@@ -510,6 +651,7 @@ def main() -> int:
     report_many = run_many_guard()
     report_dpop = run_dpop_guard()
     report_sup = run_supervisor_guard()
+    report_service = run_service_guard()
     print(
         json.dumps(
             {
@@ -517,6 +659,7 @@ def main() -> int:
                 "solve_many": report_many,
                 "dpop": report_dpop,
                 "supervisor": report_sup,
+                "service": report_service,
             }
         )
     )
@@ -526,6 +669,7 @@ def main() -> int:
         and report_many["ok"]
         and report_dpop["ok"]
         and report_sup["ok"]
+        and report_service["ok"]
         else 1
     )
 
