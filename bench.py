@@ -149,6 +149,30 @@ SVC_REPS = 3  # interleaved (sequential, burst) pairs; medians
 SVC_RATIO_BOUND = 5.0
 SVC_P99_FACTOR = 3.0
 
+# semiring_infer stage (ISSUE 8): the semiring contraction core
+# (ops/semiring.py) running log_z + marginals against the min/+
+# (map / DPOP) baseline on the SAME sweeps — cells/sec of the
+# contraction engine per ⊕.  Two workloads: (a) a 10k-variable
+# 3-coloring over a random recursive tree (the north-star coloring
+# constraint shape, restricted to a tractable width so EXACT
+# counting/marginals are even possible — the random degree-3 graph's
+# treewidth puts exact inference out of reach at 10k), measured on
+# the host sweep; (b) the tiled-zone SECP from the dpop_secp stage
+# at reduced size, with the device forced on and tol relaxed so the
+# vmapped level-pack logsumexp dispatches are what's measured
+# (tol=inf: the bench wants device throughput; the result still
+# reports its true error_bound).  Reps interleaved, medians reported
+# (this box's 2 throttled vCPUs swing ~2x between runs).
+SEM_TREE_VARS = 10_000
+SEM_COLORS = 3
+SEM_REPS = 3
+SEM_SECP_LIGHTS = 192
+SEM_SECP_MODELS = 192
+SEM_SECP_RULES = 48
+SEM_SECP_LEVELS = 5
+SEM_SECP_ZONE = 8
+SEM_DEVICE_MIN_CELLS = 256
+
 
 def _git_sha() -> str:
     try:
@@ -688,6 +712,137 @@ def _measure_dpop(phase_budget: float = 0.0) -> dict:
     return out
 
 
+def _measure_semiring(phase_budget: float = 0.0) -> dict:
+    """semiring_infer: contraction-core throughput per ⊕ (ISSUE 8).
+
+    Reports median cells/sec for log_z (+/x), marginals (+/x,
+    normalized, incl. the downward pass) and map (max/+ — the
+    idempotent twin of DPOP's min/+) on a tractable 10k-variable
+    coloring tree, with DPOP's own UTIL sweep on the same instance
+    as the min/+ baseline row; then the tiled-SECP device sweep with
+    the level-pack logsumexp dispatches forced on (tol relaxed; the
+    true error_bound is reported alongside).  Consistency is
+    asserted (map cost == dpop cost; device log_z within its bound
+    of host f64) so a throughput win can never hide a wrong answer.
+    """
+    import random as _random
+    import statistics
+
+    with _bounded_phase("import:jax", phase_budget):
+        import jax
+
+    with _bounded_phase("import:pydcop", phase_budget):
+        from argparse import Namespace
+
+        import numpy as np
+
+        from pydcop_tpu.api import infer, solve
+        from pydcop_tpu.commands.generators.secp import generate
+        from pydcop_tpu.dcop.dcop import DCOP
+        from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+        from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    _phase("problem_built")
+    rnd = _random.Random(1)
+    dom = Domain("colors", "", list(range(SEM_COLORS)))
+    tree = DCOP(f"tree_coloring_{SEM_TREE_VARS}")
+    vs = [Variable(f"v{i}", dom) for i in range(SEM_TREE_VARS)]
+    for v in vs:
+        tree.add_variable(v)
+    eq = np.eye(SEM_COLORS)
+    for i in range(1, SEM_TREE_VARS):
+        # random recursive tree: expected depth O(log n), so the
+        # height-wave sweep gets wide waves (the batching shape)
+        j = rnd.randrange(i)
+        tree.add_constraint(
+            NAryMatrixRelation([vs[j], vs[i]], eq, name=f"c{i}")
+        )
+    tree.add_agents([AgentDef("a0")])
+
+    def med_run(fn):
+        times, last = [], None
+        for _ in range(SEM_REPS):
+            t0 = time.perf_counter()
+            last = fn()
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times), last
+
+    _phase("measure:tree_10k")
+    out: dict = {
+        "platform": jax.devices()[0].platform,
+        "tree": {"n_vars": SEM_TREE_VARS, "colors": SEM_COLORS},
+    }
+    queries = {}
+    for query in ("log_z", "marginals", "map"):
+        dt, r = med_run(lambda q=query: infer(tree, q))
+        queries[query] = {
+            "seconds": round(dt, 4),
+            "cells_per_sec": round(r["cells"] / dt),
+        }
+        if query == "log_z":
+            out["tree"]["log_z"] = round(r["log_z"], 6)
+            out["tree"]["cells"] = r["cells"]
+            out["tree"]["width"] = r["width"]
+        if query == "map":
+            map_cost = r["cost"]
+    out["tree"]["queries"] = queries
+    # the min/+ baseline on the SAME instance: DPOP's own UTIL sweep
+    dt, r_dpop = med_run(
+        lambda: solve(tree, "dpop", {"util_device": "auto"})
+    )
+    out["tree"]["min_plus_dpop"] = {
+        "util_seconds": round(r_dpop["util_time"], 4),
+        "util_cells_per_sec": round(
+            r_dpop["util_cells"] / max(r_dpop["util_time"], 1e-9)
+        ),
+    }
+    out["tree"]["results_match"] = bool(
+        abs(map_cost - r_dpop["cost"]) < 1e-9
+    )
+
+    _phase("measure:secp_device")
+    spec = Namespace(
+        nb_lights=SEM_SECP_LIGHTS, nb_models=SEM_SECP_MODELS,
+        nb_rules=SEM_SECP_RULES, light_levels=SEM_SECP_LEVELS,
+        model_arity=3, zone_size=SEM_SECP_ZONE, zone_layout="tiled",
+        efficiency_weight=0.1, capacity=100.0, seed=7,
+    )
+    secp = generate(spec)
+    dev_kw = dict(
+        device="always", device_min_cells=SEM_DEVICE_MIN_CELLS,
+        tol=float("inf"), pad_policy="pow2",
+    )
+    infer(secp, "log_z", **dev_kw)  # warm: XLA compiles out of window
+    dt_dev, r_dev = med_run(lambda: infer(secp, "log_z", **dev_kw))
+    dt_host, r_host = med_run(
+        lambda: infer(secp, "log_z", device="never")
+    )
+    out["secp_tiled"] = {
+        "n_vars": SEM_SECP_LIGHTS,
+        "light_levels": SEM_SECP_LEVELS,
+        "zone_size": SEM_SECP_ZONE,
+        "cells": r_dev["cells"],
+        "log_z": round(r_dev["log_z"], 6),
+        "error_bound": r_dev["error_bound"],
+        "device": {
+            "seconds": round(dt_dev, 4),
+            "cells_per_sec": round(r_dev["cells"] / dt_dev),
+            "dispatches": r_dev["dispatches"],
+            "device_nodes": r_dev["device_nodes"],
+        },
+        "host_f64": {
+            "seconds": round(dt_host, 4),
+            "cells_per_sec": round(r_host["cells"] / dt_host),
+        },
+        "results_match": bool(
+            abs(r_dev["log_z"] - r_host["log_z"])
+            <= r_dev["error_bound"] + 1e-9
+        ),
+    }
+    _phase("measured")
+    return out
+
+
 def _measure_supervised(phase_budget: float = 0.0) -> dict:
     """Supervisor no-fault overhead on the dsa/maxsum hot loops.
 
@@ -963,6 +1118,7 @@ def _inner_main() -> None:
     p.add_argument("--dpop_stage", action="store_true")
     p.add_argument("--supervised_stage", action="store_true")
     p.add_argument("--service_stage", action="store_true")
+    p.add_argument("--semiring_stage", action="store_true")
     a = p.parse_args()
     import jax
 
@@ -977,7 +1133,9 @@ def _inner_main() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # older jax: cache flags absent — correctness unaffected
-    if a.service_stage:
+    if a.semiring_stage:
+        metrics = _measure_semiring(a.phase_budget)
+    elif a.service_stage:
         metrics = _measure_service(a.phase_budget)
     elif a.supervised_stage:
         metrics = _measure_supervised(a.phase_budget)
@@ -993,7 +1151,7 @@ def _inner_main() -> None:
 def _run_sub(
     pin_cpu: bool, timeout: float, n_vars: int, rounds: int,
     many: bool = False, dpop: bool = False, supervised: bool = False,
-    service: bool = False,
+    service: bool = False, semiring: bool = False,
 ) -> dict:
     """Run ``bench.py --inner`` in a subprocess; parse its JSON line.
 
@@ -1025,7 +1183,8 @@ def _run_sub(
             + (["--many_stage"] if many else [])
             + (["--dpop_stage"] if dpop else [])
             + (["--supervised_stage"] if supervised else [])
-            + (["--service_stage"] if service else []),
+            + (["--service_stage"] if service else [])
+            + (["--semiring_stage"] if semiring else []),
             env=env,
             cwd=REPO,
             capture_output=True,
@@ -1288,6 +1447,57 @@ def main() -> None:
             latency_p99_s=service.get("latency_s", {}).get("p99"),
         )
 
+    # semiring contraction core (ops/semiring.py): log_z + marginals
+    # cells/sec vs the min/+ (map / DPOP UTIL) baseline on a 10k
+    # coloring tree, plus the device-forced tiled-SECP logsumexp
+    # sweep — the ISSUE 8 evidence row.  Same platform policy as the
+    # stages above.
+    semiring = _run_sub(pin_cpu=False, timeout=300.0, n_vars=0,
+                        rounds=0, semiring=True)
+    if "error" in semiring:
+        semiring = _run_sub(pin_cpu=True, timeout=300.0, n_vars=0,
+                            rounds=0, semiring=True)
+    if "error" in semiring:
+        errors.append(f"semiring_infer stage: {semiring['error']}")
+        semiring = None
+    elif not (
+        semiring.get("tree", {}).get("results_match")
+        and semiring.get("secp_tiled", {}).get("results_match")
+    ):
+        errors.append(
+            "semiring_infer consistency failure: "
+            + json.dumps(
+                {
+                    "tree_results_match": semiring.get("tree", {}).get(
+                        "results_match"
+                    ),
+                    "secp_results_match": semiring.get(
+                        "secp_tiled", {}
+                    ).get("results_match"),
+                }
+            )
+        )
+    elif semiring.get("platform") == "tpu":
+        # durable evidence row (msgs_per_sec=None: the contraction
+        # engine reports cells/sec per semiring, not a message rate)
+        append_tpu_log(
+            f"semiring_infer_{SEM_TREE_VARS}",
+            None,
+            source="bench_stage_semiring_infer",
+            log_z_cells_per_sec=semiring["tree"]["queries"]["log_z"][
+                "cells_per_sec"
+            ],
+            marginals_cells_per_sec=semiring["tree"]["queries"][
+                "marginals"
+            ]["cells_per_sec"],
+            map_cells_per_sec=semiring["tree"]["queries"]["map"][
+                "cells_per_sec"
+            ],
+            secp_device_cells_per_sec=semiring["secp_tiled"][
+                "device"
+            ]["cells_per_sec"],
+        )
+
     # supervised-dispatch no-fault overhead (engine/supervisor.py):
     # dsa/maxsum hot loops under the default supervisor vs bare
     # dispatch — the <2% acceptance bound of the robustness layer.
@@ -1386,6 +1596,12 @@ def main() -> None:
                 "algos", "ok",
             )
             if k in supervised
+        }
+    if semiring is not None:
+        out["semiring_infer"] = {
+            k: semiring[k]
+            for k in ("platform", "tree", "secp_tiled")
+            if k in semiring
         }
     if dpop is not None:
         out["dpop_secp"] = {

@@ -91,6 +91,7 @@ from pydcop_tpu.ops.padding import (
     PadPolicy,
     as_pad_policy,
     pad_util_parts,
+    stack_bucket as _stack_bucket,
     util_level_key,
 )
 
@@ -1079,21 +1080,6 @@ def _certify_and_repair(name, parts, target, shape,
         amin[cell] = int(row.argmin())
 
 
-def _stack_bucket(n: int) -> int:
-    """Stack-height lattice for the vmapped level dispatches: pow-2 up
-    to 32, multiples of 32 above.  Pure pow-2 wastes up to 2x device
-    compute on ghost rows at large stacks (a K=8 solve_many group
-    stacks hundreds of leaves); the multiple-of-32 tail caps the
-    waste at one row block while keeping the number of distinct
-    leading dims — and so of kernel retraces — small and stable."""
-    if n <= 32:
-        b = 1
-        while b < n:
-            b <<= 1
-        return b
-    return -(-n // 32) * 32
-
-
 def _host_redo(met, host_nodes, finish, item):
     """Tie-heavy table (>10% of cells uncertifiable — per-cell repair
     would dominate): redo THIS node wholesale on host f64, the same
@@ -1133,11 +1119,15 @@ def _exact_u_at(parts, target, shape, amin, grids=None):
     return u
 
 
-# LRU-bounded: long-lived processes solving many DCOPs with varying
-# domain/separator shapes would otherwise retain one compiled XLA
-# executable per distinct bucket forever
-_JOIN_KERNELS: "Dict[Tuple, Any]" = {}
-_JOIN_KERNELS_MAX = 256
+# The join kernels live in the semiring-generic contraction core now
+# (``ops/semiring.py``): DPOP's join+project+argmin is the ``min/+``
+# instantiation of :func:`~pydcop_tpu.ops.semiring.contraction_kernel`
+# — bit-for-bit the same traced ops, one shared LRU-bounded cache
+# across every semiring (the alias below keeps
+# ``tools/recompile_guard.py``'s cold-start ``clear()`` working).
+from pydcop_tpu.ops import semiring as _semiring  # noqa: E402
+
+_JOIN_KERNELS = _semiring._KERNELS
 
 
 def _join_kernel(
@@ -1156,41 +1146,15 @@ def _join_kernel(
     bucket count (= compile count, guarded by
     ``tools/recompile_guard.py:run_dpop_guard``) stays small however
     ragged the real separator shapes are.
+
+    The kernel itself is the generic semiring contraction
+    instantiated at ``min/+`` (``ops/semiring.py``) — the arg+margin
+    outputs and the no-values-shipped contract are documented there.
     """
-    key = (shape, part_shapes, batched)
-    fn = _JOIN_KERNELS.get(key)
-    if fn is not None:
-        return fn
-    if len(_JOIN_KERNELS) >= _JOIN_KERNELS_MAX:
-        _JOIN_KERNELS.pop(next(iter(_JOIN_KERNELS)))
-    import jax
-    import jax.numpy as jnp
-
-    def join(*tabs):
-        j = jnp.zeros(shape, dtype=jnp.float32)
-        for t in tabs:
-            j = j + t  # aligned: broadcast over the missing axes
-        u = jnp.min(j, axis=-1)
-        amin = jnp.argmin(j, axis=-1)
-        if shape[-1] == 1:
-            margins = jnp.full(shape[:-1], jnp.inf)
-        else:
-            # second best via masking the argmin cell (exact; no sort)
-            one_hot = jnp.arange(shape[-1]) == amin[..., None]
-            second = jnp.min(jnp.where(one_hot, jnp.inf, j), axis=-1)
-            margins = second - u
-        # u itself is NOT returned: the caller re-evaluates it exactly
-        # on host at the certified argmin, so shipping the f32 table
-        # back would be dead transfer
-        return amin, margins
-
-    from pydcop_tpu.telemetry.jit import profiled_jit
-
-    fn = profiled_jit(
-        jax.vmap(join) if batched else join, label="dpop-join"
+    return _semiring.contraction_kernel(
+        _semiring.MIN_SUM, tuple(shape), tuple(part_shapes),
+        batched=batched,
     )
-    _JOIN_KERNELS[key] = fn
-    return fn
 
 
 def _cell_slice(

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 from pydcop_tpu.algorithms import AlgoParameterDef
 from pydcop_tpu.graphs import factor_graph as _graph
 from pydcop_tpu.ops import costs as _costs
+from pydcop_tpu.ops import semiring as _semiring
 from pydcop_tpu.ops.compile import CompiledProblem
 
 GRAPH_TYPE = "factor_graph"
@@ -289,7 +290,6 @@ def step(
     mdt = q.dtype  # message storage dtype (msg_dtype param)
     damping = params["damping"]
     unary_t = problem.unary.T + state["noise"]  # [d, n]
-    d = problem.d_max
 
     # The round is phased factor-first so ONE belief computation (the
     # expensive per-variable aggregation) serves both the q update and
@@ -347,17 +347,15 @@ def step(
                 r_blocks.append(jnp.concatenate([r0, r1], axis=1))
                 off += m * k
                 continue
-            s = tab  # [d, ..., d, m] — f32; bf16 q upcasts on the add
-            for p in range(k):
-                shape = (1,) * p + (d,) + (1,) * (k - 1 - p) + (m,)
-                s = s + q_pos[p].astype(tab.dtype).reshape(shape)
-            outs = []
-            for p in range(k):
-                axes = tuple(a for a in range(k) if a != p)
-                mp = jnp.min(s, axis=axes)  # [d, m]
-                rp = mp - q_pos[p].astype(tab.dtype)
-                rp = rp - jnp.min(rp, axis=0, keepdims=True)
-                outs.append(rp.astype(mdt))
+            # the factor marginalization is the generic semiring
+            # contraction instantiated at min/+ (ops/semiring.py
+            # bp_factor_messages: join, per-position ⊕-projection,
+            # subtract, shift-normalize — bit-for-bit the historical
+            # inline loop); other semirings turn the same wiring into
+            # sum-product / max-product BP
+            outs = _semiring.bp_factor_messages(
+                _semiring.MIN_SUM, tab, q_pos, mdt
+            )
             r_blocks.append(jnp.concatenate(outs, axis=1))  # [d, m·k]
             off += m * k
     r_new = (

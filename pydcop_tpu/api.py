@@ -1001,6 +1001,150 @@ def solve_many(
     return results
 
 
+def infer(
+    dcop: Union[DCOP, str],
+    query: str = "marginals",
+    *,
+    order: str = "pseudo_tree",
+    beta: float = 1.0,
+    tol: float = 1e-6,
+    device: str = "auto",
+    device_min_cells: int = 1 << 14,
+    timeout: Optional[float] = None,
+    pad_policy: str = "none",
+    max_table_size: int = 1 << 26,
+    trace: Optional[str] = None,
+    trace_format: str = "jsonl",
+    compile_cache: Optional[str] = None,
+    retry_budget: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Exact probabilistic inference over a DCOP's cost model — the
+    semiring-generic twin of :func:`solve` (``docs/semirings.md``).
+
+    The DCOP's total cost is read as an energy ``E(x)`` defining the
+    Gibbs distribution ``p(x) ∝ exp(-beta·E(x))``, and ``query``
+    picks the semiring the contraction engine
+    (``ops/semiring.py``) runs over the elimination order:
+
+    - ``"marginals"`` — per-variable distributions ``p(x_v)`` (one
+      list of probabilities per variable, in domain order) plus
+      ``log_z``;
+    - ``"log_z"`` — the log partition function
+      ``log Σ_x exp(-beta·E(x))`` (weighted model counting);
+    - ``"map"`` — the exact MAP assignment (``max/+`` — for
+      ``beta``-independent problems this equals the DPOP argmin,
+      certified exact the same way).
+
+    ``order`` picks the elimination-order heuristic:
+    ``"pseudo_tree"`` (the DFS order DPOP uses — best on the wide
+    shallow shapes the level-synchronous sweep batches well) or
+    ``"min_fill"`` (greedy min-fill — often much narrower on loopy
+    graphs, directly bounding the largest table).
+
+    Large contractions run on the device under the same machinery as
+    DPOP's UTIL sweep — level-pack bucketed vmapped dispatches
+    (``pad_policy`` quantizes the buckets), the shape-keyed compiled-
+    kernel cache, and the ambient supervisor
+    (``engine/supervisor.py``; ``retry_budget`` as in
+    :func:`solve`).  ``map`` stays EXACT on device via the f32
+    argmax certificate; ``log_z``/``marginals`` use error-bound
+    accounting — a contraction whose accumulated f32 bound would
+    exceed ``tol`` runs on host f64 instead
+    (``semiring.logsumexp_repairs``), and the result reports the
+    final ``error_bound``.  ``device``: ``"auto"`` (tables >=
+    ``device_min_cells`` cells), ``"never"`` (pure host f64),
+    ``"always"``.
+
+    Returns a result dict with ``status``/``time``/``telemetry``
+    plus the query's payload, ``cells``/``dispatches``/
+    ``device_nodes``/``host_nodes`` contraction stats, and the
+    plan's induced ``width``.
+    """
+    return infer_many(
+        [dcop], query, order=order, beta=beta, tol=tol,
+        device=device, device_min_cells=device_min_cells,
+        timeout=timeout, pad_policy=pad_policy,
+        max_table_size=max_table_size, trace=trace,
+        trace_format=trace_format, compile_cache=compile_cache,
+        retry_budget=retry_budget,
+    )[0]
+
+
+def infer_many(
+    dcops: Sequence[Union[DCOP, str]],
+    query: str = "marginals",
+    *,
+    order: str = "pseudo_tree",
+    beta: float = 1.0,
+    tol: float = 1e-6,
+    device: str = "auto",
+    device_min_cells: int = 1 << 14,
+    timeout: Optional[float] = None,
+    pad_policy: str = "pow2",
+    max_table_size: int = 1 << 26,
+    trace: Optional[str] = None,
+    trace_format: str = "jsonl",
+    compile_cache: Optional[str] = None,
+    retry_budget: Optional[int] = None,
+) -> list:
+    """Run one inference ``query`` over MANY instances with their
+    contraction sweeps MERGED — the :func:`solve_many` batching
+    contract applied to :func:`infer`: same-level-pack-bucket
+    contractions from different instances ride ONE vmapped device
+    dispatch and share one compiled kernel (``pad_policy`` defaults
+    to ``"pow2"`` here so similarly-sized instances land in the same
+    buckets), and per-instance results are identical to sequential
+    :func:`infer` calls.  ``timeout`` bounds the whole call.
+    Returns one result dict per input, in input order, each carrying
+    ``instances_batched``.
+    """
+    from pydcop_tpu.telemetry import session
+
+    dcops = list(dcops)
+    if not dcops:
+        return []
+    if compile_cache is not None:
+        from pydcop_tpu.ops.compile import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache(compile_cache)
+
+    import time as _time
+
+    from pydcop_tpu.engine.supervisor import make_supervisor, supervision
+    from pydcop_tpu.ops.semiring import run_infer_many
+
+    sup = make_supervisor(retry_budget=retry_budget)
+    # the deadline covers the WHOLE call, yaml loads included (the
+    # same contract solve_many keeps) — hand the engine only what is
+    # left once the files are parsed
+    deadline = (
+        _time.perf_counter() + timeout if timeout is not None else None
+    )
+    loaded = [
+        load_dcop_from_file(d)
+        if isinstance(d, (str, list, tuple))
+        else d
+        for d in dcops
+    ]
+    with session(trace, trace_format) as tel, supervision(sup):
+        results = run_infer_many(
+            loaded, query, order=order, beta=beta, tol=tol,
+            device=device, device_min_cells=device_min_cells,
+            pad_policy=pad_policy, max_table_size=max_table_size,
+            timeout=(
+                None
+                if deadline is None
+                else max(deadline - _time.perf_counter(), 0.01)
+            ),
+        )
+        summary = tel.summary()
+    for r in results:
+        r["telemetry"] = summary
+    return results
+
+
 def solve_compiled(
     problem,
     algo: Union[str, AlgorithmDef],

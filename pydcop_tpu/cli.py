@@ -3,9 +3,10 @@
 ``python -m pydcop_tpu <command> ...`` with one module per subcommand
 under ``pydcop_tpu/commands/`` — the same layout as the reference CLI:
 solve, run, graph, distribute, generate, batch, consolidate,
-replica_dist, orchestrator, agent; plus serve (the resident
-continuous-batching solver service, ``docs/serving.md``) and
-trace-summary (telemetry trace aggregation,
+replica_dist, orchestrator, agent; plus infer (exact
+marginals/log_z/MAP over the cost model, ``docs/semirings.md``),
+serve (the resident continuous-batching solver service,
+``docs/serving.md``) and trace-summary (telemetry trace aggregation,
 ``docs/observability.md``).
 """
 
@@ -20,6 +21,9 @@ import sys
 
 COMMANDS = [
     "solve",
+    # exact inference (marginals / log_z / map) over the cost model —
+    # the semiring contraction core (docs/semirings.md)
+    "infer",
     "run",
     "graph",
     "distribute",

@@ -1,0 +1,114 @@
+"""``pydcop_tpu infer`` — exact probabilistic inference over a DCOP's
+cost model (the semiring contraction core, ``docs/semirings.md``).
+
+One file prints one result JSON; several files are MANY instances
+whose contraction sweeps merge (``api.infer_many`` — same-bucket
+contractions share one vmapped dispatch) and print a JSON array.
+"""
+
+from __future__ import annotations
+
+from pydcop_tpu.commands._common import (
+    add_trace_arguments,
+    write_result,
+)
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "infer",
+        help="exact inference (marginals / log Z / MAP) over the "
+        "Gibbs distribution p(x) ~ exp(-beta * cost(x)) via the "
+        "semiring contraction engine (ops/semiring.py)",
+    )
+    p.add_argument(
+        "dcop_files", nargs="+",
+        help="dcop yaml file(s); several files = several instances "
+        "batched into one merged contraction sweep (api.infer_many)",
+    )
+    p.add_argument(
+        "-q", "--query",
+        choices=["marginals", "log_z", "map"], default="marginals",
+        help="marginals: per-variable distributions p(x_v) (+ log_z); "
+        "log_z: the log partition function (weighted counting); map: "
+        "the exact MAP assignment (max/+, certified like DPOP)",
+    )
+    p.add_argument(
+        "--order", choices=["pseudo_tree", "min_fill"],
+        default="pseudo_tree",
+        help="elimination-order heuristic: pseudo_tree (the DPOP DFS "
+        "order) or min_fill (greedy width heuristic — often much "
+        "narrower on loopy graphs)",
+    )
+    p.add_argument(
+        "--beta", type=float, default=1.0,
+        help="inverse temperature of p(x) ~ exp(-beta * cost(x))",
+    )
+    p.add_argument(
+        "--tol", type=float, default=1e-6,
+        help="log-domain error budget for device (f32) logsumexp "
+        "contractions: a contraction whose accumulated bound would "
+        "exceed this runs on host f64 instead (the result reports "
+        "its final error_bound); default 1e-6",
+    )
+    p.add_argument(
+        "--device", choices=["auto", "never", "always"],
+        default="auto",
+        help="device offload of large contractions (auto: tables >= "
+        "--device_min_cells cells)",
+    )
+    p.add_argument(
+        "--device_min_cells", type=int, default=1 << 14,
+        help="smallest contraction table worth a device dispatch",
+    )
+    p.add_argument(
+        "--pad_policy", default=None, metavar="POLICY",
+        help="bucket the contraction dispatches on the pow-2 "
+        "level-pack lattice ('pow2' or 'pow2:<floor>') so near-miss "
+        "shapes share compiled kernels; default: none for one file, "
+        "pow2 for several (docs/performance.md)",
+    )
+    p.add_argument(
+        "--compile_cache", default=None, metavar="DIR",
+        help="persist XLA executables to DIR (jax compilation "
+        "cache), as in `solve --compile_cache`",
+    )
+    p.add_argument(
+        "--retry_budget", type=int, default=None, metavar="N",
+        help="transient device failures retry up to N times per "
+        "dispatch (engine/supervisor.py; default 2)",
+    )
+    add_trace_arguments(p)
+    p.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    from pydcop_tpu.api import infer, infer_many
+
+    kw = dict(
+        order=args.order,
+        beta=args.beta,
+        tol=args.tol,
+        device=args.device,
+        device_min_cells=args.device_min_cells,
+        timeout=args.timeout,
+        trace=args.trace,
+        trace_format=args.trace_format,
+        compile_cache=args.compile_cache,
+        retry_budget=args.retry_budget,
+    )
+    if len(args.dcop_files) == 1:
+        result = infer(
+            args.dcop_files[0], args.query,
+            pad_policy=args.pad_policy or "none", **kw,
+        )
+        write_result(args, result)
+        return 0
+    results = infer_many(
+        list(args.dcop_files), args.query,
+        pad_policy=args.pad_policy or "pow2", **kw,
+    )
+    for r in results:
+        r.pop("telemetry", None)  # keep the printed JSON compact
+    write_result(args, results)
+    return 0

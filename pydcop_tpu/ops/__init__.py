@@ -41,6 +41,23 @@ _COSTS_EXPORTS = {
     "segment_sum_edges",
     "total_cost",
 }
+# the semiring contraction core (ops/semiring.py) is numpy-only at
+# import, but numpy itself must stay off the `import pydcop_tpu` cold
+# path — so its surface rides the same PEP 562 laziness as compile/
+# costs (jax loads even later, inside its kernel builder)
+_SEMIRING_EXPORTS = {
+    "ELIMINATION_ORDERS",
+    "QUERY_SEMIRINGS",
+    "SEMIRINGS",
+    "Semiring",
+    "bp_factor_messages",
+    "build_plan",
+    "contraction_kernel",
+    "get_semiring",
+    "min_fill_order",
+    "register_semiring",
+    "run_infer_many",
+}
 
 __all__ = [
     "BIG",
@@ -49,6 +66,7 @@ __all__ = [
     "util_level_key",
     *sorted(_COMPILE_EXPORTS),
     *sorted(_COSTS_EXPORTS),
+    *sorted(_SEMIRING_EXPORTS),
 ]
 
 
@@ -61,6 +79,10 @@ def __getattr__(name):
         import pydcop_tpu.ops.costs as _costs
 
         return getattr(_costs, name)
+    if name in _SEMIRING_EXPORTS:
+        import pydcop_tpu.ops.semiring as _semiring
+
+        return getattr(_semiring, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )

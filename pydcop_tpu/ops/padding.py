@@ -154,17 +154,24 @@ def pad_util_parts(
     aligned: Sequence[np.ndarray],
     shape: Sequence[int],
     pshape: Sequence[int],
+    guard: float = np.inf,
+    with_mask: bool = True,
 ) -> list:
     """Zero-pad aligned f32 UTIL parts up to the level-pack bucket and
-    append the own-axis ghost mask (0 on real values, +inf on padded
-    ones).
+    append the own-axis ghost mask (0 on real values, ``guard`` on
+    padded ones).
 
     Real cells compute BIT-IDENTICALLY to the unpadded join: zero
     pads only fill cells outside the real region (sliced away by the
     caller), and adding the mask's exact 0.0 to a finite f32 is
-    exact, so the certificate's error bound is unchanged.  The +inf
-    own-axis guard keeps every argmin/second-best inside the real
-    domain."""
+    exact, so the certificate's error bound is unchanged.  The guard
+    defaults to ``+inf`` — keeping every min-argmin/second-best
+    inside the real domain (DPOP) — and semiring callers
+    (``ops/semiring.py``) pass ``-inf`` for max/logsumexp ⊕, where
+    it is absorbing for ``max`` and contributes ``exp(-inf)=0`` to a
+    logsumexp.  ``with_mask=False`` skips the mask (a NO_PADDING
+    bucket whose key carries no mask slot) and the call degenerates
+    to the per-part f32 casts."""
     out = []
     for a in aligned:
         target = tuple(
@@ -179,12 +186,32 @@ def pad_util_parts(
             b = np.zeros(target, dtype=np.float32)
             b[tuple(slice(0, s) for s in a.shape)] = a
             out.append(b)
-    mask = np.zeros(
-        (1,) * (len(pshape) - 1) + (pshape[-1],), dtype=np.float32
-    )
-    mask[..., shape[-1]:] = np.inf
-    out.append(mask)
+    if with_mask:
+        mask = np.zeros(
+            (1,) * (len(pshape) - 1) + (pshape[-1],), dtype=np.float32
+        )
+        mask[..., shape[-1]:] = guard
+        out.append(mask)
     return out
+
+
+def stack_bucket(n: int) -> int:
+    """Stack-height lattice for vmapped level dispatches: pow-2 up to
+    32, multiples of 32 above.  Pure pow-2 wastes up to 2x device
+    compute on ghost rows at large stacks (a K=8 ``solve_many`` group
+    stacks hundreds of leaves); the multiple-of-32 tail caps the
+    waste at one row block while keeping the number of distinct
+    leading dims — and so of kernel retraces — small and stable.
+    Shared by the DPOP UTIL sweep (``algorithms/dpop.py``) and the
+    semiring contraction sweep (``ops/semiring.py``): the lattice is
+    load-bearing for retrace counts in BOTH, so it has one
+    definition."""
+    if n <= 32:
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+    return -(-n // 32) * 32
 
 
 # -- ghost construction (the ONE definition of the padding contract) ---

@@ -1,0 +1,1324 @@
+"""Semiring-generic contraction core — one device engine for
+optimization, marginals, and counting (``docs/semirings.md``).
+
+DPOP's join+project+argmin, Max-Sum's factor marginalization, and
+SyncBB's bound evaluation are all instances of ONE functional
+aggregate query: a semiring contraction over an elimination order
+(FAQ, arXiv:1504.04044; "Juggling Functions Inside a Database",
+arXiv:1703.03147).  This module factors that query out of the
+per-algorithm kernels:
+
+- a :class:`Semiring` registry — ``min/+`` (exact optimization:
+  today's DPOP UTIL join), ``max/+`` (MAP, i.e. ``max/×`` in
+  log-space), ``+/×`` via stable logsumexp (weighted counting — the
+  partition function ``log Z``), and ``+/×`` with per-message
+  normalization (marginal inference).  Everything operates in the
+  LOG DOMAIN, where ``⊗`` is ``+`` — so every kernel is the same
+  broadcast-add join with only the ``⊕`` projection swapped;
+- :func:`contraction_kernel` — the jitted device kernel for one
+  ``(joined shape, aligned part shapes)`` bucket, cached per
+  SEMIRING so swapping ``⊕`` on the same shape bucket compiles at
+  most one new executable (the level-pack keys themselves are
+  shape-only and shared — ``tools/recompile_guard.py:
+  run_semiring_guard`` pins this);
+- pluggable elimination orders (:func:`build_plan`):
+  ``"pseudo_tree"`` — the DFS order today's DPOP uses — and
+  ``"min_fill"`` — the classic greedy width heuristic, often much
+  narrower on loopy graphs;
+- :func:`run_infer_many` — the merged multi-instance contraction
+  sweep behind ``api.infer``/``api.infer_many``: waves by node
+  height, device-eligible contractions bucketed across instances by
+  level-pack key (``ops/padding.py:util_level_key``) and dispatched
+  as ONE vmapped kernel per bucket, exactly the machinery the
+  level-synchronous DPOP sweep built (``docs/performance.md``), with
+  every device dispatch routed through the ambient supervisor
+  (``engine/supervisor.py``).
+
+Precision contract, per ``⊕``:
+
+- **Idempotent ⊕ (min, max)** — the f32 exactness CERTIFICATE
+  generalizes: the device returns only the arg-reduce plus each
+  cell's decision margin; a margin ≥ 2·(#parts+1)·eps32·Σmax|part|
+  proves the f32 arg equals the true arg, near-ties are repaired on
+  host, and the projected values are re-evaluated on host in exact
+  f64 at the certified arg — results are EXACT at any depth (the
+  DPOP scheme, ``algorithms/dpop.py``).
+- **logsumexp ⊕** — there is no arg to certify: the VALUE is the
+  answer, so the engine does error-BOUND ACCOUNTING instead.  Each
+  contraction carries an accumulated log-domain error bound
+  (children's bounds + the local f32 join/reduction rounding); a
+  contraction whose bound would exceed ``tol`` runs on host f64
+  (counted as ``semiring.logsumexp_repairs``), and the result
+  reports the final bound as ``error_bound``.  With the default
+  ``tol=1e-6`` small problems run entirely in host f64; loosening
+  ``tol`` buys device throughput at a known, reported cost.
+
+This module is numpy-only at import (jax loads inside the kernel
+builder, like ``algorithms/dpop.py``) so the API/CLI surfaces stay
+jax-free (``tests/test_import_time.py``); ``pydcop_tpu.ops``
+re-exports it lazily (PEP 562).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pydcop_tpu.ops.padding import (
+    NO_PADDING,
+    PadPolicy,
+    as_pad_policy,
+    pad_util_parts,
+    stack_bucket,
+    util_level_key,
+)
+
+_EPS32 = float(np.finfo(np.float32).eps)
+_EPS64 = float(np.finfo(np.float64).eps)
+
+
+# -- the semiring registry ---------------------------------------------
+
+
+def _np_logsumexp(a: np.ndarray, axis=None, keepdims: bool = False):
+    """Stable host-f64 logsumexp: max-shifted, and an all-``-inf``
+    slice reduces to ``-inf`` (no ``nan`` from ``-inf - -inf``)."""
+    a = np.asarray(a, dtype=np.float64)
+    m = np.max(a, axis=axis, keepdims=True)
+    m = np.where(np.isfinite(m), m, 0.0)
+    with np.errstate(divide="ignore"):  # log(0) = -inf is the
+        # correct, expected reduce of an all--inf slice
+        out = np.log(
+            np.sum(np.exp(a - m), axis=axis, keepdims=True)
+        ) + m
+    if not keepdims:
+        out = np.squeeze(
+            out, axis=tuple(range(a.ndim)) if axis is None else axis
+        )
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Semiring:
+    """One ``(⊕, ⊗)`` pair in LOG-DOMAIN representation (``⊗ = +``).
+
+    ``idempotent`` ⊕ (min/max) supports an arg-reduce and the f32
+    exactness certificate; non-idempotent ⊕ (logsumexp) uses
+    error-bound accounting instead.  ``normalize`` marks the
+    marginal-inference variant whose messages are shift-normalized
+    (the shifts are tracked, so absolute aggregates like ``log Z``
+    are still recovered exactly).
+    """
+
+    name: str
+    idempotent: bool
+    maximize: bool = False  # direction of an idempotent ⊕
+    normalize: bool = False
+    doc: str = ""
+
+    # -- algebra (log domain) ------------------------------------------
+
+    @property
+    def plus_identity(self) -> float:
+        """Identity of ``⊕`` — also the annihilator of ``⊗``."""
+        if self.idempotent and not self.maximize:
+            return float(np.inf)
+        return float(-np.inf)
+
+    @property
+    def times_identity(self) -> float:
+        """Identity of ``⊗`` (log-domain ``+``)."""
+        return 0.0
+
+    def add(self, a, b):
+        """Elementwise ``⊕`` (host f64) — the axiom-test primitive."""
+        if self.idempotent:
+            return (np.maximum if self.maximize else np.minimum)(a, b)
+        return _np_logsumexp(np.stack([a, b]), axis=0)
+
+    def combine(self, a, b):
+        """Elementwise ``⊗`` (host f64): ``+`` in the log domain."""
+        return np.asarray(a, dtype=np.float64) + np.asarray(
+            b, dtype=np.float64
+        )
+
+    def reduce(self, a, axis=None, keepdims: bool = False):
+        """``⊕``-projection over ``axis`` (host f64)."""
+        if self.idempotent:
+            fn = np.max if self.maximize else np.min
+            return fn(a, axis=axis, keepdims=keepdims)
+        return _np_logsumexp(a, axis=axis, keepdims=keepdims)
+
+    def arg_reduce(self, a, axis: int = -1):
+        """Argmin/argmax over ``axis`` — idempotent ⊕ only."""
+        if not self.idempotent:
+            raise ValueError(
+                f"semiring {self.name!r}: ⊕ is not idempotent — there "
+                "is no arg to reduce to"
+            )
+        return (np.argmax if self.maximize else np.argmin)(a, axis=axis)
+
+    def shift_of(self, a: np.ndarray) -> float:
+        """Message-normalization offset: the value subtracted from an
+        outgoing message (min for ``min/+`` — DPOP's normalization —
+        max otherwise, which is also the logsumexp stability shift)."""
+        if a.size == 0:
+            return 0.0
+        if self.idempotent and not self.maximize:
+            return float(a.min())
+        return float(a.max())
+
+    # -- traced (jnp) variants for use inside compiled steps -----------
+
+    def jnp_reduce(self, a, axis, keepdims: bool = False):
+        """``⊕``-projection inside a jax trace (``bp_factor_messages``
+        and the contraction kernels)."""
+        import jax.numpy as jnp
+
+        if self.idempotent:
+            fn = jnp.max if self.maximize else jnp.min
+            return fn(a, axis=axis, keepdims=keepdims)
+        m = jnp.max(a, axis=axis, keepdims=True)
+        m = jnp.where(jnp.isfinite(m), m, 0.0)
+        out = (
+            jnp.log(jnp.sum(jnp.exp(a - m), axis=axis, keepdims=True))
+            + m
+        )
+        return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+SEMIRINGS: Dict[str, Semiring] = {}
+
+
+def register_semiring(sr: Semiring) -> Semiring:
+    """Add a semiring to the registry (``get_semiring`` name lookup)."""
+    SEMIRINGS[sr.name] = sr
+    return sr
+
+
+def get_semiring(name: str) -> Semiring:
+    if isinstance(name, Semiring):
+        return name
+    try:
+        return SEMIRINGS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown semiring {name!r} (registered: "
+            f"{sorted(SEMIRINGS)})"
+        )
+
+
+MIN_SUM = register_semiring(
+    Semiring(
+        "min_sum", idempotent=True, maximize=False,
+        doc="exact optimization over costs — DPOP's UTIL join",
+    )
+)
+MAX_SUM = register_semiring(
+    Semiring(
+        "max_sum", idempotent=True, maximize=True,
+        doc="MAP over log-weights (max/x in log space)",
+    )
+)
+LOG_SUM_EXP = register_semiring(
+    Semiring(
+        "log_sum_exp", idempotent=False,
+        doc="weighted counting: partition function log Z (+/x via "
+        "stable logsumexp)",
+    )
+)
+MARGINALS = register_semiring(
+    Semiring(
+        "marginals", idempotent=False, normalize=True,
+        doc="+/x with message normalization — marginal inference",
+    )
+)
+
+# query name (api.infer) -> the semiring its sweep runs on
+QUERY_SEMIRINGS = {
+    "map": "max_sum",
+    "log_z": "log_sum_exp",
+    "marginals": "marginals",
+}
+
+
+# -- device kernels -----------------------------------------------------
+#
+# One jitted join+projection per (semiring, joined shape, aligned part
+# shapes) bucket.  The level-pack KEY is shape-only and shared across
+# semirings (ops/padding.py:util_level_key), so swapping the semiring
+# on the same problem bucket reuses the bucketing and compiles at most
+# one new executable per semiring — zero on repeat
+# (tools/recompile_guard.py:run_semiring_guard).  LRU-bounded for the
+# same reason the DPOP join-kernel cache was: long-lived processes
+# must not retain one executable per distinct shape forever.
+
+_KERNELS: Dict[Tuple, Any] = {}
+_KERNELS_MAX = 256
+
+
+def contraction_kernel(
+    sr: Semiring,
+    shape: Tuple[int, ...],
+    part_shapes: Tuple[Tuple[int, ...], ...],
+    batched: bool = False,
+):
+    """Jit-compiled semiring contraction for one bucket: broadcast-add
+    join of the aligned parts, then the ``⊕``-projection over the own
+    (last) axis.  ``batched=True`` vmaps it over a leading stack axis.
+
+    Idempotent ⊕ returns ``(arg, margins)`` — the exactness-
+    certificate outputs; the projected values are NOT shipped back
+    (the caller re-evaluates them exactly on host at the certified
+    arg, so the transfer would be dead).  For ``min_sum`` this is
+    bit-for-bit the historical DPOP join kernel
+    (``algorithms/dpop.py:_join_kernel`` now delegates here).
+    Non-idempotent ⊕ returns ``(values,)`` — a max-shifted f32
+    logsumexp whose rounding is covered by the caller's error-bound
+    accounting.
+    """
+    sr = get_semiring(sr)
+    key = (sr.name, tuple(shape), tuple(part_shapes), batched)
+    fn = _KERNELS.get(key)
+    if fn is not None:
+        return fn
+    if len(_KERNELS) >= _KERNELS_MAX:
+        _KERNELS.pop(next(iter(_KERNELS)))
+    import jax
+    import jax.numpy as jnp
+
+    if sr.idempotent:
+        if sr.maximize:
+
+            def contract(*tabs):
+                j = jnp.zeros(shape, dtype=jnp.float32)
+                for t in tabs:
+                    j = j + t  # aligned: broadcast over missing axes
+                u = jnp.max(j, axis=-1)
+                arg = jnp.argmax(j, axis=-1)
+                if shape[-1] == 1:
+                    margins = jnp.full(shape[:-1], jnp.inf)
+                else:
+                    one_hot = (
+                        jnp.arange(shape[-1]) == arg[..., None]
+                    )
+                    second = jnp.max(
+                        jnp.where(one_hot, -jnp.inf, j), axis=-1
+                    )
+                    margins = u - second
+                return arg, margins
+
+        else:
+
+            def contract(*tabs):
+                j = jnp.zeros(shape, dtype=jnp.float32)
+                for t in tabs:
+                    j = j + t  # aligned: broadcast over missing axes
+                u = jnp.min(j, axis=-1)
+                amin = jnp.argmin(j, axis=-1)
+                if shape[-1] == 1:
+                    margins = jnp.full(shape[:-1], jnp.inf)
+                else:
+                    # second best via masking the arg cell (exact; no
+                    # sort)
+                    one_hot = (
+                        jnp.arange(shape[-1]) == amin[..., None]
+                    )
+                    second = jnp.min(
+                        jnp.where(one_hot, jnp.inf, j), axis=-1
+                    )
+                    margins = second - u
+                # values are NOT returned: the caller re-evaluates
+                # them exactly on host at the certified arg
+                return amin, margins
+
+    else:
+
+        def contract(*tabs):
+            j = jnp.zeros(shape, dtype=jnp.float32)
+            for t in tabs:
+                j = j + t
+            m = jnp.max(j, axis=-1)
+            safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+            s = jnp.sum(jnp.exp(j - safe_m[..., None]), axis=-1)
+            # an all--inf row (impossible configuration, or a padded
+            # ghost guard row) stays -inf instead of going nan
+            vals = jnp.where(
+                jnp.isfinite(m), safe_m + jnp.log(s), m
+            )
+            return (vals,)
+
+    from pydcop_tpu.telemetry.jit import profiled_jit
+
+    fn = profiled_jit(
+        jax.vmap(contract) if batched else contract,
+        label=f"semiring-{sr.name}",
+    )
+    _KERNELS[key] = fn
+    return fn
+
+
+def bp_factor_messages(
+    sr: Semiring,
+    tab,
+    q_pos: Sequence,
+    mdt,
+) -> list:
+    """Factor→variable belief-propagation messages for one arity
+    bucket, as a semiring contraction inside a jax trace.
+
+    The standard sum-then-subtract marginalization:
+    ``S = table ⊗ ⊗_p q_p`` (broadcast-add over the bucket's axes),
+    ``M_p = ⊕`` over all axes but ``p``, ``r_p = M_p − q_p``,
+    shift-normalized per edge.  With ``sr=min_sum`` this is bit-for-
+    bit Max-Sum's factor phase (``algorithms/maxsum.py`` step 2 now
+    delegates here); other semirings turn the same wiring into
+    sum-product (marginal BP) or max-product message passing.
+
+    ``tab`` is the bucket's ``[d, ..., d, m]`` table stack (f32),
+    ``q_pos`` the ``k`` per-position ``[d, m]`` incoming messages
+    (message dtype ``mdt`` — bf16 upcasts on the add), and the
+    returned list holds the ``k`` outgoing ``[d, m]`` messages in
+    ``mdt``.
+    """
+    import jax.numpy as jnp
+
+    sr = get_semiring(sr)
+    k = len(q_pos)
+    d = q_pos[0].shape[0]
+    m = q_pos[0].shape[1]
+    s = tab  # [d, ..., d, m] — f32; mdt q upcasts on the add
+    for p in range(k):
+        shape = (1,) * p + (d,) + (1,) * (k - 1 - p) + (m,)
+        s = s + q_pos[p].astype(tab.dtype).reshape(shape)
+    outs = []
+    for p in range(k):
+        axes = tuple(a for a in range(k) if a != p)
+        mp = sr.jnp_reduce(s, axes)  # [d, m]
+        rp = mp - q_pos[p].astype(tab.dtype)
+        # shift-normalize per edge (bounded over cycles): min for
+        # min/+ — the historical Max-Sum normalization — max for the
+        # maximizing/summing semirings
+        if sr.idempotent and not sr.maximize:
+            rp = rp - jnp.min(rp, axis=0, keepdims=True)
+        else:
+            rp = rp - jnp.max(rp, axis=0, keepdims=True)
+        outs.append(rp.astype(mdt))
+    return outs
+
+
+# -- elimination orders and contraction plans ---------------------------
+
+
+ELIMINATION_ORDERS = ("pseudo_tree", "min_fill")
+
+
+def min_fill_order(
+    domains: Dict[str, Sequence],
+    scopes: Sequence[Sequence[str]],
+    deadline: Optional[float] = None,
+) -> List[str]:
+    """Greedy min-fill elimination order over the primal graph: at
+    each step eliminate the variable whose removal adds the fewest
+    fill edges among its remaining neighbors (ties: smallest
+    neighborhood, then name — deterministic).  The classic width
+    heuristic; on loopy graphs it is often far narrower than the DFS
+    pseudo-tree order.
+
+    Fill counts are cached and invalidated INCREMENTALLY — a count
+    changes only for the eliminated variable's neighbors and for the
+    common neighbors of each added fill edge — so the selection loop
+    is O(n) per step instead of recomputing every count
+    (recompute-everything measured ~20s at just 800 vars; this stays
+    sub-second at that size).  Dense graphs can still be slow —
+    ``deadline`` (a ``perf_counter`` timestamp) raises
+    ``TimeoutError`` between steps so an ``infer(timeout=...)``
+    cannot hang inside plan construction."""
+    adj: Dict[str, set] = {v: set() for v in domains}
+    for scope in scopes:
+        sc = [v for v in scope if v in adj]
+        for a in sc:
+            for b in sc:
+                if a != b:
+                    adj[a].add(b)
+    remaining = {v: set(ns) for v, ns in adj.items()}
+    order: List[str] = []
+    cache: Dict[str, int] = {}
+
+    def fill_count(v: str) -> int:
+        ns = list(remaining[v])
+        cnt = 0
+        for i in range(len(ns)):
+            ri = remaining[ns[i]]
+            for j in range(i + 1, len(ns)):
+                if ns[j] not in ri:
+                    cnt += 1
+        return cnt
+
+    while remaining:
+        if deadline is not None and time.perf_counter() > deadline:
+            raise TimeoutError(
+                f"min_fill elimination order timed out with "
+                f"{len(remaining)} of {len(adj)} variables left"
+            )
+        best_key = None
+        best = None
+        for x in remaining:
+            c = cache.get(x)
+            if c is None:
+                c = cache[x] = fill_count(x)
+            key = (c, len(remaining[x]), x)
+            if best_key is None or key < best_key:
+                best_key, best = key, x
+        v = best
+        order.append(v)
+        ns = list(remaining[v])
+        # invalidation set: v's neighbors (their neighborhoods change)
+        # plus, per added fill edge (a, b), every common neighbor of
+        # a and b (the pair stops counting as missing for them)
+        dirty = set(ns)
+        for i in range(len(ns)):
+            for j in range(i + 1, len(ns)):
+                a, b = ns[i], ns[j]
+                if b not in remaining[a]:
+                    remaining[a].add(b)
+                    remaining[b].add(a)
+                    dirty |= remaining[a] & remaining[b]
+        for n in ns:
+            remaining[n].discard(v)
+        del remaining[v]
+        cache.pop(v, None)
+        for x in dirty:
+            cache.pop(x, None)
+    return order
+
+
+class ContractionPlan:
+    """One instance's bucket tree: the elimination order, per-variable
+    buckets of owned ENERGY tables (f64, minimization convention —
+    semiring transforms apply at sweep time so one plan serves every
+    query), and the parent/children structure a dims-only simulation
+    of the elimination derives.  ``const_energy`` accumulates
+    fully-external (scope-free after slicing) parts — invisible to
+    arg queries, a constant factor of ``Z``."""
+
+    __slots__ = (
+        "domains", "order", "pos", "buckets", "parent", "children",
+        "roots", "height", "const_energy", "order_name",
+    )
+
+    def __init__(self, domains, order, buckets, const_energy, order_name):
+        self.domains = domains
+        self.order = order
+        self.pos = {v: i for i, v in enumerate(order)}
+        self.buckets = buckets
+        self.const_energy = const_energy
+        self.order_name = order_name
+        # dims-only elimination simulation: the message scope of v is
+        # the union of its bucket dims and its children's message
+        # dims, minus v; its parent is the earliest-ELIMINATED
+        # variable of that scope (the bucket the message lands in)
+        self.parent: Dict[str, Optional[str]] = {}
+        self.children: Dict[str, List[str]] = {v: [] for v in order}
+        self.roots: List[str] = []
+        msg_dims: Dict[str, set] = {}
+        for v in order:
+            dims: set = set()
+            for scope, _ in buckets[v]:
+                dims.update(scope)
+            for c in self.children[v]:
+                dims.update(msg_dims[c])
+            dims.discard(v)
+            msg_dims[v] = dims
+            if dims:
+                p = min(dims, key=self.pos.__getitem__)
+                self.parent[v] = p
+                self.children[p].append(v)
+            else:
+                self.parent[v] = None
+                self.roots.append(v)
+        # wave index = node HEIGHT (children resolve strictly earlier
+        # waves; every leaf lands in wave 0 — the ragged-tree batching
+        # property the level-sync DPOP sweep established)
+        self.height: Dict[str, int] = {}
+        for v in order:  # children precede parents in elim order
+            self.height[v] = 1 + max(
+                (self.height[c] for c in self.children[v]), default=-1
+            )
+
+    def sep_of(self, name: str, child_seps: Dict[str, List[str]]):
+        """Separator of ``name``: dims of its own parts plus its
+        children's separators, minus itself — sorted root-most first
+        (descending elimination position), the axis convention every
+        stored message uses."""
+        dims: set = set()
+        for scope, _ in self.buckets[name]:
+            dims.update(scope)
+        for c in self.children[name]:
+            dims.update(child_seps[c])
+        dims.discard(name)
+        return sorted(dims, key=lambda v: -self.pos[v])
+
+    def width(self) -> int:
+        """Induced width: the largest separator the sweep will build
+        (dims-only; cheap enough to report up front)."""
+        seps: Dict[str, List[str]] = {}
+        w = 0
+        for v in self.order:
+            seps[v] = self.sep_of(v, seps)
+            w = max(w, len(seps[v]))
+        return w
+
+
+def build_plan(
+    dcop,
+    order: str = "pseudo_tree",
+    deadline: Optional[float] = None,
+) -> ContractionPlan:
+    """Build the contraction plan for one DCOP under an elimination
+    order heuristic.  ``deadline`` (a ``perf_counter`` timestamp)
+    bounds the ``min_fill`` search — it raises ``TimeoutError``, which
+    :func:`run_infer_many` turns into ``status="timeout"`` results.
+
+    Tables are extracted ONCE as f64 energies (sign-folded for
+    ``objective: max`` problems, external variables sliced out,
+    variable value-costs folded in as unary parts — the same
+    preparation DPOP's ``_prepare_instance`` performs); each part is
+    owned by its earliest-eliminated scope variable, which under the
+    ``pseudo_tree`` order reproduces DPOP's deepest-variable
+    ownership exactly.
+    """
+    if order not in ELIMINATION_ORDERS:
+        raise ValueError(
+            f"unknown elimination order {order!r} (expected one of "
+            f"{ELIMINATION_ORDERS})"
+        )
+    sign = -1.0 if dcop.objective == "max" else 1.0
+    ext_values = {
+        n: ev.value for n, ev in dcop.external_variables.items()
+    }
+    domains: Dict[str, list] = {
+        v.name: list(v.domain.values) for v in dcop.variables.values()
+    }
+
+    parts: List[Tuple[List[str], np.ndarray]] = []
+    const_energy = 0.0
+    for v in dcop.variables.values():
+        if v.has_cost:
+            costs = np.array(
+                [sign * v.cost_for_val(x) for x in v.domain.values],
+                dtype=np.float64,
+            )
+            parts.append(([v.name], costs))
+    for c in dcop.constraints.values():
+        scope_ext = [n for n in c.scope_names if n in ext_values]
+        if scope_ext:
+            c = c.slice({n: ext_values[n] for n in scope_ext})
+        scope = list(c.scope_names)
+        m = c.as_matrix()
+        table = sign * np.asarray(m.matrix, dtype=np.float64)
+        if not scope:
+            const_energy += float(table)
+            continue
+        parts.append((scope, table))
+
+    if order == "min_fill":
+        elim = min_fill_order(
+            domains, [s for s, _ in parts], deadline=deadline
+        )
+    else:
+        from pydcop_tpu.graphs import pseudotree as _pt
+
+        graph = _pt.build_computation_graph(dcop)
+        names = [
+            n
+            for root in graph.roots
+            for n in graph.depth_first_order(root)
+        ]
+        # reverse DFS pre-order: children strictly before parents —
+        # the elimination order whose bucket tree IS the pseudo-tree
+        elim = list(reversed(names))
+
+    pos = {v: i for i, v in enumerate(elim)}
+    buckets: Dict[str, List[Tuple[List[str], np.ndarray]]] = {
+        v: [] for v in elim
+    }
+    for scope, table in parts:
+        owner = min(scope, key=pos.__getitem__)
+        buckets[owner].append((scope, table))
+    return ContractionPlan(domains, elim, buckets, const_energy, order)
+
+
+# -- the merged contraction sweep ---------------------------------------
+
+
+def _align(table, dims, target):
+    """Jax-free broadcast alignment (the DPOP join primitive —
+    ``algorithms/_tables.align_table``, imported lazily to keep ops/
+    free of an algorithms/ import at module load)."""
+    from pydcop_tpu.algorithms._tables import align_table
+
+    return align_table(table, dims, target)
+
+
+class _Sweep:
+    """Per-call state of one merged upward sweep (K instances)."""
+
+    __slots__ = (
+        "msgs", "args", "root_total", "total_shift", "cells",
+        "device_nodes", "host_nodes", "dispatches", "err", "seps",
+    )
+
+    def __init__(self, K: int):
+        # msgs[k][name] = (sep, message f64, max|message|)
+        self.msgs: List[Dict[str, tuple]] = [{} for _ in range(K)]
+        self.args: List[Dict[str, tuple]] = [{} for _ in range(K)]
+        self.seps: List[Dict[str, List[str]]] = [{} for _ in range(K)]
+        self.root_total = [0.0] * K
+        self.total_shift = [0.0] * K
+        self.cells = [0] * K
+        self.device_nodes = [0] * K
+        self.host_nodes = [0] * K
+        self.dispatches = [0] * K
+        self.err = [
+            {} for _ in range(K)
+        ]  # name -> accumulated log-domain error bound
+
+
+def contract_sweep(
+    plans: Sequence[ContractionPlan],
+    sr: Semiring,
+    *,
+    beta: float = 1.0,
+    device_min_cells: Optional[int] = 1 << 14,
+    pad: PadPolicy = NO_PADDING,
+    level_sync: bool = True,
+    tol: float = 1e-6,
+    max_table_size: int = 1 << 26,
+    want_args: bool = False,
+    t0: Optional[float] = None,
+    timeout: Optional[float] = None,
+) -> Optional[_Sweep]:
+    """Merged bottom-up contraction sweep over K instances.
+
+    Wave ``w`` holds every instance's height-``w`` nodes;
+    device-eligible contractions bucket by level-pack key ACROSS
+    instances (``ops/padding.py:util_level_key``) and run as ONE
+    vmapped :func:`contraction_kernel` dispatch per bucket under the
+    ambient supervisor — the level-synchronous DPOP machinery with
+    the ``⊕`` swapped.  Tables enter the sweep in KERNEL domain:
+    energies for ``min_sum``, log-weights ``-beta·E`` otherwise.
+
+    Per ``⊕``: idempotent contractions are certified + host-repaired
+    (exact, ``want_args`` retains the arg tables for a MAP value
+    phase); logsumexp contractions carry accumulated error bounds
+    and fall back to host f64 when a device pass would push the
+    bound past ``tol`` (``semiring.logsumexp_repairs``).  Returns
+    the sweep state, or None on timeout.  Counters:
+    ``semiring.contractions`` per node, ``semiring.dispatches`` per
+    device dispatch.
+    """
+    from pydcop_tpu.engine.supervisor import (
+        DeviceOOMError,
+        get_supervisor,
+    )
+    from pydcop_tpu.telemetry import get_metrics, get_tracer
+
+    met = get_metrics()
+    tracer = get_tracer()
+    sup = get_supervisor()
+    t0 = time.perf_counter() if t0 is None else t0
+    K = len(plans)
+    sw = _Sweep(K)
+    _key_memo: Dict[tuple, tuple] = {}
+
+    def table_in(tbl: np.ndarray) -> np.ndarray:
+        if sr.idempotent and not sr.maximize:
+            return tbl  # min/+: raw energies (beta rescales argmins
+            # by nothing and the magnitudes stay familiar)
+        return (-beta) * tbl
+
+    def finish(k, name, plan, sep, u, arg):
+        if met.enabled:
+            met.inc("semiring.contractions")
+        if want_args:
+            sw.args[k][name] = (sep, arg)
+        if plan.parent[name] is None:
+            # root: the reduce is a scalar — fold it into the
+            # instance aggregate (plus every shift already applied)
+            sw.root_total[k] += float(u)
+        else:
+            shift = sr.shift_of(u)
+            if not np.isfinite(shift):
+                shift = 0.0  # an all--inf message normalizes to itself
+            u = u - shift
+            sw.total_shift[k] += shift
+            sw.msgs[k][name] = (
+                sep, u, float(np.max(np.abs(u), initial=0.0))
+            )
+            sw.cells[k] += u.size
+
+    def host_contract(k, name, plan, sep, target, shape, parts, err_in):
+        j = np.zeros(shape, dtype=np.float64)
+        for dims, table in parts:
+            j = j + _align(table, dims, target)
+        arg = sr.arg_reduce(j, axis=-1) if want_args else None
+        u = sr.reduce(j, axis=-1)
+        sw.host_nodes[k] += 1
+        if not sr.idempotent:
+            # f64 rounding of the same computation: negligible, but
+            # accounted so the reported bound is never an understatement
+            scale = max(
+                sum(
+                    float(np.max(np.abs(t), initial=0.0))
+                    for _, t in parts
+                ),
+                1.0,
+            )
+            sw.err[k][name] = err_in + _EPS64 * (
+                (len(parts) + 1) * scale + shape[-1] + 2
+            )
+        finish(k, name, plan, sep, u, arg)
+
+    waves: List[List[Tuple[int, str]]] = []
+    for k, plan in enumerate(plans):
+        for n in plan.order:
+            w = plan.height[n]
+            while len(waves) <= w:
+                waves.append([])
+            waves[w].append((k, n))
+
+    t_sweep = time.perf_counter()
+    for wave in waves:
+        buckets: Dict[tuple, list] = {}
+        order: List[tuple] = []
+        for k, name in wave:
+            if timeout is not None and time.perf_counter() - t0 > timeout:
+                return None
+            plan = plans[k]
+            domains = plan.domains
+            sep = plan.sep_of(name, sw.seps[k])
+            sw.seps[k][name] = sep
+            target = sep + [name]
+            shape = [len(domains[d]) for d in target]
+            size = 1
+            for s in shape:
+                size *= s
+            if size > max_table_size:
+                raise ValueError(
+                    f"contraction table for {name!r} needs {size} "
+                    f"cells (separator {sep}); exceeds "
+                    f"max_table_size={max_table_size}.  The induced "
+                    f"width under order={plan.order_name!r} is too "
+                    "large — try order='min_fill', or an approximate "
+                    "(message-passing) algorithm."
+                )
+            # own parts PRE-SUMMED into one exact f64 part (the DPOP
+            # trick: bitwise the same join, collapses leaf kernel
+            # signatures, tightens the f32 bound), then children
+            own_parts = plan.buckets[name]
+            parts: List[Tuple[List[str], np.ndarray]] = []
+            parts_max = 0.0
+            err_in = 0.0
+            if own_parts:
+                odims: List[str] = []
+                for dims, _ in own_parts:
+                    odims.extend(d for d in dims if d not in odims)
+                if len(own_parts) > 1:
+                    o = np.zeros(
+                        [len(domains[d]) for d in odims],
+                        dtype=np.float64,
+                    )
+                    for dims, table in own_parts:
+                        o = o + _align(
+                            table_in(table), dims, odims
+                        )
+                else:
+                    o = np.asarray(
+                        table_in(own_parts[0][1]), dtype=np.float64
+                    )
+                    odims = list(own_parts[0][0])
+                parts.append((odims, o))
+                parts_max += float(np.max(np.abs(o), initial=0.0))
+            for c in plan.children[name]:
+                cdims, ctable, cmax = sw.msgs[k][c]
+                parts.append((cdims, ctable))
+                parts_max += cmax
+                err_in += sw.err[k].get(c, 0.0)
+            if not parts:
+                # an isolated, cost-free variable: its contraction is
+                # the reduce of a zero table over its own domain
+                parts.append(([name], np.zeros(shape[-1])))
+
+            dmc = device_min_cells
+            use_device = dmc is not None and size >= dmc
+            if use_device and not sr.idempotent:
+                # error-budget gate: a device (f32) pass whose
+                # accumulated bound would exceed tol runs on host f64
+                # instead — the logsumexp analogue of the exactness
+                # certificate (there is no arg to repair; the value
+                # IS the answer)
+                scale = max(parts_max, 1.0)
+                local = _EPS32 * (
+                    (len(parts) + 1) * scale + shape[-1] + 2
+                )
+                if err_in + local > tol:
+                    use_device = False
+                    if met.enabled:
+                        met.inc("semiring.logsumexp_repairs")
+            if not use_device:
+                host_contract(
+                    k, name, plan, sep, target, shape, parts, err_in
+                )
+                continue
+
+            aligned = [
+                _align(t, dims, target) for dims, t in parts
+            ]
+            raw = (
+                tuple(shape), tuple(a.shape for a in aligned)
+            )
+            key = _key_memo.get(raw)
+            if key is None:
+                key = _key_memo[raw] = util_level_key(
+                    raw[0], raw[1], pad
+                )
+            if key not in buckets:
+                buckets[key] = []
+                order.append(key)
+            buckets[key].append(
+                (
+                    (k, name, sep, target, shape, parts,
+                     parts_max, err_in),
+                    aligned,
+                )
+            )
+
+        # ghost guard over padded own-axis cells is the ⊕-identity:
+        # +inf keeps a MIN arg-reduce inside the real domain; -inf is
+        # absorbing for max AND contributes exp(-inf)=0 to a logsumexp
+        guard = sr.plus_identity
+
+        for key in order:
+            entries = buckets[key]
+            if timeout is not None and time.perf_counter() - t0 > timeout:
+                return None
+            pshape, part_shapes = key
+            n_rows = len(entries)
+            shape0 = entries[0][0][4]
+            uniform = all(it[4] == shape0 for it, _ in entries)
+            if level_sync and n_rows > 1 and uniform:
+                ok = _dispatch_stacked(
+                    sw, sr, entries, pshape, part_shapes, shape0,
+                    pad, guard, tol, want_args, finish, sup, met,
+                    plans,
+                )
+                if ok:
+                    continue
+                # OOM on the stacked dispatch: degrade to the
+                # per-node path below (a single join that still OOMs
+                # degrades further to the exact host contraction)
+                if met.enabled:
+                    met.inc("engine.oom_splits")
+            fn = contraction_kernel(sr, pshape, part_shapes)
+            for item, aligned in entries:
+                (k, name, sep, target, shape, parts,
+                 parts_max, err_in) = item
+                if (
+                    timeout is not None
+                    and time.perf_counter() - t0 > timeout
+                ):
+                    return None
+                # the ONE padding-contract implementation
+                # (ops/padding.py): the mask is part of the kernel
+                # signature exactly when the policy is enabled
+                # (util_level_key), and the guard is this semiring's
+                # ⊕-identity
+                padded = pad_util_parts(
+                    aligned, shape, pshape, guard=guard,
+                    with_mask=pad.enabled,
+                )
+                try:
+                    outs = sup.dispatch(
+                        lambda p=padded: tuple(
+                            np.asarray(x) for x in fn(*p)
+                        ),
+                        scope="semiring.node", width=1,
+                    )
+                except DeviceOOMError:
+                    host_contract(
+                        k, name, plans[k], sep, target, shape,
+                        parts, err_in,
+                    )
+                    continue
+                if met.enabled:
+                    met.inc("semiring.dispatches")
+                sw.dispatches[k] += 1
+                region = tuple(slice(0, s) for s in shape[:-1])
+                _finish_device_row(
+                    sw, sr, plans[k], item, outs, region, tol,
+                    want_args, finish,
+                )
+    if tracer.enabled:
+        tracer.add_span(
+            "semiring.contract", "phase", t_sweep,
+            time.perf_counter() - t_sweep, semiring=sr.name,
+            instances=K, cells=sum(sw.cells),
+        )
+    return sw
+
+
+def _dispatch_stacked(
+    sw, sr, entries, pshape, part_shapes, shape0, pad, guard, tol,
+    want_args, finish, sup, met, plans,
+) -> bool:
+    """One vmapped dispatch for a uniform level-pack bucket.  Returns
+    False on device OOM (caller degrades to per-node dispatches)."""
+    from pydcop_tpu.engine.supervisor import DeviceOOMError
+
+    n_rows = len(entries)
+    stack_h = stack_bucket(n_rows) if pad.enabled else n_rows
+    n_parts = len(part_shapes)
+    has_mask = n_parts == len(entries[0][1]) + 1
+    bufs = [
+        np.zeros((stack_h,) + tuple(ps), dtype=np.float64)
+        for ps in part_shapes
+    ]
+    for r, (item, aligned) in enumerate(entries):
+        for i, a in enumerate(aligned):
+            bufs[i][r][tuple(slice(0, s) for s in a.shape)] = a
+        if has_mask:
+            bufs[-1][r][..., shape0[-1]:] = guard
+    fn = contraction_kernel(sr, pshape, part_shapes, batched=True)
+    casts = [b.astype(np.float32) for b in bufs]
+    try:
+        outs = sup.dispatch(
+            lambda: tuple(np.asarray(x) for x in fn(*casts)),
+            scope="semiring.level", width=stack_h,
+        )
+    except DeviceOOMError:
+        return False
+    if met.enabled:
+        met.inc("semiring.dispatches")
+    for k in sorted({item[0] for item, _ in entries}):
+        sw.dispatches[k] += 1
+    region_rows = tuple(slice(0, s) for s in shape0[:-1])
+    for r, (item, aligned) in enumerate(entries):
+        row_outs = tuple(o[r] for o in outs)
+        _finish_device_row(
+            sw, sr, plans[item[0]], item, row_outs, region_rows,
+            tol, want_args, finish,
+        )
+    return True
+
+
+def _finish_device_row(
+    sw, sr, plan, item, outs, region, tol, want_args, finish
+):
+    """Certify / account one device contraction and finish the node.
+
+    Idempotent ⊕: certify the f32 arg against the decision-margin
+    bound, repair near-ties on host, re-evaluate the projected
+    values in exact f64 at the certified arg (tie-heavy tables are
+    redone wholesale on host — same contract as DPOP).  logsumexp ⊕:
+    accept the f32 values and extend the accumulated error bound
+    (the tol gate already ran before dispatch)."""
+    from pydcop_tpu.telemetry import get_metrics
+
+    met = get_metrics()
+    (k, name, sep, target, shape, parts, parts_max, err_in) = item
+    if sr.idempotent:
+        arg, margins = outs
+        arg = np.array(arg[region])  # writable (repair)
+        margins = np.asarray(margins[region], dtype=np.float64)
+        local_err = _EPS32 * (len(parts) + 1) * parts_max
+        bad = np.argwhere(margins < 2.0 * local_err)
+        if len(bad) * 10 > margins.size:
+            # tie-heavy: per-cell repair would dominate — redo the
+            # whole contraction on host f64 (still exact)
+            if met.enabled:
+                met.inc("semiring.cert_fallbacks")
+            j = np.zeros(shape, dtype=np.float64)
+            for dims, table in parts:
+                j = j + _align(table, dims, target)
+            u = sr.reduce(j, axis=-1)
+            arg = sr.arg_reduce(j, axis=-1) if want_args else None
+            sw.host_nodes[k] += 1
+            finish(k, name, plan, sep, u, arg)
+            return
+        own = target[-1]
+        for cell in map(tuple, bad):
+            row = np.zeros(shape[-1], dtype=np.float64)
+            for dims, table in parts:
+                row += _cell_row(table, dims, target, cell)
+            arg[cell] = int(sr.arg_reduce(row, axis=-1))
+        # exact f64 values AT the certified arg: children contribute
+        # zero error to their parents, whatever the tree depth
+        grids = (
+            np.indices(tuple(shape[:-1]), dtype=np.intp)
+            if len(shape) > 1
+            else None
+        )
+        u = np.zeros(tuple(shape[:-1]), dtype=np.float64)
+        for dims, table in parts:
+            idx = []
+            for d in dims:
+                if d == own:
+                    idx.append(arg)
+                else:
+                    idx.append(grids[target.index(d)])
+            u += np.asarray(table, dtype=np.float64)[tuple(idx)]
+        sw.device_nodes[k] += 1
+        finish(k, name, plan, sep, u, arg)
+    else:
+        (vals,) = outs
+        u = np.asarray(vals[region], dtype=np.float64)
+        scale = max(parts_max, 1.0)
+        sw.err[k][name] = err_in + _EPS32 * (
+            (len(parts) + 1) * scale + shape[-1] + 2
+        )
+        sw.device_nodes[k] += 1
+        finish(k, name, plan, sep, u, None)
+
+
+def _cell_row(table, dims, target, cell):
+    """Exact f64 row of one part at a fixed separator cell (broadcast
+    over the own axis when the part does not carry it)."""
+    own = target[-1]
+    idx = []
+    for d in dims:
+        if d == own:
+            idx.append(slice(None))
+        else:
+            idx.append(cell[target.index(d)])
+    row = np.asarray(table, dtype=np.float64)[tuple(idx)]
+    if own not in dims:
+        return np.full(1, float(row))
+    return row
+
+
+# -- queries ------------------------------------------------------------
+
+
+def _value_phase(plan: ContractionPlan, args) -> Dict[str, Any]:
+    """Top-down MAP value wave: condition each node's retained arg
+    table on the accumulated ancestor assignment (parents precede
+    children in reversed elimination order)."""
+    assignment: Dict[str, Any] = {}
+    idx: Dict[str, int] = {}
+    for name in reversed(plan.order):
+        sep, arg = args[name]
+        best = int(arg[tuple(idx[d] for d in sep)])
+        idx[name] = best
+        assignment[name] = plan.domains[name][best]
+    return assignment
+
+
+def _downward_marginals(
+    plan: ContractionPlan,
+    sw: _Sweep,
+    k: int,
+    sr: Semiring,
+    beta: float,
+    t0: float,
+    timeout: Optional[float],
+) -> Optional[Dict[str, np.ndarray]]:
+    """Host-f64 downward pass: outside-messages root→leaves, then each
+    variable's normalized marginal.  Prefix/suffix child combines (no
+    log-domain subtraction — ``-inf`` entries from hard constraints
+    stay well-defined)."""
+    down: Dict[str, Tuple[List[str], np.ndarray]] = {}
+    marginals: Dict[str, np.ndarray] = {}
+
+    def tin(tbl):
+        return (-beta) * tbl
+
+    for name in reversed(plan.order):  # parents before children
+        if timeout is not None and time.perf_counter() - t0 > timeout:
+            return None
+        sep = sw.seps[k][name]
+        target = sep + [name]
+        shape = [len(plan.domains[d]) for d in target]
+        base = np.zeros(shape, dtype=np.float64)
+        for dims, table in plan.buckets[name]:
+            base = base + _align(tin(table), dims, target)
+        if name in down:
+            ddims, dtable = down[name]
+            base = base + _align(dtable, ddims, target)
+        cs = plan.children[name]
+        aligned_c = [
+            _align(sw.msgs[k][c][1], sw.msgs[k][c][0], target)
+            for c in cs
+        ]
+        # prefix[i] = ⊗ of children < i, suffix[i] = ⊗ of children >= i
+        prefix = [np.zeros(shape, dtype=np.float64)]
+        for a in aligned_c:
+            prefix.append(prefix[-1] + a)
+        suffix = [np.zeros(shape, dtype=np.float64)]
+        for a in reversed(aligned_c):
+            suffix.append(suffix[-1] + a)
+        suffix.reverse()
+        joint = base + prefix[-1]
+        b = sr.reduce(joint, axis=tuple(range(len(sep)))) if sep else joint
+        m = float(np.max(b)) if np.isfinite(np.max(b)) else 0.0
+        p = np.exp(b - m)
+        total = float(p.sum())
+        marginals[name] = (
+            p / total if total > 0 else np.full_like(p, 1.0 / p.size)
+        )
+        for i, c in enumerate(cs):
+            excl = base + prefix[i] + suffix[i + 1]
+            sep_c = sw.msgs[k][c][0]
+            keep = set(sep_c)
+            axes = tuple(
+                ax for ax, d in enumerate(target) if d not in keep
+            )
+            d_c = sr.reduce(excl, axis=axes) if axes else excl
+            shift = float(np.max(d_c))
+            if np.isfinite(shift):
+                d_c = d_c - shift
+            down[c] = ([d for d in target if d in keep], d_c)
+    return marginals
+
+
+def run_infer_many(
+    dcops: Sequence[Any],
+    query: str,
+    *,
+    order: str = "pseudo_tree",
+    beta: float = 1.0,
+    tol: float = 1e-6,
+    device: str = "auto",
+    device_min_cells: int = 1 << 14,
+    pad_policy: Any = None,
+    max_table_size: int = 1 << 26,
+    timeout: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """Run one inference query over K instances with their contraction
+    sweeps MERGED (the ``solve_many`` batching contract: same-bucket
+    contractions from different instances share one vmapped dispatch
+    and one compiled kernel; per-instance results are identical to
+    sequential calls).  The engine behind ``api.infer`` /
+    ``api.infer_many`` — callers own the telemetry session and
+    supervisor installation.
+
+    Queries: ``"map"`` (max/+ — the exact MAP assignment, certified
+    like DPOP), ``"log_z"`` (+/x — ``log Σ_x exp(-beta·E(x))``),
+    ``"marginals"`` (+/x normalized — per-variable distributions
+    ``p(x_v)``, plus ``log_z`` which the upward pass yields for
+    free).
+    """
+    t0 = time.perf_counter()
+    if query not in QUERY_SEMIRINGS:
+        raise ValueError(
+            f"unknown query {query!r} (expected one of "
+            f"{sorted(QUERY_SEMIRINGS)})"
+        )
+    if device not in ("auto", "never", "always"):
+        raise ValueError(
+            f"device must be 'auto'|'never'|'always', got {device!r}"
+        )
+    if beta <= 0:
+        raise ValueError(f"beta must be > 0, got {beta}")
+    sr = get_semiring(QUERY_SEMIRINGS[query])
+    pad = as_pad_policy(pad_policy)
+    dmc: Optional[int]
+    if device == "never":
+        dmc = None
+    elif device == "always":
+        dmc = 0
+    else:
+        dmc = int(device_min_cells)
+
+    from pydcop_tpu.telemetry import get_tracer
+
+    tracer = get_tracer()
+    K = len(dcops)
+    deadline = None if timeout is None else t0 + timeout
+    try:
+        plans = [
+            build_plan(d, order=order, deadline=deadline)
+            for d in dcops
+        ]
+    except TimeoutError:
+        # plan construction (the min_fill search) ate the budget —
+        # same contract as a sweep timeout
+        return [_timeout_result(query, t0) for _ in range(K)]
+    want_args = query == "map"
+
+    sw = contract_sweep(
+        plans, sr, beta=beta, device_min_cells=dmc, pad=pad,
+        tol=tol, max_table_size=max_table_size, want_args=want_args,
+        t0=t0, timeout=timeout,
+    )
+    if sw is None:
+        return [_timeout_result(query, t0) for _ in range(K)]
+
+    results: List[Dict[str, Any]] = []
+    for k, (dcop, plan) in enumerate(zip(dcops, plans)):
+        agg = (
+            sw.root_total[k]
+            + sw.total_shift[k]
+            - beta * plan.const_energy
+        )
+        # the instance bound is the sum over ROOT accumulations only:
+        # each node's entry already chains its whole subtree via
+        # err_in, so summing every node would count a leaf's local
+        # error once per ancestor
+        err = sum(sw.err[k].get(r, 0.0) for r in plan.roots)
+        out: Dict[str, Any] = {
+            "query": query,
+            "semiring": sr.name,
+            "order": plan.order_name,
+            "status": "finished",
+            "cells": sw.cells[k],
+            "dispatches": sw.dispatches[k],
+            "device_nodes": sw.device_nodes[k],
+            "host_nodes": sw.host_nodes[k],
+            # the sweep already derived every separator — don't re-run
+            # the dims-only pass plan.width() would
+            "width": max(
+                (len(s) for s in sw.seps[k].values()), default=0
+            ),
+            "error_bound": err,
+            "instances_batched": K,
+        }
+        if query == "map":
+            assignment = _value_phase(plan, sw.args[k])
+            cost = dcop.solution_cost(assignment)
+            out["assignment"] = assignment
+            out["cost"] = cost
+            out["log_weight"] = agg
+        elif query == "log_z":
+            out["log_z"] = agg
+        else:  # marginals
+            t_down = time.perf_counter()
+            margs = _downward_marginals(
+                plan, sw, k, sr, beta, t0, timeout
+            )
+            if margs is None:
+                results.append(_timeout_result(query, t0))
+                continue
+            if tracer.enabled:
+                tracer.add_span(
+                    "semiring.downward", "phase", t_down,
+                    time.perf_counter() - t_down, semiring=sr.name,
+                )
+            out["marginals"] = {
+                v: [float(x) for x in p] for v, p in margs.items()
+            }
+            out["log_z"] = agg
+        out["time"] = (time.perf_counter() - t0) / K
+        results.append(out)
+    return results
+
+
+def _timeout_result(query: str, t0: float) -> Dict[str, Any]:
+    return {
+        "query": query,
+        "status": "timeout",
+        "time": time.perf_counter() - t0,
+    }

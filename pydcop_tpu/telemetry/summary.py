@@ -124,6 +124,10 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     svc_waits: List[float] = []
     svc_lats: List[float] = []
     svc_occs: List[float] = []
+    # semiring contraction sweeps (ops/semiring.py, docs/semirings.md)
+    # aggregate per ⊕: sweep spans carry the semiring name and cell
+    # counts, so the report can say cells/sec per semiring
+    semirings: Dict[str, Dict[str, Any]] = {}
     for r in records:
         kind = r.get("kind")
         if kind == "meta":
@@ -148,6 +152,17 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 occ = (r.get("args") or {}).get("instances")
                 if occ is not None:
                     svc_occs.append(float(occ))
+            elif name.startswith("semiring."):
+                args = r.get("args") or {}
+                rec = semirings.setdefault(
+                    str(args.get("semiring", "?")),
+                    {"sweeps": 0, "total_s": 0.0, "cells": 0},
+                )
+                rec["sweeps"] += 1
+                rec["total_s"] += dur
+                cells = args.get("cells")
+                if cells:
+                    rec["cells"] += int(cells)
         elif kind == "event":
             name = r.get("name", "?")
             events[name] = events.get(name, 0) + 1
@@ -172,6 +187,27 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
     if svc_waits or svc_lats or svc_occs:
         out["service"] = _service_summary(svc_waits, svc_lats, svc_occs)
+    if semirings:
+        for rec in semirings.values():
+            rec["total_s"] = round(rec["total_s"], 6)
+            if rec["cells"] and rec["total_s"] > 0:
+                rec["cells_per_sec"] = round(
+                    rec["cells"] / rec["total_s"]
+                )
+        counters = metrics.get("counters") or {}
+        out["semiring"] = {
+            "by_semiring": semirings,
+            "counters": {
+                k: counters[k]
+                for k in (
+                    "semiring.contractions",
+                    "semiring.dispatches",
+                    "semiring.logsumexp_repairs",
+                    "semiring.cert_fallbacks",
+                )
+                if k in counters
+            },
+        }
     return out
 
 
@@ -221,6 +257,27 @@ def format_summary(s: Dict[str, Any]) -> str:
                         for q in ("p50", "p90", "p99", "max")
                     )
                 )
+    sem = s.get("semiring")
+    if sem:
+        lines.append("")
+        lines.append(
+            "semiring contractions (ops/semiring.py, "
+            "docs/semirings.md):"
+        )
+        for name in sorted(sem.get("by_semiring", {})):
+            rec = sem["by_semiring"][name]
+            rate = (
+                f" ({rec['cells_per_sec']} cells/s)"
+                if "cells_per_sec" in rec
+                else ""
+            )
+            lines.append(
+                f"  {name:<14} {rec['sweeps']:>3} sweep(s) "
+                f"{rec['cells']:>10} cells {rec['total_s']:>9.4f}s"
+                + rate
+            )
+        for k, v in sorted(sem.get("counters", {}).items()):
+            lines.append(f"  {k:<34} {v}")
     faults = s.get("faults", {})
     if faults:
         lines.append("")

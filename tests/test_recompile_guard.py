@@ -120,5 +120,20 @@ def test_service_guard_steady_state_zero_compiles():
     ), report
 
 
+@pytest.mark.semiring
+def test_semiring_guard_swap_reuses_buckets():
+    """Swapping the semiring on the same problem bucket reuses the
+    level-pack bucketing and compiles at most one new executable per
+    semiring — zero on repeat — with device results matching host f64
+    (map exactly, log_z within the reported bound).  See
+    tools/recompile_guard.py:run_semiring_guard."""
+    guard = _load_guard()
+    report = guard.run_semiring_guard()
+    assert report["ok"], report
+    assert report["map_compiles"] >= 1, report  # guard actually ran
+    assert report["log_z_compiles"] <= report["map_compiles"], report
+    assert report["repeat_compiles"] == 0, report
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

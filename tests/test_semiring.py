@@ -1,0 +1,615 @@
+"""Semiring contraction core (``ops/semiring.py``,
+``docs/semirings.md``): algebra axioms, logsumexp stability,
+brute-force parity of marginals/log_z/MAP on small random graphs,
+elimination-order equivalence, batched-vs-sequential identity, and
+the device path's exactness/error contracts.
+"""
+
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.ops import semiring as sr
+
+pytestmark = pytest.mark.semiring
+
+
+# -- helpers ------------------------------------------------------------
+
+
+def _random_dcop(n, seed, d=3, extra_edges=2, objective="min"):
+    """A random spanning tree plus a few loop edges: small enough to
+    brute-force, loopy enough that pseudo_tree and min_fill orders
+    genuinely differ."""
+    rnd = random.Random(seed)
+    dom = Domain("d", "", list(range(d)))
+    dcop = DCOP(f"g{seed}", objective=objective)
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    cid = 0
+    for i in range(1, n):
+        j = rnd.randrange(i)
+        t = np.array(
+            [[rnd.uniform(0, 3) for _ in range(d)] for _ in range(d)]
+        )
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[j], vs[i]], t, name=f"c{cid}")
+        )
+        cid += 1
+    for _ in range(extra_edges):
+        i, j = rnd.sample(range(n), 2)
+        t = np.array(
+            [[rnd.uniform(0, 3) for _ in range(d)] for _ in range(d)]
+        )
+        dcop.add_constraint(
+            NAryMatrixRelation(
+                [vs[min(i, j)], vs[max(i, j)]], t, name=f"c{cid}"
+            )
+        )
+        cid += 1
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n)])
+    return dcop
+
+
+def _brute_force(dcop, beta=1.0):
+    """Host-f64 enumeration: (log_z, marginals, min cost)."""
+    sign = -1.0 if dcop.objective == "max" else 1.0
+    vs = sorted(dcop.variables)
+    doms = {v: list(dcop.variables[v].domain.values) for v in vs}
+    logw, costs, assigns = [], [], []
+    for combo in itertools.product(*(doms[v] for v in vs)):
+        a = dict(zip(vs, combo))
+        e = sign * dcop.solution_cost(a)
+        logw.append(-beta * e)
+        costs.append(e)
+        assigns.append(a)
+    logw = np.asarray(logw)
+    m = logw.max()
+    log_z = m + np.log(np.exp(logw - m).sum())
+    p = np.exp(logw - log_z)
+    marg = {}
+    for v in vs:
+        out = np.zeros(len(doms[v]))
+        for pi, a in enumerate(assigns):
+            out[doms[v].index(a[v])] += p[pi]
+        marg[v] = out
+    return float(log_z), marg, float(min(costs))
+
+
+# -- semiring axioms ----------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name", ["min_sum", "max_sum", "log_sum_exp", "marginals"]
+)
+def test_semiring_axioms(name):
+    """⊕ is associative+commutative with its identity; ⊗ (+) is
+    associative+commutative with identity 0; the ⊕-identity
+    annihilates ⊗; ⊗ distributes over ⊕ — the properties the
+    contraction sweep's reorderings rely on.  Idempotent ⊕ is exact
+    (array equality); logsumexp up to f64 rounding."""
+    s = sr.get_semiring(name)
+    rnd = np.random.RandomState(7)
+    a, b, c = (rnd.uniform(-5, 5, size=17) for _ in range(3))
+    # min/max are EXACT on floats; logsumexp and chained f64 adds
+    # (⊗-associativity, distributivity) carry rounding — approx there
+    exact = (
+        np.testing.assert_array_equal
+        if s.idempotent
+        else lambda x, y: np.testing.assert_allclose(
+            x, y, rtol=0, atol=1e-12
+        )
+    )
+
+    def approx(x, y):
+        np.testing.assert_allclose(x, y, rtol=0, atol=1e-12)
+
+    # ⊕: associative, commutative, identity
+    exact(s.add(s.add(a, b), c), s.add(a, s.add(b, c)))
+    exact(s.add(a, b), s.add(b, a))
+    ident = np.full_like(a, s.plus_identity)
+    exact(s.add(a, ident), a)
+    # ⊗ (+ in log domain): associative, commutative, identity 0
+    approx(
+        s.combine(s.combine(a, b), c), s.combine(a, s.combine(b, c))
+    )
+    exact(s.combine(a, b), s.combine(b, a))
+    exact(s.combine(a, np.full_like(a, s.times_identity)), a)
+    # the ⊕-identity annihilates ⊗
+    exact(s.combine(a, ident), ident)
+    # distributivity: a ⊗ (b ⊕ c) == (a ⊗ b) ⊕ (a ⊗ c)
+    approx(
+        s.combine(a, s.add(b, c)),
+        s.add(s.combine(a, b), s.combine(a, c)),
+    )
+    # idempotence where claimed
+    if s.idempotent:
+        exact(s.add(a, a), a)
+
+
+def test_logsumexp_stability_vs_host_f64():
+    """The stable logsumexp must survive magnitudes where the naive
+    form overflows/underflows, and match a shifted f64 reference."""
+    s = sr.get_semiring("log_sum_exp")
+    for scale in (1.0, 500.0, 1000.0, -1000.0):
+        rnd = np.random.RandomState(int(abs(scale)))
+        a = rnd.uniform(-2, 2, size=64) + scale
+        m = a.max()
+        ref = m + np.log(np.exp(a - m).sum())
+        got = float(s.reduce(a))
+        assert np.isfinite(got)
+        assert got == pytest.approx(ref, abs=1e-12)
+    # all--inf reduces to -inf, not nan
+    assert s.reduce(np.full(5, -np.inf)) == -np.inf
+    # -inf entries are absorbed exactly
+    a = np.array([-np.inf, 0.0, 1.0])
+    assert float(s.reduce(a)) == pytest.approx(
+        np.log(1 + np.e), abs=1e-12
+    )
+
+
+def test_registry_lookup_and_registration():
+    assert sr.get_semiring("min_sum") is sr.MIN_SUM
+    assert sr.get_semiring(sr.MAX_SUM) is sr.MAX_SUM
+    with pytest.raises(ValueError, match="unknown semiring"):
+        sr.get_semiring("tropical_typo")
+    custom = sr.Semiring("test_custom_max", idempotent=True,
+                         maximize=True)
+    sr.register_semiring(custom)
+    try:
+        assert sr.get_semiring("test_custom_max") is custom
+    finally:
+        del sr.SEMIRINGS["test_custom_max"]
+
+
+# -- brute-force parity -------------------------------------------------
+
+
+@pytest.mark.parametrize("order", ["pseudo_tree", "min_fill"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_infer_matches_brute_force(order, seed):
+    """log_z and marginals within 1e-6 of host-f64 enumeration, MAP
+    cost exactly the brute-force optimum — on <=12-var random loopy
+    graphs, under both elimination orders (the ISSUE 8 acceptance
+    bar)."""
+    from pydcop_tpu.api import infer
+
+    n = 6 + seed  # 6, 7, 8 vars (brute force is 3^n enumerations)
+    dcop = _random_dcop(n, seed)
+    log_z, marg, best = _brute_force(dcop)
+    rz = infer(dcop, "log_z", order=order)
+    assert rz["status"] == "finished"
+    assert rz["log_z"] == pytest.approx(log_z, abs=1e-6)
+    assert rz["error_bound"] < 1e-6
+    rm = infer(dcop, "marginals", order=order)
+    assert rm["log_z"] == pytest.approx(log_z, abs=1e-6)
+    for v, probs in marg.items():
+        np.testing.assert_allclose(
+            rm["marginals"][v], probs, atol=1e-6
+        )
+        assert sum(rm["marginals"][v]) == pytest.approx(1.0)
+    rmap = infer(dcop, "map", order=order)
+    assert rmap["cost"] == pytest.approx(best, abs=1e-9)
+    assert dcop.solution_cost(rmap["assignment"]) == rmap["cost"]
+    # the MAP log-weight is -beta * cost (up to fp noise)
+    assert rmap["log_weight"] == pytest.approx(-best, abs=1e-6)
+
+
+def test_infer_beta_scales_distribution():
+    """beta reweights the Gibbs distribution: large beta concentrates
+    mass on the optimum (log_z -> -beta * min cost + log #optima)."""
+    from pydcop_tpu.api import infer
+
+    dcop = _random_dcop(6, 3)
+    _, _, best = _brute_force(dcop)
+    r = infer(dcop, "log_z", beta=50.0)
+    assert r["log_z"] == pytest.approx(-50.0 * best, abs=1e-3)
+    bb = _brute_force(dcop, beta=0.25)
+    r2 = infer(dcop, "log_z", beta=0.25)
+    assert r2["log_z"] == pytest.approx(bb[0], abs=1e-6)
+
+
+def test_infer_max_objective_and_map_equals_dpop():
+    """`objective: max` problems fold signs the same way solve() does:
+    MAP equals the DPOP optimum."""
+    from pydcop_tpu.api import infer, solve
+
+    dcop = _random_dcop(7, 5, objective="max")
+    rmap = infer(dcop, "map")
+    rdpop = solve(dcop, "dpop", {"util_device": "never"})
+    assert rmap["cost"] == pytest.approx(rdpop["cost"], abs=1e-9)
+
+
+def test_infer_handles_isolated_variable_and_unary_costs():
+    """A constraint-free variable contributes log(d) to log_z and a
+    uniform marginal; unary value costs are folded in."""
+    from pydcop_tpu.api import infer
+
+    dom = Domain("d", "", [0, 1, 2])
+    dcop = DCOP("iso")
+    a = Variable("a", dom)
+    b = Variable("b", dom)  # isolated
+    dcop.add_variable(a)
+    dcop.add_variable(b)
+    dcop.add_constraint(
+        NAryMatrixRelation([a], np.array([0.0, 1.0, 2.0]), name="u")
+    )
+    dcop.add_agents([AgentDef("ag0"), AgentDef("ag1")])
+    r = infer(dcop, "marginals")
+    w = np.exp(-np.array([0.0, 1.0, 2.0]))
+    np.testing.assert_allclose(
+        r["marginals"]["a"], w / w.sum(), atol=1e-9
+    )
+    np.testing.assert_allclose(
+        r["marginals"]["b"], np.full(3, 1 / 3), atol=1e-9
+    )
+    assert r["log_z"] == pytest.approx(
+        float(np.log(w.sum()) + np.log(3)), abs=1e-9
+    )
+
+
+def test_min_fill_is_narrower_on_a_loopy_grid():
+    """On a grid the DFS pseudo-tree order's induced width is known
+    to exceed min-fill's (which achieves the grid's treewidth-ish
+    bound) — the reason the heuristic is pluggable at all.  Both must
+    agree on the answer, and match brute force."""
+    from pydcop_tpu.api import infer
+
+    rows, cols = 3, 4
+    dom = Domain("d", "", [0, 1])
+    dcop = DCOP("grid")
+    vs = {}
+    for i in range(rows):
+        for j in range(cols):
+            v = Variable(f"v{i}{j}", dom)
+            vs[i, j] = v
+            dcop.add_variable(v)
+    rnd = np.random.RandomState(0)
+    cid = 0
+    for i in range(rows):
+        for j in range(cols):
+            for di, dj in ((0, 1), (1, 0)):
+                if i + di < rows and j + dj < cols:
+                    dcop.add_constraint(
+                        NAryMatrixRelation(
+                            [vs[i, j], vs[i + di, j + dj]],
+                            rnd.uniform(0, 2, (2, 2)),
+                            name=f"c{cid}",
+                        )
+                    )
+                    cid += 1
+    dcop.add_agents([AgentDef(f"ag{i}") for i in range(rows * cols)])
+    rp = infer(dcop, "log_z", order="pseudo_tree")
+    rf = infer(dcop, "log_z", order="min_fill")
+    assert rf["log_z"] == pytest.approx(rp["log_z"], abs=1e-6)
+    assert rf["width"] <= rp["width"]
+    log_z, _, _ = _brute_force(dcop)
+    assert rf["log_z"] == pytest.approx(log_z, abs=1e-6)
+
+
+# -- batching -----------------------------------------------------------
+
+
+def test_infer_many_batched_identical_to_sequential():
+    """K>1 merged sweeps return byte-identical payloads to sequential
+    infer() calls — the solve_many batching contract (ISSUE 8
+    acceptance)."""
+    from pydcop_tpu.api import infer, infer_many
+
+    dcops = [_random_dcop(6 + s, s) for s in range(4)]
+    for query in ("log_z", "marginals", "map"):
+        many = infer_many(dcops, query, pad_policy="pow2")
+        for i, d in enumerate(dcops):
+            one = infer(d, query, pad_policy="pow2")
+            assert many[i]["instances_batched"] == len(dcops)
+            if query == "map":
+                assert many[i]["assignment"] == one["assignment"]
+                assert many[i]["cost"] == one["cost"]
+            elif query == "log_z":
+                assert many[i]["log_z"] == one["log_z"]
+            else:
+                assert many[i]["marginals"] == one["marginals"]
+                assert many[i]["log_z"] == one["log_z"]
+
+
+def test_infer_many_empty_and_validation():
+    from pydcop_tpu.api import infer_many
+
+    assert infer_many([], "log_z") == []
+    dcop = _random_dcop(5, 0)
+    with pytest.raises(ValueError, match="unknown query"):
+        infer_many([dcop], "entropy")
+    with pytest.raises(ValueError, match="unknown elimination order"):
+        infer_many([dcop], "log_z", order="min_width")
+    with pytest.raises(ValueError, match="device"):
+        infer_many([dcop], "log_z", device="gpu")
+    with pytest.raises(ValueError, match="beta"):
+        infer_many([dcop], "log_z", beta=0.0)
+
+
+def test_infer_width_guard_suggests_min_fill():
+    """An over-width contraction fails with an actionable error
+    instead of a MemoryError."""
+    from pydcop_tpu.api import infer
+
+    dcop = _random_dcop(10, 2, extra_edges=12)
+    with pytest.raises(ValueError, match="min_fill"):
+        infer(dcop, "log_z", max_table_size=8)
+
+
+# -- device path --------------------------------------------------------
+
+
+def test_device_map_is_exact_and_log_z_within_bound():
+    """device='always': MAP stays EXACT (f32 argmax certificate +
+    host-f64 values), and the device log_z lands within its reported
+    error_bound of the host-f64 answer."""
+    from pydcop_tpu.api import infer
+
+    dcop = _random_dcop(8, 4)
+    host_map = infer(dcop, "map", device="never")
+    dev_map = infer(dcop, "map", device="always", pad_policy="pow2")
+    assert dev_map["device_nodes"] > 0
+    assert dev_map["assignment"] == host_map["assignment"]
+    assert dev_map["cost"] == host_map["cost"]
+
+    host_z = infer(dcop, "log_z", device="never")
+    dev_z = infer(
+        dcop, "log_z", device="always", tol=float("inf"),
+        pad_policy="pow2",
+    )
+    assert dev_z["device_nodes"] > 0
+    assert dev_z["error_bound"] > 0
+    assert (
+        abs(dev_z["log_z"] - host_z["log_z"])
+        <= dev_z["error_bound"] + 1e-9
+    )
+
+
+def test_logsumexp_tol_gate_forces_host_and_counts_repairs():
+    """With the default tight tol, device-eligible logsumexp
+    contractions are repaired onto host f64 (counted), and the
+    result matches the pure-host run bit-for-bit."""
+    from pydcop_tpu.api import infer
+    from pydcop_tpu.telemetry import session
+
+    dcop = _random_dcop(8, 4)
+    with session() as tel:
+        r = infer(dcop, "log_z", device="always", tol=1e-9)
+    counters = tel.summary()["counters"]
+    assert r["device_nodes"] == 0  # every contraction gated to host
+    assert int(counters.get("semiring.logsumexp_repairs", 0)) > 0
+    host = infer(dcop, "log_z", device="never")
+    assert r["log_z"] == host["log_z"]
+    assert r["error_bound"] < 1e-9
+
+
+def test_contraction_kernel_cache_is_per_semiring():
+    """The kernel cache keys on the semiring name: the same shape
+    bucket resolves to distinct executables per ⊕, and repeat lookups
+    hit the cache."""
+    shape = (4, 4)
+    parts = ((4, 4), (1, 4))
+    k_min = sr.contraction_kernel("min_sum", shape, parts)
+    k_max = sr.contraction_kernel("max_sum", shape, parts)
+    k_lse = sr.contraction_kernel("log_sum_exp", shape, parts)
+    assert k_min is not k_max and k_max is not k_lse
+    assert sr.contraction_kernel("min_sum", shape, parts) is k_min
+    # marginals and log_sum_exp share ⊕ but cache separately (their
+    # sweeps differ in normalization, not in the kernel math)
+    assert (
+        sr.contraction_kernel("marginals", shape, parts) is not k_lse
+    )
+
+
+def test_dpop_join_kernel_is_the_min_sum_instantiation():
+    """algorithms/dpop.py's UTIL join resolves to the shared semiring
+    kernel cache (the rebuilt-on-top property, not a parallel code
+    path)."""
+    from pydcop_tpu.algorithms import dpop
+
+    assert dpop._JOIN_KERNELS is sr._KERNELS
+    shape, parts = (3, 5), ((3, 5), (1, 5))
+    fn = dpop._join_kernel(shape, parts)
+    assert (
+        sr.contraction_kernel("min_sum", shape, parts) is fn
+    )
+
+
+# -- BP factor messages (the Max-Sum instantiation) ---------------------
+
+
+def test_bp_factor_messages_min_sum_matches_inline_loop():
+    """bp_factor_messages(min_sum) reproduces Max-Sum's historical
+    factor phase bit-for-bit (the refactor's parity contract)."""
+    import jax.numpy as jnp
+
+    rnd = np.random.RandomState(3)
+    d, m, k = 3, 5, 2
+    tab = jnp.asarray(
+        rnd.uniform(0, 4, size=(d, d, m)).astype(np.float32)
+    )
+    q_pos = [
+        jnp.asarray(rnd.uniform(0, 2, size=(d, m)).astype(np.float32))
+        for _ in range(k)
+    ]
+    # the historical inline loop
+    s = tab
+    for p in range(k):
+        shape = (1,) * p + (d,) + (1,) * (k - 1 - p) + (m,)
+        s = s + q_pos[p].astype(tab.dtype).reshape(shape)
+    expect = []
+    for p in range(k):
+        axes = tuple(a for a in range(k) if a != p)
+        mp = jnp.min(s, axis=axes)
+        rp = mp - q_pos[p].astype(tab.dtype)
+        rp = rp - jnp.min(rp, axis=0, keepdims=True)
+        expect.append(rp)
+    got = sr.bp_factor_messages(sr.MIN_SUM, tab, q_pos, tab.dtype)
+    for g, e in zip(got, expect):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(e))
+
+
+def test_bp_factor_messages_sum_product_is_normalized_marginal_bp():
+    """The same wiring at +/x computes sum-product messages: for a
+    single binary factor, exp(r_p) must be proportional to the true
+    conditional-marginal message."""
+    rnd = np.random.RandomState(1)
+    d, m = 3, 1
+    tab = (-rnd.uniform(0, 2, size=(d, d, m))).astype(np.float32)
+    q0 = np.zeros((d, m), dtype=np.float32)
+    q1 = np.log(
+        rnd.dirichlet(np.ones(d)).reshape(d, m)
+    ).astype(np.float32)
+    import jax.numpy as jnp
+
+    got = sr.bp_factor_messages(
+        sr.LOG_SUM_EXP, jnp.asarray(tab), [jnp.asarray(q0),
+                                           jnp.asarray(q1)],
+        jnp.float32,
+    )
+    # reference: r_0(x0) ~ log sum_x1 exp(tab + q1)
+    ref = np.log(
+        np.sum(np.exp(tab[..., 0] + q1[:, 0][None, :]), axis=1)
+    )
+    r0 = np.asarray(got[0])[:, 0]
+    np.testing.assert_allclose(
+        r0 - r0.max(), ref - ref.max(), atol=1e-5
+    )
+
+
+def test_error_bound_accumulates_linearly_with_depth():
+    """The reported error_bound is the sum of ROOT accumulations (each
+    root entry already chains its subtree) — doubling a chain's depth
+    must roughly double the bound, not quadruple it (the
+    every-node-summed regression counted each local error once per
+    ancestor)."""
+    from pydcop_tpu.api import infer
+
+    def chain(n):
+        rnd = random.Random(0)
+        dom = Domain("d", "", [0, 1, 2])
+        dcop = DCOP(f"chain{n}")
+        vs = [Variable(f"v{i:03d}", dom) for i in range(n)]
+        for v in vs:
+            dcop.add_variable(v)
+        for i in range(1, n):
+            t = np.array(
+                [[rnd.uniform(0, 3) for _ in range(3)] for _ in range(3)]
+            )
+            dcop.add_constraint(
+                NAryMatrixRelation([vs[i - 1], vs[i]], t, name=f"c{i}")
+            )
+        dcop.add_agents([AgentDef("a")])
+        return dcop
+
+    kw = dict(device="always", tol=float("inf"), pad_policy="pow2")
+    b8 = infer(chain(8), "log_z", **kw)["error_bound"]
+    b16 = infer(chain(16), "log_z", **kw)["error_bound"]
+    b32 = infer(chain(32), "log_z", **kw)["error_bound"]
+    assert 0 < b8 < b16 < b32
+    assert b16 / b8 < 3.0 and b32 / b16 < 3.0
+
+
+def test_min_fill_incremental_matches_recompute_reference():
+    """The incrementally-cached min-fill must pick the exact same
+    order as the naive recompute-every-count definition (same fill
+    counts, same (fill, degree, name) tie-break), and its deadline
+    turns an over-budget search into a timeout instead of a hang."""
+
+    def min_fill_ref(domains, scopes):
+        adj = {v: set() for v in domains}
+        for scope in scopes:
+            sc = [v for v in scope if v in adj]
+            for a in sc:
+                for b in sc:
+                    if a != b:
+                        adj[a].add(b)
+        remaining = {v: set(ns) for v, ns in adj.items()}
+        order = []
+
+        def fc(v):
+            ns = list(remaining[v])
+            c = 0
+            for i in range(len(ns)):
+                for j in range(i + 1, len(ns)):
+                    if ns[j] not in remaining[ns[i]]:
+                        c += 1
+            return c
+
+        while remaining:
+            v = min(
+                remaining,
+                key=lambda x: (fc(x), len(remaining[x]), x),
+            )
+            order.append(v)
+            ns = list(remaining[v])
+            for i in range(len(ns)):
+                for j in range(i + 1, len(ns)):
+                    remaining[ns[i]].add(ns[j])
+                    remaining[ns[j]].add(ns[i])
+            for nb in ns:
+                remaining[nb].discard(v)
+            del remaining[v]
+        return order
+
+    for seed in range(4):
+        rnd = random.Random(seed)
+        n = 30
+        doms = {f"v{i}": [0, 1] for i in range(n)}
+        scopes = [
+            [f"v{rnd.randrange(n)}", f"v{rnd.randrange(n)}"]
+            for _ in range(70)
+        ]
+        assert sr.min_fill_order(doms, scopes) == min_fill_ref(
+            doms, scopes
+        ), seed
+    with pytest.raises(TimeoutError, match="min_fill"):
+        sr.min_fill_order(doms, scopes, deadline=0.0)
+    # and through the API: a spent budget surfaces as a timeout
+    # result (large enough that the min_fill search cannot finish
+    # inside the 10ms floor the API clamps a spent deadline to)
+    from pydcop_tpu.api import infer
+
+    r = infer(_random_dcop(400, 0, extra_edges=400), "log_z",
+              order="min_fill", timeout=1e-9)
+    assert r["status"] == "timeout"
+
+
+# -- observability ------------------------------------------------------
+
+
+def test_trace_summary_folds_semiring_report(tmp_path):
+    """A traced infer run lands contraction spans + counters, and
+    trace-summary folds them into a per-semiring report (cells/sec),
+    in both the JSON and text renderings."""
+    from pydcop_tpu.api import infer
+    from pydcop_tpu.telemetry.summary import (
+        format_summary,
+        load_trace,
+        summarize,
+    )
+
+    trace = str(tmp_path / "t.jsonl")
+    infer(_random_dcop(6, 0), "marginals", trace=trace)
+    s = summarize(load_trace(trace))
+    assert "marginals" in s["semiring"]["by_semiring"]
+    rec = s["semiring"]["by_semiring"]["marginals"]
+    assert rec["sweeps"] >= 2  # upward contract + downward pass
+    assert rec["cells"] > 0 and "cells_per_sec" in rec
+    assert (
+        s["semiring"]["counters"]["semiring.contractions"] == 6
+    )
+    text = format_summary(s)
+    assert "semiring contractions" in text
+    assert "cells/s" in text
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

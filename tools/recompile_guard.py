@@ -20,9 +20,12 @@ Run standalone (prints one JSON line, exit 1 when over budget):
 or via the tier-1 suite: ``tests/test_recompile_guard.py`` imports
 :func:`run_guard` (dynamic solve), :func:`run_many_guard`
 (cross-instance vmap batching), :func:`run_dpop_guard`
-(level-batched DPOP through ``solve_many``) and
+(level-batched DPOP through ``solve_many``),
 :func:`run_supervisor_guard` (supervised recovery: zero-compile
-transient retries, bounded-compile OOM group splits) directly.
+transient retries, bounded-compile OOM group splits) and
+:func:`run_semiring_guard` (semiring swaps reuse the level-pack
+bucketing: one executable per semiring per bucket, zero on repeat)
+directly.
 
 ``BUDGET`` is the recorded compile count of the canned scenario: one
 chunk-runner compile in segment 1, zero afterwards.  Raise it only
@@ -91,6 +94,20 @@ SERVICE_ROUNDS = 48
 # groups) = the de-batching regression this guards.
 DPOP_K = 8
 DPOP_BUDGET = 5
+
+# semiring contraction core (ops/semiring.py): the level-pack bucket
+# KEYS are shape-only and shared across semirings, and the kernel
+# cache keys on (semiring, bucket) — so running a SECOND query
+# (log_z, i.e. the logsumexp semiring) over the SAME K instances
+# after a first (map, i.e. max/+) must reuse the bucketing wholesale
+# and compile at most one new executable per bucket for the new
+# semiring (<= the first query's compile count), with ZERO compiles
+# on a repeat of either.  More compiles on the second query = the
+# bucketing is churning per semiring; compiles on repeat = the cache
+# key regressed.  Results must match the device='never' host-f64
+# runs: map exactly (the certificate), log_z within the reported
+# error bound.
+SEMIRING_K = 4
 
 
 def _build_dcop():
@@ -641,6 +658,103 @@ def run_dpop_guard() -> dict:
     return report
 
 
+def run_semiring_guard() -> dict:
+    """Compile budget for semiring swaps on one problem bucket
+    (``ops/semiring.py``): over K same-structure SECP instances with
+    the device forced on, (1) a first ``infer_many(query='map')``
+    compiles one max/+ contraction kernel per level-pack bucket, (2)
+    swapping the semiring — ``query='log_z'`` on the SAME instances —
+    reuses the bucketing and compiles AT MOST one new executable per
+    bucket (<= the first query's count), (3) repeating either query
+    performs ZERO new compiles, and (4) both merged sweeps agree with
+    the pure host-f64 runs (map exactly, log_z within the reported
+    ``error_bound``).  Regressions this catches: per-semiring
+    bucket-key churn, the kernel cache keying on something unstable,
+    and device-path drift in either ⊕."""
+    from pydcop_tpu.api import infer_many
+    from pydcop_tpu.ops import semiring as sr_mod
+    from pydcop_tpu.telemetry import session
+
+    # cold start for the shared contraction-kernel cache (also DPOP's
+    # join cache — one object), same reason as the other guards
+    sr_mod._KERNELS.clear()
+
+    dcops = [
+        _build_secp(10, 8, 3, seed=40 + i) for i in range(SEMIRING_K)
+    ]
+    kw = dict(device="always", pad_policy="pow2")
+
+    def compiles(tel):
+        return int(tel.summary()["counters"].get("jit.compiles", 0))
+
+    with session() as t1:
+        maps = infer_many(dcops, "map", **kw)
+    with session() as t2:
+        zs = infer_many(dcops, "log_z", tol=float("inf"), **kw)
+    with session() as t3:
+        infer_many(dcops, "map", **kw)
+        infer_many(dcops, "log_z", tol=float("inf"), **kw)
+    map_compiles, z_compiles, repeat_compiles = (
+        compiles(t1), compiles(t2), compiles(t3)
+    )
+    report = {
+        "map_compiles": map_compiles,
+        "log_z_compiles": z_compiles,
+        "repeat_compiles": repeat_compiles,
+        "ok": True,
+        "costs": [r["cost"] for r in maps],
+        "log_z": [round(r["log_z"], 6) for r in zs],
+        "device_nodes": sum(r["device_nodes"] for r in zs),
+    }
+    if map_compiles < 1 or sum(r["device_nodes"] for r in maps) < 1:
+        report["ok"] = False
+        report["error"] = (
+            "the first query never reached the device — the guard "
+            "is vacuous (device='always' stopped forcing the path)"
+        )
+    elif z_compiles > map_compiles:
+        report["ok"] = False
+        report["error"] = (
+            f"semiring swap compiled {z_compiles} executable(s) vs "
+            f"{map_compiles} bucket(s) — the level-pack bucketing is "
+            "churning per semiring instead of being reused wholesale"
+        )
+    elif repeat_compiles != 0:
+        report["ok"] = False
+        report["error"] = (
+            f"{repeat_compiles} new compile(s) on identical repeat "
+            "queries — the (semiring, bucket) kernel cache key is "
+            "unstable"
+        )
+    else:
+        # device results must agree with the pure host-f64 runs
+        host_maps = infer_many(dcops, "map", device="never")
+        host_zs = infer_many(dcops, "log_z", device="never")
+        for i in range(SEMIRING_K):
+            if (
+                maps[i]["cost"] != host_maps[i]["cost"]
+                or maps[i]["assignment"] != host_maps[i]["assignment"]
+            ):
+                report["ok"] = False
+                report["error"] = (
+                    f"instance {i}: device MAP diverges from host "
+                    f"({maps[i]['cost']} vs {host_maps[i]['cost']}) "
+                    "— the argmax certificate stopped holding"
+                )
+                break
+            bound = zs[i]["error_bound"] + 1e-9
+            if abs(zs[i]["log_z"] - host_zs[i]["log_z"]) > bound:
+                report["ok"] = False
+                report["error"] = (
+                    f"instance {i}: device log_z off by "
+                    f"{abs(zs[i]['log_z'] - host_zs[i]['log_z'])} "
+                    f"> reported error_bound {zs[i]['error_bound']} "
+                    "— the logsumexp error accounting is wrong"
+                )
+                break
+    return report
+
+
 def main() -> int:
     import jax
 
@@ -652,6 +766,7 @@ def main() -> int:
     report_dpop = run_dpop_guard()
     report_sup = run_supervisor_guard()
     report_service = run_service_guard()
+    report_semiring = run_semiring_guard()
     print(
         json.dumps(
             {
@@ -660,6 +775,7 @@ def main() -> int:
                 "dpop": report_dpop,
                 "supervisor": report_sup,
                 "service": report_service,
+                "semiring": report_semiring,
             }
         )
     )
@@ -670,6 +786,7 @@ def main() -> int:
         and report_dpop["ok"]
         and report_sup["ok"]
         and report_service["ok"]
+        and report_semiring["ok"]
         else 1
     )
 
