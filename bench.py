@@ -139,7 +139,11 @@ DPOP_MANY_K = 8
 # client p99 <= SVC_P99_FACTOR x the sequential per-call latency
 # (medians across reps), results bit-identical; zero steady-state XLA
 # compiles is guarded separately by
-# tools/recompile_guard.py:run_service_guard.
+# tools/recompile_guard.py:run_service_guard.  An overload
+# sub-measure (ISSUE 9) then floods a small-capacity service at ~4x
+# its per-tick drain and records shed counts, the bounded queue
+# depth, p99 admission-to-reject latency, and bit-parity of the
+# accepted requests against unloaded solves.
 SVC_N = 32
 SVC_PROBLEMS = 4  # distinct graphs cycled over the SVC_N clients
 SVC_VARS = 64  # sizes SVC_VARS-6 .. SVC_VARS: one pow2 shape bucket
@@ -1094,6 +1098,69 @@ def _measure_service(phase_budget: float = 0.0) -> dict:
             and steady_compiles == 0
         ),
     }
+
+    # overload evidence (ISSUE 9): flood a small-capacity service at
+    # ~4x its per-tick drain with deadline-carrying requests.  The
+    # bounded queue + deadline-aware admission must shed the excess in
+    # microseconds (p99 admission-to-reject), keep the queue depth at
+    # its bound, and leave every ACCEPTED request's result
+    # bit-identical to an unloaded sequential solve.
+    _phase("measure:overload")
+    with SolverService(
+        pad_policy="pow2", max_batch=4, max_wait=0.005,
+        max_queue=8,
+    ) as svc:
+        # teach the tick-duration EWMA with a couple of normal ticks
+        for i in range(4):
+            svc.solve(paths[i % SVC_PROBLEMS], algo, params,
+                      seed=i, **kw)
+        # ~4x the per-tick drain in one burst: even-indexed requests
+        # carry an unmeetable deadline (deadline sheds), odd ones none
+        # (queue-full sheds past the bound, the rest accepted)
+        flood = [
+            svc.submit(
+                paths[i % SVC_PROBLEMS], algo, params, seed=i,
+                timeout=0.001 if i % 2 == 0 else None, **kw
+            )
+            for i in range(4 * SVC_N)
+        ]
+        flood_res = [p.result(120) for p in flood]
+        over_stats = svc.stats()
+    shed = [r for r in flood_res if r.get("status") == "shed"]
+    finished = [
+        (i, r)
+        for i, r in enumerate(flood_res)
+        if r.get("status") == "finished"
+    ]
+    acc_match = all(
+        r["cost"]
+        == solve(
+            paths[i % SVC_PROBLEMS], algo, params,
+            pad_policy="pow2", seed=i, **kw
+        )["cost"]
+        for i, r in finished
+    )
+    out["overload"] = {
+        "flooded": len(flood_res),
+        "shed": len(shed),
+        "shed_reasons": sorted(
+            {r.get("shed_reason") for r in shed}
+        ),
+        "finished": len(finished),
+        "max_queue": 8,
+        "max_observed_queue_depth": max(
+            (r.get("queue_depth", 0) for r in shed), default=0
+        ),
+        "shed_reject_p99_s": over_stats["shed_latency_s"]["p99"],
+        "accepted_match_unloaded": acc_match,
+        "ok": (
+            len(shed) > 0
+            and len(finished) > 0
+            and over_stats["shed_latency_s"]["p99"] < 0.01
+            and acc_match
+        ),
+    }
+    out["ok"] = out["ok"] and out["overload"]["ok"]
     _phase("measured")
     return out
 
@@ -1584,7 +1651,8 @@ def main() -> None:
                 "requests_per_sec_sequential",
                 "sequential_per_call_s", "latency_s",
                 "batch_occupancy", "coalesce_ratio",
-                "steady_state_jit_compiles", "results_match", "ok",
+                "steady_state_jit_compiles", "results_match",
+                "overload", "ok",
             )
             if k in service
         }

@@ -237,13 +237,22 @@ def _solve_dispatch(
         # and the caller would believe the recovery path was exercised
         from pydcop_tpu.faults import FaultPlan
 
-        if FaultPlan.from_spec(chaos, chaos_seed).device_faults_configured:
+        plan_probe = FaultPlan.from_spec(chaos, chaos_seed)
+        if plan_probe.device_faults_configured:
             raise ValueError(
                 "device-layer chaos kinds (device_oom/"
                 "device_transient/nan_inject) inject at the batched "
                 "engine's supervised device dispatch "
                 f"(engine/supervisor.py); mode={mode!r} has no device "
                 "dispatch — use mode='batched' (docs/faults.md)"
+            )
+        if plan_probe.wire_faults_configured:
+            raise ValueError(
+                "wire-level chaos kinds (conn_drop/slow_client/"
+                "frame_corrupt) inject at the solver service's frame "
+                f"loop (engine/service.py); mode={mode!r} has no "
+                "serving wire — use `pydcop_tpu serve --chaos` "
+                "(docs/serving.md)"
             )
 
     if mode in ("thread", "sim"):
@@ -322,6 +331,14 @@ def _solve_dispatch(
                 "events).  The batched engine accepts the "
                 "DEVICE-layer kinds only: device_oom, "
                 "device_transient, nan_inject (docs/faults.md)"
+            )
+        if plan.wire_faults_configured:
+            raise ValueError(
+                "wire-level chaos kinds (conn_drop/slow_client/"
+                "frame_corrupt) inject at the solver service's frame "
+                "loop — use `pydcop_tpu serve --chaos` "
+                "(docs/serving.md); a one-shot solve has no serving "
+                "wire"
             )
     if k_target:
         raise ValueError(
@@ -833,6 +850,13 @@ def solve_many(
                 "message plane — chaos accepts the DEVICE-layer "
                 "kinds only: device_oom, device_transient, "
                 "nan_inject (docs/faults.md)"
+            )
+        if plan.wire_faults_configured:
+            raise ValueError(
+                "wire-level chaos kinds (conn_drop/slow_client/"
+                "frame_corrupt) inject at the solver service's frame "
+                "loop — use `pydcop_tpu serve --chaos` "
+                "(docs/serving.md); solve_many has no serving wire"
             )
 
     if compile_cache is not None:

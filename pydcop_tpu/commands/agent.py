@@ -69,6 +69,22 @@ def run_cmd(args) -> int:
             "--chaos injects message-plane faults — only the host "
             "runtime has a per-agent message plane (--runtime host)"
         )
+    if args.chaos:
+        from pydcop_tpu.faults import FaultPlan, FaultSpecError
+
+        try:
+            plan = FaultPlan.from_spec(args.chaos, args.chaos_seed)
+        except FaultSpecError as e:
+            raise SystemExit(f"agent: {e}")
+        if plan.wire_faults_configured:
+            # a silently-inert clause would record the spec as
+            # applied while injecting nothing
+            raise SystemExit(
+                "agent: wire-level chaos kinds (conn_drop/"
+                "slow_client/frame_corrupt) inject at the solver "
+                "service's frame loop — use `pydcop_tpu serve "
+                "--chaos` (docs/serving.md)"
+            )
     if len(args.names) > 1:
         # one OS process per agent: each is an independent
         # jax.distributed participant, so fork real subprocesses

@@ -165,6 +165,22 @@ def run_cmd(args) -> int:
             "--elastic + real kills, or `run --chaos` for scripted "
             "crashes on the batched engine)"
         )
+    if args.chaos:
+        from pydcop_tpu.faults import FaultPlan, FaultSpecError
+
+        try:
+            plan = FaultPlan.from_spec(args.chaos, args.chaos_seed)
+        except FaultSpecError as e:
+            raise SystemExit(f"orchestrator: {e}")
+        if plan.wire_faults_configured:
+            # a silently-inert clause would record the spec as
+            # applied while injecting nothing
+            raise SystemExit(
+                "orchestrator: wire-level chaos kinds (conn_drop/"
+                "slow_client/frame_corrupt) inject at the solver "
+                "service's frame loop — use `pydcop_tpu serve "
+                "--chaos` (docs/serving.md)"
+            )
     placement = None
     dist_name = None
     if args.distribution:

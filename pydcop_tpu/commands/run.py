@@ -123,6 +123,13 @@ def run_cmd(args) -> int:
                 "partition) need the host runtimes (solve --mode "
                 "thread/process, orchestrator --runtime host)"
             )
+        if chaos_plan.wire_faults_configured:
+            raise SystemExit(
+                "run: wire-level chaos kinds (conn_drop/slow_client/"
+                "frame_corrupt) inject at the solver service's frame "
+                "loop — use `pydcop_tpu serve --chaos` "
+                "(docs/serving.md)"
+            )
         if not chaos_plan.crashes and not chaos_plan.device_faults_configured:
             raise SystemExit(
                 "run: --chaos without crash=AGENT@T or device-layer "

@@ -3,16 +3,27 @@
 Starts a resident :class:`~pydcop_tpu.engine.service.SolverService`
 behind a TCP :class:`~pydcop_tpu.engine.service.ServiceServer`
 (newline-JSON frames, ``docs/serving.md``) and serves until a client
-sends ``shutdown``, the global ``-t/--timeout`` elapses, or Ctrl-C.
+sends ``shutdown``, the global ``-t/--timeout`` elapses, Ctrl-C, or
+SIGTERM.
+
+Every exit path is a **graceful drain**: new admissions are rejected,
+in-flight ticks finish and deliver, the final session checkpoint is
+written (``--session_checkpoint``), and the final JSON stats report —
+including the zeroed queue-depth gauge — is emitted on stderr.  A
+restarted ``serve --resume`` replays the checkpointed sessions through
+the :class:`~pydcop_tpu.engine.incremental.IncrementalCompiler`, so
+reconnecting clients' ``set_values`` follow-ups stay
+``compile.incremental``-only.
 
 Prints one JSON line ``{"serving": "host:port", "pid": N}`` once the
 socket is bound (a parent process can parse it to find an ephemeral
-``--port 0``), and a final JSON stats report on exit.
+``--port 0``).
 """
 
 from __future__ import annotations
 
 import json
+import signal
 import sys
 
 from pydcop_tpu.commands._common import (
@@ -69,12 +80,43 @@ def set_parser(subparsers) -> None:
         "programs any previous process built (docs/performance.md)",
     )
     p.add_argument(
+        "--max_queue", type=int, default=1024, metavar="N",
+        help="bounded admission queue: requests arriving at depth N "
+        "are rejected immediately with status='shed' instead of "
+        "growing the queue without limit; deadline-carrying requests "
+        "the service already knows it cannot meet are shed too "
+        "(docs/serving.md); default 1024",
+    )
+    p.add_argument(
+        "--max_inflight", type=int, default=8, metavar="N",
+        help="per-connection in-flight request cap (wire "
+        "backpressure): a client pipelining past it is answered "
+        "status='shed'; default 8",
+    )
+    p.add_argument(
+        "--session_checkpoint", default=None, metavar="FILE",
+        help="write the final session checkpoint (pinned dcops, "
+        "applied set_values deltas, per-session counters) to FILE on "
+        "every exit path — SIGTERM/Ctrl-C/shutdown all drain "
+        "gracefully first (docs/serving.md)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="replay the --session_checkpoint file at startup (if it "
+        "exists): restored sessions' set_values follow-ups stay "
+        "compile.incremental-only, bit-identical to an undisturbed "
+        "service",
+    )
+    p.add_argument(
         "--chaos", default=None, metavar="SPEC",
         help="inject deterministic DEVICE-layer faults into every "
         "dispatch (device_oom=W[:R], device_transient=P[:AFTER], "
-        "nan_inject=P[:I] — docs/faults.md): a poisoned or OOM-ing "
-        "request degrades/splits under the supervisor while its "
-        "batchmates return bit-identical results",
+        "nan_inject=P[:I]) and WIRE faults into the frame loop "
+        "(conn_drop=P[:AFTER], slow_client=W, frame_corrupt=P[:AFTER] "
+        "— docs/faults.md): a poisoned or OOM-ing request "
+        "degrades/splits under the supervisor while its batchmates "
+        "return bit-identical results; dropped/corrupted replies are "
+        "replayed from the reply cache on idempotent retry",
     )
     p.add_argument(
         "--chaos_seed", type=int, default=0,
@@ -96,41 +138,91 @@ def run_cmd(args) -> int:
 
         enable_persistent_compilation_cache(args.compile_cache)
 
+    stats = None
     with session(args.trace, args.trace_format):
-        service = SolverService(
-            pad_policy=args.pad_policy,
-            max_batch=args.max_batch,
-            max_wait=args.max_wait,
-            instance_bucket=args.instance_bucket,
-            chaos=args.chaos,
-            chaos_seed=args.chaos_seed,
-            retry_budget=args.retry_budget,
-            chunk_floor=args.chunk_floor,
-            on_numeric_fault=args.on_numeric_fault,
-        )
         try:
-            with ServiceServer(
-                service, host=args.host, port=args.port
-            ) as server:
-                import os
+            service = SolverService(
+                pad_policy=args.pad_policy,
+                max_batch=args.max_batch,
+                max_wait=args.max_wait,
+                instance_bucket=args.instance_bucket,
+                chaos=args.chaos,
+                chaos_seed=args.chaos_seed,
+                retry_budget=args.retry_budget,
+                chunk_floor=args.chunk_floor,
+                on_numeric_fault=args.on_numeric_fault,
+                max_queue=args.max_queue,
+                session_checkpoint=args.session_checkpoint,
+                resume=args.resume,
+            )
+        except ValueError as e:
+            # flag/spec usage errors exit cleanly, like the sibling
+            # commands — not as a raw traceback (ServiceError IS a
+            # RuntimeError, so a bad --resume checkpoint is caught by
+            # its own clause below)
+            raise SystemExit(f"serve: {e}")
+        except RuntimeError as e:
+            raise SystemExit(f"serve: {e}")
+        server = None
+        prev_term = None
+        try:
+            server = ServiceServer(
+                service, host=args.host, port=args.port,
+                max_inflight=args.max_inflight,
+            )
+            import os
 
-                print(
-                    json.dumps(
-                        {
-                            "serving": "%s:%d" % server.address,
-                            "pid": os.getpid(),
-                        }
-                    ),
-                    flush=True,
-                )
-                try:
-                    # the global -t/--timeout doubles as a serve
-                    # duration bound (handy for scripted benches/tests)
-                    server.wait(args.timeout)
-                except KeyboardInterrupt:
-                    pass
+            # SIGTERM = "drain and go": the handler only flips the
+            # shutdown event; this thread wakes from wait() and runs
+            # the same graceful-drain path as a client `shutdown` op
+            # or Ctrl-C
+            prev_term = signal.signal(
+                signal.SIGTERM,
+                lambda *_: server.request_shutdown(),
+            )
+            print(
+                json.dumps(
+                    {
+                        "serving": "%s:%d" % server.address,
+                        "pid": os.getpid(),
+                        "sessions_restored": service.stats()[
+                            "sessions_restored"
+                        ],
+                    }
+                ),
+                flush=True,
+            )
+            try:
+                # the global -t/--timeout doubles as a serve
+                # duration bound (handy for scripted benches/tests)
+                server.wait(args.timeout)
+            except KeyboardInterrupt:
+                pass
         finally:
-            service.close()
-            stats = service.stats()
-    print(json.dumps({"stats": stats}, default=str), file=sys.stderr)
+            # EVERY exit path funnels through the graceful drain and
+            # emits the final stats line — an interrupted serve must
+            # never vanish without flushing its aggregates (and its
+            # session checkpoint, when configured).  Order matters
+            # twice over: the service drains FIRST, while the wire
+            # connections are still open, so queued requests' results
+            # actually reach their clients ("finish and deliver") and
+            # only then does the server tear the connections down;
+            # and the SIGTERM handler stays installed UNTIL the drain
+            # finishes, so a re-delivered TERM during it is absorbed
+            # instead of killing the process mid-checkpoint.
+            try:
+                service.close()
+            finally:
+                try:
+                    if server is not None:
+                        server.close()
+                finally:
+                    if prev_term is not None:
+                        signal.signal(signal.SIGTERM, prev_term)
+                    stats = service.stats()
+                    print(
+                        json.dumps({"stats": stats}, default=str),
+                        file=sys.stderr,
+                        flush=True,
+                    )
     return 0

@@ -55,13 +55,47 @@ coalesce into shared ticks.  ``pydcop_tpu serve`` is the CLI front
 (``docs/serving.md`` covers the tick policy, affinity, and failure
 semantics under the PR 6 recovery matrix).
 
+Production hardening (the serving loop is the fourth supervised layer
+of the recovery matrix, ``docs/faults.md``):
+
+- **overload control** — the admission queue is bounded
+  (``max_queue``); requests past the bound, and requests whose
+  ``timeout`` the service already knows it cannot meet at the current
+  queue depth (predicted wait from a median of recent tick durations),
+  are
+  rejected at admission with ``status="shed"`` in microseconds
+  instead of burning a dispatch slot on a doomed solve.  The wire
+  server adds a per-connection in-flight cap as backpressure.
+- **graceful lifecycle** — :meth:`SolverService.close` drains: new
+  admissions are refused, queued ticks finish and deliver, and the
+  final **session checkpoint** (each pinned session's dcop identity,
+  its ordered applied ``set_values`` deltas, and counters) is written
+  atomically.  A restarted service (``serve --resume``) replays the
+  deltas through the ``IncrementalCompiler`` in order — the exact
+  update arithmetic of the original — so a reconnecting session's
+  follow-up is ``compile.incremental``-only and bit-identical to an
+  undisturbed service.
+- **wire-level chaos + idempotent retries** — the seeded FaultPlan
+  wire kinds (``conn_drop``, ``slow_client``, ``frame_corrupt``)
+  inject in :class:`ServiceServer`'s reply path;
+  :class:`ServiceClient` reconnects with keyed-backoff
+  (``utils/backoff.py``) and resends under a per-request idempotency
+  key, which the server answers from a bounded reply cache — a
+  dropped-but-computed response is replayed, never re-solved.
+  Malformed or oversized frames get a structured error reply and the
+  connection survives.
+
 Telemetry (``docs/observability.md``): counters ``service.requests``/
 ``service.ticks``/``service.dispatches``/``service.coalesced``/
-``service.pad_instances``, histograms ``service.queue_wait_s``/
-``service.latency_s``/``service.batch_occupancy``, and per-request
-``service.queue-wait`` + ``service.request`` spans / per-group
-``service.dispatch`` spans that ``pydcop_tpu trace-summary`` folds
-into queue-wait / occupancy / latency percentiles.
+``service.pad_instances``/``service.shed``/
+``service.frames_rejected``/``service.sessions_restored``/
+``service.replayed_replies``/``service.client_retries``, histograms
+``service.queue_wait_s``/``service.latency_s``/
+``service.batch_occupancy``/``service.shed_latency_s``, and
+per-request ``service.queue-wait`` + ``service.request`` spans /
+per-group ``service.dispatch`` spans / a final ``service.drain`` span
+that ``pydcop_tpu trace-summary`` folds into queue-wait / occupancy /
+latency percentiles plus shed/retry/drain rows.
 
 This module is import-light by design: jax (and the batched engine)
 load on first dispatch, not at import, so ``api.ServiceClient`` stays
@@ -75,6 +109,7 @@ import hashlib
 import json
 import os
 import socket
+import sys
 import threading
 import time
 from collections import OrderedDict, deque
@@ -95,12 +130,38 @@ _LATENCY_BUCKETS = (
 #: bench's request counts without growing forever in a resident process
 _STATS_WINDOW = 8192
 
+#: per-session delta-log bound: a resident session streaming
+#: set_values forever must not grow its checkpoint (and the resume
+#: replay it implies) with session AGE — past the bound the oldest
+#: half folds into one cumulative delta (see _Session.record_delta)
+_DELTA_LOG_MAX = 4096
+
 
 def _next_pow2(n: int) -> int:
     p = 1
     while p < n:
         p *= 2
     return p
+
+
+def _pad_policy_doc(pad_policy: Any) -> Dict[str, Any]:
+    """The canonical JSON form of a pad policy (string spec or
+    PadPolicy object) — what session checkpoints store and compare."""
+    from pydcop_tpu.ops.padding import as_pad_policy
+
+    return dataclasses.asdict(as_pad_policy(pad_policy))
+
+
+def _dcop_source(dcop: Any) -> Optional[Tuple[str, str]]:
+    """The serializable identity of a request's dcop, for session
+    checkpoints: yaml text ships verbatim, paths by realpath; DCOP
+    objects have no wire identity (None — serialized at checkpoint
+    time via ``dcop_yaml`` when possible)."""
+    if isinstance(dcop, str) and "\n" in dcop:
+        return ("yaml", dcop)
+    if isinstance(dcop, str):
+        return ("path", os.path.realpath(dcop))
+    return None
 
 
 @dataclasses.dataclass
@@ -135,6 +196,8 @@ class PendingResult:
         self._done = threading.Event()
         self._result: Optional[Dict[str, Any]] = None
         self._error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._callbacks: List[Any] = []
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -150,15 +213,37 @@ class PendingResult:
         assert self._result is not None
         return self._result
 
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn(self)`` once the request reaches its terminal
+        state (immediately if it already has).  The wire server uses
+        this to pipeline replies instead of parking one blocked thread
+        per in-flight request."""
+        with self._lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
     # -- service side ----------------------------------------------------
+
+    def _finish(self) -> None:
+        with self._lock:
+            self._done.set()
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 — a broken callback must
+                # not take down the tick worker delivering the result
+                pass
 
     def _set_result(self, result: Dict[str, Any]) -> None:
         self._result = result
-        self._done.set()
+        self._finish()
 
     def _set_error(self, error: BaseException) -> None:
         self._error = error
-        self._done.set()
+        self._finish()
 
 
 @dataclasses.dataclass
@@ -178,6 +263,9 @@ class _Request:
     session: Optional[str]
     set_values: Optional[Dict[str, Any]]
     pending: PendingResult
+    # serializable dcop identity for session checkpoints:
+    # ("yaml", text) / ("path", realpath) / None for in-process objects
+    dcop_src: Optional[Tuple[str, str]] = None
     enqueue_t: float = 0.0
     queue_wait: float = 0.0
 
@@ -190,18 +278,53 @@ class _Session:
     device delta-update (``compile.incremental``) or nothing
     (``compile.reused``) — never a host rebuild or an XLA compile."""
 
-    def __init__(self, compiler, dcop, dcop_key: Tuple) -> None:
+    def __init__(
+        self,
+        compiler,
+        dcop,
+        dcop_key: Tuple,
+        source: Optional[Tuple[str, str]] = None,
+    ) -> None:
         self.compiler = compiler
         self.dcop = dcop
         self.dcop_key = dcop_key  # admission identity of segment 1
+        self.source = source  # checkpointable identity (yaml/path)
         self.ext_values: Dict[str, Any] = {}
+        # the ordered applied set_values deltas — a restarted service
+        # replays them through the IncrementalCompiler so a follow-up
+        # lands on bit-identical device tables (docs/serving.md)
+        self.deltas: List[Dict[str, Any]] = []
         self.segments = 0
+
+    def record_delta(self, delta: Dict[str, Any]) -> None:
+        """Append one applied delta, keeping the log bounded: past
+        ``_DELTA_LOG_MAX`` the oldest half folds into one cumulative
+        delta.  Folding keeps the replay VALUE-equal (every touched
+        constraint re-tabulates from the same final external state);
+        only the f32 fold ORDER of unary-folded rows can drift by an
+        ulp for sessions older than the bound — the price of a
+        checkpoint and resume that are O(bound), not O(session age)."""
+        self.deltas.append(dict(delta))
+        if len(self.deltas) > _DELTA_LOG_MAX:
+            half = len(self.deltas) // 2
+            merged: Dict[str, Any] = {}
+            for d in self.deltas[:half]:
+                merged.update(d)
+            self.deltas = [merged] + self.deltas[half:]
 
 
 class ServiceError(RuntimeError):
     """A request the service could not solve (bad algo/params/dcop, or
     an unrecoverable dispatch failure); the message is the client-side
     report."""
+
+
+class ServiceTransportError(ServiceError):
+    """The request could not be exchanged with the service at all
+    (connect/send/receive failed past the retry window) — as opposed
+    to a structured server-side refusal.  Best-effort operations
+    (:meth:`ServiceClient.shutdown`) tolerate this after the request
+    was plausibly delivered."""
 
 
 class SolverService:
@@ -239,6 +362,9 @@ class SolverService:
         chunk_floor: Optional[int] = None,
         on_numeric_fault: Optional[str] = None,
         compile_cache_max: int = 256,
+        max_queue: int = 1024,
+        session_checkpoint: Optional[str] = None,
+        resume: bool = False,
         autostart: bool = True,
     ):
         from pydcop_tpu.ops.padding import as_pad_policy
@@ -259,6 +385,13 @@ class SolverService:
             )
         self.instance_bucket = instance_bucket
 
+        if max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1, got {max_queue}"
+            )
+        self.max_queue = max_queue
+        self.session_checkpoint = session_checkpoint
+
         plan = None
         if chaos:
             from pydcop_tpu.faults import FaultPlan
@@ -268,8 +401,10 @@ class SolverService:
                 raise ValueError(
                     "the solver service dispatches on the batched "
                     "engine, which has no message plane — chaos "
-                    "accepts the DEVICE-layer kinds only: device_oom, "
-                    "device_transient, nan_inject (docs/faults.md)"
+                    "accepts the DEVICE-layer kinds (device_oom, "
+                    "device_transient, nan_inject) and the WIRE "
+                    "kinds (conn_drop, slow_client, frame_corrupt) "
+                    "only (docs/faults.md)"
                 )
         self.chaos_plan = plan
         from pydcop_tpu.engine.supervisor import make_supervisor
@@ -280,6 +415,7 @@ class SolverService:
         )
 
         self._cond = threading.Condition()
+        self._close_lock = threading.Lock()  # serializes close()
         self._queue: deque = deque()
         self._closing = False
         self._worker: Optional[threading.Thread] = None
@@ -300,9 +436,33 @@ class SolverService:
         self._n_coalesced = 0  # requests that shared a group with >= 1 other
         self._n_pad_instances = 0
         self._n_errors = 0
+        self._n_shed = 0
+        self._n_frames_rejected = 0
+        self._n_sessions_restored = 0
+        self._n_replayed_replies = 0
         self._queue_waits: deque = deque(maxlen=_STATS_WINDOW)
         self._latencies: deque = deque(maxlen=_STATS_WINDOW)
         self._occupancies: deque = deque(maxlen=_STATS_WINDOW)
+        self._shed_lats: deque = deque(maxlen=_STATS_WINDOW)
+        # the deadline-aware shed's capacity estimate: the MEDIAN of
+        # a recent-tick-duration window (robust — one compile-heavy
+        # cold tick must not poison the predictor into shedding
+        # easily-meetable deadlines for the next N ticks, which a
+        # decay-based EWMA would); None until the first tick lands
+        # (the service never sheds on a deadline it has no data to
+        # judge)
+        self._tick_durs: deque = deque(maxlen=32)
+        self._tick_med: Optional[float] = None
+        self._drained = False
+
+        if resume:
+            if not session_checkpoint:
+                raise ValueError(
+                    "resume=True needs session_checkpoint=<path> — "
+                    "there is nothing else to resume from"
+                )
+            if os.path.exists(session_checkpoint):
+                self.restore_sessions(session_checkpoint)
 
         if autostart:
             self.start()
@@ -315,19 +475,63 @@ class SolverService:
             if self._worker is not None and self._worker.is_alive():
                 return
             self._closing = False
+            self._drained = False
             self._worker = threading.Thread(
                 target=self._run, name="solver-service-tick", daemon=True
             )
             self._worker.start()
 
     def close(self) -> None:
-        """Stop admitting, drain the queue, join the worker."""
+        """Graceful drain: stop admitting, finish every queued tick,
+        join the worker, write the final session checkpoint (when
+        ``session_checkpoint`` is configured) and flush the final
+        queue-depth gauge.  Idempotent and safe under concurrent
+        callers (a signal-path close racing a ``with``-block exit):
+        the close lock serializes the drain, and late callers return
+        once the first finished."""
+        with self._close_lock:
+            self._close_locked()
+
+    def _close_locked(self) -> None:
         with self._cond:
+            if self._drained:
+                return
             self._closing = True
             self._cond.notify_all()
+        t0 = time.perf_counter()
         if self._worker is not None:
             self._worker.join()
             self._worker = None
+        if self.session_checkpoint:
+            try:
+                self.write_session_checkpoint(self.session_checkpoint)
+            except Exception as e:  # noqa: BLE001 — a checkpoint
+                # failure must not mask the drain (the in-flight
+                # results were already delivered); record it where
+                # the operator actually looks, not only on a trace
+                # that may not be running
+                print(
+                    "service: session checkpoint write failed: "
+                    f"{type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+                tr = get_tracer()
+                if tr.enabled:
+                    tr.event(
+                        "service-checkpoint-error", cat="service",
+                        error=f"{type(e).__name__}: {e}"[:300],
+                    )
+        self._drained = True
+        met = get_metrics()
+        if met.enabled:
+            met.gauge("service.queue_depth", 0)
+        tr = get_tracer()
+        if tr.enabled:
+            tr.add_span(
+                "service.drain", "service", t0,
+                time.perf_counter() - t0,
+                sessions=len(self._sessions),
+            )
 
     def __enter__(self) -> "SolverService":
         self.start()
@@ -420,29 +624,301 @@ class SolverService:
             n_restarts=n_restarts, timeout=timeout, session=session,
             set_values=dict(set_values) if set_values else None,
             pending=PendingResult(),
+            dcop_src=_dcop_source(dcop),
         )
         met = get_metrics()
         if met.enabled:
             met.inc("service.requests")
+        t_admit = time.perf_counter()
+        shed_reason = None
+        depth = 0
         with self._cond:
             if self._closing:
                 raise ServiceError("service is closed")
-            req.enqueue_t = time.perf_counter()
-            self._queue.append(req)
-            self._cond.notify_all()
+            depth = len(self._queue)
+            shed_reason = self._shed_reason_locked(req.timeout)
+            if shed_reason is None:
+                req.enqueue_t = t_admit
+                self._queue.append(req)
+                self._cond.notify_all()
         with self._stats_lock:
             self._n_requests += 1
+        if shed_reason is not None:
+            self._shed(req, shed_reason, depth, t_admit)
         return req.pending
 
     def solve(self, *args, **kwargs) -> Dict[str, Any]:
         """Blocking convenience: ``submit(...).result()``."""
         return self.submit(*args, **kwargs).result()
 
+    # -- overload control ------------------------------------------------
+
+    def _shed_reason_locked(self, timeout: Optional[float]):
+        """Why this request must be shed at admission, or None.
+
+        Two triggers (docs/serving.md): the bounded queue is full, or
+        the request carries a deadline the service already knows it
+        cannot meet.  ``timeout`` is the request's END-TO-END budget
+        from admission (dispatch hands the remainder to the engine,
+        which truncates at chunk boundaries with terminal
+        ``status="timeout"``); we shed only when the predicted queue
+        WAIT ALONE — full ticks queued ahead of it times the median
+        recent tick duration — already consumes it, i.e. the request would
+        reach dispatch with nothing left.  An idle service therefore
+        never sheds, however tight the budget.  Shedding at admission
+        costs microseconds; admitting a doomed request would cost a
+        dispatch slot and still return nothing."""
+        depth = len(self._queue)
+        if depth >= self.max_queue:
+            return "queue-full"
+        if timeout is not None and self._tick_med:
+            ticks_ahead = depth // self.tick.max_batch
+            if ticks_ahead * self._tick_med >= timeout:
+                return "deadline"
+        return None
+
+    def _shed(
+        self,
+        req: _Request,
+        reason: str,
+        depth: int,
+        t_admit: float,
+    ) -> None:
+        met = get_metrics()
+        tr = get_tracer()
+        latency = time.perf_counter() - t_admit
+        if met.enabled:
+            met.inc("service.shed")
+            met.observe(
+                "service.shed_latency_s", latency,
+                buckets=_LATENCY_BUCKETS,
+            )
+        if tr.enabled:
+            tr.event(
+                "service-shed", cat="service", reason=reason,
+                algo=req.algo, queue_depth=depth,
+            )
+        with self._stats_lock:
+            self._n_shed += 1
+            self._shed_lats.append(latency)
+        req.pending._set_result(
+            {
+                "status": "shed",
+                "shed_reason": reason,
+                "queue_depth": depth,
+                "algo": req.algo,
+            }
+        )
+
+    # -- wire-server hooks (ServiceServer bookkeeping) -------------------
+
+    def note_shed(self, reason: str) -> None:
+        """Record a request shed BEFORE admission (the wire server's
+        per-connection in-flight cap) so overload shows up in one
+        place regardless of which layer refused the work."""
+        met = get_metrics()
+        if met.enabled:
+            met.inc("service.shed")
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event("service-shed", cat="service", reason=reason)
+        with self._stats_lock:
+            self._n_shed += 1
+
+    def note_frame_rejected(self) -> None:
+        met = get_metrics()
+        if met.enabled:
+            met.inc("service.frames_rejected")
+        with self._stats_lock:
+            self._n_frames_rejected += 1
+
+    def note_replayed_reply(self) -> None:
+        met = get_metrics()
+        if met.enabled:
+            met.inc("service.replayed_replies")
+        with self._stats_lock:
+            self._n_replayed_replies += 1
+
     def close_session(self, name: str) -> bool:
         """Drop a pinned session (frees its compiled state); returns
         whether it existed."""
         with self._cond:
             return self._sessions.pop(name, None) is not None
+
+    # -- session checkpoint / restore ------------------------------------
+
+    def write_session_checkpoint(self, path: str) -> Dict[str, Any]:
+        """Serialize every restorable pinned session — the dcop
+        identity (yaml text or path), the ORDERED applied
+        ``set_values`` deltas, and the per-session segment counter —
+        plus a final :meth:`stats` snapshot, atomically, to ``path``
+        (JSON).  ``serve --resume`` replays it so reconnecting
+        sessions' follow-ups stay ``compile.incremental``-only.
+
+        Object-pinned in-process sessions serialize through
+        ``dcop_yaml`` when possible; ones that cannot are listed under
+        ``"skipped"`` instead of failing the drain."""
+        with self._cond:
+            sessions = dict(self._sessions)
+        entries: List[Dict[str, Any]] = []
+        skipped: List[str] = []
+        for name, sess in sessions.items():
+            src = sess.source
+            if src is None:
+                try:
+                    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+                    src = ("yaml", dcop_yaml(sess.dcop))
+                except Exception:  # noqa: BLE001 — not every
+                    # in-process constraint round-trips through yaml
+                    skipped.append(name)
+                    continue
+            entries.append(
+                {
+                    "name": name,
+                    "source": list(src),
+                    "deltas": sess.deltas,
+                    "segments": sess.segments,
+                }
+            )
+        doc = {
+            "kind": "pydcop_tpu-service-sessions",
+            "version": 1,
+            # canonical JSON-safe form: pad_policy may be a PadPolicy
+            # OBJECT (as_pad_policy accepts both) and must still
+            # checkpoint — and compare equal at resume regardless of
+            # which spelling either side used
+            "pad_policy": _pad_policy_doc(self.pad_policy),
+            "sessions": entries,
+            "skipped": skipped,
+            "stats": self.stats(),
+        }
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event(
+                "service-checkpoint", cat="service",
+                sessions=len(entries), skipped=len(skipped),
+            )
+        return doc
+
+    def restore_sessions(self, path: str) -> int:
+        """Replay a drained service's session checkpoint: rebuild each
+        session's dcop, pin a fresh IncrementalCompiler, and re-apply
+        the recorded ``set_values`` deltas IN ORDER — the exact
+        incremental-update arithmetic of the original service, so a
+        reconnecting client's next follow-up lands on bit-identical
+        device tables and costs ``compile.incremental`` only (the
+        replay itself pays the one segment-1 ``compile.full``, at
+        startup, before any request is admitted).  Returns the number
+        of sessions restored."""
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if doc.get("kind") != "pydcop_tpu-service-sessions":
+            raise ServiceError(
+                f"{path} is not a service session checkpoint"
+            )
+        if doc.get("pad_policy") != _pad_policy_doc(self.pad_policy):
+            raise ServiceError(
+                f"checkpoint was written under pad_policy="
+                f"{doc.get('pad_policy')!r}, the service runs "
+                f"{_pad_policy_doc(self.pad_policy)!r} — resumed "
+                "sessions would land in different shape buckets "
+                "(docs/serving.md)"
+            )
+        from pydcop_tpu.engine.incremental import IncrementalCompiler
+
+        restored = 0
+        skipped: List[Tuple[str, str]] = []
+        for entry in doc.get("sessions", ()):
+            # per-entry tolerance, mirroring the write side's
+            # "skipped" list: one stale entry (a cleaned-up yaml
+            # path, a since-invalid dcop) must not abort the whole
+            # resume and lose every OTHER session
+            try:
+                name = str(entry["name"])
+                kind, val = entry["source"]
+                if kind == "yaml":
+                    from pydcop_tpu.dcop.yamldcop import load_dcop
+
+                    dcop = load_dcop(val)
+                    key: Tuple = (
+                        "yaml",
+                        hashlib.sha256(
+                            val.encode("utf-8")
+                        ).hexdigest(),
+                    )
+                elif kind == "path":
+                    from pydcop_tpu.dcop.yamldcop import (
+                        load_dcop_from_file,
+                    )
+
+                    dcop = load_dcop_from_file(val)
+                    st = os.stat(os.path.realpath(val))
+                    key = (
+                        "path", os.path.realpath(val),
+                        st.st_mtime_ns, st.st_size,
+                    )
+                else:
+                    raise ServiceError(
+                        f"unknown source kind {kind!r}"
+                    )
+                compiler = IncrementalCompiler(
+                    dcop, pad_policy=self.pad_policy
+                )
+                sess = _Session(
+                    compiler, dcop, key, source=(kind, val)
+                )
+                ext: Dict[str, Any] = {}
+                compiler.compile({}, ext)  # segment 1 (the one full)
+                for delta in entry.get("deltas", ()):
+                    ext.update(delta)
+                    compiler.compile({}, ext)  # replayed incremental
+                sess.ext_values = ext
+                sess.deltas = [
+                    dict(d) for d in entry.get("deltas", ())
+                ]
+                sess.segments = int(entry.get("segments", 0))
+            except Exception as e:  # noqa: BLE001 — skip, record
+                skipped.append(
+                    (
+                        str(entry.get("name")),
+                        f"{type(e).__name__}: {e}"[:200],
+                    )
+                )
+                continue
+            with self._cond:
+                self._sessions[name] = sess
+            restored += 1
+        if skipped:
+            tr = get_tracer()
+            if tr.enabled:
+                tr.event(
+                    "service-restore-skipped", cat="service",
+                    sessions=[n for n, _ in skipped],
+                    errors=[err for _, err in skipped],
+                )
+        met = get_metrics()
+        if met.enabled and restored:
+            met.inc("service.sessions_restored", restored)
+        with self._stats_lock:
+            self._n_sessions_restored += restored
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event(
+                "service-restore", cat="service", sessions=restored,
+            )
+        return restored
 
     # -- stats -----------------------------------------------------------
 
@@ -454,6 +930,7 @@ class SolverService:
             waits = list(self._queue_waits)
             lats = list(self._latencies)
             occs = [float(o) for o in self._occupancies]
+            shed_lats = list(self._shed_lats)
             out = {
                 "requests": self._n_requests,
                 "ticks": self._n_ticks,
@@ -461,7 +938,13 @@ class SolverService:
                 "coalesced_requests": self._n_coalesced,
                 "pad_instances": self._n_pad_instances,
                 "errors": self._n_errors,
+                "shed": self._n_shed,
+                "frames_rejected": self._n_frames_rejected,
+                "sessions_restored": self._n_sessions_restored,
+                "replayed_replies": self._n_replayed_replies,
                 "sessions": len(self._sessions),
+                "queue_depth": len(self._queue),
+                "drained": self._drained,
             }
         out["coalesce_ratio"] = (
             round(len(lats) and sum(occs) / max(1, len(occs)), 4)
@@ -481,6 +964,13 @@ class SolverService:
         out["batch_occupancy"] = {
             "p50": _percentile(occs, 50),
             "max": max(occs) if occs else 0.0,
+        }
+        # admission-to-reject latency: the overload acceptance wants a
+        # BOUNDED p99 here — shedding must stay cheap under pressure
+        out["shed_latency_s"] = {
+            "p50": _percentile(shed_lats, 50),
+            "p99": _percentile(shed_lats, 99),
+            "max": max(shed_lats) if shed_lats else 0.0,
         }
         return out
 
@@ -577,6 +1067,7 @@ class SolverService:
                         min(len(self._queue), self.tick.max_batch)
                     )
                 ]
+            tick_t0 = time.perf_counter()
             try:
                 self._dispatch_tick(batch)
             except Exception as e:  # noqa: BLE001 — the worker must
@@ -597,6 +1088,14 @@ class SolverService:
                                     f"{type(e).__name__}: {e}"
                                 )
                             )
+            # feed the deadline-aware shed's capacity estimate: how
+            # long a tick of work actually takes right now
+            dur = time.perf_counter() - tick_t0
+            with self._cond:
+                self._tick_durs.append(dur)
+                self._tick_med = _percentile(
+                    list(self._tick_durs), 50
+                )
 
     def _dispatch_tick(self, batch: List[_Request]) -> None:
         from pydcop_tpu.engine.supervisor import supervision
@@ -856,6 +1355,7 @@ class SolverService:
                 ),
                 req.dcop,
                 req.dcop_key,
+                source=req.dcop_src,
             )
             self._sessions[req.session] = sess
             met = get_metrics()
@@ -873,6 +1373,7 @@ class SolverService:
                     "new session, docs/serving.md)"
                 )
             sess.ext_values.update(req.set_values)
+            sess.record_delta(req.set_values)
         problem, _fp = sess.compiler.compile({}, sess.ext_values)
         if problem is None:
             raise ServiceError(
@@ -918,17 +1419,29 @@ def _load_module(algo_name: str):
 # wire protocol: newline-JSON frames (the hostnet control-plane framing)
 # ---------------------------------------------------------------------------
 #
-# request:  {"op": "solve", "id": N, "algo": ..., "dcop": yaml-text |
-#            path, "params": {...}, "rounds": ..., "seed": ...,
+# request:  {"op": "solve", "id": N, "cid": client-id, "ikey":
+#            idempotency-key, "algo": ..., "dcop": yaml-text | path,
+#            "params": {...}, "rounds": ..., "seed": ...,
 #            "session": ..., "set_values": {...}, ...}
 #           {"op": "stats" | "ping" | "close_session" | "shutdown",
 #            "id": N, ...}
 # response: {"id": N, "ok": true, "result"|"stats"|...: ...}
 #           {"id": N, "ok": false, "error": "..."}
 #
-# One request in flight per connection (a client wanting concurrency
-# opens more connections — that is exactly what makes requests
-# coalesce); responses carry the request id regardless.
+# Solve frames PIPELINE: the server admits them asynchronously and
+# replies (tagged with the request id) as results land, up to a
+# per-connection in-flight cap — the wire-level backpressure knob;
+# frames beyond the cap are answered immediately with
+# status="shed".  A malformed or oversized frame gets a structured
+# error reply and the connection STAYS OPEN (framing is
+# newline-delimited, so the stream resyncs at the next newline).
+#
+# Idempotent retries: each solve frame carries an idempotency key
+# ("ikey", stable across resends of the same logical request); the
+# server remembers its last replies in a bounded cache, so a client
+# that lost a connection AFTER the result was computed (the
+# conn_drop chaos kind, or a real peer death) reconnects, resends,
+# and is answered from the cache — never re-solved.
 
 _SOLVE_FIELDS = (
     "rounds", "seed", "chunk_size", "convergence_chunks",
@@ -939,18 +1452,150 @@ _SOLVE_FIELDS = (
 #: orders of magnitude bigger than the answer
 _WIRE_DROP = ("cost_trace", "restart_costs")
 
+#: inbound frame size cap: big enough for any realistic shipped yaml,
+#: small enough that one hostile (or corrupted) line cannot balloon a
+#: handler's memory — oversized frames get a structured error reply
+_MAX_FRAME_BYTES = 32 * 1024 * 1024
+
+#: how long a reply send may block on a slow peer before the
+#: connection is declared dead (send-only: receive stays unbounded so
+#: idle keep-alive connections survive)
+_SEND_TIMEOUT_S = 30
+
+
+def _read_frame(reader):
+    """One inbound frame from a buffered reader.
+
+    Returns ``(msg, None)`` for a valid frame, ``(None, None)`` when
+    the peer closed, and ``(None, error_text)`` for a malformed or
+    oversized frame — the connection stays usable (newline framing
+    resyncs at the next newline; oversized lines are drained first),
+    so one bad frame costs its sender an error reply, not the
+    connection and every pipelined request behind it."""
+    try:
+        line = reader.readline(_MAX_FRAME_BYTES + 1)
+    except (OSError, ValueError):
+        return None, None
+    if not line:
+        return None, None
+    if not line.endswith(b"\n"):
+        if len(line) > _MAX_FRAME_BYTES:
+            # drain the rest of the oversized line so the stream
+            # resyncs on the next newline
+            while True:
+                try:
+                    chunk = reader.readline(_MAX_FRAME_BYTES)
+                except (OSError, ValueError):
+                    return None, None
+                if not chunk or chunk.endswith(b"\n"):
+                    break
+            return None, (
+                f"frame exceeds {_MAX_FRAME_BYTES} bytes"
+            )
+        return None, None  # EOF mid-frame: treat as closed
+    try:
+        msg = json.loads(line.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        return None, f"malformed frame: {e}"
+    if not isinstance(msg, dict):
+        return None, "malformed frame: not a JSON object"
+    return msg, None
+
+
+def _corrupt_payload(payload: bytes) -> bytes:
+    """The frame_corrupt chaos kind: invalid-UTF-8 garbage in place of
+    the payload's head, framing (trailing newline, length) preserved —
+    the receiving side's frame validation must reject it cleanly."""
+    return b"\xff\xfe\xfd\xfc" + payload[4:]
+
+
+#: outbound reply queue bound per connection: a peer that stops
+#: reading while this many computed replies pile up is declared dead
+#: (its results remain in the reply cache for a reconnect)
+_MAX_QUEUED_REPLIES = 64
+
+
+class _ConnState:
+    """Per-connection server bookkeeping: the socket, the client's
+    declared id (``cid``), the chaos scope (fresh per connection so
+    retries re-roll their fault hashes), the per-connection reply
+    sequence the wire fault decisions key on, the in-flight request
+    count the backpressure cap reads, and the outbound reply queue a
+    dedicated per-connection writer thread drains — result delivery
+    (which runs on the tick worker) only ever ENQUEUES, so one
+    stalled peer can never block dispatch for everyone else."""
+
+    __slots__ = (
+        "sock", "cid", "scope", "reply_seq", "flushed", "inflight",
+        "lock", "cond", "outq", "alive",
+    )
+
+    def __init__(self, sock: socket.socket, scope: str) -> None:
+        self.sock = sock
+        self.cid: Optional[str] = None
+        self.scope = scope
+        self.reply_seq = 0
+        self.flushed = 0  # highest reply seq actually sent
+        self.inflight = 0
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.outq: deque = deque()
+        self.alive = True
+
+    def wait_flushed(self, timeout: float) -> None:
+        """Block until every queued reply has been SENT (not just
+        popped) or the connection dies — the ordering guarantee
+        behind shutdown replies and handler teardown."""
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while self.alive and (
+                self.outq or self.flushed < self.reply_seq
+            ):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return
+                self.cond.wait(left)
+
 
 class ServiceServer:
     """TCP front for a :class:`SolverService`: accepts connections,
-    one handler thread per connection, newline-JSON frames."""
+    one handler thread per connection, newline-JSON frames.
+
+    Hardened for the real world (docs/serving.md):
+
+    - solve frames pipeline up to ``max_inflight`` per connection;
+      frames beyond the cap are answered ``status="shed"`` instead of
+      queueing unboundedly behind one socket;
+    - malformed / oversized frames get a structured error reply and
+      the connection survives;
+    - solve replies are cached by idempotency key (bounded LRU,
+      ``reply_cache`` entries), so a retry of a dropped-but-computed
+      response replays the answer instead of re-solving;
+    - the service's seeded :class:`~pydcop_tpu.faults.plan.FaultPlan`
+      wire kinds (``conn_drop``, ``slow_client``, ``frame_corrupt``)
+      inject HERE, in the reply path — the serving loop's chaos seam.
+    """
 
     def __init__(
         self,
         service: SolverService,
         host: str = "127.0.0.1",
         port: int = 0,
+        max_inflight: int = 8,
+        reply_cache: int = 512,
     ):
+        if max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1, got {max_inflight}"
+            )
         self.service = service
+        self.max_inflight = max_inflight
+        plan = service.chaos_plan
+        self._plan = (
+            plan
+            if plan is not None and plan.wire_faults_configured
+            else None
+        )
         self._server = socket.create_server((host, port))
         self.address: Tuple[str, int] = (
             host, self._server.getsockname()[1]
@@ -958,7 +1603,28 @@ class ServiceServer:
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
+        self._states: List[_ConnState] = []  # for close-time flush
         self._lock = threading.Lock()
+        self._conn_counter = 0
+        # per-client-id connection ordinals (chaos scope freshness on
+        # reconnect): bounded LRU like the reply cache — default
+        # client ids are unique per client instance, so a resident
+        # server would otherwise grow one entry per client EVER
+        # served (an evicted cid that returns restarts at ordinal 1,
+        # which only re-bases its fault hashes)
+        self._cid_conns: "OrderedDict[str, int]" = OrderedDict()
+        self._cid_conns_max = 4096
+        self._replies: "OrderedDict[str, Dict[str, Any]]" = (
+            OrderedDict()
+        )
+        self._reply_cache_max = reply_cache
+        # idempotency keys whose solve is STILL RUNNING: a retry that
+        # arrives before the reply cache is populated (client-side
+        # timeout shorter than the solve, a slow_client hold) attaches
+        # to the in-flight PendingResult instead of submitting a
+        # duplicate solve — "never re-solved" covers the in-flight
+        # window, not just completed replies
+        self._inflight_ikeys: Dict[str, PendingResult] = {}
         self._accept = threading.Thread(
             target=self._accept_loop, name="solver-service-accept",
             daemon=True,
@@ -966,9 +1632,17 @@ class ServiceServer:
         self._accept.start()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
-        """Block until :meth:`close` / a ``shutdown`` op (or the
-        timeout); returns True when shut down."""
+        """Block until :meth:`close` / a ``shutdown`` op / a SIGTERM
+        relayed through :meth:`request_shutdown` (or the timeout);
+        returns True when shut down."""
         return self._shutdown.wait(timeout)
+
+    def request_shutdown(self) -> None:
+        """Ask the serve loop to stop (signal-handler safe: only sets
+        an event — the thread blocked in :meth:`wait` does the actual
+        teardown, so the graceful drain never runs inside a signal
+        handler)."""
+        self._shutdown.set()
 
     def close(self) -> None:
         self._shutdown.set()
@@ -978,8 +1652,22 @@ class ServiceServer:
             pass
         with self._lock:
             conns = list(self._conns)
+            states = list(self._states)
             threads = list(self._threads)
+        # flush before destroying: replies computed during a graceful
+        # drain sit in per-connection writer queues — tearing the
+        # sockets down first would silently drop them, breaking the
+        # "finish and deliver" drain contract.  One shared deadline
+        # bounds the whole flush (a genuinely stalled peer forfeits
+        # its tail, as always).
+        deadline = time.monotonic() + 5.0
+        for st in states:
+            st.wait_flushed(max(0.0, deadline - time.monotonic()))
         for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 c.close()
             except OSError:
@@ -1010,34 +1698,113 @@ class ServiceServer:
             t.start()
 
     def _handle(self, conn: socket.socket) -> None:
-        from pydcop_tpu.infrastructure.hostnet import _recv, _send
+        try:
+            # send-only timeout: the writer must not park forever on
+            # a dead peer the TCP stack hasn't noticed; receives stay
+            # unbounded so idle keep-alive connections survive
+            import struct
 
+            conn.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                struct.pack("ll", _SEND_TIMEOUT_S, 0),
+            )
+        except (OSError, AttributeError):
+            pass
+        with self._lock:
+            self._conn_counter += 1
+            idx = self._conn_counter
+        st = _ConnState(conn, scope=f"conn{idx}")
+        with self._lock:
+            self._states.append(st)
+        writer = threading.Thread(
+            target=self._writer_loop, args=(st,),
+            name="solver-service-writer", daemon=True,
+        )
+        writer.start()
         reader = conn.makefile("rb")
         try:
             while not self._shutdown.is_set():
-                try:
-                    msg = _recv(reader)
-                except (OSError, ValueError):
-                    return
-                if msg is None:
-                    return
+                msg, err = _read_frame(reader)
+                if msg is None and err is None:
+                    return  # peer closed
+                if err is not None:
+                    self.service.note_frame_rejected()
+                    if not self._reply(
+                        st,
+                        {
+                            "id": None,
+                            "ok": False,
+                            "error": err,
+                            "frame_rejected": True,
+                        },
+                    ):
+                        return
+                    continue
+                cid = msg.get("cid")
+                if cid is not None and st.cid is None:
+                    st.cid = str(cid)
+                    with self._lock:
+                        k = self._cid_conns.get(st.cid, 0) + 1
+                        self._cid_conns[st.cid] = k
+                        self._cid_conns.move_to_end(st.cid)
+                        while (
+                            len(self._cid_conns)
+                            > self._cid_conns_max
+                        ):
+                            self._cid_conns.popitem(last=False)
+                    # scope = (client id, connection ordinal): fault
+                    # hashes re-roll on reconnect, replay identically
+                    # for the same seed + client behavior
+                    st.scope = f"{st.cid}/{k}"
+                if msg.get("op") == "solve":
+                    self._handle_solve(st, msg)
+                    continue
                 rid = msg.get("id")
-                try:
-                    reply = self._serve_op(msg)
-                except Exception as e:  # noqa: BLE001 — per-request
-                    reply = {
-                        "ok": False,
-                        "error": f"{type(e).__name__}: {e}",
-                    }
+                ikey = msg.get("ikey")
+                cached = None
+                if ikey is not None:
+                    with self._lock:
+                        cached = self._replies.get(ikey)
+                        if cached is not None:
+                            self._replies.move_to_end(ikey)
+                if cached is not None:
+                    # a retried non-solve op whose ack was lost: the
+                    # op already EXECUTED (e.g. close_session popped
+                    # its session) — replay the original reply
+                    # instead of re-executing and reporting a
+                    # different answer
+                    self.service.note_replayed_reply()
+                    reply = dict(cached)
+                else:
+                    try:
+                        reply = self._serve_op(msg)
+                    except Exception as e:  # noqa: BLE001
+                        reply = {
+                            "ok": False,
+                            "error": f"{type(e).__name__}: {e}",
+                        }
+                    if ikey is not None:
+                        self._cache_reply(ikey, reply)
                 reply["id"] = rid
-                try:
-                    _send(conn, reply)
-                except OSError:
+                if not self._reply(st, reply):
                     return
                 if msg.get("op") == "shutdown":
+                    # flush the acknowledgement BEFORE flipping the
+                    # shutdown event: teardown races the writer
+                    # otherwise and the client would see a reset from
+                    # a shutdown that succeeded
+                    st.wait_flushed(5.0)
                     self._shutdown.set()
                     return
         finally:
+            # give the writer a bounded window to flush what is
+            # already queued (a final reply, an error), then signal
+            # it down; on an idle close this is instant
+            st.wait_flushed(5.0)
+            with st.cond:
+                st.alive = False
+                st.cond.notify_all()
+            writer.join(timeout=_SEND_TIMEOUT_S + 1)
             try:
                 reader.close()
                 conn.close()
@@ -1052,9 +1819,289 @@ class ServiceServer:
                 except ValueError:
                     pass
                 try:
+                    self._states.remove(st)
+                except ValueError:
+                    pass
+                try:
                     self._threads.remove(threading.current_thread())
                 except ValueError:
                     pass
+
+    # -- the reply path (where wire chaos injects) -----------------------
+
+    def _reply(self, st: _ConnState, obj: Dict[str, Any]) -> bool:
+        """Enqueue one reply frame for the connection's writer thread;
+        returns False when the connection is gone.  Never blocks on
+        the peer: result delivery runs on the tick worker, and a
+        stalled client must cost only ITS connection, not a tick.  A
+        peer that stops reading while the bounded queue fills is
+        declared dead (the computed results stay in the reply cache
+        for its reconnect).  Serialization happens on the WRITER
+        thread too — a tick of large results must not pay
+        ``json.dumps`` serially on the dispatch hot path."""
+        overflow = False
+        with st.cond:
+            if not st.alive:
+                return False
+            if len(st.outq) >= _MAX_QUEUED_REPLIES:
+                overflow = True
+            else:
+                st.outq.append(obj)
+                st.cond.notify_all()
+        if overflow:
+            # actually disconnect the stalled peer (outside the cond:
+            # _drop_conn re-acquires it) — just marking it dead would
+            # leave the handler parked in readline forever and the
+            # peer never learning it was dropped
+            self._drop_conn(st)
+            return False
+        return True
+
+    def _writer_loop(self, st: _ConnState) -> None:
+        """Drain one connection's reply queue onto its socket.  The
+        seeded wire fault kinds act here, in dequeue order (single
+        writer, so the per-connection reply sequence the decisions
+        key on is deterministic): ``slow_client`` holds the frame,
+        ``frame_corrupt`` mangles its bytes (framing intact),
+        ``conn_drop`` closes the socket instead of sending — the
+        computed result stays in the reply cache for the client's
+        idempotent retry."""
+        plan = self._plan
+        while True:
+            with st.cond:
+                while st.alive and not st.outq:
+                    st.cond.wait()
+                if not st.outq:
+                    return  # closed and drained
+                obj = st.outq.popleft()
+                st.reply_seq += 1
+                seq = st.reply_seq
+                # a closing handler may be waiting for the drain
+                st.cond.notify_all()
+            payload = (json.dumps(obj) + "\n").encode("utf-8")
+            if plan is not None:
+                w = plan.wire
+                if w.slow_client:
+                    self._fault("slow_client", st.scope, seq, plan)
+                    time.sleep(w.slow_client)
+                if plan.decide_conn_drop(st.scope, seq):
+                    self._fault("conn_drop", st.scope, seq, plan)
+                    self._drop_conn(st)
+                    return
+                if plan.decide_frame_corrupt(st.scope, seq):
+                    self._fault("frame_corrupt", st.scope, seq, plan)
+                    payload = _corrupt_payload(payload)
+            try:
+                st.sock.sendall(payload)
+            except (OSError, ValueError):
+                with st.cond:
+                    st.alive = False
+                    st.cond.notify_all()
+                return
+            with st.cond:
+                st.flushed = seq
+                st.cond.notify_all()
+
+    @staticmethod
+    def _drop_conn(st: _ConnState) -> None:
+        with st.cond:
+            st.alive = False
+            st.cond.notify_all()  # wake the writer so it exits
+        try:
+            # shutdown, not just close: the handler's makefile reader
+            # still references the fd, so close() alone would leave
+            # the wire open and neither end would ever see the drop
+            st.sock.shutdown(socket.SHUT_RDWR)
+            st.sock.close()
+        except OSError:
+            pass
+
+    @staticmethod
+    def _fault(kind: str, scope: str, seq: int, plan) -> None:
+        met = get_metrics()
+        tr = get_tracer()
+        if met.enabled:
+            met.inc(f"fault.{kind}")
+        if tr.enabled:
+            # conn=, not link=: trace-summary derives per-AGENT rows
+            # from `link` args, and a connection scope is not an
+            # agent — thousands of reconnecting clients would
+            # otherwise drown the agent table in fake rows
+            tr.event(
+                kind, cat="fault", conn=scope, seq=seq,
+                seed=plan.seed,
+            )
+
+    # -- ops -------------------------------------------------------------
+
+    def _cache_reply(self, ikey: str, reply: Dict[str, Any]) -> None:
+        """The one bounded-LRU reply-cache insert (solve and non-solve
+        paths share it, so idempotency semantics cannot drift between
+        them)."""
+        with self._lock:
+            self._replies[ikey] = dict(reply)
+            self._replies.move_to_end(ikey)
+            while len(self._replies) > self._reply_cache_max:
+                self._replies.popitem(last=False)
+
+    def _handle_solve(self, st: _ConnState, msg: Dict[str, Any]) -> None:
+        rid = msg.get("id")
+        ikey = msg.get("ikey")
+        cached = None
+        pending: Optional[PendingResult] = None
+        if ikey is not None:
+            # ONE lock acquisition over both lookups: deliver's
+            # critical section (cache insert + in-flight unregister)
+            # is atomic under the same lock, so a retry racing the
+            # original's completion hits exactly one of the two —
+            # checking them separately would leave a window where it
+            # misses both and re-solves
+            with self._lock:
+                cached = self._replies.get(ikey)
+                if cached is not None:
+                    self._replies.move_to_end(ikey)
+                else:
+                    pending = self._inflight_ikeys.get(ikey)
+        if cached is not None:
+            # a retry of a computed-but-lost response: answer from
+            # the bounded reply cache, never re-solve
+            self.service.note_replayed_reply()
+            self._reply(st, {**cached, "id": rid})
+            return
+        with st.lock:
+            over_cap = st.inflight >= self.max_inflight
+            if not over_cap:
+                st.inflight += 1
+        if over_cap:
+            # per-connection backpressure: a pipelining client past
+            # its cap is shed immediately, not queued unboundedly
+            self.service.note_shed("inflight-cap")
+            self._reply(
+                st,
+                {
+                    "id": rid,
+                    "ok": True,
+                    "result": {
+                        "status": "shed",
+                        # machine-readable token, like queue-full /
+                        # deadline — clients dispatch on it
+                        "shed_reason": "inflight-cap",
+                        "max_inflight": self.max_inflight,
+                    },
+                },
+            )
+            return
+        # a retry racing its original: the first frame's solve is
+        # still in flight — attach this connection to it instead of
+        # submitting a duplicate ("never re-solved" must cover the
+        # in-flight window too, or a client timeout shorter than the
+        # solve would burn a dispatch slot per retry and re-apply
+        # session deltas)
+        if pending is not None:
+            self.service.note_replayed_reply()
+        else:
+            placeholder: Optional[PendingResult] = None
+            if ikey is not None:
+                # register a placeholder BEFORE submit: admission
+                # itself can be slow (parsing a shipped yaml), and a
+                # retry landing during it must attach here instead of
+                # double-submitting.  The re-check closes the race
+                # with another handler doing the same.
+                placeholder = PendingResult()
+                with self._lock:
+                    existing = self._inflight_ikeys.get(ikey)
+                    if existing is not None:
+                        pending = existing
+                        placeholder = None
+                    else:
+                        self._inflight_ikeys[ikey] = placeholder
+            if pending is not None:
+                self.service.note_replayed_reply()
+            else:
+                kwargs = {
+                    k: msg[k]
+                    for k in _SOLVE_FIELDS
+                    if msg.get(k) is not None
+                }
+                try:
+                    real = self.service.submit(
+                        msg.get("dcop"),
+                        msg.get("algo"),
+                        msg.get("params") or None,
+                        **kwargs,
+                    )
+                except Exception as e:  # noqa: BLE001 — per-request
+                    if placeholder is not None:
+                        # resolve attached retries with the SAME
+                        # validation error, then unregister (errors
+                        # are cheap to recompute, so no cache entry)
+                        placeholder._set_error(
+                            ServiceError(
+                                f"{type(e).__name__}: {e}"
+                            )
+                        )
+                        with self._lock:
+                            if (
+                                self._inflight_ikeys.get(ikey)
+                                is placeholder
+                            ):
+                                del self._inflight_ikeys[ikey]
+                    with st.lock:
+                        st.inflight -= 1
+                    self._reply(
+                        st,
+                        {
+                            "id": rid,
+                            "ok": False,
+                            "error": f"{type(e).__name__}: {e}",
+                        },
+                    )
+                    return
+                if placeholder is not None:
+                    # the placeholder IS the canonical in-flight
+                    # handle: mirror the real result into it
+                    ph = placeholder
+
+                    def _mirror(p: PendingResult) -> None:
+                        if p._error is not None:
+                            ph._set_error(p._error)
+                        else:
+                            ph._set_result(p._result)
+
+                    real.add_done_callback(_mirror)
+                    pending = ph
+                else:
+                    pending = real
+
+        def deliver(p: PendingResult) -> None:
+            with st.lock:
+                st.inflight -= 1
+            try:
+                result = p.result(0)
+                reply = {
+                    "ok": True,
+                    "result": {
+                        k: v
+                        for k, v in result.items()
+                        if k not in _WIRE_DROP
+                    },
+                }
+            except Exception as e:  # noqa: BLE001 — the error IS
+                # the reply
+                reply = {
+                    "ok": False,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+            if ikey is not None:
+                self._cache_reply(ikey, reply)
+                with self._lock:
+                    # cache first, THEN unregister: a retry arriving
+                    # in between sees the cached reply
+                    if self._inflight_ikeys.get(ikey) is p:
+                        del self._inflight_ikeys[ikey]
+            self._reply(st, {**reply, "id": rid})
+
+        pending.add_done_callback(deliver)
 
     def _serve_op(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         op = msg.get("op")
@@ -1071,21 +2118,20 @@ class ServiceServer:
             }
         if op == "shutdown":
             return {"ok": True, "stopping": True}
-        if op == "solve":
-            kwargs = {
-                k: msg[k] for k in _SOLVE_FIELDS if msg.get(k) is not None
-            }
-            result = self.service.solve(
-                msg.get("dcop"),
-                msg.get("algo"),
-                msg.get("params") or None,
-                **kwargs,
-            )
-            result = {
-                k: v for k, v in result.items() if k not in _WIRE_DROP
-            }
-            return {"ok": True, "result": result}
         raise ServiceError(f"unknown op {op!r}")
+
+
+#: per-process ordinal for default client ids (unique within the
+#: process; combined with the pid for cross-process uniqueness)
+_CLIENT_ORDINAL = [0]
+_CLIENT_ORDINAL_LOCK = threading.Lock()
+
+
+def _next_client_id() -> str:
+    with _CLIENT_ORDINAL_LOCK:
+        _CLIENT_ORDINAL[0] += 1
+        n = _CLIENT_ORDINAL[0]
+    return f"c{os.getpid():x}-{n}"
 
 
 class ServiceClient:
@@ -1097,38 +2143,184 @@ class ServiceClient:
     coalesces.  ``dcop`` arguments that name an existing file are
     read and shipped as yaml text, so the server needs no shared
     filesystem.
+
+    Resilient by default: a dropped connection or a corrupt reply
+    frame triggers keyed-backoff reconnect (``utils/backoff.py`` —
+    jitter is a pure hash of ``(client id, attempt)``, so chaos
+    replays reproduce retry timing) for up to ``retry_window``
+    seconds, resending the SAME frame.  Every solve frame carries an
+    idempotency key, so a retry of a request whose response was
+    computed-but-lost is answered from the server's reply cache,
+    never re-solved.  ``retry_window=0`` disables retries (failures
+    surface as :class:`ServiceError` immediately).  Retries are
+    counted on ``service.client_retries``.
     """
 
     def __init__(
         self,
         address: Union[str, Tuple[str, int]],
         timeout: Optional[float] = None,
+        *,
+        client_id: Optional[str] = None,
+        retry_window: float = 5.0,
+        backoff_seed: int = 0,
     ):
         if isinstance(address, str):
             host, _, port = address.rpartition(":")
             address = (host or "127.0.0.1", int(port))
-        self._sock = socket.create_connection(
-            address, timeout=timeout
-        )
-        self._reader = self._sock.makefile("rb")
+        self._address: Tuple[str, int] = address
+        self._timeout = timeout
+        self.client_id = client_id or _next_client_id()
+        self.retry_window = retry_window
+        self._backoff_seed = backoff_seed
         self._lock = threading.Lock()
         self._next_id = 0
+        self._closed = False
+        # idempotency keys must be unique per logical request across
+        # client LIFETIMES, not just within one: request ids restart
+        # at 1 per instance, so a reused client_id (explicit, or a
+        # recycled pid in the default) would otherwise collide with a
+        # previous life's keys and replay ITS cached replies.  The
+        # nonce scopes the keys to this instance; chaos scopes key on
+        # client_id alone, so determinism is untouched.
+        self._ikey_nonce = os.urandom(4).hex()
+        self._sock: Optional[socket.socket] = None
+        self._reader = None
+        self._connect()  # fail fast on a dead address
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            self._address, timeout=self._timeout
+        )
+        self._reader = self._sock.makefile("rb")
+
+    def _teardown(self) -> None:
+        try:
+            if self._reader is not None:
+                self._reader.close()
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._reader = None
+        self._sock = None
+
+    def _recv_checked(self, reader) -> Dict[str, Any]:
+        """One reply frame through the SAME validation the server
+        applies inbound (:func:`_read_frame` — one definition of the
+        framing rules, not two drifting copies), raising instead of
+        returning error tuples: a closed stream raises
+        ConnectionError, an oversized / truncated / non-JSON /
+        non-object frame raises ValueError.  Both are retryable (the
+        frame_corrupt chaos kind lands here)."""
+        reply, err = _read_frame(reader)
+        if err is not None:
+            if err.startswith("frame exceeds"):
+                # unlike a chaos-corrupted frame, an OVERSIZED reply
+                # is deterministic — every retry replays the same
+                # frame from the server's cache — so retrying can
+                # only burn the window before failing anyway
+                raise ServiceError(f"reply {err}")
+            raise ValueError(err)
+        if reply is None:
+            raise ConnectionError(
+                "service connection closed mid-request"
+            )
+        return reply
+
+    def _attempt(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        if self._closed:
+            # close() must WIN against an in-flight call's retry
+            # loop — reconnecting a client the user just closed would
+            # resurrect the socket for up to retry_window seconds
+            raise ServiceError("client is closed")
+        if self._sock is None:
+            self._connect()
+        # locals, not self._sock/_reader: a concurrent close() nulls
+        # the attributes mid-attempt, and None.sendall would escape
+        # the (OSError, ValueError) retry contract as AttributeError
+        # — the closed file objects raise the RIGHT exceptions, and
+        # the retry's _closed check then aborts cleanly
+        sock, reader = self._sock, self._reader
+        try:
+            from pydcop_tpu.infrastructure.hostnet import _send
+
+            _send(sock, frame)
+            while True:
+                reply = self._recv_checked(reader)
+                if reply.get("id") == frame["id"]:
+                    return reply
+                if (
+                    reply.get("id") is None
+                    and reply.get("frame_rejected")
+                ):
+                    # the server rejected OUR frame (malformed /
+                    # oversized) — with one request in flight per
+                    # connection it unambiguously belongs to this
+                    # request, and resending the same frame can only
+                    # be rejected again: surface, don't retry
+                    raise ServiceError(
+                        "server rejected the request frame: "
+                        f"{reply.get('error')}"
+                    )
+        except (OSError, ValueError):
+            # leave no half-read stream behind: the retry reconnects
+            self._teardown()
+            raise
 
     def _call(self, op: str, **fields) -> Dict[str, Any]:
-        from pydcop_tpu.infrastructure.hostnet import _recv, _send
-
         with self._lock:
             self._next_id += 1
             rid = self._next_id
-            _send(self._sock, {"op": op, "id": rid, **fields})
-            while True:
-                reply = _recv(self._reader)
-                if reply is None:
-                    raise ServiceError(
-                        "service connection closed mid-request"
+            frame = {
+                "op": op, "id": rid, "cid": self.client_id, **fields,
+            }
+            if op not in ("ping", "stats"):
+                # stable across resends of this frame — the server's
+                # reply-cache dedupe key.  Solves AND state-mutating
+                # ops (close_session) need it: a retried
+                # close_session whose ack was lost would otherwise
+                # re-execute and report closed=False for a session
+                # that WAS closed.  ping/stats are read-only and
+                # should return fresh data on retry.
+                frame["ikey"] = (
+                    f"{self.client_id}:{self._ikey_nonce}:{rid}"
+                )
+            if self.retry_window <= 0:
+                try:
+                    reply = self._attempt(frame)
+                except (OSError, ValueError) as e:
+                    raise ServiceTransportError(
+                        f"service request failed: "
+                        f"{type(e).__name__}: {e}"
+                    ) from e
+            else:
+                from pydcop_tpu.utils.backoff import call_with_backoff
+
+                met = get_metrics()
+
+                def _note_retry(attempt: int, error: BaseException):
+                    if met.enabled:
+                        met.inc("service.client_retries")
+
+                try:
+                    reply = call_with_backoff(
+                        lambda: self._attempt(frame),
+                        retry_for=self.retry_window,
+                        exceptions=(OSError, ValueError),
+                        base=0.05,
+                        max_delay=1.0,
+                        key=f"service-client/{self.client_id}",
+                        seed=self._backoff_seed,
+                        on_retry=_note_retry,
+                        giving_up=lambda: self._closed,
                     )
-                if reply.get("id") == rid:
-                    break
+                except (OSError, ValueError) as e:
+                    raise ServiceTransportError(
+                        f"service request failed after "
+                        f"{self.retry_window}s of retries: "
+                        f"{type(e).__name__}: {e}"
+                    ) from e
         if not reply.get("ok"):
             raise ServiceError(
                 reply.get("error", "service request failed")
@@ -1177,15 +2369,24 @@ class ServiceClient:
         )
 
     def shutdown(self) -> None:
-        """Ask the server process to stop serving."""
-        self._call("shutdown")
+        """Ask the server process to stop serving.  Best-effort by
+        nature: a transport failure counts as success, because a
+        shutdown that WORKED kills the address the retry loop would
+        need — the idempotent resend (shutdown frames do carry a key)
+        can only bang on a dead listener until the window expires and
+        surface a spurious error.  Only a structured server-side
+        refusal raises."""
+        try:
+            self._call("shutdown")
+        except ServiceTransportError:
+            pass
 
     def close(self) -> None:
-        try:
-            self._reader.close()
-            self._sock.close()
-        except OSError:
-            pass
+        # flag first, no lock: an in-flight call holds the client
+        # lock for its whole request — close() aborts its retry loop
+        # via the flag/giving_up instead of waiting it out
+        self._closed = True
+        self._teardown()
 
     def __enter__(self) -> "ServiceClient":
         return self

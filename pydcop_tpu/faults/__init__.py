@@ -20,6 +20,12 @@ harness:
   (:mod:`pydcop_tpu.engine.supervisor`) so the batched engine's
   recovery paths — transient retry, OOM chunk-halving and group
   splits, per-instance NaN quarantine — are exercised on demand.
+- **Wire-level fault kinds** (``conn_drop``, ``slow_client``,
+  ``frame_corrupt``) extend it to the serving boundary: they are
+  injected in the solver service's frame loop
+  (:mod:`pydcop_tpu.engine.service`) so the client's idempotent
+  reconnect/retry path and the server's reply cache are exercised on
+  demand (``pydcop_tpu serve --chaos``, ``docs/serving.md``).
 
 Wired through ``--chaos SPEC --chaos_seed N`` on the ``solve``,
 ``run``, ``agent`` and ``orchestrator`` commands and through
@@ -34,6 +40,7 @@ from pydcop_tpu.faults.plan import (
     FaultSpecError,
     LinkFaults,
     Partition,
+    WireFaults,
 )
 
 __all__ = [
@@ -43,4 +50,5 @@ __all__ = [
     "FaultSpecError",
     "LinkFaults",
     "Partition",
+    "WireFaults",
 ]

@@ -51,6 +51,27 @@ supervised-dispatch seam of ``engine/supervisor.py``, same
   poison an instance's carry with NaN with probability ``P`` (hashed
   on ``(seed, instance, boundary seq)``); ``:I`` restricts the
   injection to stack lane ``I`` of a ``solve_many`` group.
+
+Wire-level fault kinds (the serving boundary; injected in the solver
+service's frame loop, ``engine/service.py`` ``ServiceServer`` — same
+``--chaos SPEC --chaos_seed N`` contract):
+
+- ``conn_drop=P`` / ``conn_drop=P:AFTER`` — after computing a reply,
+  close the connection WITHOUT sending it with probability ``P``
+  (hashed on ``(seed, connection scope, per-connection reply seq)``);
+  with ``:AFTER``, the first ``AFTER`` replies of every connection are
+  exempt.  A reconnecting client re-rolls (its new connection carries
+  a fresh scope), so ``P < 1`` retries eventually get through — and an
+  idempotency-keyed retry of a dropped-but-computed response is
+  answered from the server's reply cache, never re-solved.
+- ``slow_client=W`` — hold every reply ``W`` seconds before sending
+  (the scripted slow-draining client, for exercising backpressure and
+  client-side timeouts).
+- ``frame_corrupt=P`` / ``frame_corrupt=P:AFTER`` — corrupt the bytes
+  of a reply frame (framing preserved, payload garbage) with
+  probability ``P``, same hashing/exemption contract as ``conn_drop``;
+  the client's frame validation rejects it and takes the reconnect
+  path.
 """
 
 from __future__ import annotations
@@ -132,6 +153,32 @@ class DeviceFaults:
         )
 
 
+@dataclass(frozen=True)
+class WireFaults:
+    """Wire-level fault injection parameters (all default off).
+
+    ``conn_drop`` / ``frame_corrupt`` are per-reply probabilities
+    hashed on ``(seed, connection scope, per-connection reply seq)``;
+    their ``*_after`` fields exempt the first N replies of every
+    connection (the deterministic "work, then fail" schedule —
+    mirrors ``DeviceFaults.transient_after``).  ``slow_client`` delays
+    every reply by that many seconds."""
+
+    conn_drop: float = 0.0
+    conn_drop_after: int = 0
+    slow_client: float = 0.0
+    frame_corrupt: float = 0.0
+    frame_corrupt_after: int = 0
+
+    @property
+    def configured(self) -> bool:
+        return (
+            self.conn_drop > 0.0
+            or self.slow_client > 0.0
+            or self.frame_corrupt > 0.0
+        )
+
+
 class Decision(NamedTuple):
     """The fate of one message (at most one fault fires per message —
     drop wins over dup over reorder over delay)."""
@@ -167,6 +214,7 @@ class FaultPlan:
     partitions: List[Partition] = field(default_factory=list)
     crashes: Dict[str, float] = field(default_factory=dict)
     device: DeviceFaults = field(default_factory=DeviceFaults)
+    wire: WireFaults = field(default_factory=WireFaults)
     spec: Optional[str] = None  # the source text, for run metadata
 
     # -- construction ---------------------------------------------------
@@ -178,6 +226,7 @@ class FaultPlan:
         overrides: Dict[Tuple[str, str], Dict[str, float]] = {}
         defaults: Dict[str, float] = {}
         device_fields: Dict[str, object] = {}
+        wire_fields: Dict[str, object] = {}
         for raw in spec.split(","):
             clause = raw.strip()
             if not clause:
@@ -195,6 +244,14 @@ class FaultPlan:
                 key, val = clause.split("=", 1)
                 device_fields.update(
                     _parse_device_value(key, val, clause)
+                )
+                continue
+            if clause.startswith(
+                ("conn_drop=", "slow_client=", "frame_corrupt=")
+            ):
+                key, val = clause.split("=", 1)
+                wire_fields.update(
+                    _parse_wire_value(key, val, clause)
                 )
                 continue
             m = _CLAUSE.match(clause)
@@ -216,6 +273,8 @@ class FaultPlan:
             plan.links[lk] = replace(plan.default, **fields)
         if device_fields:
             plan.device = DeviceFaults(**device_fields)
+        if wire_fields:
+            plan.wire = WireFaults(**wire_fields)
         plan.validate()
         return plan
 
@@ -262,6 +321,25 @@ class FaultPlan:
                 f"chaos spec: device_transient AFTER="
                 f"{d.transient_after} must be >= 0"
             )
+        w = self.wire
+        for name in ("conn_drop", "frame_corrupt"):
+            p = getattr(w, name)
+            if not 0.0 <= p <= 1.0:
+                raise FaultSpecError(
+                    f"chaos spec: wire {name} probability {p} "
+                    "outside [0, 1]"
+                )
+        for name in ("conn_drop_after", "frame_corrupt_after"):
+            v = getattr(w, name)
+            if v < 0:
+                raise FaultSpecError(
+                    f"chaos spec: wire {name}={v} must be >= 0"
+                )
+        if w.slow_client < 0:
+            raise FaultSpecError(
+                f"chaos spec: slow_client={w.slow_client}s must be "
+                ">= 0"
+            )
 
     def referenced_agents(self) -> set:
         """Every agent name the plan targets (crash schedules,
@@ -296,6 +374,14 @@ class FaultPlan:
         """True when any device-layer fault kind (``device_oom``,
         ``device_transient``, ``nan_inject``) is configured."""
         return self.device.configured
+
+    @property
+    def wire_faults_configured(self) -> bool:
+        """True when any wire-level fault kind (``conn_drop``,
+        ``slow_client``, ``frame_corrupt``) is configured — these
+        inject at the solver service's frame loop
+        (``engine/service.py``), nowhere else."""
+        return self.wire.configured
 
     # -- queries (all pure) ---------------------------------------------
 
@@ -383,6 +469,34 @@ class FaultPlan:
             _u(self.seed, f"lane{instance}", seq, "nan_inject") < d.nan
         )
 
+    # -- wire-level queries (all pure, engine/service.py frame loop) -----
+
+    def decide_conn_drop(self, scope: str, seq: int) -> bool:
+        """Whether reply number ``seq`` (1-based, per connection) of
+        connection ``scope`` is dropped — computed but never sent, the
+        connection closed.  Pure in ``(seed, scope, seq)``; a
+        reconnect's scope is fresh, so probabilities < 1 let a retry
+        through eventually."""
+        w = self.wire
+        if not w.conn_drop or seq <= w.conn_drop_after:
+            return False
+        if w.conn_drop >= 1.0:
+            return True
+        return _u(self.seed, scope, seq, "conn_drop") < w.conn_drop
+
+    def decide_frame_corrupt(self, scope: str, seq: int) -> bool:
+        """Whether reply number ``seq`` of connection ``scope`` has
+        its frame bytes corrupted.  Same contract as
+        :meth:`decide_conn_drop`."""
+        w = self.wire
+        if not w.frame_corrupt or seq <= w.frame_corrupt_after:
+            return False
+        if w.frame_corrupt >= 1.0:
+            return True
+        return (
+            _u(self.seed, scope, seq, "frame_corrupt") < w.frame_corrupt
+        )
+
     def to_meta(self) -> Dict[str, object]:
         """The replay record for run metadata: spec + seed reconstruct
         the plan exactly (``FaultPlan.from_spec(spec, seed)``)."""
@@ -438,6 +552,30 @@ def _parse_device_value(
             f"chaos spec: bad number in clause {clause!r} (expected "
             "device_oom=W[:R], device_transient=P[:AFTER] or "
             "nan_inject=P[:INSTANCE])"
+        ) from None
+
+
+def _parse_wire_value(
+    key: str, val: str, clause: str
+) -> Dict[str, object]:
+    """Parse one wire-level clause into :class:`WireFaults` fields
+    (``conn_drop=P[:AFTER]``, ``slow_client=W``,
+    ``frame_corrupt=P[:AFTER]`` — module docstring)."""
+    head, _, tail = val.partition(":")
+    try:
+        if key == "slow_client":
+            if tail:
+                raise ValueError("slow_client takes one value")
+            return {"slow_client": float(head)}
+        out: Dict[str, object] = {key: float(head)}
+        if tail:
+            out[f"{key}_after"] = int(tail)
+        return out
+    except ValueError:
+        raise FaultSpecError(
+            f"chaos spec: bad number in clause {clause!r} (expected "
+            "conn_drop=P[:AFTER], slow_client=W or "
+            "frame_corrupt=P[:AFTER])"
         ) from None
 
 

@@ -187,6 +187,26 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
     if svc_waits or svc_lats or svc_occs:
         out["service"] = _service_summary(svc_waits, svc_lats, svc_occs)
+    # serving hardening rows (docs/serving.md): shed / client-retry /
+    # restore / frame-rejection counters and the final drain span — a
+    # trace where ONLY these fired (e.g. a pure-overload run) still
+    # gets a service block
+    counters = metrics.get("counters") or {}
+    svc_extra: Dict[str, Any] = {}
+    for counter, label in (
+        ("service.shed", "shed"),
+        ("service.client_retries", "client_retries"),
+        ("service.sessions_restored", "sessions_restored"),
+        ("service.frames_rejected", "frames_rejected"),
+        ("service.replayed_replies", "replayed_replies"),
+    ):
+        if counter in counters:
+            svc_extra[label] = counters[counter]
+    drain = phases.get("service.drain")
+    if drain:
+        svc_extra["drain_s"] = round(drain["total_s"], 6)
+    if svc_extra:
+        out.setdefault("service", {}).update(svc_extra)
     if semirings:
         for rec in semirings.values():
             rec["total_s"] = round(rec["total_s"], 6)
@@ -257,6 +277,21 @@ def format_summary(s: Dict[str, Any]) -> str:
                         for q in ("p50", "p90", "p99", "max")
                     )
                 )
+        # hardening rows: overload shedding, idempotent client
+        # retries, drain/restore lifecycle, rejected frames
+        hard = [
+            (label, svc[label])
+            for label in (
+                "shed", "client_retries", "sessions_restored",
+                "replayed_replies", "frames_rejected", "drain_s",
+            )
+            if label in svc
+        ]
+        if hard:
+            lines.append(
+                "  "
+                + "  ".join(f"{k}={v}" for k, v in hard)
+            )
     sem = s.get("semiring")
     if sem:
         lines.append("")

@@ -86,6 +86,7 @@ def call_with_backoff(
     clock: Callable[[], float] = time.monotonic,
     sleep: Callable[[float], None] = time.sleep,
     giving_up: Optional[Callable[[], bool]] = None,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
 ) -> T:
     """Call ``fn`` until it succeeds or ``retry_for`` seconds elapse.
 
@@ -97,17 +98,26 @@ def call_with_backoff(
     waiting on.  Sleeps never overshoot the deadline: the final attempt
     happens AT the deadline, not ``max_delay`` past it.  ``key``
     selects the keyed deterministic jitter (module docstring).
+    ``on_retry(attempt, error)`` fires once per retry, after the
+    decision to keep going and before the sleep — the seam callers use
+    to count retries (e.g. the service client's
+    ``service.client_retries``) without wrapping ``fn``.
     """
     deadline = clock() + retry_for
-    for delay in backoff_delays(
-        base=base, factor=factor, max_delay=max_delay, jitter=jitter,
-        seed=seed, key=key,
+    for attempt, delay in enumerate(
+        backoff_delays(
+            base=base, factor=factor, max_delay=max_delay,
+            jitter=jitter, seed=seed, key=key,
+        ),
+        start=1,
     ):
         try:
             return fn()
-        except exceptions:
+        except exceptions as e:
             remaining = deadline - clock()
             if remaining <= 0 or (giving_up is not None and giving_up()):
                 raise
+            if on_retry is not None:
+                on_retry(attempt, e)
             sleep(min(delay, remaining))
     raise AssertionError("unreachable")  # pragma: no cover

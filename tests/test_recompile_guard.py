@@ -120,6 +120,25 @@ def test_service_guard_steady_state_zero_compiles():
     ), report
 
 
+@pytest.mark.service
+def test_restore_guard_zero_recompiles_after_resume():
+    """The drain/restore acceptance criterion: a drained service's
+    session checkpoint, resumed by a fresh service, replays the
+    set_values deltas at startup (exactly ONE compile.full) and the
+    session's next follow-up is compile.incremental-only — zero full
+    recompiles, zero XLA compiles — bit-identical to the same
+    follow-up on an undisturbed service.  See
+    tools/recompile_guard.py:run_restore_guard."""
+    guard = _load_guard()
+    report = guard.run_restore_guard()
+    assert report["ok"], report
+    assert report["sessions_restored"] == 1, report
+    assert report["restore_fulls"] == 1, report
+    assert report["followup_fulls"] == 0, report
+    assert report["followup_incrementals"] >= 1, report
+    assert report["followup_jit_compiles"] == 0, report
+
+
 @pytest.mark.semiring
 def test_semiring_guard_swap_reuses_buckets():
     """Swapping the semiring on the same problem bucket reuses the

@@ -22,10 +22,12 @@ or via the tier-1 suite: ``tests/test_recompile_guard.py`` imports
 (cross-instance vmap batching), :func:`run_dpop_guard`
 (level-batched DPOP through ``solve_many``),
 :func:`run_supervisor_guard` (supervised recovery: zero-compile
-transient retries, bounded-compile OOM group splits) and
+transient retries, bounded-compile OOM group splits),
 :func:`run_semiring_guard` (semiring swaps reuse the level-pack
 bucketing: one executable per semiring per bucket, zero on repeat)
-directly.
+and :func:`run_restore_guard` (drain -> restart -> session follow-up:
+zero full recompiles, zero XLA compiles, bit-identical to an
+undisturbed service) directly.
 
 ``BUDGET`` is the recorded compile count of the canned scenario: one
 chunk-runner compile in segment 1, zero afterwards.  Raise it only
@@ -83,6 +85,20 @@ SERVICE_WAVE_K = 8
 SERVICE_WAVES = 3
 SERVICE_BUDGET = 2
 SERVICE_ROUNDS = 48
+
+# drain/restore (engine/service.py session checkpoints): a drained
+# service writes its pinned sessions (dcop identity + the ORDERED
+# applied set_values deltas); a restarted `serve --resume` replays the
+# deltas through the IncrementalCompiler at startup — paying exactly
+# ONE compile.full (segment 1 of the replay) — after which a
+# reconnecting session's follow-up must cost compile.incremental
+# ONLY: zero full recompiles and zero XLA compiles (the runner cache
+# in-process, the persistent XLA cache across processes), with the
+# result bit-identical to the same follow-up on an undisturbed
+# service.  Extra full compiles = the delta replay regressed to
+# rebuild-per-segment; extra XLA compiles = the restored problem
+# landed outside its original shape bucket.
+RESTORE_ROUNDS = 48
 
 # level-batched DPOP through solve_many: K same-bucket SECP instances
 # merge their UTIL phases into one level-synchronous sweep, and each
@@ -534,6 +550,146 @@ def run_service_guard() -> dict:
     return report
 
 
+_RESTORE_YAML = """name: restore-guard
+objective: min
+domains:
+  colors: {values: [0, 1, 2]}
+variables:
+  v0: {domain: colors}
+  v1: {domain: colors}
+  v2: {domain: colors}
+  v3: {domain: colors}
+external_variables:
+  sensor: {domain: colors, initial_value: 0}
+constraints:
+  c0: {type: intention, function: '1 if v0 == v1 else 0'}
+  c1: {type: intention, function: '1 if v1 == v2 else 0'}
+  c2: {type: intention, function: '1 if v2 == v3 else 0'}
+  track: {type: intention, function: '0 if v0 == sensor else 1'}
+agents: [a1]
+"""
+
+
+def run_restore_guard() -> dict:
+    """Compile + parity budget for the drain/restore lifecycle
+    (``engine/service.py`` session checkpoints, ``docs/serving.md``):
+    a session that ran two segments (pin + one ``set_values`` delta)
+    is drained to a checkpoint; a NEW service resumes it, which may
+    pay exactly ONE ``compile.full`` (the replayed segment 1); the
+    session's next follow-up must then be ``compile.incremental``-only
+    — zero full recompiles, zero XLA compiles — and bit-identical
+    (cost, assignment, cost trace) to the same follow-up on an
+    undisturbed service that never restarted."""
+    import tempfile
+
+    from pydcop_tpu.engine.service import SolverService
+    from pydcop_tpu.telemetry import session
+
+    kw = dict(rounds=RESTORE_ROUNDS, chunk_size=RESTORE_ROUNDS, seed=7)
+
+    def seg(svc, sv=None):
+        first = "s" not in svc._sessions
+        return svc.solve(
+            _RESTORE_YAML if first else None, "dsa", {"variant": "B"},
+            session="s", set_values=sv, **kw,
+        )
+
+    # the undisturbed reference: three segments in one service life
+    with SolverService(
+        max_batch=1, max_wait=0.0, autostart=False
+    ) as svc:
+        seg(svc)
+        seg(svc, {"sensor": 2})
+        ref = seg(svc, {"sensor": 1})
+
+    ckpt = os.path.join(
+        tempfile.mkdtemp(prefix="restore_guard_"), "sessions.json"
+    )
+    with session() as tel:
+        with SolverService(
+            max_batch=1, max_wait=0.0, autostart=False,
+            session_checkpoint=ckpt,
+        ) as svc:
+            seg(svc)
+            seg(svc, {"sensor": 2})
+        # exiting the `with` drained and wrote the checkpoint
+        c_drained = dict(tel.summary()["counters"])
+
+        restored_svc = SolverService(
+            max_batch=1, max_wait=0.0, autostart=False,
+            session_checkpoint=ckpt, resume=True,
+        )
+        restored_svc.start()
+        c_restored = dict(tel.summary()["counters"])
+        got = seg(restored_svc, {"sensor": 1})
+        c_after = dict(tel.summary()["counters"])
+        sessions_restored = restored_svc.stats()["sessions_restored"]
+        restored_svc.close()
+
+    restore_fulls = c_restored.get("compile.full", 0) - c_drained.get(
+        "compile.full", 0
+    )
+    followup_fulls = c_after.get("compile.full", 0) - c_restored.get(
+        "compile.full", 0
+    )
+    followup_incrementals = c_after.get(
+        "compile.incremental", 0
+    ) - c_restored.get("compile.incremental", 0)
+    followup_jit = c_after.get("jit.compiles", 0) - c_restored.get(
+        "jit.compiles", 0
+    )
+    report = {
+        "sessions_restored": sessions_restored,
+        "restore_fulls": restore_fulls,
+        "followup_fulls": followup_fulls,
+        "followup_incrementals": followup_incrementals,
+        "followup_jit_compiles": followup_jit,
+        "cost": got.get("cost"),
+        "ok": True,
+    }
+    if sessions_restored != 1:
+        report["ok"] = False
+        report["error"] = (
+            f"restored {sessions_restored} session(s), expected 1 — "
+            "the checkpoint lost the pinned session"
+        )
+    elif restore_fulls != 1:
+        report["ok"] = False
+        report["error"] = (
+            f"the restore replay paid {restore_fulls} full "
+            "compile(s), expected exactly 1 (segment 1 of the "
+            "replay) — the delta replay regressed to "
+            "rebuild-per-segment"
+        )
+    elif followup_fulls != 0 or followup_incrementals < 1:
+        report["ok"] = False
+        report["error"] = (
+            f"the post-restore follow-up cost {followup_fulls} full "
+            f"compile(s) / {followup_incrementals} incremental(s); "
+            "expected 0 fulls and >= 1 incremental — session state "
+            "did not survive the restart"
+        )
+    elif followup_jit != 0:
+        report["ok"] = False
+        report["error"] = (
+            f"the post-restore follow-up performed {followup_jit} "
+            "XLA compile(s); the replayed problem must land back in "
+            "its original shape bucket and hit the warm runner cache"
+        )
+    else:
+        for k in ("cost", "assignment", "cost_trace"):
+            if got.get(k) != ref.get(k):
+                report["ok"] = False
+                report["error"] = (
+                    f"post-restore follow-up {k} diverges from the "
+                    "undisturbed service — the delta replay must "
+                    "reproduce the incremental-update arithmetic "
+                    "bit-for-bit"
+                )
+                break
+    return report
+
+
 def _build_secp(n_lights: int, n_models: int, levels: int, seed: int):
     """A fixed-STRUCTURE smart-lighting SECP: deterministic model
     scopes (consecutive 3-light windows) so every seed compiles to
@@ -767,6 +923,7 @@ def main() -> int:
     report_sup = run_supervisor_guard()
     report_service = run_service_guard()
     report_semiring = run_semiring_guard()
+    report_restore = run_restore_guard()
     print(
         json.dumps(
             {
@@ -776,6 +933,7 @@ def main() -> int:
                 "supervisor": report_sup,
                 "service": report_service,
                 "semiring": report_semiring,
+                "restore": report_restore,
             }
         )
     )
@@ -787,6 +945,7 @@ def main() -> int:
         and report_sup["ok"]
         and report_service["ok"]
         and report_semiring["ok"]
+        and report_restore["ok"]
         else 1
     )
 
