@@ -177,6 +177,36 @@ SEM_SECP_LEVELS = 5
 SEM_SECP_ZONE = 8
 SEM_DEVICE_MIN_CELLS = 256
 
+# membound stage (ISSUE 10 acceptance): an OVERLAP-zone SECP —
+# chained windows sharing MB_OVERLAP lights, the high-induced-width
+# band tiled zones can never produce — whose naive peak UTIL table is
+# >= 10x MB_BUDGET bytes, solved EXACTLY under the budget by the
+# memory-bounded contraction planner (ops/membound.py): domains
+# consistency-pruned, a cut set conditioned, cut lanes riding the
+# level-pack stack as extra vmapped rows.  Evidence: naive-vs-budget
+# ratio, cut width/lanes, peak/pruned cells, bit-parity of the
+# budgeted device solve against the bounded host-f64 run of the SAME
+# instance (the "downscaled twin" is unnecessary here — the bounded
+# host pass affords the instance exactly BECAUSE the planner bounded
+# it), and log_z from the same budget machinery within its reported
+# error bound.  A small CONTROL instance (both budgeted and
+# unbounded fit) reports util-cells/sec for the budget machinery vs
+# the unbounded baseline.  CPU is an acceptable platform (the claim
+# is exactness under a byte bound + bounded overhead, not FLOPs).
+MB_LIGHTS = 96
+MB_MODELS = 96
+MB_ZONE = 8
+MB_OVERLAP = 5
+MB_ARITY = 5
+MB_LEVELS = 4
+MB_BUDGET = 16384  # bytes; naive peak 262144 B => 16x
+MB_CTL_MODELS = 64
+MB_CTL_ZONE = 7
+MB_CTL_OVERLAP = 3
+MB_CTL_ARITY = 4
+MB_CTL_BUDGET = 2048
+MB_REPS = 3
+
 
 def _git_sha() -> str:
     try:
@@ -301,6 +331,7 @@ EVIDENCE_ROWS = [
     ("config5_maxsum_meeting10k", ["maxsum_meeting_10000"]),
     ("restart_sweep_10k", ["maxsum_coloring_10000_restarts*"]),
     ("supervised_overhead", ["supervised_overhead_*"]),
+    ("membound_secp", ["membound_secp_*"]),
 ]
 
 
@@ -847,6 +878,158 @@ def _measure_semiring(phase_budget: float = 0.0) -> dict:
     return out
 
 
+def _measure_membound(phase_budget: float = 0.0) -> dict:
+    """membound: exact solves past the table-memory wall (ISSUE 10).
+
+    An overlap-zone SECP whose naive peak UTIL table is >= 10x
+    MB_BUDGET solves exactly on the device under the budget
+    (bit-parity asserted against the bounded host-f64 pass of the
+    same instance), the same budget machinery produces a log_z
+    within its reported error bound, and a control instance
+    reports util-cells/sec of the budgeted sweep vs the unbounded
+    baseline.  Consistency failures flip ``results_match`` /
+    ``log_z_within_bound`` so a throughput row can never hide a
+    wrong answer.
+    """
+    import statistics
+
+    with _bounded_phase("import:jax", phase_budget):
+        import jax
+
+    with _bounded_phase("import:pydcop", phase_budget):
+        from argparse import Namespace
+
+        from pydcop_tpu.api import infer, solve
+        from pydcop_tpu.commands.generators.secp import generate
+
+    def spec(models, zone, overlap, arity):
+        return Namespace(
+            nb_lights=MB_LIGHTS, nb_models=models, nb_rules=0,
+            light_levels=MB_LEVELS, model_arity=arity,
+            zone_size=zone, zone_layout="overlap",
+            zone_overlap=overlap, efficiency_weight=0.1,
+            capacity=100.0, seed=7,
+        )
+
+    _phase("problem_built")
+    big = generate(spec(MB_MODELS, MB_ZONE, MB_OVERLAP, MB_ARITY))
+    ctl = generate(
+        spec(MB_CTL_MODELS, MB_CTL_ZONE, MB_CTL_OVERLAP, MB_CTL_ARITY)
+    )
+    dev_p = {"util_device": "always"}
+    host_p = {"util_device": "never"}
+
+    with _bounded_phase("xla_compile", phase_budget):
+        solve(
+            big, "dpop", dev_p, max_util_bytes=MB_BUDGET,
+            pad_policy="pow2",
+        )
+
+    _phase("measure:budgeted_device")
+    times = []
+    for _ in range(MB_REPS):
+        t0 = time.perf_counter()
+        r_dev = solve(
+            big, "dpop", dev_p, max_util_bytes=MB_BUDGET,
+            pad_policy="pow2",
+        )
+        times.append(time.perf_counter() - t0)
+    med_dev = statistics.median(times)
+    mb = r_dev["membound"]
+    # the bounded host-f64 reference affords the instance exactly
+    # because the planner bounded it — the exactness oracle
+    r_host = solve(big, "dpop", host_p, max_util_bytes=MB_BUDGET)
+
+    _phase("measure:log_z")
+    z_dev = infer(
+        big, "log_z", device="always", pad_policy="pow2",
+        tol=float("inf"), max_util_bytes=MB_BUDGET,
+    )
+    z_host = infer(big, "log_z", device="never",
+                   max_util_bytes=MB_BUDGET)
+
+    _phase("measure:control")
+    t_unb, t_bud = [], []
+    for _ in range(MB_REPS):  # interleaved: load noise hits both
+        t0 = time.perf_counter()
+        rc_u = solve(ctl, "dpop", dev_p, pad_policy="pow2")
+        t_unb.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        rc_b = solve(
+            ctl, "dpop", dev_p, max_util_bytes=MB_CTL_BUDGET,
+            pad_policy="pow2",
+        )
+        t_bud.append(time.perf_counter() - t0)
+    med_u, med_b = statistics.median(t_unb), statistics.median(t_bud)
+
+    out = {
+        "platform": jax.devices()[0].platform,
+        "n_vars": MB_LIGHTS,
+        "light_levels": MB_LEVELS,
+        "zone_size": MB_ZONE,
+        "zone_overlap": MB_OVERLAP,
+        "max_util_bytes": MB_BUDGET,
+        "naive_peak_table_bytes": mb["naive_peak_table_bytes"],
+        "naive_over_budget": round(
+            mb["naive_peak_table_bytes"] / MB_BUDGET, 1
+        ),
+        "peak_table_bytes": mb["peak_table_bytes"],
+        "cut_width": mb["cut_width"],
+        "cut_lanes": mb["cut_lanes"],
+        "pruned_cells": mb["pruned_cells"],
+        "replans": mb["replans"],
+        "best_cost": r_dev["cost"],
+        "util_cells": r_dev["util_cells"],
+        "seconds": round(med_dev, 4),
+        "util_cells_per_sec": round(
+            r_dev["util_cells"] / max(r_dev["util_time"], 1e-9)
+        ),
+        "results_match": bool(
+            r_dev["cost"] == r_host["cost"]
+            and r_dev["assignment"] == r_host["assignment"]
+        ),
+        "log_z": round(z_dev["log_z"], 6),
+        "log_z_error_bound": z_dev["error_bound"],
+        "log_z_within_bound": bool(
+            abs(z_dev["log_z"] - z_host["log_z"])
+            <= z_dev["error_bound"] + z_host["error_bound"] + 1e-9
+        ),
+        "control": {
+            "n_models": MB_CTL_MODELS,
+            "max_util_bytes": MB_CTL_BUDGET,
+            "cut_width": rc_b["membound"]["cut_width"],
+            "results_match": bool(rc_u["cost"] == rc_b["cost"]),
+            "unbounded": {
+                "seconds": round(med_u, 4),
+                "util_cells": rc_u["util_cells"],
+                "util_cells_per_sec": round(
+                    rc_u["util_cells"]
+                    / max(rc_u["util_time"], 1e-9)
+                ),
+            },
+            "budgeted": {
+                "seconds": round(med_b, 4),
+                "util_cells": rc_b["util_cells"],
+                "util_cells_per_sec": round(
+                    rc_b["util_cells"]
+                    / max(rc_b["util_time"], 1e-9)
+                ),
+            },
+        },
+        "ok": True,
+    }
+    if not (
+        out["results_match"]
+        and out["log_z_within_bound"]
+        and out["control"]["results_match"]
+        and out["naive_over_budget"] >= 10.0
+        and mb["peak_table_bytes"] <= MB_BUDGET
+    ):
+        out["ok"] = False
+    _phase("measured")
+    return out
+
+
 def _measure_supervised(phase_budget: float = 0.0) -> dict:
     """Supervisor no-fault overhead on the dsa/maxsum hot loops.
 
@@ -1186,6 +1369,7 @@ def _inner_main() -> None:
     p.add_argument("--supervised_stage", action="store_true")
     p.add_argument("--service_stage", action="store_true")
     p.add_argument("--semiring_stage", action="store_true")
+    p.add_argument("--membound_stage", action="store_true")
     a = p.parse_args()
     import jax
 
@@ -1200,7 +1384,9 @@ def _inner_main() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # older jax: cache flags absent — correctness unaffected
-    if a.semiring_stage:
+    if a.membound_stage:
+        metrics = _measure_membound(a.phase_budget)
+    elif a.semiring_stage:
         metrics = _measure_semiring(a.phase_budget)
     elif a.service_stage:
         metrics = _measure_service(a.phase_budget)
@@ -1219,6 +1405,7 @@ def _run_sub(
     pin_cpu: bool, timeout: float, n_vars: int, rounds: int,
     many: bool = False, dpop: bool = False, supervised: bool = False,
     service: bool = False, semiring: bool = False,
+    membound: bool = False,
 ) -> dict:
     """Run ``bench.py --inner`` in a subprocess; parse its JSON line.
 
@@ -1251,7 +1438,8 @@ def _run_sub(
             + (["--dpop_stage"] if dpop else [])
             + (["--supervised_stage"] if supervised else [])
             + (["--service_stage"] if service else [])
-            + (["--semiring_stage"] if semiring else []),
+            + (["--semiring_stage"] if semiring else [])
+            + (["--membound_stage"] if membound else []),
             env=env,
             cwd=REPO,
             capture_output=True,
@@ -1565,6 +1753,46 @@ def main() -> None:
             ]["cells_per_sec"],
         )
 
+    # memory-bounded contraction (ops/membound.py): an overlap-SECP
+    # whose naive peak UTIL table is >= 10x the budget solved exactly
+    # under max_util_bytes — the ISSUE 10 evidence row.  Same
+    # platform policy as the stages above (the claim is exactness
+    # under a byte bound + bounded machinery overhead).
+    membound = _run_sub(pin_cpu=False, timeout=300.0, n_vars=0,
+                        rounds=0, membound=True)
+    if "error" in membound:
+        membound = _run_sub(pin_cpu=True, timeout=300.0, n_vars=0,
+                            rounds=0, membound=True)
+    if "error" in membound:
+        errors.append(f"membound stage: {membound['error']}")
+        membound = None
+    elif not membound.get("ok", False):
+        errors.append(
+            "membound below acceptance: "
+            + json.dumps(
+                {
+                    k: membound.get(k)
+                    for k in (
+                        "results_match", "log_z_within_bound",
+                        "naive_over_budget", "peak_table_bytes",
+                    )
+                }
+            )
+        )
+    elif membound.get("platform") == "tpu":
+        # durable evidence row (msgs_per_sec=None: the stage reports
+        # exactness under a byte budget + util-cells/sec, not a
+        # message rate)
+        append_tpu_log(
+            f"membound_secp_{MB_LIGHTS}",
+            None,
+            source="bench_stage_membound",
+            best_cost=membound.get("best_cost"),
+            naive_over_budget=membound.get("naive_over_budget"),
+            cut_width=membound.get("cut_width"),
+            util_cells_per_sec=membound.get("util_cells_per_sec"),
+        )
+
     # supervised-dispatch no-fault overhead (engine/supervisor.py):
     # dsa/maxsum hot loops under the default supervisor vs bare
     # dispatch — the <2% acceptance bound of the robustness layer.
@@ -1670,6 +1898,20 @@ def main() -> None:
             k: semiring[k]
             for k in ("platform", "tree", "secp_tiled")
             if k in semiring
+        }
+    if membound is not None:
+        out["membound"] = {
+            k: membound[k]
+            for k in (
+                "platform", "n_vars", "max_util_bytes",
+                "naive_peak_table_bytes", "naive_over_budget",
+                "peak_table_bytes", "cut_width", "cut_lanes",
+                "pruned_cells", "replans", "best_cost",
+                "util_cells", "util_cells_per_sec",
+                "results_match", "log_z", "log_z_error_bound",
+                "log_z_within_bound", "control", "ok",
+            )
+            if k in membound
         }
     if dpop is not None:
         out["dpop_secp"] = {

@@ -122,6 +122,18 @@ algo_params: list = [
     # UTIL/VALUE machinery — device certificates included — runs
     # unchanged per assignment)
     AlgoParameterDef("memory_bound", "int", None, 0),
+    # memory-bounded exact mode, planner edition (ops/membound.py):
+    # cap every UTIL/message TABLE at this many f32 BYTES by
+    # conditioning a minimal cut set chosen on the bucket-tree plan
+    # (RMB-DPOP-style, shared-across-siblings preference +
+    # cross-edge consistency pruning); cut assignments ride the
+    # level-pack stack as extra vmapped lanes, certificates
+    # unchanged per lane, and a device OOM re-plans at half budget
+    # before abandoning the device (docs/semirings.md,
+    # "Memory-bounded contraction").  0 = off.  Supersedes
+    # memory_bound's sequential conditioning passes for device runs;
+    # the two are mutually exclusive.
+    AlgoParameterDef("max_util_bytes", "int", None, 0),
 ]
 
 _EPS32 = float(np.finfo(np.float32).eps)
@@ -210,6 +222,27 @@ def solve_host(
     bit-identical with or without it (module docstring)."""
     t0 = time.perf_counter()
     pad = as_pad_policy(pad_policy)
+
+    # -- byte-budgeted exact mode (max_util_bytes > 0): the planner
+    # subsystem (ops/membound.py) — consistency-pruned domains, a
+    # cut set chosen on the bucket-tree plan, cut lanes merged into
+    # ONE level-pack-batched sweep, OOM re-planning — same result
+    # dict plus a "membound" block
+    max_util_bytes = int(params.get("max_util_bytes", 0) or 0)
+    if max_util_bytes > 0:
+        if int(params.get("memory_bound", 0) or 0):
+            raise ValueError(
+                "memory_bound (sequential conditioning passes, "
+                "cells) and max_util_bytes (planner cut lanes, "
+                "bytes) are two bounded-memory modes — set one"
+            )
+        from pydcop_tpu.ops.membound import solve_dpop_bounded
+
+        return solve_dpop_bounded(
+            dcop, params, timeout=timeout, pad_policy=pad,
+            max_table_size=max_util_size,
+        )
+
     graph, domains, depth, owned = _prepare_instance(dcop)
 
     # -- bounded-memory planning (memory_bound > 0): pick a cut set
@@ -383,6 +416,9 @@ def solve_host_many(
     merged_idx = [
         i for i in range(K)
         if not int(params_list[i].get("memory_bound", 0) or 0)
+        # budgeted instances run their own lane-merged bounded sweep
+        # (ops/membound.py) — their lanes already fill the stack axis
+        and not int(params_list[i].get("max_util_bytes", 0) or 0)
     ]
     for i in range(K):
         if i not in merged_idx:
@@ -885,6 +921,7 @@ def _util_phase_multi(
                             np.asarray(x) for x in fn(*casts)
                         ),
                         scope="dpop.level", width=stack_h,
+                        table_bytes=4 * int(np.prod(pshape)),
                     )
                     level_batched = True
                 except DeviceOOMError:
@@ -1010,6 +1047,7 @@ def _util_phase_multi(
                             np.asarray(x) for x in fn(*a)
                         ),
                         scope="dpop.node", width=1,
+                        table_bytes=4 * int(np.prod(pshape)),
                     )
                 except DeviceOOMError:
                     # bottom of the OOM ladder: this single join does

@@ -71,6 +71,7 @@ def solve(
     retry_budget: Optional[int] = None,
     chunk_floor: Optional[int] = None,
     on_numeric_fault: Optional[str] = None,
+    max_util_bytes: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -148,6 +149,18 @@ def solve(
     under the same seeded-determinism contract as the message-plane
     kinds.
 
+    ``max_util_bytes`` (exact algorithms with a bounded-memory plan —
+    DPOP) caps every UTIL/message table at that many device (f32)
+    bytes via the memory-bounded contraction planner
+    (``ops/membound.py``, ``docs/semirings.md``): domains are
+    consistency-pruned, a minimal cut set of separator variables is
+    conditioned and its assignments ride the level-pack stack as
+    extra vmapped lanes, results stay exact, and a device OOM
+    re-plans at half budget before abandoning the device.  The
+    result carries a ``membound`` block (cut width/lanes, peak table
+    bytes, replans).  Equivalent to
+    ``algo_params={"max_util_bytes": N}``.
+
     >>> result = solve(my_dcop, "dsa", {"variant": "B"}, rounds=100)
     >>> result["assignment"], result["cost"]
     """
@@ -172,6 +185,7 @@ def solve(
             k_target=k_target, chaos=chaos, chaos_seed=chaos_seed,
             pad_policy=pad_policy, retry_budget=retry_budget,
             chunk_floor=chunk_floor, on_numeric_fault=on_numeric_fault,
+            max_util_bytes=max_util_bytes,
         )
         result["telemetry"] = tel.summary()
     return result
@@ -204,6 +218,7 @@ def _solve_dispatch(
     retry_budget=None,
     chunk_floor=None,
     on_numeric_fault=None,
+    max_util_bytes=None,
 ) -> Dict[str, Any]:
     """Mode dispatch behind :func:`solve` (which owns the telemetry
     session and the ``result["telemetry"]`` attach)."""
@@ -228,6 +243,14 @@ def _solve_dispatch(
             "batched engine's supervised device dispatch "
             f"(engine/supervisor.py); mode={mode!r} has no device "
             "dispatch to supervise"
+        )
+
+    if mode != "batched" and max_util_bytes is not None:
+        raise ValueError(
+            "max_util_bytes bounds the batched engine's exact "
+            "contraction sweeps (ops/membound.py); the "
+            f"message-driven mode={mode!r} never builds whole UTIL "
+            "tables to bound"
         )
 
     if mode != "batched" and chaos:
@@ -371,6 +394,29 @@ def _solve_dispatch(
     algo_name, params_in = resolve_algo(algo, algo_params)
 
     module = load_algorithm_module(algo_name)
+    if max_util_bytes is not None:
+        if not any(
+            p.name == "max_util_bytes" for p in module.algo_params
+        ):
+            raise ValueError(
+                "max_util_bytes bounds the exact contraction "
+                "engine's largest UTIL/message table — supported by "
+                "algorithms with a bounded-memory plan (dpop) and "
+                f"by api.infer; {algo_name!r} has no such table to "
+                "bound"
+            )
+        if int(max_util_bytes) <= 0:
+            # the algo-param route's 0 means "off" (the dataclass
+            # default), but an EXPLICIT budget of <= 0 is a sizing
+            # bug — silently running the naive sweep would be the
+            # exact OOM the caller tried to prevent
+            raise ValueError(
+                f"max_util_bytes must be > 0, got {max_util_bytes}"
+            )
+        params_in = {
+            **dict(params_in or {}),
+            "max_util_bytes": int(max_util_bytes),
+        }
     params = prepare_algo_params(params_in, module.algo_params)
 
     # every batched-mode call runs under a per-call supervisor
@@ -1041,6 +1087,7 @@ def infer(
     trace_format: str = "jsonl",
     compile_cache: Optional[str] = None,
     retry_budget: Optional[int] = None,
+    max_util_bytes: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Exact probabilistic inference over a DCOP's cost model — the
     semiring-generic twin of :func:`solve` (``docs/semirings.md``).
@@ -1079,6 +1126,18 @@ def infer(
     ``device_min_cells`` cells), ``"never"`` (pure host f64),
     ``"always"``.
 
+    ``max_util_bytes`` runs the sweep MEMORY-BOUNDED
+    (``ops/membound.py``, ``docs/semirings.md`` "Memory-bounded
+    contraction"): every contraction table is kept under the budget
+    by conditioning a cut set whose assignments ride the level-pack
+    stack as extra vmapped lanes — the same per-⊕ exactness
+    contracts hold across the lane combine (``map`` stays certified
+    exact; ``log_z``/``marginals`` report a sound cross-lane
+    ``error_bound``), the result carries a ``membound`` block, and a
+    device OOM re-plans at half budget before abandoning the device.
+    An unplannable budget raises a sizing error (planned peak table
+    bytes vs budget, cut width) instead of an order hint.
+
     Returns a result dict with ``status``/``time``/``telemetry``
     plus the query's payload, ``cells``/``dispatches``/
     ``device_nodes``/``host_nodes`` contraction stats, and the
@@ -1090,7 +1149,7 @@ def infer(
         timeout=timeout, pad_policy=pad_policy,
         max_table_size=max_table_size, trace=trace,
         trace_format=trace_format, compile_cache=compile_cache,
-        retry_budget=retry_budget,
+        retry_budget=retry_budget, max_util_bytes=max_util_bytes,
     )[0]
 
 
@@ -1110,6 +1169,7 @@ def infer_many(
     trace_format: str = "jsonl",
     compile_cache: Optional[str] = None,
     retry_budget: Optional[int] = None,
+    max_util_bytes: Optional[int] = None,
 ) -> list:
     """Run one inference ``query`` over MANY instances with their
     contraction sweeps MERGED — the :func:`solve_many` batching
@@ -1157,6 +1217,7 @@ def infer_many(
             loaded, query, order=order, beta=beta, tol=tol,
             device=device, device_min_cells=device_min_cells,
             pad_policy=pad_policy, max_table_size=max_table_size,
+            max_util_bytes=max_util_bytes,
             timeout=(
                 None
                 if deadline is None

@@ -42,14 +42,27 @@ def set_parser(subparsers) -> None:
         "rooms do — required for exact DPOP at scale",
     )
     p.add_argument(
-        "--zone_layout", choices=["random", "tiled"],
+        "--zone_layout", choices=["random", "tiled", "overlap"],
         default="random",
         help="'random': zone windows start anywhere (overlapping "
         "windows chain the whole building into one deep band); "
         "'tiled': windows align to disjoint zone_size blocks — "
         "independent rooms, giving the wide shallow pseudo-forest "
         "that DPOP's level-synchronous UTIL batching exploits "
-        "(docs/performance.md, 'Level-synchronous DPOP')",
+        "(docs/performance.md, 'Level-synchronous DPOP'); "
+        "'overlap': windows slide by zone_size - zone_overlap so "
+        "every consecutive pair of zones SHARES zone_overlap lights "
+        "— an open-plan floor whose chained zones drive the induced "
+        "width up with the overlap degree, the workload the "
+        "memory-bounded planner (--max_util_bytes, "
+        "docs/semirings.md) exists for; tiled zones are deliberately "
+        "shallow and can never exercise it",
+    )
+    p.add_argument(
+        "--zone_overlap", type=int, default=0,
+        help="(zone_layout=overlap) lights shared by consecutive "
+        "zone windows (0 = half the zone).  More overlap = wider "
+        "separators = exponentially bigger UTIL tables",
     )
     p.add_argument(
         "--efficiency_weight", type=float, default=0.1,
@@ -95,13 +108,43 @@ def generate(args):
     for m in range(args.nb_models):
         arity = rnd.randint(1, min(args.model_arity, args.nb_lights))
         if zone and zone < args.nb_lights:
-            if getattr(args, "zone_layout", "random") == "tiled":
+            layout = getattr(args, "zone_layout", "random")
+            if layout == "tiled":
                 # disjoint rooms: windows snap to zone_size blocks;
                 # ceil so a non-divisible nb_lights puts the tail
                 # lights in a final short room instead of leaving
                 # them model-free
                 n_blocks = -(-args.nb_lights // zone)
                 start = rnd.randrange(n_blocks) * zone
+            elif layout == "overlap":
+                # chained zones: window m slides by stride =
+                # zone - overlap, so consecutive zones share exactly
+                # `overlap` lights — every shared light sits in two
+                # zones' separators and the chain's induced width
+                # grows with the overlap degree (deterministic
+                # anchors per model index; the scope draw below
+                # stays seeded-random inside the window).  Anchors
+                # CYCLE over the fixed lattice 0, stride, 2·stride…
+                # instead of wrapping mid-stride: model counts past
+                # one full sweep of the strip revisit the SAME
+                # chain of windows (a raw (m·stride) % span would
+                # drift the anchors by a few lights per wrap and
+                # consecutive zones at the seam would share
+                # nothing).
+                overlap = int(
+                    getattr(args, "zone_overlap", 0) or 0
+                ) or zone // 2
+                if not 0 < overlap < zone:
+                    raise ValueError(
+                        f"zone_overlap={overlap} must be in "
+                        f"[1, zone_size={zone}) — equal windows "
+                        "never advance, and a non-positive overlap "
+                        "is not an overlap"
+                    )
+                stride = zone - overlap
+                span = args.nb_lights - zone + 1
+                n_anchors = (span - 1) // stride + 1
+                start = (m % n_anchors) * stride
             else:
                 start = rnd.randrange(args.nb_lights - zone + 1)
             pool = lights[start : start + zone]

@@ -78,6 +78,16 @@ def set_parser(subparsers) -> None:
         help="transient device failures retry up to N times per "
         "dispatch (engine/supervisor.py; default 2)",
     )
+    p.add_argument(
+        "--max_util_bytes", type=int, default=None, metavar="N",
+        help="run the sweep memory-bounded (ops/membound.py): every "
+        "contraction table stays under N device (f32) bytes by "
+        "conditioning a cut set whose assignments ride the "
+        "level-pack stack as extra vmapped lanes — exact per the "
+        "query's ⊕ contract on instances whose naive tables exceed "
+        "device memory; the result carries a 'membound' block "
+        "(docs/semirings.md, 'Memory-bounded contraction')",
+    )
     add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
 
@@ -96,6 +106,7 @@ def run_cmd(args) -> int:
         trace_format=args.trace_format,
         compile_cache=args.compile_cache,
         retry_budget=args.retry_budget,
+        max_util_bytes=args.max_util_bytes,
     )
     if len(args.dcop_files) == 1:
         result = infer(

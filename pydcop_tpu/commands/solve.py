@@ -138,6 +138,17 @@ def set_parser(subparsers) -> None:
         "repeated runs of the same program skip backend compilation "
         "entirely, across processes (docs/performance.md)",
     )
+    p.add_argument(
+        "--max_util_bytes", type=int, default=None, metavar="N",
+        help="(exact algorithms with a bounded-memory plan — dpop) "
+        "cap every UTIL/message table at N device (f32) bytes: the "
+        "memory-bounded contraction planner (ops/membound.py) "
+        "conditions a cut set whose assignments ride the level-pack "
+        "stack as extra vmapped lanes — exact results on instances "
+        "whose naive tables exceed device memory, a device OOM "
+        "re-plans at half budget, and the result carries a "
+        "'membound' block (docs/semirings.md)",
+    )
     add_supervisor_arguments(p)
     add_collect_arguments(p)
     add_trace_arguments(p)
@@ -186,6 +197,7 @@ def run_cmd(args) -> int:
             retry_budget=args.retry_budget,
             chunk_floor=args.chunk_floor,
             on_numeric_fault=args.on_numeric_fault,
+            max_util_bytes=args.max_util_bytes,
         )
     finally:
         # flush the trace even when the solve raises — a profile of a
@@ -205,6 +217,10 @@ def _run_many_cmd(args, params) -> int:
     :func:`pydcop_tpu.api.solve_many` (cross-instance batching)."""
     from pydcop_tpu.api import solve_many
 
+    if args.max_util_bytes is not None:
+        # solve_many takes it through the per-algorithm params (the
+        # budget is a dpop algo param — docs/semirings.md)
+        params = {**params, "max_util_bytes": args.max_util_bytes}
     if args.mode != "tpu":
         raise SystemExit(
             "--many batches instances on the batched engine; "

@@ -556,6 +556,7 @@ class SolverService:
         timeout: Optional[float] = None,
         session: Optional[str] = None,
         set_values: Optional[Mapping[str, Any]] = None,
+        max_util_bytes: Optional[int] = None,
     ) -> PendingResult:
         """Admit one solve request; returns a :class:`PendingResult`.
 
@@ -564,9 +565,14 @@ class SolverService:
         protocol's form).  ``session`` names a session: its first
         request must carry the dcop and pins an incremental compiler;
         later requests may omit ``dcop`` and stream ``set_values``
-        deltas ({external variable: value}) instead.  Validation
-        errors raise HERE (before admission); dispatch errors surface
-        from ``PendingResult.result()``.
+        deltas ({external variable: value}) instead.
+        ``max_util_bytes`` (exact algorithms with a bounded-memory
+        plan — DPOP) caps the request's largest UTIL table via the
+        memory-bounded contraction planner (``ops/membound.py``) —
+        it folds into the algorithm params, so it also partitions
+        dispatch groups like any other param.  Validation errors
+        raise HERE (before admission); dispatch errors surface from
+        ``PendingResult.result()``.
         """
         with self._cond:
             if self._closing:
@@ -614,6 +620,25 @@ class SolverService:
 
         algo_name, params_in = resolve_algo(algo, algo_params)
         module = load_algorithm_module(algo_name)
+        if max_util_bytes is not None:
+            if not any(
+                p.name == "max_util_bytes"
+                for p in module.algo_params
+            ):
+                raise ValueError(
+                    "max_util_bytes bounds the exact contraction "
+                    "engine's largest UTIL table (ops/membound.py) "
+                    f"— {algo_name!r} has no such table to bound"
+                )
+            if int(max_util_bytes) <= 0:
+                raise ValueError(
+                    "max_util_bytes must be > 0, got "
+                    f"{max_util_bytes}"
+                )
+            params_in = {
+                **dict(params_in or {}),
+                "max_util_bytes": int(max_util_bytes),
+            }
         params = prepare_algo_params(params_in, module.algo_params)
 
         req = _Request(
@@ -1446,6 +1471,7 @@ def _load_module(algo_name: str):
 _SOLVE_FIELDS = (
     "rounds", "seed", "chunk_size", "convergence_chunks",
     "n_restarts", "timeout", "session", "set_values",
+    "max_util_bytes",
 )
 
 #: results are trimmed for the wire: the per-round cost trace can be

@@ -266,16 +266,22 @@ class Supervisor:
             )
 
     def _inject(
-        self, scope: str, seq: int, width: int, rounds: Optional[int]
+        self,
+        scope: str,
+        seq: int,
+        width: int,
+        rounds: Optional[int],
+        table_bytes: Optional[int] = None,
     ) -> None:
         plan = self.config.plan
         if plan is None or not plan.device_faults_configured:
             return
-        if plan.oom_injected(width, rounds):
+        if plan.oom_injected(width, rounds, table_bytes):
             self._record_fault("device_oom", scope, seq)
             raise DeviceOOMError(
                 f"injected device OOM: dispatch {scope}#{seq} "
-                f"(width={width}, rounds={rounds}) exceeds the chaos "
+                f"(width={width}, rounds={rounds}, "
+                f"table_bytes={table_bytes}) exceeds the chaos "
                 "plan's capacity",
                 injected=True,
             )
@@ -295,6 +301,7 @@ class Supervisor:
         width: int = 1,
         rounds: Optional[int] = None,
         retryable: bool = True,
+        table_bytes: Optional[int] = None,
     ):
         """Run one device dispatch under supervision and return its
         result.
@@ -306,7 +313,11 @@ class Supervisor:
         is the dispatch's vmapped lane count (instances × restarts,
         or a DPOP stack height) and ``rounds`` its scanned round
         count — the quantities the injected capacity model and the
-        callers' degradation moves operate on.
+        callers' degradation moves operate on.  ``table_bytes`` is
+        the dispatch's PER-LANE joined-table size (the UTIL-sweep
+        quantity exponential in induced width) — the dimension the
+        ``device_oom_bytes`` capacity model caps and the budgeted
+        sweeps' replan ladder shrinks (``ops/membound.py``).
 
         Transient failures retry in place (seeded keyed-jitter
         backoff, ``engine.retries``) up to ``retry_budget`` times,
@@ -373,7 +384,7 @@ class Supervisor:
         while True:
             seq = self._next_seq(scope)
             try:
-                self._inject(scope, seq, width, rounds)
+                self._inject(scope, seq, width, rounds, table_bytes)
             except DeviceTransientError as e:
                 # injected BEFORE fn ran: in-place retry is sound
                 # even for donated dispatches

@@ -40,6 +40,14 @@ supervised-dispatch seam of ``engine/supervisor.py``, same
   supervisor's degradation ladder (halve chunks, split groups)
   *converge* — once a dispatch fits the injected capacity it succeeds,
   exactly like real HBM.
+- ``device_oom_bytes=N`` — every device dispatch whose PER-LANE
+  joined table exceeds ``N`` bytes raises ``RESOURCE_EXHAUSTED``.
+  The capacity model for the width-EXPONENTIAL dimension: the
+  memory-bounded sweeps (``ops/membound.py``) answer it by
+  re-planning at half their ``max_util_bytes`` budget
+  (``membound.replans``), converging the moment the planned tables
+  fit; dispatches that report no table size (the batched hot loops)
+  are exempt.
 - ``device_transient=P`` / ``device_transient=P:AFTER`` — each
   dispatch *attempt* fails with a transient runtime error with
   probability ``P``, hashed on ``(seed, dispatch scope, attempt
@@ -138,6 +146,11 @@ class DeviceFaults:
 
     oom_width_cap: Optional[int] = None
     oom_rounds_cap: Optional[int] = None
+    #: HBM capacity on the PER-LANE joined-table bytes of a dispatch
+    #: (``device_oom_bytes=N``) — the width-exponential dimension the
+    #: budgeted sweeps' replan ladder shrinks (``ops/membound.py``);
+    #: dispatches that report no table size are exempt.
+    oom_bytes_cap: Optional[int] = None
     transient: float = 0.0
     transient_after: int = 0
     nan: float = 0.0
@@ -148,6 +161,7 @@ class DeviceFaults:
         return (
             self.oom_width_cap is not None
             or self.oom_rounds_cap is not None
+            or self.oom_bytes_cap is not None
             or self.transient > 0.0
             or self.nan > 0.0
         )
@@ -239,7 +253,12 @@ class FaultPlan:
                 plan.crashes[agent] = t
                 continue
             if clause.startswith(
-                ("device_oom=", "device_transient=", "nan_inject=")
+                (
+                    "device_oom=",
+                    "device_oom_bytes=",
+                    "device_transient=",
+                    "nan_inject=",
+                )
             ):
                 key, val = clause.split("=", 1)
                 device_fields.update(
@@ -426,14 +445,28 @@ class FaultPlan:
     # -- device-layer queries (all pure, engine/supervisor.py seam) ------
 
     def oom_injected(
-        self, width: int, rounds: Optional[int] = None
+        self,
+        width: int,
+        rounds: Optional[int] = None,
+        table_bytes: Optional[int] = None,
     ) -> bool:
         """Whether a device dispatch of ``width`` vmapped lanes
-        covering ``rounds`` scanned rounds exceeds the injected
-        capacity — a deterministic capacity model (no hashing), so a
-        degraded re-dispatch that fits always succeeds."""
+        covering ``rounds`` scanned rounds with a ``table_bytes``
+        per-lane joined table exceeds the injected capacity — a
+        deterministic capacity model (no hashing), so a degraded
+        re-dispatch that fits always succeeds: chunk halvings and
+        group splits converge on the width/rounds caps, and the
+        budgeted sweeps' budget-halving replans
+        (``ops/membound.py``) converge on the bytes cap exactly
+        like real HBM."""
         d = self.device
         if d.oom_width_cap is not None and width > d.oom_width_cap:
+            return True
+        if (
+            d.oom_bytes_cap is not None
+            and table_bytes is not None
+            and table_bytes > d.oom_bytes_cap
+        ):
             return True
         return (
             d.oom_rounds_cap is not None
@@ -537,6 +570,15 @@ def _parse_device_value(
             if not out:
                 raise ValueError("empty device_oom clause")
             return out
+        if key == "device_oom_bytes":
+            if tail:
+                # reject rather than silently drop: a clause that
+                # parses but means less than the user wrote would
+                # fake chaos coverage (the wire-kind rule)
+                raise ValueError(
+                    "device_oom_bytes takes a single byte count"
+                )
+            return {"oom_bytes_cap": int(head)}
         if key == "device_transient":
             out = {"transient": float(head)}
             if tail:
@@ -550,8 +592,8 @@ def _parse_device_value(
     except ValueError:
         raise FaultSpecError(
             f"chaos spec: bad number in clause {clause!r} (expected "
-            "device_oom=W[:R], device_transient=P[:AFTER] or "
-            "nan_inject=P[:INSTANCE])"
+            "device_oom=W[:R], device_oom_bytes=N, "
+            "device_transient=P[:AFTER] or nan_inject=P[:INSTANCE])"
         ) from None
 
 

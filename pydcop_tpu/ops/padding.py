@@ -108,10 +108,17 @@ def bucket_util_shape(
 ) -> tuple:
     """Quantize a UTIL joined-table shape axis-wise to the policy's
     pow-2 lattice (floor :data:`UTIL_AXIS_FLOOR`).  Identity under
-    ``NO_PADDING``."""
+    ``NO_PADDING``.  Size-1 axes STAY 1: they are conditioned or
+    degenerate axes (singleton domains — ``memory_bound`` passes,
+    the cut lanes of ``ops/membound.py``), and raising them to the
+    floor would DOUBLE the table per conditioned axis for pure ghost
+    compute — the exact opposite of what a memory budget is for."""
     if not policy.enabled:
         return tuple(shape)
-    return tuple(policy.bucket(s, UTIL_AXIS_FLOOR) for s in shape)
+    return tuple(
+        s if s == 1 else policy.bucket(s, UTIL_AXIS_FLOOR)
+        for s in shape
+    )
 
 
 def util_level_key(

@@ -701,6 +701,7 @@ def contract_sweep(
     want_args: bool = False,
     t0: Optional[float] = None,
     timeout: Optional[float] = None,
+    on_oom: str = "host",
 ) -> Optional[_Sweep]:
     """Merged bottom-up contraction sweep over K instances.
 
@@ -720,6 +721,14 @@ def contract_sweep(
     the sweep state, or None on timeout.  Counters:
     ``semiring.contractions`` per node, ``semiring.dispatches`` per
     device dispatch.
+
+    ``on_oom`` picks the bottom rung of the device-OOM ladder: a
+    level stack that OOMs always degrades to per-node dispatches;
+    a PER-NODE OOM then either redoes that node on host f64
+    (``"host"``, the default) or raises the ``DeviceOOMError``
+    (``"raise"`` — the budgeted sweeps of ``ops/membound.py``, which
+    answer it by RE-PLANNING at a tighter ``max_util_bytes`` before
+    abandoning the device).
     """
     from pydcop_tpu.engine.supervisor import (
         DeviceOOMError,
@@ -947,8 +956,11 @@ def contract_sweep(
                             np.asarray(x) for x in fn(*p)
                         ),
                         scope="semiring.node", width=1,
+                        table_bytes=4 * int(np.prod(pshape)),
                     )
                 except DeviceOOMError:
+                    if on_oom == "raise":
+                        raise
                     host_contract(
                         k, name, plans[k], sep, target, shape,
                         parts, err_in,
@@ -998,6 +1010,7 @@ def _dispatch_stacked(
         outs = sup.dispatch(
             lambda: tuple(np.asarray(x) for x in fn(*casts)),
             scope="semiring.level", width=stack_h,
+            table_bytes=4 * int(np.prod(pshape)),
         )
     except DeviceOOMError:
         return False
@@ -1196,6 +1209,7 @@ def run_infer_many(
     pad_policy: Any = None,
     max_table_size: int = 1 << 26,
     timeout: Optional[float] = None,
+    max_util_bytes: Optional[int] = None,
 ) -> List[Dict[str, Any]]:
     """Run one inference query over K instances with their contraction
     sweeps MERGED (the ``solve_many`` batching contract: same-bucket
@@ -1204,6 +1218,19 @@ def run_infer_many(
     sequential calls).  The engine behind ``api.infer`` /
     ``api.infer_many`` — callers own the telemetry session and
     supervisor installation.
+
+    ``max_util_bytes`` runs the sweep MEMORY-BOUNDED
+    (``ops/membound.py``): domains are consistency-pruned, every
+    contraction table is kept under the budget by conditioning a cut
+    set of variables, and the cut assignments ride the level-pack
+    stack as extra vmapped lanes — exact results (per the query's ⊕
+    contract) on instances whose naive tables dwarf device memory,
+    at the cost of one sweep pass per cut lane.  The result carries
+    a ``membound`` block (cut width/lanes, peak table bytes,
+    replans).  An unplannable budget raises
+    :class:`~pydcop_tpu.ops.membound.MemboundError`, which reports
+    peak-table-bytes-vs-budget and the cut width reached — the
+    actionable sizing, not a retry hint.
 
     Queries: ``"map"`` (max/+ — the exact MAP assignment, certified
     like DPOP), ``"log_z"`` (+/x — ``log Σ_x exp(-beta·E(x))``),
@@ -1248,6 +1275,14 @@ def run_infer_many(
         # same contract as a sweep timeout
         return [_timeout_result(query, t0) for _ in range(K)]
     want_args = query == "map"
+
+    if max_util_bytes is not None:
+        return _run_bounded_infer(
+            dcops, plans, query, sr,
+            max_util_bytes=int(max_util_bytes), beta=beta, dmc=dmc,
+            pad=pad, tol=tol, max_table_size=max_table_size,
+            want_args=want_args, t0=t0, timeout=timeout, K=K,
+        )
 
     sw = contract_sweep(
         plans, sr, beta=beta, device_min_cells=dmc, pad=pad,
@@ -1322,3 +1357,78 @@ def _timeout_result(query: str, t0: float) -> Dict[str, Any]:
         "status": "timeout",
         "time": time.perf_counter() - t0,
     }
+
+
+def _run_bounded_infer(
+    dcops, plans, query, sr, *, max_util_bytes, beta, dmc, pad,
+    tol, max_table_size, want_args, t0, timeout, K,
+) -> List[Dict[str, Any]]:
+    """Memory-bounded assembly behind :func:`run_infer_many`
+    (``max_util_bytes`` set): the budgeted lane sweep
+    (``ops/membound.py``) plus the per-⊕ cross-lane combines —
+    idempotent ⊕ picks the best lane (exact), logsumexp ⊕-combines
+    the lane values under the worst-lane error bound, marginals mix
+    lane marginals by lane weight and scatter over the original
+    (pre-pruning) domains."""
+    from pydcop_tpu.ops import membound as _mb
+    from pydcop_tpu.telemetry import get_tracer
+
+    tracer = get_tracer()
+    bs = _mb.run_bounded(
+        plans, sr, max_util_bytes=max_util_bytes, beta=beta,
+        device_min_cells=dmc, pad=pad, tol=tol,
+        max_table_size=max_table_size, want_args=want_args,
+        t0=t0, timeout=timeout,
+    )
+    if bs is None:
+        return [_timeout_result(query, t0) for _ in range(K)]
+    results: List[Dict[str, Any]] = []
+    for k, (dcop, plan) in enumerate(zip(dcops, bs.plans)):
+        const = beta * plan.const_energy
+        out: Dict[str, Any] = {
+            "query": query,
+            "semiring": sr.name,
+            "order": plan.order_name,
+            "status": "finished",
+            **bs.stats(k),
+            "width": bs.width(k),
+            "instances_batched": K,
+            "membound": bs.meta(k),
+        }
+        if query == "map":
+            winner = bs.best_lane(k, maximize=True)
+            assignment = _value_phase(
+                bs.lanes[winner], bs.sw.args[winner]
+            )
+            out["assignment"] = assignment
+            out["cost"] = dcop.solution_cost(assignment)
+            out["log_weight"] = (
+                bs.lane_values(k)[winner - bs.ranges[k][0]] - const
+            )
+            out["error_bound"] = 0.0  # certified per lane, exact
+        elif query == "log_z":
+            v, err = bs.logsumexp_lanes(k)
+            out["log_z"] = v - const
+            out["error_bound"] = err
+        else:  # marginals
+            t_down = time.perf_counter()
+            margs = _mb.combine_marginals(
+                bs, k, sr, beta, t0, timeout
+            )
+            if margs is None:
+                results.append(_timeout_result(query, t0))
+                continue
+            if tracer.enabled:
+                tracer.add_span(
+                    "semiring.downward", "phase", t_down,
+                    time.perf_counter() - t_down, semiring=sr.name,
+                )
+            out["marginals"] = {
+                v: [float(x) for x in p] for v, p in margs.items()
+            }
+            z, err = bs.logsumexp_lanes(k)
+            out["log_z"] = z - const
+            out["error_bound"] = err
+        out["time"] = (time.perf_counter() - t0) / K
+        results.append(out)
+    return results

@@ -1,0 +1,428 @@
+"""Memory-bounded contraction tests (``ops/membound.py``,
+``docs/semirings.md`` "Memory-bounded contraction").
+
+Bit-parity suite: budgeted solves/inference vs the unbounded device
+and host-f64 references across min_sum / max_sum / log_sum_exp,
+including budgets that force >= 2 nested cut variables; cross-edge
+consistency pruning exactness; deterministic re-planning under
+injected ``device_oom_bytes``; and the api/service surfaces of
+``max_util_bytes``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+from argparse import Namespace
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+pytestmark = pytest.mark.membound
+
+_TOOL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools",
+    "recompile_guard.py",
+)
+_spec = importlib.util.spec_from_file_location(
+    "recompile_guard_membound", _TOOL
+)
+_guard = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_guard)
+
+
+def _overlap_secp(n_lights=12, n_models=10, levels=3, seed=77):
+    """The guard's fixed-structure overlap-zone SECP
+    (``tools/recompile_guard.py:_build_secp_overlap`` — ONE builder,
+    so the compile guard and this parity suite can never drift onto
+    different workloads): chained windows whose induced width forces
+    cuts."""
+    return _guard._build_secp_overlap(
+        n_lights, n_models, levels, seed=seed
+    )
+
+
+def _hard_chain(n=5, d=3):
+    """Chain of hard not-equal constraints plus a unary that forbids
+    one value of the head — the cross-edge pruning workload."""
+    dom = Domain("d", "", list(range(d)))
+    dcop = DCOP("hard_chain")
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    u0 = np.array([0.0, 0.5] + [np.inf] * (d - 2))
+    dcop.add_constraint(NAryMatrixRelation([vs[0]], u0, name="u0"))
+    neq = np.where(np.eye(d) > 0, np.inf, 0.0) + 0.1 * np.arange(d)[
+        None, :
+    ]
+    for i in range(n - 1):
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[i], vs[i + 1]], neq, name=f"c{i}")
+        )
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+# -- planner units -------------------------------------------------------
+
+
+def test_plan_cut_deterministic_and_bounded():
+    from pydcop_tpu.ops.membound import BYTES_PER_CELL, plan_cut
+    from pydcop_tpu.ops.semiring import build_plan
+
+    dcop = _overlap_secp()
+    c1 = plan_cut(build_plan(dcop), 256)
+    c2 = plan_cut(build_plan(dcop), 256)
+    # pure function of (graph, budget) — the determinism that makes
+    # OOM re-planning replayable
+    assert c1 == c2
+    assert c1.width >= 1
+    assert c1.bounded_peak_cells <= 256 // BYTES_PER_CELL
+    assert c1.naive_peak_cells > 256 // BYTES_PER_CELL
+    tighter = plan_cut(build_plan(dcop), 64)
+    assert tighter.width >= c1.width
+    assert tighter.bounded_peak_cells <= 64 // BYTES_PER_CELL
+
+
+def test_overlap_zone_layout_raises_induced_width():
+    """The generator satellite: tiled zones are shallow by design;
+    the overlap layout chains them into a band whose induced width
+    grows with the overlap degree."""
+    from pydcop_tpu.commands.generators.secp import generate
+    from pydcop_tpu.ops.semiring import build_plan
+
+    def spec(layout, overlap):
+        return Namespace(
+            nb_lights=48, nb_models=48, nb_rules=12, light_levels=5,
+            model_arity=4, zone_size=6, zone_layout=layout,
+            zone_overlap=overlap, efficiency_weight=0.1,
+            capacity=100.0, seed=7,
+        )
+
+    w_tiled = build_plan(generate(spec("tiled", 0))).width()
+    w_overlap = build_plan(generate(spec("overlap", 3))).width()
+    assert w_overlap > w_tiled
+    with pytest.raises(ValueError, match="zone_overlap"):
+        generate(spec("overlap", 6))  # overlap >= zone never advances
+
+
+# -- bit-parity: budgeted vs unbounded ----------------------------------
+
+
+def test_budgeted_dpop_bit_parity_host():
+    from pydcop_tpu.api import solve
+
+    dcop = _overlap_secp()
+    base = solve(dcop, "dpop", {"util_device": "never"})
+    for budget in (256, 128):
+        r = solve(
+            dcop, "dpop", {"util_device": "never"},
+            max_util_bytes=budget,
+        )
+        assert r["cost"] == base["cost"]
+        assert r["assignment"] == base["assignment"]
+        assert r["status"] == "finished"
+        mb = r["membound"]
+        assert mb["peak_table_bytes"] <= budget
+        assert mb["naive_peak_table_bytes"] > budget
+    # the tighter budget needs >= 2 nested cut variables
+    tight = solve(
+        dcop, "dpop", {"util_device": "never"}, max_util_bytes=64
+    )
+    assert tight["cost"] == base["cost"]
+    assert tight["membound"]["cut_width"] >= 2
+    assert tight["membound"]["cut_lanes"] >= 9
+
+
+def test_budgeted_dpop_device_bit_parity():
+    from pydcop_tpu.api import solve
+
+    dcop = _overlap_secp()
+    base = solve(dcop, "dpop", {"util_device": "never"})
+    r = solve(
+        dcop, "dpop", {"util_device": "always"},
+        max_util_bytes=256, pad_policy="pow2",
+    )
+    assert r["cost"] == base["cost"]
+    assert r["assignment"] == base["assignment"]
+    assert r["util_device_nodes"] >= 1
+    assert r["membound"]["on_device"] is True
+    assert r["membound"]["cut_width"] >= 1
+
+
+def test_budgeted_infer_parity_all_semirings():
+    """max_sum (map) exact, log_sum_exp within the reported bound,
+    marginals allclose — budgeted vs the unbounded host-f64
+    reference, device forced on for the budgeted run."""
+    from pydcop_tpu.api import infer
+
+    dcop = _overlap_secp()
+    kw = dict(
+        device="always", pad_policy="pow2", max_util_bytes=128,
+        tol=float("inf"),
+    )
+    mp0 = infer(dcop, "map", device="never")
+    mp1 = infer(dcop, "map", **kw)
+    assert mp1["cost"] == mp0["cost"]
+    assert mp1["assignment"] == mp0["assignment"]
+    assert mp1["membound"]["cut_width"] >= 2  # nested cut
+
+    z0 = infer(dcop, "log_z", device="never")
+    z1 = infer(dcop, "log_z", **kw)
+    assert (
+        abs(z1["log_z"] - z0["log_z"])
+        <= z1["error_bound"] + z0["error_bound"] + 1e-9
+    )
+
+    m0 = infer(dcop, "marginals", device="never")
+    m1 = infer(dcop, "marginals", device="never", max_util_bytes=128)
+    assert set(m1["marginals"]) == set(m0["marginals"])
+    for v in m0["marginals"]:
+        assert np.allclose(
+            m0["marginals"][v], m1["marginals"][v], atol=1e-8
+        ), v
+
+
+def test_infer_many_budgeted_merged_matches_sequential():
+    from pydcop_tpu.api import infer, infer_many
+
+    dcops = [_overlap_secp(seed=77), _overlap_secp(seed=78)]
+    merged = infer_many(
+        dcops, "log_z", device="never", max_util_bytes=256
+    )
+    for d, r in zip(dcops, merged):
+        solo = infer(d, "log_z", device="never", max_util_bytes=256)
+        assert r["log_z"] == solo["log_z"]
+        assert r["membound"]["cut"] == solo["membound"]["cut"]
+        assert r["instances_batched"] == 2
+
+
+# -- cross-edge consistency pruning -------------------------------------
+
+
+def test_cross_edge_pruning_exact_and_counted():
+    from pydcop_tpu.api import infer, solve
+
+    dcop = _hard_chain()
+    big = 1 << 20  # budget met without cuts: pruning alone
+    z0 = infer(dcop, "log_z", device="never")
+    z1 = infer(dcop, "log_z", device="never", max_util_bytes=big)
+    assert z1["membound"]["pruned_cells"] > 0
+    assert abs(z1["log_z"] - z0["log_z"]) < 1e-9
+
+    m0 = infer(dcop, "marginals", device="never")
+    m1 = infer(
+        dcop, "marginals", device="never", max_util_bytes=big
+    )
+    for v in m0["marginals"]:
+        # full original-domain length, exactly 0 at pruned values
+        assert len(m1["marginals"][v]) == len(m0["marginals"][v])
+        assert np.allclose(
+            m0["marginals"][v], m1["marginals"][v], atol=1e-12
+        )
+    assert m1["marginals"]["v0"][2] == 0.0
+
+    r0 = solve(dcop, "dpop", {"util_device": "never"})
+    r1 = solve(
+        dcop, "dpop", {"util_device": "never"}, max_util_bytes=big
+    )
+    assert r1["cost"] == r0["cost"]
+    assert r1["membound"]["pruned_cells"] > 0
+
+
+# -- sizing error (the actionable over-width report) ---------------------
+
+
+def test_membound_error_reports_sizing_not_a_retry_hint():
+    from pydcop_tpu.api import infer
+    from pydcop_tpu.ops.membound import MemboundError
+
+    d = Domain("d", "", list(range(5)))
+    dcop = DCOP("wide_chain")
+    vs = [Variable(f"v{i}", d) for i in range(12)]
+    for v in vs:
+        dcop.add_variable(v)
+    t = np.random.default_rng(0).random((5, 5))
+    for i in range(11):
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[i], vs[i + 1]], t, name=f"c{i}")
+        )
+    dcop.add_agents([AgentDef("a0")])
+    with pytest.raises(MemboundError) as ei:
+        infer(dcop, "log_z", device="never", max_util_bytes=4)
+    e = ei.value
+    assert e.max_util_bytes == 4
+    assert e.naive_peak_bytes == 100  # 5*5 cells * 4 bytes
+    assert e.cut_width >= 1
+    msg = str(e)
+    assert "bytes" in msg and "max_util_bytes=4" in msg
+    assert "width" in msg
+
+
+# -- OOM ladder: replanning ----------------------------------------------
+
+
+def test_oom_replan_deterministic_and_stays_on_device():
+    """Injected ``device_oom_bytes`` makes per-lane tables over the
+    cap OOM: the budgeted sweep must RE-PLAN at half budget
+    (``membound.replans`` >= 1) instead of falling straight to host,
+    still bit-match the fault-free run, and replay identically."""
+    from pydcop_tpu.api import solve
+
+    # levels=4: d = 4 sits exactly on the pow-2 lattice, so planned
+    # table bytes == dispatched table bytes and the injected bytes
+    # cap reads directly against the plan
+    dcop = _overlap_secp(levels=4)
+    clean = solve(
+        dcop, "dpop", {"util_device": "always"},
+        max_util_bytes=1024, pad_policy="pow2",
+    )
+    runs = [
+        solve(
+            dcop, "dpop", {"util_device": "always"},
+            max_util_bytes=1024, pad_policy="pow2",
+            chaos="device_oom_bytes=500", chaos_seed=5,
+        )
+        for _ in range(2)
+    ]
+    for r in runs:
+        assert r["cost"] == clean["cost"]
+        assert r["assignment"] == clean["assignment"]
+        assert r["membound"]["replans"] >= 1
+        assert r["membound"]["on_device"] is True
+        assert r["membound"]["budget_bytes"] < 1024
+        counters = r["telemetry"]["counters"]
+        assert counters.get("membound.replans", 0) >= 1
+        assert counters.get("fault.device_oom", 0) >= 1
+    # deterministic: the replayed run reproduces plan AND outcome
+    assert runs[0]["membound"] == runs[1]["membound"]
+    assert runs[0]["cost"] == runs[1]["cost"]
+
+
+def test_oom_replan_bottoms_out_to_bounded_host():
+    """A capacity no plan can fit (every device table > cap) walks
+    the whole ladder and lands on bounded host f64 — still exact,
+    never an exception."""
+    from pydcop_tpu.api import solve
+
+    dcop = _overlap_secp()
+    base = solve(dcop, "dpop", {"util_device": "never"})
+    r = solve(
+        dcop, "dpop", {"util_device": "always"},
+        max_util_bytes=256, pad_policy="pow2",
+        chaos="device_oom_bytes=4", chaos_seed=1,
+    )
+    assert r["cost"] == base["cost"]
+    assert r["membound"]["on_device"] is False
+    assert r["membound"]["replans"] >= 1
+    assert r["util_device_nodes"] == 0
+
+
+# -- surfaces ------------------------------------------------------------
+
+
+def test_pruning_keeps_neg_inf_optima():
+    """-inf is an infinitely GOOD cost (a legitimate hard-constraint
+    value — docs/faults.md): cross-edge pruning must only remove
+    +inf-supported values, never the -inf optimum."""
+    from pydcop_tpu.api import solve
+
+    d = Domain("d", "", [0, 1])
+    dcop = DCOP("mixed_inf")
+    x, y = Variable("x", d), Variable("y", d)
+    dcop.add_variable(x)
+    dcop.add_variable(y)
+    dcop.add_constraint(
+        NAryMatrixRelation(
+            [x, y],
+            np.array([[np.inf, -np.inf], [0.0, 0.0]]),
+            name="c",
+        )
+    )
+    dcop.add_agents([AgentDef("a0")])
+    base = solve(dcop, "dpop", {"util_device": "never"})
+    r = solve(
+        dcop, "dpop", {"util_device": "never"},
+        max_util_bytes=1 << 20,
+    )
+    assert r["cost"] == base["cost"] == -np.inf
+    assert r["assignment"] == base["assignment"]
+
+
+def test_solve_rejects_budget_without_a_bounded_plan():
+    from pydcop_tpu.api import solve
+
+    with pytest.raises(ValueError, match="max_util_bytes"):
+        solve(_overlap_secp(), "dsa", max_util_bytes=1024)
+
+
+def test_non_positive_budget_rejected_everywhere():
+    """An explicit budget of 0 must error, not silently run the
+    naive unbounded sweep (the OOM the caller tried to prevent)."""
+    from pydcop_tpu.api import infer, solve
+    from pydcop_tpu.engine.service import SolverService
+
+    dcop = _overlap_secp()
+    with pytest.raises(ValueError, match="must be > 0"):
+        solve(dcop, "dpop", max_util_bytes=0)
+    with pytest.raises(ValueError, match="must be > 0"):
+        infer(dcop, "log_z", device="never", max_util_bytes=0)
+    with SolverService(max_wait=0.05) as svc:
+        with pytest.raises(ValueError, match="must be > 0"):
+            svc.submit(dcop, "dpop", max_util_bytes=0)
+
+
+def test_memory_bound_and_max_util_bytes_are_exclusive():
+    from pydcop_tpu.api import solve
+
+    with pytest.raises(ValueError, match="bounded-memory"):
+        solve(
+            _overlap_secp(), "dpop",
+            {"memory_bound": 4096, "max_util_bytes": 1024},
+        )
+
+
+def test_solve_many_budgeted_matches_sequential():
+    from pydcop_tpu.api import solve, solve_many
+
+    dcops = [_overlap_secp(seed=77), _overlap_secp(seed=78)]
+    params = {"util_device": "never", "max_util_bytes": 256}
+    many = solve_many(dcops, "dpop", params)  # pad defaults to pow2
+    for d, r in zip(dcops, many):
+        # the planner sizes targets on the PAD lattice, so the solo
+        # reference must run under solve_many's pad default
+        solo = solve(d, "dpop", params, pad_policy="pow2")
+        assert r["cost"] == solo["cost"]
+        assert r["assignment"] == solo["assignment"]
+        assert r["membound"] == solo["membound"]
+
+
+def test_service_request_schema_carries_max_util_bytes():
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.engine.service import SolverService
+
+    dcop = _overlap_secp()
+    # the reference must run under the service's pad default (pow2):
+    # the planner sizes targets on the pad lattice
+    ref = solve(
+        dcop, "dpop", {"util_device": "never"},
+        max_util_bytes=256, pad_policy="pow2",
+    )
+    with SolverService(max_wait=0.05) as svc:
+        out = svc.solve(
+            dcop, "dpop", {"util_device": "never"},
+            max_util_bytes=256,
+        )
+        with pytest.raises(ValueError, match="max_util_bytes"):
+            svc.submit(dcop, "dsa", max_util_bytes=256)
+    assert out["cost"] == ref["cost"]
+    assert out["membound"] == ref["membound"]
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

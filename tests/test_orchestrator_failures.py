@@ -18,6 +18,7 @@ import sys
 import time
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -72,6 +73,14 @@ def _parse_json_tail(text):
     return json.loads(text[start:])
 
 
+# multi-process jax.distributed gauntlets — failing since seed on
+# this CPU-only image ("Multiprocess computations aren't implemented
+# on the CPU backend", ROADMAP open item 5), `slow` for the same
+# reason as test_elastic's (PR 6) and test_orchestrator's: in tier-1
+# they only burned budget re-reporting a known image limitation.
+
+
+@pytest.mark.slow
 def test_agent_sigkill_fails_orchestrator_fast(tmp_path):
     yaml_file = tmp_path / "ring.yaml"
     yaml_file.write_text(_ring_yaml())
@@ -141,6 +150,7 @@ def test_agent_sigkill_fails_orchestrator_fast(tmp_path):
                 p.wait()
 
 
+@pytest.mark.slow  # multi-process jax.distributed — see note above
 def test_scenario_across_processes_matches_inprocess(tmp_path):
     yaml_file = tmp_path / "ring.yaml"
     yaml_file.write_text(_ring_yaml(n_agents=24))

@@ -154,5 +154,23 @@ def test_semiring_guard_swap_reuses_buckets():
     assert report["repeat_compiles"] == 0, report
 
 
+@pytest.mark.membound
+def test_membound_guard_budgeted_solve_reuses_buckets():
+    """Memory-bounded solves (ops/membound.py): the first budgeted
+    solve compiles within its recorded budget (cut lanes share the
+    level-pack stack), an identical repeat compiles ZERO, a second
+    budget reuses the buckets, and every budgeted result is
+    bit-identical to the unbounded solve.  See
+    tools/recompile_guard.py:run_membound_guard."""
+    guard = _load_guard()
+    report = guard.run_membound_guard()
+    assert report["ok"], report
+    assert report["b1_compiles"] >= 1, report  # guard actually ran
+    assert report["b1_compiles"] <= guard.MEMBOUND_BUDGET, report
+    assert report["repeat_compiles"] == 0, report
+    assert report["b2_compiles"] <= report["b1_compiles"], report
+    assert report["cut_width"] >= 1, report
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

@@ -125,6 +125,22 @@ DPOP_BUDGET = 5
 # error bound.
 SEMIRING_K = 4
 
+# memory-bounded contraction (ops/membound.py): an OVERLAP-zone SECP
+# (chained windows — the high-induced-width shape tiled zones can
+# never produce) solved with max_util_bytes forcing a cut set.  Cut
+# lanes are conditioned copies with IDENTICAL shapes, so they ride
+# the level-pack stack: the first budgeted solve compiles one kernel
+# set for the conditioned buckets (MEMBOUND_BUDGET — the added cut
+# axes are the only new shapes vs the unbounded sweep), an identical
+# repeat compiles ZERO, and a SECOND, tighter budget — which here
+# picks a genuinely WIDER cut (width 6 vs 3) — still compiles at
+# most the first budget's count.  The budgeted result must be
+# bit-identical to the unbounded solve — the whole point of exact
+# memory bounding.
+MEMBOUND_B1 = 256
+MEMBOUND_B2 = 128
+MEMBOUND_BUDGET = 12  # recorded: 11 compiles for the 64-lane sweep
+
 
 def _build_dcop():
     from pydcop_tpu.dcop.dcop import DCOP
@@ -911,6 +927,148 @@ def run_semiring_guard() -> dict:
     return report
 
 
+def _build_secp_overlap(
+    n_lights: int, n_models: int, levels: int, seed: int,
+    arity: int = 4, stride: int = 2,
+):
+    """Fixed-structure OVERLAP-zone SECP: model ``m``'s window starts
+    at ``m * stride`` (consecutive windows share ``arity - stride``
+    lights), chaining the strip into one band whose induced width the
+    memory-bounded planner must cut — the deliberately-deep twin of
+    :func:`_build_secp`'s shallow consecutive windows.  Deterministic
+    scopes, per-seed targets/rules."""
+    import itertools
+    import random
+
+    import numpy as np
+
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rnd = random.Random(seed)
+    dcop = DCOP(f"secp_overlap_guard_{n_lights}_{seed}")
+    lum = Domain("lum", "", list(range(levels)))
+    lights = [Variable(f"l{i}", lum) for i in range(n_lights)]
+    for i, v in enumerate(lights):
+        dcop.add_variable(v)
+        dcop.add_constraint(
+            NAryMatrixRelation(
+                [v],
+                np.arange(levels, dtype=np.float64)
+                * rnd.uniform(0.05, 0.2),
+                name=f"eff_{i}",
+            )
+        )
+    for m in range(n_models):
+        scope = lights[(m * stride) % (n_lights - arity + 1):][:arity]
+        target = rnd.uniform(0.3, 1.0) * arity * (levels - 1)
+        matrix = np.zeros((levels,) * arity, dtype=np.float64)
+        for idx in itertools.product(range(levels), repeat=arity):
+            matrix[idx] = abs(sum(idx) - target)
+        dcop.add_constraint(
+            NAryMatrixRelation(scope, matrix, name=f"mod{m}")
+        )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n_lights)])
+    return dcop
+
+
+def run_membound_guard() -> dict:
+    """Compile/parity budget for memory-bounded solves
+    (``ops/membound.py``): on one overlap-SECP instance with the
+    device forced on, (1) a budgeted solve whose cut lanes ride the
+    level-pack stack compiles at most :data:`MEMBOUND_BUDGET`
+    kernels, (2) an IDENTICAL repeat compiles ZERO, (3) a SECOND
+    budget reuses the buckets (<= the first budget's count), and
+    (4) every budgeted result is bit-identical (cost AND assignment)
+    to the unbounded solve.  Regressions this catches: lane shapes
+    churning per budget (cut axes leaking into un-cut buckets), the
+    kernel cache keying on lane count, and any budgeted-path drift
+    from the exact unbounded answer."""
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.ops import semiring as sr_mod
+    from pydcop_tpu.telemetry import session
+
+    # cold start for the shared contraction-kernel cache, same
+    # reason as the other guards
+    sr_mod._KERNELS.clear()
+
+    dcop = _build_secp_overlap(12, 10, 3, seed=77)
+    params = {"util_device": "always"}
+    kw = dict(pad_policy="pow2")
+
+    def compiles(tel):
+        return int(tel.summary()["counters"].get("jit.compiles", 0))
+
+    base = solve(dcop, "dpop", {"util_device": "never"})
+    with session() as t1:
+        r1 = solve(
+            dcop, "dpop", params, max_util_bytes=MEMBOUND_B1, **kw
+        )
+    with session() as t2:
+        r1b = solve(
+            dcop, "dpop", params, max_util_bytes=MEMBOUND_B1, **kw
+        )
+    with session() as t3:
+        r2 = solve(
+            dcop, "dpop", params, max_util_bytes=MEMBOUND_B2, **kw
+        )
+    b1_compiles, repeat_compiles, b2_compiles = (
+        compiles(t1), compiles(t2), compiles(t3)
+    )
+    report = {
+        "b1_compiles": b1_compiles,
+        "repeat_compiles": repeat_compiles,
+        "b2_compiles": b2_compiles,
+        "budget": MEMBOUND_BUDGET,
+        "cut_width": r1["membound"]["cut_width"],
+        "cut_lanes": r1["membound"]["cut_lanes"],
+        "cut_width_b2": r2["membound"]["cut_width"],
+        "device_nodes": r1["util_device_nodes"],
+        "cost": r1["cost"],
+        "ok": True,
+    }
+    if r1["membound"]["cut_width"] < 1 or r1["util_device_nodes"] < 1:
+        report["ok"] = False
+        report["error"] = (
+            "the budget forced no cut (or nothing reached the "
+            "device) — the guard is vacuous"
+        )
+    elif not (
+        base["cost"] == r1["cost"] == r1b["cost"] == r2["cost"]
+        and base["assignment"]
+        == r1["assignment"]
+        == r1b["assignment"]
+        == r2["assignment"]
+    ):
+        report["ok"] = False
+        report["error"] = (
+            "budgeted result diverges from the unbounded solve "
+            f"({base['cost']} vs {r1['cost']}/{r2['cost']}) — exact "
+            "memory bounding stopped being exact"
+        )
+    elif b1_compiles > MEMBOUND_BUDGET:
+        report["ok"] = False
+        report["error"] = (
+            f"{b1_compiles} compiles > budget {MEMBOUND_BUDGET} — "
+            "cut lanes stopped sharing level-pack buckets"
+        )
+    elif repeat_compiles != 0:
+        report["ok"] = False
+        report["error"] = (
+            f"{repeat_compiles} new compile(s) on an identical "
+            "repeat — the budgeted kernel cache key is unstable"
+        )
+    elif b2_compiles > b1_compiles:
+        report["ok"] = False
+        report["error"] = (
+            f"second budget compiled {b2_compiles} > first's "
+            f"{b1_compiles} — re-budgeting churns the buckets "
+            "instead of reusing them"
+        )
+    return report
+
+
 def main() -> int:
     import jax
 
@@ -923,6 +1081,7 @@ def main() -> int:
     report_sup = run_supervisor_guard()
     report_service = run_service_guard()
     report_semiring = run_semiring_guard()
+    report_membound = run_membound_guard()
     report_restore = run_restore_guard()
     print(
         json.dumps(
@@ -933,6 +1092,7 @@ def main() -> int:
                 "supervisor": report_sup,
                 "service": report_service,
                 "semiring": report_semiring,
+                "membound": report_membound,
                 "restore": report_restore,
             }
         )
@@ -945,6 +1105,7 @@ def main() -> int:
         and report_sup["ok"]
         and report_service["ok"]
         and report_semiring["ok"]
+        and report_membound["ok"]
         and report_restore["ok"]
         else 1
     )
