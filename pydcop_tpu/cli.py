@@ -36,6 +36,8 @@ COMMANDS = [
     "worker",
     # resident continuous-batching solver service (docs/serving.md)
     "serve",
+    # graftlint invariant checks (tools/graftlint, docs/linting.md)
+    "lint",
     # telemetry trace aggregation (module trace_summary registers the
     # subcommand as `trace-summary`)
     "trace_summary",

@@ -85,6 +85,15 @@ def run_cmd(args) -> int:
                 "service's frame loop — use `pydcop_tpu serve "
                 "--chaos` (docs/serving.md)"
             )
+        if plan.device_faults_configured:
+            # same inert-clause rule for the device layer: a host
+            # agent has no supervised device dispatch to inject into
+            raise SystemExit(
+                "agent: device-layer chaos kinds (device_oom/"
+                "device_oom_bytes/device_transient/nan_inject) "
+                "inject at the batched engine's supervised dispatch "
+                "— use `solve`/`run --chaos` (docs/faults.md)"
+            )
     if len(args.names) > 1:
         # one OS process per agent: each is an independent
         # jax.distributed participant, so fork real subprocesses

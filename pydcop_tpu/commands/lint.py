@@ -1,0 +1,82 @@
+"""``pydcop_tpu lint`` — run graftlint, the AST-based invariant
+linter (``tools/graftlint/``, ``docs/linting.md``).
+
+Machine-checks the contracts reviewer vigilance kept missing: the
+jax-free import surface, determinism purity of seeded scopes,
+chaos-spec symmetry across entry points, telemetry/doc drift, and
+trace-key stability.  Findings diff against the recorded baseline
+(``tools/graftlint_baseline.json``); exit 1 on any NEW finding.
+
+The linter lives under ``tools/`` (it lints the repository, it is not
+part of the package), so this command needs a source checkout — it
+locates ``tools/graftlint`` next to the ``pydcop_tpu`` package.
+Parser and scan are stdlib-``ast``-only: linting the jax-free surface
+never imports jax (``tests/test_import_time.py`` pins this).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "lint",
+        help="run graftlint: machine-check determinism, import-"
+        "hygiene, chaos-symmetry, telemetry and trace-key contracts "
+        "(docs/linting.md)",
+    )
+    p.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable findings (file, line, rule id, "
+        "message) for CI annotation",
+    )
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help="pin the current findings into "
+        "tools/graftlint_baseline.json (existing justifications "
+        "kept; new entries marked TODO for review)",
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="baseline file (default: tools/graftlint_baseline.json)",
+    )
+    p.add_argument(
+        "--root", default=None, metavar="DIR",
+        help="project root (default: the checkout containing the "
+        "pydcop_tpu package)",
+    )
+    p.add_argument(
+        "--rule", action="append", default=None, metavar="RULE_ID",
+        help="run only this rule (repeatable; see docs/linting.md "
+        "for the catalog)",
+    )
+    p.set_defaults(func=run_cmd)
+
+
+def _find_root(explicit) -> Path:
+    if explicit:
+        return Path(explicit).resolve()
+    import pydcop_tpu
+
+    return Path(pydcop_tpu.__file__).resolve().parent.parent
+
+
+def run_cmd(args) -> int:
+    root = _find_root(args.root)
+    tools_dir = root / "tools"
+    if not (tools_dir / "graftlint" / "__init__.py").is_file():
+        raise SystemExit(
+            f"lint: {tools_dir}/graftlint not found — graftlint runs "
+            "from a source checkout (pass --root, or run from the "
+            "repository)"
+        )
+    if str(tools_dir) not in sys.path:
+        sys.path.insert(0, str(tools_dir))
+    from graftlint.cli import run as graftlint_run
+
+    # reuse the tool's own runner so `pydcop_tpu lint` and
+    # `python tools/graftlint/cli.py` cannot drift apart
+    args.root = str(root)
+    return graftlint_run(args)

@@ -181,6 +181,15 @@ def run_cmd(args) -> int:
                 "service's frame loop — use `pydcop_tpu serve "
                 "--chaos` (docs/serving.md)"
             )
+        if plan.device_faults_configured:
+            # same inert-clause rule for the device layer: the host
+            # orchestrator runtime has no supervised device dispatch
+            raise SystemExit(
+                "orchestrator: device-layer chaos kinds (device_oom/"
+                "device_oom_bytes/device_transient/nan_inject) "
+                "inject at the batched engine's supervised dispatch "
+                "— use `solve`/`run --chaos` (docs/faults.md)"
+            )
     placement = None
     dist_name = None
     if args.distribution:

@@ -1,0 +1,9 @@
+"""Clean entry point: both live categories accepted-or-rejected."""
+
+
+def run(plan):
+    if plan.message_faults_configured:
+        raise ValueError("message kinds not supported here")
+    if plan.device_faults_configured:
+        raise ValueError("device kinds not supported here")
+    return "ok"

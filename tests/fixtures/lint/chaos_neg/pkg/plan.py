@@ -1,0 +1,28 @@
+"""Clean chaos plan module: every parsed kind classified (fixture
+config), every field readable through ``configured``."""
+
+import re
+from dataclasses import dataclass
+
+_CLAUSE = re.compile(r"^(?P<key>drop|delay)=(?P<val>[^=]+)$")
+
+
+@dataclass(frozen=True)
+class DeviceFaults:
+    zap: float = 0.0
+    zap_after: int = 0
+
+    @property
+    def configured(self) -> bool:
+        return self.zap > 0.0
+
+
+class FaultPlan:
+    @classmethod
+    def from_spec(cls, spec, seed=0):
+        for clause in spec.split(","):
+            if clause.startswith("zap="):
+                continue
+            if not _CLAUSE.match(clause):
+                raise ValueError(clause)
+        return cls()

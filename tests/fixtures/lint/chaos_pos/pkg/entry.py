@@ -1,0 +1,9 @@
+"""VIOLATES chaos-symmetry: this entry point validates message kinds
+but never consults the device predicate — a `zap=` clause would be
+silently ignored."""
+
+
+def run(plan):
+    if plan.message_faults_configured:
+        raise ValueError("message kinds not supported here")
+    return "ok"

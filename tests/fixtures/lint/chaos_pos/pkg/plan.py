@@ -1,0 +1,30 @@
+"""VIOLATES chaos-symmetry (the `boom` kind is unclassified in the
+fixture config) and chaos-inert-field (`fizzle` never flips
+``configured``)."""
+
+import re
+from dataclasses import dataclass
+
+_CLAUSE = re.compile(r"^(?P<key>drop|delay)=(?P<val>[^=]+)$")
+
+
+@dataclass(frozen=True)
+class DeviceFaults:
+    zap: float = 0.0
+    zap_after: int = 0  # modifier: exempt from the inert check
+    fizzle: float = 0.0  # parses but never read below: INERT
+
+    @property
+    def configured(self) -> bool:
+        return self.zap > 0.0
+
+
+class FaultPlan:
+    @classmethod
+    def from_spec(cls, spec, seed=0):
+        for clause in spec.split(","):
+            if clause.startswith(("zap=", "boom=")):
+                continue
+            if not _CLAUSE.match(clause):
+                raise ValueError(clause)
+        return cls()

@@ -1,0 +1,8 @@
+"""Clean: jax deferred into the function that needs it, the approved
+pattern for the jax-free surface."""
+
+
+def solve():
+    import jax
+
+    return jax.numpy.zeros(1)

@@ -1,0 +1,14 @@
+"""Clean PEP-562 table: the lazily exposed module is imported only
+inside ``__getattr__``."""
+
+_LAZY = {"thing"}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import pkg.lazy.impl as _impl
+
+        return getattr(_impl, name)
+    raise AttributeError(name)

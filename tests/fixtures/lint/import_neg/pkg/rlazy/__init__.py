@@ -1,0 +1,12 @@
+"""Clean relative-style PEP-562 table: ``.impl`` imported only
+inside ``__getattr__``."""
+
+_LAZY = {"thing"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from . import impl as _impl
+
+        return getattr(_impl, name)
+    raise AttributeError(name)

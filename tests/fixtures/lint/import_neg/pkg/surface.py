@@ -1,0 +1,14 @@
+"""Clean: the heavy module is imported inside the function, so the
+module-level chain stays jax-free; TYPE_CHECKING imports never
+execute and are exempt."""
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from pkg.heavy import kernel  # noqa: F401 — typing only
+
+
+def run(x):
+    from pkg.heavy import kernel
+
+    return kernel(x)

@@ -1,0 +1,8 @@
+"""VIOLATES jax-import-surface: direct module-level jax import on a
+module declared jax-free."""
+
+import jax  # the stray eager import the rule exists to catch
+
+
+def solve():
+    return jax.numpy.zeros(1)

@@ -1,0 +1,8 @@
+"""A device-side module: module-level jax is fine HERE (not on the
+declared surface) — it exists to poison the transitive chain."""
+
+import jax
+
+
+def kernel(x):
+    return jax.numpy.asarray(x)

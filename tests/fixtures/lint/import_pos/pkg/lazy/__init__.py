@@ -1,0 +1,15 @@
+"""VIOLATES lazy-init-eager-import: the PEP-562 table lazily exposes
+``pkg.lazy.impl`` — and then eagerly imports it anyway, so the
+laziness is decorative."""
+
+from pkg.lazy.impl import thing  # defeats the table below
+
+_LAZY = {"thing"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import pkg.lazy.impl as _impl
+
+        return getattr(_impl, name)
+    raise AttributeError(name)

@@ -1,0 +1,16 @@
+"""VIOLATES lazy-init-eager-import in the RELATIVE-import style:
+the table lazily exposes ``.impl`` via ``from . import impl`` while
+the body eagerly does ``from .impl import thing`` — same defeat, no
+absolute names anywhere."""
+
+from .impl import thing  # defeats the table below
+
+_LAZY = {"thing"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from . import impl as _impl
+
+        return getattr(_impl, name)
+    raise AttributeError(name)

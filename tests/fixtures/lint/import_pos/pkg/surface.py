@@ -1,0 +1,9 @@
+"""VIOLATES jax-import-surface TRANSITIVELY: no jax import in sight,
+but the module-level import of pkg.heavy drags jax onto the cold
+path — the regression class reviewers miss."""
+
+from pkg.heavy import kernel
+
+
+def run(x):
+    return kernel(x)

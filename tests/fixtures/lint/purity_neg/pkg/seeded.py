@@ -1,0 +1,33 @@
+"""Clean seeded scope: seeded private streams, keyed hashes,
+sorted set iteration, injectable clock references, and ONE audited
+allow-marked exception."""
+
+import hashlib
+import random
+import time
+
+
+def _hashed_unit(seed, key, attempt):
+    h = hashlib.blake2b(
+        f"{seed}|{key}|{attempt}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(h, "big") / 2.0**64
+
+
+def decide(seed, link, seq):
+    rnd = random.Random(seed)  # seeded PRIVATE stream: approved
+    return rnd.random() + _hashed_unit(seed, link, seq)
+
+
+def fan_out(agents):
+    return [a for a in sorted(set(agents))]  # sorted: approved
+
+
+def wait(sleep=time.sleep, clock=time.monotonic):
+    # references as injectable defaults are fine — only calls count
+    return sleep, clock
+
+
+def nonce():
+    # graftlint: allow[impure-call] — audited: uniqueness is the point
+    return time.time_ns()

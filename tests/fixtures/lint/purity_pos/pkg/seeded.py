@@ -1,0 +1,18 @@
+"""VIOLATES impure-call and set-iteration inside a seeded scope."""
+
+import random
+import time
+
+
+def decide(seed, link, seq):
+    jitter = time.time()  # wall clock in a replay path
+    pick = random.choice([0, 1])  # bare module stream
+    return (jitter, pick)
+
+
+def fan_out(agents):
+    order = []
+    for a in {"a1", "a2", "a3"}:  # hash order escapes into order
+        order.append(a)
+    first = list(set(agents))  # same escape, list() spelling
+    return order, first

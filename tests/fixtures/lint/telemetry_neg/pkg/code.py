@@ -1,0 +1,10 @@
+"""Clean: every emission documented, no stale rows."""
+
+
+def record(met, kind):
+    if met.enabled:
+        met.inc("foo.hits")
+        met.inc("foo.requests")
+        met.inc("foo.runner_cache_hits")
+        met.inc("foo.runner_cache_misses")
+        met.inc(f"bar.{kind}")

@@ -1,0 +1,11 @@
+"""VIOLATES metric-undocumented: emits `foo.hits` which the fixture
+doc never mentions (the doc's stale `foo.gone` row violates
+metric-stale-doc, and the plan/doc clause mismatch violates
+chaos-clause-doc)."""
+
+
+def record(met, kind):
+    if met.enabled:
+        met.inc("foo.hits")
+        met.inc("foo.requests")
+        met.inc(f"bar.{kind}")

@@ -1,0 +1,16 @@
+"""Clean runner builder: captured state is an immutable tuple, and
+mutable containers stay OUT of the jitted closure (threaded through
+the traced arguments instead)."""
+
+from pkg.telemetry import profiled_jit
+
+
+def build_runner(tables):
+    shapes = tuple(t.shape for t in tables)  # immutable capture: fine
+    scratch = []  # mutable, but never captured by the jitted fn
+
+    def step(state, tables_in):
+        return state + len(shapes), tables_in
+
+    scratch.append(shapes)
+    return profiled_jit(step, label="runner")

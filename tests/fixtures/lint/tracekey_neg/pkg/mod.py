@@ -1,0 +1,9 @@
+"""Clean: everything routes through profiled_jit; the sanctioned
+helper module (pkg/helper.py in the fixture config) may call jax.jit
+directly."""
+
+from pkg.telemetry import profiled_jit
+
+
+def build(fn):
+    return profiled_jit(fn, label="mod.build")

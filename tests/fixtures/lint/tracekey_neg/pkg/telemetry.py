@@ -1,0 +1,2 @@
+def profiled_jit(fn, **kw):
+    return fn

@@ -1,0 +1,13 @@
+"""VIOLATES unhashable-closure: the cached runner builder jits a
+function closing over a dict local the cache key cannot see."""
+
+from pkg.telemetry import profiled_jit
+
+
+def build_runner(tables):
+    opts = {"damping": 0.5}  # mutable: invisible to the cache key
+
+    def step(state):
+        return state * opts["damping"]
+
+    return profiled_jit(step, label="runner")

@@ -1,0 +1,20 @@
+"""VIOLATES bare-jit: direct jax.jit outside the sanctioned cache
+helpers (and a partial-wrapped one)."""
+
+import functools
+
+import jax
+
+
+def build(fn):
+    return jax.jit(fn)
+
+
+def build_partial(fn):
+    wrap = functools.partial(jax.jit, static_argnums=(1,))
+    return wrap(fn)
+
+
+@jax.jit
+def decorated(x):
+    return x

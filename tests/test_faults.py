@@ -573,3 +573,41 @@ def test_run_command_chaos_crash_schedule(tmp_path):
     )
     assert bad.returncode != 0
     assert "no message plane" in bad.stderr
+
+
+def test_agent_and_orchestrator_reject_device_and_wire_chaos_kinds():
+    """The chaos-spec symmetry contract at the host CLIs: a clause
+    neither runtime can inject must be REJECTED, never silently
+    ignored (graftlint's chaos-symmetry rule pins the static side of
+    this; here the runtime behavior).  A device-layer kind on the
+    host agent/orchestrator would otherwise record the plan as
+    applied while injecting nothing."""
+    from pydcop_tpu.cli import main
+
+    for argv, needle in [
+        (
+            ["agent", "--names", "a1", "--orchestrator",
+             "127.0.0.1:1", "--runtime", "host",
+             "--chaos", "device_oom=4"],
+            "device-layer",
+        ),
+        (
+            ["agent", "--names", "a1", "--orchestrator",
+             "127.0.0.1:1", "--runtime", "host",
+             "--chaos", "conn_drop=0.5"],
+            "wire-level",
+        ),
+        (
+            ["orchestrator", "-a", "dsa", "--runtime", "host",
+             "--chaos", "nan_inject=0.5", "nope.yaml"],
+            "device-layer",
+        ),
+        (
+            ["orchestrator", "-a", "dsa", "--runtime", "host",
+             "--chaos", "frame_corrupt=1", "nope.yaml"],
+            "wire-level",
+        ),
+    ]:
+        with pytest.raises(SystemExit) as exc:
+            main(argv)
+        assert needle in str(exc.value), (argv, exc.value)

@@ -1,0 +1,363 @@
+"""Per-rule fixture tests for graftlint (``tools/graftlint/``).
+
+Each rule gets a POSITIVE fixture (a violating mini-module that must
+fire) and a NEGATIVE one (a clean mini-module that must stay quiet)
+under ``tests/fixtures/lint/``, exercised against fixture-local
+configs — rules read only the :class:`LintConfig` they are handed, so
+these tests are independent of the real repository contract (which
+``tests/test_lint_guard.py`` covers).
+
+Also here: the allow-comment escape hatch, the baseline round-trip
+(``--update-baseline`` then a clean run), justification preservation,
+and the ``--json`` CI schema.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+_FIXTURES = os.path.join(_REPO, "tests", "fixtures", "lint")
+
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from graftlint import LintConfig, scan  # noqa: E402
+from graftlint.cli import main as lint_main  # noqa: E402
+
+
+_RULE_FAMILIES = {
+    "import": ("jax-import-surface", "lazy-init-eager-import"),
+    "purity": ("impure-call", "set-iteration"),
+    "chaos": ("chaos-symmetry", "chaos-inert-field"),
+    "telemetry": (
+        "metric-undocumented",
+        "metric-stale-doc",
+        "chaos-clause-doc",
+    ),
+    "tracekey": ("bare-jit", "unhashable-closure"),
+}
+
+
+def _scan_family(fixture, family, **overrides):
+    """Scan one fixture with only its rule family enabled — each
+    family's fixtures are minimal for THEIR rules, not the others'."""
+    return scan(
+        _fixture_config(fixture, **overrides),
+        rules=_RULE_FAMILIES[family],
+    )
+
+
+def _fixture_config(name, **overrides):
+    base = dict(
+        root=os.path.join(_FIXTURES, name),
+        scan_roots=("pkg",),
+        package="pkg",
+        jax_free_surface=(),
+        seeded_modules=(),
+        chaos_plan_module="pkg/plan.py",
+        chaos_kind_categories={},
+        chaos_entry_points={},
+        metrics_code=(),
+        metrics_docs=(),
+        faults_doc="docs/faults.md",
+        sanctioned_jit_modules=(),
+        runner_builder_modules=(),
+    )
+    base.update(overrides)
+    return LintConfig(**base)
+
+
+def _rules_fired(findings):
+    return {(f.rule, f.path) for f in findings}
+
+
+# -- rule 1: import hygiene ----------------------------------------------
+
+
+_IMPORT_KW = dict(
+    jax_free_surface=(
+        "pkg/api.py",
+        "pkg/surface.py",
+        "pkg/lazy/__init__.py",
+        "pkg/rlazy/__init__.py",
+    ),
+)
+
+
+def test_import_hygiene_fixture_fires():
+    findings = _scan_family("import_pos", "import", **_IMPORT_KW)
+    fired = _rules_fired(findings)
+    assert ("jax-import-surface", "pkg/api.py") in fired  # direct
+    assert ("jax-import-surface", "pkg/surface.py") in fired  # transitive
+    assert ("lazy-init-eager-import", "pkg/lazy/__init__.py") in fired
+    # the RELATIVE-import lazy style must be matched too (lazy and
+    # eager sides resolved into the same absolute namespace)
+    assert ("lazy-init-eager-import", "pkg/rlazy/__init__.py") in fired
+    # the transitive finding names the chain, not just the fact
+    transitive = next(
+        f for f in findings if f.path == "pkg/surface.py"
+    )
+    assert "pkg/heavy.py" in transitive.message
+    # heavy.py is OFF the surface: module-level jax is legal there
+    assert not any(f.path == "pkg/heavy.py" for f in findings)
+
+
+def test_import_hygiene_fixture_quiet():
+    assert _scan_family("import_neg", "import", **_IMPORT_KW) == []
+
+
+# -- rule 2: determinism purity ------------------------------------------
+
+
+_PURITY_KW = dict(seeded_modules=("pkg/seeded.py",))
+
+
+def test_purity_fixture_fires():
+    findings = _scan_family("purity_pos", "purity", **_PURITY_KW)
+    details = {(f.rule, f.detail) for f in findings}
+    assert ("impure-call", "time.time@decide") in details
+    assert ("impure-call", "random.choice@decide") in details
+    assert any(
+        r == "set-iteration" and d.startswith("for-loop@fan_out")
+        for r, d in details
+    )
+    assert any(
+        r == "set-iteration" and d.startswith("list()@fan_out")
+        for r, d in details
+    )
+
+
+def test_purity_fixture_quiet_and_allow_marker():
+    # the negative fixture CONTAINS a banned call (time.time_ns) —
+    # under an allow[impure-call] marker, the audited-exception path
+    assert _scan_family("purity_neg", "purity", **_PURITY_KW) == []
+
+
+def test_purity_stale_scope_guard():
+    """A configured purity scope that matches nothing is itself a
+    finding — a renamed seeded function must not silently drop its
+    scope (the parseable-but-inert drift class, applied to the lint
+    config)."""
+    findings = _scan_family(
+        "purity_neg",
+        "purity",
+        seeded_modules=("pkg/seeded.py", "pkg/gone.py"),
+        seeded_functions={"pkg/seeded.py": ("decide", "renamed_away")},
+    )
+    details = {f.detail for f in findings}
+    assert "stale-scope:pkg/gone.py" in details
+    assert "stale-scope:renamed_away" in details
+    # live scopes produce no stale-scope noise
+    assert "stale-scope:decide" not in details
+
+
+# -- rule 3: chaos-spec symmetry -----------------------------------------
+
+
+_CHAOS_KW = dict(
+    chaos_kind_categories={
+        "drop": "message",
+        "delay": "message",
+        "zap": "device",
+    },
+    chaos_entry_points={
+        "pkg/entry.py": {
+            "message": ("message_faults_configured",),
+            "device": ("device_faults_configured",),
+        },
+    },
+)
+
+
+def test_chaos_symmetry_fixture_fires():
+    findings = _scan_family("chaos_pos", "chaos", **_CHAOS_KW)
+    details = {(f.rule, f.detail) for f in findings}
+    # the `boom=` kind is parsed but unclassified in the table
+    assert ("chaos-symmetry", "unclassified:boom") in details
+    # the entry point never consults the device predicate
+    assert ("chaos-symmetry", "category:device") in details
+    # `fizzle` parses but can never flip `configured`
+    assert ("chaos-inert-field", "DeviceFaults.fizzle") in details
+    # the modifier field is exempt
+    assert not any("zap_after" in d for _, d in details)
+
+
+def test_chaos_symmetry_fixture_quiet():
+    assert _scan_family("chaos_neg", "chaos", **_CHAOS_KW) == []
+
+
+def test_chaos_symmetry_stale_table_row():
+    cfg = _fixture_config(
+        "chaos_neg",
+        **{
+            **_CHAOS_KW,
+            "chaos_kind_categories": {
+                **_CHAOS_KW["chaos_kind_categories"],
+                "ghost": "wire",  # classified but no longer parsed
+            },
+        },
+    )
+    details = {
+        (f.rule, f.detail)
+        for f in scan(cfg, rules=_RULE_FAMILIES["chaos"])
+    }
+    assert ("chaos-symmetry", "stale:ghost") in details
+
+
+# -- rule 4: telemetry drift ---------------------------------------------
+
+
+_TELEMETRY_KW = dict(
+    metrics_code=("pkg/*",),
+    metrics_docs=("docs/metrics.md",),
+    chaos_kind_categories={"zap": "device"},
+)
+
+
+def test_telemetry_drift_fixture_fires():
+    findings = _scan_family("telemetry_pos", "telemetry", **_TELEMETRY_KW)
+    details = {(f.rule, f.detail) for f in findings}
+    assert ("metric-undocumented", "foo.hits") in details
+    assert ("metric-stale-doc", "foo.gone") in details
+    assert ("chaos-clause-doc", "undocumented:zap") in details
+    assert ("chaos-clause-doc", "stale:pow") in details
+    # documented + emitted names stay quiet, incl. the f-string family
+    assert not any(d == "foo.requests" for _, d in details)
+    assert not any(d.startswith("bar.") for _, d in details)
+
+
+def test_telemetry_drift_fixture_quiet():
+    assert _scan_family("telemetry_neg", "telemetry", **_TELEMETRY_KW) == []
+
+
+# -- rule 5: trace-key stability -----------------------------------------
+
+
+_TRACEKEY_KW = dict(
+    sanctioned_jit_modules=("pkg/helper.py",),
+    runner_builder_modules=("pkg/builder.py",),
+)
+
+
+def test_tracekey_fixture_fires():
+    findings = _scan_family("tracekey_pos", "tracekey", **_TRACEKEY_KW)
+    details = {(f.rule, f.detail) for f in findings}
+    assert ("bare-jit", "jit@build") in details
+    assert ("bare-jit", "jit@build_partial") in details  # via partial
+    # the canonical plain-decorator spelling (Attribute, not Call)
+    assert ("bare-jit", "jit@decorated") in details
+    assert ("unhashable-closure", "build_runner:opts") in details
+
+
+def test_tracekey_fixture_quiet():
+    assert _scan_family("tracekey_neg", "tracekey", **_TRACEKEY_KW) == []
+
+
+# -- baseline round-trip + CLI schema ------------------------------------
+
+
+def test_baseline_round_trip_and_justifications(tmp_path, capsys):
+    """--update-baseline pins the current findings; an immediately
+    following clean run exits 0; existing justifications survive the
+    rewrite and new entries are marked TODO."""
+    baseline = tmp_path / "baseline.json"
+    # pre-seed ONE justified entry that still exists in the repo
+    baseline.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "findings": {
+                    "bare-jit::tools/bench_gather.py::jit@bench": (
+                        "kept: standalone microbench"
+                    )
+                },
+            }
+        )
+    )
+    rc = lint_main(
+        ["--root", _REPO, "--baseline", str(baseline), "--update-baseline"]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    data = json.loads(baseline.read_text())
+    assert data["version"] == 1
+    assert (
+        data["findings"]["bare-jit::tools/bench_gather.py::jit@bench"]
+        == "kept: standalone microbench"
+    )
+    # anything else pinned by the rewrite is marked for review
+    others = {
+        k: v
+        for k, v in data["findings"].items()
+        if k != "bare-jit::tools/bench_gather.py::jit@bench"
+    }
+    assert all(v.startswith("TODO") for v in others.values())
+    # the round trip: the freshly written baseline scans clean
+    rc = lint_main(["--root", _REPO, "--baseline", str(baseline)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "clean" in out
+
+
+def test_cli_json_schema(tmp_path, capsys):
+    """--json emits (file, line, rule, message) per finding — the CI
+    annotation schema — plus ok/baselined/stale."""
+    baseline = tmp_path / "empty.json"  # nothing pinned: all NEW
+    rc = lint_main(
+        ["--root", _REPO, "--baseline", str(baseline), "--json"]
+    )
+    out = capsys.readouterr().out
+    data = json.loads(out)
+    assert set(data) >= {
+        "ok",
+        "findings",
+        "baselined",
+        "stale",
+        "rules",
+        "scan_seconds",
+    }
+    # the repo's own baselined findings surface as NEW under an empty
+    # baseline, so the schema is exercised on real records
+    assert rc == 1 and data["ok"] is False
+    for f in data["findings"]:
+        assert set(f) == {"rule", "file", "line", "message", "key"}
+        assert isinstance(f["line"], int) and f["line"] >= 1
+    assert "bare-jit" in {f["rule"] for f in data["findings"]}
+
+
+def test_stale_baseline_entry_fails(tmp_path, capsys):
+    """A baseline entry nothing matches any more must FAIL the run —
+    fixed violations leave the baseline in the same PR."""
+    baseline = tmp_path / "stale.json"
+    baseline.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "findings": {
+                    # the real pinned entries, so the run is otherwise
+                    # clean …
+                    "bare-jit::tools/bench_gather.py::jit@bench": "x",
+                    "bare-jit::tools/profile_maxsum.py::jit@_bench": "x",
+                    "bare-jit::tools/profile_maxsum.py::jit@main": "x",
+                    # … plus one pinned ghost
+                    "impure-call::pkg/ghost.py::time.time@gone": "x",
+                },
+            }
+        )
+    )
+    rc = lint_main(["--root", _REPO, "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "stale" in out and "ghost" in out
+
+
+def test_unknown_rule_is_a_usage_error(capsys):
+    rc = lint_main(["--root", _REPO, "--rule", "no-such-rule"])
+    assert rc == 2
+    assert "unknown rule" in capsys.readouterr().err

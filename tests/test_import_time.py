@@ -75,6 +75,20 @@ def test_cli_parser_defers_jax():
     )
 
 
+def test_lint_cli_is_jax_free():
+    """``pydcop_tpu lint`` parses, scans the whole package and diffs
+    the baseline WITHOUT importing jax: graftlint is stdlib-``ast``
+    only, so linting the jax-free surface cannot itself violate it.
+    (Also re-proves end-to-end that the repo lints clean: rc == 0.)"""
+    _run(
+        "import sys; from pydcop_tpu.cli import main; "
+        "rc = main(['lint', '--json']); "
+        "assert rc == 0, f'lint found new violations (rc={rc})'; "
+        "assert 'jax' not in sys.modules, "
+        "'the lint CLI path pulls jax'"
+    )
+
+
 def test_ops_padding_is_jax_free():
     """The host-path DPOP engines import ops.padding (level-pack
     keys) at module level — it must never grow a jax dependency."""

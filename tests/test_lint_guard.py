@@ -1,0 +1,240 @@
+"""Tier-1 hook for graftlint (``tools/graftlint/``): contract
+violations fail CI like any other test.
+
+Two guards:
+
+- the FULL-PACKAGE scan must report zero non-baselined findings (and
+  zero stale baseline entries — fixed violations leave the baseline
+  in the same PR), and must stay fast: the scan is stdlib-``ast``
+  only, no jax import, so it is pinned under a ~10s budget to protect
+  the thin 870s suite budget;
+- SEEDED violations of each rule class — a module-level jax import on
+  the jax-free surface, ``time.time()`` in ``faults/plan.py``, a
+  registered fault kind dropped from one entry point's validation, an
+  undocumented counter, a bare ``jax.jit`` outside the cache helpers
+  — are caught by the corresponding rule.  Violations are seeded
+  IN MEMORY (the ``scan(modules=…)`` seam) against the real package
+  tree, so the test proves the real contract catches them without
+  copying 179 files around.
+"""
+
+import ast
+import os
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from graftlint import (  # noqa: E402
+    Module,
+    default_config,
+    diff_baseline,
+    load_baseline,
+    load_modules,
+    scan,
+)
+
+# AST-only full-package scan, measured ~1.3s on this box; the budget
+# is ~7x the recording — it catches "somebody made a rule quadratic",
+# not scheduler noise, while protecting the suite's 870s ceiling
+SCAN_BUDGET_SECONDS = 10.0
+
+_BASELINE = os.path.join(_TOOLS, "graftlint_baseline.json")
+
+
+def _config():
+    return default_config(_REPO)
+
+
+def test_full_package_scan_clean_and_fast():
+    """Zero NEW findings, zero stale baseline entries, under budget."""
+    t0 = time.perf_counter()
+    findings = scan(_config())
+    elapsed = time.perf_counter() - t0
+    d = diff_baseline(findings, load_baseline(_BASELINE))
+    assert d.new == [], "\n".join(
+        f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in d.new
+    )
+    assert d.stale == [], (
+        "baseline entries no longer matched — run "
+        "`pydcop_tpu lint --update-baseline`: " + ", ".join(d.stale)
+    )
+    assert elapsed < SCAN_BUDGET_SECONDS, (
+        f"full-package lint scan took {elapsed:.1f}s (budget "
+        f"{SCAN_BUDGET_SECONDS}s) — a rule regressed from AST-linear"
+    )
+
+
+def test_baseline_entries_are_justified():
+    """Every pinned finding carries a real one-line justification —
+    a committed TODO means a violation was baselined unreviewed."""
+    baseline = load_baseline(_BASELINE)
+    assert baseline, "expected the repo's pinned findings"
+    for key, justification in baseline.items():
+        assert justification.strip() and not justification.startswith(
+            "TODO"
+        ), f"unjustified baseline entry: {key}"
+
+
+def _mutate(modules, relpath, transform):
+    mod = modules[relpath]
+    text = transform(mod.text)
+    modules[relpath] = Module(
+        relpath=relpath,
+        path=mod.path,
+        text=text,
+        tree=ast.parse(text),
+    )
+
+
+def test_seeded_violations_are_caught():
+    """One seeded violation per rule class, all caught in one scan."""
+    config = _config()
+    modules = load_modules(config)
+
+    # 1. module-level jax import on the declared jax-free surface
+    _mutate(
+        modules,
+        "pydcop_tpu/api.py",
+        lambda t: "import jax\n" + t,
+    )
+    # 2. wall-clock call in the seeded fault-plan module
+    _mutate(
+        modules,
+        "pydcop_tpu/faults/plan.py",
+        lambda t: t
+        + (
+            "\n\nimport time\n\n\n"
+            "def _seeded_clock():\n"
+            "    return time.time()\n"
+        ),
+    )
+    # 3. a registered fault kind removed from one entry point's
+    #    validation (the device check renamed away in `run`)
+    _mutate(
+        modules,
+        "pydcop_tpu/commands/run.py",
+        lambda t: t.replace(
+            "device_faults_configured", "device_faults_elsewhere"
+        ),
+    )
+    # 4. an undocumented counter + 5. a bare jax.jit outside the
+    #    sanctioned cache helpers (batched.py imports jax already)
+    _mutate(
+        modules,
+        "pydcop_tpu/engine/batched.py",
+        lambda t: t
+        + (
+            "\n\ndef _seeded_violations(met):\n"
+            '    met.inc("engine.seeded_undocumented")\n'
+            "    return jax.jit(lambda x: x)\n"
+        ),
+    )
+
+    findings = scan(config, modules=modules)
+    d = diff_baseline(findings, load_baseline(_BASELINE))
+    caught = {(f.rule, f.path) for f in d.new}
+    assert ("jax-import-surface", "pydcop_tpu/api.py") in caught
+    assert ("impure-call", "pydcop_tpu/faults/plan.py") in caught
+    assert ("chaos-symmetry", "pydcop_tpu/commands/run.py") in caught
+    assert ("metric-undocumented", "pydcop_tpu/engine/batched.py") in caught
+    assert ("bare-jit", "pydcop_tpu/engine/batched.py") in caught
+    # and each is attributed precisely, not as a co-located blur
+    details = {(f.rule, f.detail) for f in d.new}
+    assert ("impure-call", "time.time@_seeded_clock") in details
+    assert ("chaos-symmetry", "category:device") in details
+    assert (
+        "metric-undocumented",
+        "engine.seeded_undocumented",
+    ) in details
+    assert ("bare-jit", "jit@_seeded_violations") in details
+
+
+def test_seeded_loop_body_jax_import_is_caught():
+    """An import-time import hiding inside a module-level loop body
+    (the conditional fallback-import pattern) still executes on every
+    cold start — the surface rule must see through the loop."""
+    config = _config()
+    modules = load_modules(config)
+    _mutate(
+        modules,
+        "pydcop_tpu/api.py",
+        lambda t: t + "\n\nfor _lint_seed in range(1):\n    import jax\n",
+    )
+    # and the match-statement analogue (platform-dispatch pattern)
+    _mutate(
+        modules,
+        "pydcop_tpu/cli.py",
+        lambda t: t
+        + "\n\nmatch 1:\n    case 1:\n        import jax\n",
+    )
+    findings = scan(config, modules=modules, rules=["jax-import-surface"])
+    for rel in ("pydcop_tpu/api.py", "pydcop_tpu/cli.py"):
+        assert any(
+            f.path == rel and f.detail == "direct:jax"
+            for f in findings
+        ), (rel, findings)
+
+
+def test_seeded_bare_jit_decorator_is_caught():
+    """The plain `@jax.jit` decorator spelling (an Attribute, not a
+    Call) outside the sanctioned helpers."""
+    config = _config()
+    modules = load_modules(config)
+    _mutate(
+        modules,
+        "pydcop_tpu/engine/batched.py",
+        lambda t: t
+        + "\n\n@jax.jit\ndef _seeded_decorated(x):\n    return x\n",
+    )
+    findings = scan(config, modules=modules, rules=["bare-jit"])
+    assert any(
+        f.detail == "jit@_seeded_decorated" for f in findings
+    ), findings
+
+
+def test_seeded_transitive_jax_import_is_caught():
+    """The harder variant of the surface rule: no jax import in
+    sight, just a module-level hop into a jax-heavy module."""
+    config = _config()
+    modules = load_modules(config)
+    _mutate(
+        modules,
+        "pydcop_tpu/api.py",
+        lambda t: "from pydcop_tpu.engine.batched import run_batched\n"
+        + t,
+    )
+    findings = scan(config, modules=modules, rules=["jax-import-surface"])
+    hits = [f for f in findings if f.path == "pydcop_tpu/api.py"]
+    assert hits, "transitive jax chain not detected"
+    assert "pydcop_tpu/engine/batched.py" in hits[0].message
+
+
+def test_seeded_inert_chaos_field_is_caught():
+    """A new fault-parameter field that never flips `configured` is
+    the parseable-but-inert bug class (PR 9's wire kinds)."""
+    config = _config()
+    modules = load_modules(config)
+    _mutate(
+        modules,
+        "pydcop_tpu/faults/plan.py",
+        lambda t: t.replace(
+            "    transient: float = 0.0\n",
+            "    transient: float = 0.0\n"
+            "    reply_dup: float = 0.0\n",
+        ),
+    )
+    findings = scan(
+        config, modules=modules, rules=["chaos-inert-field"]
+    )
+    assert any(
+        f.detail == "DeviceFaults.reply_dup" for f in findings
+    ), findings

@@ -1,0 +1,61 @@
+"""graftlint — AST-based invariant linter for this repository.
+
+Machine-checks the contracts that keep the framework production-grade
+and that reviewer vigilance kept missing (see docs/linting.md):
+
+- **import hygiene** — the declared jax-free surface stays jax-free,
+  directly and transitively; PEP-562 lazy ``__init__`` tables actually
+  defer;
+- **determinism purity** — seeded/replayable scopes never consult
+  wall-clock or OS entropy, never iterate bare sets;
+- **chaos-spec symmetry** — every registered fault kind is accepted or
+  rejected at every entry point, and never parseable-but-inert;
+- **telemetry drift** — emitted metric names and the docs registry
+  agree, both directions; same for chaos clauses vs docs/faults.md;
+- **trace-key stability** — jax.jit only inside the sanctioned cache
+  helpers; cached runner builders don't close over mutable state the
+  cache key can't see.
+
+Stdlib-only (``ast``): importing and running graftlint never pulls
+jax, so it lints the jax-free surface without violating it.  Findings
+diff against the recorded baseline ``tools/graftlint_baseline.json``;
+tier-1 (``tests/test_lint_guard.py``) fails on any NEW finding.
+
+Entry points: ``pydcop_tpu lint [--json] [--update-baseline]`` or
+``python tools/graftlint/cli.py`` from a checkout.
+"""
+
+from graftlint.baseline import (  # noqa: F401
+    Diff,
+    diff_baseline,
+    load_baseline,
+    save_baseline,
+)
+from graftlint.config import LintConfig, default_config  # noqa: F401
+from graftlint.core import (  # noqa: F401
+    ALLOW_MARKER,
+    Context,
+    Finding,
+    Module,
+    RULES,
+    load_modules,
+    rule,
+    scan,
+)
+
+__all__ = [
+    "ALLOW_MARKER",
+    "Context",
+    "Diff",
+    "Finding",
+    "LintConfig",
+    "Module",
+    "RULES",
+    "default_config",
+    "diff_baseline",
+    "load_baseline",
+    "load_modules",
+    "rule",
+    "save_baseline",
+    "scan",
+]

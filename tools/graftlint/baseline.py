@@ -1,0 +1,83 @@
+"""Recorded-baseline mechanism (the ``recompile_guard`` pattern for
+findings): pre-existing violations are *pinned*, tier-1 fails on any
+NEW one, and a fixed violation must leave the baseline in the same PR
+(a stale entry fails too — the baseline only ever shrinks unless a
+justified exception is added deliberately).
+
+``tools/graftlint_baseline.json``::
+
+    {"version": 1,
+     "findings": {"<rule>::<path>::<detail>": "one-line justification"}}
+
+Keys are position-free (see ``core.Finding.key``), so unrelated edits
+never churn the file.  ``--update-baseline`` rewrites it from the
+current scan, preserving existing justifications and marking new
+entries ``TODO: justify`` — a TODO left in the committed file is a
+review smell by design.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List
+
+from graftlint.core import Finding
+
+TODO_JUSTIFICATION = "TODO: justify (added by --update-baseline)"
+
+
+def load_baseline(path) -> Dict[str, str]:
+    p = Path(path)
+    if not p.is_file():
+        return {}
+    data = json.loads(p.read_text(encoding="utf-8"))
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(
+            f"{p}: expected {{'version': 1, 'findings': {{...}}}}"
+        )
+    findings = data["findings"]
+    if not isinstance(findings, dict) or not all(
+        isinstance(k, str) and isinstance(v, str)
+        for k, v in findings.items()
+    ):
+        raise ValueError(f"{p}: findings must map key -> justification")
+    return dict(findings)
+
+
+def save_baseline(path, findings: List[Finding], old: Dict[str, str]):
+    """Write the baseline for the current findings, keeping old
+    justifications for keys that persist."""
+    entries = {
+        f.key: old.get(f.key, TODO_JUSTIFICATION)
+        for f in findings
+    }
+    payload = {
+        "version": 1,
+        "findings": dict(sorted(entries.items())),
+    }
+    Path(path).write_text(
+        json.dumps(payload, indent=2, ensure_ascii=False) + "\n",
+        encoding="utf-8",
+    )
+
+
+@dataclass
+class Diff:
+    new: List[Finding]
+    baselined: List[Finding]
+    stale: List[str]  # baseline keys no finding matches any more
+
+    @property
+    def clean(self) -> bool:
+        return not self.new and not self.stale
+
+
+def diff_baseline(findings: List[Finding], baseline: Dict[str, str]) -> Diff:
+    current = {f.key for f in findings}
+    return Diff(
+        new=[f for f in findings if f.key not in baseline],
+        baselined=[f for f in findings if f.key in baseline],
+        stale=sorted(k for k in baseline if k not in current),
+    )

@@ -1,0 +1,185 @@
+"""graftlint command line (also backs ``pydcop_tpu lint``).
+
+Exit codes: 0 clean (every finding baselined, no stale entries),
+1 new or stale findings, 2 usage error.  ``--json`` emits a
+machine-readable report for CI annotation::
+
+    {"findings": [{"rule", "file", "line", "message", "key"}, ...],
+     "baselined": N, "stale": [...], "rules": [...], "ok": bool}
+
+``findings`` lists only NEW (non-baselined) violations — the ones
+that fail the run; the baselined set is a count plus keys so CI noise
+stays proportional to what changed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+
+def _ensure_importable() -> None:
+    """Allow running as ``python tools/graftlint/cli.py``."""
+    tools_dir = Path(__file__).resolve().parent.parent
+    if str(tools_dir) not in sys.path:
+        sys.path.insert(0, str(tools_dir))
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description=(
+            "AST-based invariant linter: import hygiene, determinism "
+            "purity, chaos-spec symmetry, telemetry drift, trace-key "
+            "stability (docs/linting.md)"
+        ),
+    )
+    ap.add_argument(
+        "--root",
+        default=None,
+        help="project root (default: the checkout containing this "
+        "tool)",
+    )
+    ap.add_argument(
+        "--baseline",
+        default=None,
+        metavar="FILE",
+        help="baseline file (default: tools/graftlint_baseline.json "
+        "under the root)",
+    )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        dest="as_json",
+        help="machine-readable findings (file, line, rule id, "
+        "message) for CI annotation",
+    )
+    ap.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline from the current scan (existing "
+        "justifications kept, new entries marked TODO) and exit 0",
+    )
+    ap.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        metavar="RULE_ID",
+        help="run only this rule (repeatable)",
+    )
+    return ap
+
+
+def run(args) -> int:
+    _ensure_importable()
+    from graftlint import (
+        RULES,
+        default_config,
+        diff_baseline,
+        load_baseline,
+        save_baseline,
+        scan,
+    )
+
+    root = Path(
+        args.root
+        if args.root
+        else Path(__file__).resolve().parent.parent.parent
+    ).resolve()
+    if not root.is_dir():
+        print(f"graftlint: root {root} is not a directory", file=sys.stderr)
+        return 2
+    config = default_config(str(root))
+    baseline_path = (
+        Path(args.baseline)
+        if args.baseline
+        else root / "tools" / "graftlint_baseline.json"
+    )
+    rules = args.rule
+    if rules is not None:
+        import graftlint.rules  # noqa: F401 — populate the registry
+
+        unknown = sorted(set(rules) - set(RULES))
+        if unknown:
+            print(
+                f"graftlint: unknown rule(s) {', '.join(unknown)}; "
+                f"known: {', '.join(sorted(RULES))}",
+                file=sys.stderr,
+            )
+            return 2
+    t0 = time.perf_counter()
+    findings = scan(config, rules=rules)
+    elapsed = time.perf_counter() - t0
+    try:
+        baseline = load_baseline(baseline_path)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    if rules is not None:
+        # partial runs diff only against the selected rules' entries,
+        # and never report the others' baseline keys as stale
+        baseline = {
+            k: v
+            for k, v in baseline.items()
+            if k.split("::", 1)[0] in set(rules)
+        }
+    d = diff_baseline(findings, baseline)
+
+    if args.update_baseline:
+        if rules is not None:
+            print(
+                "graftlint: --update-baseline with --rule would drop "
+                "the other rules' entries; run it unfiltered",
+                file=sys.stderr,
+            )
+            return 2
+        save_baseline(baseline_path, findings, baseline)
+        print(
+            f"graftlint: baseline updated — {len(findings)} pinned "
+            f"finding(s) in {baseline_path}"
+        )
+        return 0
+
+    if args.as_json:
+        print(
+            json.dumps(
+                {
+                    "ok": d.clean,
+                    "findings": [f.to_dict() for f in d.new],
+                    "baselined": len(d.baselined),
+                    "baselined_keys": sorted(
+                        f.key for f in d.baselined
+                    ),
+                    "stale": d.stale,
+                    "rules": sorted(RULES) if rules is None else sorted(rules),
+                    "scan_seconds": round(elapsed, 3),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in d.new:
+            print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+        for key in d.stale:
+            print(
+                f"baseline: [{key}] no longer found — remove the "
+                "entry (pydcop_tpu lint --update-baseline)"
+            )
+        status = "clean" if d.clean else "FAILED"
+        print(
+            f"graftlint: {status} — {len(d.new)} new, "
+            f"{len(d.baselined)} baselined, {len(d.stale)} stale "
+            f"({elapsed:.2f}s)"
+        )
+    return 0 if d.clean else 1
+
+
+def main(argv=None) -> int:
+    return run(build_arg_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,257 @@
+"""The project contract graftlint checks code against.
+
+:func:`default_config` encodes THIS repository's invariants — the
+declared jax-free import surface, the seeded/replayable determinism
+scopes, the chaos-spec symmetry table, the metric documentation
+registry, and the sanctioned jit cache helpers.  Rules read only the
+:class:`LintConfig` they are handed, so tests exercise them against
+fixture mini-projects with their own configs
+(``tests/fixtures/lint/``).
+
+Extending the contract (docs/linting.md has the workflow):
+
+- a new module joins the jax-free surface by adding its glob to
+  ``jax_free_surface``;
+- an audited impurity is allowlisted in place with
+  ``# graftlint: allow[rule-id] — reason`` (never here);
+- a new chaos fault kind gets a row in ``chaos_kind_categories`` AND
+  accept-or-reject handling at every entry point in
+  ``chaos_entry_points`` — the symmetry rule fails until both exist.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Mapping, Tuple
+
+
+@dataclass(frozen=True)
+class LintConfig:
+    #: project root (the directory paths in findings are relative to)
+    root: str
+    #: files/directories to parse, relative to root
+    scan_roots: Tuple[str, ...] = ()
+    #: relpath globs excluded from parsing entirely
+    exclude: Tuple[str, ...] = ()
+    #: the top-level package name internal imports resolve against
+    package: str = "pydcop_tpu"
+
+    # -- import-hygiene ---------------------------------------------------
+    #: import roots banned at module level on the jax-free surface
+    banned_import_roots: Tuple[str, ...] = ("jax", "jaxlib")
+    #: relpath globs of the declared jax-free surface
+    jax_free_surface: Tuple[str, ...] = ()
+
+    # -- determinism-purity ----------------------------------------------
+    #: relpath globs where WHOLE modules must stay pure
+    seeded_modules: Tuple[str, ...] = ()
+    #: relpath → qualname globs: function-scoped purity regions
+    seeded_functions: Mapping[str, Tuple[str, ...]] = field(
+        default_factory=dict
+    )
+
+    # -- chaos-spec symmetry ----------------------------------------------
+    #: the module registering fault kinds (FaultPlan.from_spec)
+    chaos_plan_module: str = "pydcop_tpu/faults/plan.py"
+    #: registered kind → category; a kind parsed by from_spec but
+    #: absent here is itself a finding (unclassified kind)
+    chaos_kind_categories: Mapping[str, str] = field(default_factory=dict)
+    #: entry-point relpath → category → acceptable evidence symbols
+    #: (the module must reference at least one: the category's
+    #: accept-or-reject validation, or its documented downstream sink)
+    chaos_entry_points: Mapping[str, Mapping[str, Tuple[str, ...]]] = field(
+        default_factory=dict
+    )
+    #: dataclass field suffixes that are kind MODIFIERS (``:AFTER``
+    #: tails etc.), exempt from the parseable-but-inert check
+    chaos_modifier_suffixes: Tuple[str, ...] = ("_after", "_instance", "_s")
+
+    # -- telemetry drift --------------------------------------------------
+    #: relpath glob for code whose metric emissions must be documented
+    metrics_code: Tuple[str, ...] = ("pydcop_tpu/*",)
+    #: the documentation registry: a metric must appear in at least one
+    metrics_docs: Tuple[str, ...] = (
+        "docs/observability.md",
+        "docs/serving.md",
+    )
+    #: doc tokens that look like metrics but are not (python paths…)
+    doc_token_ignore: Tuple[str, ...] = ()
+    #: chaos spec clauses must be documented here
+    faults_doc: str = "docs/faults.md"
+    #: ``word=`` tokens in faults_doc code spans that are NOT spec
+    #: clauses (grammar placeholders, CLI flags, parameter names)
+    clause_token_ignore: Tuple[str, ...] = ()
+
+    # -- trace-key stability ----------------------------------------------
+    #: modules allowed to call jax.jit directly (the cache helpers)
+    sanctioned_jit_modules: Tuple[str, ...] = (
+        "pydcop_tpu/ops/compile.py",
+        "pydcop_tpu/ops/semiring.py",
+        "pydcop_tpu/telemetry/jit.py",
+    )
+    #: modules whose runner builders are checked for unhashable
+    #: closure capture (mutable state a cached trace key cannot see)
+    runner_builder_modules: Tuple[str, ...] = (
+        "pydcop_tpu/engine/batched.py",
+        "pydcop_tpu/ops/semiring.py",
+    )
+
+
+def default_config(root: str) -> LintConfig:
+    """The contract for this repository, rooted at ``root``."""
+    root = str(Path(root).resolve())
+    return LintConfig(
+        root=root,
+        scan_roots=("pydcop_tpu", "tools", "bench.py", "bench_configs.py"),
+        exclude=("tools/graftlint/*",),
+        package="pydcop_tpu",
+        # The declared jax-free surface: embedding API, CLI parser and
+        # every commands/ module, the host-path engines, the chaos
+        # layer, shared utils, the numpy-only ops modules, telemetry.
+        # tests/test_import_time.py pins the same property dynamically
+        # for the entry points; this list is the static closure.
+        jax_free_surface=(
+            "pydcop_tpu/__init__.py",
+            "pydcop_tpu/__main__.py",
+            "pydcop_tpu/api.py",
+            "pydcop_tpu/cli.py",
+            "pydcop_tpu/commands/*.py",
+            "pydcop_tpu/commands/generators/*.py",
+            "pydcop_tpu/engine/__init__.py",
+            "pydcop_tpu/engine/host_batch.py",
+            "pydcop_tpu/engine/supervisor.py",
+            "pydcop_tpu/engine/service.py",
+            "pydcop_tpu/faults/*.py",
+            "pydcop_tpu/utils/*.py",
+            "pydcop_tpu/ops/__init__.py",
+            "pydcop_tpu/ops/padding.py",
+            "pydcop_tpu/ops/membound.py",
+            "pydcop_tpu/ops/semiring.py",
+            "pydcop_tpu/telemetry/*.py",
+        ),
+        # Seeded/replayable scopes: every decision here must be a pure
+        # function of (seed, scope, seq) — the FaultPlan contract.
+        seeded_modules=(
+            "pydcop_tpu/faults/*.py",
+            "pydcop_tpu/utils/backoff.py",
+        ),
+        seeded_functions={
+            # supervisor retry/classification: replay must reproduce
+            # retry decisions bit-for-bit
+            "pydcop_tpu/engine/supervisor.py": (
+                "classify_failure",
+                "Supervisor._inject",
+                "Supervisor._next_seq",
+                "Supervisor._record_fault",
+            ),
+            # service shed predictor + idempotency-key paths: a replay
+            # of the same admission sequence must shed/replay the same
+            # requests
+            "pydcop_tpu/engine/service.py": (
+                "SolverService._shed_reason_locked",
+                "ServiceServer._cache_reply",
+                "ServiceClient.__init__",
+            ),
+        },
+        chaos_plan_module="pydcop_tpu/faults/plan.py",
+        chaos_kind_categories={
+            # message plane (ChaosCommunicationLayer)
+            "drop": "message",
+            "dup": "message",
+            "duplicate": "message",
+            "reorder": "message",
+            "delay": "message",
+            # scripted schedules (partition windows, crash kills)
+            "partition": "schedule",
+            "crash": "schedule",
+            # device layer (engine/supervisor.py dispatch seam)
+            "device_oom": "device",
+            "device_oom_bytes": "device",
+            "device_transient": "device",
+            "nan_inject": "device",
+            # wire level (engine/service.py frame loop)
+            "conn_drop": "wire",
+            "slow_client": "wire",
+            "frame_corrupt": "wire",
+        },
+        chaos_entry_points={
+            # api.solve / api.solve_many accept-or-reject every
+            # category per mode, referencing each predicate directly
+            "pydcop_tpu/api.py": {
+                "message": ("message_faults_configured",),
+                "schedule": ("crashes",),
+                "device": ("device_faults_configured",),
+                "wire": ("wire_faults_configured",),
+            },
+            # run: scripted scenarios — accepts crashes + device kinds,
+            # rejects the rest explicitly
+            "pydcop_tpu/commands/run.py": {
+                "message": ("message_faults_configured",),
+                "schedule": ("crashes",),
+                "device": ("device_faults_configured",),
+                "wire": ("wire_faults_configured",),
+            },
+            # serve: validation lives in SolverService (commands/serve
+            # is a thin forwarder); device kinds are ACCEPTED by
+            # handing the plan to the supervised dispatch layer
+            "pydcop_tpu/engine/service.py": {
+                "message": ("message_faults_configured",),
+                "schedule": ("crashes",),
+                "device": ("device_faults_configured", "make_supervisor"),
+                "wire": ("wire_faults_configured",),
+            },
+            # agent: message/crash kinds flow into the per-agent host
+            # runtime (run_host_agent); device/wire must be rejected
+            "pydcop_tpu/commands/agent.py": {
+                "message": (
+                    "message_faults_configured",
+                    "run_host_agent",
+                ),
+                "schedule": ("crashes", "run_host_agent"),
+                "device": ("device_faults_configured",),
+                "wire": ("wire_faults_configured",),
+            },
+            # orchestrator: message/crash kinds flow into the hostnet
+            # runtime; device/wire must be rejected
+            "pydcop_tpu/commands/orchestrator.py": {
+                "message": (
+                    "message_faults_configured",
+                    "run_host_orchestrator",
+                ),
+                "schedule": ("crashes", "run_host_orchestrator"),
+                "device": ("device_faults_configured",),
+                "wire": ("wire_faults_configured",),
+            },
+        },
+        metrics_code=("pydcop_tpu/*",),
+        metrics_docs=("docs/observability.md", "docs/serving.md"),
+        doc_token_ignore=(
+            # trace SPAN names (tracer timeline), not registry
+            # metrics — they share the dotted naming but are checked
+            # by the schema tests, not this registry
+            "semiring.contract",
+            "semiring.downward",
+            "service.dispatch",
+            "service.queue-wait",
+            "service.request",
+            "service.drain",
+        ),
+        faults_doc="docs/faults.md",
+        clause_token_ignore=(
+            # grammar placeholders and non-clause key=value examples
+            # that legitimately appear in faults.md code spans
+            "key",
+            "name",
+            "seed",
+            "p",
+            "w",
+            "n",
+            # CLI flags / result fields shown in faults.md examples
+            "chaos",
+            "chaos_seed",
+            "status",
+            "on_numeric_fault",
+            "kind",
+        ),
+    )

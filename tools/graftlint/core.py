@@ -1,0 +1,367 @@
+"""Scanner core: module loading, the rule registry, findings, and the
+``graftlint: allow[...]`` audited-exception marker.
+
+Everything here is stdlib-only (``ast`` + ``pathlib``) — the linter
+must run on the jax-free CLI surface it polices, so it can never grow
+a dependency on the package it scans (``tests/test_import_time.py``
+pins this).
+
+Design notes:
+
+- A :class:`Finding`'s baseline **key** deliberately excludes the line
+  number: baselines keyed on positions churn on every unrelated edit.
+  The key is ``rule::path::detail`` where ``detail`` is a semantic
+  identifier the rule chooses (imported module name, metric name,
+  ``call@qualname`` …) — the same recorded-identity discipline as
+  ``tools/recompile_guard.py``'s compile budgets.
+- Rules run on a pre-parsed module set (:func:`load_modules`), and
+  :func:`scan` accepts an explicit ``modules``/``docs`` override so
+  tests can seed violations *in memory* instead of copying the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional
+
+#: The audited-exception marker: ``# graftlint: allow[rule-id] — why``
+#: on the flagged line or the line directly above it.  The reason text
+#: is mandatory by convention (docs/linting.md) but not machine-parsed.
+ALLOW_MARKER = "graftlint: allow"
+
+_ALLOW_RE = re.compile(r"graftlint:\s*allow\[([a-z0-9_*-]+)\]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one location."""
+
+    rule: str
+    path: str  # posix path relative to the project root
+    line: int  # 1-based; informational only — NOT part of the key
+    message: str
+    detail: str  # stable identity within (rule, path): the baseline key
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.detail}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "file": self.path,
+            "line": self.line,
+            "message": self.message,
+            "key": self.key,
+        }
+
+
+@dataclass
+class Module:
+    """One parsed source file."""
+
+    relpath: str  # posix, relative to the project root
+    path: Optional[Path]
+    text: str
+    tree: ast.Module
+
+    @property
+    def lines(self) -> List[str]:
+        return self.text.splitlines()
+
+
+@dataclass(frozen=True)
+class Rule:
+    rule_id: str
+    summary: str
+    check: Callable[["Context"], Iterable[Finding]]
+
+
+#: The registry ``tools/graftlint/rules/`` populates at import.
+RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, summary: str):
+    """Register a rule check function under ``rule_id``."""
+
+    def deco(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id!r}")
+        RULES[rule_id] = Rule(rule_id, summary, fn)
+        return fn
+
+    return deco
+
+
+class Context:
+    """What a rule sees: the parsed module set, doc texts, config."""
+
+    def __init__(
+        self,
+        config,
+        modules: Dict[str, Module],
+        docs: Optional[Dict[str, str]] = None,
+    ):
+        self.config = config
+        self.modules = modules
+        self._docs: Dict[str, str] = dict(docs or {})
+
+    def match(self, patterns: Iterable[str]) -> List[Module]:
+        """Modules whose relpath matches any of the glob patterns."""
+        pats = list(patterns)
+        return [
+            m
+            for rel, m in sorted(self.modules.items())
+            if any(fnmatch.fnmatch(rel, p) for p in pats)
+        ]
+
+    def module(self, relpath: str) -> Optional[Module]:
+        return self.modules.get(relpath)
+
+    def doc_text(self, relpath: str) -> Optional[str]:
+        """A non-Python project file (docs/*.md), cached/patchable."""
+        if relpath not in self._docs:
+            p = Path(self.config.root) / relpath
+            self._docs[relpath] = (
+                p.read_text(encoding="utf-8") if p.is_file() else None
+            )
+        return self._docs[relpath]
+
+    def allowed(self, module: Module, lineno: int, rule_id: str) -> bool:
+        """True when the line (or the one above) carries an
+        ``allow[rule_id]`` marker — the audited-exception escape
+        hatch."""
+        lines = module.lines
+        for ln in (lineno, lineno - 1):
+            if 1 <= ln <= len(lines):
+                m = _ALLOW_RE.search(lines[ln - 1])
+                if m and m.group(1) in (rule_id, "*"):
+                    return True
+        return False
+
+
+def load_modules(config) -> Dict[str, Module]:
+    """Parse every ``*.py`` under the configured scan roots.
+
+    A file that fails to parse becomes a ``parse-error`` module with an
+    empty tree — rules skip it, and :func:`scan` reports it as a
+    finding rather than crashing the whole run.
+    """
+    root = Path(config.root)
+    files: List[Path] = []
+    for entry in config.scan_roots:
+        p = root / entry
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.is_file():
+            files.append(p)
+        # a missing root (partial checkout, in-memory test tree) is
+        # simply not scanned — rules that need it report nothing
+    modules: Dict[str, Module] = {}
+    for f in files:
+        rel = f.relative_to(root).as_posix()
+        if any(fnmatch.fnmatch(rel, pat) for pat in config.exclude):
+            continue
+        text = f.read_text(encoding="utf-8")
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as e:
+            tree = ast.Module(body=[], type_ignores=[])
+            tree._graftlint_syntax_error = e  # type: ignore[attr-defined]
+        modules[rel] = Module(relpath=rel, path=f, text=text, tree=tree)
+    return modules
+
+
+def scan(
+    config,
+    modules: Optional[Dict[str, Module]] = None,
+    docs: Optional[Dict[str, str]] = None,
+    rules: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run the (selected) rules and return all findings, sorted.
+
+    ``modules``/``docs`` override disk loading — the in-memory seam
+    the seeded-violation tests use.  Findings on lines carrying an
+    ``allow[rule]`` marker are dropped here, centrally.
+    """
+    # rule modules self-register on import
+    from graftlint import rules as _rules  # noqa: F401
+
+    if modules is None:
+        modules = load_modules(config)
+    ctx = Context(config, modules, docs)
+    selected = sorted(set(rules)) if rules is not None else sorted(RULES)
+    findings: List[Finding] = []
+    for rel, mod in sorted(modules.items()):
+        err = getattr(mod.tree, "_graftlint_syntax_error", None)
+        if err is not None:
+            findings.append(
+                Finding(
+                    rule="parse-error",
+                    path=rel,
+                    line=err.lineno or 1,
+                    message=f"syntax error: {err.msg}",
+                    detail="syntax",
+                )
+            )
+    for rule_id in selected:
+        for f in RULES[rule_id].check(ctx):
+            mod = modules.get(f.path)
+            if mod is not None and ctx.allowed(mod, f.line, f.rule):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.detail))
+    return findings
+
+
+# -- shared AST helpers (used by several rules) --------------------------
+
+
+def qualname_map(tree: ast.Module) -> Dict[ast.AST, str]:
+    """Map every function/class node to its dotted qualname."""
+    out: Dict[ast.AST, str] = {}
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out[child] = q
+                walk(child, q)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def enclosing_qualnames(tree: ast.Module) -> Dict[int, str]:
+    """Map line numbers to the qualname of the innermost enclosing
+    function/class (``"<module>"`` at top level).  Approximate —
+    keyed on line spans — but stable enough for baseline details."""
+    qmap = qualname_map(tree)
+    spans = []
+    for node, q in qmap.items():
+        end = getattr(node, "end_lineno", node.lineno)
+        spans.append((node.lineno, end, q))
+    spans.sort(key=lambda s: (s[0], -s[1]))
+
+    def lookup(lineno: int) -> str:
+        best = "<module>"
+        for lo, hi, q in spans:
+            if lo <= lineno <= hi:
+                best = q
+        return best
+
+    return _LazyLineMap(lookup)
+
+
+class _LazyLineMap(dict):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def __missing__(self, key):
+        # memoize: rules look lines up once per Call node, and the
+        # span scan is linear in the module's function count
+        val = self[key] = self._fn(key)
+        return val
+
+
+def imported_names(tree: ast.Module) -> Dict[str, str]:
+    """Name → dotted origin for every import binding in the module
+    (module-level AND nested: purity rules care about what a name
+    *means*, wherever the import statement sits).
+
+    ``import random as rnd`` → ``{"rnd": "random"}``;
+    ``from time import time`` → ``{"time": "time.time"}``.
+    """
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_name(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    """The canonical dotted identity of a Name/Attribute chain,
+    resolved through the module's import bindings: ``rnd.choice``
+    with ``import random as rnd`` resolves to ``random.choice``."""
+    dn = dotted_name(node)
+    if dn is None:
+        return None
+    head, _, rest = dn.partition(".")
+    origin = imports.get(head)
+    if origin is not None:
+        return f"{origin}.{rest}" if rest else origin
+    return dn
+
+
+def resolve_call(node: ast.Call, imports: Dict[str, str]) -> Optional[str]:
+    """:func:`resolve_name` applied to a call's target."""
+    return resolve_name(node.func, imports)
+
+
+def module_level_statements(tree: ast.Module):
+    """Statements that execute at import time: the module body,
+    descending into ``if``/``try``/``with`` blocks and class bodies,
+    NOT into function bodies.  ``if TYPE_CHECKING:`` branches are
+    skipped — they never execute."""
+
+    def is_type_checking(test: ast.AST) -> bool:
+        dn = dotted_name(test)
+        return dn in ("TYPE_CHECKING", "typing.TYPE_CHECKING")
+
+    def walk(body):
+        for node in body:
+            yield node
+            if isinstance(node, ast.If):
+                if not is_type_checking(node.test):
+                    yield from walk(node.body)
+                yield from walk(node.orelse)
+            elif isinstance(node, ast.Try):
+                yield from walk(node.body)
+                for h in node.handlers:
+                    yield from walk(h.body)
+                yield from walk(node.orelse)
+                yield from walk(node.finalbody)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                yield from walk(node.body)
+            elif isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                # loop bodies DO execute at import time (conditional
+                # fallback-import loops are a real-world pattern)
+                yield from walk(node.body)
+                yield from walk(node.orelse)
+            elif isinstance(node, ast.Match):
+                for case in node.cases:
+                    yield from walk(case.body)
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body)
+
+    yield from walk(tree.body)

@@ -1,0 +1,22 @@
+"""Rule modules self-register into ``graftlint.core.RULES`` on import.
+
+Five rule families (docs/linting.md has the catalog):
+
+- :mod:`graftlint.rules.imports` — ``jax-import-surface``,
+  ``lazy-init-eager-import``
+- :mod:`graftlint.rules.purity` — ``impure-call``, ``set-iteration``
+- :mod:`graftlint.rules.chaos` — ``chaos-symmetry``,
+  ``chaos-inert-field``
+- :mod:`graftlint.rules.telemetry` — ``metric-undocumented``,
+  ``metric-stale-doc``, ``chaos-clause-doc``
+- :mod:`graftlint.rules.tracekeys` — ``bare-jit``,
+  ``unhashable-closure``
+"""
+
+from graftlint.rules import (  # noqa: F401
+    chaos,
+    imports,
+    purity,
+    telemetry,
+    tracekeys,
+)

@@ -1,0 +1,257 @@
+"""Chaos-spec symmetry rules.
+
+The seeded fault plan (``faults/plan.py``) is a *contract*: every
+registered ``--chaos`` clause must be either accepted (handed to the
+layer that injects it) or explicitly rejected at EVERY entry point.  A
+kind that one surface parses but neither injects nor rejects fakes
+chaos coverage — the run records the spec as applied while injecting
+nothing (the PR 9 parseable-but-inert wire-kind bug, generalized).
+
+``chaos-symmetry`` — three checks against the config's contract table:
+
+1. every kind ``FaultPlan.from_spec`` parses is classified into a
+   category (``chaos_kind_categories``);
+2. every category in the table is actually registered in the plan
+   module (a stale table row is also drift);
+3. every entry point in ``chaos_entry_points`` references, per
+   category, at least one *evidence symbol* — the category's
+   ``*_faults_configured`` accept-or-reject predicate, or its
+   documented downstream sink (e.g. ``make_supervisor`` for device
+   kinds in the solver service).
+
+``chaos-inert-field`` — every non-modifier field of a fault-parameter
+dataclass that defines a ``configured`` property must be read inside
+that property: a field that parses but never flips ``configured`` is
+invisible to every ``*_faults_configured`` validation above.
+
+The kind extraction is AST-based, not a hardcoded list: new
+``clause.startswith("newkind=")`` branches and new alternation keys in
+the ``_CLAUSE`` regex are discovered automatically, so adding a kind
+without extending the contract table is itself a lint failure.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from graftlint.core import Finding, Module, rule
+
+#: category → the FaultPlan attribute whose reference counts as
+#: accept-or-reject evidence by default
+CATEGORY_PREDICATES = {
+    "message": "message_faults_configured",
+    "schedule": "crashes",
+    "device": "device_faults_configured",
+    "wire": "wire_faults_configured",
+}
+
+_CLAUSE_KEY_RE = re.compile(r"\(\?P<key>([A-Za-z_|]+)\)")
+
+
+def registered_kinds(plan_mod: Module) -> Dict[str, int]:
+    """kind → line, extracted from the plan module's AST: string
+    prefixes tested with ``.startswith("kind=")`` (singly or in
+    tuples) plus the alternation keys of the ``_CLAUSE`` regex."""
+    kinds: Dict[str, int] = {}
+
+    def add(prefix: str, line: int) -> None:
+        if prefix.endswith("=") and prefix[:-1].isidentifier():
+            kinds.setdefault(prefix[:-1], line)
+
+    for node in ast.walk(plan_mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "startswith"
+            and node.args
+        ):
+            arg = node.args[0]
+            consts: List[ast.Constant] = []
+            if isinstance(arg, ast.Constant):
+                consts = [arg]
+            elif isinstance(arg, ast.Tuple):
+                consts = [
+                    e for e in arg.elts if isinstance(e, ast.Constant)
+                ]
+            for c in consts:
+                if isinstance(c.value, str):
+                    add(c.value, node.lineno)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            m = _CLAUSE_KEY_RE.search(node.value)
+            if m:
+                for key in m.group(1).split("|"):
+                    kinds.setdefault(key, node.lineno)
+    return kinds
+
+
+def _referenced_symbols(mod: Module) -> Set[str]:
+    """Every Name id and Attribute attr the module mentions."""
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+    return out
+
+
+@rule(
+    "chaos-symmetry",
+    "every registered fault kind must be classified and accepted-or-"
+    "rejected at every entry point",
+)
+def check_chaos_symmetry(ctx):
+    cfg = ctx.config
+    plan_mod = ctx.module(cfg.chaos_plan_module)
+    if plan_mod is None:
+        return
+    kinds = registered_kinds(plan_mod)
+    categories = dict(cfg.chaos_kind_categories)
+
+    # 1. every parsed kind is classified
+    for kind, line in sorted(kinds.items()):
+        if kind not in categories:
+            yield Finding(
+                rule="chaos-symmetry",
+                path=cfg.chaos_plan_module,
+                line=line,
+                message=(
+                    f"fault kind `{kind}=` is parsed by from_spec but "
+                    "not classified in the chaos symmetry table "
+                    "(graftlint config chaos_kind_categories) — every "
+                    "entry point must accept or reject it explicitly"
+                ),
+                detail=f"unclassified:{kind}",
+            )
+
+    # 2. no stale table rows
+    for kind in sorted(categories):
+        if kind not in kinds:
+            yield Finding(
+                rule="chaos-symmetry",
+                path=cfg.chaos_plan_module,
+                line=1,
+                message=(
+                    f"chaos symmetry table classifies `{kind}` but "
+                    "from_spec no longer parses it — drop the stale "
+                    "row"
+                ),
+                detail=f"stale:{kind}",
+            )
+
+    # 3. per-entry-point coverage of every live category
+    live_categories = sorted(
+        {categories[k] for k in kinds if k in categories}
+    )
+    for rel, coverage in sorted(cfg.chaos_entry_points.items()):
+        mod = ctx.module(rel)
+        if mod is None:
+            yield Finding(
+                rule="chaos-symmetry",
+                path=rel,
+                line=1,
+                message=(
+                    f"chaos entry point {rel} is configured but the "
+                    "module does not exist — update the symmetry table"
+                ),
+                detail="missing-module",
+            )
+            continue
+        symbols = _referenced_symbols(mod)
+        for cat in live_categories:
+            evidence = tuple(coverage.get(cat, ())) or (
+                (CATEGORY_PREDICATES[cat],)
+                if cat in CATEGORY_PREDICATES
+                else ()
+            )
+            if not evidence:
+                yield Finding(
+                    rule="chaos-symmetry",
+                    path=rel,
+                    line=1,
+                    message=(
+                        f"no evidence symbols configured for fault "
+                        f"category `{cat}` at entry point {rel} — add "
+                        "them to chaos_entry_points"
+                    ),
+                    detail=f"unconfigured:{cat}",
+                )
+                continue
+            if not any(sym in symbols for sym in evidence):
+                cat_kinds = sorted(
+                    k for k in kinds if categories.get(k) == cat
+                )
+                yield Finding(
+                    rule="chaos-symmetry",
+                    path=rel,
+                    line=1,
+                    message=(
+                        f"entry point never consults {' / '.join(evidence)}"
+                        f" — `{'/'.join(cat_kinds)}` clauses would be "
+                        "silently ignored here; accept the category "
+                        "(hand the plan to its injection layer) or "
+                        "reject it with a clear error"
+                    ),
+                    detail=f"category:{cat}",
+                )
+
+
+@rule(
+    "chaos-inert-field",
+    "every fault-parameter field must be readable through its class's "
+    "`configured` predicate",
+)
+def check_inert_fields(ctx):
+    cfg = ctx.config
+    plan_mod = ctx.module(cfg.chaos_plan_module)
+    if plan_mod is None:
+        return
+    for node in ast.walk(plan_mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        configured = next(
+            (
+                n
+                for n in node.body
+                if isinstance(n, ast.FunctionDef)
+                and n.name == "configured"
+            ),
+            None,
+        )
+        if configured is None:
+            continue
+        reads: Set[str] = set()
+        for sub in ast.walk(configured):
+            if isinstance(sub, ast.Attribute):
+                reads.add(sub.attr)
+            elif isinstance(sub, ast.Name):
+                reads.add(sub.id)
+        for stmt in node.body:
+            if not isinstance(stmt, ast.AnnAssign) or not isinstance(
+                stmt.target, ast.Name
+            ):
+                continue
+            name = stmt.target.id
+            if name.startswith("_"):
+                continue
+            if any(
+                name.endswith(suf)
+                for suf in cfg.chaos_modifier_suffixes
+            ):
+                continue
+            if name not in reads:
+                yield Finding(
+                    rule="chaos-inert-field",
+                    path=cfg.chaos_plan_module,
+                    line=stmt.lineno,
+                    message=(
+                        f"{node.name}.{name} parses from the spec but "
+                        "is never read by the `configured` predicate — "
+                        "a clause setting only it is parseable-but-"
+                        "inert: every *_faults_configured validation "
+                        "would wave it through while nothing injects"
+                    ),
+                    detail=f"{node.name}.{name}",
+                )

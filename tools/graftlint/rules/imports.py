@@ -1,0 +1,227 @@
+"""Import-hygiene rules: the declared jax-free surface stays jax-free.
+
+``jax-import-surface`` — a module on the surface must not import
+``jax``/``jaxlib`` at module level, **directly or transitively**
+through module-level imports of other package modules.  The transitive
+closure is the part reviewers miss: PR 5's cold-start regression was
+``api.py`` eagerly importing an engine module that imported jax, not a
+literal ``import jax`` line.
+
+``lazy-init-eager-import`` — a PEP-562 ``__init__.py`` (one defining a
+module-level ``__getattr__``) must not eagerly import any module it
+lazily exposes: one stray eager line silently re-serializes the whole
+jax import chain onto every cold start while the lazy table still
+*looks* correct.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from graftlint.core import (
+    Finding,
+    Module,
+    module_level_statements,
+    rule,
+)
+
+
+def _import_entries(
+    mod: Module, node: ast.stmt
+) -> List[Tuple[str, int, Optional[str]]]:
+    """(absolute dotted module, line, from-name) for one import
+    statement, with relative imports resolved against ``mod``.
+    ``from X import Y`` yields ``from-name=Y`` so callers can detect
+    submodule imports."""
+    out: List[Tuple[str, int, Optional[str]]] = []
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            out.append((a.name, node.lineno, None))
+    elif isinstance(node, ast.ImportFrom):
+        base = node.module or ""
+        if node.level:  # relative: resolve against this module
+            pkg_parts = mod.relpath.rsplit(".py", 1)[0].split("/")
+            # the containing package: drop the module file name —
+            # correct for plain modules AND __init__.py (whose
+            # package is its directory)
+            pkg_parts = pkg_parts[:-1]
+            anchor = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+            base = ".".join(
+                anchor + ([node.module] if node.module else [])
+            )
+        if base:
+            for a in node.names:
+                out.append((base, node.lineno, a.name))
+    return out
+
+
+def _module_level_imports(
+    mod: Module,
+) -> List[Tuple[str, int, Optional[str]]]:
+    """Every import executed at module import time (absolute dotted
+    names — see :func:`_import_entries`)."""
+    out: List[Tuple[str, int, Optional[str]]] = []
+    for node in module_level_statements(mod.tree):
+        out.extend(_import_entries(mod, node))
+    return out
+
+
+def _candidate_files(modname: str, package: str) -> List[str]:
+    """Project files executed by importing ``modname`` (the module
+    itself plus every ancestor ``__init__``)."""
+    if modname.split(".")[0] != package:
+        return []
+    parts = modname.split(".")
+    files = []
+    for i in range(1, len(parts) + 1):
+        prefix = "/".join(parts[:i])
+        files.append(f"{prefix}/__init__.py")
+    files.append("/".join(parts) + ".py")
+    return files
+
+
+class _ImportGraph:
+    """Module-level import edges between project files, plus the set
+    of files that import a banned root directly at module level."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        cfg = ctx.config
+        self.direct: Dict[str, Tuple[str, int]] = {}  # rel -> (root, line)
+        self.edges: Dict[str, List[Tuple[str, int, str]]] = {}
+        for rel, mod in ctx.modules.items():
+            edges: List[Tuple[str, int, str]] = []
+            for modname, line, fromname in _module_level_imports(mod):
+                root = modname.split(".")[0]
+                if root in cfg.banned_import_roots:
+                    self.direct.setdefault(rel, (modname, line))
+                    continue
+                targets = _candidate_files(modname, cfg.package)
+                if fromname is not None:
+                    # `from X import Y`: Y may itself be a submodule
+                    targets += _candidate_files(
+                        f"{modname}.{fromname}", cfg.package
+                    )
+                for t in targets:
+                    if t in ctx.modules and t != rel:
+                        edges.append((t, line, modname))
+            self.edges[rel] = edges
+
+    def jax_path(self, rel: str) -> Optional[List[str]]:
+        """A module-level import chain from ``rel`` to a direct
+        banned import, or None.  BFS: shortest chain reported."""
+        seen: Set[str] = {rel}
+        frontier: List[Tuple[str, List[str]]] = [(rel, [rel])]
+        while frontier:
+            nxt: List[Tuple[str, List[str]]] = []
+            for cur, path in frontier:
+                if cur in self.direct:
+                    return path
+                for t, _line, _mn in self.edges.get(cur, ()):
+                    if t not in seen:
+                        seen.add(t)
+                        nxt.append((t, path + [t]))
+            frontier = nxt
+        return None
+
+
+@rule(
+    "jax-import-surface",
+    "declared jax-free modules must not import jax at module level, "
+    "directly or transitively",
+)
+def check_jax_free_surface(ctx):
+    graph = _ImportGraph(ctx)
+    for mod in ctx.match(ctx.config.jax_free_surface):
+        rel = mod.relpath
+        if rel in graph.direct:
+            modname, line = graph.direct[rel]
+            yield Finding(
+                rule="jax-import-surface",
+                path=rel,
+                line=line,
+                message=(
+                    f"module-level `import {modname}` on the declared "
+                    "jax-free surface — move it into the function that "
+                    "needs it (docs/linting.md)"
+                ),
+                detail=f"direct:{modname.split('.')[0]}",
+            )
+            continue
+        path = graph.jax_path(rel)
+        if path is not None and len(path) > 1:
+            culprit = path[-1]
+            modname, line = graph.direct[culprit]
+            hop_line = next(
+                (
+                    ln
+                    for t, ln, _mn in graph.edges[rel]
+                    if t == path[1]
+                ),
+                1,
+            )
+            chain = " -> ".join(path)
+            yield Finding(
+                rule="jax-import-surface",
+                path=rel,
+                line=hop_line,
+                message=(
+                    "jax reaches this jax-free module through "
+                    f"module-level imports: {chain} (which does "
+                    f"`import {modname}` at line {line}) — defer the "
+                    "first hop into a function or a PEP-562 lazy table"
+                ),
+                detail=f"reaches:{culprit}",
+            )
+
+
+@rule(
+    "lazy-init-eager-import",
+    "a PEP-562 __init__ must not eagerly import modules it lazily "
+    "exposes",
+)
+def check_lazy_init(ctx):
+    for rel, mod in sorted(ctx.modules.items()):
+        if not rel.endswith("__init__.py"):
+            continue
+        getattr_def = next(
+            (
+                n
+                for n in mod.tree.body
+                if isinstance(n, ast.FunctionDef)
+                and n.name == "__getattr__"
+            ),
+            None,
+        )
+        if getattr_def is None:
+            continue
+        # resolve the lazily-imported modules EXACTLY like the eager
+        # side (relative imports included) — the two sets must live
+        # in the same namespace or the comparison is silently inert
+        lazy_mods: Set[str] = set()
+        for node in ast.walk(getattr_def):
+            for modname, _line, fromname in _import_entries(mod, node):
+                lazy_mods.add(modname)
+                if fromname is not None:
+                    # `from pkg import impl` lazily exposes pkg.impl
+                    lazy_mods.add(f"{modname}.{fromname}")
+        if not lazy_mods:
+            continue
+        for modname, line, fromname in _module_level_imports(mod):
+            eager = {modname}
+            if fromname is not None:
+                eager.add(f"{modname}.{fromname}")
+            hit = sorted(eager & lazy_mods)
+            if hit:
+                yield Finding(
+                    rule="lazy-init-eager-import",
+                    path=rel,
+                    line=line,
+                    message=(
+                        f"eagerly imports {hit[0]} which __getattr__ "
+                        "exposes lazily — the PEP-562 table no longer "
+                        "defers anything for it"
+                    ),
+                    detail=f"eager:{hit[0]}",
+                )

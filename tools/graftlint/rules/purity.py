@@ -1,0 +1,245 @@
+"""Determinism-purity rules for seeded/replayable scopes.
+
+``impure-call`` — inside a seeded scope (whole modules like
+``faults/``, or named functions like the service shed predictor), a
+call to wall-clock/OS-entropy sources (``time.time``, the bare
+``random`` module stream, ``os.urandom``, ``uuid.uuid4``,
+``secrets.*``, ``datetime.now``) breaks the pure-hash replay contract:
+the same seed no longer reproduces the same decisions.
+``random.Random(seed)`` stays legal — a *seeded private* stream is the
+approved construction — as are injectable clock/sleep *references*
+(only calls are flagged).
+
+``set-iteration`` — iterating a bare ``set`` lets hash order escape
+into decisions (and PYTHONHASHSEED varies per process for str keys).
+Flagged: ``for``/comprehension iteration directly over a set
+display/comprehension/``set()``/``frozenset()`` call, and
+``list(set(...))`` / ``tuple(set(...))``.  ``sorted(set(...))`` is the
+approved spelling and is naturally not flagged.
+
+Audited exceptions carry ``# graftlint: allow[impure-call] — reason``
+in place (core.py strips them centrally).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, Iterator, Tuple
+
+from graftlint.core import (
+    Finding,
+    Module,
+    dotted_name,
+    enclosing_qualnames,
+    imported_names,
+    resolve_call,
+    rule,
+)
+
+#: canonical dotted call targets that break seeded replay
+_BANNED_EXACT = {
+    "time.time",
+    "time.time_ns",
+    "os.urandom",
+    "uuid.uuid1",
+    "uuid.uuid4",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+}
+_BANNED_PREFIXES = ("secrets.",)
+#: the bare module-level random stream; random.Random(seed) is fine
+_RANDOM_ALLOWED = {"random.Random", "random.SystemRandom"}
+
+
+def _seeded_scopes(ctx) -> Iterator[Tuple[Module, object]]:
+    """(module, qualname-filter) pairs; filter None = whole module."""
+    cfg = ctx.config
+    for mod in ctx.match(cfg.seeded_modules):
+        yield mod, None
+    for rel, quals in sorted(cfg.seeded_functions.items()):
+        mod = ctx.module(rel)
+        if mod is not None:
+            yield mod, tuple(quals)
+
+
+def _in_scope(qual: str, quals) -> bool:
+    if quals is None:
+        return True
+    return any(fnmatch.fnmatch(qual, q) for q in quals)
+
+
+def _banned_target(target: str) -> bool:
+    if target in _BANNED_EXACT:
+        return True
+    if any(target.startswith(p) for p in _BANNED_PREFIXES):
+        return True
+    if (
+        target.startswith("random.")
+        and target not in _RANDOM_ALLOWED
+        and target.count(".") == 1
+    ):
+        return True
+    return False
+
+
+def _stale_scope_findings(ctx):
+    """The liveness guard on the purity contract itself: a configured
+    seeded module that no longer exists, or a qualname glob matching
+    no function, silently removes a purity scope — the same
+    parseable-but-inert drift class the chaos rules guard their own
+    tables against."""
+    from graftlint.core import qualname_map
+
+    cfg = ctx.config
+    for pat in cfg.seeded_modules:
+        if not ctx.match((pat,)):
+            yield Finding(
+                rule="impure-call",
+                path=pat,
+                line=1,
+                message=(
+                    f"seeded-module glob `{pat}` matches no scanned "
+                    "file — the purity scope it declared is gone; "
+                    "update graftlint config seeded_modules"
+                ),
+                detail=f"stale-scope:{pat}",
+            )
+    for rel, quals in sorted(cfg.seeded_functions.items()):
+        mod = ctx.module(rel)
+        if mod is None:
+            yield Finding(
+                rule="impure-call",
+                path=rel,
+                line=1,
+                message=(
+                    f"seeded-functions module `{rel}` is not scanned "
+                    "any more — its purity scopes are gone; update "
+                    "graftlint config seeded_functions"
+                ),
+                detail="stale-scope:module",
+            )
+            continue
+        names = set(qualname_map(mod.tree).values())
+        for q in quals:
+            if not any(fnmatch.fnmatch(n, q) for n in names):
+                yield Finding(
+                    rule="impure-call",
+                    path=rel,
+                    line=1,
+                    message=(
+                        f"seeded qualname `{q}` matches no function "
+                        f"in {rel} (renamed or deleted) — the purity "
+                        "scope is silently inert; update graftlint "
+                        "config seeded_functions"
+                    ),
+                    detail=f"stale-scope:{q}",
+                )
+
+
+@rule(
+    "impure-call",
+    "seeded scopes must not call wall-clock / OS-entropy sources",
+)
+def check_impure_calls(ctx):
+    yield from _stale_scope_findings(ctx)
+    for mod, quals in _seeded_scopes(ctx):
+        imports = imported_names(mod.tree)
+        qmap = enclosing_qualnames(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = resolve_call(node, imports)
+            if target is None or not _banned_target(target):
+                continue
+            qual = qmap[node.lineno]
+            if not _in_scope(qual, quals):
+                continue
+            yield Finding(
+                rule="impure-call",
+                path=mod.relpath,
+                line=node.lineno,
+                message=(
+                    f"`{target}()` in seeded scope `{qual}` — replay "
+                    "would diverge; derive it from (seed, key, seq) "
+                    "via a blake2b hash (utils/backoff.py), or mark "
+                    "an audited exception with "
+                    "`# graftlint: allow[impure-call] — reason`"
+                ),
+                detail=f"{target}@{qual}",
+            )
+
+
+def _is_bare_set(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        dn = dotted_name(node.func)
+        return dn in ("set", "frozenset")
+    return False
+
+
+@rule(
+    "set-iteration",
+    "seeded scopes must not iterate bare sets (hash order escapes "
+    "into decisions)",
+)
+def check_set_iteration(ctx):
+    for mod, quals in _seeded_scopes(ctx):
+        qmap = enclosing_qualnames(mod.tree)
+        counters: Dict[str, int] = {}
+
+        def emit(node, kind, iter_node):
+            qual = qmap[node.lineno]
+            if not _in_scope(qual, quals):
+                return None
+            # the baseline detail keys on the iterated EXPRESSION, so
+            # inserting an unrelated bare-set loop above a baselined
+            # one cannot steal its identity; an ordinal only breaks
+            # ties between textually identical iterations
+            try:
+                snippet = ast.unparse(iter_node)[:60]
+            except Exception:  # pragma: no cover — defensive
+                snippet = "?"
+            ident = f"{kind}@{qual}:{snippet}"
+            n = counters.get(ident, 0) + 1
+            counters[ident] = n
+            return Finding(
+                rule="set-iteration",
+                path=mod.relpath,
+                line=node.lineno,
+                message=(
+                    f"{kind} over a bare set in seeded scope "
+                    f"`{qual}` — iteration order is hash order; "
+                    "wrap in sorted(...)"
+                ),
+                detail=ident if n == 1 else f"{ident}#{n}",
+            )
+
+        for node in ast.walk(mod.tree):
+            f = None
+            if isinstance(node, (ast.For, ast.AsyncFor)) and _is_bare_set(
+                node.iter
+            ):
+                f = emit(node, "for-loop", node.iter)
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+            ):
+                bad = next(
+                    (g.iter for g in node.generators if _is_bare_set(g.iter)),
+                    None,
+                )
+                if bad is not None:
+                    f = emit(node, "comprehension", bad)
+            elif isinstance(node, ast.Call):
+                dn = dotted_name(node.func)
+                if (
+                    dn in ("list", "tuple")
+                    and len(node.args) == 1
+                    and _is_bare_set(node.args[0])
+                ):
+                    f = emit(node, f"{dn}()", node.args[0])
+            if f is not None:
+                yield f

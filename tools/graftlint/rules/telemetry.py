@@ -1,0 +1,284 @@
+"""Telemetry-drift rules: code and docs must agree on what exists.
+
+``metric-undocumented`` — every counter/gauge/histogram name a
+``MetricsRegistry`` call site emits (string literals and f-string
+prefixes at ``.inc(...)`` / ``.gauge(...)`` / ``.observe(...)``) must
+appear in the documentation registry (``docs/observability.md`` +
+``docs/serving.md``).  PR after PR added counters and forgot the doc
+row; an undocumented counter is invisible to operators.
+
+``metric-stale-doc`` — the reverse: a metric-shaped token in the docs
+that no call site emits any more.  To keep python-path lookalikes out
+(``ops.compile.compile_dcop``), only tokens whose first segment is a
+*live metric prefix* (one some call site actually uses) are checked —
+a fully removed metric family needs its doc rows deleted in the same
+PR, which this rule enforces for every family still partially alive.
+
+``chaos-clause-doc`` — every fault kind registered in
+``faults/plan.py`` must appear as a ``kind=`` clause in
+``docs/faults.md``, and every clause-shaped token there must be a
+registered kind (stale spec rows mislead chaos users into writing
+specs that raise).
+
+F-string emissions (``met.inc(f"fault.{kind}")``) become wildcard
+names (``fault.*``): any documented name under the prefix matches, and
+the doc may document the family as ``fault.<kind>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from graftlint.core import Finding, rule
+from graftlint.rules.chaos import registered_kinds
+
+_METRIC_METHODS = {"inc", "gauge", "observe"}
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_*<>-]+)+$")
+_CODE_SPAN_RE = re.compile(r"`([^`\n]+)`")
+#: doc shorthand continuing the previous metric name: `_misses`
+#: (replace the trailing _segment) or `.ticks` (replace the last
+#: dotted segment) — the `x.y_hits`/`_misses` and
+#: `service.requests` / `.ticks` list styles
+_UNDERSCORE_SHORTHAND_RE = re.compile(r"^_[a-z0-9_]+$")
+_DOTTED_SHORTHAND_RE = re.compile(r"^\.[a-z0-9_]+$")
+_NONMETRIC_SUFFIXES = (
+    ".py",
+    ".md",
+    ".json",
+    ".jsonl",
+    ".yaml",
+    ".yml",
+    ".sh",
+)
+
+
+def code_metrics(ctx) -> Dict[str, Tuple[str, int]]:
+    """name (``*``-wildcarded for f-strings) → first (relpath, line)
+    emitting it."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for mod in ctx.match(ctx.config.metrics_code):
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_METHODS
+                and node.args
+            ):
+                continue
+            for name in _literal_names(node.args[0]):
+                if "." in name:
+                    out.setdefault(name, (mod.relpath, node.lineno))
+    return out
+
+
+def _literal_names(arg: ast.AST) -> List[str]:
+    """String values an emission argument can take: plain literals,
+    both branches of a conditional expression, and f-strings as
+    ``prefix*`` wildcards."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, ast.IfExp):
+        return _literal_names(arg.body) + _literal_names(arg.orelse)
+    if isinstance(arg, ast.JoinedStr):
+        prefix = ""
+        for v in arg.values:
+            if isinstance(v, ast.Constant):
+                prefix += str(v.value)
+            else:
+                break
+        if prefix:
+            return [prefix + "*"]
+    return []
+
+
+def doc_metrics(
+    ctx, prefixes: Set[str]
+) -> Dict[str, Tuple[str, int]]:
+    """Metric-shaped tokens in the doc registry, normalized:
+    ``fault.<kind>`` → ``fault.*``; suffix shorthand
+    (`` `x.y_hits`/`_misses` ``) expands against the previous token."""
+    out: Dict[str, Tuple[str, int]] = {}
+    ignore = set(ctx.config.doc_token_ignore)
+    for rel in ctx.config.metrics_docs:
+        text = ctx.doc_text(rel)
+        if text is None:
+            continue
+        prev: Optional[str] = None
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            for m in _CODE_SPAN_RE.finditer(line):
+                token = m.group(1).strip()
+                if prev and _UNDERSCORE_SHORTHAND_RE.match(token):
+                    # strip as many trailing _segments from the
+                    # previous name as the shorthand supplies:
+                    # `x_hits`/`_misses` and
+                    # `x_cache_hits`/`_cache_misses` both expand right
+                    n = token.count("_")
+                    token = re.sub(
+                        r"(?:_[a-z0-9]+){%d}$" % n, token, prev
+                    )
+                elif prev and _DOTTED_SHORTHAND_RE.match(token):
+                    token = prev.rsplit(".", 1)[0] + token
+                if not _NAME_RE.match(token):
+                    prev = None
+                    continue
+                if token.endswith(_NONMETRIC_SUFFIXES) or "/" in token:
+                    prev = None
+                    continue
+                norm = re.sub(r"<[^>]*>", "*", token)
+                norm = re.sub(r"\*+", "*", norm).rstrip(".")
+                if norm in ignore or token in ignore:
+                    prev = None
+                    continue
+                if norm.split(".")[0] not in prefixes:
+                    prev = None
+                    continue
+                out.setdefault(norm, (rel, lineno))
+                prev = norm
+    return out
+
+
+def _code_covered(name: str, documented: Set[str]) -> bool:
+    """Whether an EMITTED name is documented.  Deliberately
+    asymmetric: a doc-side family wildcard (``service.*`` prose) does
+    NOT document an exact code name — otherwise one ``service.*``
+    mention would wave every future service counter through, exactly
+    the drift this rule exists to stop.  A code-side wildcard
+    (f-string family) is documented by the same wildcard
+    (``fault.<kind>``) or by any exact doc name under its prefix."""
+    if name in documented:
+        return True
+    if name.endswith("*"):
+        stem = name[:-1]
+        return any(
+            d.startswith(stem) and not d.endswith("*")
+            for d in documented
+        )
+    return False
+
+
+def _doc_covered(name: str, emitted: Set[str]) -> bool:
+    """Whether a DOCUMENTED name is still emitted.  A doc exact name
+    is covered by the exact emission or by a code-side family
+    wildcard; a doc family wildcard stays valid while any emission
+    lives under its prefix."""
+    if name in emitted:
+        return True
+    if name.endswith("*"):
+        stem = name[:-1]
+        return any(e.startswith(stem) for e in emitted)
+    return any(
+        e.endswith("*") and name.startswith(e[:-1]) for e in emitted
+    )
+
+
+@rule(
+    "metric-undocumented",
+    "every emitted metric name must appear in the documentation "
+    "registry",
+)
+def check_undocumented_metrics(ctx):
+    emitted = code_metrics(ctx)
+    prefixes = {n.split(".")[0] for n in emitted}
+    documented = set(doc_metrics(ctx, prefixes))
+    docs = " + ".join(ctx.config.metrics_docs)
+    for name, (rel, line) in sorted(emitted.items()):
+        if not _code_covered(name, documented):
+            yield Finding(
+                rule="metric-undocumented",
+                path=rel,
+                line=line,
+                message=(
+                    f"metric `{name}` is emitted here but documented "
+                    f"nowhere in {docs} — add the doc row (operators "
+                    "can't use a counter they can't find)"
+                ),
+                detail=name,
+            )
+
+
+@rule(
+    "metric-stale-doc",
+    "every documented metric name must still be emitted somewhere",
+)
+def check_stale_doc_metrics(ctx):
+    emitted = code_metrics(ctx)
+    prefixes = {n.split(".")[0] for n in emitted}
+    emitted_names = set(emitted)
+    for name, (rel, line) in sorted(doc_metrics(ctx, prefixes).items()):
+        if not _doc_covered(name, emitted_names):
+            yield Finding(
+                rule="metric-stale-doc",
+                path=rel,
+                line=line,
+                message=(
+                    f"documented metric `{name}` is emitted by no "
+                    "call site — delete the stale row or restore the "
+                    "emission"
+                ),
+                detail=name,
+            )
+
+
+_CLAUSE_TOKEN_RE = re.compile(r"\b([a-z][a-z0-9_]*)=")
+
+
+@rule(
+    "chaos-clause-doc",
+    "registered chaos spec clauses and docs/faults.md must agree",
+)
+def check_clause_docs(ctx):
+    cfg = ctx.config
+    plan_mod = ctx.module(cfg.chaos_plan_module)
+    if plan_mod is None:
+        return
+    kinds = set(registered_kinds(plan_mod))
+    text = ctx.doc_text(cfg.faults_doc)
+    if text is None:
+        for kind in sorted(kinds):
+            yield Finding(
+                rule="chaos-clause-doc",
+                path=cfg.faults_doc,
+                line=1,
+                message=(
+                    f"{cfg.faults_doc} missing — registered chaos "
+                    f"clause `{kind}=` has no documentation"
+                ),
+                detail=f"undocumented:{kind}",
+            )
+        return
+    doc_tokens: Dict[str, int] = {}
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        for span in _CODE_SPAN_RE.finditer(line):
+            for m in _CLAUSE_TOKEN_RE.finditer(span.group(1)):
+                doc_tokens.setdefault(m.group(1), lineno)
+    ignore = set(cfg.clause_token_ignore)
+    for kind in sorted(kinds):
+        if kind not in doc_tokens:
+            yield Finding(
+                rule="chaos-clause-doc",
+                path=cfg.faults_doc,
+                line=1,
+                message=(
+                    f"registered chaos clause `{kind}=` is not "
+                    f"documented in {cfg.faults_doc} — add the spec "
+                    "row"
+                ),
+                detail=f"undocumented:{kind}",
+            )
+    for token, lineno in sorted(doc_tokens.items()):
+        if token not in kinds and token not in ignore:
+            yield Finding(
+                rule="chaos-clause-doc",
+                path=cfg.faults_doc,
+                line=lineno,
+                message=(
+                    f"{cfg.faults_doc} documents clause `{token}=` "
+                    "but from_spec does not register it — a spec "
+                    "using it would raise; drop the stale row or add "
+                    "the token to clause_token_ignore if it is not a "
+                    "clause"
+                ),
+                detail=f"stale:{token}",
+            )

@@ -1,0 +1,245 @@
+"""Trace-key stability rules.
+
+``bare-jit`` — ``jax.jit`` (and ``pjit``/``functools.partial(jax.jit,
+…)``) may only be called inside the sanctioned cache helpers
+(``ops/compile.py``, the ``ops/semiring.py`` kernel builder,
+``telemetry/jit.py``).  Everywhere else must go through
+``telemetry.jit.profiled_jit``: a bare jit call is invisible to the
+compile/cache-hit telemetry, so a recompile storm it causes shows up
+as unexplained wall-clock instead of `jit-compile` spans — and it
+bypasses the label discipline the recompile guard budgets key on.
+
+``unhashable-closure`` — inside the cached runner-builder modules, a
+function handed to ``profiled_jit``/``jax.jit`` must not close over a
+local bound to a **mutable container literal** (``{}``/``[]``/set
+displays, comprehensions, or bare ``dict()``/``list()``/``set()``
+calls).  The runner cache keys on shapes/statics, never on the
+closure: captured mutable state is baked into the first trace and
+silently ignored after mutation — the exact "stale trace key" class
+of bug.  Capture tuples (or thread the value through the traced
+arguments) instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, List, Optional, Set
+
+from graftlint.core import (
+    Finding,
+    dotted_name,
+    enclosing_qualnames,
+    imported_names,
+    resolve_name,
+    rule,
+)
+
+_JIT_TARGETS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_MUTABLE_CALLS = {"dict", "list", "set"}
+
+
+def _is_jit_ref(node: ast.AST, imports: Dict[str, str]) -> bool:
+    return resolve_name(node, imports) in _JIT_TARGETS
+
+
+@rule(
+    "bare-jit",
+    "jax.jit is called only inside the sanctioned cache helpers; "
+    "everywhere else uses profiled_jit",
+)
+def check_bare_jit(ctx):
+    cfg = ctx.config
+    for rel, mod in sorted(ctx.modules.items()):
+        if any(
+            fnmatch.fnmatch(rel, pat)
+            for pat in cfg.sanctioned_jit_modules
+        ):
+            continue
+        imports = imported_names(mod.tree)
+        qmap = enclosing_qualnames(mod.tree)
+        for node in ast.walk(mod.tree):
+            hit = None
+            if isinstance(node, ast.Call) and _is_jit_ref(
+                node.func, imports
+            ):
+                hit = node
+            elif isinstance(node, ast.Call):
+                # functools.partial(jax.jit, ...) and decorator-style
+                # indirections: jax.jit passed as an argument
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if _is_jit_ref(arg, imports):
+                        hit = node
+                        break
+            qual = None
+            if hit is None and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                # the canonical bare spelling: a plain `@jax.jit`
+                # decorator is an Attribute reference, not a Call —
+                # attribute it to the DECORATED function (the
+                # decorator line sits above the function's span)
+                for dec in node.decorator_list:
+                    if _is_jit_ref(dec, imports):
+                        hit = dec
+                        qual = qmap[node.lineno]
+                        break
+            if hit is None:
+                continue
+            if qual is None:
+                qual = qmap[hit.lineno]
+            yield Finding(
+                rule="bare-jit",
+                path=rel,
+                line=hit.lineno,
+                message=(
+                    f"direct jax.jit in `{qual}` outside the "
+                    "sanctioned cache helpers — route through "
+                    "telemetry.jit.profiled_jit (compile telemetry + "
+                    "labeled trace keys), or move the call into "
+                    "ops/compile.py / ops/semiring.py / "
+                    "telemetry/jit.py"
+                ),
+                detail=f"jit@{qual}",
+            )
+
+
+def _bound_mutables(fn: ast.AST) -> Dict[str, int]:
+    """Locals of ``fn`` bound (at this level) to a mutable container
+    literal/constructor — name → line."""
+    out: Dict[str, int] = {}
+
+    def value_is_mutable(v: ast.AST) -> bool:
+        if isinstance(v, (ast.Dict, ast.List, ast.Set)):
+            return True
+        if isinstance(v, (ast.DictComp, ast.ListComp, ast.SetComp)):
+            return True
+        if isinstance(v, ast.Call):
+            return dotted_name(v.func) in _MUTABLE_CALLS
+        return False
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            if node is not fn:
+                return  # don't descend into nested functions
+            self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Assign(self, node):
+            if value_is_mutable(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = node.lineno
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            if node.value is not None and value_is_mutable(node.value):
+                if isinstance(node.target, ast.Name):
+                    out[node.target.id] = node.lineno
+
+    V().visit(fn)
+    return out
+
+
+def _free_loads(fn: ast.AST) -> Set[str]:
+    """Names ``fn`` (including nested scopes) loads but never binds."""
+    bound: Set[str] = set()
+    loaded: Set[str] = set()
+    args = fn.args
+    for a in (
+        args.posonlyargs
+        + args.args
+        + args.kwonlyargs
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        bound.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                loaded.add(node.id)
+            else:
+                bound.add(node.id)
+        elif isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            if node is not fn:
+                bound.add(node.name)
+        elif isinstance(node, ast.arg):
+            bound.add(node.arg)
+    return loaded - bound
+
+
+@rule(
+    "unhashable-closure",
+    "cached runner builders must not jit functions closing over "
+    "mutable container locals",
+)
+def check_unhashable_closure(ctx):
+    cfg = ctx.config
+    for rel, mod in sorted(ctx.modules.items()):
+        if not any(
+            fnmatch.fnmatch(rel, pat)
+            for pat in cfg.runner_builder_modules
+        ):
+            continue
+        imports = imported_names(mod.tree)
+        for builder in ast.walk(mod.tree):
+            if not isinstance(
+                builder, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            # find jit/profiled_jit calls directly in this builder
+            jitted: List[ast.AST] = []
+            inner_defs: Dict[str, ast.AST] = {
+                n.name: n
+                for n in ast.walk(builder)
+                if isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                )
+                and n is not builder
+            }
+            for node in ast.walk(builder):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = resolve_name(node.func, imports)
+                if target is None:
+                    continue
+                tail = target.rsplit(".", 1)[-1]
+                if (
+                    target in _JIT_TARGETS
+                    or tail == "profiled_jit"
+                ) and node.args:
+                    first = node.args[0]
+                    if isinstance(first, ast.Lambda):
+                        jitted.append(first)
+                    elif (
+                        isinstance(first, ast.Name)
+                        and first.id in inner_defs
+                    ):
+                        jitted.append(inner_defs[first.id])
+            if not jitted:
+                continue
+            mutables = _bound_mutables(builder)
+            for fn in jitted:
+                for name in sorted(_free_loads(fn)):
+                    if name in mutables:
+                        yield Finding(
+                            rule="unhashable-closure",
+                            path=rel,
+                            line=fn.lineno,
+                            message=(
+                                f"jitted function in `{builder.name}` "
+                                f"closes over `{name}`, a mutable "
+                                f"container built at line "
+                                f"{mutables[name]} — the runner cache "
+                                "key cannot see it, so mutations "
+                                "after the first trace are silently "
+                                "ignored; capture a tuple or pass it "
+                                "as a traced argument"
+                            ),
+                            detail=f"{builder.name}:{name}",
+                        )
