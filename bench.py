@@ -332,6 +332,7 @@ EVIDENCE_ROWS = [
     ("restart_sweep_10k", ["maxsum_coloring_10000_restarts*"]),
     ("supervised_overhead", ["supervised_overhead_*"]),
     ("membound_secp", ["membound_secp_*"]),
+    ("semiring_queries", ["semiring_queries_*"]),
 ]
 
 
@@ -747,6 +748,32 @@ def _measure_dpop(phase_budget: float = 0.0) -> dict:
     return out
 
 
+def _build_coloring_tree(DCOP, Domain, Variable, AgentDef, NAry):
+    """The 10k-variable 3-coloring random recursive tree both
+    semiring stages measure on (expected depth O(log n), so the
+    height-wave sweep gets wide waves — the batching shape) — ONE
+    builder so `semiring_queries` numbers are comparable to the
+    `semiring_infer` baselines row for row."""
+    import random as _random
+
+    import numpy as np
+
+    rnd = _random.Random(1)
+    dom = Domain("colors", "", list(range(SEM_COLORS)))
+    tree = DCOP(f"tree_coloring_{SEM_TREE_VARS}")
+    vs = [Variable(f"v{i}", dom) for i in range(SEM_TREE_VARS)]
+    for v in vs:
+        tree.add_variable(v)
+    eq = np.eye(SEM_COLORS)
+    for i in range(1, SEM_TREE_VARS):
+        j = rnd.randrange(i)
+        tree.add_constraint(
+            NAry([vs[j], vs[i]], eq, name=f"c{i}")
+        )
+    tree.add_agents([AgentDef("a0")])
+    return tree
+
+
 def _measure_semiring(phase_budget: float = 0.0) -> dict:
     """semiring_infer: contraction-core throughput per ⊕ (ISSUE 8).
 
@@ -760,7 +787,6 @@ def _measure_semiring(phase_budget: float = 0.0) -> dict:
     asserted (map cost == dpop cost; device log_z within its bound
     of host f64) so a throughput win can never hide a wrong answer.
     """
-    import random as _random
     import statistics
 
     with _bounded_phase("import:jax", phase_budget):
@@ -769,8 +795,6 @@ def _measure_semiring(phase_budget: float = 0.0) -> dict:
     with _bounded_phase("import:pydcop", phase_budget):
         from argparse import Namespace
 
-        import numpy as np
-
         from pydcop_tpu.api import infer, solve
         from pydcop_tpu.commands.generators.secp import generate
         from pydcop_tpu.dcop.dcop import DCOP
@@ -778,21 +802,9 @@ def _measure_semiring(phase_budget: float = 0.0) -> dict:
         from pydcop_tpu.dcop.relations import NAryMatrixRelation
 
     _phase("problem_built")
-    rnd = _random.Random(1)
-    dom = Domain("colors", "", list(range(SEM_COLORS)))
-    tree = DCOP(f"tree_coloring_{SEM_TREE_VARS}")
-    vs = [Variable(f"v{i}", dom) for i in range(SEM_TREE_VARS)]
-    for v in vs:
-        tree.add_variable(v)
-    eq = np.eye(SEM_COLORS)
-    for i in range(1, SEM_TREE_VARS):
-        # random recursive tree: expected depth O(log n), so the
-        # height-wave sweep gets wide waves (the batching shape)
-        j = rnd.randrange(i)
-        tree.add_constraint(
-            NAryMatrixRelation([vs[j], vs[i]], eq, name=f"c{i}")
-        )
-    tree.add_agents([AgentDef("a0")])
+    tree = _build_coloring_tree(
+        DCOP, Domain, Variable, AgentDef, NAryMatrixRelation
+    )
 
     def med_run(fn):
         times, last = [], None
@@ -874,6 +886,86 @@ def _measure_semiring(phase_budget: float = 0.0) -> dict:
             <= r_dev["error_bound"] + 1e-9
         ),
     }
+    _phase("measured")
+    return out
+
+
+def _measure_semiring_queries(phase_budget: float = 0.0) -> dict:
+    """semiring_queries: structured-cell query throughput (ISSUE 13).
+
+    kbest:5 and expectation cells/sec on the SAME 10k-variable
+    coloring tree the `semiring_infer` stage measures (one builder),
+    so the new queries read directly against the PR 8 log_z / map
+    baselines.  Consistency is asserted so a throughput number can
+    never hide a wrong answer: the kbest list is ascending and
+    distinct with its best equal to the map cost, and expectation's
+    log_z matches the log_z query to 1e-9.
+    """
+    import statistics
+
+    with _bounded_phase("import:jax", phase_budget):
+        import jax
+
+    with _bounded_phase("import:pydcop", phase_budget):
+        from pydcop_tpu.api import infer
+        from pydcop_tpu.dcop.dcop import DCOP
+        from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+        from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    _phase("problem_built")
+    tree = _build_coloring_tree(
+        DCOP, Domain, Variable, AgentDef, NAryMatrixRelation
+    )
+
+    def med_run(fn):
+        times, last = [], None
+        for _ in range(SEM_REPS):
+            t0 = time.perf_counter()
+            last = fn()
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times), last
+
+    _phase("measure:queries_10k")
+    out: dict = {
+        "platform": jax.devices()[0].platform,
+        "n_vars": SEM_TREE_VARS,
+        "colors": SEM_COLORS,
+        "k": 5,
+        "ok": True,
+    }
+    queries: dict = {}
+    for query in ("kbest:5", "expectation", "log_z", "map"):
+        dt, r = med_run(lambda q=query: infer(tree, q))
+        queries[query] = {
+            "seconds": round(dt, 4),
+            "cells_per_sec": round(r["cells"] / dt),
+        }
+        if query == "kbest:5":
+            kb = r
+        elif query == "expectation":
+            ex = r
+        elif query == "log_z":
+            lz = r
+        else:
+            mp = r
+    out["queries"] = queries
+    # consistency: throughput may never hide a wrong answer
+    costs = kb["costs"]
+    distinct = len(
+        {tuple(sorted(s["assignment"].items()))
+         for s in kb["solutions"]}
+    )
+    out["kbest_costs"] = [round(c, 6) for c in costs]
+    out["e_cost"] = round(ex["e_cost"], 6)
+    out["log_z"] = round(lz["log_z"], 6)
+    out["results_match"] = bool(
+        len(costs) == 5
+        and costs == sorted(costs)
+        and distinct == 5
+        and abs(costs[0] - mp["cost"]) < 1e-9
+        and abs(ex["log_z"] - lz["log_z"]) < 1e-9
+    )
+    out["ok"] = out["results_match"]
     _phase("measured")
     return out
 
@@ -1369,6 +1461,7 @@ def _inner_main() -> None:
     p.add_argument("--supervised_stage", action="store_true")
     p.add_argument("--service_stage", action="store_true")
     p.add_argument("--semiring_stage", action="store_true")
+    p.add_argument("--semiring_queries_stage", action="store_true")
     p.add_argument("--membound_stage", action="store_true")
     a = p.parse_args()
     import jax
@@ -1386,6 +1479,8 @@ def _inner_main() -> None:
         pass  # older jax: cache flags absent — correctness unaffected
     if a.membound_stage:
         metrics = _measure_membound(a.phase_budget)
+    elif a.semiring_queries_stage:
+        metrics = _measure_semiring_queries(a.phase_budget)
     elif a.semiring_stage:
         metrics = _measure_semiring(a.phase_budget)
     elif a.service_stage:
@@ -1405,7 +1500,7 @@ def _run_sub(
     pin_cpu: bool, timeout: float, n_vars: int, rounds: int,
     many: bool = False, dpop: bool = False, supervised: bool = False,
     service: bool = False, semiring: bool = False,
-    membound: bool = False,
+    semiring_queries: bool = False, membound: bool = False,
 ) -> dict:
     """Run ``bench.py --inner`` in a subprocess; parse its JSON line.
 
@@ -1439,6 +1534,11 @@ def _run_sub(
             + (["--supervised_stage"] if supervised else [])
             + (["--service_stage"] if service else [])
             + (["--semiring_stage"] if semiring else [])
+            + (
+                ["--semiring_queries_stage"]
+                if semiring_queries
+                else []
+            )
             + (["--membound_stage"] if membound else []),
             env=env,
             cwd=REPO,
@@ -1753,6 +1853,46 @@ def main() -> None:
             ]["cells_per_sec"],
         )
 
+    # structured-cell semiring queries (ops/semiring.py): kbest:5 and
+    # expectation cells/sec on the SAME 10k coloring tree as the
+    # semiring_infer baselines — the ISSUE 13 evidence row.  Same
+    # platform policy as the stages above.
+    squeries = _run_sub(pin_cpu=False, timeout=300.0, n_vars=0,
+                        rounds=0, semiring_queries=True)
+    if "error" in squeries:
+        squeries = _run_sub(pin_cpu=True, timeout=300.0, n_vars=0,
+                            rounds=0, semiring_queries=True)
+    if "error" in squeries:
+        errors.append(f"semiring_queries stage: {squeries['error']}")
+        squeries = None
+    elif not squeries.get("results_match", False):
+        errors.append(
+            "semiring_queries consistency failure: "
+            + json.dumps(
+                {
+                    k: squeries.get(k)
+                    for k in ("kbest_costs", "e_cost", "log_z")
+                }
+            )
+        )
+    elif squeries.get("platform") == "tpu":
+        # durable evidence row (msgs_per_sec=None: cells/sec per
+        # query, not a message rate)
+        append_tpu_log(
+            f"semiring_queries_{SEM_TREE_VARS}",
+            None,
+            source="bench_stage_semiring_queries",
+            kbest_cells_per_sec=squeries["queries"]["kbest:5"][
+                "cells_per_sec"
+            ],
+            expectation_cells_per_sec=squeries["queries"][
+                "expectation"
+            ]["cells_per_sec"],
+            log_z_cells_per_sec=squeries["queries"]["log_z"][
+                "cells_per_sec"
+            ],
+        )
+
     # memory-bounded contraction (ops/membound.py): an overlap-SECP
     # whose naive peak UTIL table is >= 10x the budget solved exactly
     # under max_util_bytes — the ISSUE 10 evidence row.  Same
@@ -1898,6 +2038,15 @@ def main() -> None:
             k: semiring[k]
             for k in ("platform", "tree", "secp_tiled")
             if k in semiring
+        }
+    if squeries is not None:
+        out["semiring_queries"] = {
+            k: squeries[k]
+            for k in (
+                "platform", "n_vars", "k", "queries", "kbest_costs",
+                "e_cost", "log_z", "results_match", "ok",
+            )
+            if k in squeries
         }
     if membound is not None:
         out["membound"] = {

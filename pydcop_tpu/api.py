@@ -1088,6 +1088,10 @@ def infer(
     compile_cache: Optional[str] = None,
     retry_budget: Optional[int] = None,
     max_util_bytes: Optional[int] = None,
+    map_vars: Optional[Sequence[str]] = None,
+    external_dists: Optional[
+        Mapping[str, Mapping[Any, float]]
+    ] = None,
 ) -> Dict[str, Any]:
     """Exact probabilistic inference over a DCOP's cost model — the
     semiring-generic twin of :func:`solve` (``docs/semirings.md``).
@@ -1104,7 +1108,24 @@ def infer(
       ``log Σ_x exp(-beta·E(x))`` (weighted model counting);
     - ``"map"`` — the exact MAP assignment (``max/+`` — for
       ``beta``-independent problems this equals the DPOP argmin,
-      certified exact the same way).
+      certified exact the same way);
+    - ``"kbest:<k>"`` — the k BEST assignments in cost order
+      (structured top-K cells: ⊕ merges sorted k-vectors, ⊗
+      cross-sums and truncates; certified per component and
+      re-evaluated on host f64, so the list is exact like ``map``).
+      The result carries ``solutions`` (``[{assignment, cost,
+      energy}]``, best first, all distinct) and ``costs``;
+    - ``"marginal_map"`` — maximize over ``map_vars`` of the summed
+      weight of the rest: ``max_{x_M} log Σ_{x_S} exp(-beta·E)``.
+      Both elimination-order heuristics honor the required two-block
+      order (summed variables eliminated first); the result carries
+      the ``assignment`` over ``map_vars`` and the ``value``;
+    - ``"expectation"`` — ``E[cost]`` under the Gibbs distribution
+      via first-order expectation pairs ``(log w, E[cost])``.
+      ``external_dists={external: {value: prob}}`` turns stochastic
+      externals into a MODELED expectation (the named externals are
+      summed over their distribution instead of pinned to their
+      current value); the result carries ``e_cost`` and ``log_z``.
 
     ``order`` picks the elimination-order heuristic:
     ``"pseudo_tree"`` (the DFS order DPOP uses — best on the wide
@@ -1150,6 +1171,7 @@ def infer(
         max_table_size=max_table_size, trace=trace,
         trace_format=trace_format, compile_cache=compile_cache,
         retry_budget=retry_budget, max_util_bytes=max_util_bytes,
+        map_vars=map_vars, external_dists=external_dists,
     )[0]
 
 
@@ -1170,6 +1192,10 @@ def infer_many(
     compile_cache: Optional[str] = None,
     retry_budget: Optional[int] = None,
     max_util_bytes: Optional[int] = None,
+    map_vars: Optional[Sequence[str]] = None,
+    external_dists: Optional[
+        Mapping[str, Mapping[Any, float]]
+    ] = None,
 ) -> list:
     """Run one inference ``query`` over MANY instances with their
     contraction sweeps MERGED — the :func:`solve_many` batching
@@ -1218,6 +1244,7 @@ def infer_many(
             device=device, device_min_cells=device_min_cells,
             pad_policy=pad_policy, max_table_size=max_table_size,
             max_util_bytes=max_util_bytes,
+            map_vars=map_vars, external_dists=external_dists,
             timeout=(
                 None
                 if deadline is None

@@ -17,9 +17,10 @@ from pydcop_tpu.commands._common import (
 def set_parser(subparsers) -> None:
     p = subparsers.add_parser(
         "infer",
-        help="exact inference (marginals / log Z / MAP) over the "
-        "Gibbs distribution p(x) ~ exp(-beta * cost(x)) via the "
-        "semiring contraction engine (ops/semiring.py)",
+        help="exact inference (marginals / log Z / MAP / K-best / "
+        "marginal MAP / E[cost]) over the Gibbs distribution "
+        "p(x) ~ exp(-beta * cost(x)) via the semiring contraction "
+        "engine (ops/semiring.py)",
     )
     p.add_argument(
         "dcop_files", nargs="+",
@@ -27,11 +28,31 @@ def set_parser(subparsers) -> None:
         "batched into one merged contraction sweep (api.infer_many)",
     )
     p.add_argument(
-        "-q", "--query",
-        choices=["marginals", "log_z", "map"], default="marginals",
+        "-q", "--query", default="marginals", metavar="QUERY",
         help="marginals: per-variable distributions p(x_v) (+ log_z); "
         "log_z: the log partition function (weighted counting); map: "
-        "the exact MAP assignment (max/+, certified like DPOP)",
+        "the exact MAP assignment (max/+, certified like DPOP); "
+        "kbest:<k>: the k best assignments in cost order (top-K "
+        "cells, exact like map); marginal_map: maximize --map_vars "
+        "over the summed weight of the rest (two-block elimination); "
+        "expectation: E[cost] under the Gibbs distribution "
+        "(+ --external_dists for stochastic externals).  Unknown "
+        "names fail with the nearest query suggested",
+    )
+    p.add_argument(
+        "--map_vars", default=None, metavar="V1,V2,...",
+        help="marginal_map only: comma-separated names of the "
+        "variables maximized over (every other variable is summed "
+        "out)",
+    )
+    p.add_argument(
+        "--external_dists", default=None, metavar="JSON",
+        help="expectation only: JSON mapping external-variable names "
+        "to {value: prob} distributions, e.g. "
+        "'{\"sensor\": {\"0\": 0.7, \"1\": 0.3}}' — the named "
+        "externals are summed over their distribution instead of "
+        "pinned to their current value (values are matched against "
+        "the domain, with a string fallback for JSON's string keys)",
     )
     p.add_argument(
         "--order", choices=["pseudo_tree", "min_fill"],
@@ -93,8 +114,25 @@ def set_parser(subparsers) -> None:
 
 
 def run_cmd(args) -> int:
+    import json
+
     from pydcop_tpu.api import infer, infer_many
 
+    external_dists = None
+    if args.external_dists:
+        try:
+            external_dists = json.loads(args.external_dists)
+        except ValueError as e:
+            raise SystemExit(
+                f"--external_dists is not valid JSON: {e}"
+            )
+        if not isinstance(external_dists, dict) or not all(
+            isinstance(d, dict) for d in external_dists.values()
+        ):
+            raise SystemExit(
+                "--external_dists must be a JSON object mapping "
+                "external names to {value: prob} objects"
+            )
     kw = dict(
         order=args.order,
         beta=args.beta,
@@ -107,18 +145,29 @@ def run_cmd(args) -> int:
         compile_cache=args.compile_cache,
         retry_budget=args.retry_budget,
         max_util_bytes=args.max_util_bytes,
+        map_vars=(
+            [v.strip() for v in args.map_vars.split(",") if v.strip()]
+            if args.map_vars
+            else None
+        ),
+        external_dists=external_dists,
     )
-    if len(args.dcop_files) == 1:
-        result = infer(
-            args.dcop_files[0], args.query,
-            pad_policy=args.pad_policy or "none", **kw,
+    try:
+        if len(args.dcop_files) == 1:
+            result = infer(
+                args.dcop_files[0], args.query,
+                pad_policy=args.pad_policy or "none", **kw,
+            )
+            write_result(args, result)
+            return 0
+        results = infer_many(
+            list(args.dcop_files), args.query,
+            pad_policy=args.pad_policy or "pow2", **kw,
         )
-        write_result(args, result)
-        return 0
-    results = infer_many(
-        list(args.dcop_files), args.query,
-        pad_policy=args.pad_policy or "pow2", **kw,
-    )
+    except ValueError as e:
+        # bad query / map_vars / dists: the message already carries
+        # the nearest-name suggestion — surface it, not a traceback
+        raise SystemExit(f"infer: {e}")
     for r in results:
         r.pop("telemetry", None)  # keep the printed JSON compact
     write_result(args, results)

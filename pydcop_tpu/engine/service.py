@@ -248,7 +248,7 @@ class PendingResult:
 
 @dataclasses.dataclass
 class _Request:
-    """One admitted solve request (internal)."""
+    """One admitted solve or infer request (internal)."""
 
     dcop: Any  # DCOP object (loaded at admission)
     dcop_key: Tuple  # compiled-problem cache key
@@ -268,6 +268,12 @@ class _Request:
     dcop_src: Optional[Tuple[str, str]] = None
     enqueue_t: float = 0.0
     queue_wait: float = 0.0
+    # inference requests (submit_infer): the query string and its
+    # knobs — the QUERY joins the dispatch partition key, so mixed
+    # kbest/map/log_z traffic in one tick coalesces per query and
+    # never mixes sweeps across queries
+    query: Optional[str] = None
+    infer_kw: Optional[Dict[str, Any]] = None
 
 
 class _Session:
@@ -651,6 +657,11 @@ class SolverService:
             pending=PendingResult(),
             dcop_src=_dcop_source(dcop),
         )
+        return self._admit(req)
+
+    def _admit(self, req: _Request) -> PendingResult:
+        """The one admission tail (solve and infer requests share it):
+        count, overload-check under the queue lock, enqueue or shed."""
         met = get_metrics()
         if met.enabled:
             met.inc("service.requests")
@@ -672,9 +683,126 @@ class SolverService:
             self._shed(req, shed_reason, depth, t_admit)
         return req.pending
 
+    def submit_infer(
+        self,
+        dcop: Any = None,
+        query: str = "marginals",
+        *,
+        order: str = "pseudo_tree",
+        beta: float = 1.0,
+        tol: float = 1e-6,
+        device: str = "auto",
+        device_min_cells: int = 1 << 14,
+        timeout: Optional[float] = None,
+        map_vars: Optional[Sequence[str]] = None,
+        external_dists: Optional[
+            Mapping[str, Mapping[Any, float]]
+        ] = None,
+        max_util_bytes: Optional[int] = None,
+    ) -> PendingResult:
+        """Admit one inference request (``docs/semirings.md``): the
+        semiring contraction queries — ``marginals`` / ``log_z`` /
+        ``map`` / ``kbest:<k>`` / ``marginal_map`` (``map_vars``) /
+        ``expectation`` (``external_dists``) — served by the same
+        tick loop as solves.  The QUERY joins the dispatch partition
+        key: a tick of mixed-query traffic coalesces each query's
+        requests into ONE merged contraction sweep
+        (``run_infer_many`` — per-request results bit-identical to
+        sequential ``api.infer`` calls) and never mixes sweeps across
+        queries.  Validation errors raise here; dispatch errors
+        surface from ``PendingResult.result()``."""
+        with self._cond:
+            if self._closing:
+                raise ServiceError("service is closed")
+        from pydcop_tpu.ops.semiring import (
+            ELIMINATION_ORDERS,
+            parse_query,
+        )
+
+        qkind, _ = parse_query(query)  # fail fast, nearest-name hint
+        # the cross-field checks run_infer_many enforces must fail at
+        # ADMISSION too — a doomed request must not occupy queue
+        # depth and fail asynchronously a tick later
+        if qkind == "marginal_map":
+            if not map_vars:
+                raise ValueError(
+                    "marginal_map needs map_vars=[...] — the "
+                    "variables maximized over"
+                )
+            if max_util_bytes is not None:
+                raise ValueError(
+                    "marginal_map cannot run memory-bounded "
+                    "(docs/semirings.md, 'Structured cells')"
+                )
+        elif map_vars:
+            raise ValueError(
+                f"map_vars applies to query='marginal_map' only, "
+                f"not {query!r}"
+            )
+        if external_dists and qkind != "expectation":
+            raise ValueError(
+                "external_dists applies to query='expectation' "
+                f"only, not {query!r}"
+            )
+        if order not in ELIMINATION_ORDERS:
+            raise ValueError(
+                f"unknown elimination order {order!r} (expected one "
+                f"of {ELIMINATION_ORDERS})"
+            )
+        if device not in ("auto", "never", "always"):
+            raise ValueError(
+                f"device must be 'auto'|'never'|'always', got "
+                f"{device!r}"
+            )
+        if beta <= 0:
+            raise ValueError(f"beta must be > 0, got {beta}")
+        if max_util_bytes is not None and int(max_util_bytes) <= 0:
+            raise ValueError(
+                f"max_util_bytes must be > 0, got {max_util_bytes}"
+            )
+        if dcop is None:
+            raise ValueError("dcop is required")
+        dcop_obj, dcop_key = self._load_dcop(dcop)
+        req = _Request(
+            dcop=dcop_obj, dcop_key=dcop_key,
+            algo=f"infer:{query}", params={}, rounds=0, seed=0,
+            chunk_size=0, convergence_chunks=0, n_restarts=1,
+            timeout=timeout, session=None, set_values=None,
+            pending=PendingResult(), dcop_src=_dcop_source(dcop),
+            query=str(query),
+            infer_kw={
+                "order": str(order),
+                "beta": float(beta),
+                "tol": float(tol),
+                "device": str(device),
+                "device_min_cells": int(device_min_cells),
+                "map_vars": (
+                    tuple(map_vars) if map_vars else None
+                ),
+                "external_dists": (
+                    {
+                        str(n): dict(d)
+                        for n, d in external_dists.items()
+                    }
+                    if external_dists
+                    else None
+                ),
+                "max_util_bytes": (
+                    int(max_util_bytes)
+                    if max_util_bytes is not None
+                    else None
+                ),
+            },
+        )
+        return self._admit(req)
+
     def solve(self, *args, **kwargs) -> Dict[str, Any]:
         """Blocking convenience: ``submit(...).result()``."""
         return self.submit(*args, **kwargs).result()
+
+    def infer(self, *args, **kwargs) -> Dict[str, Any]:
+        """Blocking convenience: ``submit_infer(...).result()``."""
+        return self.submit_infer(*args, **kwargs).result()
 
     # -- overload control ------------------------------------------------
 
@@ -1148,16 +1276,22 @@ class SolverService:
             met.gauge("service.queue_depth", len(self._queue))
 
         # session requests keep FIFO order per session; stateless
-        # requests coalesce into groups
+        # solves coalesce into groups; infer requests partition by
+        # QUERY (plus knobs) and merge per partition
         with supervision(self._sup):
             stateless: List[_Request] = []
+            infer_reqs: List[_Request] = []
             for req in batch:
-                if req.session is not None:
+                if req.query is not None:
+                    infer_reqs.append(req)
+                elif req.session is not None:
                     self._dispatch_session(req)
                 else:
                     stateless.append(req)
             if stateless:
                 self._dispatch_groups(stateless)
+            if infer_reqs:
+                self._dispatch_infer_groups(infer_reqs)
 
     # -- dispatch: coalesced stateless groups ----------------------------
 
@@ -1353,6 +1487,94 @@ class SolverService:
         for req, out in zip(part, results):
             self._finish(req, out, out.get("instances_batched", k))
 
+    # -- dispatch: coalesced inference partitions ------------------------
+
+    def _infer_group_key(self, req: _Request) -> Tuple:
+        """The infer dispatch partition key: QUERY first — mixed-query
+        traffic in one tick must coalesce per query, never across —
+        then every knob that changes the sweep's arithmetic or its
+        group-wide timeout."""
+        kw = req.infer_kw
+        ed = kw.get("external_dists")
+        ed_key = (
+            None
+            if not ed
+            else tuple(
+                sorted(
+                    (
+                        n,
+                        tuple(
+                            sorted(
+                                (str(v), float(p))
+                                for v, p in d.items()
+                            )
+                        ),
+                    )
+                    for n, d in ed.items()
+                )
+            )
+        )
+        return (
+            "infer", req.query, kw["order"], kw["beta"], kw["tol"],
+            kw["device"], kw["device_min_cells"], kw["map_vars"],
+            ed_key, kw["max_util_bytes"], req.timeout,
+        )
+
+    def _dispatch_infer_groups(self, reqs: List[_Request]) -> None:
+        partitions: "OrderedDict[Tuple, List[_Request]]" = (
+            OrderedDict()
+        )
+        for req in reqs:
+            partitions.setdefault(
+                self._infer_group_key(req), []
+            ).append(req)
+        for part in partitions.values():
+            try:
+                self._dispatch_infer(part)
+            except Exception as e:  # noqa: BLE001 — fail this
+                # partition's requests, keep serving the others
+                self._fail(part, e)
+
+    def _dispatch_infer(self, part: List[_Request]) -> None:
+        """One merged ``run_infer_many`` sweep per infer partition:
+        same-bucket contractions from different requests share one
+        vmapped dispatch, and per-request results are bit-identical
+        to sequential ``api.infer`` calls (the solve_many contract)."""
+        from pydcop_tpu.ops.semiring import run_infer_many
+
+        tr = get_tracer()
+        r0 = part[0]
+        kw = r0.infer_kw
+        k = len(part)
+        run_timeout = None
+        if r0.timeout is not None:
+            run_timeout = max(
+                r0.timeout - (time.perf_counter() - r0.enqueue_t),
+                0.01,
+            )
+        self._record_dispatch(k, 0)
+        mv = kw["map_vars"]
+        with tr.span(
+            "service.dispatch", cat="service", instances=k, padded=0,
+            algo=r0.algo,
+        ):
+            results = run_infer_many(
+                [g.dcop for g in part],
+                r0.query,
+                order=kw["order"],
+                beta=kw["beta"],
+                tol=kw["tol"],
+                device=kw["device"],
+                device_min_cells=kw["device_min_cells"],
+                pad_policy=self.pad_policy,
+                timeout=run_timeout,
+                max_util_bytes=kw["max_util_bytes"],
+                map_vars=list(mv) if mv else None,
+                external_dists=kw["external_dists"],
+            )
+        for req, out in zip(part, results):
+            self._finish(req, out, k)
+
     # -- dispatch: session-affine requests -------------------------------
 
     def _dispatch_session(self, req: _Request) -> None:
@@ -1472,6 +1694,14 @@ _SOLVE_FIELDS = (
     "rounds", "seed", "chunk_size", "convergence_chunks",
     "n_restarts", "timeout", "session", "set_values",
     "max_util_bytes",
+)
+
+#: fields an ``op: "infer"`` frame may carry — mirrors
+#: :meth:`SolverService.submit_infer` (the query itself rides the
+#: frame's ``query`` field and joins the dispatch partition key)
+_INFER_FIELDS = (
+    "order", "beta", "tol", "device", "device_min_cells",
+    "timeout", "map_vars", "external_dists", "max_util_bytes",
 )
 
 #: results are trimmed for the wire: the per-round cost trace can be
@@ -1782,7 +2012,7 @@ class ServiceServer:
                     # hashes re-roll on reconnect, replay identically
                     # for the same seed + client behavior
                     st.scope = f"{st.cid}/{k}"
-                if msg.get("op") == "solve":
+                if msg.get("op") in ("solve", "infer"):
                     self._handle_solve(st, msg)
                     continue
                 rid = msg.get("id")
@@ -2044,18 +2274,30 @@ class ServiceServer:
             if pending is not None:
                 self.service.note_replayed_reply()
             else:
-                kwargs = {
-                    k: msg[k]
-                    for k in _SOLVE_FIELDS
-                    if msg.get(k) is not None
-                }
                 try:
-                    real = self.service.submit(
-                        msg.get("dcop"),
-                        msg.get("algo"),
-                        msg.get("params") or None,
-                        **kwargs,
-                    )
+                    if msg.get("op") == "infer":
+                        kwargs = {
+                            k: msg[k]
+                            for k in _INFER_FIELDS
+                            if msg.get(k) is not None
+                        }
+                        real = self.service.submit_infer(
+                            msg.get("dcop"),
+                            msg.get("query", "marginals"),
+                            **kwargs,
+                        )
+                    else:
+                        kwargs = {
+                            k: msg[k]
+                            for k in _SOLVE_FIELDS
+                            if msg.get(k) is not None
+                        }
+                        real = self.service.submit(
+                            msg.get("dcop"),
+                            msg.get("algo"),
+                            msg.get("params") or None,
+                            **kwargs,
+                        )
                 except Exception as e:  # noqa: BLE001 — per-request
                     if placeholder is not None:
                         # resolve attached retries with the SAME
@@ -2381,6 +2623,35 @@ class ServiceClient:
         reply = self._call(
             "solve", dcop=dcop, algo=algo,
             params=dict(params) if params else None, **kwargs,
+        )
+        return reply["result"]
+
+    def infer(
+        self,
+        dcop: Optional[str] = None,
+        query: str = "marginals",
+        **kwargs,
+    ) -> Dict[str, Any]:
+        """Inference over the wire; kwargs mirror
+        :meth:`SolverService.submit_infer` (order, beta, tol, device,
+        device_min_cells, timeout, map_vars, external_dists,
+        max_util_bytes).  Mixed-query clients coalesce per query in
+        the service's ticks."""
+        unknown = set(kwargs) - set(_INFER_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown infer field(s) {sorted(unknown)}; the wire "
+                f"protocol accepts {_INFER_FIELDS}"
+            )
+        if (
+            isinstance(dcop, str)
+            and "\n" not in dcop
+            and os.path.isfile(dcop)
+        ):
+            with open(dcop, encoding="utf-8") as f:
+                dcop = f.read()
+        reply = self._call(
+            "infer", dcop=dcop, query=query, **kwargs,
         )
         return reply["result"]
 

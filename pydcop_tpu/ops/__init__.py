@@ -47,6 +47,7 @@ _COSTS_EXPORTS = {
 # costs (jax loads even later, inside its kernel builder)
 _SEMIRING_EXPORTS = {
     "ELIMINATION_ORDERS",
+    "KNOWN_QUERIES",
     "QUERY_SEMIRINGS",
     "SEMIRINGS",
     "Semiring",
@@ -54,7 +55,9 @@ _SEMIRING_EXPORTS = {
     "build_plan",
     "contraction_kernel",
     "get_semiring",
+    "kbest_semiring",
     "min_fill_order",
+    "parse_query",
     "register_semiring",
     "run_infer_many",
 }

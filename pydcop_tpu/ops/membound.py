@@ -201,6 +201,16 @@ def prune_plan(plan: ContractionPlan):
             pruned_cells += before - t.size
             new_bucket.append((scope, t))
         plan.buckets[v] = new_bucket
+        if plan.wbuckets[v]:
+            # weight (log-prob) parts ride the same domains — slice
+            # them too or an expectation lane misaligns its axes
+            new_w = []
+            for scope, t in plan.wbuckets[v]:
+                for ax, u in enumerate(scope):
+                    if keep[u].size != orig_len[u]:
+                        t = np.take(t, keep[u], axis=ax)
+                new_w.append((scope, t))
+            plan.wbuckets[v] = new_w
     for v in list(domains):
         if keep[v].size != orig_len[v]:
             domains[v] = [domains[v][i] for i in keep[v]]
@@ -221,6 +231,10 @@ class CutPlan:
     budget_cells: int
     naive_peak_cells: int
     bounded_peak_cells: int
+    #: bytes per SCALAR-WORLD cell = BYTES_PER_CELL × the semiring's
+    #: cell width (a kbest:8 sweep moves 8 f32s per table cell — the
+    #: budget model must see them or the sweep lands 8× over budget)
+    cell_width: int = 1
 
     @property
     def width(self) -> int:
@@ -232,6 +246,7 @@ def plan_cut(
     max_util_bytes: int,
     pad=None,
     max_cut_lanes: int = MAX_CUT_LANES,
+    cell_width: int = 1,
 ) -> CutPlan:
     """Choose a minimal cut set keeping every contraction table of
     the plan under ``max_util_bytes``.
@@ -251,11 +266,17 @@ def plan_cut(
     sit in the most separators), then by name.  Deterministic: a
     pure function of (graph, domains, budget, pad).  Raises
     :class:`MemboundError` when no cut within ``max_cut_lanes``
-    enumeration lanes meets the budget."""
+    enumeration lanes meets the budget.
+
+    ``cell_width`` is the semiring's structured-cell width
+    (``ops/semiring.py``): every table cell is ``cell_width`` f32s on
+    device, so the cell budget divides by it — a ``kbest:8`` sweep
+    under ``max_util_bytes`` must not land 8× over budget unseen."""
     from pydcop_tpu.ops.padding import NO_PADDING, bucket_util_shape
 
     pad = NO_PADDING if pad is None else pad
-    budget_cells = max(int(max_util_bytes) // BYTES_PER_CELL, 1)
+    bytes_per_cell = BYTES_PER_CELL * max(int(cell_width), 1)
+    budget_cells = max(int(max_util_bytes) // bytes_per_cell, 1)
     seps: Dict[str, List[str]] = {}
     targets: Dict[str, List[str]] = {}
     for v in plan.order:
@@ -303,8 +324,8 @@ def plan_cut(
                 (s for _, _, s in sizes(cutset)), default=1
             )
             raise MemboundError(
-                naive_peak_bytes=naive_peak * BYTES_PER_CELL,
-                reached_peak_bytes=reached * BYTES_PER_CELL,
+                naive_peak_bytes=naive_peak * bytes_per_cell,
+                reached_peak_bytes=reached * bytes_per_cell,
                 max_util_bytes=int(max_util_bytes),
                 cut_width=len(cut),
                 lanes=lanes * dsize[pick],
@@ -315,7 +336,8 @@ def plan_cut(
         lanes *= dsize[pick]
     bounded_peak = max((s for _, _, s in sizes(cutset)), default=1)
     return CutPlan(
-        tuple(cut), lanes, budget_cells, naive_peak, bounded_peak
+        tuple(cut), lanes, budget_cells, naive_peak, bounded_peak,
+        cell_width=max(int(cell_width), 1),
     )
 
 
@@ -340,10 +362,10 @@ def lane_plans(plan: ContractionPlan, cut: Sequence[str]):
         domains_l = dict(plan.domains)
         for c, i in fixed.items():
             domains_l[c] = [plan.domains[c][i]]
-        buckets_l: Dict[str, list] = {}
-        for v in plan.order:
+
+        def _slice(bucket):
             lane_parts = []
-            for scope, table in plan.buckets[v]:
+            for scope, table in bucket:
                 t = table
                 for d in scope:
                     if d in fixed:
@@ -351,11 +373,20 @@ def lane_plans(plan: ContractionPlan, cut: Sequence[str]):
                             t, [fixed[d]], axis=scope.index(d)
                         )
                 lane_parts.append((scope, t))
-            buckets_l[v] = lane_parts
+            return lane_parts
+
+        buckets_l: Dict[str, list] = {}
+        wbuckets_l: Dict[str, list] = {}
+        for v in plan.order:
+            buckets_l[v] = _slice(plan.buckets[v])
+            wbuckets_l[v] = _slice(plan.wbuckets[v])
         out.append(
             ContractionPlan(
                 domains_l, plan.order, buckets_l,
                 plan.const_energy, plan.order_name,
+                wbuckets=wbuckets_l,
+                node_semiring=plan.node_semiring,
+                max_vars=plan.max_vars,
             )
         )
     return out, combos
@@ -469,9 +500,9 @@ class BoundedSweep:
             "cut_width": cp.width,
             "cut_lanes": cp.n_lanes,
             "peak_table_bytes": cp.bounded_peak_cells
-            * BYTES_PER_CELL,
+            * BYTES_PER_CELL * cp.cell_width,
             "naive_peak_table_bytes": cp.naive_peak_cells
-            * BYTES_PER_CELL,
+            * BYTES_PER_CELL * cp.cell_width,
             "pruned_cells": int(self.pruned_cells),
             "replans": int(self.replans),
         }
@@ -528,7 +559,10 @@ def run_bounded(
     # sizing error (peak bytes vs budget, cut width), replacing the
     # old "try order='min_fill'" retry hint for budgeted calls
     cuts0 = [
-        plan_cut(p, max_util_bytes, pad, max_cut_lanes)
+        plan_cut(
+            p, max_util_bytes, pad, max_cut_lanes,
+            cell_width=sr.cell_width,
+        )
         for p in plans
     ]
     cuts = cuts0
@@ -563,7 +597,10 @@ def run_bounded(
             if budget >= 2 * BYTES_PER_CELL:
                 try:
                     next_cuts = [
-                        plan_cut(p, budget, pad, max_cut_lanes)
+                        plan_cut(
+                            p, budget, pad, max_cut_lanes,
+                            cell_width=sr.cell_width,
+                        )
                         for p in plans
                     ]
                 except MemboundError:

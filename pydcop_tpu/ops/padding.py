@@ -143,11 +143,24 @@ def util_level_key(
     axis: 0 on real values, +inf on padded ones) that
     :func:`pad_util_parts` adds so no argmin can land in a ghost cell
     — the mask is part of the kernel signature.
+
+    A part with ONE MORE axis than the joined shape carries a
+    structured-cell value axis (``ops/semiring.py`` kbest /
+    expectation cells): its named axes bucket as usual and the
+    trailing value axis is STATIC — kept verbatim, never padded (the
+    cell width is part of the semiring, not of the problem size, so
+    padding it would change the algebra).
     """
     pshape = bucket_util_shape(shape, policy)
+    nd = len(pshape)
     pparts = tuple(
         tuple(
-            1 if s == 1 else pshape[i] for i, s in enumerate(ps)
+            (
+                s
+                if (len(ps) == nd + 1 and i == len(ps) - 1)
+                else (1 if s == 1 else pshape[i])
+            )
+            for i, s in enumerate(ps)
         )
         for ps in part_shapes
     )
@@ -178,12 +191,22 @@ def pad_util_parts(
     it is absorbing for ``max`` and contributes ``exp(-inf)=0`` to a
     logsumexp.  ``with_mask=False`` skips the mask (a NO_PADDING
     bucket whose key carries no mask slot) and the call degenerates
-    to the per-part f32 casts."""
+    to the per-part f32 casts.  Parts carrying a trailing
+    structured-cell value axis (one more axis than ``pshape``) pad
+    their named axes only — the value axis is static, mirroring
+    :func:`util_level_key`."""
     out = []
     for a in aligned:
-        target = tuple(
-            1 if s == 1 else pshape[i] for i, s in enumerate(a.shape)
-        )
+        if a.ndim == len(pshape) + 1:
+            target = tuple(
+                1 if s == 1 else pshape[i]
+                for i, s in enumerate(a.shape[:-1])
+            ) + (a.shape[-1],)
+        else:
+            target = tuple(
+                1 if s == 1 else pshape[i]
+                for i, s in enumerate(a.shape)
+            )
         if target == a.shape:
             # f64 inputs cast here so every returned part is kernel-
             # ready f32 (callers pass exact f64 aligned parts)

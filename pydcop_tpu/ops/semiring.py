@@ -62,8 +62,9 @@ re-exports it lazily (PEP 562).
 from __future__ import annotations
 
 import dataclasses
+import difflib
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -101,6 +102,57 @@ def _np_logsumexp(a: np.ndarray, axis=None, keepdims: bool = False):
     return out
 
 
+def _norm_cell_axes(axis, n_dims: int) -> Tuple[int, ...]:
+    """Normalize a reduce ``axis`` spec against the DIM axes of a
+    cell-carrying array (the trailing cell axis is never reduced —
+    negative indices count from the last dim axis).  Out-of-range
+    axes raise, exactly like numpy on a scalar-cell array — a
+    caller's axis-bookkeeping bug must crash, not silently reduce
+    the wrong dimension."""
+    if axis is None:
+        return tuple(range(n_dims))
+    if isinstance(axis, int):
+        axis = (axis,)
+    for a in axis:
+        if not -n_dims <= a < n_dims:
+            raise np.exceptions.AxisError(a, n_dims)
+    return tuple(sorted(a % n_dims for a in axis))
+
+
+def _kbest_sorted(a: np.ndarray, k: int) -> np.ndarray:
+    """The k smallest of the candidate axis, sorted ascending (the
+    top-K ⊕ primitive — stable, +inf-padded when candidates run out)."""
+    out = np.sort(a, axis=-1, kind="stable")[..., :k]
+    if out.shape[-1] < k:
+        pad = np.full(
+            out.shape[:-1] + (k - out.shape[-1],), np.inf
+        )
+        out = np.concatenate([out, pad], axis=-1)
+    return out
+
+
+def _exp_pair_reduce(a: np.ndarray, axes: Tuple[int, ...]):
+    """⊕-reduce of expectation pairs ``(log w, r)`` over dim ``axes``:
+    ``log w`` reduces by stable logsumexp, ``r`` by the matching
+    convex (softmax-weighted) combine — the first-order expectation
+    semiring in its normalized ``(log W, Σwr/W)`` representation."""
+    lw = np.asarray(a[..., 0], dtype=np.float64)
+    r = np.asarray(a[..., 1], dtype=np.float64)
+    m = np.max(lw, axis=axes, keepdims=True)
+    safe_m = np.where(np.isfinite(m), m, 0.0)
+    w = np.exp(lw - safe_m)
+    s = np.sum(w, axis=axes, keepdims=True)
+    with np.errstate(divide="ignore"):
+        lw_out = np.where(np.isfinite(m), safe_m + np.log(s), m)
+    r_out = np.where(
+        s > 0, np.sum(w * r, axis=axes, keepdims=True)
+        / np.where(s > 0, s, 1.0), 0.0,
+    )
+    lw_out = np.squeeze(lw_out, axis=axes)
+    r_out = np.squeeze(r_out, axis=axes)
+    return np.stack([lw_out, r_out], axis=-1)
+
+
 @dataclasses.dataclass(frozen=True)
 class Semiring:
     """One ``(⊕, ⊗)`` pair in LOG-DOMAIN representation (``⊗ = +``).
@@ -111,6 +163,22 @@ class Semiring:
     marginal-inference variant whose messages are shift-normalized
     (the shifts are tracked, so absolute aggregates like ``log Z``
     are still recovered exactly).
+
+    ``kind``/``cell_width`` extend the algebra from scalar cells to
+    STRUCTURED cells — a trailing static value axis of width
+    ``cell_width`` on every table/message cell, so XLA shapes stay
+    static and the level-pack lattice is untouched
+    (``docs/semirings.md``, "Structured cells"):
+
+    - ``"scalar"`` — the classic one-float cell (``cell_width=1``);
+    - ``"kbest"`` — the k best partial COSTS per cell, sorted
+      ascending and +inf-padded; ⊕ merges two sorted k-vectors, ⊗
+      cross-sums and truncates (exact: a sum's rank-k prefix only
+      needs each argument's rank-k prefix);
+    - ``"expectation"`` — the first-order expectation pair
+      ``(log w, r)`` in normalized form (``r = Σ w·cost / w``): ⊗
+      adds both planes, ⊕ logsumexps the weights and convex-combines
+      ``r`` — the root pair is ``(log Z, E[cost])``.
     """
 
     name: str
@@ -118,12 +186,20 @@ class Semiring:
     maximize: bool = False  # direction of an idempotent ⊕
     normalize: bool = False
     doc: str = ""
+    kind: str = "scalar"  # "scalar" | "kbest" | "expectation"
+    cell_width: int = 1  # trailing static value axis per cell
 
     # -- algebra (log domain) ------------------------------------------
 
     @property
     def plus_identity(self) -> float:
-        """Identity of ``⊕`` — also the annihilator of ``⊗``."""
+        """Identity of ``⊕`` — also the annihilator of ``⊗``.  For
+        structured cells this is the scalar every component of the
+        identity cell holds (kbest: all +inf) or the scalar that
+        annihilates the weight plane (expectation: -inf log-weight),
+        which is exactly what the ghost-guard mask adds."""
+        if self.kind == "kbest":
+            return float(np.inf)
         if self.idempotent and not self.maximize:
             return float(np.inf)
         return float(-np.inf)
@@ -133,27 +209,114 @@ class Semiring:
         """Identity of ``⊗`` (log-domain ``+``)."""
         return 0.0
 
+    @property
+    def error_bounded(self) -> bool:
+        """Whether this ⊕ runs under error-BOUND accounting (the
+        ``tol`` device gate) rather than an exactness certificate.
+        kbest is non-idempotent but still CERTIFIED: each component is
+        a selection with an arg, so the per-component margin
+        certificate + host-f64 re-evaluation keeps it exact."""
+        return not self.idempotent and self.kind != "kbest"
+
+    def identity_cell(self) -> np.ndarray:
+        """The ⊕-identity as one cell (length ``cell_width``)."""
+        if self.kind == "expectation":
+            return np.array([-np.inf, 0.0])
+        return np.full(self.cell_width, self.plus_identity)
+
+    def times_identity_cell(self) -> np.ndarray:
+        """The ⊗-identity as one cell (length ``cell_width``)."""
+        cell = np.zeros(self.cell_width)
+        if self.kind == "kbest" and self.cell_width > 1:
+            cell[1:] = np.inf
+        return cell
+
     def add(self, a, b):
-        """Elementwise ``⊕`` (host f64) — the axiom-test primitive."""
+        """Elementwise ``⊕`` (host f64) — the axiom-test primitive.
+        Structured kinds take cell-carrying arrays (trailing axis
+        ``cell_width``)."""
+        if self.kind == "kbest":
+            return _kbest_sorted(
+                np.concatenate(
+                    [
+                        np.asarray(a, dtype=np.float64),
+                        np.asarray(b, dtype=np.float64),
+                    ],
+                    axis=-1,
+                ),
+                self.cell_width,
+            )
+        if self.kind == "expectation":
+            return _exp_pair_reduce(
+                np.stack(
+                    [
+                        np.asarray(a, dtype=np.float64),
+                        np.asarray(b, dtype=np.float64),
+                    ]
+                ),
+                (0,),
+            )
         if self.idempotent:
             return (np.maximum if self.maximize else np.minimum)(a, b)
         return _np_logsumexp(np.stack([a, b]), axis=0)
 
     def combine(self, a, b):
-        """Elementwise ``⊗`` (host f64): ``+`` in the log domain."""
+        """Elementwise ``⊗`` (host f64): ``+`` in the log domain;
+        cross-sum-truncate for kbest cells, per-plane ``+`` for
+        expectation pairs."""
+        if self.kind == "kbest":
+            a = np.asarray(a, dtype=np.float64)
+            b = np.asarray(b, dtype=np.float64)
+            sums = a[..., :, None] + b[..., None, :]
+            return _kbest_sorted(
+                sums.reshape(sums.shape[:-2] + (-1,)), self.cell_width
+            )
+        if self.kind == "expectation":
+            a = np.asarray(a, dtype=np.float64)
+            b = np.asarray(b, dtype=np.float64)
+            return a + b  # both planes add: log w multiplies, r sums
         return np.asarray(a, dtype=np.float64) + np.asarray(
             b, dtype=np.float64
         )
 
     def reduce(self, a, axis=None, keepdims: bool = False):
-        """``⊕``-projection over ``axis`` (host f64)."""
+        """``⊕``-projection over ``axis`` (host f64).  For structured
+        kinds ``axis`` names DIM axes of a cell-carrying array (the
+        trailing cell axis is carried, never reduced); ``keepdims``
+        applies to the dim axes."""
+        if self.kind == "kbest":
+            a = np.asarray(a, dtype=np.float64)
+            axes = _norm_cell_axes(axis, a.ndim - 1)
+            if not axes:
+                return a
+            dst = tuple(
+                range(a.ndim - 1 - len(axes), a.ndim - 1)
+            )
+            moved = np.moveaxis(a, axes, dst)
+            flat = moved.reshape(moved.shape[: dst[0]] + (-1,))
+            out = _kbest_sorted(flat, self.cell_width)
+            if keepdims:
+                for ax in axes:
+                    out = np.expand_dims(out, axis=ax)
+            return out
+        if self.kind == "expectation":
+            a = np.asarray(a, dtype=np.float64)
+            axes = _norm_cell_axes(axis, a.ndim - 1)
+            if not axes:
+                return a
+            out = _exp_pair_reduce(a, axes)
+            if keepdims:
+                for ax in axes:
+                    out = np.expand_dims(out, axis=ax)
+            return out
         if self.idempotent:
             fn = np.max if self.maximize else np.min
             return fn(a, axis=axis, keepdims=keepdims)
         return _np_logsumexp(a, axis=axis, keepdims=keepdims)
 
     def arg_reduce(self, a, axis: int = -1):
-        """Argmin/argmax over ``axis`` — idempotent ⊕ only."""
+        """Argmin/argmax over ``axis`` — idempotent ⊕ only (kbest
+        keeps per-component backpointers through its own kernels)."""
         if not self.idempotent:
             raise ValueError(
                 f"semiring {self.name!r}: ⊕ is not idempotent — there "
@@ -164,12 +327,34 @@ class Semiring:
     def shift_of(self, a: np.ndarray) -> float:
         """Message-normalization offset: the value subtracted from an
         outgoing message (min for ``min/+`` — DPOP's normalization —
-        max otherwise, which is also the logsumexp stability shift)."""
+        max otherwise, which is also the logsumexp stability shift).
+        Structured cells shift on their leading component (kbest: the
+        per-cell best; expectation: the log-weight plane), ignoring
+        non-finite entries (+inf slot padding / -inf zero weights)."""
         if a.size == 0:
             return 0.0
+        if self.kind in ("kbest", "expectation"):
+            lead = np.asarray(a[..., 0], dtype=np.float64)
+            lead = lead[np.isfinite(lead)]
+            if lead.size == 0:
+                return 0.0
+            return float(
+                lead.min() if self.kind == "kbest" else lead.max()
+            )
         if self.idempotent and not self.maximize:
             return float(a.min())
         return float(a.max())
+
+    def apply_shift(self, a: np.ndarray, shift: float) -> np.ndarray:
+        """⊗-divide a message by the scalar ``shift``: scalar and
+        kbest cells subtract it from every component; the expectation
+        pair subtracts it from the log-weight plane only (``r`` is
+        already weight-normalized)."""
+        if self.kind == "expectation":
+            out = np.array(a, dtype=np.float64)
+            out[..., 0] -= shift
+            return out
+        return a - shift
 
     # -- traced (jnp) variants for use inside compiled steps -----------
 
@@ -199,16 +384,36 @@ def register_semiring(sr: Semiring) -> Semiring:
     return sr
 
 
+def _did_you_mean(name: str, candidates: Sequence[str]) -> str:
+    """One nearest-name hint (difflib) for unknown-name errors — "I
+    typed log_sumexp" should not require reading the whole registry
+    dump to spot the typo."""
+    close = difflib.get_close_matches(
+        str(name), list(candidates), n=1, cutoff=0.55
+    )
+    return f" — did you mean {close[0]!r}?" if close else ""
+
+
 def get_semiring(name: str) -> Semiring:
     if isinstance(name, Semiring):
         return name
-    try:
-        return SEMIRINGS[name]
-    except KeyError:
-        raise ValueError(
-            f"unknown semiring {name!r} (registered: "
-            f"{sorted(SEMIRINGS)})"
-        )
+    got = SEMIRINGS.get(name)
+    if got is not None:
+        return got
+    if isinstance(name, str) and name.startswith("kbest:"):
+        try:
+            k = int(name.split(":", 1)[1])
+        except ValueError:
+            raise ValueError(
+                f"malformed kbest semiring {name!r} — the width is "
+                "an integer, e.g. 'kbest:5'"
+            )
+        return kbest_semiring(k)
+    raise ValueError(
+        f"unknown semiring {name!r} (registered: "
+        f"{sorted(SEMIRINGS)}, plus parametric 'kbest:<k>')"
+        + _did_you_mean(name, sorted(SEMIRINGS) + ["kbest:5"])
+    )
 
 
 MIN_SUM = register_semiring(
@@ -236,13 +441,91 @@ MARGINALS = register_semiring(
         doc="+/x with message normalization — marginal inference",
     )
 )
+EXPECTATION = register_semiring(
+    Semiring(
+        "expectation", idempotent=False, kind="expectation",
+        cell_width=2,
+        doc="first-order expectation pairs (log w, E[cost]) — E[cost] "
+        "under the Gibbs distribution and optional stochastic "
+        "externals",
+    )
+)
+
+#: widest registered top-K cell (the candidate sort is O(k^2 log k)
+#: per cross-sum — past this, K-best enumeration wants a search
+#: algorithm, not a semiring)
+KBEST_MAX = 64
+
+
+def kbest_semiring(k: int) -> Semiring:
+    """The top-K semiring for width ``k`` (registered on first use —
+    ``get_semiring("kbest:5")`` and ``query="kbest:5"`` resolve
+    here).  Fixed ``k`` keeps every cell shape static for XLA."""
+    k = int(k)
+    if not 2 <= k <= KBEST_MAX:
+        raise ValueError(
+            f"kbest wants 2 <= k <= {KBEST_MAX}, got {k} (k=1 is "
+            "query='map')"
+        )
+    name = f"kbest:{k}"
+    got = SEMIRINGS.get(name)
+    if got is None:
+        got = register_semiring(
+            Semiring(
+                name, idempotent=False, kind="kbest", cell_width=k,
+                doc="top-K cost tuples: ⊕ merge-sorts k-vectors, ⊗ "
+                "cross-sums and truncates — the K best assignments",
+            )
+        )
+    return got
+
 
 # query name (api.infer) -> the semiring its sweep runs on
 QUERY_SEMIRINGS = {
     "map": "max_sum",
     "log_z": "log_sum_exp",
     "marginals": "marginals",
+    "expectation": "expectation",
 }
+
+#: every query ``api.infer`` understands (``kbest:<k>`` is
+#: parametric; ``marginal_map`` rides max/+ with a two-block order)
+KNOWN_QUERIES = (
+    "map", "log_z", "marginals", "marginal_map", "expectation",
+    "kbest:<k>",
+)
+
+
+def parse_query(query: str) -> Tuple[str, Semiring]:
+    """Resolve an ``api.infer`` query string to ``(kind, semiring)``,
+    where ``kind`` is the query family (``"kbest"`` for any
+    ``kbest:<k>``).  ``marginal_map`` returns the max/+ semiring —
+    its sum block rides ``log_sum_exp`` per node via the plan's
+    two-block elimination order (:func:`build_plan` ``max_vars``).
+    Unknown queries fail with the nearest known name suggested."""
+    if query in QUERY_SEMIRINGS:
+        return query, get_semiring(QUERY_SEMIRINGS[query])
+    if query == "marginal_map":
+        return "marginal_map", get_semiring("max_sum")
+    if isinstance(query, str) and (
+        query == "kbest" or query.startswith("kbest:")
+    ):
+        if query == "kbest":
+            k = 5  # the documented default width
+        else:
+            try:
+                k = int(query.split(":", 1)[1])
+            except ValueError:
+                raise ValueError(
+                    f"malformed query {query!r} — the kbest width is "
+                    "an integer, e.g. 'kbest:5'"
+                )
+        return "kbest", kbest_semiring(k)
+    known = [q for q in KNOWN_QUERIES if "<" not in q] + ["kbest:5"]
+    raise ValueError(
+        f"unknown query {query!r} (expected one of "
+        f"{sorted(KNOWN_QUERIES)})" + _did_you_mean(query, known)
+    )
 
 
 # -- device kernels -----------------------------------------------------
@@ -290,7 +573,108 @@ def contraction_kernel(
     import jax
     import jax.numpy as jnp
 
-    if sr.idempotent:
+    if sr.kind == "kbest":
+        # structured cells: parts of ndim len(shape) are scalar
+        # (pre-summed energies + the ghost mask), parts of ndim+1
+        # carry the trailing K axis (child messages).  The kernel
+        # returns, per separator cell: the K best candidate values,
+        # the margin to the NEXT candidate per slot (the
+        # per-component certificate input), the selected own-value
+        # index, and one selected child-slot index per vector part —
+        # the backpointers the host value phase walks.
+        kk = sr.cell_width
+        nd = len(shape)
+        d = shape[-1]
+
+        def contract(*tabs):
+            scal = [t for t in tabs if t.ndim == nd]
+            vecs = [t for t in tabs if t.ndim == nd + 1]
+            j = jnp.zeros(shape, dtype=jnp.float32)
+            for t in scal:
+                j = j + t
+            if vecs:
+                cell = j[..., None] + vecs[0]
+                provs = [
+                    jnp.broadcast_to(
+                        jnp.arange(kk, dtype=jnp.int32), cell.shape
+                    )
+                ]
+                for t in vecs[1:]:
+                    # cross-sum-truncate: exact for top-K because
+                    # sums are monotone in each argument — a dropped
+                    # rank->K candidate already has K smaller sums
+                    sums = cell[..., :, None] + t[..., None, :]
+                    flat = sums.reshape(
+                        sums.shape[:-2] + (kk * kk,)
+                    )
+                    idx = jnp.argsort(flat, axis=-1)[..., :kk]
+                    cell = jnp.take_along_axis(flat, idx, axis=-1)
+                    a_i = (idx // kk).astype(jnp.int32)
+                    provs = [
+                        jnp.take_along_axis(p, a_i, axis=-1)
+                        for p in provs
+                    ] + [(idx % kk).astype(jnp.int32)]
+            else:
+                lift = jnp.full((kk,), jnp.inf, dtype=jnp.float32)
+                lift = lift.at[0].set(0.0)
+                cell = j[..., None] + lift
+                provs = []
+            flat = cell.reshape(cell.shape[:-2] + (d * kk,))
+            # one +inf pad column so the (K+1)-th candidate — the
+            # margin reference — always exists, even at d*kk == kk
+            flat = jnp.concatenate(
+                [
+                    flat,
+                    jnp.full(
+                        flat.shape[:-1] + (1,), jnp.inf, flat.dtype
+                    ),
+                ],
+                axis=-1,
+            )
+            idx = jnp.argsort(flat, axis=-1)[..., : kk + 1]
+            vals_all = jnp.take_along_axis(flat, idx, axis=-1)
+            vals = vals_all[..., :kk]
+            margins = jnp.where(
+                jnp.isfinite(vals), vals_all[..., 1:] - vals, jnp.inf
+            )
+            sel = jnp.minimum(idx[..., :kk], d * kk - 1)
+            own = (sel // kk).astype(jnp.int32)
+            slot = sel % kk
+            outs = [vals, margins, own]
+            for p in provs:
+                pf = p.reshape(p.shape[:-2] + (d * kk,))
+                outs.append(
+                    jnp.take_along_axis(pf, slot + own * kk, axis=-1)
+                )
+            return tuple(outs)
+
+    elif sr.kind == "expectation":
+        nd = len(shape)
+
+        def contract(*tabs):
+            lw = jnp.zeros(shape, dtype=jnp.float32)
+            r = jnp.zeros(shape, dtype=jnp.float32)
+            for t in tabs:
+                if t.ndim == nd + 1:
+                    lw = lw + t[..., 0]
+                    r = r + t[..., 1]
+                else:
+                    lw = lw + t  # scalar parts weight only (the mask)
+            m = jnp.max(lw, axis=-1)
+            safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+            w = jnp.exp(lw - safe_m[..., None])
+            s = jnp.sum(w, axis=-1)
+            lw_out = jnp.where(
+                jnp.isfinite(m), safe_m + jnp.log(s), m
+            )
+            r_out = jnp.where(
+                s > 0,
+                jnp.sum(w * r, axis=-1) / jnp.where(s > 0, s, 1.0),
+                0.0,
+            )
+            return (jnp.stack([lw_out, r_out], axis=-1),)
+
+    elif sr.idempotent:
         if sr.maximize:
 
             def contract(*tabs):
@@ -420,6 +804,7 @@ def min_fill_order(
     domains: Dict[str, Sequence],
     scopes: Sequence[Sequence[str]],
     deadline: Optional[float] = None,
+    last_block: Optional[set] = None,
 ) -> List[str]:
     """Greedy min-fill elimination order over the primal graph: at
     each step eliminate the variable whose removal adds the fewest
@@ -436,7 +821,13 @@ def min_fill_order(
     sub-second at that size).  Dense graphs can still be slow —
     ``deadline`` (a ``perf_counter`` timestamp) raises
     ``TimeoutError`` between steps so an ``infer(timeout=...)``
-    cannot hang inside plan construction."""
+    cannot hang inside plan construction.
+
+    ``last_block`` constrains the order into TWO BLOCKS: variables in
+    it are only eligible once every other variable is eliminated —
+    the marginal-MAP constraint (sum variables first, max variables
+    last), applied inside the greedy selection so the heuristic still
+    minimizes fill within each block."""
     adj: Dict[str, set] = {v: set() for v in domains}
     for scope in scopes:
         sc = [v for v in scope if v in adj]
@@ -464,9 +855,15 @@ def min_fill_order(
                 f"min_fill elimination order timed out with "
                 f"{len(remaining)} of {len(adj)} variables left"
             )
+        if last_block:
+            pool = [x for x in remaining if x not in last_block]
+            if not pool:  # only the last block is left
+                pool = list(remaining)
+        else:
+            pool = remaining
         best_key = None
         best = None
-        for x in remaining:
+        for x in pool:
             c = cache.get(x)
             if c is None:
                 c = cache[x] = fill_count(x)
@@ -503,18 +900,34 @@ class ContractionPlan:
     query), and the parent/children structure a dims-only simulation
     of the elimination derives.  ``const_energy`` accumulates
     fully-external (scope-free after slicing) parts — invisible to
-    arg queries, a constant factor of ``Z``."""
+    arg queries, a constant factor of ``Z``.
+
+    ``wbuckets`` holds LOG-WEIGHT parts (already in kernel domain —
+    no ``beta`` scaling, no cost contribution): the stochastic
+    external distributions of an expectation query.  ``node_semiring``
+    (marginal MAP) overrides the ⊕ per node — ``"log_sum_exp"`` for
+    the summed block, ``"max_sum"`` for the ``max_vars`` block the
+    two-block elimination order puts last."""
 
     __slots__ = (
         "domains", "order", "pos", "buckets", "parent", "children",
-        "roots", "height", "const_energy", "order_name",
+        "roots", "height", "const_energy", "order_name", "wbuckets",
+        "node_semiring", "max_vars",
     )
 
-    def __init__(self, domains, order, buckets, const_energy, order_name):
+    def __init__(
+        self, domains, order, buckets, const_energy, order_name,
+        wbuckets=None, node_semiring=None, max_vars=None,
+    ):
         self.domains = domains
         self.order = order
         self.pos = {v: i for i, v in enumerate(order)}
         self.buckets = buckets
+        self.wbuckets = (
+            {v: [] for v in order} if wbuckets is None else wbuckets
+        )
+        self.node_semiring = node_semiring
+        self.max_vars = max_vars
         self.const_energy = const_energy
         self.order_name = order_name
         # dims-only elimination simulation: the message scope of v is
@@ -528,6 +941,8 @@ class ContractionPlan:
         for v in order:
             dims: set = set()
             for scope, _ in buckets[v]:
+                dims.update(scope)
+            for scope, _ in self.wbuckets[v]:
                 dims.update(scope)
             for c in self.children[v]:
                 dims.update(msg_dims[c])
@@ -557,6 +972,8 @@ class ContractionPlan:
         dims: set = set()
         for scope, _ in self.buckets[name]:
             dims.update(scope)
+        for scope, _ in self.wbuckets[name]:
+            dims.update(scope)
         for c in self.children[name]:
             dims.update(child_seps[c])
         dims.discard(name)
@@ -577,6 +994,8 @@ def build_plan(
     dcop,
     order: str = "pseudo_tree",
     deadline: Optional[float] = None,
+    max_vars: Optional[Sequence[str]] = None,
+    external_dists: Optional[Mapping[str, Mapping[Any, float]]] = None,
 ) -> ContractionPlan:
     """Build the contraction plan for one DCOP under an elimination
     order heuristic.  ``deadline`` (a ``perf_counter`` timestamp)
@@ -590,19 +1009,83 @@ def build_plan(
     owned by its earliest-eliminated scope variable, which under the
     ``pseudo_tree`` order reproduces DPOP's deepest-variable
     ownership exactly.
-    """
+
+    ``max_vars`` (marginal MAP) constrains BOTH heuristics to a
+    two-block order — every summed variable eliminated before every
+    maximized one, so the max stays outside the sum — and annotates
+    the plan with a per-node ⊕ (``node_semiring``).  ``external_dists``
+    (expectation) maps external-variable names to ``{value: prob}``
+    distributions: those externals are NOT sliced to their pinned
+    value but join the plan as summed variables carrying a unary
+    log-probability part (``wbuckets``)."""
     if order not in ELIMINATION_ORDERS:
         raise ValueError(
             f"unknown elimination order {order!r} (expected one of "
             f"{ELIMINATION_ORDERS})"
         )
     sign = -1.0 if dcop.objective == "max" else 1.0
+    dists = dict(external_dists) if external_dists else {}
+    unknown_ext = set(dists) - set(dcop.external_variables)
+    if unknown_ext:
+        raise ValueError(
+            f"external_dists names {sorted(unknown_ext)} — not "
+            "external variables of this dcop (externals: "
+            f"{sorted(dcop.external_variables)})"
+        )
     ext_values = {
-        n: ev.value for n, ev in dcop.external_variables.items()
+        n: ev.value
+        for n, ev in dcop.external_variables.items()
+        if n not in dists
     }
     domains: Dict[str, list] = {
         v.name: list(v.domain.values) for v in dcop.variables.values()
     }
+    wparts: List[Tuple[List[str], np.ndarray]] = []
+    for n, dist in dists.items():
+        ev = dcop.external_variables[n]
+        dom = list(ev.domain.values)
+        # a JSON-shipped dist (the CLI / wire path) carries string
+        # keys — match domain values with a str() fallback
+        dom_keys = set(dom) | {str(x) for x in dom}
+        bad = sorted(str(x) for x in set(dist) - dom_keys)
+        if bad:
+            raise ValueError(
+                f"external_dists[{n!r}] names values {bad} outside "
+                f"the external's domain {dom}"
+            )
+        probs = np.array(
+            [
+                float(dist.get(x, dist.get(str(x), 0.0)))
+                for x in dom
+            ],
+            dtype=np.float64,
+        )
+        if (probs < 0).any() or probs.sum() <= 0:
+            raise ValueError(
+                f"external_dists[{n!r}] must be non-negative with "
+                "positive total mass"
+            )
+        probs = probs / probs.sum()
+        with np.errstate(divide="ignore"):  # p=0 -> log -inf: the
+            # value simply carries zero weight
+            wparts.append(([n], np.log(probs)))
+        domains[n] = dom
+
+    if max_vars is not None:
+        mv = set(max_vars)
+        unknown_mv = mv - set(domains)
+        if unknown_mv:
+            raise ValueError(
+                f"map_vars names {sorted(unknown_mv)} — not "
+                "variables of this dcop"
+            )
+        if not mv:
+            raise ValueError(
+                "map_vars is empty — with nothing maximized the "
+                "query is 'log_z'"
+            )
+    else:
+        mv = None
 
     parts: List[Tuple[List[str], np.ndarray]] = []
     const_energy = 0.0
@@ -627,7 +1110,10 @@ def build_plan(
 
     if order == "min_fill":
         elim = min_fill_order(
-            domains, [s for s, _ in parts], deadline=deadline
+            domains,
+            [s for s, _ in parts] + [s for s, _ in wparts],
+            deadline=deadline,
+            last_block=mv,
         )
     else:
         from pydcop_tpu.graphs import pseudotree as _pt
@@ -639,17 +1125,41 @@ def build_plan(
             for n in graph.depth_first_order(root)
         ]
         # reverse DFS pre-order: children strictly before parents —
-        # the elimination order whose bucket tree IS the pseudo-tree
-        elim = list(reversed(names))
+        # the elimination order whose bucket tree IS the pseudo-tree.
+        # Distribution-carrying externals are summed leaves: eliminate
+        # them first (they hang off whatever constraints scope them)
+        elim = sorted(dists) + list(reversed(names))
+        if mv is not None:
+            # two-block constraint, DFS order preserved within each
+            # block: sum variables first, max variables last
+            elim = [v for v in elim if v not in mv] + [
+                v for v in elim if v in mv
+            ]
+
+    node_semiring = None
+    if mv is not None:
+        node_semiring = {
+            v: ("max_sum" if v in mv else "log_sum_exp") for v in elim
+        }
 
     pos = {v: i for i, v in enumerate(elim)}
     buckets: Dict[str, List[Tuple[List[str], np.ndarray]]] = {
         v: [] for v in elim
     }
+    wbuckets: Dict[str, List[Tuple[List[str], np.ndarray]]] = {
+        v: [] for v in elim
+    }
     for scope, table in parts:
         owner = min(scope, key=pos.__getitem__)
         buckets[owner].append((scope, table))
-    return ContractionPlan(domains, elim, buckets, const_energy, order)
+    for scope, table in wparts:
+        owner = min(scope, key=pos.__getitem__)
+        wbuckets[owner].append((scope, table))
+    return ContractionPlan(
+        domains, elim, buckets, const_energy, order,
+        wbuckets=wbuckets, node_semiring=node_semiring,
+        max_vars=(sorted(mv) if mv is not None else None),
+    )
 
 
 # -- the merged contraction sweep ---------------------------------------
@@ -658,10 +1168,36 @@ def build_plan(
 def _align(table, dims, target):
     """Jax-free broadcast alignment (the DPOP join primitive —
     ``algorithms/_tables.align_table``, imported lazily to keep ops/
-    free of an algorithms/ import at module load)."""
+    free of an algorithms/ import at module load).  A part with one
+    more axis than named ``dims`` is a STRUCTURED-cell part: the
+    named axes align as usual and the trailing cell axis rides
+    along."""
     from pydcop_tpu.algorithms._tables import align_table
 
+    table = np.asarray(table)
+    if table.ndim == len(dims) + 1:
+        order = [d for d in target if d in dims]
+        t = np.transpose(
+            table,
+            [list(dims).index(d) for d in order] + [len(dims)],
+        )
+        shape = [
+            t.shape[order.index(d)] if d in dims else 1
+            for d in target
+        ]
+        return t.reshape(shape + [table.shape[-1]])
     return align_table(table, dims, target)
+
+
+def _finite_amax(a) -> float:
+    """max |finite entries| — the message-magnitude scale structured
+    cells use (+inf slot padding / -inf zero weights are structural,
+    not magnitudes the rounding analysis should see)."""
+    a = np.asarray(a)
+    if a.size == 0:
+        return 0.0
+    m = np.abs(a[np.isfinite(a)])
+    return float(m.max()) if m.size else 0.0
 
 
 class _Sweep:
@@ -670,6 +1206,7 @@ class _Sweep:
     __slots__ = (
         "msgs", "args", "root_total", "total_shift", "cells",
         "device_nodes", "host_nodes", "dispatches", "err", "seps",
+        "root_cells",
     )
 
     def __init__(self, K: int):
@@ -678,6 +1215,13 @@ class _Sweep:
         self.args: List[Dict[str, tuple]] = [{} for _ in range(K)]
         self.seps: List[Dict[str, List[str]]] = [{} for _ in range(K)]
         self.root_total = [0.0] * K
+        # structured-cell kinds keep per-root CELLS (the kbest value
+        # phase re-merges them with provenance; expectation pairs
+        # ⊗-combine at result assembly); scalar sweeps fold into
+        # root_total as before
+        self.root_cells: List[Dict[str, np.ndarray]] = [
+            {} for _ in range(K)
+        ]
         self.total_shift = [0.0] * K
         self.cells = [0] * K
         self.device_nodes = [0] * K
@@ -745,39 +1289,80 @@ def contract_sweep(
     _key_memo: Dict[tuple, tuple] = {}
 
     def table_in(tbl: np.ndarray) -> np.ndarray:
-        if sr.idempotent and not sr.maximize:
-            return tbl  # min/+: raw energies (beta rescales argmins
-            # by nothing and the magnitudes stay familiar)
+        if sr.kind == "kbest" or (
+            sr.idempotent and not sr.maximize
+        ):
+            return tbl  # cost-ordered kinds (min/+, top-K): raw
+            # energies (beta rescales argmins by nothing and the
+            # magnitudes stay familiar)
         return (-beta) * tbl
 
-    def finish(k, name, plan, sep, u, arg):
+    def finish(sr_n, k, name, plan, sep, u, arg):
         if met.enabled:
             met.inc("semiring.contractions")
-        if want_args:
+            if sr_n.kind == "kbest":
+                met.inc("semiring.kbest_merges")
+        if want_args and arg is not None:
             sw.args[k][name] = (sep, arg)
         if plan.parent[name] is None:
-            # root: the reduce is a scalar — fold it into the
-            # instance aggregate (plus every shift already applied)
-            sw.root_total[k] += float(u)
+            if sr_n.cell_width > 1:
+                # structured kinds keep the root CELL (kbest re-merges
+                # roots with provenance; expectation pairs ⊗-combine
+                # at result assembly)
+                sw.root_cells[k][name] = np.asarray(
+                    u, dtype=np.float64
+                )
+            else:
+                # root: the reduce is a scalar — fold it into the
+                # instance aggregate (plus every shift already applied)
+                sw.root_total[k] += float(u)
         else:
-            shift = sr.shift_of(u)
+            shift = sr_n.shift_of(u)
             if not np.isfinite(shift):
                 shift = 0.0  # an all--inf message normalizes to itself
-            u = u - shift
+            u = sr_n.apply_shift(u, shift)
             sw.total_shift[k] += shift
-            sw.msgs[k][name] = (
-                sep, u, float(np.max(np.abs(u), initial=0.0))
+            mag = (
+                _finite_amax(u)
+                if sr_n.cell_width > 1
+                else float(np.max(np.abs(u), initial=0.0))
             )
+            sw.msgs[k][name] = (sep, u, mag)
             sw.cells[k] += u.size
 
-    def host_contract(k, name, plan, sep, target, shape, parts, err_in):
+    def host_contract(
+        sr_n, k, name, plan, sep, target, shape, parts, err_in
+    ):
+        if sr_n.kind == "kbest":
+            u, own, provs = _kbest_host(
+                parts, target, shape, sr_n.cell_width
+            )
+            sw.host_nodes[k] += 1
+            arg = (own, dict(zip(plan.children[name], provs)))
+            finish(sr_n, k, name, plan, sep, u, arg)
+            return
+        if sr_n.kind == "expectation":
+            u = _expect_host(parts, target, shape)
+            sw.host_nodes[k] += 1
+            scale = max(
+                sum(_finite_amax(t) for _, t in parts), 1.0
+            )
+            sw.err[k][name] = err_in + _EPS64 * (
+                (len(parts) + 1) * scale + shape[-1] + 2
+            )
+            finish(sr_n, k, name, plan, sep, u, None)
+            return
         j = np.zeros(shape, dtype=np.float64)
         for dims, table in parts:
             j = j + _align(table, dims, target)
-        arg = sr.arg_reduce(j, axis=-1) if want_args else None
-        u = sr.reduce(j, axis=-1)
+        arg = (
+            sr_n.arg_reduce(j, axis=-1)
+            if want_args and sr_n.idempotent
+            else None
+        )
+        u = sr_n.reduce(j, axis=-1)
         sw.host_nodes[k] += 1
-        if not sr.idempotent:
+        if not sr_n.idempotent:
             # f64 rounding of the same computation: negligible, but
             # accounted so the reported bound is never an understatement
             scale = max(
@@ -790,7 +1375,11 @@ def contract_sweep(
             sw.err[k][name] = err_in + _EPS64 * (
                 (len(parts) + 1) * scale + shape[-1] + 2
             )
-        finish(k, name, plan, sep, u, arg)
+        elif err_in:
+            # a mixed sweep's max node: the sum block's accumulated
+            # bounds flow through to the root report unchanged
+            sw.err[k][name] = err_in
+        finish(sr_n, k, name, plan, sep, u, arg)
 
     waves: List[List[Tuple[int, str]]] = []
     for k, plan in enumerate(plans):
@@ -800,15 +1389,28 @@ def contract_sweep(
                 waves.append([])
             waves[w].append((k, n))
 
+    mixed = any(p.node_semiring for p in plans)
     t_sweep = time.perf_counter()
     for wave in waves:
         buckets: Dict[tuple, list] = {}
         order: List[tuple] = []
+        wave_srs: set = set()
         for k, name in wave:
             if timeout is not None and time.perf_counter() - t0 > timeout:
                 return None
             plan = plans[k]
             domains = plan.domains
+            # per-node ⊕: a mixed (marginal-MAP) plan sums its first
+            # block and maximizes its last; everything else runs the
+            # sweep's one semiring
+            sr_n = (
+                get_semiring(plan.node_semiring[name])
+                if plan.node_semiring is not None
+                else sr
+            )
+            if mixed:
+                wave_srs.add(sr_n.name)
+            cw = sr_n.cell_width
             sep = plan.sep_of(name, sw.seps[k])
             sw.seps[k][name] = sep
             target = sep + [name]
@@ -816,10 +1418,11 @@ def contract_sweep(
             size = 1
             for s in shape:
                 size *= s
-            if size > max_table_size:
+            if size * cw > max_table_size:
                 raise ValueError(
-                    f"contraction table for {name!r} needs {size} "
-                    f"cells (separator {sep}); exceeds "
+                    f"contraction table for {name!r} needs "
+                    f"{size * cw} cells (separator {sep}, cell width "
+                    f"{cw}); exceeds "
                     f"max_table_size={max_table_size}.  The induced "
                     f"width under order={plan.order_name!r} is too "
                     "large — try order='min_fill', or an approximate "
@@ -829,11 +1432,42 @@ def contract_sweep(
             # trick: bitwise the same join, collapses leaf kernel
             # signatures, tightens the f32 bound), then children
             own_parts = plan.buckets[name]
+            own_w = plan.wbuckets[name]
             parts: List[Tuple[List[str], np.ndarray]] = []
             parts_max = 0.0
             err_in = 0.0
-            if own_parts:
-                odims: List[str] = []
+            if own_w and sr_n.kind != "expectation":
+                # only the expectation pair carries a weight plane;
+                # a selecting ⊕ cannot weight assignments and the
+                # scalar sums would need the pair's r-plane anyway
+                raise ValueError(
+                    "external distributions weight assignments — "
+                    f"the {sr_n.name!r} ⊕ cannot carry them (use "
+                    "query='expectation')"
+                )
+            if sr_n.kind == "expectation":
+                if own_parts or own_w:
+                    odims: List[str] = []
+                    for dims, _ in own_parts:
+                        odims.extend(
+                            d for d in dims if d not in odims
+                        )
+                    for dims, _ in own_w:
+                        odims.extend(
+                            d for d in dims if d not in odims
+                        )
+                    oshape = [len(domains[d]) for d in odims]
+                    e = np.zeros(oshape, dtype=np.float64)
+                    for dims, table in own_parts:
+                        e = e + _align(table, dims, odims)
+                    lw = (-beta) * e
+                    for dims, table in own_w:
+                        lw = lw + _align(table, dims, odims)
+                    o = np.stack([lw, e], axis=-1)
+                    parts.append((odims, o))
+                    parts_max += _finite_amax(o)
+            elif own_parts:
+                odims = []
                 for dims, _ in own_parts:
                     odims.extend(d for d in dims if d not in odims)
                 if len(own_parts) > 1:
@@ -859,12 +1493,15 @@ def contract_sweep(
                 err_in += sw.err[k].get(c, 0.0)
             if not parts:
                 # an isolated, cost-free variable: its contraction is
-                # the reduce of a zero table over its own domain
-                parts.append(([name], np.zeros(shape[-1])))
+                # the reduce of a ⊗-identity table over its own domain
+                if sr_n.kind == "expectation":
+                    parts.append(([name], np.zeros((shape[-1], 2))))
+                else:
+                    parts.append(([name], np.zeros(shape[-1])))
 
             dmc = device_min_cells
-            use_device = dmc is not None and size >= dmc
-            if use_device and not sr.idempotent:
+            use_device = dmc is not None and size * cw >= dmc
+            if use_device and sr_n.error_bounded:
                 # error-budget gate: a device (f32) pass whose
                 # accumulated bound would exceed tol runs on host f64
                 # instead — the logsumexp analogue of the exactness
@@ -880,7 +1517,8 @@ def contract_sweep(
                         met.inc("semiring.logsumexp_repairs")
             if not use_device:
                 host_contract(
-                    k, name, plan, sep, target, shape, parts, err_in
+                    sr_n, k, name, plan, sep, target, shape, parts,
+                    err_in,
                 )
                 continue
 
@@ -888,12 +1526,17 @@ def contract_sweep(
                 _align(t, dims, target) for dims, t in parts
             ]
             raw = (
-                tuple(shape), tuple(a.shape for a in aligned)
+                sr_n.name, tuple(shape),
+                tuple(a.shape for a in aligned),
             )
             key = _key_memo.get(raw)
             if key is None:
-                key = _key_memo[raw] = util_level_key(
-                    raw[0], raw[1], pad
+                # the level-pack key is shape-only and shared; the ⊕
+                # joins the BUCKET key so a mixed wave dispatches one
+                # block per semiring without ever mixing kernels
+                key = _key_memo[raw] = (
+                    sr_n.name,
+                    util_level_key(raw[1], raw[2], pad),
                 )
             if key not in buckets:
                 buckets[key] = []
@@ -906,22 +1549,28 @@ def contract_sweep(
                 )
             )
 
-        # ghost guard over padded own-axis cells is the ⊕-identity:
-        # +inf keeps a MIN arg-reduce inside the real domain; -inf is
-        # absorbing for max AND contributes exp(-inf)=0 to a logsumexp
-        guard = sr.plus_identity
+        if mixed and len(wave_srs) > 1 and met.enabled:
+            # one per wave that contracted nodes from more than one
+            # ⊕ block of a mixed-elimination (marginal-MAP) sweep
+            met.inc("semiring.mixed_blocks")
 
         for key in order:
             entries = buckets[key]
             if timeout is not None and time.perf_counter() - t0 > timeout:
                 return None
-            pshape, part_shapes = key
+            sr_b = get_semiring(key[0])
+            # ghost guard over padded own-axis cells is the ⊕-identity:
+            # +inf keeps a MIN arg-reduce (and every kbest component)
+            # inside the real domain; -inf is absorbing for max AND
+            # contributes exp(-inf)=0 weight to logsumexp/expectation
+            guard = sr_b.plus_identity
+            pshape, part_shapes = key[1]
             n_rows = len(entries)
             shape0 = entries[0][0][4]
             uniform = all(it[4] == shape0 for it, _ in entries)
             if level_sync and n_rows > 1 and uniform:
                 ok = _dispatch_stacked(
-                    sw, sr, entries, pshape, part_shapes, shape0,
+                    sw, sr_b, entries, pshape, part_shapes, shape0,
                     pad, guard, tol, want_args, finish, sup, met,
                     plans,
                 )
@@ -932,7 +1581,7 @@ def contract_sweep(
                 # degrades further to the exact host contraction)
                 if met.enabled:
                     met.inc("engine.oom_splits")
-            fn = contraction_kernel(sr, pshape, part_shapes)
+            fn = contraction_kernel(sr_b, pshape, part_shapes)
             for item, aligned in entries:
                 (k, name, sep, target, shape, parts,
                  parts_max, err_in) = item
@@ -956,13 +1605,14 @@ def contract_sweep(
                             np.asarray(x) for x in fn(*p)
                         ),
                         scope="semiring.node", width=1,
-                        table_bytes=4 * int(np.prod(pshape)),
+                        table_bytes=4 * int(np.prod(pshape))
+                        * sr_b.cell_width,
                     )
                 except DeviceOOMError:
                     if on_oom == "raise":
                         raise
                     host_contract(
-                        k, name, plans[k], sep, target, shape,
+                        sr_b, k, name, plans[k], sep, target, shape,
                         parts, err_in,
                     )
                     continue
@@ -971,7 +1621,7 @@ def contract_sweep(
                 sw.dispatches[k] += 1
                 region = tuple(slice(0, s) for s in shape[:-1])
                 _finish_device_row(
-                    sw, sr, plans[k], item, outs, region, tol,
+                    sw, sr_b, plans[k], item, outs, region, tol,
                     want_args, finish,
                 )
     if tracer.enabled:
@@ -1010,7 +1660,7 @@ def _dispatch_stacked(
         outs = sup.dispatch(
             lambda: tuple(np.asarray(x) for x in fn(*casts)),
             scope="semiring.level", width=stack_h,
-            table_bytes=4 * int(np.prod(pshape)),
+            table_bytes=4 * int(np.prod(pshape)) * sr.cell_width,
         )
     except DeviceOOMError:
         return False
@@ -1043,12 +1693,58 @@ def _finish_device_row(
 
     met = get_metrics()
     (k, name, sep, target, shape, parts, parts_max, err_in) = item
-    if sr.idempotent:
+    if sr.kind == "kbest":
+        vals, margins, own_idx, *slots = outs
+        margins = np.asarray(margins[region], dtype=np.float64)
+        local_err = _EPS32 * (len(parts) + 1) * parts_max
+        # per-COMPONENT certificate: every selected slot must beat
+        # the next candidate by the f32 rounding bound, or the slot
+        # sequence (and so the backpointers) is uncertain — the whole
+        # node is then redone on host f64, still exact
+        if np.any(margins < 2.0 * (local_err + err_in)):
+            if met.enabled:
+                met.inc("semiring.cert_fallbacks")
+            host_kw = _kbest_host(
+                parts, target, shape, sr.cell_width
+            )
+            u, own, provs = host_kw
+            sw.host_nodes[k] += 1
+            finish(
+                sr, k, name, plan, sep, u,
+                (own, dict(zip(plan.children[name], provs))),
+            )
+            return
+        own = np.asarray(own_idx[region], dtype=np.intp)
+        slot_arrs = [
+            np.asarray(s[region], dtype=np.intp) for s in slots
+        ]
+        u = _kbest_reeval(parts, target, shape, own, slot_arrs)
+        # slots past the candidate count (or genuinely infeasible)
+        # are +inf in the kernel's values; their backpointers are
+        # clamped padding — the re-evaluation must not resurrect them
+        u = np.where(
+            np.isfinite(np.asarray(vals[region])), u, np.inf
+        )
+        sw.device_nodes[k] += 1
+        finish(
+            sr, k, name, plan, sep, u,
+            (own, dict(zip(plan.children[name], slot_arrs))),
+        )
+    elif sr.kind == "expectation":
+        (vals,) = outs
+        u = np.asarray(vals[region], dtype=np.float64)
+        scale = max(parts_max, 1.0)
+        sw.err[k][name] = err_in + _EPS32 * (
+            (len(parts) + 1) * scale + shape[-1] + 2
+        )
+        sw.device_nodes[k] += 1
+        finish(sr, k, name, plan, sep, u, None)
+    elif sr.idempotent:
         arg, margins = outs
         arg = np.array(arg[region])  # writable (repair)
         margins = np.asarray(margins[region], dtype=np.float64)
         local_err = _EPS32 * (len(parts) + 1) * parts_max
-        bad = np.argwhere(margins < 2.0 * local_err)
+        bad = np.argwhere(margins < 2.0 * (local_err + err_in))
         if len(bad) * 10 > margins.size:
             # tie-heavy: per-cell repair would dominate — redo the
             # whole contraction on host f64 (still exact)
@@ -1060,7 +1756,9 @@ def _finish_device_row(
             u = sr.reduce(j, axis=-1)
             arg = sr.arg_reduce(j, axis=-1) if want_args else None
             sw.host_nodes[k] += 1
-            finish(k, name, plan, sep, u, arg)
+            if err_in:
+                sw.err[k][name] = err_in
+            finish(sr, k, name, plan, sep, u, arg)
             return
         own = target[-1]
         for cell in map(tuple, bad):
@@ -1085,7 +1783,9 @@ def _finish_device_row(
                     idx.append(grids[target.index(d)])
             u += np.asarray(table, dtype=np.float64)[tuple(idx)]
         sw.device_nodes[k] += 1
-        finish(k, name, plan, sep, u, arg)
+        if err_in:
+            sw.err[k][name] = err_in
+        finish(sr, k, name, plan, sep, u, arg)
     else:
         (vals,) = outs
         u = np.asarray(vals[region], dtype=np.float64)
@@ -1094,7 +1794,7 @@ def _finish_device_row(
             (len(parts) + 1) * scale + shape[-1] + 2
         )
         sw.device_nodes[k] += 1
-        finish(k, name, plan, sep, u, None)
+        finish(sr, k, name, plan, sep, u, None)
 
 
 def _cell_row(table, dims, target, cell):
@@ -1113,21 +1813,201 @@ def _cell_row(table, dims, target, cell):
     return row
 
 
+# -- structured-cell host contractions ----------------------------------
+
+
+def _kbest_host(parts, target, shape, kk):
+    """Exact host-f64 top-K contraction of one node with provenance:
+    scalar parts broadcast-add into the base ``j``, child k-cells
+    cross-sum-truncate one at a time (exact — sums are monotone in
+    each argument, so a dropped rank->K candidate already had K
+    smaller sums), then the own-axis projection merge-sorts the
+    ``d·k`` candidates.  Returns ``(values [sep..,k], own-value index
+    [sep..,k], per-child slot arrays)`` — the backpointers the value
+    phase walks.  Selection among exact ties is by candidate index
+    (stable argsort): deterministic, and shared with the device
+    kernel's ordering."""
+    nd = len(shape)
+    j = np.zeros(shape, dtype=np.float64)
+    vecs = []
+    for dims, t in parts:
+        t = np.asarray(t, dtype=np.float64)
+        if t.ndim == len(dims) + 1:
+            vecs.append(_align(t, dims, target))
+        else:
+            j = j + _align(t, dims, target)
+    if vecs:
+        with np.errstate(invalid="ignore"):
+            cell = j[..., None] + np.broadcast_to(
+                vecs[0], shape + [kk]
+            )
+        provs = [
+            np.broadcast_to(
+                np.arange(kk, dtype=np.intp), cell.shape
+            )
+        ]
+        for t in vecs[1:]:
+            with np.errstate(invalid="ignore"):
+                sums = cell[..., :, None] + np.broadcast_to(
+                    t, shape + [kk]
+                )[..., None, :]
+            flat = sums.reshape(sums.shape[:-2] + (kk * kk,))
+            idx = np.argsort(flat, axis=-1, kind="stable")[..., :kk]
+            cell = np.take_along_axis(flat, idx, axis=-1)
+            provs = [
+                np.take_along_axis(p, idx // kk, axis=-1)
+                for p in provs
+            ]
+            provs.append(idx % kk)
+    else:
+        lift = np.full(kk, np.inf)
+        lift[0] = 0.0
+        cell = j[..., None] + lift
+        provs = []
+    d = shape[-1]
+    flat = cell.reshape(cell.shape[:-2] + (d * kk,))
+    idx = np.argsort(flat, axis=-1, kind="stable")[..., :kk]
+    vals = np.take_along_axis(flat, idx, axis=-1)
+    own = idx // kk
+    provs = [
+        np.take_along_axis(
+            np.ascontiguousarray(p).reshape(
+                p.shape[:-2] + (d * kk,)
+            ),
+            idx,
+            axis=-1,
+        )
+        for p in provs
+    ]
+    if vals.shape[-1] < kk:
+        pad = kk - vals.shape[-1]
+        vals = np.concatenate(
+            [vals, np.full(vals.shape[:-1] + (pad,), np.inf)], -1
+        )
+        own = np.concatenate(
+            [own, np.zeros(own.shape[:-1] + (pad,), np.intp)], -1
+        )
+        provs = [
+            np.concatenate(
+                [p, np.zeros(p.shape[:-1] + (pad,), np.intp)], -1
+            )
+            for p in provs
+        ]
+    return vals, own, provs
+
+
+def _kbest_reeval(parts, target, shape, own, slot_arrs):
+    """Exact f64 top-K values AT certified device backpointers: the
+    same part-order accumulation as :func:`_kbest_host`, gathered at
+    the selected (own value, child slot) per separator cell and slot
+    — children contribute zero error to their parents, whatever the
+    tree depth (the kbest twin of DPOP's value re-evaluation)."""
+    kk = own.shape[-1]
+    own_var = target[-1]
+    grids = np.indices(tuple(shape[:-1]) + (kk,), dtype=np.intp)
+    u = np.zeros(tuple(shape[:-1]) + (kk,), dtype=np.float64)
+    vec_i = 0
+    for dims, t in parts:
+        t64 = np.asarray(t, dtype=np.float64)
+        if t64.ndim == len(dims) + 1:
+            a = np.broadcast_to(
+                _align(t64, dims, target), tuple(shape) + (kk,)
+            )
+            idx = [
+                grids[target.index(d)] for d in target[:-1]
+            ]
+            u = u + a[tuple(idx) + (own, slot_arrs[vec_i])]
+            vec_i += 1
+        else:
+            a = np.broadcast_to(
+                _align(t64, dims, target), tuple(shape)
+            )
+            idx = [
+                grids[target.index(d)] for d in target[:-1]
+            ]
+            u = u + a[tuple(idx) + (own,)]
+    return u
+
+
+def _expect_host(parts, target, shape):
+    """Host-f64 expectation contraction of one node: pair parts add
+    per plane (scalar parts weight-only), then the own-axis ⊕ —
+    logsumexp on the weights, softmax-weighted combine on ``r``."""
+    lw = np.zeros(shape, dtype=np.float64)
+    r = np.zeros(shape, dtype=np.float64)
+    for dims, t in parts:
+        t = np.asarray(t, dtype=np.float64)
+        if t.ndim == len(dims) + 1:
+            a = _align(t, dims, target)
+            lw = lw + a[..., 0]
+            r = r + a[..., 1]
+        else:
+            lw = lw + _align(t, dims, target)
+    return _exp_pair_reduce(
+        np.stack([lw, r], axis=-1), (len(shape) - 1,)
+    )
+
+
 # -- queries ------------------------------------------------------------
 
 
-def _value_phase(plan: ContractionPlan, args) -> Dict[str, Any]:
+def _value_phase(
+    plan: ContractionPlan, args, only: Optional[set] = None
+) -> Dict[str, Any]:
     """Top-down MAP value wave: condition each node's retained arg
     table on the accumulated ancestor assignment (parents precede
-    children in reversed elimination order)."""
+    children in reversed elimination order).  ``only`` restricts the
+    walk to the maximized block of a marginal-MAP plan — those nodes
+    come LAST in elimination order (so first here), and their
+    separators contain only maximized variables, so the walk never
+    needs a summed node's (nonexistent) arg table."""
     assignment: Dict[str, Any] = {}
     idx: Dict[str, int] = {}
     for name in reversed(plan.order):
+        if only is not None and name not in only:
+            continue
         sep, arg = args[name]
         best = int(arg[tuple(idx[d] for d in sep)])
         idx[name] = best
         assignment[name] = plan.domains[name][best]
     return assignment
+
+
+def _kbest_solutions(plan: ContractionPlan, root_cells, args, kk):
+    """The K best full assignments of one instance (or one lane), in
+    cost order: cross-sum the per-root K-best cells tracking the
+    per-root slot each final slot came from (roots are independent,
+    so the instance optimum list is the truncated cross-sum of root
+    lists), then walk each slot's backpointers top-down.  Returns
+    ``[(energy value — shifts excluded, {var: value-index})]``;
+    deterministic under exact ties via the (value, slot-tuple)
+    sort key."""
+    combos: List[Tuple[float, tuple]] = [(0.0, ())]
+    for rt in plan.roots:
+        cell = root_cells[rt]
+        nxt = []
+        for base, slots in combos:
+            for s in range(cell.shape[-1]):
+                v = float(cell[s])
+                if np.isfinite(v):
+                    nxt.append((base + v, slots + (s,)))
+        nxt.sort(key=lambda t: (t[0], t[1]))
+        combos = nxt[:kk]
+    out = []
+    for val, slots in combos:
+        idx: Dict[str, int] = {}
+        stack = list(zip(plan.roots, slots))
+        while stack:
+            v, s = stack.pop()
+            sep, (own, cslots) = args[v]
+            cell_i = tuple(idx[d] for d in sep)
+            idx[v] = int(own[cell_i + (s,)])
+            for c in plan.children[v]:
+                stack.append(
+                    (c, int(cslots[c][cell_i + (s,)]))
+                )
+        out.append((val, idx))
+    return out
 
 
 def _downward_marginals(
@@ -1210,6 +2090,10 @@ def run_infer_many(
     max_table_size: int = 1 << 26,
     timeout: Optional[float] = None,
     max_util_bytes: Optional[int] = None,
+    map_vars: Optional[Sequence[str]] = None,
+    external_dists: Optional[
+        Mapping[str, Mapping[Any, float]]
+    ] = None,
 ) -> List[Dict[str, Any]]:
     """Run one inference query over K instances with their contraction
     sweeps MERGED (the ``solve_many`` batching contract: same-bucket
@@ -1236,21 +2120,42 @@ def run_infer_many(
     like DPOP), ``"log_z"`` (+/x — ``log Σ_x exp(-beta·E(x))``),
     ``"marginals"`` (+/x normalized — per-variable distributions
     ``p(x_v)``, plus ``log_z`` which the upward pass yields for
-    free).
+    free), ``"kbest:<k>"`` (top-K cells — the k best assignments in
+    cost order, certified per component + host-f64 re-evaluated, so
+    exact like ``map``), ``"marginal_map"`` (mixed elimination:
+    ``map_vars`` maximized LAST over the logsumexp of the rest —
+    both order heuristics honor the two-block constraint), and
+    ``"expectation"`` (expectation pairs — ``E[cost]`` under the
+    Gibbs distribution and, via ``external_dists = {external:
+    {value: prob}}``, under stochastic externals: a modeled
+    expectation, not a chaos-injected sample).
     """
     t0 = time.perf_counter()
-    if query not in QUERY_SEMIRINGS:
-        raise ValueError(
-            f"unknown query {query!r} (expected one of "
-            f"{sorted(QUERY_SEMIRINGS)})"
-        )
+    qkind, sr = parse_query(query)
     if device not in ("auto", "never", "always"):
         raise ValueError(
             f"device must be 'auto'|'never'|'always', got {device!r}"
         )
     if beta <= 0:
         raise ValueError(f"beta must be > 0, got {beta}")
-    sr = get_semiring(QUERY_SEMIRINGS[query])
+    if qkind == "marginal_map":
+        if not map_vars:
+            raise ValueError(
+                "marginal_map needs map_vars=[...] — the variables "
+                "maximized over (every other variable is summed out; "
+                "with none maximized the query is 'log_z')"
+            )
+    elif map_vars:
+        raise ValueError(
+            f"map_vars applies to query='marginal_map' only, not "
+            f"{query!r}"
+        )
+    if external_dists and qkind != "expectation":
+        raise ValueError(
+            "external_dists weight assignments by external-variable "
+            f"probabilities — query {query!r} has no expectation to "
+            "weight (use query='expectation')"
+        )
     pad = as_pad_policy(pad_policy)
     dmc: Optional[int]
     if device == "never":
@@ -1267,21 +2172,40 @@ def run_infer_many(
     deadline = None if timeout is None else t0 + timeout
     try:
         plans = [
-            build_plan(d, order=order, deadline=deadline)
+            build_plan(
+                d, order=order, deadline=deadline,
+                max_vars=(
+                    map_vars if qkind == "marginal_map" else None
+                ),
+                external_dists=(
+                    external_dists
+                    if qkind == "expectation"
+                    else None
+                ),
+            )
             for d in dcops
         ]
     except TimeoutError:
         # plan construction (the min_fill search) ate the budget —
         # same contract as a sweep timeout
         return [_timeout_result(query, t0) for _ in range(K)]
-    want_args = query == "map"
+    want_args = qkind in ("map", "marginal_map", "kbest")
 
     if max_util_bytes is not None:
+        if qkind == "marginal_map":
+            raise ValueError(
+                "marginal_map cannot run memory-bounded: "
+                "conditioning a summed variable would hoist the max "
+                "outside its sum (lanes ⊕-combine per lane, and "
+                "max_{M} Σ_{S} ≠ Σ_{S cut} max_{M}) — raise the "
+                "budget or narrow the order instead"
+            )
         return _run_bounded_infer(
-            dcops, plans, query, sr,
+            dcops, plans, qkind, sr,
             max_util_bytes=int(max_util_bytes), beta=beta, dmc=dmc,
             pad=pad, tol=tol, max_table_size=max_table_size,
             want_args=want_args, t0=t0, timeout=timeout, K=K,
+            query=query,
         )
 
     sw = contract_sweep(
@@ -1321,13 +2245,34 @@ def run_infer_many(
             "error_bound": err,
             "instances_batched": K,
         }
-        if query == "map":
+        if qkind == "map":
             assignment = _value_phase(plan, sw.args[k])
             cost = dcop.solution_cost(assignment)
             out["assignment"] = assignment
             out["cost"] = cost
             out["log_weight"] = agg
-        elif query == "log_z":
+        elif qkind == "marginal_map":
+            assignment = _value_phase(
+                plan, sw.args[k], only=set(plan.max_vars)
+            )
+            out["assignment"] = assignment
+            out["map_vars"] = list(plan.max_vars)
+            out["value"] = agg  # max_{x_M} log Σ_{x_S} e^{-βE}
+        elif qkind == "kbest":
+            out.update(
+                _kbest_result(plan, sw, k, sr.cell_width, dcop)
+            )
+        elif qkind == "expectation":
+            cells = [
+                sw.root_cells[k][rt] for rt in plan.roots
+            ]
+            lw = sum(float(c[0]) for c in cells)
+            rr = sum(float(c[1]) for c in cells)
+            out["log_z"] = (
+                lw + sw.total_shift[k] - beta * plan.const_energy
+            )
+            out["e_cost"] = rr + plan.const_energy
+        elif qkind == "log_z":
             out["log_z"] = agg
         else:  # marginals
             t_down = time.perf_counter()
@@ -1351,6 +2296,38 @@ def run_infer_many(
     return results
 
 
+def _kbest_result(plan, sw, k, kk, dcop) -> Dict[str, Any]:
+    """The kbest result block for one instance of an unbounded sweep:
+    walk the backpointers, fold shifts back into the energy values,
+    and report each solution with its true (dcop-convention) cost —
+    K DISTINCT assignments, best first."""
+    sols = _kbest_solutions(
+        plan, sw.root_cells[k], sw.args[k], kk
+    )
+    solutions = []
+    for val, idx in sols:
+        assignment = {
+            v: plan.domains[v][i] for v, i in idx.items()
+        }
+        solutions.append(
+            {
+                "assignment": assignment,
+                "cost": dcop.solution_cost(assignment),
+                "energy": val + sw.total_shift[k]
+                + plan.const_energy,
+            }
+        )
+    out: Dict[str, Any] = {
+        "k": kk,
+        "solutions": solutions,
+        "costs": [s["cost"] for s in solutions],
+    }
+    if solutions:
+        out["assignment"] = solutions[0]["assignment"]
+        out["cost"] = solutions[0]["cost"]
+    return out
+
+
 def _timeout_result(query: str, t0: float) -> Dict[str, Any]:
     return {
         "query": query,
@@ -1360,8 +2337,9 @@ def _timeout_result(query: str, t0: float) -> Dict[str, Any]:
 
 
 def _run_bounded_infer(
-    dcops, plans, query, sr, *, max_util_bytes, beta, dmc, pad,
+    dcops, plans, qkind, sr, *, max_util_bytes, beta, dmc, pad,
     tol, max_table_size, want_args, t0, timeout, K,
+    query: Optional[str] = None,
 ) -> List[Dict[str, Any]]:
     """Memory-bounded assembly behind :func:`run_infer_many`
     (``max_util_bytes`` set): the budgeted lane sweep
@@ -1369,10 +2347,14 @@ def _run_bounded_infer(
     idempotent ⊕ picks the best lane (exact), logsumexp ⊕-combines
     the lane values under the worst-lane error bound, marginals mix
     lane marginals by lane weight and scatter over the original
-    (pre-pruning) domains."""
+    (pre-pruning) domains, kbest merge-sorts the lanes' solution
+    lists (lanes partition the assignment space, so the truncated
+    merge is the exact instance list), and expectation ⊕-combines
+    the lanes' (log w, r) pairs."""
     from pydcop_tpu.ops import membound as _mb
     from pydcop_tpu.telemetry import get_tracer
 
+    query = qkind if query is None else query
     tracer = get_tracer()
     bs = _mb.run_bounded(
         plans, sr, max_util_bytes=max_util_bytes, beta=beta,
@@ -1395,7 +2377,7 @@ def _run_bounded_infer(
             "instances_batched": K,
             "membound": bs.meta(k),
         }
-        if query == "map":
+        if qkind == "map":
             winner = bs.best_lane(k, maximize=True)
             assignment = _value_phase(
                 bs.lanes[winner], bs.sw.args[winner]
@@ -1406,7 +2388,71 @@ def _run_bounded_infer(
                 bs.lane_values(k)[winner - bs.ranges[k][0]] - const
             )
             out["error_bound"] = 0.0  # certified per lane, exact
-        elif query == "log_z":
+        elif qkind == "kbest":
+            kk = sr.cell_width
+            lo, hi = bs.ranges[k]
+            all_sols: List[Tuple[float, Dict[str, Any]]] = []
+            for l in range(lo, hi):
+                lane = bs.lanes[l]
+                for val, idx in _kbest_solutions(
+                    lane, bs.sw.root_cells[l], bs.sw.args[l], kk
+                ):
+                    a = {
+                        v: lane.domains[v][i]
+                        for v, i in idx.items()
+                    }
+                    all_sols.append(
+                        (
+                            val + bs.sw.total_shift[l]
+                            + plan.const_energy,
+                            a,
+                        )
+                    )
+            all_sols.sort(
+                key=lambda t: (t[0], sorted(t[1].items()).__repr__())
+            )
+            solutions = [
+                {
+                    "assignment": a,
+                    "cost": dcop.solution_cost(a),
+                    "energy": val,
+                }
+                for val, a in all_sols[:kk]
+            ]
+            out["k"] = kk
+            out["solutions"] = solutions
+            out["costs"] = [s["cost"] for s in solutions]
+            if solutions:
+                out["assignment"] = solutions[0]["assignment"]
+                out["cost"] = solutions[0]["cost"]
+            out["error_bound"] = 0.0  # certified per lane, exact
+        elif qkind == "expectation":
+            lo, hi = bs.ranges[k]
+            lws, rs = [], []
+            for l in range(lo, hi):
+                cells = [
+                    bs.sw.root_cells[l][rt]
+                    for rt in bs.lanes[l].roots
+                ]
+                lws.append(
+                    sum(float(c[0]) for c in cells)
+                    + bs.sw.total_shift[l]
+                )
+                rs.append(sum(float(c[1]) for c in cells))
+            pair = _exp_pair_reduce(
+                np.stack(
+                    [np.asarray(lws), np.asarray(rs)], axis=-1
+                ),
+                (0,),
+            )
+            out["log_z"] = float(pair[0]) - const
+            out["e_cost"] = float(pair[1]) + plan.const_energy
+            errs = bs.lane_errs(k)
+            out["error_bound"] = (
+                max(errs, default=0.0)
+                + _EPS64 * (len(errs) + 2)
+            )
+        elif qkind == "log_z":
             v, err = bs.logsumexp_lanes(k)
             out["log_z"] = v - const
             out["error_bound"] = err

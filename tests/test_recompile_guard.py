@@ -154,6 +154,29 @@ def test_semiring_guard_swap_reuses_buckets():
     assert report["repeat_compiles"] == 0, report
 
 
+@pytest.mark.semiring
+def test_query_guard_structured_queries_reuse_buckets():
+    """The structured-cell query pack (kbest / marginal_map /
+    expectation): swapping the query on the same K instances compiles
+    at most one new executable per (semiring, level-pack bucket) —
+    within the recorded per-query budget — ZERO on repeat, with
+    device results matching host f64 (kbest exactly, marginal_map
+    assignment exactly + value in bound, expectation in bound).  See
+    tools/recompile_guard.py:run_query_guard."""
+    guard = _load_guard()
+    report = guard.run_query_guard()
+    assert report["ok"], report
+    assert report["kbest_compiles"] >= 1, report  # guard actually ran
+    assert report["kbest_compiles"] <= guard.QUERY_BUDGET, report
+    assert (
+        report["marginal_map_compiles"] <= guard.QUERY_BUDGET
+    ), report
+    assert (
+        report["expectation_compiles"] <= guard.QUERY_BUDGET
+    ), report
+    assert report["repeat_compiles"] == 0, report
+
+
 @pytest.mark.membound
 def test_membound_guard_budgeted_solve_reuses_buckets():
     """Memory-bounded solves (ops/membound.py): the first budgeted

@@ -1,0 +1,625 @@
+"""Structured-cell semiring queries (ISSUE 13, ``ops/semiring.py``,
+``docs/semirings.md`` "Structured cells"): top-K / marginal-MAP /
+expectation algebra axioms, brute-force parity on small loopy graphs
+under both elimination orders, merged-sweep bit-parity, the device
+paths' exactness contracts, the cell-width-aware membound budget
+model, and the solver service's per-query coalescing.
+"""
+
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import (
+    AgentDef,
+    Domain,
+    ExternalVariable,
+    Variable,
+)
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.ops import semiring as sr
+
+from tests.test_semiring import _random_dcop
+
+pytestmark = pytest.mark.semiring
+
+
+# -- brute-force references ---------------------------------------------
+
+
+def _enumerate(dcop):
+    """All assignments with their dcop-convention costs, sorted by
+    (cost, assignment) — the k-best reference."""
+    vs = sorted(dcop.variables)
+    doms = {v: list(dcop.variables[v].domain.values) for v in vs}
+    rows = []
+    for combo in itertools.product(*(doms[v] for v in vs)):
+        a = dict(zip(vs, combo))
+        rows.append((dcop.solution_cost(a), a))
+    rows.sort(key=lambda t: (t[0], sorted(t[1].items())))
+    return rows
+
+
+def _brute_marginal_map(dcop, map_vars, beta=1.0):
+    """max over map_vars of ``log Σ_{rest} exp(-beta·E)`` plus its
+    argmax (host-f64 enumeration)."""
+    vs = sorted(dcop.variables)
+    doms = {v: list(dcop.variables[v].domain.values) for v in vs}
+    rest = [v for v in vs if v not in map_vars]
+    best = None
+    for combo in itertools.product(*(doms[v] for v in map_vars)):
+        fixed = dict(zip(map_vars, combo))
+        logw = []
+        for c2 in itertools.product(*(doms[v] for v in rest)):
+            a = {**fixed, **dict(zip(rest, c2))}
+            logw.append(-beta * dcop.solution_cost(a))
+        logw = np.asarray(logw)
+        m = logw.max()
+        v = float(m + np.log(np.exp(logw - m).sum()))
+        if best is None or v > best[0]:
+            best = (v, fixed)
+    return best
+
+
+def _brute_expectation(dcop, beta=1.0):
+    """(log_z, E[cost]) under the Gibbs distribution."""
+    rows = _enumerate(dcop)
+    logw = np.asarray([-beta * c for c, _ in rows])
+    m = logw.max()
+    log_z = float(m + np.log(np.exp(logw - m).sum()))
+    p = np.exp(logw - log_z)
+    e_cost = float(sum(pi * c for pi, (c, _) in zip(p, rows)))
+    return log_z, e_cost
+
+
+# -- cell algebra axioms ------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["kbest:4", "expectation"])
+def test_structured_semiring_axioms(name):
+    """⊕/⊗ axioms on structured CELLS: associativity, commutativity,
+    identities, the ⊕-identity annihilating ⊗, and distributivity —
+    the reorderings the sweep relies on, now on vector cells."""
+    s = sr.get_semiring(name)
+    rnd = np.random.RandomState(3)
+
+    def cell(seed):
+        r = np.random.RandomState(seed)
+        if s.kind == "kbest":
+            return np.sort(
+                r.uniform(-3, 3, size=(7, s.cell_width)), axis=-1
+            )
+        return np.stack(
+            [r.uniform(-3, 0, size=7), r.uniform(-2, 2, size=7)],
+            axis=-1,
+        )
+
+    a, b, c = cell(0), cell(1), cell(2)
+
+    def approx(x, y):
+        np.testing.assert_allclose(x, y, rtol=0, atol=1e-9)
+
+    # ⊕: associative, commutative, identity
+    approx(s.add(s.add(a, b), c), s.add(a, s.add(b, c)))
+    approx(s.add(a, b), s.add(b, a))
+    ident = np.broadcast_to(s.identity_cell(), a.shape)
+    approx(s.add(a, ident), a)
+    # ⊗: associative, commutative, identity
+    approx(
+        s.combine(s.combine(a, b), c), s.combine(a, s.combine(b, c))
+    )
+    approx(s.combine(a, b), s.combine(b, a))
+    tident = np.broadcast_to(s.times_identity_cell(), a.shape)
+    approx(s.combine(a, tident), a)
+    # the ⊕-identity annihilates ⊗
+    if s.kind == "kbest":
+        assert np.all(np.isinf(s.combine(a, ident)))
+    else:  # expectation: the weight plane annihilates
+        assert np.all(np.isneginf(s.combine(a, ident)[..., 0]))
+    # distributivity: a ⊗ (b ⊕ c) == (a ⊗ b) ⊕ (a ⊗ c)
+    approx(
+        s.combine(a, s.add(b, c)),
+        s.add(s.combine(a, b), s.combine(a, c)),
+    )
+    # kbest ⊕ is NOT idempotent (a ⊕ a duplicates values) — the
+    # reason it runs under the per-component certificate, not the
+    # min/max one
+    if s.kind == "kbest":
+        assert not np.array_equal(s.add(a, a), a)
+
+
+def test_kbest_reduce_matches_flat_sort():
+    s = sr.kbest_semiring(3)
+    rnd = np.random.RandomState(0)
+    a = np.sort(rnd.uniform(0, 5, size=(4, 5, 3)), axis=-1)
+    got = s.reduce(a, axis=(0, 1))
+    ref = np.sort(a.reshape(-1))[:3]
+    np.testing.assert_allclose(got, ref, atol=0)
+
+
+# -- registry / query parsing (the nearest-name satellite) --------------
+
+
+def test_get_semiring_suggests_nearest_name():
+    with pytest.raises(ValueError, match="did you mean 'log_sum_exp'"):
+        sr.get_semiring("log_sumexp")
+    with pytest.raises(ValueError, match="unknown semiring"):
+        sr.get_semiring("tropical_typo")
+    # parametric kbest resolves (and caches) on demand
+    assert sr.get_semiring("kbest:7").cell_width == 7
+    assert sr.get_semiring("kbest:7") is sr.kbest_semiring(7)
+    with pytest.raises(ValueError, match="2 <= k"):
+        sr.get_semiring("kbest:1")
+    with pytest.raises(ValueError, match="malformed"):
+        sr.get_semiring("kbest:five")
+
+
+def test_parse_query_suggests_nearest_query():
+    from pydcop_tpu.api import infer_many
+
+    for bad, expect in (
+        ("kbset:5", "kbest:5"),
+        ("marginal_maps", "marginal_map"),
+        ("expectatin", "expectation"),
+    ):
+        with pytest.raises(
+            ValueError, match=f"did you mean '{expect}'"
+        ):
+            infer_many([_random_dcop(4, 0)], bad)
+    with pytest.raises(ValueError, match="unknown query"):
+        infer_many([_random_dcop(4, 0)], "entropy")
+
+
+def test_query_validation():
+    from pydcop_tpu.api import infer
+
+    d = _random_dcop(5, 0)
+    with pytest.raises(ValueError, match="needs map_vars"):
+        infer(d, "marginal_map")
+    with pytest.raises(ValueError, match="marginal_map"):
+        infer(d, "map", map_vars=["v0"])
+    with pytest.raises(ValueError, match="expectation"):
+        infer(d, "log_z", external_dists={"e": {0: 1.0}})
+    with pytest.raises(ValueError, match="not\n*.*variables|not "):
+        infer(d, "marginal_map", map_vars=["nope"])
+    with pytest.raises(ValueError, match="cannot run memory-bounded"):
+        infer(d, "marginal_map", map_vars=["v0"], max_util_bytes=64)
+
+
+# -- brute-force parity -------------------------------------------------
+
+
+@pytest.mark.parametrize("order", ["pseudo_tree", "min_fill"])
+def test_kbest_matches_brute_force(order):
+    """The kbest:5 list equals the brute-force 5 smallest costs, in
+    order, with 5 DISTINCT assignments whose reported costs are their
+    true dcop costs (the ISSUE 13 acceptance bar)."""
+    from pydcop_tpu.api import infer
+
+    dcop = _random_dcop(7, 1)
+    rows = _enumerate(dcop)
+    r = infer(dcop, "kbest:5", order=order)
+    assert r["status"] == "finished"
+    assert len(r["solutions"]) == 5
+    np.testing.assert_allclose(
+        r["costs"], [c for c, _ in rows[:5]], atol=1e-9
+    )
+    assert r["costs"] == sorted(r["costs"])
+    seen = set()
+    for s in r["solutions"]:
+        assert dcop.solution_cost(s["assignment"]) == pytest.approx(
+            s["cost"], abs=1e-9
+        )
+        seen.add(tuple(sorted(s["assignment"].items())))
+    assert len(seen) == 5
+    # best-of-list == the MAP optimum
+    assert r["cost"] == pytest.approx(rows[0][0], abs=1e-9)
+
+
+def test_kbest_exact_ties_cover_the_whole_tie_class():
+    """Hard-constraint-style 0/1 tables tie massively: the returned
+    costs must still be the k smallest multiset, distinct
+    assignments, deterministic across repeat calls."""
+    from pydcop_tpu.api import infer
+
+    dom = Domain("c", "", [0, 1, 2])
+    dcop = DCOP("ring")
+    vs = [Variable(f"v{i}", dom) for i in range(5)]
+    for v in vs:
+        dcop.add_variable(v)
+    eq = np.eye(3)
+    for i in range(5):
+        dcop.add_constraint(
+            NAryMatrixRelation(
+                [vs[i], vs[(i + 1) % 5]], eq, name=f"c{i}"
+            )
+        )
+    dcop.add_agents([AgentDef("a0")])
+    rows = _enumerate(dcop)
+    r1 = infer(dcop, "kbest:6")
+    r2 = infer(dcop, "kbest:6")
+    assert r1["costs"] == [c for c, _ in rows[:6]]
+    assert r1["solutions"] == r2["solutions"]  # deterministic
+    assert (
+        len(
+            {
+                tuple(sorted(s["assignment"].items()))
+                for s in r1["solutions"]
+            }
+        )
+        == 6
+    )
+
+
+def test_kbest_k_exceeding_assignment_space_truncates():
+    from pydcop_tpu.api import infer
+
+    dom = Domain("d", "", [0, 1])
+    dcop = DCOP("tiny")
+    a = Variable("a", dom)
+    dcop.add_variable(a)
+    dcop.add_constraint(
+        NAryMatrixRelation([a], np.array([1.0, 3.0]), name="u")
+    )
+    dcop.add_agents([AgentDef("ag")])
+    r = infer(dcop, "kbest:5")
+    assert r["costs"] == [1.0, 3.0]  # only 2 assignments exist
+    assert len(r["solutions"]) == 2
+
+
+@pytest.mark.parametrize("order", ["pseudo_tree", "min_fill"])
+def test_marginal_map_matches_brute_force(order):
+    from pydcop_tpu.api import infer
+
+    dcop = _random_dcop(7, 2)
+    mv = sorted(dcop.variables)[:3]
+    value, assignment = _brute_marginal_map(dcop, mv)
+    r = infer(dcop, "marginal_map", map_vars=mv, order=order)
+    assert r["status"] == "finished"
+    assert r["value"] == pytest.approx(value, abs=1e-6)
+    assert r["assignment"] == assignment
+    assert sorted(r["map_vars"]) == mv
+    # the summed block must be eliminated FIRST under both heuristics
+    plan = sr.build_plan(dcop, order=order, max_vars=mv)
+    positions = [plan.pos[v] for v in mv]
+    assert min(positions) == len(plan.order) - len(mv)
+
+
+@pytest.mark.parametrize("order", ["pseudo_tree", "min_fill"])
+def test_expectation_matches_brute_force(order):
+    from pydcop_tpu.api import infer
+
+    dcop = _random_dcop(7, 3)
+    for beta in (1.0, 0.25):
+        log_z, e_cost = _brute_expectation(dcop, beta=beta)
+        r = infer(dcop, "expectation", order=order, beta=beta)
+        assert r["status"] == "finished"
+        assert r["e_cost"] == pytest.approx(e_cost, abs=1e-6)
+        assert r["log_z"] == pytest.approx(log_z, abs=1e-6)
+
+
+def test_expectation_stochastic_externals_model_e_cost():
+    """external_dists turns a pinned external into a summed variable
+    with its probability as weight: E[cost] and log_z match the
+    host-f64 enumeration over (internal vars × external values)."""
+    from pydcop_tpu.api import infer
+
+    dom = Domain("d", "", [0, 1, 2])
+    dcop = DCOP("ext")
+    a = Variable("a", dom)
+    b = Variable("b", dom)
+    e = ExternalVariable("e", dom, value=0)
+    dcop.add_variable(a)
+    dcop.add_variable(b)
+    dcop.add_variable(e)
+    rnd = np.random.RandomState(0)
+    t_ab = rnd.uniform(0, 3, (3, 3))
+    t_be = rnd.uniform(0, 3, (3, 3))
+    dcop.add_constraint(NAryMatrixRelation([a, b], t_ab, name="c0"))
+    dcop.add_constraint(NAryMatrixRelation([b, e], t_be, name="c1"))
+    dcop.add_agents([AgentDef("ag0"), AgentDef("ag1")])
+    dist = {0: 0.5, 1: 0.3, 2: 0.2}
+    num = den = 0.0
+    for av, bv, ev in itertools.product(range(3), repeat=3):
+        cost = float(
+            dcop.solution_cost({"a": av, "b": bv, "e": ev})
+        )
+        w = np.exp(-cost) * dist[ev]
+        num += w * cost
+        den += w
+    for order in ("pseudo_tree", "min_fill"):
+        r = infer(
+            dcop, "expectation", external_dists={"e": dist},
+            order=order,
+        )
+        assert r["e_cost"] == pytest.approx(num / den, abs=1e-6)
+        assert r["log_z"] == pytest.approx(
+            float(np.log(den)), abs=1e-6
+        )
+    # string keys (the JSON / wire / CLI form) match via str fallback
+    r = infer(
+        dcop, "expectation",
+        external_dists={"e": {str(k): v for k, v in dist.items()}},
+    )
+    assert r["e_cost"] == pytest.approx(num / den, abs=1e-6)
+    # validation: unknown external / out-of-domain value / bad mass
+    with pytest.raises(ValueError, match="not\n*.*external|names"):
+        infer(dcop, "expectation", external_dists={"x": {0: 1.0}})
+    with pytest.raises(ValueError, match="outside"):
+        infer(dcop, "expectation", external_dists={"e": {9: 1.0}})
+    with pytest.raises(ValueError, match="positive total mass"):
+        infer(dcop, "expectation", external_dists={"e": {0: 0.0}})
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("order", ["pseudo_tree", "min_fill"])
+@pytest.mark.parametrize("seed", [4, 5])
+def test_queries_brute_force_12var_loopy(order, seed):
+    """The full-size acceptance matrix: ≤12-var loopy graphs, every
+    query, both orders (the cheap 7-var versions run in tier-1)."""
+    from pydcop_tpu.api import infer
+
+    dcop = _random_dcop(10 + (seed % 2), seed, extra_edges=3)
+    rows = _enumerate(dcop)
+    r = infer(dcop, "kbest:5", order=order)
+    np.testing.assert_allclose(
+        r["costs"], [c for c, _ in rows[:5]], atol=1e-9
+    )
+    mv = sorted(dcop.variables)[:3]
+    value, assignment = _brute_marginal_map(dcop, mv)
+    rm = infer(dcop, "marginal_map", map_vars=mv, order=order)
+    assert rm["value"] == pytest.approx(value, abs=1e-6)
+    assert rm["assignment"] == assignment
+    log_z, e_cost = _brute_expectation(dcop)
+    re = infer(dcop, "expectation", order=order)
+    assert re["e_cost"] == pytest.approx(e_cost, abs=1e-6)
+    assert re["log_z"] == pytest.approx(log_z, abs=1e-6)
+
+
+# -- batching -----------------------------------------------------------
+
+
+def test_infer_many_structured_queries_bit_identical():
+    """K>1 merged sweeps return byte-identical payloads to sequential
+    infer() calls for all three new queries (the solve_many batching
+    contract — ISSUE 13 acceptance)."""
+    from pydcop_tpu.api import infer, infer_many
+
+    dcops = [_random_dcop(5 + s, s) for s in range(4)]
+    many = infer_many(dcops, "kbest:4", pad_policy="pow2")
+    for i, d in enumerate(dcops):
+        one = infer(d, "kbest:4", pad_policy="pow2")
+        assert many[i]["instances_batched"] == len(dcops)
+        assert many[i]["costs"] == one["costs"]
+        assert many[i]["solutions"] == one["solutions"]
+    mv = ["v0", "v1"]
+    many = infer_many(
+        dcops, "marginal_map", map_vars=mv, pad_policy="pow2"
+    )
+    for i, d in enumerate(dcops):
+        one = infer(d, "marginal_map", map_vars=mv, pad_policy="pow2")
+        assert many[i]["value"] == one["value"]
+        assert many[i]["assignment"] == one["assignment"]
+    many = infer_many(dcops, "expectation", pad_policy="pow2")
+    for i, d in enumerate(dcops):
+        one = infer(d, "expectation", pad_policy="pow2")
+        assert many[i]["e_cost"] == one["e_cost"]
+        assert many[i]["log_z"] == one["log_z"]
+
+
+# -- device paths -------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_device_kbest_bit_identical_and_bounds_hold():
+    """device='always': the kbest list is BIT-identical to host f64
+    (per-component certificate + f64 re-evaluation), marginal_map's
+    assignment matches with its value inside the reported bound, and
+    expectation lands inside its bound.  (The tier-1 twin of this
+    runs inside tools/recompile_guard.py:run_query_guard.)"""
+    from pydcop_tpu.api import infer
+
+    dcop = _random_dcop(8, 4)
+    kw = dict(device="always", pad_policy="pow2")
+    host = infer(dcop, "kbest:5", device="never")
+    dev = infer(dcop, "kbest:5", **kw)
+    assert dev["device_nodes"] > 0
+    assert dev["costs"] == host["costs"]
+    assert dev["solutions"] == host["solutions"]
+
+    mv = sorted(dcop.variables)[:3]
+    h = infer(dcop, "marginal_map", map_vars=mv, device="never")
+    d = infer(
+        dcop, "marginal_map", map_vars=mv, tol=float("inf"), **kw
+    )
+    assert d["device_nodes"] > 0
+    assert d["assignment"] == h["assignment"]
+    assert abs(d["value"] - h["value"]) <= d["error_bound"] + 1e-9
+
+    h = infer(dcop, "expectation", device="never")
+    d = infer(dcop, "expectation", tol=float("inf"), **kw)
+    assert d["device_nodes"] > 0
+    assert abs(d["log_z"] - h["log_z"]) <= d["error_bound"] + 1e-9
+    assert d["e_cost"] == pytest.approx(h["e_cost"], abs=1e-3)
+
+
+# -- counters -----------------------------------------------------------
+
+
+def test_kbest_merges_and_mixed_blocks_counters():
+    from pydcop_tpu.api import infer
+    from pydcop_tpu.telemetry import session
+
+    dcop = _random_dcop(6, 0)
+    with session() as tel:
+        infer(dcop, "kbest:3")
+    counters = tel.summary()["counters"]
+    assert counters["semiring.kbest_merges"] == 6  # one per node
+    # a mixed sweep whose wave 0 holds both an isolated summed var
+    # and an isolated maximized var crosses blocks in one wave
+    dom = Domain("d", "", [0, 1])
+    d2 = DCOP("mix")
+    for n in ("s0", "m0"):
+        d2.add_variable(Variable(n, dom))
+    d2.add_constraint(
+        NAryMatrixRelation(
+            [d2.variables["s0"]], np.array([0.0, 1.0]), name="u"
+        )
+    )
+    d2.add_constraint(
+        NAryMatrixRelation(
+            [d2.variables["m0"]], np.array([2.0, 1.0]), name="w"
+        )
+    )
+    d2.add_agents([AgentDef("a0")])
+    with session() as tel:
+        r = infer(d2, "marginal_map", map_vars=["m0"])
+    counters = tel.summary()["counters"]
+    assert counters.get("semiring.mixed_blocks", 0) >= 1
+    assert r["assignment"] == {"m0": 1}
+
+
+# -- membound (the cell-width budget-model satellite) -------------------
+
+
+@pytest.mark.membound
+def test_plan_cut_budget_accounts_cell_width():
+    """The regression the satellite names: a kbest:8 sweep under
+    max_util_bytes must budget cells × cell_width × 4 bytes — the
+    same byte budget buys 8× fewer cells, so the cut is at least as
+    wide, never silently 8× over budget."""
+    from pydcop_tpu.ops import membound as mb
+
+    plan = sr.build_plan(_random_dcop(10, 2, extra_edges=4))
+    cp1 = mb.plan_cut(plan, 256, cell_width=1)
+    cp8 = mb.plan_cut(plan, 256, cell_width=8)
+    assert cp8.budget_cells == cp1.budget_cells // 8
+    assert cp8.width >= cp1.width
+    assert cp8.cell_width == 8
+    # the meta block reports BYTES including the cell width
+    assert (
+        cp8.bounded_peak_cells * mb.BYTES_PER_CELL * 8
+        <= 256
+    )
+
+
+@pytest.mark.membound
+def test_membound_kbest_and_expectation_exact_across_lanes():
+    """Budgeted structured-cell sweeps: the kbest list is identical
+    to the unbounded one (lanes partition the space; the merged list
+    is exact) and stays under the cell-width-aware budget;
+    expectation matches to 1e-6."""
+    from pydcop_tpu.api import infer
+
+    dcop = _random_dcop(9, 2, extra_edges=3)
+    budget = 5 * 4 * 8  # 8 cells of width 5
+    ref = infer(dcop, "kbest:5", device="never")
+    b = infer(
+        dcop, "kbest:5", device="never", max_util_bytes=budget
+    )
+    assert b["membound"]["cut_width"] >= 1
+    assert b["membound"]["peak_table_bytes"] <= budget
+    assert b["costs"] == ref["costs"]
+    assert [s["assignment"] for s in b["solutions"]] == [
+        s["assignment"] for s in ref["solutions"]
+    ]
+    ref = infer(dcop, "expectation", device="never")
+    b = infer(
+        dcop, "expectation", device="never", max_util_bytes=64
+    )
+    assert b["membound"]["cut_width"] >= 1
+    assert b["e_cost"] == pytest.approx(ref["e_cost"], abs=1e-6)
+    assert b["log_z"] == pytest.approx(ref["log_z"], abs=1e-6)
+
+
+# -- the solver service (mixed-query coalescing acceptance) -------------
+
+
+@pytest.mark.service
+def test_service_coalesces_mixed_query_traffic_in_one_tick():
+    """The ISSUE 13 service acceptance: mixed kbest/map/log_z traffic
+    submitted together lands in ONE tick, partitions per query (the
+    query joins the dispatch partition key: 3 dispatches, all 6
+    requests coalesced), and every result is bit-identical to a
+    sequential api.infer call."""
+    from pydcop_tpu.api import infer
+    from pydcop_tpu.engine.service import SolverService
+
+    dcops = [_random_dcop(5 + s, s) for s in range(6)]
+    queries = ["kbest:5", "kbest:5", "map", "map", "log_z", "log_z"]
+    with SolverService(
+        pad_policy="pow2", max_batch=16, max_wait=0.3
+    ) as svc:
+        pendings = [
+            svc.submit_infer(d, q) for d, q in zip(dcops, queries)
+        ]
+        results = [p.result(120) for p in pendings]
+        stats = svc.stats()
+    assert stats["ticks"] == 1, stats
+    assert stats["dispatches"] == 3, stats
+    assert stats["coalesced_requests"] == 6, stats
+    for d, q, r in zip(dcops, queries, results):
+        one = infer(d, q, pad_policy="pow2")
+        assert r["instances_batched"] == 2
+        if q.startswith("kbest"):
+            assert r["costs"] == one["costs"]
+            assert r["solutions"] == one["solutions"]
+        elif q == "map":
+            assert r["assignment"] == one["assignment"]
+            assert r["cost"] == one["cost"]
+        else:
+            assert r["log_z"] == one["log_z"]
+
+
+@pytest.mark.service
+def test_service_infer_validation_and_wire_round_trip():
+    """submit_infer validates at admission (nearest-name hint
+    included); the wire op ships every infer field and returns the
+    same payload as the in-process call."""
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.engine.service import (
+        ServiceClient,
+        ServiceServer,
+        SolverService,
+    )
+
+    dcop = _random_dcop(5, 0)
+    with SolverService(pad_policy="pow2", max_wait=0.05) as svc:
+        with pytest.raises(ValueError, match="did you mean"):
+            svc.submit_infer(dcop, "kbset:5")
+        with pytest.raises(ValueError, match="elimination order"):
+            svc.submit_infer(dcop, "map", order="min_width")
+        with pytest.raises(ValueError, match="beta"):
+            svc.submit_infer(dcop, "map", beta=0.0)
+        # cross-field checks fail AT ADMISSION, not a tick later
+        with pytest.raises(ValueError, match="needs map_vars"):
+            svc.submit_infer(dcop, "marginal_map")
+        with pytest.raises(ValueError, match="marginal_map"):
+            svc.submit_infer(dcop, "map", map_vars=["v0"])
+        with pytest.raises(ValueError, match="expectation"):
+            svc.submit_infer(
+                dcop, "log_z", external_dists={"e": {0: 1.0}}
+            )
+        direct = svc.infer(dcop, "kbest:3")
+
+        with ServiceServer(svc) as server:
+            with ServiceClient(server.address) as client:
+                txt = dcop_yaml(dcop)
+                r = client.infer(txt, "kbest:3")
+                assert r["costs"] == direct["costs"]
+                mv = ["v0", "v1"]
+                rw = client.infer(txt, "marginal_map", map_vars=mv)
+                assert rw["value"] == svc.infer(
+                    dcop, "marginal_map", map_vars=mv
+                )["value"]
+                with pytest.raises(Exception, match="did you mean"):
+                    client.infer(txt, "kbset:3")
+                with pytest.raises(ValueError, match="unknown infer"):
+                    client.infer(txt, "map", rounds=5)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -125,6 +125,21 @@ DPOP_BUDGET = 5
 # error bound.
 SEMIRING_K = 4
 
+# structured-cell query pack (ISSUE 13, ops/semiring.py): over K
+# same-structure SECP instances with the device forced on, swapping
+# the query kbest:5 -> marginal_map -> expectation on the SAME
+# instances compiles at most one new executable per (semiring,
+# level-pack bucket) — each query's compile count stays within
+# QUERY_BUDGET (the recorded per-query bucket count, with marginal
+# MAP allowed up to two blocks' worth since its waves split per ⊕)
+# — and repeating all three queries performs ZERO new compiles.
+# Results are cross-checked against the device='never' host-f64
+# runs: the kbest list exactly (per-component certificate + f64
+# re-evaluation), the marginal-MAP assignment exactly with its value
+# inside the reported bound, and e_cost/log_z inside theirs.
+QUERY_K = 4
+QUERY_BUDGET = 8  # recorded: kbest 5 / marginal_map 6 / expectation 5
+
 # memory-bounded contraction (ops/membound.py): an OVERLAP-zone SECP
 # (chained windows — the high-induced-width shape tiled zones can
 # never produce) solved with max_util_bytes forcing a cut set.  Cut
@@ -927,6 +942,119 @@ def run_semiring_guard() -> dict:
     return report
 
 
+def run_query_guard() -> dict:
+    """Compile budget for the structured-cell query pack (ISSUE 13,
+    module-constant comment at :data:`QUERY_K`)."""
+    from pydcop_tpu.api import infer_many
+    from pydcop_tpu.ops import semiring as sr_mod
+    from pydcop_tpu.telemetry import session
+
+    # cold start for the shared contraction-kernel cache (also DPOP's
+    # join cache — one object), same reason as the other guards
+    sr_mod._KERNELS.clear()
+
+    dcops = [
+        _build_secp(10, 8, 3, seed=60 + i) for i in range(QUERY_K)
+    ]
+    map_vars = ["l0", "l1", "l2"]
+    kw = dict(device="always", pad_policy="pow2")
+
+    def compiles(tel):
+        return int(tel.summary()["counters"].get("jit.compiles", 0))
+
+    with session() as t1:
+        kb = infer_many(dcops, "kbest:5", **kw)
+    with session() as t2:
+        mm = infer_many(
+            dcops, "marginal_map", map_vars=map_vars,
+            tol=float("inf"), **kw,
+        )
+    with session() as t3:
+        ex = infer_many(dcops, "expectation", tol=float("inf"), **kw)
+    with session() as t4:
+        infer_many(dcops, "kbest:5", **kw)
+        infer_many(
+            dcops, "marginal_map", map_vars=map_vars,
+            tol=float("inf"), **kw,
+        )
+        infer_many(dcops, "expectation", tol=float("inf"), **kw)
+    kb_c, mm_c, ex_c, repeat_c = (
+        compiles(t1), compiles(t2), compiles(t3), compiles(t4)
+    )
+    report = {
+        "kbest_compiles": kb_c,
+        "marginal_map_compiles": mm_c,
+        "expectation_compiles": ex_c,
+        "repeat_compiles": repeat_c,
+        "ok": True,
+        "kbest_costs": [r["costs"] for r in kb],
+        "device_nodes": sum(
+            r["device_nodes"] for r in kb + mm + ex
+        ),
+    }
+    if kb_c < 1 or any(r["device_nodes"] < 1 for r in kb):
+        report["ok"] = False
+        report["error"] = (
+            "the kbest query never reached the device — the guard "
+            "is vacuous (device='always' stopped forcing the path)"
+        )
+    elif max(kb_c, mm_c, ex_c) > QUERY_BUDGET:
+        report["ok"] = False
+        report["error"] = (
+            f"a query compiled more than QUERY_BUDGET="
+            f"{QUERY_BUDGET} executables (kbest {kb_c}, "
+            f"marginal_map {mm_c}, expectation {ex_c}) — more than "
+            "one executable per (semiring, level-pack bucket)"
+        )
+    elif repeat_c != 0:
+        report["ok"] = False
+        report["error"] = (
+            f"{repeat_c} new compile(s) on identical repeat queries "
+            "— the (semiring, bucket) kernel cache key is unstable"
+        )
+    else:
+        host_kb = infer_many(dcops, "kbest:5", device="never")
+        host_mm = infer_many(
+            dcops, "marginal_map", map_vars=map_vars, device="never"
+        )
+        host_ex = infer_many(dcops, "expectation", device="never")
+        for i in range(QUERY_K):
+            if kb[i]["costs"] != host_kb[i]["costs"] or [
+                s["assignment"] for s in kb[i]["solutions"]
+            ] != [s["assignment"] for s in host_kb[i]["solutions"]]:
+                report["ok"] = False
+                report["error"] = (
+                    f"instance {i}: device kbest diverges from host "
+                    "— the per-component certificate stopped holding"
+                )
+                break
+            if mm[i]["assignment"] != host_mm[i]["assignment"] or (
+                abs(mm[i]["value"] - host_mm[i]["value"])
+                > mm[i]["error_bound"] + 1e-9
+            ):
+                report["ok"] = False
+                report["error"] = (
+                    f"instance {i}: device marginal_map diverges "
+                    f"from host ({mm[i]['value']} vs "
+                    f"{host_mm[i]['value']}, bound "
+                    f"{mm[i]['error_bound']})"
+                )
+                break
+            if (
+                abs(ex[i]["log_z"] - host_ex[i]["log_z"])
+                > ex[i]["error_bound"] + 1e-9
+                or abs(ex[i]["e_cost"] - host_ex[i]["e_cost"]) > 1e-3
+            ):
+                report["ok"] = False
+                report["error"] = (
+                    f"instance {i}: device expectation diverges from "
+                    f"host (e_cost {ex[i]['e_cost']} vs "
+                    f"{host_ex[i]['e_cost']})"
+                )
+                break
+    return report
+
+
 def _build_secp_overlap(
     n_lights: int, n_models: int, levels: int, seed: int,
     arity: int = 4, stride: int = 2,
@@ -1081,6 +1209,7 @@ def main() -> int:
     report_sup = run_supervisor_guard()
     report_service = run_service_guard()
     report_semiring = run_semiring_guard()
+    report_query = run_query_guard()
     report_membound = run_membound_guard()
     report_restore = run_restore_guard()
     print(
@@ -1092,6 +1221,7 @@ def main() -> int:
                 "supervisor": report_sup,
                 "service": report_service,
                 "semiring": report_semiring,
+                "query": report_query,
                 "membound": report_membound,
                 "restore": report_restore,
             }
@@ -1105,6 +1235,7 @@ def main() -> int:
         and report_sup["ok"]
         and report_service["ok"]
         and report_semiring["ok"]
+        and report_query["ok"]
         and report_membound["ok"]
         and report_restore["ok"]
         else 1
