@@ -207,6 +207,30 @@ MB_CTL_ARITY = 4
 MB_CTL_BUDGET = 2048
 MB_REPS = 3
 
+# obs_overhead stage (ISSUE 14 acceptance): the serving observability
+# plane — the always-on flight-recorder ring (every span/event/counter
+# delta also lands on a bounded deque), wire trace propagation, and a
+# LIVE /metrics exporter being scraped throughout the burst — measured
+# as a tax on the solver service's request path: OBS_N in-process
+# submits per burst, OBS_REPS alternating on/off bursts per arm
+# against one warm service, median per-burst times compared.  "Off"
+# is a telemetry session with the flight ring disabled and no
+# exporter (the PR-7 baseline); "on" adds ring + exporter + scraper.
+# Bound: < 2% median overhead.  The
+# scrape cadence is 4 Hz — aggressive versus real Prometheus
+# deployments (15-60 s intervals) but bounded: rendering the full
+# registry is real CPU work, and on this box's 2 throttled vCPUs a
+# pathological 40 Hz scraper measurably competed with the solve
+# itself (6-7% "overhead" that was scrape CPU, not telemetry tax).
+OBS_N = 32
+OBS_PROBLEMS = 4
+OBS_VARS = 64
+OBS_ROUNDS = 32
+OBS_CHUNK = 32
+OBS_REPS = 20  # bursts PER ARM, alternating on/off
+OBS_BOUND_PCT = 2.0
+OBS_SCRAPE_INTERVAL = 0.25
+
 
 def _git_sha() -> str:
     try:
@@ -333,6 +357,7 @@ EVIDENCE_ROWS = [
     ("supervised_overhead", ["supervised_overhead_*"]),
     ("membound_secp", ["membound_secp_*"]),
     ("semiring_queries", ["semiring_queries_*"]),
+    ("serving_observability", ["serving_observability_*"]),
 ]
 
 
@@ -1203,6 +1228,157 @@ def _measure_supervised(phase_budget: float = 0.0) -> dict:
     return out
 
 
+def _measure_obs(phase_budget: float = 0.0) -> dict:
+    """Serving-observability overhead (ISSUE 14): exporter + flight
+    recorder on vs off.
+
+    OBS_REPS alternating on/off bursts run against ONE warm
+    :class:`~pydcop_tpu.engine.service.SolverService`: the "on" arm
+    is a full observability session (flight ring mirroring every
+    span/event/counter delta, a live ``/metrics`` exporter scraped at
+    ``1/OBS_SCRAPE_INTERVAL`` Hz — 4 Hz — by a background thread),
+    the "off" arm the PR-7 baseline session (ring off, no exporter).
+    Both arms pay the identical dispatch work — the delta is exactly
+    the telemetry plane; the statistic is the ratio of MEDIAN
+    per-burst times (outlier-robust, see the constants' comment).
+    Median overhead must stay under ``OBS_BOUND_PCT``.
+    """
+    import statistics
+    import tempfile
+    import threading
+
+    with _bounded_phase("import:jax", phase_budget):
+        import jax
+
+    with _bounded_phase("import:pydcop", phase_budget):
+        import __graft_entry__ as g
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+        from pydcop_tpu.engine.service import SolverService
+        from pydcop_tpu.telemetry import session as _tel_session
+        from pydcop_tpu.telemetry.export import (
+            MetricsExporter,
+            http_get,
+        )
+
+    _phase("problem_built")
+    base = [
+        g._make_coloring_dcop(
+            OBS_VARS - 2 * i, degree=DEGREE, seed=300 + i
+        )
+        for i in range(OBS_PROBLEMS)
+    ]
+    tmp = tempfile.mkdtemp(prefix="bench_obs_")
+    paths = []
+    for i, d in enumerate(base):
+        path = os.path.join(tmp, f"p{i}.yaml")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(dcop_yaml(d))
+        paths.append(path)
+    algo, params = "dsa", {"variant": "B", "probability": 0.7}
+    kw = dict(rounds=OBS_ROUNDS, chunk_size=OBS_CHUNK)
+
+    def burst(svc):
+        t0 = time.perf_counter()
+        pendings = [
+            svc.submit(
+                paths[i % OBS_PROBLEMS], algo, params, seed=i, **kw
+            )
+            for i in range(OBS_N)
+        ]
+        res = [p.result(300) for p in pendings]
+        return res, time.perf_counter() - t0
+
+    # ONE warm service serves both arms (its runner + compiled-problem
+    # caches stay hot, so a burst is pure request-path work); the arms
+    # differ only in the ambient telemetry plane around the burst.
+    # Individual ~0.15s bursts on this box's 2 throttled vCPUs carry
+    # ±10% scheduler-jitter outliers, so the statistic is the MEDIAN
+    # per-burst time of OBS_REPS alternating on/off bursts per arm —
+    # alternation spreads machine drift evenly across both arms and
+    # the median trims the outliers that poisoned ratio-of-pairs
+    # variants of this measurement.
+    svc = SolverService(
+        pad_policy="pow2", max_batch=OBS_N, max_wait=0.25
+    )
+    scrapes = [0]
+
+    def one_burst(obs_on: bool):
+        stop = threading.Event()
+        with _tel_session(flight=obs_on) as tel:
+            exporter = scraper = None
+            if obs_on:
+                exporter = MetricsExporter(
+                    tel.metrics.snapshot,
+                    svc.health,
+                )
+                url = "http://%s:%d/metrics" % exporter.address
+
+                def poll():
+                    while not stop.is_set():
+                        try:
+                            http_get(url, timeout=2)
+                            scrapes[0] += 1
+                        except OSError:
+                            pass
+                        stop.wait(OBS_SCRAPE_INTERVAL)
+
+                scraper = threading.Thread(
+                    target=poll, daemon=True
+                )
+                scraper.start()
+            try:
+                return burst(svc)
+            finally:
+                stop.set()
+                if scraper is not None:
+                    scraper.join(5)
+                if exporter is not None:
+                    exporter.close()
+
+    with _bounded_phase("xla_compile", phase_budget):
+        one_burst(False)  # cold: vmapped-runner compiles
+        one_burst(True)  # warm settle, both arm shapes
+
+    _phase("measure:obs_overhead")
+    on_dts, off_dts = [], []
+    res_on = res_off = None
+    for rep in range(OBS_REPS):
+        if rep % 2 == 0:
+            res_on, dt_on = one_burst(True)
+            res_off, dt_off = one_burst(False)
+        else:
+            res_off, dt_off = one_burst(False)
+            res_on, dt_on = one_burst(True)
+        on_dts.append(dt_on)
+        off_dts.append(dt_off)
+    svc.close()
+    total_scrapes = scrapes[0]
+    on_med = statistics.median(on_dts)
+    off_med = statistics.median(off_dts)
+    overhead_pct = round((on_med / off_med - 1.0) * 100.0, 2)
+    results_match = all(
+        a["cost"] == b["cost"] and a["assignment"] == b["assignment"]
+        for a, b in zip(res_on, res_off)
+    )
+    out = {
+        "platform": jax.devices()[0].platform,
+        "n_requests": OBS_N,
+        "n_problems": OBS_PROBLEMS,
+        "n_vars": OBS_VARS,
+        "rounds": OBS_ROUNDS,
+        "reps": OBS_REPS,
+        "bound_pct": OBS_BOUND_PCT,
+        "burst_s_observability_on": round(on_med, 4),
+        "burst_s_observability_off": round(off_med, 4),
+        "overhead_pct": overhead_pct,
+        "scrapes": total_scrapes,
+        "results_match": results_match,
+        "ok": overhead_pct < OBS_BOUND_PCT and results_match,
+    }
+    _phase("measured")
+    return out
+
+
 def _measure_service(phase_budget: float = 0.0) -> dict:
     """Continuous-batching service throughput vs sequential api.solve.
 
@@ -1463,6 +1639,7 @@ def _inner_main() -> None:
     p.add_argument("--semiring_stage", action="store_true")
     p.add_argument("--semiring_queries_stage", action="store_true")
     p.add_argument("--membound_stage", action="store_true")
+    p.add_argument("--obs_stage", action="store_true")
     a = p.parse_args()
     import jax
 
@@ -1477,7 +1654,9 @@ def _inner_main() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # older jax: cache flags absent — correctness unaffected
-    if a.membound_stage:
+    if a.obs_stage:
+        metrics = _measure_obs(a.phase_budget)
+    elif a.membound_stage:
         metrics = _measure_membound(a.phase_budget)
     elif a.semiring_queries_stage:
         metrics = _measure_semiring_queries(a.phase_budget)
@@ -1501,6 +1680,7 @@ def _run_sub(
     many: bool = False, dpop: bool = False, supervised: bool = False,
     service: bool = False, semiring: bool = False,
     semiring_queries: bool = False, membound: bool = False,
+    obs: bool = False,
 ) -> dict:
     """Run ``bench.py --inner`` in a subprocess; parse its JSON line.
 
@@ -1539,7 +1719,8 @@ def _run_sub(
                 if semiring_queries
                 else []
             )
-            + (["--membound_stage"] if membound else []),
+            + (["--membound_stage"] if membound else [])
+            + (["--obs_stage"] if obs else []),
             env=env,
             cwd=REPO,
             capture_output=True,
@@ -1933,6 +2114,44 @@ def main() -> None:
             util_cells_per_sec=membound.get("util_cells_per_sec"),
         )
 
+    # serving-observability overhead (telemetry/flightrec.py +
+    # telemetry/export.py): flight recorder + live /metrics exporter
+    # on vs off on the service request path — the ISSUE 14 < 2%
+    # bound.  Same platform policy as the stages above.
+    obs = _run_sub(pin_cpu=False, timeout=300.0, n_vars=0,
+                   rounds=0, obs=True)
+    if "error" in obs:
+        obs = _run_sub(pin_cpu=True, timeout=300.0, n_vars=0,
+                       rounds=0, obs=True)
+    if "error" in obs:
+        errors.append(f"obs_overhead stage: {obs['error']}")
+        obs = None
+    elif not obs.get("ok", False):
+        errors.append(
+            "obs_overhead over bound: "
+            + json.dumps(
+                {
+                    k: obs.get(k)
+                    for k in (
+                        "overhead_pct", "bound_pct",
+                        "results_match",
+                    )
+                }
+            )
+        )
+    elif obs.get("platform") == "tpu":
+        # durable evidence row (msgs_per_sec=None: the stage reports
+        # an overhead percentage on the serving path)
+        append_tpu_log(
+            f"serving_observability_{OBS_N}",
+            None,
+            source="bench_stage_obs_overhead",
+            overhead_pct=obs.get("overhead_pct"),
+            scrapes=obs.get("scrapes"),
+            burst_s_on=obs.get("burst_s_observability_on"),
+            burst_s_off=obs.get("burst_s_observability_off"),
+        )
+
     # supervised-dispatch no-fault overhead (engine/supervisor.py):
     # dsa/maxsum hot loops under the default supervisor vs bare
     # dispatch — the <2% acceptance bound of the robustness layer.
@@ -2023,6 +2242,17 @@ def main() -> None:
                 "overload", "ok",
             )
             if k in service
+        }
+    if obs is not None:
+        out["obs_overhead"] = {
+            k: obs[k]
+            for k in (
+                "platform", "n_requests", "n_vars", "rounds", "reps",
+                "bound_pct", "burst_s_observability_on",
+                "burst_s_observability_off", "overhead_pct",
+                "scrapes", "results_match", "ok",
+            )
+            if k in obs
         }
     if supervised is not None:
         out["supervised_overhead"] = {

@@ -36,11 +36,17 @@ COMMANDS = [
     "worker",
     # resident continuous-batching solver service (docs/serving.md)
     "serve",
+    # live terminal view of a serve --metrics_port exporter
+    # (docs/observability.md, "Serving observability")
+    "top",
     # graftlint invariant checks (tools/graftlint, docs/linting.md)
     "lint",
     # telemetry trace aggregation (module trace_summary registers the
     # subcommand as `trace-summary`)
     "trace_summary",
+    # flight-recorder dump renderer (module flight_dump registers the
+    # subcommand as `flight-dump`)
+    "flight_dump",
 ]
 
 

@@ -108,6 +108,24 @@ def set_parser(subparsers) -> None:
         "service",
     )
     p.add_argument(
+        "--metrics_port", type=int, default=None, metavar="PORT",
+        help="serve GET /metrics (Prometheus text exposition of the "
+        "full registry) and GET /healthz (queue depth, in-flight, "
+        "drain state) on this port (0 = ephemeral; the bound port is "
+        "printed on the serving line as \"metrics\"); poll it live "
+        "with `pydcop_tpu top` (docs/observability.md)",
+    )
+    p.add_argument(
+        "--flight_dump", default=None, metavar="FILE",
+        help="dump the always-on flight-recorder ring (recent spans/"
+        "events/counter deltas, bounded — no trace file needed) "
+        "atomically to FILE whenever a request is shed or "
+        "quarantined, a dispatch fails, or the service drains "
+        "(SIGTERM included), the triggering request's trace id "
+        "front and center; render with `pydcop_tpu flight-dump "
+        "FILE` (docs/observability.md)",
+    )
+    p.add_argument(
         "--chaos", default=None, metavar="SPEC",
         help="inject deterministic DEVICE-layer faults into every "
         "dispatch (device_oom=W[:R], device_transient=P[:AFTER], "
@@ -139,7 +157,7 @@ def run_cmd(args) -> int:
         enable_persistent_compilation_cache(args.compile_cache)
 
     stats = None
-    with session(args.trace, args.trace_format):
+    with session(args.trace, args.trace_format) as tel:
         try:
             service = SolverService(
                 pad_policy=args.pad_policy,
@@ -154,6 +172,7 @@ def run_cmd(args) -> int:
                 max_queue=args.max_queue,
                 session_checkpoint=args.session_checkpoint,
                 resume=args.resume,
+                flight_dump=args.flight_dump,
             )
         except ValueError as e:
             # flag/spec usage errors exit cleanly, like the sibling
@@ -164,12 +183,30 @@ def run_cmd(args) -> int:
         except RuntimeError as e:
             raise SystemExit(f"serve: {e}")
         server = None
+        exporter = None
         prev_term = None
         try:
             server = ServiceServer(
                 service, host=args.host, port=args.port,
                 max_inflight=args.max_inflight,
             )
+            if args.metrics_port is not None:
+                from pydcop_tpu.telemetry.export import MetricsExporter
+
+                srv = server
+
+                def _health():
+                    return {
+                        **service.health(),
+                        "inflight": srv.inflight(),
+                    }
+
+                exporter = MetricsExporter(
+                    tel.metrics.snapshot,
+                    _health,
+                    host=args.host,
+                    port=args.metrics_port,
+                )
             import os
 
             # SIGTERM = "drain and go": the handler only flips the
@@ -180,18 +217,16 @@ def run_cmd(args) -> int:
                 signal.SIGTERM,
                 lambda *_: server.request_shutdown(),
             )
-            print(
-                json.dumps(
-                    {
-                        "serving": "%s:%d" % server.address,
-                        "pid": os.getpid(),
-                        "sessions_restored": service.stats()[
-                            "sessions_restored"
-                        ],
-                    }
-                ),
-                flush=True,
-            )
+            head = {
+                "serving": "%s:%d" % server.address,
+                "pid": os.getpid(),
+                "sessions_restored": service.stats()[
+                    "sessions_restored"
+                ],
+            }
+            if exporter is not None:
+                head["metrics"] = "%s:%d" % exporter.address
+            print(json.dumps(head), flush=True)
             try:
                 # the global -t/--timeout doubles as a serve
                 # duration bound (handy for scripted benches/tests)
@@ -217,6 +252,11 @@ def run_cmd(args) -> int:
                     if server is not None:
                         server.close()
                 finally:
+                    if exporter is not None:
+                        # last out: /healthz keeps answering
+                        # "draining" for the whole graceful drain
+                        # above, then the scrape endpoint goes away
+                        exporter.close()
                     if prev_term is not None:
                         signal.signal(signal.SIGTERM, prev_term)
                     stats = service.stats()

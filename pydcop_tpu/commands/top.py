@@ -1,0 +1,163 @@
+"""``pydcop_tpu top`` — live terminal view of a serving process.
+
+Polls a ``serve --metrics_port`` exporter's ``/metrics`` and
+``/healthz`` endpoints (``telemetry/export.py``,
+``docs/observability.md`` "Serving observability") and renders the
+serving vitals in place: health/drain state, queue depth, request /
+tick / shed counters with per-interval rates, and the latency
+histogram percentiles.  ``--count 1`` prints one snapshot and exits
+(scriptable); the default loops until Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "top",
+        help="live terminal view of a running serve --metrics_port "
+        "process: polls /metrics + /healthz into request/shed rates, "
+        "queue depth and latency percentiles "
+        "(docs/observability.md)",
+    )
+    p.add_argument(
+        "address",
+        help="the exporter address: host:port or a full http:// URL "
+        "(the serving line of `pydcop_tpu serve --metrics_port` "
+        "prints it)",
+    )
+    p.add_argument(
+        "--interval", type=float, default=2.0, metavar="SECONDS",
+        help="poll interval (default 2s)",
+    )
+    p.add_argument(
+        "--count", type=int, default=0, metavar="N",
+        help="stop after N polls (0 = until Ctrl-C); --count 1 is "
+        "the scriptable one-shot snapshot",
+    )
+    p.set_defaults(func=run_cmd)
+
+
+#: the headline counter rows, in display order (raw exported names —
+#: the `pydcop_` prefix and `_total` suffix are added by the exporter)
+_HEADLINE_COUNTERS = (
+    ("service_requests", "requests"),
+    ("service_ticks", "ticks"),
+    ("service_dispatches", "dispatches"),
+    ("service_coalesced", "coalesced"),
+    ("service_shed", "shed"),
+    ("service_errors", "errors"),
+    ("service_replayed_replies", "replayed"),
+    ("service_frames_rejected", "frames_rejected"),
+    ("telemetry_flight_dumps", "flight_dumps"),
+)
+
+_HIST_ROWS = (
+    ("service_queue_wait_s", "queue_wait_s"),
+    ("service_latency_s", "latency_s"),
+    ("service_shed_latency_s", "shed_latency_s"),
+    ("service_batch_occupancy", "occupancy"),
+)
+
+
+def _base_url(address: str) -> str:
+    if address.startswith(("http://", "https://")):
+        return address.rstrip("/")
+    return "http://" + address
+
+
+def format_top(
+    metrics: dict, health: dict, rates: dict
+) -> str:
+    """One rendered frame from parsed /metrics + /healthz (split out
+    for tests)."""
+    lines = []
+    status = health.get("status", "?")
+    lines.append(
+        f"serve: status={status} queue_depth="
+        f"{health.get('queue_depth', '?')} inflight="
+        f"{health.get('inflight', '?')} sessions="
+        f"{health.get('sessions', '?')}"
+    )
+    lines.append("")
+    lines.append(f"{'counter':<18}{'total':>12}{'per_sec':>10}")
+    from pydcop_tpu.telemetry.export import PREFIX
+
+    for raw, label in _HEADLINE_COUNTERS:
+        key = PREFIX + raw + "_total"
+        if key not in metrics:
+            continue
+        rate = rates.get(key)
+        lines.append(
+            f"{label:<18}{int(metrics[key]):>12}"
+            + (f"{rate:>10.1f}" if rate is not None else f"{'-':>10}")
+        )
+    hist_lines = []
+    for raw, label in _HIST_ROWS:
+        count_key = PREFIX + raw + "_count"
+        if count_key not in metrics:
+            continue
+        row = f"{label:<18}{int(metrics[count_key]):>8}"
+        for q in ("p50", "p90", "p99"):
+            v = metrics.get(f"{PREFIX}{raw}_{q}")
+            row += (
+                f"  {q}={v:g}" if v is not None else f"  {q}=-"
+            )
+        hist_lines.append(row)
+    if hist_lines:
+        lines.append("")
+        lines.append(f"{'histogram':<18}{'count':>8}  percentiles")
+        lines.extend(hist_lines)
+    return "\n".join(lines)
+
+
+def run_cmd(args) -> int:
+    from pydcop_tpu.telemetry.export import (
+        http_get,
+        parse_prometheus_text,
+    )
+
+    base = _base_url(args.address)
+    if args.interval <= 0:
+        raise SystemExit("top: --interval must be > 0")
+    prev: dict = {}
+    prev_t = None
+    polls = 0
+    try:
+        while True:
+            try:
+                metrics = parse_prometheus_text(
+                    http_get(base + "/metrics")
+                )
+                health = json.loads(http_get(base + "/healthz"))
+            except (OSError, ValueError) as e:
+                raise SystemExit(
+                    f"top: cannot scrape {base}: {e}"
+                )
+            now = time.perf_counter()
+            rates = {}
+            if prev_t is not None:
+                dt = max(now - prev_t, 1e-9)
+                rates = {
+                    k: (v - prev.get(k, 0.0)) / dt
+                    for k, v in metrics.items()
+                    if isinstance(v, (int, float))
+                    and k.endswith("_total")
+                }
+            frame = format_top(metrics, health, rates)
+            if polls and sys.stdout.isatty():
+                # redraw in place on a live terminal; plain append
+                # otherwise (pipes/tests get one frame per poll)
+                print("\x1b[2J\x1b[H", end="")
+            print(frame, flush=True)
+            polls += 1
+            prev, prev_t = metrics, now
+            if args.count and polls >= args.count:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0

@@ -15,9 +15,23 @@ def set_parser(subparsers) -> None:
     p = subparsers.add_parser(
         "trace-summary",
         help="aggregate a --trace telemetry file (per-phase / "
-        "per-agent totals)",
+        "per-agent totals); --requests stitches several files into "
+        "per-request timelines by wire-propagated trace id",
     )
-    p.add_argument("trace_file", help="trace file (jsonl or chrome)")
+    p.add_argument(
+        "trace_file", nargs="+",
+        help="trace file(s) (jsonl or chrome); the default summary "
+        "reads the first, --requests correlates ALL of them (e.g. a "
+        "client-side trace plus the server's)",
+    )
+    p.add_argument(
+        "--requests", action="store_true", dest="as_requests",
+        help="stitch one correlated timeline per request across the "
+        "given trace files: client attempt spans and server "
+        "queue/dispatch/phase spans joined on the trace id the wire "
+        "protocol propagates (docs/observability.md, 'Serving "
+        "observability')",
+    )
     p.add_argument(
         "--json", action="store_true", dest="as_json",
         help="print the aggregates as JSON instead of a table",
@@ -27,21 +41,37 @@ def set_parser(subparsers) -> None:
 
 def run_cmd(args) -> int:
     from pydcop_tpu.telemetry.summary import (
+        format_requests,
         format_summary,
         load_trace,
+        stitch_requests,
         summarize,
     )
 
     try:
-        records = load_trace(args.trace_file)
+        tracesets = [load_trace(p) for p in args.trace_file]
     except (OSError, ValueError) as e:
         raise SystemExit(f"trace-summary: {e}")
-    s = summarize(records)
-    out = (
-        json.dumps(s, indent=2, default=str)
-        if args.as_json
-        else format_summary(s)
-    )
+    if args.as_requests:
+        stitched = stitch_requests(tracesets)
+        out = (
+            json.dumps(stitched, indent=2, default=str)
+            if args.as_json
+            else format_requests(stitched)
+        )
+    else:
+        if len(tracesets) > 1:
+            raise SystemExit(
+                "trace-summary: several trace files only combine "
+                "under --requests (the aggregate summary is "
+                "per-process — run it per file)"
+            )
+        s = summarize(tracesets[0])
+        out = (
+            json.dumps(s, indent=2, default=str)
+            if args.as_json
+            else format_summary(s)
+        )
     print(out)
     if getattr(args, "output", None):
         with open(args.output, "w") as f:

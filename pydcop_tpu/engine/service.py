@@ -97,6 +97,25 @@ per-group ``service.dispatch`` spans / a final ``service.drain`` span
 that ``pydcop_tpu trace-summary`` folds into queue-wait / occupancy /
 latency percentiles plus shed/retry/drain rows.
 
+Serving observability (ISSUE 14, ``docs/observability.md`` "Serving
+observability"): every request carries a TRACE CONTEXT — the wire
+client mints a trace id (stable across idempotent resends) + a
+per-attempt span id (``telemetry/context.py``) and the service tags
+its spans, the group dispatch, and every supervisor event inside it
+with the id(s); in-process submits get a service-minted id
+deterministic in admission order.  Every reply returns a per-request
+PHASE BREAKDOWN (``result["phases"]``: admission / queue / compile /
+device / decode / reply_write — contiguous segments whose sum is the
+server-side share of the client latency), and ``trace-summary
+--requests`` stitches client + server trace files into one correlated
+timeline per request.  On a shed / quarantine / dispatch-error /
+drain trigger the session's always-on flight-recorder ring is dumped
+(``flight_dump=``/``serve --flight_dump``), the triggering request's
+trace id front and center; ``serve --metrics_port`` exposes the live
+registry as ``/metrics`` (Prometheus text) + ``/healthz``
+(:meth:`SolverService.health` — flips to ``draining`` during a
+graceful shutdown).
+
 This module is import-light by design: jax (and the batched engine)
 load on first dispatch, not at import, so ``api.ServiceClient`` stays
 usable from jax-free client processes.
@@ -115,7 +134,18 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Mapping, Optional, Tuple, Union
 
-from pydcop_tpu.telemetry import get_metrics, get_tracer
+from pydcop_tpu.telemetry import (
+    get_flight_recorder,
+    get_metrics,
+    get_tracer,
+)
+from pydcop_tpu.telemetry.context import (
+    attempt_span_id,
+    mint_trace_id,
+    parse_wire_trace,
+    trace_scope,
+    wire_trace,
+)
 from pydcop_tpu.telemetry.summary import _percentile
 
 #: queue-wait / latency histogram buckets (seconds) — service
@@ -274,6 +304,22 @@ class _Request:
     # never mixes sweeps across queries
     query: Optional[str] = None
     infer_kw: Optional[Dict[str, Any]] = None
+    # request trace context (telemetry/context.py): the wire client's
+    # trace id + per-attempt span id, or a service-minted id for
+    # in-process submits — what correlates this request's spans
+    # across processes and client retries
+    trace_id: Optional[str] = None
+    trace_span: Optional[str] = None
+    trace_attempt: int = 1
+    # phase-breakdown timestamps (docs/observability.md, "Serving
+    # observability"): contiguous segments from submit entry to
+    # result delivery, attached to the reply as result["phases"]
+    t_sub: float = 0.0  # submit() entry
+    admit_s: float = 0.0  # submit entry -> enqueued
+    dispatch_t: float = 0.0  # this request's group started processing
+    compile_s: float = 0.0  # problem compile + stack/pad, pre-device
+    device_s: float = 0.0  # the device (or host-solve) run
+    decode_t0: float = 0.0  # run done; decode runs until _finish
 
 
 class _Session:
@@ -371,6 +417,7 @@ class SolverService:
         max_queue: int = 1024,
         session_checkpoint: Optional[str] = None,
         resume: bool = False,
+        flight_dump: Optional[str] = None,
         autostart: bool = True,
     ):
         from pydcop_tpu.ops.padding import as_pad_policy
@@ -397,6 +444,19 @@ class SolverService:
             )
         self.max_queue = max_queue
         self.session_checkpoint = session_checkpoint
+        # flight-recorder dump target: on a shed / quarantine /
+        # dispatch-error / drain trigger the session's always-on ring
+        # (telemetry/flightrec.py) is dumped here atomically, the
+        # triggering request's trace id front and center.  Dumps are
+        # throttled (below) — a sustained overload shedding hundreds
+        # of requests/sec must not amplify itself into hundreds of
+        # full-ring serializations/sec of the same overwritten file
+        self.flight_dump = flight_dump
+        self._flight_last = 0.0
+        # per-service request ordinal: mints DETERMINISTIC trace ids
+        # (pure in admission order) for in-process submits that carry
+        # no wire trace context
+        self._trace_ordinal = 0
 
         plan = None
         if chaos:
@@ -538,6 +598,71 @@ class SolverService:
                 time.perf_counter() - t0,
                 sessions=len(self._sessions),
             )
+        # the drain itself is a flight trigger: the last thing a
+        # terminating service leaves behind is its recent timeline
+        self._flight_trigger("drain", None)
+
+    #: minimum seconds between non-drain flight dumps: the FIRST
+    #: trigger of a failure episode captures the interesting window;
+    #: later triggers inside the interval would serialize the same
+    #: ~4096-record ring again only to overwrite the file.  Under a
+    #: shed storm this caps the dump cost at one write per interval
+    #: instead of one per rejected request (a drain always dumps —
+    #: it is the terminal artifact).
+    _FLIGHT_DUMP_MIN_INTERVAL_S = 1.0
+
+    def _flight_trigger(
+        self, trigger: str, trace_id: Optional[str]
+    ) -> None:
+        """Dump the session's flight-recorder ring (when a dump path
+        is configured and a session is active), throttled per
+        ``_FLIGHT_DUMP_MIN_INTERVAL_S``.  Best-effort: the recorder
+        must never take down the path that triggered it."""
+        if not self.flight_dump:
+            return
+        rec = get_flight_recorder()
+        if not rec.enabled:
+            return
+        now = time.perf_counter()
+        if (
+            trigger != "drain"
+            and now - self._flight_last
+            < self._FLIGHT_DUMP_MIN_INTERVAL_S
+        ):
+            return
+        self._flight_last = now
+        try:
+            rec.dump(self.flight_dump, trigger, trace_id=trace_id)
+        except OSError as e:
+            print(
+                f"service: flight dump failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+
+    def health(self) -> Dict[str, Any]:
+        """The ``/healthz`` document (``telemetry/export.py``):
+        liveness + readiness at a glance.  ``status`` flips ``ok`` →
+        ``draining`` the moment a graceful shutdown starts and
+        ``drained`` once the queue has fully delivered."""
+        with self._cond:
+            depth = len(self._queue)
+            closing = self._closing
+            drained = self._drained
+            sessions = len(self._sessions)
+        status = (
+            "drained" if drained else "draining" if closing else "ok"
+        )
+        with self._stats_lock:
+            return {
+                "status": status,
+                "queue_depth": depth,
+                "sessions": sessions,
+                "requests": self._n_requests,
+                "shed": self._n_shed,
+                "errors": self._n_errors,
+                "drained": drained,
+            }
 
     def __enter__(self) -> "SolverService":
         self.start()
@@ -563,6 +688,7 @@ class SolverService:
         session: Optional[str] = None,
         set_values: Optional[Mapping[str, Any]] = None,
         max_util_bytes: Optional[int] = None,
+        trace: Optional[Mapping[str, Any]] = None,
     ) -> PendingResult:
         """Admit one solve request; returns a :class:`PendingResult`.
 
@@ -576,10 +702,13 @@ class SolverService:
         plan — DPOP) caps the request's largest UTIL table via the
         memory-bounded contraction planner (``ops/membound.py``) —
         it folds into the algorithm params, so it also partitions
-        dispatch groups like any other param.  Validation errors
-        raise HERE (before admission); dispatch errors surface from
-        ``PendingResult.result()``.
+        dispatch groups like any other param.  ``trace`` is the wire
+        client's trace context (``telemetry/context.py`` wire form);
+        omitted, the service mints a deterministic id at admission.
+        Validation errors raise HERE (before admission); dispatch
+        errors surface from ``PendingResult.result()``.
         """
+        t_sub = time.perf_counter()
         with self._cond:
             if self._closing:
                 raise ServiceError("service is closed")
@@ -656,8 +785,27 @@ class SolverService:
             set_values=dict(set_values) if set_values else None,
             pending=PendingResult(),
             dcop_src=_dcop_source(dcop),
+            t_sub=t_sub,
         )
+        self._apply_trace(req, trace)
         return self._admit(req)
+
+    def _apply_trace(
+        self, req: _Request, trace: Optional[Mapping[str, Any]]
+    ) -> None:
+        """Attach the request's trace context: the wire client's when
+        the frame carried one, else a service-minted id that is pure
+        in the per-service admission ordinal (so in-process traffic
+        stitches and replays deterministically too)."""
+        parsed = parse_wire_trace(trace)
+        if parsed is not None:
+            req.trace_id, req.trace_span, req.trace_attempt = parsed
+            return
+        with self._stats_lock:
+            self._trace_ordinal += 1
+            ordinal = self._trace_ordinal
+        req.trace_id = mint_trace_id("local", ordinal)
+        req.trace_span = attempt_span_id(req.trace_id, 1)
 
     def _admit(self, req: _Request) -> PendingResult:
         """The one admission tail (solve and infer requests share it):
@@ -666,6 +814,9 @@ class SolverService:
         if met.enabled:
             met.inc("service.requests")
         t_admit = time.perf_counter()
+        if not req.t_sub:
+            req.t_sub = t_admit
+        req.admit_s = t_admit - req.t_sub
         shed_reason = None
         depth = 0
         with self._cond:
@@ -699,6 +850,7 @@ class SolverService:
             Mapping[str, Mapping[Any, float]]
         ] = None,
         max_util_bytes: Optional[int] = None,
+        trace: Optional[Mapping[str, Any]] = None,
     ) -> PendingResult:
         """Admit one inference request (``docs/semirings.md``): the
         semiring contraction queries — ``marginals`` / ``log_z`` /
@@ -711,6 +863,7 @@ class SolverService:
         sequential ``api.infer`` calls) and never mixes sweeps across
         queries.  Validation errors raise here; dispatch errors
         surface from ``PendingResult.result()``."""
+        t_sub = time.perf_counter()
         with self._cond:
             if self._closing:
                 raise ServiceError("service is closed")
@@ -794,6 +947,8 @@ class SolverService:
                 ),
             },
         )
+        req.t_sub = t_sub
+        self._apply_trace(req, trace)
         return self._admit(req)
 
     def solve(self, *args, **kwargs) -> Dict[str, Any]:
@@ -850,6 +1005,7 @@ class SolverService:
             tr.event(
                 "service-shed", cat="service", reason=reason,
                 algo=req.algo, queue_depth=depth,
+                trace=req.trace_id,
             )
         with self._stats_lock:
             self._n_shed += 1
@@ -860,8 +1016,13 @@ class SolverService:
                 "shed_reason": reason,
                 "queue_depth": depth,
                 "algo": req.algo,
+                "trace": req.trace_id,
+                # a shed never reaches dispatch: the whole breakdown
+                # is the admission segment
+                "phases": {"admission": req.admit_s + latency},
             }
         )
+        self._flight_trigger("shed", req.trace_id)
 
     # -- wire-server hooks (ServiceServer bookkeeping) -------------------
 
@@ -1267,6 +1428,7 @@ class SolverService:
                 tr.add_span(
                     "service.queue-wait", "service", req.enqueue_t,
                     req.queue_wait, algo=req.algo,
+                    trace=req.trace_id,
                 )
         with self._stats_lock:
             self._n_ticks += 1
@@ -1334,10 +1496,35 @@ class SolverService:
     ) -> None:
         met = get_metrics()
         tr = get_tracer()
-        latency = time.perf_counter() - req.enqueue_t
+        now = time.perf_counter()
+        latency = now - req.enqueue_t
         result["queue_wait"] = req.queue_wait
         result["instances_batched"] = group_n
         result.pop("telemetry", None)  # service-level, not per-request
+        result["trace"] = req.trace_id
+        # the per-request phase breakdown (docs/observability.md,
+        # "Serving observability"): contiguous segments from submit
+        # entry to this delivery — their sum is the server-side share
+        # of the client-observed latency.  `queue` runs from enqueue
+        # to the request's GROUP starting to process (so a late group
+        # in a multi-group tick reports its true wait, not the tick's
+        # start), `decode` from the device sync to this delivery.
+        phases = {
+            "admission": round(req.admit_s, 6),
+            "queue": round(
+                max(
+                    (req.dispatch_t or now) - req.enqueue_t, 0.0
+                ),
+                6,
+            ),
+            "compile": round(req.compile_s, 6),
+            "device": round(req.device_s, 6),
+            "decode": round(
+                max(now - req.decode_t0, 0.0) if req.decode_t0 else 0.0,
+                6,
+            ),
+        }
+        result["phases"] = phases
         if met.enabled:
             met.observe(
                 "service.latency_s", latency, buckets=_LATENCY_BUCKETS
@@ -1347,13 +1534,20 @@ class SolverService:
         if tr.enabled:
             tr.add_span(
                 "service.request", "service", req.enqueue_t, latency,
-                algo=req.algo, instances=group_n, status=result.get("status"),
+                algo=req.algo, instances=group_n,
+                status=result.get("status"), trace=req.trace_id,
+                phases=phases,
             )
         with self._stats_lock:
             self._latencies.append(latency)
             if group_n > 1:
                 self._n_coalesced += 1
         req.pending._set_result(result)
+        if result.get("status") == "degraded":
+            # a quarantined lane: the evidence of WHY (the nan_inject
+            # fault event, the supervisor actions, the batchmates'
+            # spans) is on the ring right now — dump it
+            self._flight_trigger("quarantine", req.trace_id)
 
     def _fail(self, reqs: List[_Request], error: BaseException) -> None:
         # a partition can span several stacked groups; groups that
@@ -1369,6 +1563,8 @@ class SolverService:
                 "service-error", cat="service",
                 error=f"{type(error).__name__}: {error}"[:300],
                 requests=len(reqs),
+                trace=[r.trace_id for r in reqs if r.trace_id]
+                or None,
             )
         if met.enabled:
             met.inc("service.errors", len(reqs))
@@ -1381,6 +1577,10 @@ class SolverService:
                     f"{type(error).__name__}: {error}"
                 )
             )
+        # an unrecoverable dispatch is the flight recorder's reason to
+        # exist: the failing group's spans + supervisor events are on
+        # the ring, the reply only carries the error string
+        self._flight_trigger("error", reqs[0].trace_id)
 
     def _record_dispatch(self, k: int, padded: int) -> None:
         met = get_metrics()
@@ -1404,8 +1604,16 @@ class SolverService:
 
         tr = get_tracer()
         r0 = part[0]
+        # phase attribution: the first group's `compile` segment opens
+        # at the partition's problem-compile, later groups' at their
+        # own iteration start (their wait behind earlier groups'
+        # device runs is queue time, which `dispatch_t` delimits)
+        t_part0 = time.perf_counter()
         problems = [self._compiled_problem(r) for r in part]
+        first_group = True
         for stacked in stack_problems(problems):
+            g0 = t_part0 if first_group else time.perf_counter()
+            first_group = False
             group = [part[i] for i in stacked.indices]
             k = len(group)
             # occupancy bucketing: pad the group to a pow-2 instance
@@ -1436,21 +1644,33 @@ class SolverService:
             if padded:
                 params_list = params_list + [params_list[-1]] * padded
                 seeds = seeds + [seeds[-1]] * padded
-            with tr.span(
-                "service.dispatch", cat="service", instances=k,
-                padded=padded, algo=r0.algo,
-            ):
-                results = run_many_batched(
-                    stacked,
-                    module,
-                    params_list,
-                    rounds=r0.rounds,
-                    seeds=seeds,
-                    timeout=run_timeout,
-                    chunk_size=r0.chunk_size,
-                    convergence_chunks=r0.convergence_chunks,
-                    n_restarts=r0.n_restarts,
-                )
+            t_run0 = time.perf_counter()
+            for req in group:
+                req.dispatch_t = g0
+                req.compile_s = t_run0 - g0
+            # every span/event recorded inside the dispatch — the
+            # dispatch span itself, supervisor retries/faults,
+            # quarantine events — tags with the group's trace ids
+            with trace_scope([g.trace_id for g in group]):
+                with tr.span(
+                    "service.dispatch", cat="service", instances=k,
+                    padded=padded, algo=r0.algo,
+                ):
+                    results = run_many_batched(
+                        stacked,
+                        module,
+                        params_list,
+                        rounds=r0.rounds,
+                        seeds=seeds,
+                        timeout=run_timeout,
+                        chunk_size=r0.chunk_size,
+                        convergence_chunks=r0.convergence_chunks,
+                        n_restarts=r0.n_restarts,
+                    )
+            t_done = time.perf_counter()
+            for req in group:
+                req.device_s = t_done - t_run0
+                req.decode_t0 = t_done
             for req, rr in zip(group, results):  # pads fall off zip
                 out = _result_dict(rr)
                 out["time"] = rr.time / k
@@ -1473,17 +1693,29 @@ class SolverService:
                 0.01,
             )
         self._record_dispatch(k, 0)
-        with tr.span(
-            "service.dispatch", cat="service", instances=k,
-            padded=0, algo=r0.algo,
-        ):
-            results = run_many_host(
-                [g.dcop for g in part],
-                module,
-                [g.params for g in part],
-                timeout=run_timeout,
-                pad_policy=self.pad_policy,
-            )
+        t_run0 = time.perf_counter()
+        for req in part:
+            # host-path phase attribution: compile (dcop -> tables)
+            # happens inside run_many_host, inseparable from the
+            # sweep — the whole call is the `device` segment
+            req.dispatch_t = t_run0
+            req.compile_s = 0.0
+        with trace_scope([g.trace_id for g in part]):
+            with tr.span(
+                "service.dispatch", cat="service", instances=k,
+                padded=0, algo=r0.algo,
+            ):
+                results = run_many_host(
+                    [g.dcop for g in part],
+                    module,
+                    [g.params for g in part],
+                    timeout=run_timeout,
+                    pad_policy=self.pad_policy,
+                )
+        t_done = time.perf_counter()
+        for req in part:
+            req.device_s = t_done - t_run0
+            req.decode_t0 = t_done
         for req, out in zip(part, results):
             self._finish(req, out, out.get("instances_batched", k))
 
@@ -1554,24 +1786,33 @@ class SolverService:
             )
         self._record_dispatch(k, 0)
         mv = kw["map_vars"]
-        with tr.span(
-            "service.dispatch", cat="service", instances=k, padded=0,
-            algo=r0.algo,
-        ):
-            results = run_infer_many(
-                [g.dcop for g in part],
-                r0.query,
-                order=kw["order"],
-                beta=kw["beta"],
-                tol=kw["tol"],
-                device=kw["device"],
-                device_min_cells=kw["device_min_cells"],
-                pad_policy=self.pad_policy,
-                timeout=run_timeout,
-                max_util_bytes=kw["max_util_bytes"],
-                map_vars=list(mv) if mv else None,
-                external_dists=kw["external_dists"],
-            )
+        t_run0 = time.perf_counter()
+        for req in part:
+            req.dispatch_t = t_run0
+            req.compile_s = 0.0  # plan+kernels build inside the sweep
+        with trace_scope([g.trace_id for g in part]):
+            with tr.span(
+                "service.dispatch", cat="service", instances=k,
+                padded=0, algo=r0.algo,
+            ):
+                results = run_infer_many(
+                    [g.dcop for g in part],
+                    r0.query,
+                    order=kw["order"],
+                    beta=kw["beta"],
+                    tol=kw["tol"],
+                    device=kw["device"],
+                    device_min_cells=kw["device_min_cells"],
+                    pad_policy=self.pad_policy,
+                    timeout=run_timeout,
+                    max_util_bytes=kw["max_util_bytes"],
+                    map_vars=list(mv) if mv else None,
+                    external_dists=kw["external_dists"],
+                )
+        t_done = time.perf_counter()
+        for req in part:
+            req.device_s = t_done - t_run0
+            req.decode_t0 = t_done
         for req, out in zip(part, results):
             self._finish(req, out, k)
 
@@ -1621,6 +1862,8 @@ class SolverService:
                 )
             sess.ext_values.update(req.set_values)
             sess.record_delta(req.set_values)
+        t_compile0 = time.perf_counter()
+        req.dispatch_t = t_compile0
         problem, _fp = sess.compiler.compile({}, sess.ext_values)
         if problem is None:
             raise ServiceError(
@@ -1634,22 +1877,28 @@ class SolverService:
                 0.01,
             )
         self._record_dispatch(1, 0)
-        with tr.span(
-            "service.dispatch", cat="service", instances=1, padded=0,
-            algo=req.algo, session=req.session,
-            segment=sess.segments,
-        ):
-            result = run_batched(
-                problem,
-                _load_module(req.algo),
-                req.params,
-                rounds=req.rounds,
-                seed=req.seed,
-                timeout=run_timeout,
-                chunk_size=req.chunk_size,
-                convergence_chunks=req.convergence_chunks,
-                n_restarts=req.n_restarts,
-            )
+        t_run0 = time.perf_counter()
+        req.compile_s = t_run0 - t_compile0
+        with trace_scope([req.trace_id]):
+            with tr.span(
+                "service.dispatch", cat="service", instances=1,
+                padded=0, algo=req.algo, session=req.session,
+                segment=sess.segments,
+            ):
+                result = run_batched(
+                    problem,
+                    _load_module(req.algo),
+                    req.params,
+                    rounds=req.rounds,
+                    seed=req.seed,
+                    timeout=run_timeout,
+                    chunk_size=req.chunk_size,
+                    convergence_chunks=req.convergence_chunks,
+                    n_restarts=req.n_restarts,
+                )
+        t_done = time.perf_counter()
+        req.device_s = t_done - t_run0
+        req.decode_t0 = t_done
         out = _result_dict(result)
         out["session"] = req.session
         out["segment"] = sess.segments
@@ -1892,6 +2141,17 @@ class ServiceServer:
         relayed through :meth:`request_shutdown` (or the timeout);
         returns True when shut down."""
         return self._shutdown.wait(timeout)
+
+    def inflight(self) -> int:
+        """Wire-level in-flight request count across all connections
+        (the ``/healthz`` ``inflight`` field)."""
+        with self._lock:
+            states = list(self._states)
+        total = 0
+        for st in states:
+            with st.lock:
+                total += st.inflight
+        return total
 
     def request_shutdown(self) -> None:
         """Ask the serve loop to stop (signal-handler safe: only sets
@@ -2200,6 +2460,21 @@ class ServiceServer:
             while len(self._replies) > self._reply_cache_max:
                 self._replies.popitem(last=False)
 
+    def _note_replay(self, msg: Dict[str, Any]) -> None:
+        """One replayed reply: count it, and put a trace-tagged event
+        on the timeline so `trace-summary --requests` stitches the
+        retry attempt back to the ORIGINAL server spans instead of
+        showing a gap (or inventing a phantom re-solve)."""
+        self.service.note_replayed_reply()
+        tr = get_tracer()
+        if tr.enabled:
+            wt = parse_wire_trace(msg.get("trace"))
+            tr.event(
+                "service-replay", cat="service",
+                trace=wt[0] if wt else None,
+                attempt=wt[2] if wt else None,
+            )
+
     def _handle_solve(self, st: _ConnState, msg: Dict[str, Any]) -> None:
         rid = msg.get("id")
         ikey = msg.get("ikey")
@@ -2221,7 +2496,7 @@ class ServiceServer:
         if cached is not None:
             # a retry of a computed-but-lost response: answer from
             # the bounded reply cache, never re-solve
-            self.service.note_replayed_reply()
+            self._note_replay(msg)
             self._reply(st, {**cached, "id": rid})
             return
         with st.lock:
@@ -2254,7 +2529,7 @@ class ServiceServer:
         # solve would burn a dispatch slot per retry and re-apply
         # session deltas)
         if pending is not None:
-            self.service.note_replayed_reply()
+            self._note_replay(msg)
         else:
             placeholder: Optional[PendingResult] = None
             if ikey is not None:
@@ -2272,7 +2547,7 @@ class ServiceServer:
                     else:
                         self._inflight_ikeys[ikey] = placeholder
             if pending is not None:
-                self.service.note_replayed_reply()
+                self._note_replay(msg)
             else:
                 try:
                     if msg.get("op") == "infer":
@@ -2284,6 +2559,7 @@ class ServiceServer:
                         real = self.service.submit_infer(
                             msg.get("dcop"),
                             msg.get("query", "marginals"),
+                            trace=msg.get("trace"),
                             **kwargs,
                         )
                     else:
@@ -2296,6 +2572,7 @@ class ServiceServer:
                             msg.get("dcop"),
                             msg.get("algo"),
                             msg.get("params") or None,
+                            trace=msg.get("trace"),
                             **kwargs,
                         )
                 except Exception as e:  # noqa: BLE001 — per-request
@@ -2344,6 +2621,7 @@ class ServiceServer:
         def deliver(p: PendingResult) -> None:
             with st.lock:
                 st.inflight -= 1
+            t_del0 = time.perf_counter()
             try:
                 result = p.result(0)
                 reply = {
@@ -2354,6 +2632,16 @@ class ServiceServer:
                         if k not in _WIRE_DROP
                     },
                 }
+                # the last phase segment: terminal result -> reply
+                # handed to the connection writer.  Serialization and
+                # the socket send run after this frame leaves the
+                # server's attribution window — the remaining gap in
+                # a client-measured latency is wire time.
+                phases = reply["result"].get("phases")
+                if isinstance(phases, dict):
+                    phases["reply_write"] = round(
+                        time.perf_counter() - t_del0, 6
+                    )
             except Exception as e:  # noqa: BLE001 — the error IS
                 # the reply
                 reply = {
@@ -2544,6 +2832,8 @@ class ServiceClient:
             frame = {
                 "op": op, "id": rid, "cid": self.client_id, **fields,
             }
+            tid: Optional[str] = None
+            attempts = [0]
             if op not in ("ping", "stats"):
                 # stable across resends of this frame — the server's
                 # reply-cache dedupe key.  Solves AND state-mutating
@@ -2555,41 +2845,98 @@ class ServiceClient:
                 frame["ikey"] = (
                     f"{self.client_id}:{self._ikey_nonce}:{rid}"
                 )
-            if self.retry_window <= 0:
+                # the request trace id rides next to the idempotency
+                # key: stable across resends (so a replayed reply
+                # stitches to the ORIGINAL server spans), pure in
+                # (client id, request ordinal) so chaos replays
+                # produce identical stitched timelines
+                # (telemetry/context.py)
+                tid = mint_trace_id(self.client_id, rid)
+
+            def _one_attempt() -> Dict[str, Any]:
+                if tid is None:
+                    return self._attempt(frame)
+                attempts[0] += 1
+                frame["trace"] = wire_trace(tid, attempts[0])
+                tr = get_tracer()
+                t0 = time.perf_counter()
+                status = "ok"
                 try:
-                    reply = self._attempt(frame)
-                except (OSError, ValueError) as e:
-                    raise ServiceTransportError(
-                        f"service request failed: "
-                        f"{type(e).__name__}: {e}"
-                    ) from e
-            else:
-                from pydcop_tpu.utils.backoff import call_with_backoff
+                    return self._attempt(frame)
+                except BaseException as e:
+                    status = type(e).__name__
+                    raise
+                finally:
+                    if tr.enabled:
+                        tr.add_span(
+                            "client.attempt", "service", t0,
+                            time.perf_counter() - t0, trace=tid,
+                            span=frame["trace"]["span"],
+                            attempt=attempts[0], op=op,
+                            status=status,
+                        )
 
-                met = get_metrics()
-
-                def _note_retry(attempt: int, error: BaseException):
-                    if met.enabled:
-                        met.inc("service.client_retries")
-
-                try:
-                    reply = call_with_backoff(
-                        lambda: self._attempt(frame),
-                        retry_for=self.retry_window,
-                        exceptions=(OSError, ValueError),
-                        base=0.05,
-                        max_delay=1.0,
-                        key=f"service-client/{self.client_id}",
-                        seed=self._backoff_seed,
-                        on_retry=_note_retry,
-                        giving_up=lambda: self._closed,
+            t_req0 = time.perf_counter()
+            req_status = "error"
+            try:
+                if self.retry_window <= 0:
+                    try:
+                        reply = _one_attempt()
+                    except (OSError, ValueError) as e:
+                        raise ServiceTransportError(
+                            f"service request failed: "
+                            f"{type(e).__name__}: {e}"
+                        ) from e
+                else:
+                    from pydcop_tpu.utils.backoff import (
+                        call_with_backoff,
                     )
-                except (OSError, ValueError) as e:
-                    raise ServiceTransportError(
-                        f"service request failed after "
-                        f"{self.retry_window}s of retries: "
-                        f"{type(e).__name__}: {e}"
-                    ) from e
+
+                    met = get_metrics()
+
+                    def _note_retry(
+                        attempt: int, error: BaseException
+                    ):
+                        if met.enabled:
+                            met.inc("service.client_retries")
+
+                    try:
+                        reply = call_with_backoff(
+                            _one_attempt,
+                            retry_for=self.retry_window,
+                            exceptions=(OSError, ValueError),
+                            base=0.05,
+                            max_delay=1.0,
+                            key=f"service-client/{self.client_id}",
+                            seed=self._backoff_seed,
+                            on_retry=_note_retry,
+                            giving_up=lambda: self._closed,
+                        )
+                    except (OSError, ValueError) as e:
+                        raise ServiceTransportError(
+                            f"service request failed after "
+                            f"{self.retry_window}s of retries: "
+                            f"{type(e).__name__}: {e}"
+                        ) from e
+                if reply.get("ok"):
+                    req_status = str(
+                        (reply.get("result") or {}).get(
+                            "status", "ok"
+                        )
+                    )
+            finally:
+                # the whole-request span: its dur IS the
+                # client-measured end-to-end latency the reply's
+                # phase breakdown is judged against
+                if tid is not None:
+                    tr = get_tracer()
+                    if tr.enabled:
+                        tr.add_span(
+                            "client.request", "service", t_req0,
+                            time.perf_counter() - t_req0, trace=tid,
+                            op=op, attempts=attempts[0],
+                            status=req_status,
+                        )
         if not reply.get("ok"):
             raise ServiceError(
                 reply.get("error", "service request failed")

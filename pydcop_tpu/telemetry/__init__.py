@@ -30,6 +30,10 @@ from __future__ import annotations
 import contextlib
 from typing import Iterator, Optional
 
+from pydcop_tpu.telemetry.flightrec import (  # noqa: F401 (re-exports)
+    FlightRecorder,
+    NULL_FLIGHT,
+)
 from pydcop_tpu.telemetry.metrics import (  # noqa: F401 (re-exports)
     DEFAULT_BUCKETS,
     Histogram,
@@ -59,16 +63,31 @@ def get_metrics():
     return _metrics
 
 
+def get_flight_recorder():
+    """The active session's flight recorder
+    (``telemetry/flightrec.py``), or the no-op singleton."""
+    sess = _active
+    if sess is not None and sess.flight is not None:
+        return sess.flight
+    return NULL_FLIGHT
+
+
 def active_session() -> Optional["TelemetrySession"]:
     return _active
 
 
 class TelemetrySession:
-    """One run's tracer + metrics pair."""
+    """One run's tracer + metrics (+ flight recorder) set."""
 
-    def __init__(self, tracer: Tracer, metrics: MetricsRegistry):
+    def __init__(
+        self,
+        tracer: Tracer,
+        metrics: MetricsRegistry,
+        flight: Optional[FlightRecorder] = None,
+    ):
         self.tracer = tracer
         self.metrics = metrics
+        self.flight = flight
         self.closed = False
 
     def summary(self) -> dict:
@@ -99,6 +118,7 @@ class TelemetrySession:
 def session(
     trace_path: Optional[str] = None,
     trace_format: str = "jsonl",
+    flight: bool = True,
 ) -> Iterator[TelemetrySession]:
     """Install a telemetry session for the duration of the block.
 
@@ -106,7 +126,11 @@ def session(
     ``trace_format``: ``jsonl`` or ``chrome``) when the block exits —
     including per-message ``detailed`` events.  Without a path the
     session still collects spans/counters in memory for
-    ``result["telemetry"]``.
+    ``result["telemetry"]``.  ``flight`` (default on) attaches the
+    bounded flight-recorder ring (``telemetry/flightrec.py``): every
+    span/event/counter delta also lands there, dumpable on failure
+    triggers with no trace file; ``flight=False`` is the measured-off
+    arm of the ``obs_overhead`` bench stage.
 
     Nesting: entering with no ``trace_path`` while a session is already
     active REUSES the active session (records flow to the outer run's
@@ -133,7 +157,19 @@ def session(
             reuse = None
             tracer = Tracer(path=trace_path, fmt=trace_format)
             metrics = MetricsRegistry()
-            sess = TelemetrySession(tracer, metrics)
+            rec = None
+            if flight:
+                # the always-on flight recorder: a bounded ring every
+                # record and counter delta also lands on, dumpable on
+                # shed/quarantine/drain triggers with NO trace file
+                # configured (telemetry/flightrec.py); shares the
+                # tracer's timebase so its dump sorts on one timeline
+                rec = FlightRecorder(
+                    epoch=tracer._epoch, unix_t0=tracer._unix_t0
+                )
+                tracer.flight = rec
+                metrics.flight = rec
+            sess = TelemetrySession(tracer, metrics, flight=rec)
             prev = (_tracer, _metrics, _active)
             _tracer, _metrics, _active = tracer, metrics, sess
     if reuse is not None:

@@ -59,16 +59,33 @@ class Histogram:
         self.n += 1
 
     def to_dict(self) -> Dict[str, object]:
+        # percentiles ride every aggregate (result["telemetry"], the
+        # /metrics exporter, trace metrics records) at bucket
+        # resolution, computed with the SAME nearest-rank convention
+        # as the serving report's _percentile — one definition of
+        # "p99", not two drifting ones
+        from pydcop_tpu.telemetry.summary import (
+            percentiles_from_histogram,
+        )
+
         return {
             "buckets": list(self.bounds),
             "counts": list(self.counts),
             "sum": self.total,
             "count": self.n,
+            **percentiles_from_histogram(self.bounds, self.counts),
         }
 
 
 class MetricsRegistry:
-    """Live registry installed by a telemetry session."""
+    """Live registry installed by a telemetry session.
+
+    ``flight`` (attached by the session) mirrors counter/gauge deltas
+    onto the flight-recorder ring (``telemetry/flightrec.py``) so a
+    crash dump carries the recent counter activity; histogram
+    observations are not mirrored — their values are derivable from
+    the latency spans already on the ring, and they are the highest-
+    volume producer."""
 
     enabled = True
 
@@ -76,13 +93,20 @@ class MetricsRegistry:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Histogram] = {}
+        self.flight = None
 
     def inc(self, name: str, n: float = 1) -> None:
         c = self._counters
         c[name] = c.get(name, 0) + n
+        flight = self.flight
+        if flight is not None:
+            flight.counter(name, n)
 
     def gauge(self, name: str, value: float) -> None:
         self._gauges[name] = value
+        flight = self.flight
+        if flight is not None:
+            flight.gauge(name, value)
 
     def observe(
         self, name: str, value: float,

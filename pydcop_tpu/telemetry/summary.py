@@ -80,6 +80,38 @@ def _percentile(values: List[float], q: float) -> float:
     return float(s[idx])
 
 
+def percentiles_from_histogram(
+    bounds, counts, qs=(50, 90, 99)
+) -> Dict[str, float]:
+    """Percentiles of a fixed-bucket histogram, at bucket resolution.
+
+    Shares :func:`_percentile`'s nearest-rank convention (the rank of
+    the q-th percentile over n samples is ``round(q/100 * (n-1))``)
+    but walks cumulative bucket counts instead of a sorted sample, so
+    ``result["telemetry"]["histograms"]`` and the serving report agree
+    on what a percentile means.  The reported value is the UPPER BOUND
+    of the bucket holding the rank (the overflow bucket reports the
+    largest finite bound — a lower-bound estimate, flagged by the
+    bucket counts themselves)."""
+    out: Dict[str, float] = {}
+    n = sum(counts)
+    for q in qs:
+        key = f"p{int(q)}"
+        if n <= 0 or not bounds:
+            out[key] = 0.0
+            continue
+        rank = min(n - 1, max(0, int(round(q / 100.0 * (n - 1)))))
+        cum = 0
+        val = float(bounds[-1])
+        for i, c in enumerate(counts):
+            cum += c
+            if cum > rank:
+                val = float(bounds[min(i, len(bounds) - 1)])
+                break
+        out[key] = val
+    return out
+
+
 def _service_summary(
     waits: List[float], lats: List[float], occs: List[float]
 ) -> Dict[str, Any]:
@@ -337,3 +369,176 @@ def format_summary(s: Dict[str, Any]) -> str:
     if not lines:
         lines.append("(empty trace: no spans or events)")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# request stitching (`trace-summary --requests`): one correlated
+# timeline per trace id across SEPARATE trace files — client-side
+# attempt spans from the client process's trace, server-side
+# queue/dispatch/device spans from the service's.  Records correlate
+# by the wire-propagated trace id (telemetry/context.py): every span/
+# event whose args carry `trace` (a single id or a list, for group
+# dispatches) joins its request's timeline.  Cross-file ordering
+# normalizes each record to unix time via its file's meta `unix_t0`
+# (same-host clocks; skew shows up as offset, never as mis-grouping).
+# ---------------------------------------------------------------------------
+
+#: the client-side span names (engine/service.py ServiceClient): one
+#: `client.request` span per logical request (its dur is the
+#: client-measured end-to-end latency) and one `client.attempt` span
+#: per delivery attempt (resends under retry get fresh attempt spans
+#: that stitch to the SAME trace id)
+CLIENT_REQUEST_SPAN = "client.request"
+CLIENT_ATTEMPT_SPAN = "client.attempt"
+#: the server-side span that carries the request's phase breakdown in
+#: its args (engine/service.py)
+SERVER_REQUEST_SPAN = "service.request"
+
+#: the reply phase-breakdown keys, in pipeline order (docs/
+#: observability.md, "Serving observability")
+PHASE_KEYS = (
+    "admission", "queue", "compile", "device", "decode", "reply_write",
+)
+
+
+def _record_traces(rec: Dict[str, Any]) -> List[str]:
+    tr = (rec.get("args") or {}).get("trace")
+    if isinstance(tr, str):
+        return [tr]
+    if isinstance(tr, (list, tuple)):
+        return [t for t in tr if isinstance(t, str)]
+    return []
+
+
+def stitch_requests(
+    tracesets: List[List[Dict[str, Any]]],
+) -> Dict[str, Dict[str, Any]]:
+    """Correlate one or more loaded traces into per-request timelines.
+
+    Returns ``{trace_id: {"timeline": [...], "attempts": n,
+    "server_requests": n, "replays": n, "client_latency_s": s|None,
+    "phases": {...}|None, "status": ...}}`` with each timeline entry
+    ``{"t": unix_seconds, "src": file_index, "kind", "name", "dur",
+    "args"}`` sorted by time.  ``server_requests`` counts
+    ``service.request`` spans — a retry whose reply was replayed
+    stitches to the ORIGINAL server spans, so it stays 1 however many
+    client attempts the request took."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for src, records in enumerate(tracesets):
+        unix_t0 = 0.0
+        for r in records:
+            if r.get("kind") == "meta":
+                try:
+                    unix_t0 = float(r.get("unix_t0") or 0.0)
+                except (TypeError, ValueError):
+                    unix_t0 = 0.0
+                break
+        for r in records:
+            kind = r.get("kind")
+            if kind not in ("span", "event"):
+                continue
+            for tid in _record_traces(r):
+                req = out.setdefault(
+                    tid,
+                    {
+                        "timeline": [],
+                        "attempts": 0,
+                        "server_requests": 0,
+                        "replays": 0,
+                        "client_latency_s": None,
+                        "phases": None,
+                        "status": None,
+                    },
+                )
+                entry = {
+                    "t": unix_t0 + float(r.get("t", 0.0)),
+                    "src": src,
+                    "kind": kind,
+                    "name": r.get("name", "?"),
+                    "args": {
+                        k: v
+                        for k, v in (r.get("args") or {}).items()
+                        if k != "trace"
+                    },
+                }
+                if kind == "span":
+                    entry["dur"] = float(r.get("dur", 0.0))
+                req["timeline"].append(entry)
+                name = entry["name"]
+                if name == CLIENT_ATTEMPT_SPAN:
+                    req["attempts"] += 1
+                elif name == CLIENT_REQUEST_SPAN:
+                    req["client_latency_s"] = entry.get("dur")
+                    req["status"] = entry["args"].get("status")
+                elif name == SERVER_REQUEST_SPAN:
+                    req["server_requests"] += 1
+                    phases = entry["args"].get("phases")
+                    if isinstance(phases, dict):
+                        req["phases"] = phases
+                    if req["status"] is None:
+                        req["status"] = entry["args"].get("status")
+                elif name == "service-replay":
+                    req["replays"] += 1
+    for req in out.values():
+        req["timeline"].sort(key=lambda e: e["t"])
+    return out
+
+
+def format_requests(stitched: Dict[str, Dict[str, Any]]) -> str:
+    """Human-readable per-request timelines (``trace-summary
+    --requests``)."""
+    if not stitched:
+        return "(no trace-tagged records: nothing to stitch)"
+    lines: List[str] = []
+    order = sorted(
+        stitched,
+        key=lambda tid: (
+            stitched[tid]["timeline"][0]["t"]
+            if stitched[tid]["timeline"]
+            else 0.0
+        ),
+    )
+    for tid in order:
+        req = stitched[tid]
+        head = (
+            f"request {tid}: {req['attempts']} attempt(s), "
+            f"{req['server_requests']} server solve(s)"
+        )
+        if req["replays"]:
+            head += f", {req['replays']} replayed reply(ies)"
+        if req["status"] is not None:
+            head += f", status={req['status']}"
+        if req["client_latency_s"] is not None:
+            head += f", client latency {req['client_latency_s']:.4f}s"
+        lines.append(head)
+        t0 = req["timeline"][0]["t"] if req["timeline"] else 0.0
+        for e in req["timeline"]:
+            dur = (
+                f" dur={e['dur']:.4f}" if e["kind"] == "span" else ""
+            )
+            args = " ".join(
+                f"{k}={v}"
+                for k, v in sorted(e["args"].items())
+                if v is not None and k != "phases"
+            )
+            lines.append(
+                f"  +{e['t'] - t0:>8.4f}s [{e['src']}] "
+                f"{e['kind']:<5} {e['name']:<22}{dur}  {args}".rstrip()
+            )
+        phases = req.get("phases")
+        if phases:
+            total = sum(
+                float(phases.get(k, 0.0)) for k in PHASE_KEYS
+            )
+            parts = " ".join(
+                f"{k}={float(phases[k]):.4f}"
+                for k in PHASE_KEYS
+                if k in phases
+            )
+            tail = f"  phases: {parts} sum={total:.4f}"
+            lat = req["client_latency_s"]
+            if lat:
+                tail += f" ({100.0 * total / lat:.1f}% of client latency)"
+            lines.append(tail)
+        lines.append("")
+    return "\n".join(lines).rstrip()

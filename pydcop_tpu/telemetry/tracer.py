@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from pydcop_tpu.telemetry.context import current_trace_ids
+
 
 class _Span:
     """Context manager recording one span on exit."""
@@ -102,14 +104,41 @@ class Tracer:
         self._unix_t0 = time.time()
         self._records: List[Dict[str, Any]] = []
         self._closed = False
+        # the session attaches its flight recorder here: every record
+        # also lands on the bounded ring, which overwrites instead of
+        # dropping — it must stay live past the max_records cap
+        self.flight = None
 
     # -- recording ------------------------------------------------------
 
     def _append(self, rec: Dict[str, Any]) -> None:
+        # ambient request trace ids (telemetry/context.py): spans and
+        # events recorded inside a service dispatch's trace_scope get
+        # tagged without every producer threading the id through
+        if rec.get("kind") in ("span", "event"):
+            ids = current_trace_ids()
+            if ids is not None:
+                args = rec.get("args")
+                if args is None:
+                    args = rec["args"] = {}
+                args.setdefault(
+                    "trace", ids[0] if len(ids) == 1 else list(ids)
+                )
+        flight = self.flight
+        if flight is not None:
+            flight.record(rec)
         # list.append is GIL-atomic; the cap check may overshoot by a
         # few records under heavy concurrency, which is harmless
         if len(self._records) >= self.max_records:
             self.dropped += 1
+            # surface the cap bite on the live registry too: the meta
+            # line only exists once the file is written, and a
+            # resident process may never write one
+            from pydcop_tpu.telemetry import get_metrics
+
+            met = get_metrics()
+            if met.enabled:
+                met.inc("telemetry.dropped_records")
             return
         self._records.append(rec)
 

@@ -1,0 +1,17 @@
+"""Fixture trace-summary module: every folded span family has a doc
+row (the span-undocumented negative case)."""
+
+ATTEMPT_SPAN = "cli.attempt"
+
+
+def summarize(records):
+    out = {"queue": 0, "attempts": 0, "semiring": 0}
+    for r in records:
+        name = r.get("name")
+        if name == "svc.queue-wait":
+            out["queue"] += 1
+        elif name == ATTEMPT_SPAN:
+            out["attempts"] += 1
+        elif name.startswith("ring."):
+            out["semiring"] += 1
+    return out

@@ -1,0 +1,21 @@
+"""Fixture trace-summary module: folds span families, some of them
+undocumented (the span-undocumented positive case)."""
+
+ATTEMPT_SPAN = "cli.attempt"  # *_SPAN constant, undocumented
+
+
+def summarize(records):
+    out = {"queue": 0, "attempts": 0, "semiring": 0, "drains": 0}
+    for r in records:
+        name = r.get("name")
+        if name == "svc.queue-wait":  # documented: stays quiet
+            out["queue"] += 1
+        elif name == "svc.request":  # undocumented compare
+            pass
+        elif name == ATTEMPT_SPAN:
+            out["attempts"] += 1
+        elif name.startswith("ring."):  # undocumented family
+            out["semiring"] += 1
+    # undocumented dotted .get key on the span table
+    out["drains"] = out.get("svc.drain", 0)
+    return out

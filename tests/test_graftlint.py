@@ -39,6 +39,7 @@ _RULE_FAMILIES = {
         "metric-undocumented",
         "metric-stale-doc",
         "chaos-clause-doc",
+        "span-undocumented",
     ),
     "tracekey": ("bare-jit", "unhashable-closure"),
 }
@@ -217,6 +218,7 @@ _TELEMETRY_KW = dict(
     metrics_code=("pkg/*",),
     metrics_docs=("docs/metrics.md",),
     chaos_kind_categories={"zap": "device"},
+    trace_summary_module="pkg/summary.py",
 )
 
 
@@ -230,6 +232,14 @@ def test_telemetry_drift_fixture_fires():
     # documented + emitted names stay quiet, incl. the f-string family
     assert not any(d == "foo.requests" for _, d in details)
     assert not any(d.startswith("bar.") for _, d in details)
+    # span-undocumented: every extraction channel fires — a bare
+    # compare, a *_SPAN constant, a startswith family, a dotted .get
+    # key — while the documented compare stays quiet
+    assert ("span-undocumented", "svc.request") in details
+    assert ("span-undocumented", "cli.attempt") in details
+    assert ("span-undocumented", "ring.*") in details
+    assert ("span-undocumented", "svc.drain") in details
+    assert not any(d == "svc.queue-wait" for _, d in details)
 
 
 def test_telemetry_drift_fixture_quiet():

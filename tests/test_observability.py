@@ -1,0 +1,500 @@
+"""End-to-end serving observability (ISSUE 14, docs/observability.md
+"Serving observability"): wire-propagated request traces stitched
+across client and server trace files, per-request phase breakdowns
+summing to the client-observed latency, the always-on flight recorder
+dumping on quarantine/shed triggers with no trace file configured, and
+the live /metrics + /healthz exporter under a concurrent burst.
+
+Timing discipline matches tests/test_service.py: deterministic ticks
+come from ``max_batch == number of submitted requests`` with a long
+``max_wait``; the shared ``pow2:16`` pad policy + rounds/chunk shapes
+ride the runner compiles the service tests already paid in-suite.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.engine.service import (
+    ServiceClient,
+    ServiceServer,
+    SolverService,
+)
+from pydcop_tpu.telemetry import get_metrics, session
+from pydcop_tpu.telemetry.context import mint_trace_id
+from pydcop_tpu.telemetry.export import (
+    MetricsExporter,
+    http_get,
+    parse_prometheus_text,
+    prometheus_text,
+)
+from pydcop_tpu.telemetry.flightrec import load_dump
+from pydcop_tpu.telemetry.summary import (
+    PHASE_KEYS,
+    load_trace,
+    stitch_requests,
+)
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.service]
+
+D = Domain("d", "", [0, 1, 2])
+
+KW = dict(rounds=24, chunk_size=24)
+PAD = "pow2:16"
+
+
+def ring_yaml(n=6, name="ring"):
+    return (
+        f"name: {name}\n"
+        "objective: min\n"
+        "domains:\n"
+        "  colors: {values: [0, 1, 2]}\n"
+        "variables:\n"
+        + "".join(f"  v{i}: {{domain: colors}}\n" for i in range(n))
+        + "constraints:\n"
+        + "".join(
+            f"  c{i}: {{type: intention, "
+            f"function: '1 if v{i} == v{(i + 1) % n} else 0'}}\n"
+            for i in range(n)
+        )
+        + "agents: [a1]\n"
+    )
+
+
+RING_YAML = ring_yaml()
+
+
+def _ring_dcop(n=6, name="ring"):
+    dcop = DCOP(name)
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n):
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}", f"1 if v{i} == v{(i + 1) % n} else 0", vs
+            )
+        )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n)])
+    return dcop
+
+
+def _drop_scrape_counter(snapshot):
+    """The scrape endpoint counts itself (`telemetry.scrapes`), so a
+    scrape can never equal a snapshot taken around it on that one
+    counter — compare everything else."""
+    out = dict(snapshot)
+    out["counters"] = {
+        k: v
+        for k, v in snapshot.get("counters", {}).items()
+        if k != "telemetry.scrapes"
+    }
+    return out
+
+
+# -- live export: /metrics under a concurrent burst, /healthz ------------
+
+
+def test_metrics_endpoint_live_burst_parses_and_matches_snapshot():
+    """Acceptance: GET /metrics DURING a live 32-client wire burst
+    parses as Prometheus text exposition, and once the burst settles
+    the exposition matches a registry snapshot taken in the same
+    quiet window."""
+    n = 32
+    yamls = [ring_yaml(5 + i % 3, name=f"q{i}") for i in range(n)]
+    results = [None] * n
+    errors = []
+    live_scrapes = []
+    with session() as tel:
+        with SolverService(
+            pad_policy=PAD, max_batch=n, max_wait=0.25
+        ) as svc:
+            with ServiceServer(svc, port=0) as server:
+                with MetricsExporter(
+                    tel.metrics.snapshot, svc.health
+                ) as ex:
+                    url = "http://%s:%d" % ex.address
+                    health = json.loads(http_get(url + "/healthz"))
+                    assert health["status"] == "ok"
+
+                    def client(i):
+                        try:
+                            with ServiceClient(
+                                server.address, client_id=f"m{i}",
+                                retry_window=30.0,
+                            ) as cli:
+                                results[i] = cli.solve(
+                                    yamls[i], "mgm", seed=i, **KW
+                                )
+                        except Exception as e:  # noqa: BLE001
+                            errors.append((i, repr(e)))
+
+                    threads = [
+                        threading.Thread(
+                            target=client, args=(i,), daemon=True
+                        )
+                        for i in range(n)
+                    ]
+                    for t in threads:
+                        t.start()
+                    # scrape WHILE the burst is in flight: every
+                    # response must parse (strict parser)
+                    while any(t.is_alive() for t in threads):
+                        live_scrapes.append(
+                            parse_prometheus_text(
+                                http_get(url + "/metrics")
+                            )
+                        )
+                        time.sleep(0.01)
+                    for t in threads:
+                        t.join(60)
+                    assert not errors, errors
+                    # settle, then demand an exact match against a
+                    # snapshot bracketing the scrape (same tick
+                    # window: no request in flight, counters quiet)
+                    matched = False
+                    for _ in range(50):
+                        snap_before = _drop_scrape_counter(
+                            tel.metrics.snapshot()
+                        )
+                        text = http_get(url + "/metrics")
+                        snap_after = _drop_scrape_counter(
+                            tel.metrics.snapshot()
+                        )
+                        if snap_before == snap_after:
+                            got = parse_prometheus_text(text)
+                            got.pop(
+                                "pydcop_telemetry_scrapes_total",
+                                None,
+                            )
+                            assert got == parse_prometheus_text(
+                                prometheus_text(snap_before)
+                            )
+                            matched = True
+                            break
+                        time.sleep(0.02)
+                    assert matched, "registry never quiesced"
+    assert all(r is not None for r in results)
+    assert len(live_scrapes) >= 1
+    # the burst's own counters were visible live
+    final = live_scrapes[-1]
+    assert final.get("pydcop_service_requests_total", 0) <= n
+    assert (
+        get_metrics().enabled is False
+    )  # session closed cleanly behind us
+
+
+def test_healthz_flips_to_draining_during_graceful_shutdown():
+    """Acceptance: /healthz reports ok -> draining (the moment the
+    graceful drain starts, while the in-flight tick finishes) ->
+    drained."""
+    with session() as tel:
+        svc = SolverService(pad_policy=PAD, max_batch=1, max_wait=0.0)
+        ex = MetricsExporter(tel.metrics.snapshot, svc.health)
+        url = "http://%s:%d" % ex.address
+        try:
+            assert (
+                json.loads(http_get(url + "/healthz"))["status"]
+                == "ok"
+            )
+            # a deliberately long dispatch (fresh chunk shape => it
+            # also pays its runner compile inside the tick) keeps the
+            # worker busy while close() drains
+            pending = svc.submit(
+                ring_yaml(12, name="long"), "mgm", {},
+                rounds=4000, chunk_size=100,
+            )
+            deadline = time.time() + 120
+            while svc.stats()["ticks"] < 1:
+                assert time.time() < deadline
+                time.sleep(0.005)
+            closer = threading.Thread(target=svc.close)
+            closer.start()
+            saw_draining = False
+            deadline = time.time() + 120
+            while closer.is_alive() and time.time() < deadline:
+                h = json.loads(http_get(url + "/healthz"))
+                if h["status"] == "draining":
+                    saw_draining = True
+                    break
+                time.sleep(0.002)
+            closer.join(120)
+            assert saw_draining, "never observed status=draining"
+            h = json.loads(http_get(url + "/healthz"))
+            assert h["status"] == "drained"
+            assert h["queue_depth"] == 0
+            # the drained request still delivered ("finish and
+            # deliver" — the drain completed its tick)
+            assert pending.result(1)["status"] in (
+                "finished", "degraded",
+            )
+        finally:
+            ex.close()
+            svc.close()
+
+
+def test_top_one_shot_snapshot(capsys):
+    from pydcop_tpu.cli import main
+
+    with session() as tel:
+        m = get_metrics()
+        m.inc("service.requests", 3)
+        m.inc("service.shed")
+        m.observe("service.latency_s", 0.02)
+        with MetricsExporter(
+            tel.metrics.snapshot,
+            lambda: {
+                "status": "ok", "queue_depth": 0, "inflight": 0,
+                "sessions": 0,
+            },
+        ) as ex:
+            rc = main(
+                [
+                    "top", "%s:%d" % ex.address,
+                    "--count", "1", "--interval", "0.01",
+                ]
+            )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "status=ok" in out
+    assert "requests" in out and "latency_s" in out
+    # a dead address is a clean usage error, not a hang
+    with pytest.raises(SystemExit, match="cannot scrape"):
+        main(
+            ["top", "127.0.0.1:1", "--count", "1",
+             "--interval", "0.01"]
+        )
+
+
+# -- flight recorder: dumps with NO trace file ---------------------------
+
+
+def test_flight_dump_on_quarantine_and_deadline_shed(tmp_path):
+    """Acceptance: a nan_inject-quarantined and a deadline-shed
+    request each produce a flight-recorder dump containing the
+    triggering request's spans — with NO trace file configured."""
+    fpath = str(tmp_path / "flight.json")
+    dcops = [_ring_dcop(5 + i % 3, name=f"q{i}") for i in range(8)]
+    kw = dict(rounds=24, chunk_size=12)
+    with session() as tel:  # no trace path: ring only
+        assert tel.tracer.path is None
+        with SolverService(
+            pad_policy=PAD, max_batch=8, max_wait=30.0,
+            autostart=False, chaos="nan_inject=1:2", chaos_seed=3,
+            flight_dump=fpath,
+        ) as svc:
+            pendings = [
+                svc.submit(d, "mgm", {}, seed=7, **kw) for d in dcops
+            ]
+            results = [p.result(timeout=300) for p in pendings]
+            degraded = [
+                r for r in results if r["status"] == "degraded"
+            ]
+            assert len(degraded) == 1
+            # read the dump BEFORE close(): the drain trigger will
+            # overwrite it
+            doc = load_dump(fpath)
+            assert doc["trigger"] == "quarantine"
+            assert doc["trace_id"] == degraded[0]["trace"]
+            tagged = [
+                r
+                for r in doc["records"]
+                if r.get("kind") in ("span", "event")
+                and (
+                    (r.get("args") or {}).get("trace")
+                    == doc["trace_id"]
+                    or doc["trace_id"]
+                    in ((r.get("args") or {}).get("trace") or ())
+                )
+            ]
+            # the triggering request's own spans are on the ring:
+            # its queue-wait + request spans and the group dispatch
+            names = {r.get("name") for r in tagged}
+            assert "service.request" in names
+            assert "service.dispatch" in names
+            # the injected fault itself rode the ring too
+            assert any(
+                r.get("name") == "nan_inject"
+                for r in doc["records"]
+                if r.get("kind") == "event"
+            )
+        # the drain overwrote the dump, trigger front and center
+        assert load_dump(fpath)["trigger"] == "drain"
+
+    # deadline shed: stopped worker, learned tick estimate, a
+    # deadline the service knows it cannot meet
+    fpath2 = str(tmp_path / "flight2.json")
+    with session():
+        svc = SolverService(
+            pad_policy=PAD, max_batch=4, max_wait=30.0,
+            autostart=False, flight_dump=fpath2,
+        )
+        for i in range(4):
+            svc.submit(
+                ring_yaml(name=f"r{i}"), "mgm", {}, seed=i, **KW
+            )
+        svc._tick_med = 1.0
+        shed = svc.submit(
+            RING_YAML, "mgm", {}, timeout=0.5, seed=9, **KW
+        ).result(5)
+        assert shed["status"] == "shed"
+        assert shed["shed_reason"] == "deadline"
+        doc = load_dump(fpath2)
+        assert doc["trigger"] == "shed"
+        assert doc["trace_id"] == shed["trace"]
+        # the shed event carries the triggering trace id
+        assert any(
+            r.get("name") == "service-shed"
+            and (r.get("args") or {}).get("trace") == shed["trace"]
+            for r in doc["records"]
+        )
+        # dump throttling: a shed STORM must not serialize the ring
+        # once per rejected request — triggers inside the min
+        # interval are suppressed (the first dump already captured
+        # the episode), and the window reopening dumps again
+        shed2 = svc.submit(
+            RING_YAML, "mgm", {}, timeout=0.5, seed=10, **KW
+        ).result(5)
+        assert shed2["status"] == "shed"
+        assert load_dump(fpath2)["trace_id"] == shed["trace"]
+        svc._flight_last = 0.0  # the interval elapses
+        shed3 = svc.submit(
+            RING_YAML, "mgm", {}, timeout=0.5, seed=11, **KW
+        ).result(5)
+        assert load_dump(fpath2)["trace_id"] == shed3["trace"]
+        with svc._cond:
+            svc._queue.clear()  # discard without dispatching
+        svc.close()
+
+
+# -- the end-to-end wire stitch acceptance -------------------------------
+
+
+def _spawn_serve(args, env):
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "pydcop_tpu", "serve",
+            "--port", "0", *args,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        text=True,
+    )
+    line = proc.stdout.readline()
+    return proc, json.loads(line)
+
+
+def test_e2e_wire_stitch_conn_drop_and_phase_breakdown(tmp_path):
+    """THE tentpole acceptance: a wire client request that survives a
+    conn_drop retry under chaos yields ONE correlated timeline
+    (client attempt spans + server spans sharing the trace id),
+    `trace-summary --requests` prints its phase breakdown, and a
+    clean request's phase breakdown sums to within 5% of the
+    client-measured latency."""
+    from pydcop_tpu.cli import main
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    server_trace = str(tmp_path / "server.jsonl")
+    client_trace = str(tmp_path / "client.jsonl")
+    cache = str(tmp_path / "xla-cache")
+    # conn_drop=1:3 — per connection the first three replies are
+    # exempt, every later computed reply is dropped before sending:
+    # conn 1 carries ping(1) / warm solve(2) / measured solve(3)
+    # untouched, the 4th reply (the chaos solve) drops and replays
+    # from the reply cache on the retry's fresh connection (seq 1,
+    # exempt again)
+    proc, head = _spawn_serve(
+        [
+            "--max_wait", "0.0", "--max_batch", "1",
+            "--compile_cache", cache,
+            "--trace", server_trace,
+            "--chaos", "conn_drop=1:3", "--chaos_seed", "5",
+        ],
+        env,
+    )
+    ring = ring_yaml(32, name="stitch")
+    kw = dict(chunk_size=300, timeout=600)
+    lat = None
+    try:
+        with session(client_trace):
+            with ServiceClient(
+                head["serving"], client_id="e2e", retry_window=60.0,
+            ) as cli:
+                assert cli.ping()  # rid 1
+                cli.solve(ring, "mgm", rounds=300, seed=1, **kw)  # rid 2: warms the chunk-300 runner
+                t0 = time.perf_counter()
+                r = cli.solve(  # rid 3: the measured clean request
+                    ring, "mgm", rounds=12000, seed=1, **kw
+                )
+                lat = time.perf_counter() - t0
+                dropped = cli.solve(  # rid 4: the conn_drop survivor
+                    ring, "mgm", rounds=300, seed=2, **kw
+                )
+                cli.shutdown()  # rid 5
+        out, err = proc.communicate(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, err
+    assert r["status"] == "finished"
+    assert dropped["status"] == "finished"
+
+    stitched = stitch_requests(
+        [load_trace(client_trace), load_trace(server_trace)]
+    )
+    tid_clean = mint_trace_id("e2e", 3)
+    tid_drop = mint_trace_id("e2e", 4)
+    assert r["trace"] == tid_clean
+    assert dropped["trace"] == tid_drop
+
+    # ONE correlated timeline for the conn_drop survivor: >= 2 client
+    # attempts, exactly ONE server solve (no phantom re-solve), the
+    # replayed reply visible, spans from BOTH files joined
+    surv = stitched[tid_drop]
+    assert surv["attempts"] >= 2
+    assert surv["server_requests"] == 1
+    assert surv["replays"] >= 1
+    srcs = {e["src"] for e in surv["timeline"]}
+    assert srcs == {0, 1}  # client file AND server file
+    names = {e["name"] for e in surv["timeline"]}
+    assert {
+        "client.request", "client.attempt", "service.queue-wait",
+        "service.request", "service.dispatch", "service-replay",
+    } <= names
+
+    # the clean request: phase breakdown present in the reply AND the
+    # stitched timeline, summing to within 5% of the client latency
+    clean = stitched[tid_clean]
+    assert clean["attempts"] == 1 and clean["server_requests"] == 1
+    phases = r["phases"]
+    assert set(PHASE_KEYS) <= set(phases)
+    total = sum(float(phases[k]) for k in PHASE_KEYS)
+    assert total <= lat
+    gap = (lat - total) / lat
+    assert gap < 0.05, (phases, lat, gap)
+    assert clean["phases"] is not None
+    assert clean["client_latency_s"] == pytest.approx(lat, rel=0.2)
+
+    # the CLI prints the correlated timelines
+    assert (
+        main(
+            [
+                "trace-summary", client_trace, server_trace,
+                "--requests",
+            ]
+        )
+        == 0
+    )
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -550,17 +550,63 @@ SOAK_CHAOS = "conn_drop=0.3,nan_inject=1:3,device_oom=16"
 SOAK_SEED = 7
 
 
-def _run_soak():
+#: the deterministic span/event vocabulary of a stitched timeline —
+#: jit-compile / backend-compile records are tagged too but depend on
+#: warm-cache state (run 1 compiles, run 2 doesn't), so the replay
+#: comparison is over the REQUEST-shaped records only
+_SOAK_TIMELINE_NAMES = (
+    "client.request", "client.attempt", "service.queue-wait",
+    "service.request", "service.dispatch", "service-replay",
+    "service-shed", "nan_inject", "device_oom", "device_transient",
+)
+
+
+def _normalized_timelines(trace_path):
+    """Stitched per-request timelines reduced to their deterministic
+    content: per trace id, the sorted multiset of (kind, name,
+    selected args) — durations and wall-clock excluded, plus the
+    attempts / server-solve / replay counts."""
+    from pydcop_tpu.telemetry.summary import (
+        load_trace,
+        stitch_requests,
+    )
+
+    stitched = stitch_requests([load_trace(trace_path)])
+    out = {}
+    for tid, req in stitched.items():
+        entries = []
+        for e in req["timeline"]:
+            if e["name"] not in _SOAK_TIMELINE_NAMES:
+                continue
+            args = e["args"]
+            keep = tuple(
+                (k, args[k])
+                for k in ("attempt", "status", "instances", "reason")
+                if k in args
+            )
+            entries.append((e["kind"], e["name"], keep))
+        out[tid] = (
+            tuple(sorted(entries)),
+            req["attempts"],
+            req["server_requests"],
+            req["replays"],
+        )
+    return out
+
+
+def _run_soak(trace_path=None):
     """One soak pass: SOAK_N concurrent wire clients, admission order
     serialized (client i+1 releases once request i is admitted), one
     32-wide tick under combined wire + device chaos.  Returns the
-    per-request (status, cost) outcome sequence."""
+    per-request (status, cost) outcome sequence (plus the normalized
+    stitched timelines when ``trace_path`` is given)."""
     yamls = [ring_yaml(5 + i % 3, name=f"q{i}") for i in range(SOAK_N)]
     results = [None] * SOAK_N
     errors = []
     gates = [threading.Event() for _ in range(SOAK_N)]
     gates[0].set()
-    with SolverService(
+    ctx = session(trace_path) if trace_path else _nullcontext()
+    with ctx, SolverService(
         pad_policy="pow2:16", max_batch=SOAK_N, max_wait=60.0,
         autostart=False, chaos=SOAK_CHAOS, chaos_seed=SOAK_SEED,
     ) as svc:
@@ -607,16 +653,28 @@ def _run_soak():
             stats = svc.stats()
     assert not errors, errors
     assert stats["requests"] == SOAK_N  # retries never re-admitted
-    return [(r["status"], r["cost"]) for r in results]
+    outcomes = [(r["status"], r["cost"]) for r in results]
+    if trace_path is None:
+        return outcomes
+    return outcomes, _normalized_timelines(trace_path)
 
 
-def test_chaos_soak_one_terminal_status_each_and_reproducible():
+class _nullcontext:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+def test_chaos_soak_one_terminal_status_each_and_reproducible(tmp_path):
     """Chaos-soak acceptance: 32 concurrent clients under combined
     wire + device chaos (conn_drop + nan_inject + device_oom) — no
     client hangs, every request ends in exactly ONE terminal status,
     the service keeps serving throughout, and the same seed
-    reproduces the same per-request outcome sequence."""
-    first = _run_soak()
+    reproduces the same per-request outcome sequence AND (ISSUE 14)
+    identical stitched per-request timelines."""
+    first, tl_first = _run_soak(str(tmp_path / "soak1.jsonl"))
     assert len(first) == SOAK_N
     statuses = [s for s, _ in first]
     assert all(s in ("finished", "degraded", "shed") for s in statuses)
@@ -628,8 +686,27 @@ def test_chaos_soak_one_terminal_status_each_and_reproducible():
     assert [i for i, s in enumerate(statuses) if s == "degraded"] == [
         3, 19,
     ]
-    second = _run_soak()
+    # trace-context determinism groundwork: every request stitched,
+    # and a conn_drop retry whose reply was replayed correlates to
+    # the ORIGINAL server spans — exactly ONE service.request per
+    # trace id, never a phantom re-solve
+    assert len(tl_first) >= SOAK_N  # 32 solves (+ shutdown-less ops)
+    retried = [
+        tid
+        for tid, (_e, attempts, _srv, _rp) in tl_first.items()
+        if attempts > 1
+    ]
+    assert retried, "conn_drop=0.3 produced no retries to check"
+    for tid in tl_first:
+        _entries, attempts, server_requests, _replays = tl_first[tid]
+        if attempts:  # a solve request (ops without traces drop out)
+            assert server_requests == 1, (tid, attempts)
+    second, tl_second = _run_soak(str(tmp_path / "soak2.jsonl"))
     assert second == first  # seeded chaos replays outcome-for-outcome
+    # ISSUE 14 satellite: the telemetry plane replays too — same seed
+    # + same admission order ⇒ identical stitched timelines (trace
+    # ids, span multisets, attempt/server-solve/replay counts)
+    assert tl_second == tl_first
 
 
 # -- the serve CLI: SIGTERM drain + --resume ----------------------------
@@ -658,9 +735,11 @@ def test_serve_sigterm_drains_checkpoints_and_flushes_stats(tmp_path):
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     ckpt = str(tmp_path / "sessions.json")
     cache = str(tmp_path / "xla-cache")
+    flight = str(tmp_path / "flight.json")
     args = [
         "--session_checkpoint", ckpt, "--compile_cache", cache,
         "--max_wait", "0.0", "--max_batch", "1",
+        "--flight_dump", flight,
     ]
     proc, head = _spawn_serve(args, env)
     try:
@@ -687,6 +766,14 @@ def test_serve_sigterm_drains_checkpoints_and_flushes_stats(tmp_path):
     doc = json.load(open(ckpt))
     assert [s["name"] for s in doc["sessions"]] == ["plant"]
     assert doc["sessions"][0]["deltas"] == [{"sensor": 2}]
+    # ISSUE 14: the SIGTERM graceful drain also dumped the flight
+    # recorder (no --trace configured), recent spans on board
+    fdoc = json.load(open(flight))
+    assert fdoc["kind"] == "pydcop_tpu-flight"
+    assert fdoc["trigger"] == "drain"
+    assert any(
+        r.get("name") == "service.request" for r in fdoc["records"]
+    )
 
     # restart with --resume: the session replays; a follow-up delta
     # continues the segment sequence with the carried state

@@ -390,3 +390,219 @@ def test_end_metrics_csv_parses_with_dictreader(tmp_path):
     assert rows[0]["status"] == "finished"
     assert float(rows[0]["cost"]) == 1.0
     assert int(rows[0]["cycle"]) == 4
+
+
+# ---------------------------------------------------------------------------
+# serving observability (ISSUE 14): histogram percentiles, the tracer
+# record-cap counter, the flight recorder, the Prometheus exporter,
+# and the trace-context purity contract
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_aggregates_expose_shared_percentiles():
+    """Satellite: result["telemetry"]-style histogram aggregates carry
+    p50/p90/p99 computed by the ONE shared nearest-rank helper, so the
+    serving report and the registry can never disagree on what a
+    percentile means."""
+    from pydcop_tpu.telemetry import MetricsRegistry
+    from pydcop_tpu.telemetry.summary import (
+        _percentile,
+        percentiles_from_histogram,
+    )
+
+    m = MetricsRegistry()
+    sample = [0.0008] * 50 + [0.3] * 45 + [30.0] * 5
+    for v in sample:
+        m.observe("lat", v)
+    h = m.snapshot()["histograms"]["lat"]
+    assert set(h) >= {"buckets", "counts", "sum", "count",
+                      "p50", "p90", "p99"}
+    assert h["p50"] == 0.5  # 0.3 at bucket resolution
+    assert h["p90"] == 0.5
+    assert h["p99"] == 60.0  # the 30s tail bucket
+    # same nearest-rank convention as the raw-sample helper: the
+    # bucket percentile is the upper bound of the bucket holding the
+    # raw percentile
+    for q, key in ((50, "p50"), (90, "p90"), (99, "p99")):
+        raw = _percentile(sample, q)
+        bounds = h["buckets"]
+        expected = next(
+            (b for b in bounds if raw <= b), bounds[-1]
+        )
+        assert h[key] == expected
+    assert percentiles_from_histogram([], [], (50,)) == {"p50": 0.0}
+
+
+def test_session_summary_histograms_carry_percentiles():
+    from pydcop_tpu.telemetry import get_metrics, session
+
+    with session() as tel:
+        for v in (0.01, 0.02, 0.4):
+            get_metrics().observe("x.y_s", v)
+        out = tel.summary()
+    assert out["histograms"]["x.y_s"]["p50"] == 0.05
+
+
+def test_tracer_cap_emits_counter_and_flight_ring_overwrites():
+    """Satellite: past the 1M-record cap the tracer (a) counts every
+    dropped record on `telemetry.dropped_records` LIVE, not only in
+    the meta line at close, and (b) the flight-recorder ring still
+    sees every record — it overwrites its oldest, never drops."""
+    from pydcop_tpu.telemetry import get_metrics, session
+
+    with session() as tel:
+        tel.tracer.max_records = 4
+        for i in range(12):
+            tel.tracer.event(f"e{i}", cat="test")
+        assert tel.tracer.dropped == 8
+        counters = get_metrics().snapshot()["counters"]
+        assert counters["telemetry.dropped_records"] == 8
+        ring_names = [
+            r["name"]
+            for r in tel.flight.snapshot()
+            if r.get("kind") == "event" and r.get("cat") == "test"
+        ]
+        # the ring holds the NEWEST records, cap or no cap
+        assert ring_names[-3:] == ["e9", "e10", "e11"]
+        # the counter deltas the registry mirrored are on the ring too
+        assert any(
+            r.get("kind") == "counter"
+            and r.get("name") == "telemetry.dropped_records"
+            for r in tel.flight.snapshot()
+        )
+        out = tel.summary()
+    assert out["dropped_records"] == 8
+
+
+def test_flight_recorder_dump_roundtrip_and_render(tmp_path):
+    from pydcop_tpu.telemetry import get_metrics, get_tracer, session
+    from pydcop_tpu.telemetry.context import trace_scope
+    from pydcop_tpu.telemetry.flightrec import format_dump, load_dump
+
+    path = str(tmp_path / "flight.json")
+    with session() as tel:
+        get_metrics().inc("service.requests")
+        with trace_scope(["tr-feed"]):
+            get_tracer().event(
+                "nan_inject", cat="fault", link="engine.chunk[1]"
+            )
+            with get_tracer().span("service.dispatch", cat="service"):
+                pass
+        get_tracer().event("service-shed", cat="service")
+        doc = tel.flight.dump(path, "quarantine", trace_id="tr-feed")
+        assert (
+            get_metrics().snapshot()["counters"][
+                "telemetry.flight_dumps"
+            ]
+            == 1
+        )
+    loaded = load_dump(path)
+    assert loaded["trigger"] == "quarantine"
+    assert loaded["trace_id"] == "tr-feed"
+    assert len(loaded["records"]) == len(doc["records"])
+    text = format_dump(loaded)
+    assert "trigger='quarantine'" in text
+    assert "trace=tr-feed" in text
+    # the triggering request's records are flagged, others are not
+    flagged = [
+        line for line in text.splitlines() if line.startswith("*")
+    ]
+    assert any("nan_inject" in line for line in flagged)
+    assert any("service.dispatch" in line for line in flagged)
+    assert not any("service-shed" in line for line in flagged)
+    # --tail bounds the rendering
+    tail = format_dump(loaded, tail=1)
+    assert "older records" in tail
+
+
+def test_flight_dump_cli_renders(tmp_path, capsys):
+    from pydcop_tpu.cli import main
+    from pydcop_tpu.telemetry import session
+
+    path = str(tmp_path / "fl.json")
+    with session() as tel:
+        tel.tracer.event("service-shed", cat="service")
+        tel.flight.dump(path, "shed", trace_id="tr-x")
+    assert main(["flight-dump", path]) == 0
+    out = capsys.readouterr().out
+    assert "trigger='shed'" in out and "service-shed" in out
+    with pytest.raises(SystemExit):
+        main(["flight-dump", str(tmp_path / "missing.json")])
+
+
+def test_prometheus_text_round_trip():
+    from pydcop_tpu.telemetry import MetricsRegistry
+    from pydcop_tpu.telemetry.export import (
+        parse_prometheus_text,
+        prometheus_text,
+    )
+
+    m = MetricsRegistry()
+    m.inc("service.requests", 7)
+    m.gauge("service.queue_depth", 3)
+    for v in (0.002, 0.02, 0.2, 2.0):
+        m.observe("service.latency_s", v)
+    text = prometheus_text(m.snapshot())
+    parsed = parse_prometheus_text(text)
+    assert parsed["pydcop_service_requests_total"] == 7
+    assert parsed["pydcop_service_queue_depth"] == 3
+    hist = parsed["pydcop_service_latency_s_bucket"]
+    # cumulative buckets, +Inf == count
+    assert hist['le="+Inf"'] == 4
+    assert parsed["pydcop_service_latency_s_count"] == 4
+    assert parsed["pydcop_service_latency_s_sum"] == pytest.approx(
+        2.222
+    )
+    # the serving percentiles ride along as gauges (nearest-rank over
+    # 4 samples puts p50 at the third value, 0.2 → the 0.5 bucket)
+    assert parsed["pydcop_service_latency_s_p50"] == 0.5
+    # cumulative monotonicity across the rendered bucket lines
+    cum = [
+        v
+        for _k, v in sorted(
+            hist.items(),
+            key=lambda kv: float(
+                kv[0].split("=")[1].strip('"').replace("+Inf", "inf")
+            ),
+        )
+    ]
+    assert cum == sorted(cum)
+    # strictness: a garbage line is a parse error, not a zero
+    with pytest.raises(ValueError):
+        parse_prometheus_text("this is { not a sample\n")
+
+
+def test_trace_context_ids_are_pure_and_scope_tags():
+    from pydcop_tpu.telemetry import get_tracer, session
+    from pydcop_tpu.telemetry.context import (
+        attempt_span_id,
+        mint_trace_id,
+        parse_wire_trace,
+        trace_scope,
+        wire_trace,
+    )
+
+    # pure: same inputs, same ids — the determinism the stitched-
+    # timeline soak contract rides on
+    assert mint_trace_id("c7", 3) == mint_trace_id("c7", 3)
+    assert mint_trace_id("c7", 3) != mint_trace_id("c7", 4)
+    assert attempt_span_id("tr-x", 1) != attempt_span_id("tr-x", 2)
+    wt = wire_trace("tr-x", 2)
+    assert parse_wire_trace(wt) == ("tr-x", wt["span"], 2)
+    assert parse_wire_trace({"span": "sp-only"}) is None
+    assert parse_wire_trace("nonsense") is None
+    with session() as tel:
+        tr = get_tracer()
+        with trace_scope(["tr-a", "tr-b"]):
+            tr.event("grouped", cat="test")
+            with trace_scope(["tr-c"]):  # nesting: innermost wins
+                tr.event("inner", cat="test")
+        tr.event("untagged", cat="test")
+        recs = {
+            r["name"]: (r.get("args") or {}).get("trace")
+            for r in tel.tracer._records
+            if r.get("kind") == "event"
+        }
+    assert recs["grouped"] == ["tr-a", "tr-b"]
+    assert recs["inner"] == "tr-c"
+    assert recs["untagged"] is None

@@ -8,7 +8,7 @@ Five rule families (docs/linting.md has the catalog):
 - :mod:`graftlint.rules.chaos` — ``chaos-symmetry``,
   ``chaos-inert-field``
 - :mod:`graftlint.rules.telemetry` — ``metric-undocumented``,
-  ``metric-stale-doc``, ``chaos-clause-doc``
+  ``metric-stale-doc``, ``chaos-clause-doc``, ``span-undocumented``
 - :mod:`graftlint.rules.tracekeys` — ``bare-jit``,
   ``unhashable-closure``
 """

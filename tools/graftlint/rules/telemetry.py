@@ -20,6 +20,17 @@ PR, which this rule enforces for every family still partially alive.
 registered kind (stale spec rows mislead chaos users into writing
 specs that raise).
 
+``span-undocumented`` — every span/event family that ``trace-summary``
+FOLDS (the names ``telemetry/summary.py`` special-cases when
+aggregating or stitching: comparisons against the record ``name``,
+``name.startswith`` prefixes, the ``*_SPAN`` constants, dotted
+``.get`` keys on span tables) must appear in the documentation
+registry.  The folded names are the observable vocabulary of the
+serving reports and the ``--requests`` stitcher — an undocumented one
+is a report row operators cannot interpret.  Extraction is from the
+summary module's AST, so a new folded family is discovered the moment
+the fold lands.
+
 F-string emissions (``met.inc(f"fault.{kind}")``) become wildcard
 names (``fault.*``): any documented name under the prefix matches, and
 the doc may document the family as ``fault.<kind>``.
@@ -216,6 +227,132 @@ def check_stale_doc_metrics(ctx):
                     f"documented metric `{name}` is emitted by no "
                     "call site — delete the stale row or restore the "
                     "emission"
+                ),
+                detail=name,
+            )
+
+
+#: span/event-name shape: dotted (`service.request`) or dashed
+#: (`service-replay`, `chaos-plan`) lowercase families — what the
+#: tracer's built-in instrumentation uses
+_SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*[.-][a-z0-9_.<>*-]+$")
+
+
+def folded_span_names(summary_mod) -> Dict[str, int]:
+    """Span/event names ``telemetry/summary.py`` folds, extracted
+    from its AST: ``name == "literal"`` / ``name != "literal"`` /
+    ``name in ("...", ...)`` comparisons, ``name.startswith("pfx.")``
+    prefixes (→ ``pfx.*`` wildcards), module-level ``*_SPAN``
+    constants, and dotted ``.get("...")`` span-table keys.  Returns
+    ``{name_or_wildcard: first_line}``."""
+    out: Dict[str, int] = {}
+    consts: Dict[str, str] = {}
+    tree = summary_mod.tree
+
+    def note(value: str, lineno: int) -> None:
+        if _SPAN_NAME_RE.match(value):
+            out.setdefault(value, lineno)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            # CLIENT_REQUEST_SPAN = "client.request" — the stitcher's
+            # named constants
+            if (
+                isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        consts[tgt.id] = node.value.value
+                        if tgt.id.endswith("_SPAN"):
+                            note(node.value.value, node.lineno)
+        elif isinstance(node, ast.Compare):
+            operands = [node.left, *node.comparators]
+            if not any(
+                isinstance(op, (ast.Eq, ast.NotEq, ast.In))
+                for op in node.ops
+            ):
+                continue
+            for operand in operands:
+                if isinstance(operand, ast.Constant) and isinstance(
+                    operand.value, str
+                ):
+                    note(operand.value, node.lineno)
+                elif isinstance(operand, (ast.Tuple, ast.List)):
+                    for elt in operand.elts:
+                        if isinstance(
+                            elt, ast.Constant
+                        ) and isinstance(elt.value, str):
+                            note(elt.value, node.lineno)
+                elif isinstance(operand, ast.Name):
+                    ref = consts.get(operand.id)
+                    if ref is not None:
+                        note(ref, node.lineno)
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            if (
+                node.func.attr == "startswith"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                pfx = node.args[0].value
+                if _SPAN_NAME_RE.match(pfx + "*"):
+                    out.setdefault(pfx + "*", node.lineno)
+            elif (
+                node.func.attr == "get"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and "." in node.args[0].value
+            ):
+                note(node.args[0].value, node.lineno)
+    return out
+
+
+@rule(
+    "span-undocumented",
+    "every span family trace-summary folds must appear in the "
+    "documentation registry",
+)
+def check_undocumented_spans(ctx):
+    summary_mod = ctx.module(ctx.config.trace_summary_module)
+    if summary_mod is None:
+        return
+    names = folded_span_names(summary_mod)
+    # doc side: every code-span token in the registry docs counts —
+    # a family wildcard (`semiring.*`) is documented by any token
+    # under its prefix (`semiring.contract`) or the `<...>` form
+    doc_tokens: Set[str] = set()
+    for rel in ctx.config.metrics_docs:
+        text = ctx.doc_text(rel)
+        if text is None:
+            continue
+        for line in text.splitlines():
+            for m in _CODE_SPAN_RE.finditer(line):
+                tok = m.group(1).strip()
+                doc_tokens.add(re.sub(r"<[^>]*>", "*", tok))
+    docs = " + ".join(ctx.config.metrics_docs)
+    for name, line in sorted(names.items()):
+        if name.endswith("*"):
+            stem = name[:-1]
+            covered = any(
+                t == name or (t.startswith(stem) and t != name)
+                for t in doc_tokens
+            )
+        else:
+            covered = name in doc_tokens
+        if not covered:
+            yield Finding(
+                rule="span-undocumented",
+                path=summary_mod.relpath,
+                line=line,
+                message=(
+                    f"trace-summary folds span family `{name}` but "
+                    f"it is documented nowhere in {docs} — add the "
+                    "row (a report whose rows aren't documented "
+                    "can't be read)"
                 ),
                 detail=name,
             )

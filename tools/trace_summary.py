@@ -21,7 +21,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("trace_file", help="trace file (jsonl or chrome)")
+    p.add_argument(
+        "trace_file", nargs="+",
+        help="trace file(s) (jsonl or chrome)",
+    )
+    p.add_argument(
+        "--requests", action="store_true",
+        help="stitch per-request timelines across the given files by "
+        "wire-propagated trace id (docs/observability.md)",
+    )
     p.add_argument(
         "--json", action="store_true",
         help="print the aggregates as JSON instead of a table",
@@ -29,20 +37,31 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     from pydcop_tpu.telemetry.summary import (
+        format_requests,
         format_summary,
         load_trace,
+        stitch_requests,
         summarize,
     )
 
     try:
-        s = summarize(load_trace(args.trace_file))
+        tracesets = [load_trace(f) for f in args.trace_file]
+        if args.requests:
+            out = stitch_requests(tracesets)
+            text = format_requests(out)
+        else:
+            if len(tracesets) > 1:
+                raise ValueError(
+                    "several trace files only combine under "
+                    "--requests"
+                )
+            out = summarize(tracesets[0])
+            text = format_summary(out)
     except (OSError, ValueError) as e:
         print(f"trace-summary: {e}", file=sys.stderr)
         return 2
     print(
-        json.dumps(s, indent=2, default=str)
-        if args.json
-        else format_summary(s)
+        json.dumps(out, indent=2, default=str) if args.json else text
     )
     return 0
 
