@@ -122,6 +122,17 @@ algo_params: list = [
     # UTIL/VALUE machinery — device certificates included — runs
     # unchanged per assignment)
     AlgoParameterDef("memory_bound", "int", None, 0),
+    # branch-and-bound pruned UTIL joins (ops/semiring.py, the
+    # two-pass ⊕-bounded contraction kernels — docs/semirings.md
+    # "Branch-and-bound pruning"): 'auto' (default) prunes device
+    # dispatches whose per-row padded table clears
+    # BNB_AUTO_MIN_CELLS, 'on' prunes every device dispatch, 'off'
+    # keeps the single-pass kernels.  Results are BIT-IDENTICAL
+    # either way — pruned rows provably cannot enter the optimum
+    # (greedy-incumbent + rest-bound argument, f32 slack folded into
+    # the budget) — pruning only skips dead certification/
+    # re-evaluation work and dead tie-repairs.
+    AlgoParameterDef("bnb", "str", ["auto", "on", "off"], "auto"),
     # memory-bounded exact mode, planner edition (ops/membound.py):
     # cap every UTIL/message TABLE at this many f32 BYTES by
     # conditioning a minimal cut set chosen on the bucket-tree plan
@@ -256,6 +267,7 @@ def solve_host(
 
     device_min_cells = _resolve_device_min_cells(params)
     level_sync = params.get("util_batch", "level") != "node"
+    bnb = _semiring.as_bnb(params.get("bnb"), "auto")
 
     from pydcop_tpu.telemetry import get_tracer
 
@@ -270,7 +282,7 @@ def solve_host(
             graph, domains_p, depth, owned_p, t0, timeout,
             device_min_cells=device_min_cells,
             max_util_size=max_util_size,
-            pad=pad, level_sync=level_sync,
+            pad=pad, level_sync=level_sync, bnb=bnb,
         )
         if util_stats is None:
             return None
@@ -438,7 +450,11 @@ def solve_host_many(
 
     preps = {i: _prepare_instance(dcops[i]) for i in merged_idx}
     insts = [
-        _UtilInstance(*preps[i], _resolve_device_min_cells(params_list[i]))
+        _UtilInstance(
+            *preps[i],
+            _resolve_device_min_cells(params_list[i]),
+            _semiring.as_bnb(params_list[i].get("bnb"), "auto"),
+        )
         for i in merged_idx
     ]
     # 'node' on ANY instance de-batches the whole merged sweep — the
@@ -614,6 +630,41 @@ def _plan_conditioning(
         cut.append(min(cands, key=lambda d: (depth[d], d)))
 
 
+def _max_padded_util_cells(inst: "_UtilInstance", pad) -> int:
+    """Dims-only upper bound on the instance's largest PADDED UTIL
+    join — the quantity ``bnb='auto'`` gates on (the semiring twin is
+    ``ops.semiring.max_padded_join_cells``): the O(nodes·width)
+    separator simulation, sized on the pad lattice, so the sweep can
+    skip building a pruning context on instances where no dispatch
+    can ever clear ``BNB_AUTO_MIN_CELLS``."""
+    from pydcop_tpu.ops.padding import bucket_util_shape
+
+    dsize = {
+        v: bucket_util_shape((len(dom),), pad)[0]
+        for v, dom in inst.domains.items()
+    }
+    names = [
+        n
+        for root in inst.graph.roots
+        for n in inst.graph.depth_first_order(root)
+    ]
+    util_dims: Dict[str, set] = {}
+    mx = 1
+    for name in reversed(names):  # children before parents
+        node = inst.graph.node(name)
+        sep: set = set()
+        for dims, _ in inst.owned[name]:
+            sep |= {d for d in dims if d != name}
+        for c in node.children:
+            sep |= util_dims[c] - {name}
+        util_dims[name] = sep
+        size = dsize[name]
+        for d in sep:
+            size *= dsize[d]
+        mx = max(mx, size)
+    return mx
+
+
 class _PrecisionFallback(Exception):
     """Raised when an f32 decision margin fails its error bound."""
 
@@ -632,6 +683,7 @@ class _UtilInstance(NamedTuple):
     depth: Dict[str, int]
     owned: Dict[str, List[Tuple[List[str], np.ndarray]]]
     device_min_cells: Optional[int]  # None = host-only instance
+    bnb: str = "off"  # branch-and-bound pruning mode (algo param)
 
 
 def _util_phase(
@@ -645,12 +697,17 @@ def _util_phase(
     max_util_size: int = 1 << 26,
     pad: PadPolicy = NO_PADDING,
     level_sync: bool = True,
+    bnb: str = "off",
 ):
     """Single-instance UTIL phase: the K=1 case of
     :func:`_util_phase_multi`.  Returns ``(best_choice, util_cells,
     device_nodes, host_nodes, dispatches)`` or None on timeout."""
     outs = _util_phase_multi(
-        [_UtilInstance(graph, domains, depth, owned, device_min_cells)],
+        [
+            _UtilInstance(
+                graph, domains, depth, owned, device_min_cells, bnb
+            )
+        ],
         t0, timeout, max_util_size=max_util_size,
         pad=pad, level_sync=level_sync,
     )
@@ -707,9 +764,10 @@ def _util_phase_multi(
         DeviceOOMError,
         get_supervisor,
     )
-    from pydcop_tpu.telemetry import get_metrics
+    from pydcop_tpu.telemetry import get_metrics, get_tracer
 
     met = get_metrics()
+    tracer = get_tracer()
     sup = get_supervisor()
     K = len(insts)
     utils: List[Dict[str, Tuple[List[str], np.ndarray]]] = [
@@ -724,6 +782,54 @@ def _util_phase_multi(
     dispatches = [0] * K
     _key_memo: Dict[tuple, tuple] = {}  # per-call: pad is fixed here
 
+    # branch-and-bound context per instance (ops/semiring.py): the
+    # greedy incumbent, per-part rest bounds keyed by pseudo-tree
+    # subtree, and the applied-shift ledger the per-node budgets need.
+    # obs_cells/obs_pruned track the RUNNING pruned fraction of this
+    # call: the host-compact escape (pass 1 on host, pass 2 over the
+    # survivors only) pays only on heavily-pruned sweeps, so it is
+    # attempted only once the observed fraction clears BNB_HOST_FRAC
+    # — a sweep that prunes nothing pays only the masked kernel's
+    # fixed delta, never a host-side pass 1
+    obs = {"cells": 0, "pruned": 0}
+
+    def try_host_pass2() -> bool:
+        return (
+            obs["cells"] >= (1 << 15)
+            and obs["pruned"]
+            >= _semiring.BNB_HOST_FRAC * obs["cells"]
+        )
+
+    ctxs: List[Any] = [None] * K
+    for k, inst in enumerate(insts):
+        if inst.bnb != "off" and inst.device_min_cells is not None:
+            if (
+                inst.bnb == "auto"
+                and _max_padded_util_cells(inst, pad)
+                < _semiring.BNB_AUTO_MIN_CELLS
+            ):
+                # no join of this instance can ever clear the auto
+                # threshold — skip the (greedy incumbent + extrema)
+                # context build entirely, recorded once as a
+                # call-level skip (small solves must not pay for
+                # pruning that cannot happen)
+                if met.enabled:
+                    met.inc("semiring.bnb_skipped_small")
+                continue
+            names_pre = [
+                n
+                for root in inst.graph.roots
+                for n in inst.graph.depth_first_order(root)
+            ]
+            ctxs[k] = _semiring._BnbContext(
+                _semiring.MIN_SUM, names_pre, inst.domains,
+                inst.owned,
+                {
+                    n: list(inst.graph.node(n).children)
+                    for n in names_pre
+                },
+            )
+
     def finish(k, name, node, sep, u, amin):
         # min-normalize the outgoing table (either path): argmin
         # decisions are shift-invariant, the final cost comes from
@@ -732,12 +838,22 @@ def _util_phase_multi(
         # (which scale with max|J|) small up the whole tree.  The
         # normalized table is >= 0, so its max IS its abs-max — carry
         # it so the parent's certificate bound needs no re-reduction
+        # (finite-masked: bnb-pruned rows and hard constraints hold
+        # exact ±inf, which is structure, not a rounding scale).
         best_choice[k][name] = (sep, amin)
+        sh = 0.0
         if node.parent is not None:
             if u.size:
-                u = u - u.min()
-            utils[k][name] = (sep, u, float(u.max(initial=0.0)))
+                mn = u.min()
+                if np.isfinite(mn):
+                    sh = float(mn)
+                    u = u - mn
+            utils[k][name] = (sep, u, _semiring._finite_amax(u))
             util_cells[k] += u.size
+        if ctxs[k] is not None:
+            ctxs[k].record_shift(
+                name, sh, insts[k].graph.node(name).children
+            )
 
     # wave plan: wave index = node HEIGHT (longest path down to a
     # leaf), not depth — a node's children have strictly smaller
@@ -800,12 +916,11 @@ def _util_phase_multi(
                 own_parts = [(odims, o)]
             for dims, table in own_parts:
                 parts.append((dims, table))
-                parts_max += float(
-                    max(
-                        table.max(initial=0.0),
-                        -table.min(initial=0.0),
-                    )
-                )
+                # finite-masked |max|: ±inf hard-constraint entries
+                # are EXACT in f32 — an inf scale would void every
+                # certificate and drag hard-capped instances off the
+                # device wholesale
+                parts_max += _semiring._finite_amax(table)
                 sep.extend(d for d in dims if d != name)
             for child in node.children:
                 cdims, ctable, cmax = utils[k][child]
@@ -848,19 +963,34 @@ def _util_phase_multi(
             # one ulp of relative rounding, noise against the bound's
             # (#parts+1) slack.
             sum_max_abs = parts_max
-            raw = (tuple(shape), tuple(a.shape for a in aligned))
+            ctx = ctxs[k]
+            budget = None
+            if ctx is not None:
+                budget = ctx.budget(
+                    name,
+                    ctx.shift_under(node.children),
+                    len(parts), parts_max, shape[-1],
+                    size // max(shape[-1], 1),
+                )
+            # the bnb MODE joins the bucket key: a merged sweep can
+            # mix bnb=on/auto/off instances, and a pruned kernel's
+            # signature (leading budget operand, keep output) must
+            # never share a bucket with the single-pass one
+            mode = inst.bnb if ctx is not None else "off"
+            raw = (tuple(shape), tuple(a.shape for a in aligned),
+                   mode)
             key = _key_memo.get(raw)
             if key is None:  # UTIL trees repeat shapes heavily —
                 # memoize the lattice quantization per raw signature
                 key = _key_memo[raw] = util_level_key(
                     raw[0], raw[1], pad
-                )
+                ) + (mode,)
             if key not in buckets:
                 buckets[key] = []
                 order.append(key)
             buckets[key].append(
                 ((k, name, node, sep, target, shape, parts,
-                  sum_max_abs), aligned)
+                  sum_max_abs, budget), aligned)
             )
 
         # -- device joins: one vmapped dispatch per level-pack bucket.
@@ -876,11 +1006,24 @@ def _util_phase_multi(
             entries = buckets[key]
             if timeout is not None and time.perf_counter() - t0 > timeout:
                 return None
-            pshape, part_shapes = key
+            pshape, part_shapes, bnb_mode = key
             n_rows = len(entries)
             shape0 = entries[0][0][5]
             uniform = all(it[5] == shape0 for it, _ in entries)
+            use_bnb = False
+            if bnb_mode != "off":
+                use_bnb = bnb_mode == "on" or (
+                    int(np.prod(pshape))
+                    >= _semiring.BNB_AUTO_MIN_CELLS
+                )
+                if not use_bnb and met.enabled:
+                    met.inc("semiring.bnb_skipped_small")
+            # finite no-prune sentinel: joint-infeasible rows
+            # (+inf bound) prune even without a usable incumbent
+            noprune = float(np.finfo(np.float32).max) / 2
             level_batched = False
+            host_compacted = False
+            obs_counted = False
             if level_sync and n_rows > 1 and uniform:
                 # stack height bucketed pow-2 under a pad policy
                 # (ghost rows stay zero, discarded below): the
@@ -906,23 +1049,143 @@ def _util_phase_multi(
                         ] = a
                     if pad.enabled:  # own-axis ghost guard (mask)
                         bufs[-1][r][..., shape0[-1]:] = np.inf
-                fn = _join_kernel(pshape, part_shapes, batched=True)
+                budgets = None
+                if use_bnb and bufs:
+                    budgets = np.full(stack_h, noprune)
+                    for r, (item, _) in enumerate(entries):
+                        b = item[8]
+                        budgets[r] = b if b is not None else noprune
+                if use_bnb and bufs and try_host_pass2():
+                    # pass 1 on the HOST over the exact f64 parts —
+                    # each part pre-reduced over its own axis ONCE:
+                    # when most rows are provably dead, pass 2 runs
+                    # as a COMPACT host contraction of the survivors
+                    # (exact f64 min+argmin — no dispatch, no
+                    # certificate, no dense re-evaluation glue); the
+                    # masked device kernel handles the low-pruning
+                    # buckets below.  Only attempted once the
+                    # sweep's OBSERVED pruned fraction clears the
+                    # threshold — pass 1 itself costs a join-sized
+                    # reduce when a child message spans the
+                    # separator, so it must not run speculatively
+                    keep_b = np.empty(
+                        (n_rows,) + tuple(shape0[:-1]), dtype=bool
+                    )
+                    with np.errstate(invalid="ignore"):
+                        for r, (item, aligned) in enumerate(
+                            entries
+                        ):
+                            rb = np.zeros(tuple(shape0[:-1]))
+                            for a in aligned:
+                                rb = rb + np.min(a, axis=-1)
+                            keep_b[r] = np.logical_not(
+                                rb > budgets[r]
+                            )
+                    n_surv = int(keep_b.sum())
+                    pruned_cells = (
+                        keep_b.size - n_surv
+                    ) * shape0[-1]
+                    # the host bound already observed this bucket —
+                    # the kernel path below must not count it twice
+                    # (a near-threshold sweep would see 2x cells and
+                    # a biased fraction)
+                    obs["cells"] += keep_b.size * shape0[-1]
+                    obs["pruned"] += pruned_cells
+                    obs_counted = True
+                    if (
+                        keep_b.size - n_surv
+                        >= _semiring.BNB_HOST_FRAC * keep_b.size
+                    ):
+                        coords = np.nonzero(keep_b)
+                        w_own = pshape[-1]
+                        M = np.zeros((n_surv, 1))
+                        for i, bf in enumerate(bufs):
+                            ps = part_shapes[i]
+                            idx: list = [coords[0]]
+                            for j in range(len(shape0) - 1):
+                                idx.append(
+                                    coords[1 + j]
+                                    if ps[j] != 1
+                                    else 0
+                                )
+                            M = M + bf[tuple(idx)]
+                        if M.shape[1] == 1:
+                            M = np.broadcast_to(
+                                M, (n_surv, w_own)
+                            )
+                        u_b = np.full(
+                            (n_rows,) + tuple(shape0[:-1]), np.inf
+                        )
+                        amin_b = np.zeros(
+                            (n_rows,) + tuple(shape0[:-1]),
+                            dtype=np.intp,
+                        )
+                        if n_surv:
+                            u_b[coords] = M.min(axis=1)
+                            amin_b[coords] = M.argmin(axis=1)
+                        if met.enabled:
+                            met.inc("semiring.bnb_passes")
+                            if pruned_cells:
+                                met.inc(
+                                    "semiring.bnb_pruned_cells",
+                                    pruned_cells,
+                                )
+                        if tracer.enabled:
+                            tracer.event(
+                                "semiring-bnb", cat="supervisor",
+                                semiring="min_sum", rows=n_rows,
+                                pruned_cells=pruned_cells,
+                                table_cells=int(np.prod(shape0))
+                                * n_rows, pass2="host",
+                            )
+                        for r, (item, aligned) in enumerate(
+                            entries
+                        ):
+                            (k, name, node, sep, target, shape,
+                             parts, sum_max_abs, _budget) = item
+                            amin_r = amin_b[r:r + 1].reshape(
+                                tuple(shape[:-1])
+                            )
+                            host_nodes[k] += 1
+                            finish(
+                                k, name, node, sep, u_b[r], amin_r
+                            )
+                        host_compacted = True
+                if host_compacted:
+                    continue
+                fn = _join_kernel(
+                    pshape, part_shapes, batched=True, bnb=use_bnb
+                )
                 casts = [b.astype(np.float32) for b in bufs]
+                if use_bnb:
+                    budgets32 = (
+                        budgets.astype(np.float32)
+                        if budgets is not None
+                        else np.full(
+                            stack_h, noprune, dtype=np.float32
+                        )
+                    )
+                    casts = [budgets32] + casts
+                keepb = None
                 try:
-                    # pull BOTH outputs to host numpy INSIDE the
+                    # pull the outputs to host numpy INSIDE the
                     # supervised call, in one transfer each before
                     # any slicing — a per-access device slice would
                     # cost a dispatch each, and with async dispatch a
                     # runtime failure only surfaces at the sync
                     # point, which must be where the supervisor
                     # classifies it
-                    aminb, margb = sup.dispatch(
+                    outs_b = sup.dispatch(
                         lambda: tuple(
                             np.asarray(x) for x in fn(*casts)
                         ),
                         scope="dpop.level", width=stack_h,
                         table_bytes=4 * int(np.prod(pshape)),
                     )
+                    if use_bnb:
+                        aminb, margb, keepb = outs_b
+                    else:
+                        aminb, margb = outs_b
                     level_batched = True
                 except DeviceOOMError:
                     # OOM degradation ladder: a level stack that does
@@ -946,6 +1209,30 @@ def _util_phase_multi(
                 )
                 amin_b = np.array(aminb[region])  # writable (repair)
                 marg_b = np.asarray(margb[region], dtype=np.float64)
+                keep_b = None
+                if use_bnb:
+                    keep_b = np.asarray(keepb[region], dtype=bool)
+                    pruned_cells = int(
+                        keep_b.size - keep_b.sum()
+                    ) * shape0[-1]
+                    if not obs_counted:
+                        obs["cells"] += keep_b.size * shape0[-1]
+                        obs["pruned"] += pruned_cells
+                    if met.enabled:
+                        met.inc("semiring.bnb_passes")
+                        if pruned_cells:
+                            met.inc(
+                                "semiring.bnb_pruned_cells",
+                                pruned_cells,
+                            )
+                    if tracer.enabled:
+                        tracer.event(
+                            "semiring-bnb", cat="supervisor",
+                            semiring="min_sum", rows=n_rows,
+                            pruned_cells=pruned_cells,
+                            table_cells=int(np.prod(shape0))
+                            * n_rows,
+                        )
                 errs = np.array(
                     [
                         2.0 * _EPS32 * (len(it[6]) + 1) * it[7]
@@ -979,7 +1266,7 @@ def _util_phase_multi(
                         _host_redo(met, host_nodes, finish, item)
                         redone.add(r)
                         continue
-                    (_, _, _, _, target, shape, parts, _) = item
+                    (_, _, _, _, target, shape, parts, _, _) = item
                     amin_r = amin_b[r:r + 1].reshape(
                         tuple(shape[:-1])
                     )
@@ -997,22 +1284,53 @@ def _util_phase_multi(
                 # the parts order, so values are bit-identical to
                 # the per-node _exact_u_at
                 n_raw = len(entries[0][1])
-                rows_ix = np.arange(n_rows).reshape(
-                    (n_rows,) + (1,) * (len(shape0) - 1)
-                )
-                u_b = np.zeros((n_rows,) + tuple(shape0[:-1]))
-                for i in range(n_raw):
-                    ps = part_shapes[i]
-                    idx: list = [rows_ix]
-                    for j in range(len(shape0) - 1):
-                        idx.append(grids[j] if ps[j] != 1 else 0)
-                    idx.append(amin_b if ps[-1] != 1 else 0)
-                    u_b += bufs[i][tuple(idx)]
+                if (
+                    keep_b is not None
+                    and 4 * int(keep_b.sum()) < 3 * keep_b.size
+                ):
+                    # >=25% pruned: the compact survivor gather
+                    # already beats the dense fancy-index (measured
+                    # break-even ~25% on this box)
+                    # most rows pruned: gather the exact f64 values
+                    # at the SURVIVORS only — O(survivors·parts)
+                    # instead of O(cells·parts) host work, the glue
+                    # half of the two-pass win (pruned cells read
+                    # +inf, the ⊕-identity)
+                    coords = np.nonzero(keep_b)
+                    a_sel = amin_b[coords]
+                    acc = np.zeros(len(coords[0]))
+                    for i in range(n_raw):
+                        ps = part_shapes[i]
+                        idx: list = [coords[0]]
+                        for j in range(len(shape0) - 1):
+                            idx.append(
+                                coords[1 + j] if ps[j] != 1 else 0
+                            )
+                        idx.append(a_sel if ps[-1] != 1 else 0)
+                        acc += bufs[i][tuple(idx)]
+                    u_b = np.full(
+                        (n_rows,) + tuple(shape0[:-1]), np.inf
+                    )
+                    u_b[coords] = acc
+                else:
+                    rows_ix = np.arange(n_rows).reshape(
+                        (n_rows,) + (1,) * (len(shape0) - 1)
+                    )
+                    u_b = np.zeros((n_rows,) + tuple(shape0[:-1]))
+                    for i in range(n_raw):
+                        ps = part_shapes[i]
+                        idx = [rows_ix]
+                        for j in range(len(shape0) - 1):
+                            idx.append(grids[j] if ps[j] != 1 else 0)
+                        idx.append(amin_b if ps[-1] != 1 else 0)
+                        u_b += bufs[i][tuple(idx)]
+                    if keep_b is not None:
+                        u_b = np.where(keep_b, u_b, np.inf)
                 for r, (item, aligned) in enumerate(entries):
                     if r in redone:
                         continue
                     (k, name, node, sep, target, shape, parts,
-                     sum_max_abs) = item
+                     sum_max_abs, _budget) = item
                     amin_r = amin_b[r:r + 1].reshape(
                         tuple(shape[:-1])
                     )
@@ -1023,15 +1341,91 @@ def _util_phase_multi(
             # per-node dispatches: util_batch='node', singleton
             # buckets, or (rare) mixed real shapes under one padded
             # key
-            fn = _join_kernel(pshape, part_shapes)
+            fn = _join_kernel(pshape, part_shapes, bnb=use_bnb)
             for item, aligned in entries:
                 (k, name, node, sep, target, shape, parts,
-                 sum_max_abs) = item
+                 sum_max_abs, budget) = item
                 if (
                     timeout is not None
                     and time.perf_counter() - t0 > timeout
                 ):
                     return None
+                node_obs_counted = False
+                if (
+                    use_bnb and aligned and len(shape) > 1
+                    and try_host_pass2()
+                ):
+                    # pass 1 on host (exact f64, parts pre-reduced
+                    # over the own axis once); a mostly-dead node
+                    # runs pass 2 as the compact host contraction of
+                    # its surviving rows instead of dispatching —
+                    # attempted only once the sweep's observed
+                    # pruned fraction supports it (stacked-branch
+                    # comment)
+                    with np.errstate(invalid="ignore"):
+                        rowb = np.zeros(tuple(shape[:-1]))
+                        for a in aligned:
+                            rowb = rowb + np.min(a, axis=-1)
+                        keep_r = np.logical_not(
+                            rowb
+                            > (budget if budget is not None
+                               else noprune)
+                        )
+                    n_surv = int(keep_r.sum())
+                    pruned_cells = (
+                        keep_r.size - n_surv
+                    ) * shape[-1]
+                    # observed here — the kernel fall-through below
+                    # must not count this node twice
+                    obs["cells"] += keep_r.size * shape[-1]
+                    obs["pruned"] += pruned_cells
+                    node_obs_counted = True
+                    if (
+                        keep_r.size - n_surv
+                        >= _semiring.BNB_HOST_FRAC * keep_r.size
+                    ):
+                        coords = np.nonzero(keep_r)
+                        M = np.zeros((n_surv, 1))
+                        for a in aligned:
+                            idx: list = []
+                            for j in range(len(shape) - 1):
+                                idx.append(
+                                    coords[j]
+                                    if a.shape[j] != 1
+                                    else 0
+                                )
+                            M = M + np.asarray(
+                                a, dtype=np.float64
+                            )[tuple(idx)]
+                        if M.shape[1] == 1:
+                            M = np.broadcast_to(
+                                M, (n_surv, shape[-1])
+                            )
+                        u = np.full(tuple(shape[:-1]), np.inf)
+                        amin = np.zeros(
+                            tuple(shape[:-1]), dtype=np.intp
+                        )
+                        if n_surv:
+                            u[coords] = M.min(axis=1)
+                            amin[coords] = M.argmin(axis=1)
+                        if met.enabled:
+                            met.inc("semiring.bnb_passes")
+                            if pruned_cells:
+                                met.inc(
+                                    "semiring.bnb_pruned_cells",
+                                    pruned_cells,
+                                )
+                        if tracer.enabled:
+                            tracer.event(
+                                "semiring-bnb", cat="supervisor",
+                                semiring="min_sum", rows=1,
+                                pruned_cells=pruned_cells,
+                                table_cells=int(np.prod(shape)),
+                                pass2="host",
+                            )
+                        host_nodes[k] += 1
+                        finish(k, name, node, sep, u, amin)
+                        continue
                 if pad.enabled:
                     aligned = pad_util_parts(aligned, shape, pshape)
                 else:
@@ -1039,10 +1433,16 @@ def _util_phase_multi(
                         np.asarray(a, dtype=np.float32)
                         for a in aligned
                     ]
+                if use_bnb:
+                    aligned = [
+                        np.float32(
+                            budget if budget is not None else noprune
+                        )
+                    ] + list(aligned)
                 try:
                     # host pull inside the supervised call (same
                     # sync-point reasoning as the batched branch)
-                    amin, margins = sup.dispatch(
+                    outs_n = sup.dispatch(
                         lambda a=aligned: tuple(
                             np.asarray(x) for x in fn(*a)
                         ),
@@ -1055,12 +1455,18 @@ def _util_phase_multi(
                     # wholesale on host f64 (exact) and keep sweeping
                     _host_redo(met, host_nodes, finish, item)
                     continue
+                if use_bnb:
+                    amin, margins, keep = outs_n
+                else:
+                    (amin, margins), keep = outs_n, None
                 if met.enabled:
                     # per EXECUTED dispatch, not n_rows up front: a
                     # timeout aborting this loop (or an OOM degrading
                     # to host) must not count dispatches that never
                     # ran on the device
                     met.inc("dpop.level_dispatches")
+                    if use_bnb:
+                        met.inc("semiring.bnb_passes")
                 dispatches[k] += 1
                 # slice the level-pack ghost cells away before
                 # certification: only the real region is decided here
@@ -1069,6 +1475,19 @@ def _util_phase_multi(
                 margins = np.asarray(
                     margins[region], dtype=np.float64
                 )
+                keep_r = None
+                if keep is not None:
+                    keep_r = np.asarray(keep[region], dtype=bool)
+                    pruned_cells = int(
+                        keep_r.size - keep_r.sum()
+                    ) * shape[-1]
+                    if not node_obs_counted:
+                        obs["cells"] += keep_r.size * shape[-1]
+                        obs["pruned"] += pruned_cells
+                    if pruned_cells and met.enabled:
+                        met.inc(
+                            "semiring.bnb_pruned_cells", pruned_cells
+                        )
                 try:
                     _certify_and_repair(
                         name, parts, target, shape,
@@ -1077,7 +1496,7 @@ def _util_phase_multi(
                 except _PrecisionFallback:
                     _host_redo(met, host_nodes, finish, item)
                     continue
-                u = _exact_u_at(parts, target, shape, amin)
+                u = _exact_u_at(parts, target, shape, amin, keep=keep_r)
                 device_nodes[k] += 1
                 finish(k, name, node, sep, u, amin)
     return [
@@ -1123,7 +1542,7 @@ def _host_redo(met, host_nodes, finish, item):
     would dominate): redo THIS node wholesale on host f64, the same
     join the pure host path runs, and keep the sweep going.  Still
     exact; the rest of the tree keeps its device results."""
-    k, name, node, sep, target, shape, parts, _ = item
+    k, name, node, sep, target, shape, parts, _, _ = item
     if met.enabled:
         met.inc("dpop.cert_fallbacks")
     j = np.zeros(shape, dtype=np.float64)
@@ -1135,14 +1554,35 @@ def _host_redo(met, host_nodes, finish, item):
     finish(k, name, node, sep, u, amin)
 
 
-def _exact_u_at(parts, target, shape, amin, grids=None):
+def _exact_u_at(parts, target, shape, amin, grids=None, keep=None):
     """Exact f64 u: evaluate the join only AT the chosen argmin,
     u[cell] = Σ_parts part[cell, amin[cell]] — O(cells·parts)
     instead of the full O(cells·d·parts) join, and exact because
     every part (child utils included) is exact f64.  ``grids`` lets a
     bucket-vectorized caller hoist the np.indices allocation (same
-    separator shape for every row of a stack)."""
+    separator shape for every row of a stack).  ``keep`` (bnb) marks
+    the surviving rows: pruned cells read ``+inf`` (the ⊕-identity),
+    and when most cells are pruned only the survivors are gathered."""
     own = target[-1]
+    if (
+        keep is not None
+        and len(shape) > 1
+        and 4 * int(keep.sum()) < 3 * keep.size
+    ):
+        coords = np.nonzero(keep)
+        a_sel = amin[coords]
+        acc = np.zeros(len(coords[0]), dtype=np.float64)
+        for dims, table in parts:
+            idx = []
+            for d in dims:
+                if d == own:
+                    idx.append(a_sel)
+                else:
+                    idx.append(coords[target.index(d)])
+            acc += np.asarray(table, dtype=np.float64)[tuple(idx)]
+        u = np.full(shape[:-1], np.inf)
+        u[coords] = acc
+        return u
     if grids is None:
         grids = np.indices(shape[:-1], dtype=np.intp)
     u = np.zeros(shape[:-1], dtype=np.float64)
@@ -1154,6 +1594,8 @@ def _exact_u_at(parts, target, shape, amin, grids=None):
             else:
                 idx.append(grids[target.index(d)])
         u += np.asarray(table, dtype=np.float64)[tuple(idx)]
+    if keep is not None:
+        u = np.where(keep, u, np.inf)
     return u
 
 
@@ -1172,6 +1614,7 @@ def _join_kernel(
     shape: Tuple[int, ...],
     part_shapes: Tuple[Tuple[int, ...], ...],
     batched: bool = False,
+    bnb: bool = False,
 ):
     """Jit-compiled join+projection for one (joined shape, aligned
     part shapes) bucket; ``batched=True`` vmaps it over a leading
@@ -1191,7 +1634,7 @@ def _join_kernel(
     """
     return _semiring.contraction_kernel(
         _semiring.MIN_SUM, tuple(shape), tuple(part_shapes),
-        batched=batched,
+        batched=batched, bnb=bnb,
     )
 
 

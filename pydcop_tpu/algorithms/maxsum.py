@@ -90,6 +90,16 @@ algo_params = [
     # iff Mosaic's gather cost is per byte, which
     # tools/bench_gather.py measures directly (VERDICT r4 next #1b).
     AlgoParameterDef("msg_dtype", "str", ["f32", "bf16"], "f32"),
+    # branch-and-bound pruned factor marginalization
+    # (ops/semiring.py:bp_factor_messages, arXiv:1906.06863): 'auto'
+    # (default) applies the two-pass ⊕-bounded kernel to arity
+    # buckets whose per-factor config space d^k clears
+    # BNB_AUTO_MIN_CELLS — small factors (the coloring headline's
+    # arity-2 d=3 buckets) keep the single-pass kernel; 'on' forces
+    # it everywhere; 'off' disables.  Messages are BIT-IDENTICAL
+    # either way (pruned configs are strictly worse than every
+    # output's optimum, f32 slack included).
+    AlgoParameterDef("bnb", "str", ["auto", "on", "off"], "auto"),
     # compiled-island scheduling (host runtime --accel agents only;
     # ignored by the batched engine): internal rounds run at island
     # start and per boundary-message wave (_island_maxsum.py)
@@ -352,9 +362,22 @@ def step(
             # bp_factor_messages: join, per-position ⊕-projection,
             # subtract, shift-normalize — bit-for-bit the historical
             # inline loop); other semirings turn the same wiring into
-            # sum-product / max-product BP
+            # sum-product / max-product BP.  bnb='auto' enables the
+            # two-pass ⊕-bounded variant only when the factor's
+            # config space d^k clears the threshold (bit-identical
+            # messages either way)
+            bnb_mode = params.get("bnb", "auto")
+            # auto gates on the RAW per-factor config space d^k (BP
+            # tables are never level-pack padded), so the same
+            # constant reads slightly stricter here than in the
+            # contraction sweeps, which gate on padded cells
+            use_bnb = bnb_mode == "on" or (
+                bnb_mode == "auto"
+                and problem.d_max ** k
+                >= _semiring.BNB_AUTO_MIN_CELLS
+            )
             outs = _semiring.bp_factor_messages(
-                _semiring.MIN_SUM, tab, q_pos, mdt
+                _semiring.MIN_SUM, tab, q_pos, mdt, bnb=use_bnb
             )
             r_blocks.append(jnp.concatenate(outs, axis=1))  # [d, m·k]
             off += m * k

@@ -72,6 +72,7 @@ def solve(
     chunk_floor: Optional[int] = None,
     on_numeric_fault: Optional[str] = None,
     max_util_bytes: Optional[int] = None,
+    bnb: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -185,7 +186,7 @@ def solve(
             k_target=k_target, chaos=chaos, chaos_seed=chaos_seed,
             pad_policy=pad_policy, retry_budget=retry_budget,
             chunk_floor=chunk_floor, on_numeric_fault=on_numeric_fault,
-            max_util_bytes=max_util_bytes,
+            max_util_bytes=max_util_bytes, bnb=bnb,
         )
         result["telemetry"] = tel.summary()
     return result
@@ -219,6 +220,7 @@ def _solve_dispatch(
     chunk_floor=None,
     on_numeric_fault=None,
     max_util_bytes=None,
+    bnb=None,
 ) -> Dict[str, Any]:
     """Mode dispatch behind :func:`solve` (which owns the telemetry
     session and the ``result["telemetry"]`` attach)."""
@@ -417,6 +419,20 @@ def _solve_dispatch(
             **dict(params_in or {}),
             "max_util_bytes": int(max_util_bytes),
         }
+    if bnb is not None:
+        # branch-and-bound pruned contraction kernels — an algo
+        # param of the algorithms with a device contraction phase
+        # (dpop, maxsum); this keyword is the discoverable spelling,
+        # like max_util_bytes (docs/semirings.md, "Branch-and-bound
+        # pruning")
+        if not any(p.name == "bnb" for p in module.algo_params):
+            raise ValueError(
+                "bnb selects the branch-and-bound pruned "
+                "contraction kernels — supported by algorithms "
+                "with a device contraction phase (dpop, maxsum); "
+                f"{algo_name!r} has none"
+            )
+        params_in = {**dict(params_in or {}), "bnb": str(bnb)}
     params = prepare_algo_params(params_in, module.algo_params)
 
     # every batched-mode call runs under a per-call supervisor
@@ -1092,6 +1108,7 @@ def infer(
     external_dists: Optional[
         Mapping[str, Mapping[Any, float]]
     ] = None,
+    bnb: str = "auto",
 ) -> Dict[str, Any]:
     """Exact probabilistic inference over a DCOP's cost model — the
     semiring-generic twin of :func:`solve` (``docs/semirings.md``).
@@ -1159,6 +1176,14 @@ def infer(
     An unplannable budget raises a sizing error (planned peak table
     bytes vs budget, cut width) instead of an order hint.
 
+    ``bnb`` selects the branch-and-bound pruned two-pass kernels
+    (``docs/semirings.md``, "Branch-and-bound pruning"):
+    ``"auto"`` (default) prunes device dispatches whose per-row
+    table clears a size threshold, ``"on"`` prunes every device
+    dispatch, ``"off"`` keeps the single-pass kernels.  ``map``/
+    ``kbest`` results are bit-identical either way; the mass
+    queries account any discarded mass into ``error_bound``.
+
     Returns a result dict with ``status``/``time``/``telemetry``
     plus the query's payload, ``cells``/``dispatches``/
     ``device_nodes``/``host_nodes`` contraction stats, and the
@@ -1171,7 +1196,7 @@ def infer(
         max_table_size=max_table_size, trace=trace,
         trace_format=trace_format, compile_cache=compile_cache,
         retry_budget=retry_budget, max_util_bytes=max_util_bytes,
-        map_vars=map_vars, external_dists=external_dists,
+        map_vars=map_vars, external_dists=external_dists, bnb=bnb,
     )[0]
 
 
@@ -1196,6 +1221,7 @@ def infer_many(
     external_dists: Optional[
         Mapping[str, Mapping[Any, float]]
     ] = None,
+    bnb: str = "auto",
 ) -> list:
     """Run one inference ``query`` over MANY instances with their
     contraction sweeps MERGED — the :func:`solve_many` batching
@@ -1245,6 +1271,7 @@ def infer_many(
             pad_policy=pad_policy, max_table_size=max_table_size,
             max_util_bytes=max_util_bytes,
             map_vars=map_vars, external_dists=external_dists,
+            bnb=bnb,
             timeout=(
                 None
                 if deadline is None

@@ -68,6 +68,19 @@ def set_parser(subparsers) -> None:
         "--efficiency_weight", type=float, default=0.1,
         help="unary cost per emitted light level",
     )
+    p.add_argument(
+        "--hard_cap", type=float, default=0.0,
+        help="over-illumination HARD cap: a model window whose "
+        "level sum exceeds hard_cap x its target costs +inf "
+        "(infeasible), not just |sum - target| — the power-budget "
+        "rule of real lighting deployments.  Must be > 1 when set "
+        "(0 = off, all-soft costs).  Hard caps give the "
+        "branch-and-bound pruned kernels (--bnb, docs/semirings.md "
+        "'Branch-and-bound pruning') their bite: jointly-infeasible "
+        "and provably-over-budget separator rows prune in-kernel, "
+        "which single-part consistency pruning "
+        "(ops/membound.py:prune_plan) cannot see",
+    )
     p.add_argument("--capacity", type=float, default=100.0)
     p.add_argument("--seed", type=int, default=0)
     p.set_defaults(func=run_cmd)
@@ -105,6 +118,13 @@ def generate(args):
 
     max_level = levels - 1
     zone = int(getattr(args, "zone_size", 0) or 0)
+    hard_cap = float(getattr(args, "hard_cap", 0.0) or 0.0)
+    if hard_cap and hard_cap <= 1.0:
+        raise ValueError(
+            f"hard_cap={hard_cap} must be > 1 (it multiplies the "
+            "model target; at <= 1 the cap would outlaw the target "
+            "itself)"
+        )
     for m in range(args.nb_models):
         arity = rnd.randint(1, min(args.model_arity, args.nb_lights))
         if zone and zone < args.nb_lights:
@@ -155,7 +175,11 @@ def generate(args):
         shape = (levels,) * arity
         matrix = np.zeros(shape, dtype=np.float32)
         for idx in itertools.product(range(levels), repeat=arity):
-            matrix[idx] = abs(sum(idx) - target)
+            s = sum(idx)
+            if hard_cap and s > hard_cap * target:
+                matrix[idx] = np.inf
+            else:
+                matrix[idx] = abs(s - target)
         dcop.add_constraint(
             NAryMatrixRelation(scope, matrix, name=f"mod{m:03d}")
         )

@@ -109,6 +109,15 @@ def set_parser(subparsers) -> None:
         "device memory; the result carries a 'membound' block "
         "(docs/semirings.md, 'Memory-bounded contraction')",
     )
+    p.add_argument(
+        "--bnb", choices=["auto", "on", "off"], default="auto",
+        help="branch-and-bound pruned contraction kernels: two-pass "
+        "⊕-bounded marginalization masks rows a cheap bound proves "
+        "irrelevant — map/kbest stay bit-identical, the mass "
+        "queries account discarded mass into error_bound.  'auto' "
+        "(default) prunes only dispatches whose per-row table "
+        "clears a size threshold (docs/semirings.md)",
+    )
     add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
 
@@ -145,6 +154,7 @@ def run_cmd(args) -> int:
         compile_cache=args.compile_cache,
         retry_budget=args.retry_budget,
         max_util_bytes=args.max_util_bytes,
+        bnb=args.bnb,
         map_vars=(
             [v.strip() for v in args.map_vars.split(",") if v.strip()]
             if args.map_vars

@@ -149,6 +149,17 @@ def set_parser(subparsers) -> None:
         "re-plans at half budget, and the result carries a "
         "'membound' block (docs/semirings.md)",
     )
+    p.add_argument(
+        "--bnb", choices=["auto", "on", "off"], default=None,
+        help="branch-and-bound pruned contraction kernels "
+        "(algorithms with a device contraction phase — dpop, "
+        "maxsum): two-pass ⊕-bounded marginalization skips rows a "
+        "cheap bound proves irrelevant against a greedy incumbent — "
+        "results bit-identical, dead certification/re-evaluation "
+        "work skipped.  'auto' (default) prunes only dispatches "
+        "whose per-row table clears a size threshold "
+        "(docs/semirings.md, 'Branch-and-bound pruning')",
+    )
     add_supervisor_arguments(p)
     add_collect_arguments(p)
     add_trace_arguments(p)
@@ -159,6 +170,10 @@ def run_cmd(args) -> int:
     from pydcop_tpu.api import solve
 
     params = parse_algo_params(args.algo_params)
+    if args.bnb is not None:
+        # an algo param (dpop/maxsum declare it) — the flag is just
+        # the discoverable spelling, like --max_util_bytes
+        params = {**params, "bnb": args.bnb}
     if args.many:
         return _run_many_cmd(args, params)
     profile_ctx = None

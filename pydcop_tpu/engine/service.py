@@ -688,6 +688,7 @@ class SolverService:
         session: Optional[str] = None,
         set_values: Optional[Mapping[str, Any]] = None,
         max_util_bytes: Optional[int] = None,
+        bnb: Optional[str] = None,
         trace: Optional[Mapping[str, Any]] = None,
     ) -> PendingResult:
         """Admit one solve request; returns a :class:`PendingResult`.
@@ -702,7 +703,12 @@ class SolverService:
         plan — DPOP) caps the request's largest UTIL table via the
         memory-bounded contraction planner (``ops/membound.py``) —
         it folds into the algorithm params, so it also partitions
-        dispatch groups like any other param.  ``trace`` is the wire
+        dispatch groups like any other param.  ``bnb``
+        (``auto|on|off``) selects the branch-and-bound pruned
+        contraction kernels the same way (an algo param of the
+        algorithms with a device contraction phase — dpop, maxsum;
+        results bit-identical, ``docs/semirings.md``).  ``trace`` is
+        the wire
         client's trace context (``telemetry/context.py`` wire form);
         omitted, the service mints a deterministic id at admission.
         Validation errors raise HERE (before admission); dispatch
@@ -774,6 +780,21 @@ class SolverService:
                 **dict(params_in or {}),
                 "max_util_bytes": int(max_util_bytes),
             }
+        if bnb is not None:
+            if not any(
+                p.name == "bnb" for p in module.algo_params
+            ):
+                raise ValueError(
+                    "bnb selects the branch-and-bound pruned "
+                    "contraction kernels — supported by algorithms "
+                    "with a device contraction phase (dpop, "
+                    f"maxsum); {algo_name!r} has none"
+                )
+            if bnb not in ("auto", "on", "off"):
+                raise ValueError(
+                    f"bnb must be 'auto'|'on'|'off', got {bnb!r}"
+                )
+            params_in = {**dict(params_in or {}), "bnb": str(bnb)}
         params = prepare_algo_params(params_in, module.algo_params)
 
         req = _Request(
@@ -850,6 +871,7 @@ class SolverService:
             Mapping[str, Mapping[Any, float]]
         ] = None,
         max_util_bytes: Optional[int] = None,
+        bnb: str = "auto",
         trace: Optional[Mapping[str, Any]] = None,
     ) -> PendingResult:
         """Admit one inference request (``docs/semirings.md``): the
@@ -913,6 +935,10 @@ class SolverService:
             raise ValueError(
                 f"max_util_bytes must be > 0, got {max_util_bytes}"
             )
+        if bnb not in ("auto", "on", "off"):
+            raise ValueError(
+                f"bnb must be 'auto'|'on'|'off', got {bnb!r}"
+            )
         if dcop is None:
             raise ValueError("dcop is required")
         dcop_obj, dcop_key = self._load_dcop(dcop)
@@ -945,6 +971,7 @@ class SolverService:
                     if max_util_bytes is not None
                     else None
                 ),
+                "bnb": str(bnb),
             },
         )
         req.t_sub = t_sub
@@ -1749,7 +1776,8 @@ class SolverService:
         return (
             "infer", req.query, kw["order"], kw["beta"], kw["tol"],
             kw["device"], kw["device_min_cells"], kw["map_vars"],
-            ed_key, kw["max_util_bytes"], req.timeout,
+            ed_key, kw["max_util_bytes"], kw.get("bnb", "auto"),
+            req.timeout,
         )
 
     def _dispatch_infer_groups(self, reqs: List[_Request]) -> None:
@@ -1808,6 +1836,7 @@ class SolverService:
                     max_util_bytes=kw["max_util_bytes"],
                     map_vars=list(mv) if mv else None,
                     external_dists=kw["external_dists"],
+                    bnb=kw.get("bnb", "auto"),
                 )
         t_done = time.perf_counter()
         for req in part:
@@ -1942,7 +1971,7 @@ def _load_module(algo_name: str):
 _SOLVE_FIELDS = (
     "rounds", "seed", "chunk_size", "convergence_chunks",
     "n_restarts", "timeout", "session", "set_values",
-    "max_util_bytes",
+    "max_util_bytes", "bnb",
 )
 
 #: fields an ``op: "infer"`` frame may carry — mirrors
@@ -1951,6 +1980,7 @@ _SOLVE_FIELDS = (
 _INFER_FIELDS = (
     "order", "beta", "tol", "device", "device_min_cells",
     "timeout", "map_vars", "external_dists", "max_util_bytes",
+    "bnb",
 )
 
 #: results are trimmed for the wire: the per-round cost trace can be

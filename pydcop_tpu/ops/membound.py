@@ -522,6 +522,7 @@ def run_bounded(
     max_cut_lanes: int = MAX_CUT_LANES,
     t0: Optional[float] = None,
     timeout: Optional[float] = None,
+    bnb: str = "off",
 ) -> Optional[BoundedSweep]:
     """Prune, plan, and run ONE budgeted merged sweep over K
     instances (module docstring), re-planning at half the budget on
@@ -530,7 +531,17 @@ def run_bounded(
     timeout; raises :class:`MemboundError` when the USER's budget is
     itself unplannable (replan budgets that become unplannable fall
     to the host instead of raising — the caller asked for THAT
-    budget, and the original plan still bounds host memory)."""
+    budget, and the original plan still bounds host memory).
+
+    ``bnb`` threads the branch-and-bound pruned kernels through the
+    budgeted sweep: each cut LANE is an instance of the merged
+    sweep, so the pruning context — greedy incumbent, rest bounds,
+    shift ledger — is built PER LANE from the lane's conditioned
+    plan (a lane is an independent subproblem, so pruning against
+    its own incumbent is exact per lane and the cross-lane ⊕-combine
+    is untouched).  ``plan_cut``'s byte sizing ignores the mask by
+    construction: pruning changes which rows are WORKED, never what
+    the device allocates."""
     from pydcop_tpu.engine.supervisor import DeviceOOMError
     from pydcop_tpu.ops.padding import NO_PADDING
     from pydcop_tpu.telemetry import get_metrics, get_tracer
@@ -584,6 +595,7 @@ def run_bounded(
                 tol=tol, max_table_size=max_table_size,
                 want_args=want_args, t0=t0, timeout=timeout,
                 on_oom="raise" if dmc is not None else "host",
+                bnb=bnb,
             )
         except DeviceOOMError:
             # the replan rung of the OOM ladder: level->node already
@@ -712,6 +724,7 @@ def solve_dpop_bounded(
     from pydcop_tpu.ops.semiring import (
         MIN_SUM,
         _value_phase,
+        as_bnb,
         build_plan,
     )
 
@@ -732,6 +745,7 @@ def solve_dpop_bounded(
         max_util_bytes=max_util_bytes,
         device_min_cells=dmc, pad=pad, want_args=True,
         max_table_size=max_table_size, t0=t0, timeout=timeout,
+        bnb=as_bnb(params.get("bnb"), "auto"),
     )
     if bs is None:
         return _dpop_timeout(dcop, t0)

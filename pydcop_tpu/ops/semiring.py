@@ -144,8 +144,13 @@ def _exp_pair_reduce(a: np.ndarray, axes: Tuple[int, ...]):
     s = np.sum(w, axis=axes, keepdims=True)
     with np.errstate(divide="ignore"):
         lw_out = np.where(np.isfinite(m), safe_m + np.log(s), m)
+    # a zero-weight cell contributes nothing whatever its r plane
+    # holds — hard-constraint pairs are (-inf, +inf) and the naive
+    # 0·inf product would poison the whole combine with NaN
+    with np.errstate(invalid="ignore"):
+        wr = np.where(w > 0, w * r, 0.0)
     r_out = np.where(
-        s > 0, np.sum(w * r, axis=axes, keepdims=True)
+        s > 0, np.sum(wr, axis=axes, keepdims=True)
         / np.where(s > 0, s, 1.0), 0.0,
     )
     lw_out = np.squeeze(lw_out, axis=axes)
@@ -528,6 +533,449 @@ def parse_query(query: str) -> Tuple[str, Semiring]:
     )
 
 
+# -- branch-and-bound pruning (the two-pass ⊕-bounded kernels) ----------
+#
+# arXiv:1906.06863 accelerates BP-based DCOP algorithms generically by
+# branch-and-bound INSIDE the marginalization: most rows of a
+# high-arity join can be skipped because a cheap per-row ⊕-bound
+# already proves they cannot matter.  Here that becomes a TWO-PASS
+# device kernel behind :func:`contraction_kernel` (``bnb=True``):
+#
+# - **pass 1** computes a per-row (= per kept-configuration) bound —
+#   in-kernel it is the joined row's own ⊕-extremum (free for the
+#   value-carrying kinds whose outputs already bound the row;
+#   CSE-merged with pass 2's join for the arg-only idempotent
+#   kernels, so it costs one extra reduce, not a second join); the
+#   DPOP sweep's host-side pass 1 uses per-part own-axis extrema
+#   instead (O(Σ part sizes), no join materialized) — compared
+#   against a per-row ``budget`` scalar derived from the running
+#   incumbent (a greedy full assignment evaluated exactly on host,
+#   :class:`_BnbContext`);
+# - **pass 2** runs the dense join+project with the pruned rows
+#   masked to the ⊕-identity (``jnp.where`` — static shapes, so the
+#   level-pack lattice, the per-semiring kernel LRU, and the vmapped
+#   stack/membound-lane machinery are untouched).
+#
+# Exactness, per ⊕ (docs/semirings.md, "Branch-and-bound pruning"):
+# idempotent ⊕ (min/max) prunes a row only when its bound plus the
+# rest-of-problem bound provably exceeds the incumbent — no optimal
+# assignment passes through a pruned row, so results stay
+# BIT-IDENTICAL to the unpruned kernel (f32 slack folded into the
+# budget keeps the comparison conservative); kbest prunes against the
+# k-th incumbent (k distinct greedy variants), so the whole k-list
+# survives; logsumexp/marginals/expectation prune rows whose mass
+# contribution is provably negligible and ACCOUNT the discarded mass
+# (the kernel returns its logsumexp) into the existing ``error_bound``
+# ledger under the same ``tol`` gate.
+
+BNB_MODES = ("auto", "on", "off")
+
+#: ``bnb='auto'`` threshold: a dispatch whose per-row joined table
+#: (level-pack padded cells × cell width) is below this keeps the
+#: single-pass kernel — for small factors the bound pass, the masked
+#: ``where`` and the keep-mask transfer cost more than they prune;
+#: only genuinely compute-bound dispatches (~0.5 MiB of f32 per row
+#: and up) can repay the two-pass overhead on a CPU host, and on
+#: TPU the threshold errs the same safe way
+#: (``semiring.bnb_skipped_small`` counts the skips).
+BNB_AUTO_MIN_CELLS = 1 << 17
+
+#: pruned-row fraction at or above which pass 2 abandons the device
+#: for a COMPACT host contraction of the survivors (exact f64, no
+#: certificate needed): with most of the join dead, gathering the
+#: surviving rows beats a dense f32 dispatch plus the dense host
+#: re-evaluation glue.  Below it the masked device kernel runs and
+#: the glue still compacts on the keep mask.
+BNB_HOST_FRAC = 0.5
+
+
+def as_bnb(value, default: str = "auto") -> str:
+    """Normalize a ``bnb`` knob value to ``'auto'|'on'|'off'``."""
+    if value is None:
+        return default
+    if value is True:
+        return "on"
+    if value is False:
+        return "off"
+    v = str(value).lower()
+    if v not in BNB_MODES:
+        raise ValueError(
+            f"bnb must be one of {BNB_MODES}, got {value!r}"
+        )
+    return v
+
+
+def greedy_assignment(
+    order_rev: Sequence[str],
+    domains: Mapping[str, Sequence],
+    owned: Mapping[str, Sequence[Tuple[Sequence[str], np.ndarray]]],
+    maximize: bool,
+):
+    """One cheap full assignment for the incumbent: walk ``order_rev``
+    (reversed elimination order, or the pseudo-tree pre-order) and
+    score each candidate value of ``v`` against EVERY part whose
+    scope contains ``v`` — assigned variables fixed, unassigned ones
+    ⊕-marginalized out (a one-step lookahead, so a hard-capped part
+    owned further down the order steers the walk away from values
+    that would doom it to ``+inf``); keep the ⊕-best (first index on
+    ties — deterministic).  Returns ``(value-index assignment, exact
+    f64 total over ALL parts)``.  Tables are in KERNEL domain."""
+    by_var: Dict[str, list] = {}
+    flat: List[Tuple[list, np.ndarray]] = []
+    for parts in owned.values():
+        for scope, table in parts:
+            flat.append((list(scope), table))
+            for u in scope:
+                by_var.setdefault(u, []).append((scope, table))
+    red = np.max if maximize else np.min
+    worst = -np.inf if maximize else np.inf
+    assigned: Dict[str, int] = {}
+    for v in order_rev:
+        d = len(domains[v])
+        score = np.zeros(d, dtype=np.float64)
+        for scope, table in by_var.get(v, ()):
+            t = np.asarray(table, dtype=np.float64)
+            idx = tuple(
+                assigned[u] if u in assigned and u != v
+                else slice(None)
+                for u in scope
+            )
+            sub = t[idx]
+            rem = [u for u in scope if u == v or u not in assigned]
+            vax = rem.index(v)
+            axes = tuple(a for a in range(sub.ndim) if a != vax)
+            with np.errstate(invalid="ignore"):
+                vec = red(sub, axis=axes) if axes else sub
+            score = score + vec.reshape(d)
+        # a NaN score (±inf parts cancelling) is "unknown" — rank it
+        # worst so the walk prefers provably-finite values
+        score = np.where(np.isnan(score), worst, score)
+        assigned[v] = int(
+            np.argmax(score) if maximize else np.argmin(score)
+        )
+    # coordinate-descent polish: re-pick each variable's ⊕-best value
+    # with every other variable FIXED (exact part evaluations, no
+    # marginalizing) — two sweeps close most of the greedy-vs-optimum
+    # gap, and the incumbent's tightness is the pruning budget's
+    # tightness
+    for _ in range(2):
+        changed = False
+        for v in order_rev:
+            d = len(domains[v])
+            if d < 2:
+                continue
+            score = np.zeros(d, dtype=np.float64)
+            for scope, table in by_var.get(v, ()):
+                idx = tuple(
+                    slice(None) if u == v else assigned[u]
+                    for u in scope
+                )
+                score = score + np.asarray(
+                    table, dtype=np.float64
+                )[idx].reshape(d)
+            score = np.where(np.isnan(score), worst, score)
+            pick = int(
+                np.argmax(score) if maximize else np.argmin(score)
+            )
+            if pick != assigned[v]:
+                assigned[v] = pick
+                changed = True
+        if not changed:
+            break
+    total = 0.0
+    for scope, table in flat:
+        total += float(
+            np.asarray(table, dtype=np.float64)[
+                tuple(assigned[u] for u in scope)
+            ]
+        )
+    return assigned, total
+
+
+def _eval_assignment(owned, assigned) -> float:
+    total = 0.0
+    for parts in owned.values():
+        for scope, table in parts:
+            total += float(
+                np.asarray(table, dtype=np.float64)[
+                    tuple(assigned[u] for u in scope)
+                ]
+            )
+    return total
+
+
+class _BnbContext:
+    """Per-instance branch-and-bound state for one sweep.
+
+    Built from the instance's KERNEL-domain parts (energies for
+    ``min_sum``/kbest, log-weights ``-β·E`` (+ log-prob parts)
+    otherwise), keyed by owner node:
+
+    - ``inc`` — the incumbent: exact f64 total of a greedy full
+      assignment (an upper bound on the optimum for min, a lower
+      bound for max / on ``log Z`` for the mass semirings);
+      ``inc_k`` (kbest) is the k-th smallest total over k DISTINCT
+      greedy variants — a valid upper bound on the k-th best cost —
+      or None when the instance has fewer than k assignments;
+    - ``rest[v]`` — Σ of per-part extrema over every part OUTSIDE
+      ``v``'s subtree (total minus the subtree prefix sums);
+    - ``rest_logdom[v]`` — Σ log|domain| over variables outside the
+      subtree (the completion-count term of the mass bound);
+    - ``cumshift[v]`` — shifts applied inside ``v``'s subtree so far
+      (filled by the sweep as messages normalize), bridging stored
+      (shifted) message values back to true subtree aggregates.
+
+    ``budget(v, n_children_shift, n_parts, parts_max, d_own,
+    n_rows)`` returns the f32-safe per-row threshold pass 1 compares
+    against (conservative under f32 rounding: questionable rows are
+    KEPT), or the no-prune sentinel when any input is non-finite."""
+
+    __slots__ = (
+        "sr", "tol_node", "inc", "inc_k", "rest", "rest_logdom",
+        "cumshift",
+    )
+
+    def __init__(
+        self,
+        sr: Semiring,
+        order_rev: Sequence[str],
+        domains: Mapping[str, Sequence],
+        owned: Mapping[str, list],
+        children: Mapping[str, Sequence[str]],
+        tol: float = 1e-6,
+    ):
+        self.sr = sr
+        self.cumshift: Dict[str, float] = {}
+        n_nodes = max(len(order_rev), 1)
+        self.tol_node = (
+            tol / (2.0 * n_nodes) if sr.error_bounded or
+            sr.kind == "expectation" else 0.0
+        )
+        maximize = sr.maximize or not sr.idempotent
+        if sr.kind == "kbest":
+            maximize = False
+        assigned, inc = greedy_assignment(
+            order_rev, domains, owned, maximize
+        )
+        self.inc = inc
+        self.inc_k: Optional[float] = None
+        if sr.kind == "kbest":
+            self.inc_k = self._kth_incumbent(
+                assigned, domains, owned, order_rev, sr.cell_width
+            )
+        # per-node extremum of the OWNED parts, then subtree prefix
+        # sums bottom-up (order_rev reversed = children before
+        # parents); rest = total - subtree
+        ext: Dict[str, float] = {}
+        logdom: Dict[str, float] = {}
+        red = np.max if maximize else np.min
+        for v in order_rev:
+            e = 0.0
+            for _, table in owned.get(v, ()):
+                e += float(red(np.asarray(table, dtype=np.float64)))
+            ext[v] = e
+            logdom[v] = float(np.log(max(len(domains[v]), 1)))
+        sub_ext: Dict[str, float] = {}
+        sub_logdom: Dict[str, float] = {}
+        for v in reversed(order_rev):  # children first
+            sub_ext[v] = ext[v] + sum(
+                sub_ext[c] for c in children.get(v, ())
+            )
+            sub_logdom[v] = logdom[v] + sum(
+                sub_logdom[c] for c in children.get(v, ())
+            )
+        total_ext = sum(ext.values())
+        total_logdom = sum(logdom.values())
+        self.rest = {v: total_ext - sub_ext[v] for v in order_rev}
+        self.rest_logdom = {
+            v: total_logdom - sub_logdom[v] for v in order_rev
+        }
+
+    @staticmethod
+    def _kth_incumbent(assigned, domains, owned, order_rev, k):
+        """k DISTINCT assignments around the greedy one (vary the
+        widest-domain variables combinatorially); the k-th smallest
+        exact total upper-bounds the k-th best cost.  None when the
+        assignment space itself has fewer than k points."""
+        space = 1.0
+        for v in order_rev:
+            space *= max(len(domains[v]), 1)
+            if space >= k:
+                break
+        if space < k:
+            return None
+        variants = [dict(assigned)]
+        by_width = sorted(
+            order_rev,
+            key=lambda v: (-len(domains[v]), v),
+        )
+        for v in by_width:
+            if len(variants) >= k:
+                break
+            d = len(domains[v])
+            if d < 2:
+                continue
+            variants = [
+                {**a, v: i} for a in variants for i in range(d)
+            ]
+        totals = sorted(
+            _eval_assignment(owned, a) for a in variants[: 4 * k]
+        )
+        return totals[k - 1] if len(totals) >= k else None
+
+    def no_prune(self) -> float:
+        """Budget sentinel without a usable incumbent: keeps every
+        FINITE row — rows whose bound is already the ⊕-annihilator
+        (``+inf`` joint infeasibility under min/kbest, ``-inf`` zero
+        mass) still prune, exactly (their value IS the ⊕-identity;
+        masking them only skips the dead work)."""
+        big = float(np.finfo(np.float32).max) / 2
+        if self.sr.idempotent and not self.sr.maximize:
+            return big
+        if self.sr.kind == "kbest":
+            return big
+        return -big
+
+    def shift_under(self, children: Sequence[str]) -> float:
+        return sum(self.cumshift.get(c, 0.0) for c in children)
+
+    def record_shift(
+        self, name: str, shift: float, children: Sequence[str]
+    ) -> None:
+        self.cumshift[name] = shift + self.shift_under(children)
+
+    def budget(
+        self,
+        name: str,
+        shift_children: float,
+        n_parts: int,
+        parts_max: float,
+        d_own: int,
+        n_rows: int,
+    ) -> float:
+        """The per-row pass-1 threshold for node ``name`` (module
+        comment above; f32 slack keeps pruning conservative)."""
+        sr = self.sr
+        inc = self.inc_k if sr.kind == "kbest" else self.inc
+        if inc is None:
+            return self.no_prune()
+        rest = self.rest.get(name, 0.0)
+        slack = (
+            2.0
+            * (n_parts + 2)
+            * _EPS32
+            * (
+                max(parts_max, 1.0)
+                + abs(inc)
+                + abs(rest)
+                + abs(shift_children)
+            )
+        )
+        if sr.idempotent or sr.kind == "kbest":
+            if sr.maximize:
+                b = inc - rest - shift_children - slack
+            else:
+                b = inc - rest - shift_children + slack
+            return b if np.isfinite(b) else self.no_prune()
+        # mass semirings: keep rows whose mass upper bound could
+        # contribute more than tol_node relative to the incumbent's
+        # exact mass (itself a lower bound on Z); log-domain terms —
+        # the own-axis count, the completion count, and the row count
+        # — make the per-dispatch worst case <= tol_node even before
+        # the kernel measures the true discard
+        b = (
+            self.inc
+            - shift_children
+            - (rest + self.rest_logdom.get(name, 0.0))
+            - float(np.log(max(d_own, 1)))
+            - float(np.log(max(n_rows, 1)))
+            + float(np.log(max(self.tol_node, 1e-300)))
+            - slack
+        )
+        return b if np.isfinite(b) else self.no_prune()
+
+    def account(
+        self,
+        name: str,
+        disc: float,
+        shift_children: float,
+        d_own: int,
+    ) -> float:
+        """Error-ledger term for a mass dispatch's measured discard
+        ``disc`` (kernel pass-1 logsumexp over pruned row bounds):
+        relative discarded mass vs the incumbent's exact mass, with a
+        2x inflation covering the f32 bound arithmetic."""
+        if not np.isfinite(disc):  # nothing pruned
+            return 0.0
+        rest = self.rest.get(name, 0.0) + self.rest_logdom.get(
+            name, 0.0
+        )
+        ln = (
+            disc
+            + float(np.log(max(d_own, 1)))
+            + shift_children
+            + rest
+            - self.inc
+        )
+        return 2.0 * float(np.exp(min(ln, 50.0)))
+
+
+def max_padded_join_cells(plan: "ContractionPlan", pad) -> int:
+    """Dims-only upper bound on the plan's largest PADDED join (the
+    quantity ``bnb='auto'`` gates on): the O(nodes·width) separator
+    simulation `plan.width()` runs, sized on the pad lattice.  Lets
+    callers skip the (greedy incumbent + per-part extrema) context
+    build entirely on instances where no dispatch can ever clear
+    ``BNB_AUTO_MIN_CELLS`` — small solves must not pay for pruning
+    that cannot happen."""
+    from pydcop_tpu.ops.padding import bucket_util_shape
+
+    dsize = {
+        v: bucket_util_shape((len(plan.domains[v]),), pad)[0]
+        for v in plan.domains
+    }
+    seps: Dict[str, List[str]] = {}
+    mx = 1
+    for v in plan.order:
+        seps[v] = plan.sep_of(v, seps)
+        size = dsize[v]
+        for d in seps[v]:
+            size *= dsize[d]
+        mx = max(mx, size)
+    return mx
+
+
+def plan_bnb_context(
+    plan: "ContractionPlan", sr: Semiring, beta: float, tol: float
+) -> Optional[_BnbContext]:
+    """Build the BnB context for one plan, or None when the sweep
+    shape does not support pruning (mixed-⊕ marginal-MAP plans: a
+    max node's subtree contains sums, so neither bound family
+    applies cleanly)."""
+    if plan.node_semiring is not None:
+        return None
+    sign_mass = not (
+        sr.kind == "kbest" or (sr.idempotent and not sr.maximize)
+    )
+    owned: Dict[str, list] = {}
+    for v in plan.order:
+        parts = []
+        for scope, table in plan.buckets[v]:
+            t = np.asarray(table, dtype=np.float64)
+            parts.append(
+                (list(scope), (-beta) * t if sign_mass else t)
+            )
+        for scope, table in plan.wbuckets[v]:
+            # log-prob parts are already kernel-domain log-weights
+            parts.append((list(scope), np.asarray(table, np.float64)))
+        if parts:
+            owned[v] = parts
+    return _BnbContext(
+        sr, list(reversed(plan.order)), plan.domains, owned,
+        plan.children, tol=tol,
+    )
+
+
 # -- device kernels -----------------------------------------------------
 #
 # One jitted join+projection per (semiring, joined shape, aligned part
@@ -548,6 +996,7 @@ def contraction_kernel(
     shape: Tuple[int, ...],
     part_shapes: Tuple[Tuple[int, ...], ...],
     batched: bool = False,
+    bnb: bool = False,
 ):
     """Jit-compiled semiring contraction for one bucket: broadcast-add
     join of the aligned parts, then the ``⊕``-projection over the own
@@ -562,9 +1011,22 @@ def contraction_kernel(
     Non-idempotent ⊕ returns ``(values,)`` — a max-shifted f32
     logsumexp whose rounding is covered by the caller's error-bound
     accounting.
+
+    ``bnb=True`` builds the TWO-PASS branch-and-bound variant
+    (module comment above): the kernel takes a leading per-row
+    ``budget`` scalar (vmapped with the parts when ``batched``),
+    pass 1 derives a per-row ⊕-bound from per-part own-axis extrema
+    (each part reduced once per dispatch), and pass 2's outputs are
+    masked to the ⊕-identity on pruned rows — margins become
+    ``+inf`` (pruned rows never enter certification or repair), and
+    the returned outputs gain a trailing ``keep`` mask plus, for the
+    mass semirings, the logsumexp of the pruned row bounds (the
+    discarded-mass measurement the caller accounts into the
+    ``error_bound`` ledger).  Same static shapes, one extra
+    executable per ``(semiring, bucket)`` at most.
     """
     sr = get_semiring(sr)
-    key = (sr.name, tuple(shape), tuple(part_shapes), batched)
+    key = (sr.name, tuple(shape), tuple(part_shapes), batched, bnb)
     fn = _KERNELS.get(key)
     if fn is not None:
         return fn
@@ -572,6 +1034,35 @@ def contraction_kernel(
         _KERNELS.pop(next(iter(_KERNELS)))
     import jax
     import jax.numpy as jnp
+
+    nd_own = len(shape)
+
+    def _row_bound(tabs, lo: bool):
+        """Pass 1 bound for the scalar idempotent kinds: the joined
+        row's own-axis extremum — the EXACT (up to f32 rounding,
+        covered by the budget's slack) row projection, so the prune
+        test is as tight as the incumbent and rest bounds allow.
+        XLA's common-subexpression elimination merges this join with
+        pass 2's, so the bound costs one extra reduce, not a second
+        join; the ghost-guard mask part rides the join, keeping
+        level-pack ghost cells out of the bound (a per-part minima
+        bound would read a padded part's ghost zeros as real)."""
+        red = jnp.min if lo else jnp.max
+        j = jnp.zeros(shape, dtype=jnp.float32)
+        for t in tabs:
+            j = j + t
+        return red(j, axis=-1)
+
+    def _discard(rowb, keep):
+        """logsumexp of the pruned rows' mass bounds (``-inf`` when
+        nothing was pruned) — the measured discard the host accounts."""
+        pr = jnp.where(keep, -jnp.inf, rowb)
+        m = jnp.max(pr)
+        safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        s = jnp.sum(jnp.where(jnp.isfinite(pr), jnp.exp(pr - safe), 0.0))
+        return jnp.where(
+            (s > 0) & jnp.isfinite(m), safe + jnp.log(s), -jnp.inf
+        )
 
     if sr.kind == "kbest":
         # structured cells: parts of ndim len(shape) are scalar
@@ -667,9 +1158,13 @@ def contraction_kernel(
             lw_out = jnp.where(
                 jnp.isfinite(m), safe_m + jnp.log(s), m
             )
+            # zero-weight cells contribute nothing — mask before the
+            # product or a hard-constraint (-inf, +inf) pair's 0·inf
+            # poisons the row with NaN
+            wr = jnp.where(w > 0, w * r, 0.0)
             r_out = jnp.where(
                 s > 0,
-                jnp.sum(w * r, axis=-1) / jnp.where(s > 0, s, 1.0),
+                jnp.sum(wr, axis=-1) / jnp.where(s > 0, s, 1.0),
                 0.0,
             )
             return (jnp.stack([lw_out, r_out], axis=-1),)
@@ -735,11 +1230,65 @@ def contraction_kernel(
             )
             return (vals,)
 
+    if bnb:
+        base = contract
+        lo = sr.kind == "kbest" or (
+            sr.idempotent and not sr.maximize
+        )
+
+        def contract(budget, *tabs):  # noqa: F811 — bnb wrap
+            # pass-1 bound per row: for the output-carrying kinds it
+            # is FREE — the base kernel's own row values bound their
+            # mass/best exactly; the idempotent arg-only kernels
+            # re-derive the row extremum from the join (CSE-merged).
+            # Negated comparisons so a NaN bound (mixed ±inf
+            # hard-constraint parts cancelling in the sum) is always
+            # KEPT — pruning must stay conservative
+            outs = base(*tabs)
+            if sr.kind == "kbest":
+                vals, margins, own, *slots = outs
+                rowb = vals[..., 0]  # the row's best candidate
+                keep = jnp.logical_not(rowb > budget)
+                k3 = keep[..., None]
+                return (
+                    jnp.where(k3, vals, jnp.inf),
+                    jnp.where(k3, margins, jnp.inf),
+                    own, *slots, keep,
+                )
+            if sr.kind == "expectation":
+                (pair,) = outs
+                rowb = pair[..., 0]  # the row's exact log-mass
+                keep = jnp.logical_not(rowb < budget)
+                lw = jnp.where(keep, pair[..., 0], -jnp.inf)
+                rr = jnp.where(keep, pair[..., 1], 0.0)
+                return (
+                    jnp.stack([lw, rr], axis=-1),
+                    keep,
+                    _discard(rowb, keep),
+                )
+            if sr.idempotent:
+                arg, margins = outs
+                rowb = _row_bound(tabs, lo)
+                keep = (
+                    jnp.logical_not(rowb > budget)
+                    if lo
+                    else jnp.logical_not(rowb < budget)
+                )
+                return arg, jnp.where(keep, margins, jnp.inf), keep
+            (vals,) = outs
+            rowb = vals  # the row's exact logsumexp mass
+            keep = jnp.logical_not(rowb < budget)
+            return (
+                jnp.where(keep, vals, -jnp.inf),
+                keep,
+                _discard(rowb, keep),
+            )
+
     from pydcop_tpu.telemetry.jit import profiled_jit
 
     fn = profiled_jit(
         jax.vmap(contract) if batched else contract,
-        label=f"semiring-{sr.name}",
+        label=f"semiring-{sr.name}" + ("-bnb" if bnb else ""),
     )
     _KERNELS[key] = fn
     return fn
@@ -750,6 +1299,7 @@ def bp_factor_messages(
     tab,
     q_pos: Sequence,
     mdt,
+    bnb: bool = False,
 ) -> list:
     """Factor→variable belief-propagation messages for one arity
     bucket, as a semiring contraction inside a jax trace.
@@ -767,6 +1317,18 @@ def bp_factor_messages(
     (message dtype ``mdt`` — bf16 upcasts on the add), and the
     returned list holds the ``k`` outgoing ``[d, m]`` messages in
     ``mdt``.
+
+    ``bnb=True`` (idempotent ⊕ only; ignored otherwise) runs the
+    two-pass ⊕-bounded marginalization of arXiv:1906.06863 per
+    output position: pass 1 derives, per configuration, a bound from
+    the per-position q extrema and, per output cell, an incumbent —
+    the table evaluated AT the q-extrema configuration (one exact
+    candidate, so a valid incumbent for every output cell) — and
+    pass 2 masks configurations whose bound provably cannot beat the
+    incumbent to the ⊕-identity before each reduce.  An f32 slack on
+    the comparison keeps pruning conservative, and pruned entries
+    are STRICTLY worse than each output's optimum, so the returned
+    messages are bit-identical to the unpruned kernel.
     """
     import jax.numpy as jnp
 
@@ -778,10 +1340,60 @@ def bp_factor_messages(
     for p in range(k):
         shape = (1,) * p + (d,) + (1,) * (k - 1 - p) + (m,)
         s = s + q_pos[p].astype(tab.dtype).reshape(shape)
+    use_bnb = bool(bnb) and sr.idempotent
+    if use_bnb:
+        guard = jnp.asarray(
+            -jnp.inf if sr.maximize else jnp.inf, dtype=s.dtype
+        )
+        red = jnp.max if sr.maximize else jnp.min
+        arg = jnp.argmax if sr.maximize else jnp.argmin
+        qf = [q.astype(tab.dtype) for q in q_pos]
+        qv = [red(q, axis=0) for q in qf]  # [m] per position
+        qa = [arg(q, axis=0) for q in qf]
+        fin = lambda a: jnp.where(jnp.isfinite(a), jnp.abs(a), 0.0)
+        scale = jnp.max(fin(tab), initial=0.0)
+        for q in qf:
+            scale = scale + jnp.max(fin(q), initial=0.0)
+        # covers three independently-rounded f32 sums (the joint s,
+        # the bound lb, the incumbent ub), each within
+        # (k+1)·eps32·scale of its exact value — pruned entries are
+        # then STRICTLY worse than every output's f32 optimum
+        slack = 4.0 * (k + 2) * _EPS32 * jnp.maximum(scale, 1.0)
     outs = []
     for p in range(k):
         axes = tuple(a for a in range(k) if a != p)
-        mp = sr.jnp_reduce(s, axes)  # [d, m]
+        sp = s
+        if use_bnb:
+            # incumbent per output cell (p, v): the table at the
+            # q-extrema configuration of the other axes — gathered
+            # once per position, O(d·m) against the O(d^k·m) join
+            t = tab
+            for a in range(k):
+                if a == p:
+                    continue
+                idx = qa[a].reshape((1,) * k + (-1,))
+                t = jnp.take_along_axis(t, idx, axis=a)
+            ub = t  # [1,..,d@p,..,1, m_tab]
+            lb = tab
+            for a in range(k):
+                if a == p:
+                    continue
+                ub = ub + qv[a].reshape((1,) * k + (-1,))
+                lb = lb + qv[a].reshape((1,) * k + (-1,))
+            qp = qf[p].reshape(
+                (1,) * p + (d,) + (1,) * (k - 1 - p) + (m,)
+            )
+            lb = lb + qp
+            ub = ub + qp  # incumbent includes this output's own q
+            # negated comparison: NaN bounds (±inf cancellation in
+            # hard-constraint tables) always KEEP — conservative
+            worse = (
+                (lb < ub - slack)
+                if sr.maximize
+                else (lb > ub + slack)
+            )
+            sp = jnp.where(jnp.logical_not(worse), s, guard)
+        mp = sr.jnp_reduce(sp, axes)  # [d, m]
         rp = mp - q_pos[p].astype(tab.dtype)
         # shift-normalize per edge (bounded over cycles): min for
         # min/+ — the historical Max-Sum normalization — max for the
@@ -1246,6 +1858,7 @@ def contract_sweep(
     t0: Optional[float] = None,
     timeout: Optional[float] = None,
     on_oom: str = "host",
+    bnb: str = "off",
 ) -> Optional[_Sweep]:
     """Merged bottom-up contraction sweep over K instances.
 
@@ -1273,6 +1886,15 @@ def contract_sweep(
     (``"raise"`` — the budgeted sweeps of ``ops/membound.py``, which
     answer it by RE-PLANNING at a tighter ``max_util_bytes`` before
     abandoning the device).
+
+    ``bnb`` enables the two-pass branch-and-bound pruned kernels
+    (module comment above ``BNB_MODES``): ``"on"`` prunes every
+    device dispatch, ``"auto"`` only those whose per-row padded
+    table clears ``BNB_AUTO_MIN_CELLS`` (small factors keep the
+    single-pass kernel, ``semiring.bnb_skipped_small``), ``"off"``
+    is the historical sweep.  Counters ``semiring.bnb_passes`` /
+    ``semiring.bnb_pruned_cells`` and a per-dispatch-group
+    ``semiring.bnb`` trace event make the pruning observable.
     """
     from pydcop_tpu.engine.supervisor import (
         DeviceOOMError,
@@ -1287,6 +1909,25 @@ def contract_sweep(
     K = len(plans)
     sw = _Sweep(K)
     _key_memo: Dict[tuple, tuple] = {}
+
+    bnb = as_bnb(bnb, "off")
+    ctxs: List[Optional[_BnbContext]] = [None] * K
+    if bnb != "off" and device_min_cells is not None:
+        for k, p in enumerate(plans):
+            if (
+                bnb == "auto"
+                and max_padded_join_cells(p, pad) * sr.cell_width
+                < BNB_AUTO_MIN_CELLS
+            ):
+                # no dispatch of this instance can ever clear the
+                # auto threshold — skip the (greedy incumbent +
+                # extrema) context build entirely, recorded once as
+                # a call-level skip
+                if met.enabled:
+                    met.inc("semiring.bnb_skipped_small")
+                continue
+            ctxs[k] = plan_bnb_context(p, sr, beta, tol)
+    bnb_call = any(c is not None for c in ctxs)
 
     def table_in(tbl: np.ndarray) -> np.ndarray:
         if sr.kind == "kbest" or (
@@ -1316,17 +1957,22 @@ def contract_sweep(
                 # root: the reduce is a scalar — fold it into the
                 # instance aggregate (plus every shift already applied)
                 sw.root_total[k] += float(u)
+            if ctxs[k] is not None:
+                ctxs[k].record_shift(name, 0.0, plan.children[name])
         else:
             shift = sr_n.shift_of(u)
             if not np.isfinite(shift):
                 shift = 0.0  # an all--inf message normalizes to itself
             u = sr_n.apply_shift(u, shift)
             sw.total_shift[k] += shift
-            mag = (
-                _finite_amax(u)
-                if sr_n.cell_width > 1
-                else float(np.max(np.abs(u), initial=0.0))
-            )
+            if ctxs[k] is not None:
+                ctxs[k].record_shift(
+                    name, shift, plan.children[name]
+                )
+            # finite-masked magnitude: pruned rows carry the
+            # ⊕-identity and hard constraints carry ±inf — both are
+            # exact values, not rounding scales
+            mag = _finite_amax(u)
             sw.msgs[k][name] = (sep, u, mag)
             sw.cells[k] += u.size
 
@@ -1485,7 +2131,11 @@ def contract_sweep(
                     )
                     odims = list(own_parts[0][0])
                 parts.append((odims, o))
-                parts_max += float(np.max(np.abs(o), initial=0.0))
+                # finite-masked: ±inf hard-constraint entries are
+                # EXACT in f32 (no rounding to bound), and an inf
+                # scale would force every hard-capped instance off
+                # the device
+                parts_max += _finite_amax(o)
             for c in plan.children[name]:
                 cdims, ctable, cmax = sw.msgs[k][c]
                 parts.append((cdims, ctable))
@@ -1521,6 +2171,31 @@ def contract_sweep(
                     err_in,
                 )
                 continue
+            # per-row BnB budget (host f64, f32 slack folded in).
+            # Mass semirings additionally gate on the ledger: when
+            # this node's worst-case pruned mass (tol_node by
+            # construction) would push the accumulated bound past
+            # tol, the dispatch stays device but UNPRUNED — the same
+            # tol discipline that forces host-f64 above.
+            ctx = ctxs[k]
+            shiftc = 0.0
+            budget = None
+            if ctx is not None:
+                shiftc = ctx.shift_under(plan.children[name])
+                if not sr_n.error_bounded or (
+                    err_in
+                    + _EPS32 * (
+                        (len(parts) + 1) * max(parts_max, 1.0)
+                        + shape[-1] + 2
+                    )
+                    + ctx.tol_node
+                    <= tol
+                ):
+                    n_rows = size // max(shape[-1], 1)
+                    budget = ctx.budget(
+                        name, shiftc, len(parts), parts_max,
+                        shape[-1], n_rows,
+                    )
 
             aligned = [
                 _align(t, dims, target) for dims, t in parts
@@ -1544,7 +2219,7 @@ def contract_sweep(
             buckets[key].append(
                 (
                     (k, name, sep, target, shape, parts,
-                     parts_max, err_in),
+                     parts_max, err_in, budget, shiftc),
                     aligned,
                 )
             )
@@ -1568,11 +2243,35 @@ def contract_sweep(
             n_rows = len(entries)
             shape0 = entries[0][0][4]
             uniform = all(it[4] == shape0 for it, _ in entries)
+            # two-pass bnb kernels: "on" prunes every device bucket,
+            # "auto" only buckets whose per-row padded table clears
+            # the threshold (the decision is a pure function of the
+            # bucket key, so every entry of a bucket agrees)
+            use_bnb = False
+            if bnb_call and any(
+                it[8] is not None for it, _ in entries
+            ):
+                per_row = int(np.prod(pshape)) * sr_b.cell_width
+                use_bnb = (
+                    bnb == "on" or per_row >= BNB_AUTO_MIN_CELLS
+                )
+                if not use_bnb and met.enabled:
+                    met.inc("semiring.bnb_skipped_small")
+            # finite sentinel (±f32max/2): rows bounded at the
+            # ⊕-annihilator (joint infeasibility / zero mass) prune
+            # even without an incumbent — their value IS the identity
+            big = float(np.finfo(np.float32).max) / 2
+            noprune = (
+                big
+                if sr_b.kind == "kbest"
+                or (sr_b.idempotent and not sr_b.maximize)
+                else -big
+            )
             if level_sync and n_rows > 1 and uniform:
                 ok = _dispatch_stacked(
                     sw, sr_b, entries, pshape, part_shapes, shape0,
                     pad, guard, tol, want_args, finish, sup, met,
-                    plans,
+                    plans, use_bnb, noprune, ctxs, tracer,
                 )
                 if ok:
                     continue
@@ -1581,10 +2280,12 @@ def contract_sweep(
                 # degrades further to the exact host contraction)
                 if met.enabled:
                     met.inc("engine.oom_splits")
-            fn = contraction_kernel(sr_b, pshape, part_shapes)
+            fn = contraction_kernel(
+                sr_b, pshape, part_shapes, bnb=use_bnb
+            )
             for item, aligned in entries:
                 (k, name, sep, target, shape, parts,
-                 parts_max, err_in) = item
+                 parts_max, err_in, budget, shiftc) = item
                 if (
                     timeout is not None
                     and time.perf_counter() - t0 > timeout
@@ -1599,6 +2300,11 @@ def contract_sweep(
                     aligned, shape, pshape, guard=guard,
                     with_mask=pad.enabled,
                 )
+                if use_bnb:
+                    b32 = np.float32(
+                        budget if budget is not None else noprune
+                    )
+                    padded = [b32] + list(padded)
                 try:
                     outs = sup.dispatch(
                         lambda p=padded: tuple(
@@ -1618,12 +2324,25 @@ def contract_sweep(
                     continue
                 if met.enabled:
                     met.inc("semiring.dispatches")
+                    if use_bnb:
+                        met.inc("semiring.bnb_passes")
                 sw.dispatches[k] += 1
                 region = tuple(slice(0, s) for s in shape[:-1])
-                _finish_device_row(
+                pruned = _finish_device_row(
                     sw, sr_b, plans[k], item, outs, region, tol,
-                    want_args, finish,
+                    want_args, finish, bnb=use_bnb, ctx=ctxs[k],
                 )
+                if use_bnb:
+                    if pruned and met.enabled:
+                        met.inc("semiring.bnb_pruned_cells", pruned)
+                    if tracer.enabled:
+                        tracer.event(
+                            "semiring-bnb", cat="supervisor",
+                            semiring=sr_b.name, rows=1,
+                            pruned_cells=int(pruned),
+                            table_cells=int(np.prod(shape))
+                            * sr_b.cell_width,
+                        )
     if tracer.enabled:
         tracer.add_span(
             "semiring.contract", "phase", t_sweep,
@@ -1635,10 +2354,14 @@ def contract_sweep(
 
 def _dispatch_stacked(
     sw, sr, entries, pshape, part_shapes, shape0, pad, guard, tol,
-    want_args, finish, sup, met, plans,
+    want_args, finish, sup, met, plans, use_bnb=False,
+    noprune=float("inf"), ctxs=(), tracer=None,
 ) -> bool:
     """One vmapped dispatch for a uniform level-pack bucket.  Returns
-    False on device OOM (caller degrades to per-node dispatches)."""
+    False on device OOM (caller degrades to per-node dispatches).
+    ``use_bnb`` prepends the per-row budget vector (pad rows get the
+    ``noprune`` sentinel, so ghost rows never contribute to the
+    pruning counters or the discard measurement)."""
     from pydcop_tpu.engine.supervisor import DeviceOOMError
 
     n_rows = len(entries)
@@ -1654,8 +2377,16 @@ def _dispatch_stacked(
             bufs[i][r][tuple(slice(0, s) for s in a.shape)] = a
         if has_mask:
             bufs[-1][r][..., shape0[-1]:] = guard
-    fn = contraction_kernel(sr, pshape, part_shapes, batched=True)
+    fn = contraction_kernel(
+        sr, pshape, part_shapes, batched=True, bnb=use_bnb
+    )
     casts = [b.astype(np.float32) for b in bufs]
+    if use_bnb:
+        budgets = np.full(stack_h, noprune, dtype=np.float32)
+        for r, (item, _) in enumerate(entries):
+            b = item[8]
+            budgets[r] = b if b is not None else noprune
+        casts = [budgets] + casts
     try:
         outs = sup.dispatch(
             lambda: tuple(np.asarray(x) for x in fn(*casts)),
@@ -1666,20 +2397,35 @@ def _dispatch_stacked(
         return False
     if met.enabled:
         met.inc("semiring.dispatches")
+        if use_bnb:
+            met.inc("semiring.bnb_passes")
     for k in sorted({item[0] for item, _ in entries}):
         sw.dispatches[k] += 1
     region_rows = tuple(slice(0, s) for s in shape0[:-1])
+    pruned_total = 0
     for r, (item, aligned) in enumerate(entries):
         row_outs = tuple(o[r] for o in outs)
-        _finish_device_row(
+        pruned_total += _finish_device_row(
             sw, sr, plans[item[0]], item, row_outs, region_rows,
-            tol, want_args, finish,
+            tol, want_args, finish, bnb=use_bnb,
+            ctx=(ctxs[item[0]] if use_bnb else None),
         )
+    if use_bnb:
+        if pruned_total and met.enabled:
+            met.inc("semiring.bnb_pruned_cells", pruned_total)
+        if tracer is not None and tracer.enabled:
+            tracer.event(
+                "semiring-bnb", cat="supervisor", semiring=sr.name,
+                rows=n_rows, pruned_cells=int(pruned_total),
+                table_cells=int(np.prod(shape0)) * sr.cell_width
+                * n_rows,
+            )
     return True
 
 
 def _finish_device_row(
-    sw, sr, plan, item, outs, region, tol, want_args, finish
+    sw, sr, plan, item, outs, region, tol, want_args, finish,
+    bnb=False, ctx=None,
 ):
     """Certify / account one device contraction and finish the node.
 
@@ -1688,11 +2434,32 @@ def _finish_device_row(
     values in exact f64 at the certified arg (tie-heavy tables are
     redone wholesale on host — same contract as DPOP).  logsumexp ⊕:
     accept the f32 values and extend the accumulated error bound
-    (the tol gate already ran before dispatch)."""
+    (the tol gate already ran before dispatch).
+
+    ``bnb``: the kernel's trailing outputs are the keep mask (and
+    the measured discard for mass semirings).  Pruned rows carry the
+    ⊕-identity and ``+inf`` margins, so they skip certification and
+    repair entirely; when most of a row's cells are pruned the exact
+    f64 re-evaluation gathers ONLY the survivors (the host-glue half
+    of the two-pass win).  Returns the pruned JOIN-cell count (0
+    without pruning) for the counters."""
     from pydcop_tpu.telemetry import get_metrics
 
     met = get_metrics()
-    (k, name, sep, target, shape, parts, parts_max, err_in) = item
+    (k, name, sep, target, shape, parts, parts_max, err_in,
+     _budget, shiftc) = item
+    keep_r = None
+    disc = None
+    pruned_cells = 0
+    if bnb:
+        if sr.idempotent or sr.kind == "kbest":
+            *outs, keep = outs
+        else:
+            *outs, keep, disc = outs
+        keep_r = np.asarray(keep[region], dtype=bool)
+        pruned_cells = int(keep_r.size - keep_r.sum()) * shape[
+            -1
+        ] * sr.cell_width
     if sr.kind == "kbest":
         vals, margins, own_idx, *slots = outs
         margins = np.asarray(margins[region], dtype=np.float64)
@@ -1713,7 +2480,7 @@ def _finish_device_row(
                 sr, k, name, plan, sep, u,
                 (own, dict(zip(plan.children[name], provs))),
             )
-            return
+            return pruned_cells
         own = np.asarray(own_idx[region], dtype=np.intp)
         slot_arrs = [
             np.asarray(s[region], dtype=np.intp) for s in slots
@@ -1722,6 +2489,8 @@ def _finish_device_row(
         # slots past the candidate count (or genuinely infeasible)
         # are +inf in the kernel's values; their backpointers are
         # clamped padding — the re-evaluation must not resurrect them
+        # (a bnb-pruned row's slots are all +inf, so this same mask
+        # keeps pruned rows at the ⊕-identity)
         u = np.where(
             np.isfinite(np.asarray(vals[region])), u, np.inf
         )
@@ -1734,9 +2503,14 @@ def _finish_device_row(
         (vals,) = outs
         u = np.asarray(vals[region], dtype=np.float64)
         scale = max(parts_max, 1.0)
+        extra = (
+            ctx.account(name, float(disc), shiftc, shape[-1])
+            if ctx is not None and disc is not None
+            else 0.0
+        )
         sw.err[k][name] = err_in + _EPS32 * (
             (len(parts) + 1) * scale + shape[-1] + 2
-        )
+        ) + extra
         sw.device_nodes[k] += 1
         finish(sr, k, name, plan, sep, u, None)
     elif sr.idempotent:
@@ -1759,7 +2533,7 @@ def _finish_device_row(
             if err_in:
                 sw.err[k][name] = err_in
             finish(sr, k, name, plan, sep, u, arg)
-            return
+            return pruned_cells
         own = target[-1]
         for cell in map(tuple, bad):
             row = np.zeros(shape[-1], dtype=np.float64)
@@ -1768,20 +2542,49 @@ def _finish_device_row(
             arg[cell] = int(sr.arg_reduce(row, axis=-1))
         # exact f64 values AT the certified arg: children contribute
         # zero error to their parents, whatever the tree depth
-        grids = (
-            np.indices(tuple(shape[:-1]), dtype=np.intp)
-            if len(shape) > 1
-            else None
-        )
-        u = np.zeros(tuple(shape[:-1]), dtype=np.float64)
-        for dims, table in parts:
-            idx = []
-            for d in dims:
-                if d == own:
-                    idx.append(arg)
-                else:
-                    idx.append(grids[target.index(d)])
-            u += np.asarray(table, dtype=np.float64)[tuple(idx)]
+        identity = sr.plus_identity
+        if (
+            keep_r is not None
+            and len(shape) > 1
+            and 4 * int(keep_r.sum()) < 3 * keep_r.size
+        ):
+            # >=25% pruned: the same compact-gather break-even the
+            # dpop glue uses (algorithms/dpop.py _exact_u_at)
+            # most rows pruned: gather the exact values at the
+            # SURVIVORS only — O(survivors·parts) host work instead
+            # of O(cells·parts), the host-glue half of the bnb win
+            coords = np.nonzero(keep_r)
+            a_sel = arg[coords]
+            acc = np.zeros(len(coords[0]), dtype=np.float64)
+            for dims, table in parts:
+                idx = []
+                for d in dims:
+                    if d == own:
+                        idx.append(a_sel)
+                    else:
+                        idx.append(coords[target.index(d)])
+                acc += np.asarray(table, dtype=np.float64)[
+                    tuple(idx)
+                ]
+            u = np.full(tuple(shape[:-1]), identity)
+            u[coords] = acc
+        else:
+            grids = (
+                np.indices(tuple(shape[:-1]), dtype=np.intp)
+                if len(shape) > 1
+                else None
+            )
+            u = np.zeros(tuple(shape[:-1]), dtype=np.float64)
+            for dims, table in parts:
+                idx = []
+                for d in dims:
+                    if d == own:
+                        idx.append(arg)
+                    else:
+                        idx.append(grids[target.index(d)])
+                u += np.asarray(table, dtype=np.float64)[tuple(idx)]
+            if keep_r is not None:
+                u = np.where(keep_r, u, identity)
         sw.device_nodes[k] += 1
         if err_in:
             sw.err[k][name] = err_in
@@ -1790,11 +2593,17 @@ def _finish_device_row(
         (vals,) = outs
         u = np.asarray(vals[region], dtype=np.float64)
         scale = max(parts_max, 1.0)
+        extra = (
+            ctx.account(name, float(disc), shiftc, shape[-1])
+            if ctx is not None and disc is not None
+            else 0.0
+        )
         sw.err[k][name] = err_in + _EPS32 * (
             (len(parts) + 1) * scale + shape[-1] + 2
-        )
+        ) + extra
         sw.device_nodes[k] += 1
         finish(sr, k, name, plan, sep, u, None)
+    return pruned_cells
 
 
 def _cell_row(table, dims, target, cell):
@@ -2094,6 +2903,7 @@ def run_infer_many(
     external_dists: Optional[
         Mapping[str, Mapping[Any, float]]
     ] = None,
+    bnb: str = "auto",
 ) -> List[Dict[str, Any]]:
     """Run one inference query over K instances with their contraction
     sweeps MERGED (the ``solve_many`` batching contract: same-bucket
@@ -2132,6 +2942,7 @@ def run_infer_many(
     """
     t0 = time.perf_counter()
     qkind, sr = parse_query(query)
+    bnb = as_bnb(bnb, "auto")
     if device not in ("auto", "never", "always"):
         raise ValueError(
             f"device must be 'auto'|'never'|'always', got {device!r}"
@@ -2205,13 +3016,13 @@ def run_infer_many(
             max_util_bytes=int(max_util_bytes), beta=beta, dmc=dmc,
             pad=pad, tol=tol, max_table_size=max_table_size,
             want_args=want_args, t0=t0, timeout=timeout, K=K,
-            query=query,
+            query=query, bnb=bnb,
         )
 
     sw = contract_sweep(
         plans, sr, beta=beta, device_min_cells=dmc, pad=pad,
         tol=tol, max_table_size=max_table_size, want_args=want_args,
-        t0=t0, timeout=timeout,
+        t0=t0, timeout=timeout, bnb=bnb,
     )
     if sw is None:
         return [_timeout_result(query, t0) for _ in range(K)]
@@ -2339,7 +3150,7 @@ def _timeout_result(query: str, t0: float) -> Dict[str, Any]:
 def _run_bounded_infer(
     dcops, plans, qkind, sr, *, max_util_bytes, beta, dmc, pad,
     tol, max_table_size, want_args, t0, timeout, K,
-    query: Optional[str] = None,
+    query: Optional[str] = None, bnb: str = "off",
 ) -> List[Dict[str, Any]]:
     """Memory-bounded assembly behind :func:`run_infer_many`
     (``max_util_bytes`` set): the budgeted lane sweep
@@ -2360,7 +3171,7 @@ def _run_bounded_infer(
         plans, sr, max_util_bytes=max_util_bytes, beta=beta,
         device_min_cells=dmc, pad=pad, tol=tol,
         max_table_size=max_table_size, want_args=want_args,
-        t0=t0, timeout=timeout,
+        t0=t0, timeout=timeout, bnb=bnb,
     )
     if bs is None:
         return [_timeout_result(query, t0) for _ in range(K)]

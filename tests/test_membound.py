@@ -424,5 +424,43 @@ def test_service_request_schema_carries_max_util_bytes():
     assert out["membound"] == ref["membound"]
 
 
+def test_budgeted_bnb_composes_bit_identical_and_sizing_unchanged():
+    """Budgeted membound sweeps COMPOSE with branch-and-bound
+    pruning: ``run_bounded`` lanes build their incumbent per lane
+    (each lane is an independent conditioned subproblem), so
+    budgeted+bnb=on is bit-identical to unbounded+bnb=off for
+    min_sum — and ``plan_cut``'s byte sizing ignores the mask
+    entirely (pruning changes which rows are WORKED, never what the
+    device allocates): the ``membound`` meta matches the unpruned
+    budgeted solve field for field."""
+    from pydcop_tpu.api import solve
+
+    dcop = _guard._build_secp_overlap(
+        12, 10, 4, seed=31, arity=5, stride=2, hard_cap=1.15,
+    )
+    kw = dict(pad_policy="pow2")
+    base = solve(
+        dcop, "dpop", {"util_device": "never", "bnb": "off"}, **kw
+    )
+    b_off = solve(
+        dcop, "dpop", {"util_device": "always", "bnb": "off"},
+        max_util_bytes=1024, **kw
+    )
+    b_on = solve(
+        dcop, "dpop", {"util_device": "always", "bnb": "on"},
+        max_util_bytes=1024, **kw
+    )
+    assert b_off["membound"]["cut_width"] >= 1  # budget really cut
+    assert base["cost"] == b_off["cost"] == b_on["cost"]
+    assert (
+        base["assignment"]
+        == b_off["assignment"]
+        == b_on["assignment"]
+    )
+    # the mask never reaches the planner: identical cut, lanes,
+    # budget and peak bytes whether pruning ran or not
+    assert b_on["membound"] == b_off["membound"]
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

@@ -177,6 +177,25 @@ def test_query_guard_structured_queries_reuse_buckets():
     assert report["repeat_compiles"] == 0, report
 
 
+@pytest.mark.semiring
+def test_bnb_guard_pruned_kernels_share_buckets():
+    """Branch-and-bound pruned contraction kernels (ops/semiring.py
+    ``bnb``): on a K=4 hard-capped overlap-SECP stack, bnb=on
+    compiles at most ONE extra executable per (semiring, bucket)
+    versus bnb=off (here: no more compiles than the off pass, whose
+    plain kernels are already cached), an identical bnb=on repeat
+    compiles ZERO, at least one join cell actually pruned, and
+    results stay BIT-IDENTICAL to the unpruned kernels.  See
+    tools/recompile_guard.py:run_bnb_guard."""
+    guard = _load_guard()
+    report = guard.run_bnb_guard()
+    assert report["ok"], report
+    assert report["off_compiles"] >= 1, report  # guard actually ran
+    assert report["on_compiles"] <= report["off_compiles"], report
+    assert report["repeat_compiles"] == 0, report
+    assert report["pruned_cells"] >= 1, report
+
+
 @pytest.mark.membound
 def test_membound_guard_budgeted_solve_reuses_buckets():
     """Memory-bounded solves (ops/membound.py): the first budgeted

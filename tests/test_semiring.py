@@ -611,5 +611,246 @@ def test_trace_summary_folds_semiring_report(tmp_path):
     assert "cells/s" in text
 
 
+# -- branch-and-bound pruned kernels (bnb) ------------------------------
+
+
+def _hard_band_dcop(
+    n, seed, d=4, arity=4, stride=2, cap=1.15, ties=False,
+):
+    """Chained overlap band with HARD over-sum caps (``+inf`` past
+    ``cap × target``) — the structure the two-pass ⊕-bounded kernels
+    prune.  ``ties=True`` quantizes costs to a coarse grid so tables
+    are tie-heavy (exercising pruning × certificate-repair at once).
+    Small enough to brute-force / run in-suite."""
+    rnd = random.Random(seed)
+    dom = Domain("d", "", list(range(d)))
+    dcop = DCOP(f"hb{seed}")
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for i, v in enumerate(vs):
+        dcop.add_variable(v)
+        dcop.add_constraint(
+            NAryMatrixRelation(
+                [v],
+                np.arange(d, dtype=np.float64)
+                * rnd.uniform(0.05, 0.3),
+                name=f"u{i}",
+            )
+        )
+    for m in range((n - arity) // stride + 1):
+        scope = vs[m * stride:m * stride + arity]
+        t = rnd.uniform(0.3, 0.8) * arity * (d - 1)
+        mat = np.zeros((d,) * arity)
+        for idx in itertools.product(range(d), repeat=arity):
+            s = sum(idx)
+            if s > cap * t:
+                mat[idx] = np.inf
+            else:
+                c = abs(s - t)
+                mat[idx] = round(c * 2) / 2.0 if ties else c
+        dcop.add_constraint(
+            NAryMatrixRelation(scope, mat, name=f"m{m}")
+        )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n)])
+    return dcop
+
+
+def _bnb_counters(result):
+    c = result["telemetry"]["counters"]
+    return (
+        int(c.get("semiring.bnb_passes", 0)),
+        int(c.get("semiring.bnb_pruned_cells", 0)),
+    )
+
+
+@pytest.mark.semiring
+@pytest.mark.parametrize(
+    "seed,ties", [(1, False), (2, True), (5, True)]
+)
+def test_bnb_idempotent_bitwise_parity(seed, ties):
+    """bnb=on is BIT-IDENTICAL to bnb=off for the idempotent ⊕s on
+    hard-capped, tie-heavy bands: same dpop cost+assignment, same
+    infer map assignment — pruned rows provably cannot enter the
+    optimum, and the f32 slack keeps the comparison conservative."""
+    from pydcop_tpu.api import infer, solve
+
+    dcop = _hard_band_dcop(10, seed, ties=ties)
+    kw = dict(pad_policy="pow2")
+    r_off = solve(
+        dcop, "dpop", {"util_device": "always", "bnb": "off"}, **kw
+    )
+    r_on = solve(
+        dcop, "dpop", {"util_device": "always", "bnb": "on"}, **kw
+    )
+    assert r_on["cost"] == r_off["cost"]
+    assert r_on["assignment"] == r_off["assignment"]
+    passes, pruned = _bnb_counters(r_on)
+    assert passes >= 1  # the pruned kernels actually ran
+    m_off = infer(dcop, "map", device="always", bnb="off")
+    m_on = infer(dcop, "map", device="always", bnb="on")
+    assert m_on["cost"] == m_off["cost"]
+    assert m_on["assignment"] == m_off["assignment"]
+
+
+@pytest.mark.semiring
+def test_bnb_prunes_hard_capped_rows():
+    """On a hard-capped band the pruned-cell counter is non-zero
+    (jointly-over-budget rows die in pass 1) and the result is still
+    exact vs the pure host-f64 solve."""
+    from pydcop_tpu.api import solve
+
+    dcop = _hard_band_dcop(12, 3, d=5, arity=5, stride=2, cap=1.1)
+    base = solve(dcop, "dpop", {"util_device": "never"})
+    r_on = solve(
+        dcop, "dpop", {"util_device": "always", "bnb": "on"},
+        pad_policy="pow2",
+    )
+    assert r_on["cost"] == base["cost"]
+    assert r_on["assignment"] == base["assignment"]
+    passes, pruned = _bnb_counters(r_on)
+    assert pruned >= 1, r_on["telemetry"]["counters"]
+
+
+@pytest.mark.semiring
+def test_bnb_mass_queries_within_error_bound():
+    """logsumexp/marginals/expectation under bnb=on: discarded mass
+    is accounted — results stay within the REPORTED error_bound of
+    the unpruned run (tol loosened so the device + pruning actually
+    engage on these small tables)."""
+    from pydcop_tpu.api import infer
+
+    dcop = _hard_band_dcop(9, 4, d=4, arity=4)
+    kw = dict(device="always", tol=1e-3, pad_policy="pow2")
+    for query in ("log_z", "expectation"):
+        off = infer(dcop, query, bnb="off", **kw)
+        on = infer(dcop, query, bnb="on", **kw)
+        bound = max(on["error_bound"], off["error_bound"]) + 1e-9
+        key = "log_z" if query == "log_z" else "e_cost"
+        tol_key = (
+            bound if query == "log_z"
+            # e_cost error scales the weight-plane bound by the cost
+            # magnitude (docs/semirings.md) — allow that factor
+            else bound * max(abs(on["e_cost"]), 1.0) * 10
+        )
+        assert abs(on[key] - off[key]) <= tol_key, (
+            query, on[key], off[key], bound,
+        )
+    off = infer(dcop, "marginals", bnb="off", **kw)
+    on = infer(dcop, "marginals", bnb="on", **kw)
+    for v, p in off["marginals"].items():
+        assert np.allclose(
+            p, on["marginals"][v],
+            atol=max(on["error_bound"], 1e-6) * 10 + 1e-9,
+        )
+
+
+@pytest.mark.semiring
+def test_bnb_kbest_prunes_without_losing_slot_k():
+    """kbest:5 under bnb=on: per-slot bounds against the k-th
+    incumbent prune rows WITHOUT losing any of the 5 best — the
+    solution list (assignments, costs, order) is bit-identical to
+    the unpruned kernel, 5 distinct ascending entries."""
+    from pydcop_tpu.api import infer
+
+    dcop = _hard_band_dcop(11, 7, d=4, arity=4, cap=1.2)
+    kw = dict(device="always", pad_policy="pow2")
+    off = infer(dcop, "kbest:5", bnb="off", **kw)
+    on = infer(dcop, "kbest:5", bnb="on", **kw)
+    assert on["costs"] == off["costs"]
+    assert [s["assignment"] for s in on["solutions"]] == [
+        s["assignment"] for s in off["solutions"]
+    ]
+    assert len(on["solutions"]) == 5
+    es = [s["energy"] for s in on["solutions"]]
+    assert es == sorted(es)
+    assert len({tuple(sorted(s["assignment"].items()))
+                for s in on["solutions"]}) == 5
+    passes, pruned = _bnb_counters(on)
+    assert pruned >= 1, on["telemetry"]["counters"]
+
+
+@pytest.mark.semiring
+def test_bnb_auto_skips_small_dispatches():
+    """bnb='auto' keeps the single-pass kernel for dispatches below
+    the size threshold (semiring.bnb_skipped_small counts them) —
+    small factors must not pay the two-pass overhead."""
+    from pydcop_tpu.api import solve
+
+    dcop = _hard_band_dcop(8, 9, d=3, arity=3)
+    r = solve(
+        dcop, "dpop", {"util_device": "always", "bnb": "auto"},
+        pad_policy="pow2",
+    )
+    c = r["telemetry"]["counters"]
+    assert int(c.get("semiring.bnb_skipped_small", 0)) >= 1, c
+    assert int(c.get("semiring.bnb_passes", 0)) == 0, c
+
+
+@pytest.mark.semiring
+def test_bnb_bp_factor_messages_bitwise():
+    """The BP factor phase's two-pass variant is bit-identical to
+    the single-pass kernel — tie-heavy and ±inf hard-constraint
+    tables included (pruned configs are strictly worse than every
+    output's f32 optimum)."""
+    import jax.numpy as jnp
+
+    rnd = np.random.default_rng(11)
+    k, d, m = 3, 4, 6
+    tab = np.round(rnd.uniform(0, 4, size=(d,) * k + (m,)), 1)
+    tab[tab > 3.5] = np.inf  # hard cells + plenty of exact ties
+    q = [
+        np.round(rnd.uniform(0, 2, size=(d, m)), 1).astype(
+            np.float32
+        )
+        for _ in range(k)
+    ]
+    tab32 = jnp.asarray(tab, dtype=jnp.float32)
+    qj = [jnp.asarray(x) for x in q]
+    base = sr.bp_factor_messages(sr.MIN_SUM, tab32, qj, jnp.float32)
+    bnb = sr.bp_factor_messages(
+        sr.MIN_SUM, tab32, qj, jnp.float32, bnb=True
+    )
+    for a, b in zip(base, bnb):
+        assert np.array_equal(
+            np.asarray(a), np.asarray(b), equal_nan=True
+        )
+
+
+@pytest.mark.semiring
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(6))
+def test_bnb_parity_matrix_slow(seed):
+    """The broad property matrix (kept out of tier-1 for the time
+    budget): random hard/tie bands × every query family, bnb=on vs
+    off — idempotent ⊕ bitwise, mass ⊕ within bounds."""
+    from pydcop_tpu.api import infer, solve
+
+    ties = seed % 2 == 1
+    dcop = _hard_band_dcop(
+        12, 20 + seed, d=4, arity=4 + seed % 2, ties=ties,
+        cap=1.1 + 0.1 * (seed % 3),
+    )
+    kw = dict(pad_policy="pow2")
+    r_off = solve(
+        dcop, "dpop", {"util_device": "always", "bnb": "off"}, **kw
+    )
+    r_on = solve(
+        dcop, "dpop", {"util_device": "always", "bnb": "on"}, **kw
+    )
+    assert r_on["cost"] == r_off["cost"]
+    assert r_on["assignment"] == r_off["assignment"]
+    off = infer(dcop, "kbest:5", device="always", bnb="off", **kw)
+    on = infer(dcop, "kbest:5", device="always", bnb="on", **kw)
+    assert on["costs"] == off["costs"]
+    z_off = infer(
+        dcop, "log_z", device="always", tol=1e-3, bnb="off", **kw
+    )
+    z_on = infer(
+        dcop, "log_z", device="always", tol=1e-3, bnb="on", **kw
+    )
+    assert abs(z_on["log_z"] - z_off["log_z"]) <= (
+        max(z_on["error_bound"], z_off["error_bound"]) + 1e-9
+    )
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

@@ -621,5 +621,40 @@ def test_service_infer_validation_and_wire_round_trip():
                     client.infer(txt, "map", rounds=5)
 
 
+def test_service_bnb_field_on_solve_and_infer():
+    """The ``bnb`` knob rides the request schema: submit validates
+    it at admission (unknown values and algos without a contraction
+    phase rejected), submit_infer carries it into the dispatch
+    partition key, and the wire op ships it — results bit-identical
+    to bnb=off (the exactness contract)."""
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.engine.service import (
+        ServiceClient,
+        ServiceServer,
+        SolverService,
+    )
+
+    dcop = _random_dcop(5, 0)
+    with SolverService(pad_policy="pow2", max_wait=0.05) as svc:
+        with pytest.raises(ValueError, match="bnb"):
+            svc.submit_infer(dcop, "map", bnb="maybe")
+        with pytest.raises(ValueError, match="bnb"):
+            svc.submit(dcop, "dsa", bnb="on")
+        off = svc.infer(dcop, "map", bnb="off")
+        on = svc.infer(dcop, "map", bnb="on")
+        assert on["cost"] == off["cost"]
+        assert on["assignment"] == off["assignment"]
+        s_off = svc.solve(dcop, "dpop", bnb="off")
+        s_on = svc.solve(dcop, "dpop", bnb="on")
+        assert s_on["cost"] == s_off["cost"]
+        with ServiceServer(svc) as server:
+            with ServiceClient(server.address) as client:
+                txt = dcop_yaml(dcop)
+                rw = client.infer(txt, "map", bnb="on")
+                assert rw["cost"] == on["cost"]
+                sw = client.solve(txt, algo="dpop", bnb="on")
+                assert sw["cost"] == s_on["cost"]
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

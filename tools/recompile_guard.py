@@ -1057,14 +1057,17 @@ def run_query_guard() -> dict:
 
 def _build_secp_overlap(
     n_lights: int, n_models: int, levels: int, seed: int,
-    arity: int = 4, stride: int = 2,
+    arity: int = 4, stride: int = 2, hard_cap: float = 0.0,
 ):
     """Fixed-structure OVERLAP-zone SECP: model ``m``'s window starts
     at ``m * stride`` (consecutive windows share ``arity - stride``
     lights), chaining the strip into one band whose induced width the
     memory-bounded planner must cut — the deliberately-deep twin of
     :func:`_build_secp`'s shallow consecutive windows.  Deterministic
-    scopes, per-seed targets/rules."""
+    scopes, per-seed targets/rules.  ``hard_cap`` > 1 makes each
+    model's over-illumination hard (``+inf`` past ``hard_cap ×
+    target`` — the ``generate secp --hard_cap`` rule), the structure
+    branch-and-bound pruning bites on."""
     import itertools
     import random
 
@@ -1093,12 +1096,109 @@ def _build_secp_overlap(
         target = rnd.uniform(0.3, 1.0) * arity * (levels - 1)
         matrix = np.zeros((levels,) * arity, dtype=np.float64)
         for idx in itertools.product(range(levels), repeat=arity):
-            matrix[idx] = abs(sum(idx) - target)
+            s = sum(idx)
+            if hard_cap and s > hard_cap * target:
+                matrix[idx] = np.inf
+            else:
+                matrix[idx] = abs(s - target)
         dcop.add_constraint(
             NAryMatrixRelation(scope, matrix, name=f"mod{m}")
         )
     dcop.add_agents([AgentDef(f"a{i}") for i in range(n_lights)])
     return dcop
+
+
+def run_bnb_guard() -> dict:
+    """Compile/parity budget for the branch-and-bound pruned
+    contraction kernels (ops/semiring.py, ``bnb``): on a K=4
+    same-bucket stack of hard-capped overlap-SECP instances through
+    ``solve_many`` with the device forced on, (1) ``bnb=off``
+    compiles the plain kernel set, (2) ``bnb=on`` compiles at most
+    ONE extra executable per (semiring, bucket) — i.e. no more
+    compiles than the off pass, since every bucket gains exactly its
+    bnb variant, (3) an IDENTICAL bnb=on repeat compiles ZERO, and
+    (4) on/off results are BIT-IDENTICAL (cost AND assignment, per
+    instance) with a non-zero pruned-cell count — the guard is
+    vacuous if nothing pruned.  Regressions this catches: the bnb
+    flag leaking out of the kernel cache key (repeat compiles), bnb
+    kernels splitting level-pack buckets (compile blowup), and any
+    pruning-path drift from the exact unpruned answer."""
+    from pydcop_tpu.api import solve_many
+    from pydcop_tpu.ops import semiring as sr_mod
+    from pydcop_tpu.telemetry import session
+
+    sr_mod._KERNELS.clear()
+
+    dcops = [
+        _build_secp_overlap(
+            12, 10, 4, seed=100 + i, arity=5, stride=2,
+            hard_cap=1.15,
+        )
+        for i in range(4)
+    ]
+    params_off = {"util_device": "always", "bnb": "off"}
+    params_on = {"util_device": "always", "bnb": "on"}
+    kw = dict(pad_policy="pow2")
+
+    def compiles(tel):
+        return int(tel.summary()["counters"].get("jit.compiles", 0))
+
+    def pruned(tel):
+        return int(
+            tel.summary()["counters"].get(
+                "semiring.bnb_pruned_cells", 0
+            )
+        )
+
+    with session() as t0:
+        r_off = solve_many(dcops, "dpop", params_off, **kw)
+    with session() as t1:
+        r_on = solve_many(dcops, "dpop", params_on, **kw)
+    with session() as t2:
+        r_on2 = solve_many(dcops, "dpop", params_on, **kw)
+    off_compiles, on_compiles, repeat_compiles = (
+        compiles(t0), compiles(t1), compiles(t2)
+    )
+    pruned_cells = pruned(t1)
+    report = {
+        "off_compiles": off_compiles,
+        "on_compiles": on_compiles,
+        "repeat_compiles": repeat_compiles,
+        "pruned_cells": pruned_cells,
+        "costs": [r["cost"] for r in r_off],
+        "ok": True,
+    }
+    if pruned_cells < 1:
+        report["ok"] = False
+        report["error"] = (
+            "bnb=on pruned nothing on the hard-capped overlap "
+            "stack — the guard is vacuous"
+        )
+    elif not all(
+        a["cost"] == b["cost"] == c["cost"]
+        and a["assignment"] == b["assignment"] == c["assignment"]
+        for a, b, c in zip(r_off, r_on, r_on2)
+    ):
+        report["ok"] = False
+        report["error"] = (
+            "bnb=on diverges from the unpruned solve — pruning "
+            "stopped being exact"
+        )
+    elif on_compiles > off_compiles:
+        report["ok"] = False
+        report["error"] = (
+            f"bnb=on compiled {on_compiles} > bnb=off's "
+            f"{off_compiles} — more than one extra executable per "
+            "(semiring, bucket): bnb kernels stopped sharing the "
+            "level-pack buckets"
+        )
+    elif repeat_compiles != 0:
+        report["ok"] = False
+        report["error"] = (
+            f"{repeat_compiles} new compile(s) on an identical "
+            "bnb=on repeat — the bnb kernel cache key is unstable"
+        )
+    return report
 
 
 def run_membound_guard() -> dict:
@@ -1211,6 +1311,7 @@ def main() -> int:
     report_semiring = run_semiring_guard()
     report_query = run_query_guard()
     report_membound = run_membound_guard()
+    report_bnb = run_bnb_guard()
     report_restore = run_restore_guard()
     print(
         json.dumps(
@@ -1223,6 +1324,7 @@ def main() -> int:
                 "semiring": report_semiring,
                 "query": report_query,
                 "membound": report_membound,
+                "bnb": report_bnb,
                 "restore": report_restore,
             }
         )
@@ -1237,6 +1339,7 @@ def main() -> int:
         and report_semiring["ok"]
         and report_query["ok"]
         and report_membound["ok"]
+        and report_bnb["ok"]
         and report_restore["ok"]
         else 1
     )
