@@ -279,6 +279,13 @@ def _solve_dispatch(
                 "serving wire — use `pydcop_tpu serve --chaos` "
                 "(docs/serving.md)"
             )
+        if plan_probe.fleet_faults_configured:
+            raise ValueError(
+                "fleet-level chaos kinds (replica_kill) act on a "
+                "replicated serving fleet's processes "
+                f"(engine/fleet.py); mode={mode!r} has no fleet — "
+                "use `pydcop_tpu fleet --chaos` (docs/faults.md)"
+            )
 
     if mode in ("thread", "sim"):
         if checkpoint_path is not None or resume:
@@ -364,6 +371,13 @@ def _solve_dispatch(
                 "loop — use `pydcop_tpu serve --chaos` "
                 "(docs/serving.md); a one-shot solve has no serving "
                 "wire"
+            )
+        if plan.fleet_faults_configured:
+            raise ValueError(
+                "fleet-level chaos kinds (replica_kill) act on a "
+                "replicated serving fleet's processes — use "
+                "`pydcop_tpu fleet --chaos` (docs/faults.md); a "
+                "one-shot solve has no fleet"
             )
     if k_target:
         raise ValueError(
@@ -919,6 +933,13 @@ def solve_many(
                 "frame_corrupt) inject at the solver service's frame "
                 "loop — use `pydcop_tpu serve --chaos` "
                 "(docs/serving.md); solve_many has no serving wire"
+            )
+        if plan.fleet_faults_configured:
+            raise ValueError(
+                "fleet-level chaos kinds (replica_kill) act on a "
+                "replicated serving fleet's processes — use "
+                "`pydcop_tpu fleet --chaos` (docs/faults.md); "
+                "solve_many has no fleet"
             )
 
     if compile_cache is not None:

@@ -36,6 +36,10 @@ COMMANDS = [
     "worker",
     # resident continuous-batching solver service (docs/serving.md)
     "serve",
+    # self-healing replicated serving fleet: consistent-hash router +
+    # N serve replicas with k-resilient session replication
+    # (docs/serving.md, "The fleet")
+    "fleet",
     # live terminal view of a serve --metrics_port exporter
     # (docs/observability.md, "Serving observability")
     "top",

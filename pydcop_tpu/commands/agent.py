@@ -94,6 +94,12 @@ def run_cmd(args) -> int:
                 "inject at the batched engine's supervised dispatch "
                 "— use `solve`/`run --chaos` (docs/faults.md)"
             )
+        if plan.fleet_faults_configured:
+            raise SystemExit(
+                "agent: fleet-level chaos kinds (replica_kill) act "
+                "on a replicated serving fleet's processes — use "
+                "`pydcop_tpu fleet --chaos` (docs/faults.md)"
+            )
     if len(args.names) > 1:
         # one OS process per agent: each is an independent
         # jax.distributed participant, so fork real subprocesses

@@ -190,6 +190,13 @@ def run_cmd(args) -> int:
                 "inject at the batched engine's supervised dispatch "
                 "— use `solve`/`run --chaos` (docs/faults.md)"
             )
+        if plan.fleet_faults_configured:
+            raise SystemExit(
+                "orchestrator: fleet-level chaos kinds "
+                "(replica_kill) act on a replicated serving fleet's "
+                "processes — use `pydcop_tpu fleet --chaos` "
+                "(docs/faults.md)"
+            )
     placement = None
     dist_name = None
     if args.distribution:

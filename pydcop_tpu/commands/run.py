@@ -130,6 +130,12 @@ def run_cmd(args) -> int:
                 "loop — use `pydcop_tpu serve --chaos` "
                 "(docs/serving.md)"
             )
+        if chaos_plan.fleet_faults_configured:
+            raise SystemExit(
+                "run: fleet-level chaos kinds (replica_kill) act on "
+                "a replicated serving fleet's processes — use "
+                "`pydcop_tpu fleet --chaos` (docs/faults.md)"
+            )
         if not chaos_plan.crashes and not chaos_plan.device_faults_configured:
             raise SystemExit(
                 "run: --chaos without crash=AGENT@T or device-layer "

@@ -94,18 +94,32 @@ def set_parser(subparsers) -> None:
         "status='shed'; default 8",
     )
     p.add_argument(
-        "--session_checkpoint", default=None, metavar="FILE",
+        "--session_checkpoint", default=None, metavar="PATH",
         help="write the final session checkpoint (pinned dcops, "
-        "applied set_values deltas, per-session counters) to FILE on "
+        "applied set_values deltas, per-session counters) to PATH on "
         "every exit path — SIGTERM/Ctrl-C/shutdown all drain "
-        "gracefully first (docs/serving.md)",
+        "gracefully first (docs/serving.md).  When PATH is a "
+        "directory, a per-process file sessions-<port|pid>.json is "
+        "derived inside it, so fleet replicas sharing one config "
+        "never clobber each other's checkpoints",
     )
     p.add_argument(
         "--resume", action="store_true",
-        help="replay the --session_checkpoint file at startup (if it "
-        "exists): restored sessions' set_values follow-ups stay "
+        help="replay the --session_checkpoint file at startup: "
+        "restored sessions' set_values follow-ups stay "
         "compile.incremental-only, bit-identical to an undisturbed "
-        "service",
+        "service.  A missing, truncated, or schema-drifted "
+        "checkpoint fails with a structured error (exit, not a "
+        "silently-empty service)",
+    )
+    p.add_argument(
+        "--standby", action="append", default=None, metavar="ADDR",
+        dest="standbys",
+        help="stream every session's delta log to the replica at "
+        "ADDR (host:port; repeatable for k-resilience) as it "
+        "mutates, so a kill of THIS process resumes its sessions "
+        "there compile.incremental-only — `pydcop_tpu fleet` wires "
+        "these automatically from the hash ring (docs/serving.md)",
     )
     p.add_argument(
         "--metrics_port", type=int, default=None, metavar="PORT",
@@ -116,14 +130,16 @@ def set_parser(subparsers) -> None:
         "with `pydcop_tpu top` (docs/observability.md)",
     )
     p.add_argument(
-        "--flight_dump", default=None, metavar="FILE",
+        "--flight_dump", default=None, metavar="PATH",
         help="dump the always-on flight-recorder ring (recent spans/"
         "events/counter deltas, bounded — no trace file needed) "
-        "atomically to FILE whenever a request is shed or "
+        "atomically to PATH whenever a request is shed or "
         "quarantined, a dispatch fails, or the service drains "
         "(SIGTERM included), the triggering request's trace id "
         "front and center; render with `pydcop_tpu flight-dump "
-        "FILE` (docs/observability.md)",
+        "FILE` (docs/observability.md).  When PATH is a directory, "
+        "a per-process file flight-<port|pid>.json is derived "
+        "inside it (fleet replicas never clobber each other)",
     )
     p.add_argument(
         "--chaos", default=None, metavar="SPEC",
@@ -134,7 +150,9 @@ def set_parser(subparsers) -> None:
         "— docs/faults.md): a poisoned or OOM-ing request "
         "degrades/splits under the supervisor while its batchmates "
         "return bit-identical results; dropped/corrupted replies are "
-        "replayed from the reply cache on idempotent retry",
+        "replayed from the reply cache on idempotent retry.  The "
+        "FLEET kind (replica_kill) is rejected here — it kills "
+        "whole replicas, use `pydcop_tpu fleet --chaos`",
     )
     p.add_argument(
         "--chaos_seed", type=int, default=0,
@@ -143,6 +161,26 @@ def set_parser(subparsers) -> None:
     add_supervisor_arguments(p)
     add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
+
+
+def _per_process_path(
+    path, prefix: str, port: int
+):
+    """Resolve a ``--session_checkpoint`` / ``--flight_dump`` target:
+    when it is a DIRECTORY (exists as one, or is spelled with a
+    trailing separator), derive ``<dir>/<prefix>-<suffix>.json`` with
+    a per-process suffix — the bound port when one was requested
+    (stable across restarts, so ``--resume`` finds it), else the pid.
+    N fleet replicas sharing one config then never clobber each
+    other's files."""
+    import os
+
+    if path is None:
+        return None
+    if os.path.isdir(path) or path.endswith(os.sep):
+        suffix = str(port) if port else f"pid{os.getpid()}"
+        return os.path.join(path, f"{prefix}-{suffix}.json")
+    return path
 
 
 def run_cmd(args) -> int:
@@ -155,6 +193,13 @@ def run_cmd(args) -> int:
         )
 
         enable_persistent_compilation_cache(args.compile_cache)
+
+    session_checkpoint = _per_process_path(
+        args.session_checkpoint, "sessions", args.port
+    )
+    flight_dump = _per_process_path(
+        args.flight_dump, "flight", args.port
+    )
 
     stats = None
     with session(args.trace, args.trace_format) as tel:
@@ -170,9 +215,10 @@ def run_cmd(args) -> int:
                 chunk_floor=args.chunk_floor,
                 on_numeric_fault=args.on_numeric_fault,
                 max_queue=args.max_queue,
-                session_checkpoint=args.session_checkpoint,
+                session_checkpoint=session_checkpoint,
                 resume=args.resume,
-                flight_dump=args.flight_dump,
+                flight_dump=flight_dump,
+                standbys=args.standbys,
             )
         except ValueError as e:
             # flag/spec usage errors exit cleanly, like the sibling
@@ -226,6 +272,13 @@ def run_cmd(args) -> int:
             }
             if exporter is not None:
                 head["metrics"] = "%s:%d" % exporter.address
+            if session_checkpoint is not None:
+                # the RESOLVED path (a directory target gets its
+                # per-process suffix here) — the parent process /
+                # test harness reads it back from this line
+                head["session_checkpoint"] = session_checkpoint
+            if flight_dump is not None:
+                head["flight_dump"] = flight_dump
             print(json.dumps(head), flush=True)
             try:
                 # the global -t/--timeout doubles as a serve

@@ -418,6 +418,7 @@ class SolverService:
         session_checkpoint: Optional[str] = None,
         resume: bool = False,
         flight_dump: Optional[str] = None,
+        standbys: Optional[Sequence[str]] = None,
         autostart: bool = True,
     ):
         from pydcop_tpu.ops.padding import as_pad_policy
@@ -472,6 +473,13 @@ class SolverService:
                     "kinds (conn_drop, slow_client, frame_corrupt) "
                     "only (docs/faults.md)"
                 )
+            if plan.fleet_faults_configured:
+                raise ValueError(
+                    "fleet-level chaos kinds (replica_kill) act on "
+                    "a replicated serving fleet's processes — one "
+                    "service cannot kill a replica of itself; use "
+                    "`pydcop_tpu fleet --chaos` (docs/faults.md)"
+                )
         self.chaos_plan = plan
         from pydcop_tpu.engine.supervisor import make_supervisor
 
@@ -521,14 +529,39 @@ class SolverService:
         self._tick_med: Optional[float] = None
         self._drained = False
 
+        # fleet replication (docs/serving.md, "The fleet"): the
+        # standby addresses this replica streams its session delta
+        # logs to, the persistent clients it streams over, and the
+        # REPLICATED copies of OTHER replicas' sessions it holds as a
+        # standby (promoted into _sessions on the first failed-over
+        # frame).  One lock serializes standby mutation AND the
+        # per-entry sends, so a standby always applies a session's
+        # entries in segment order.
+        self._repl_lock = threading.Lock()
+        self._standby_addrs: List[str] = []
+        self._repl_clients: Dict[str, "ServiceClient"] = {}
+        self._standby_sessions: Dict[str, _Session] = {}
+        self._n_replica_updates = 0
+        self._n_replicated_segments = 0
+        self._n_replication_errors = 0
+        self._n_sessions_promoted = 0
+
         if resume:
             if not session_checkpoint:
                 raise ValueError(
                     "resume=True needs session_checkpoint=<path> — "
                     "there is nothing else to resume from"
                 )
-            if os.path.exists(session_checkpoint):
-                self.restore_sessions(session_checkpoint)
+            # no existence pre-check: resuming from a checkpoint that
+            # is missing, truncated, or schema-drifted must FAIL with
+            # a structured error, not silently start empty — a fleet
+            # health watcher treats the dead process as unhealthy and
+            # routes around it, whereas a silently-empty replica
+            # would claim its ring arc with every session lost
+            self.restore_sessions(session_checkpoint)
+
+        if standbys:
+            self.set_standbys(standbys)
 
         if autostart:
             self.start()
@@ -587,6 +620,11 @@ class SolverService:
                         "service-checkpoint-error", cat="service",
                         error=f"{type(e).__name__}: {e}"[:300],
                     )
+        with self._repl_lock:
+            repl = list(self._repl_clients.values())
+            self._repl_clients = {}
+        for cli in repl:
+            cli.close()
         self._drained = True
         met = get_metrics()
         if met.enabled:
@@ -729,6 +767,12 @@ class SolverService:
             )
 
         sess = self._sessions.get(session) if session else None
+        if sess is None and session:
+            # a failed-over session's first frame on this replica:
+            # promote the replicated standby copy into the live table
+            # so the follow-up costs compile.incremental, not a
+            # re-pin (docs/serving.md, "The fleet")
+            sess = self._promote_standby(session)
         if sess is not None:
             if dcop is not None:
                 # a follow-up may resend the SAME dcop (a reconnecting
@@ -1082,7 +1126,11 @@ class SolverService:
 
     def close_session(self, name: str) -> bool:
         """Drop a pinned session (frees its compiled state); returns
-        whether it existed."""
+        whether it existed.  A replicated standby copy of the same
+        name drops too — a closed session must not resurrect through
+        a later promotion."""
+        with self._repl_lock:
+            self._standby_sessions.pop(name, None)
         with self._cond:
             return self._sessions.pop(name, None) is not None
 
@@ -1162,12 +1210,40 @@ class SolverService:
         device tables and costs ``compile.incremental`` only (the
         replay itself pays the one segment-1 ``compile.full``, at
         startup, before any request is admitted).  Returns the number
-        of sessions restored."""
-        with open(path, encoding="utf-8") as f:
-            doc = json.load(f)
-        if doc.get("kind") != "pydcop_tpu-service-sessions":
+        of sessions restored.
+
+        The three broken-checkpoint shapes fail with STRUCTURED
+        errors (missing file, truncated/non-JSON content, schema
+        drift) — ``serve --resume`` surfaces them as a clean exit, so
+        a fleet health watcher sees the replica as dead instead of a
+        hung or silently-empty one."""
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            raise ServiceError(
+                f"session checkpoint {path} does not exist — the "
+                "previous run never drained there (or the path is "
+                "wrong); start without resume, or point at the real "
+                "checkpoint"
+            ) from None
+        except ValueError as e:
+            raise ServiceError(
+                f"session checkpoint {path} is not valid JSON "
+                f"(truncated or corrupted write?): {e}"
+            ) from None
+        if (
+            not isinstance(doc, dict)
+            or doc.get("kind") != "pydcop_tpu-service-sessions"
+        ):
             raise ServiceError(
                 f"{path} is not a service session checkpoint"
+            )
+        if doc.get("version") != 1:
+            raise ServiceError(
+                f"session checkpoint {path} has schema version "
+                f"{doc.get('version')!r}, this build reads version 1 "
+                "— re-drain under the current build (docs/serving.md)"
             )
         if doc.get("pad_policy") != _pad_policy_doc(self.pad_policy):
             raise ServiceError(
@@ -1177,8 +1253,6 @@ class SolverService:
                 "sessions would land in different shape buckets "
                 "(docs/serving.md)"
             )
-        from pydcop_tpu.engine.incremental import IncrementalCompiler
-
         restored = 0
         skipped: List[Tuple[str, str]] = []
         for entry in doc.get("sessions", ()):
@@ -1187,49 +1261,7 @@ class SolverService:
             # path, a since-invalid dcop) must not abort the whole
             # resume and lose every OTHER session
             try:
-                name = str(entry["name"])
-                kind, val = entry["source"]
-                if kind == "yaml":
-                    from pydcop_tpu.dcop.yamldcop import load_dcop
-
-                    dcop = load_dcop(val)
-                    key: Tuple = (
-                        "yaml",
-                        hashlib.sha256(
-                            val.encode("utf-8")
-                        ).hexdigest(),
-                    )
-                elif kind == "path":
-                    from pydcop_tpu.dcop.yamldcop import (
-                        load_dcop_from_file,
-                    )
-
-                    dcop = load_dcop_from_file(val)
-                    st = os.stat(os.path.realpath(val))
-                    key = (
-                        "path", os.path.realpath(val),
-                        st.st_mtime_ns, st.st_size,
-                    )
-                else:
-                    raise ServiceError(
-                        f"unknown source kind {kind!r}"
-                    )
-                compiler = IncrementalCompiler(
-                    dcop, pad_policy=self.pad_policy
-                )
-                sess = _Session(
-                    compiler, dcop, key, source=(kind, val)
-                )
-                ext: Dict[str, Any] = {}
-                compiler.compile({}, ext)  # segment 1 (the one full)
-                for delta in entry.get("deltas", ()):
-                    ext.update(delta)
-                    compiler.compile({}, ext)  # replayed incremental
-                sess.ext_values = ext
-                sess.deltas = [
-                    dict(d) for d in entry.get("deltas", ())
-                ]
-                sess.segments = int(entry.get("segments", 0))
+                name, sess = self._build_session_from_entry(entry)
             except Exception as e:  # noqa: BLE001 — skip, record
                 skipped.append(
                     (
@@ -1261,6 +1293,239 @@ class SolverService:
             )
         return restored
 
+    def _build_session_from_entry(
+        self, entry: Mapping[str, Any]
+    ) -> Tuple[str, _Session]:
+        """Rebuild one checkpoint/replication session entry through
+        the restore replay: load the dcop from its serialized
+        identity, pin a fresh IncrementalCompiler, pay the one
+        segment-1 ``compile.full``, and re-apply the recorded deltas
+        IN ORDER (``compile.incremental`` each) — bit-identical
+        device tables to the service that wrote the entry."""
+        from pydcop_tpu.engine.incremental import IncrementalCompiler
+
+        name = str(entry["name"])
+        kind, val = entry["source"]
+        if kind == "yaml":
+            from pydcop_tpu.dcop.yamldcop import load_dcop
+
+            dcop = load_dcop(val)
+            key: Tuple = (
+                "yaml",
+                hashlib.sha256(val.encode("utf-8")).hexdigest(),
+            )
+        elif kind == "path":
+            from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+
+            dcop = load_dcop_from_file(val)
+            st = os.stat(os.path.realpath(val))
+            key = (
+                "path", os.path.realpath(val),
+                st.st_mtime_ns, st.st_size,
+            )
+        else:
+            raise ServiceError(f"unknown source kind {kind!r}")
+        compiler = IncrementalCompiler(
+            dcop, pad_policy=self.pad_policy
+        )
+        sess = _Session(compiler, dcop, key, source=(kind, val))
+        ext: Dict[str, Any] = {}
+        compiler.compile({}, ext)  # segment 1 (the one full)
+        for delta in entry.get("deltas", ()):
+            ext.update(delta)
+            compiler.compile({}, ext)  # replayed incremental
+        sess.ext_values = ext
+        sess.deltas = [dict(d) for d in entry.get("deltas", ())]
+        sess.segments = int(entry.get("segments", 0))
+        return name, sess
+
+    # -- fleet replication (docs/serving.md, "The fleet") ----------------
+
+    def session_entry(self, name: str) -> Optional[Dict[str, Any]]:
+        """One session's replication entry — exactly the checkpoint
+        schema (serialized dcop identity + ordered delta log + segment
+        counter), so the standby applies it through the SAME restore
+        replay the checkpoint/resume contract already pins as
+        bit-identical.  None when the session does not exist or its
+        in-process dcop cannot serialize."""
+        with self._cond:
+            sess = self._sessions.get(name)
+        if sess is None:
+            return None
+        src = sess.source
+        if src is None:
+            try:
+                from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+                src = ("yaml", dcop_yaml(sess.dcop))
+            except Exception:  # noqa: BLE001 — same tolerance as
+                # the checkpoint writer's "skipped" list
+                return None
+        return {
+            "name": name,
+            "source": list(src),
+            "deltas": [dict(d) for d in sess.deltas],
+            "segments": sess.segments,
+        }
+
+    def set_standbys(self, addrs: Sequence[str]) -> int:
+        """Configure this replica's replication targets (its hash-ring
+        successors — the fleet controller computes them from
+        ``engine.fleet.standby_map``) and WARM them: every currently
+        live session re-streams immediately, so a standby attached
+        late (a rebalance, a restarted fleet member) holds a full
+        copy before the next failover could need it.  Returns the
+        number of sessions streamed."""
+        clean = [str(a) for a in addrs]
+        with self._repl_lock:
+            old = list(self._repl_clients.values())
+            self._repl_clients = {}
+            self._standby_addrs = clean
+        for cli in old:
+            cli.close()
+        with self._cond:
+            names = list(self._sessions)
+        for name in names:
+            self.replicate_session(name)
+        return len(names)
+
+    def replicate_session(
+        self,
+        name: str,
+        cache: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Stream one session's current replication entry to every
+        configured standby (the wire server calls this after each
+        delivered session reply, BEFORE the reply reaches the client
+        — so any reply a client observed is already recoverable).
+        ``cache`` piggybacks the delivered ``(ikey, reply)`` pair so
+        the standby pre-populates its reply cache: a failover retry
+        of an answered request replays there instead of re-solving.
+        A session that no longer exists streams a tombstone (the
+        standby drops its copy).  Best-effort per standby: a
+        replication failure is counted and traced, never raised into
+        the delivery path."""
+        with self._repl_lock:
+            if not self._standby_addrs:
+                return
+        entry = self.session_entry(name)
+        if entry is None:
+            entry = {"name": name, "closed": True}
+        with self._repl_lock:
+            addrs = list(self._standby_addrs)
+            for addr in addrs:
+                self._replicate_to_locked(addr, entry, cache)
+
+    def _replicate_to_locked(
+        self,
+        addr: str,
+        entry: Dict[str, Any],
+        cache: Optional[Dict[str, Any]],
+    ) -> None:
+        met = get_metrics()
+        try:
+            cli = self._repl_clients.get(addr)
+            if cli is None:
+                cli = ServiceClient(
+                    addr, timeout=5.0, retry_window=0.5
+                )
+                self._repl_clients[addr] = cli
+            cli._call("replicate", entry=entry, cache=cache)
+        except (ServiceError, OSError) as e:
+            # drop the client so the next entry reconnects fresh; the
+            # standby re-syncs from the full delta log it carries
+            stale = self._repl_clients.pop(addr, None)
+            if stale is not None:
+                stale.close()
+            with self._stats_lock:
+                self._n_replication_errors += 1
+            if met.enabled:
+                met.inc("service.replication_errors")
+            tr = get_tracer()
+            if tr.enabled:
+                tr.event(
+                    "service-replication-error", cat="service",
+                    standby=addr, session=entry.get("name"),
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
+            return
+        with self._stats_lock:
+            self._n_replicated_segments += 1
+        if met.enabled:
+            met.inc("service.replicated_segments")
+
+    def apply_replica_entry(
+        self, entry: Mapping[str, Any]
+    ) -> Dict[str, Any]:
+        """Apply one replicated session entry as a STANDBY (the
+        ``replicate`` wire op).  When the entry's delta log extends
+        the copy we already hold (same source, our applied deltas are
+        a prefix), only the tail replays — ``compile.incremental``
+        per new delta, zero fulls; anything else (first sight, a
+        diverged log, a re-pinned source) rebuilds through the
+        checkpoint-restore replay.  A ``closed`` tombstone drops the
+        copy."""
+        name = str(entry.get("name"))
+        if not name:
+            raise ServiceError("replicate entry has no session name")
+        if entry.get("closed"):
+            with self._repl_lock:
+                self._standby_sessions.pop(name, None)
+            return {"mode": "closed", "segments": 0}
+        deltas = [dict(d) for d in entry.get("deltas", ())]
+        with self._repl_lock:
+            sess = self._standby_sessions.get(name)
+            if (
+                sess is not None
+                and list(sess.source or ())
+                == list(entry.get("source", ()))
+                and sess.deltas == deltas[: len(sess.deltas)]
+                and int(entry.get("segments", 0)) >= sess.segments
+            ):
+                mode = "incremental"
+                for delta in deltas[len(sess.deltas):]:
+                    sess.ext_values.update(delta)
+                    sess.compiler.compile({}, sess.ext_values)
+                sess.deltas = deltas
+                sess.segments = int(
+                    entry.get("segments", sess.segments)
+                )
+            else:
+                mode = "rebuild"
+                name, sess = self._build_session_from_entry(entry)
+                self._standby_sessions[name] = sess
+        with self._stats_lock:
+            self._n_replica_updates += 1
+        met = get_metrics()
+        if met.enabled:
+            met.inc("service.replica_updates")
+        return {"mode": mode, "segments": sess.segments}
+
+    def _promote_standby(self, name: str) -> Optional["_Session"]:
+        """Move a replicated standby copy into the LIVE session table
+        — the failed-over session's first frame lands here, and its
+        follow-up must cost ``compile.incremental`` exactly as it
+        would have on the dead owner."""
+        with self._repl_lock:
+            sess = self._standby_sessions.pop(name, None)
+        if sess is None:
+            return None
+        with self._cond:
+            live = self._sessions.setdefault(name, sess)
+        if live is sess:
+            with self._stats_lock:
+                self._n_sessions_promoted += 1
+            met = get_metrics()
+            if met.enabled:
+                met.inc("service.sessions_promoted")
+            tr = get_tracer()
+            if tr.enabled:
+                tr.event(
+                    "service-promote", cat="service", session=name,
+                    segments=sess.segments,
+                )
+        return live
+
     # -- stats -----------------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:
@@ -1283,6 +1548,11 @@ class SolverService:
                 "frames_rejected": self._n_frames_rejected,
                 "sessions_restored": self._n_sessions_restored,
                 "replayed_replies": self._n_replayed_replies,
+                "replica_updates": self._n_replica_updates,
+                "replicated_segments": self._n_replicated_segments,
+                "replication_errors": self._n_replication_errors,
+                "sessions_promoted": self._n_sessions_promoted,
+                "standby_sessions": len(self._standby_sessions),
                 "sessions": len(self._sessions),
                 "queue_depth": len(self._queue),
                 "drained": self._drained,
@@ -2160,6 +2430,17 @@ class ServiceServer:
         # duplicate solve — "never re-solved" covers the in-flight
         # window, not just completed replies
         self._inflight_ikeys: Dict[str, PendingResult] = {}
+        # serializes SESSION-frame admission against the `replicate`
+        # op: a primary's delta-log stream and the router's failover
+        # re-forward arrive on two independent connections, so without
+        # a common lock the re-forward's reply-cache check can run
+        # BEFORE the replicated {entry + piggybacked reply} applies
+        # while its submit runs AFTER — promoting the fresh standby
+        # copy and re-executing an already-answered segment.  Held
+        # around {apply entry + cache insert} on one side and
+        # {cache re-check + submit} on the other; stateless frames
+        # never take it.
+        self._replica_admission = threading.Lock()
         self._accept = threading.Thread(
             target=self._accept_loop, name="solver-service-accept",
             daemon=True,
@@ -2561,92 +2842,11 @@ class ServiceServer:
         if pending is not None:
             self._note_replay(msg)
         else:
-            placeholder: Optional[PendingResult] = None
-            if ikey is not None:
-                # register a placeholder BEFORE submit: admission
-                # itself can be slow (parsing a shipped yaml), and a
-                # retry landing during it must attach here instead of
-                # double-submitting.  The re-check closes the race
-                # with another handler doing the same.
-                placeholder = PendingResult()
-                with self._lock:
-                    existing = self._inflight_ikeys.get(ikey)
-                    if existing is not None:
-                        pending = existing
-                        placeholder = None
-                    else:
-                        self._inflight_ikeys[ikey] = placeholder
-            if pending is not None:
-                self._note_replay(msg)
-            else:
-                try:
-                    if msg.get("op") == "infer":
-                        kwargs = {
-                            k: msg[k]
-                            for k in _INFER_FIELDS
-                            if msg.get(k) is not None
-                        }
-                        real = self.service.submit_infer(
-                            msg.get("dcop"),
-                            msg.get("query", "marginals"),
-                            trace=msg.get("trace"),
-                            **kwargs,
-                        )
-                    else:
-                        kwargs = {
-                            k: msg[k]
-                            for k in _SOLVE_FIELDS
-                            if msg.get(k) is not None
-                        }
-                        real = self.service.submit(
-                            msg.get("dcop"),
-                            msg.get("algo"),
-                            msg.get("params") or None,
-                            trace=msg.get("trace"),
-                            **kwargs,
-                        )
-                except Exception as e:  # noqa: BLE001 — per-request
-                    if placeholder is not None:
-                        # resolve attached retries with the SAME
-                        # validation error, then unregister (errors
-                        # are cheap to recompute, so no cache entry)
-                        placeholder._set_error(
-                            ServiceError(
-                                f"{type(e).__name__}: {e}"
-                            )
-                        )
-                        with self._lock:
-                            if (
-                                self._inflight_ikeys.get(ikey)
-                                is placeholder
-                            ):
-                                del self._inflight_ikeys[ikey]
-                    with st.lock:
-                        st.inflight -= 1
-                    self._reply(
-                        st,
-                        {
-                            "id": rid,
-                            "ok": False,
-                            "error": f"{type(e).__name__}: {e}",
-                        },
-                    )
-                    return
-                if placeholder is not None:
-                    # the placeholder IS the canonical in-flight
-                    # handle: mirror the real result into it
-                    ph = placeholder
-
-                    def _mirror(p: PendingResult) -> None:
-                        if p._error is not None:
-                            ph._set_error(p._error)
-                        else:
-                            ph._set_result(p._result)
-
-                    real.add_done_callback(_mirror)
-                    pending = ph
-                else:
-                    pending = real
+            pending = self._admit_and_submit(st, msg, rid, ikey)
+            if pending is None:
+                # already replied (a replicated-reply replay or a
+                # validation error)
+                return
 
         def deliver(p: PendingResult) -> None:
             with st.lock:
@@ -2685,9 +2885,157 @@ class ServiceServer:
                     # in between sees the cached reply
                     if self._inflight_ikeys.get(ikey) is p:
                         del self._inflight_ikeys[ikey]
+            session = msg.get("session")
+            if (
+                session is not None
+                and reply.get("ok")
+                and (reply.get("result") or {}).get("status")
+                != "shed"
+            ):
+                # replicate BEFORE the reply leaves: once the client
+                # can observe this answer, the session state behind
+                # it — and the cached reply a failover retry will ask
+                # for — already lives on the standby chain
+                self.service.replicate_session(
+                    str(session),
+                    cache=(
+                        {"ikey": ikey, "reply": reply}
+                        if ikey is not None
+                        else None
+                    ),
+                )
             self._reply(st, {**reply, "id": rid})
 
         pending.add_done_callback(deliver)
+
+    def _admit_and_submit(
+        self,
+        st: _ConnState,
+        msg: Dict[str, Any],
+        rid: Any,
+        ikey: Optional[str],
+    ) -> Optional[PendingResult]:
+        """Admission past the caches: register the in-flight
+        placeholder and submit.  Returns the PendingResult to deliver
+        from, or None when this frame was already replied to here.
+
+        Session frames run under ``_replica_admission``, serialized
+        against the ``replicate`` op: a primary's final delta-log
+        frame and the router's failover re-forward of the request
+        that produced it arrive on two independent connections, so
+        the piggybacked reply can land between `_handle_solve`'s
+        first cache check and the submit — the re-check under the
+        SHARED lock either replays it or commits to executing first
+        (in which case the late entry parks as an inert standby copy
+        and the identical piggybacked reply overwrites nothing)."""
+        admission = (
+            self._replica_admission
+            if msg.get("session") is not None
+            else None
+        )
+        if admission is not None:
+            admission.acquire()
+        try:
+            if admission is not None and ikey is not None:
+                with self._lock:
+                    cached = self._replies.get(ikey)
+                    if cached is not None:
+                        self._replies.move_to_end(ikey)
+                if cached is not None:
+                    with st.lock:
+                        st.inflight -= 1
+                    self._note_replay(msg)
+                    self._reply(st, {**cached, "id": rid})
+                    return None
+            placeholder: Optional[PendingResult] = None
+            pending: Optional[PendingResult] = None
+            if ikey is not None:
+                # register a placeholder BEFORE submit: admission
+                # itself can be slow (parsing a shipped yaml), and a
+                # retry landing during it must attach here instead of
+                # double-submitting.  The re-check closes the race
+                # with another handler doing the same.
+                placeholder = PendingResult()
+                with self._lock:
+                    existing = self._inflight_ikeys.get(ikey)
+                    if existing is not None:
+                        pending = existing
+                        placeholder = None
+                    else:
+                        self._inflight_ikeys[ikey] = placeholder
+            if pending is not None:
+                self._note_replay(msg)
+                return pending
+            try:
+                if msg.get("op") == "infer":
+                    kwargs = {
+                        k: msg[k]
+                        for k in _INFER_FIELDS
+                        if msg.get(k) is not None
+                    }
+                    real = self.service.submit_infer(
+                        msg.get("dcop"),
+                        msg.get("query", "marginals"),
+                        trace=msg.get("trace"),
+                        **kwargs,
+                    )
+                else:
+                    kwargs = {
+                        k: msg[k]
+                        for k in _SOLVE_FIELDS
+                        if msg.get(k) is not None
+                    }
+                    real = self.service.submit(
+                        msg.get("dcop"),
+                        msg.get("algo"),
+                        msg.get("params") or None,
+                        trace=msg.get("trace"),
+                        **kwargs,
+                    )
+            except Exception as e:  # noqa: BLE001 — per-request
+                if placeholder is not None:
+                    # resolve attached retries with the SAME
+                    # validation error, then unregister (errors
+                    # are cheap to recompute, so no cache entry)
+                    placeholder._set_error(
+                        ServiceError(
+                            f"{type(e).__name__}: {e}"
+                        )
+                    )
+                    with self._lock:
+                        if (
+                            self._inflight_ikeys.get(ikey)
+                            is placeholder
+                        ):
+                            del self._inflight_ikeys[ikey]
+                with st.lock:
+                    st.inflight -= 1
+                self._reply(
+                    st,
+                    {
+                        "id": rid,
+                        "ok": False,
+                        "error": f"{type(e).__name__}: {e}",
+                    },
+                )
+                return None
+            if placeholder is not None:
+                # the placeholder IS the canonical in-flight
+                # handle: mirror the real result into it
+                ph = placeholder
+
+                def _mirror(p: PendingResult) -> None:
+                    if p._error is not None:
+                        ph._set_error(p._error)
+                    else:
+                        ph._set_result(p._result)
+
+                real.add_done_callback(_mirror)
+                return ph
+            return real
+        finally:
+            if admission is not None:
+                admission.release()
 
     def _serve_op(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         op = msg.get("op")
@@ -2696,11 +3044,50 @@ class ServiceServer:
         if op == "stats":
             return {"ok": True, "stats": self.service.stats()}
         if op == "close_session":
+            name = msg.get("session", "")
+            closed = self.service.close_session(name)
+            if closed:
+                # stream the tombstone so the standby chain drops its
+                # copy — a closed session must not fail over
+                self.service.replicate_session(str(name))
+            return {"ok": True, "closed": closed}
+        if op == "replicate":
+            entry = msg.get("entry")
+            if not isinstance(entry, dict):
+                raise ServiceError(
+                    "replicate needs entry={session entry} "
+                    "(docs/serving.md, 'The fleet')"
+                )
+            with self._replica_admission:
+                # entry + piggybacked reply become visible atomically
+                # w.r.t. session admission (_admit_and_submit): a
+                # failover re-forward racing this frame either sees
+                # both (replays) or neither (executes first)
+                info = self.service.apply_replica_entry(entry)
+                cache = msg.get("cache")
+                if isinstance(cache, dict) and cache.get("ikey"):
+                    # the primary's delivered reply rides along: cache
+                    # it HERE so a failover retry of an answered
+                    # request replays instead of re-solving
+                    # (exactly-once)
+                    replayed = dict(cache.get("reply") or {})
+                    replayed.pop("id", None)
+                    self._cache_reply(str(cache["ikey"]), replayed)
+            return {"ok": True, "replicated": True, **info}
+        if op == "standby":
+            addrs = msg.get("standbys")
+            if not isinstance(addrs, list) or not all(
+                isinstance(a, str) for a in addrs
+            ):
+                raise ServiceError(
+                    "standby needs standbys=[\"host:port\", ...] "
+                    "(docs/serving.md, 'The fleet')"
+                )
+            streamed = self.service.set_standbys(addrs)
             return {
                 "ok": True,
-                "closed": self.service.close_session(
-                    msg.get("session", "")
-                ),
+                "standbys": list(addrs),
+                "streamed": streamed,
             }
         if op == "shutdown":
             return {"ok": True, "stopping": True}
@@ -2972,6 +3359,67 @@ class ServiceClient:
                 reply.get("error", "service request failed")
             )
         return reply
+
+    def forward(
+        self, frame: Mapping[str, Any]
+    ) -> Dict[str, Any]:
+        """Forward a received wire frame downstream — the fleet
+        router's primitive (``engine/fleet.py``).  Only the
+        wire-local ``id`` is rewritten; the ORIGINAL client's ``cid``,
+        idempotency key and trace context ride through untouched, so
+        the downstream reply cache dedupes on the END CLIENT's key (a
+        failover re-forward of an answered request replays instead of
+        re-solving) and the trace stitches across the hop.  Runs the
+        same keyed-backoff retry loop as :meth:`_call`; a structured
+        ``ok: false`` reply is RETURNED (the router relays it
+        verbatim), only transport failure raises
+        :class:`ServiceTransportError`."""
+        with self._lock:
+            self._next_id += 1
+            fwd = dict(frame)
+            fwd["id"] = self._next_id
+
+            def _one_attempt() -> Dict[str, Any]:
+                return self._attempt(fwd)
+
+            if self.retry_window <= 0:
+                try:
+                    reply = _one_attempt()
+                except (OSError, ValueError) as e:
+                    raise ServiceTransportError(
+                        f"forward failed: {type(e).__name__}: {e}"
+                    ) from e
+            else:
+                from pydcop_tpu.utils.backoff import (
+                    call_with_backoff,
+                )
+
+                met = get_metrics()
+
+                def _note_retry(attempt: int, error: BaseException):
+                    if met.enabled:
+                        met.inc("service.client_retries")
+
+                try:
+                    reply = call_with_backoff(
+                        _one_attempt,
+                        retry_for=self.retry_window,
+                        exceptions=(OSError, ValueError),
+                        base=0.05,
+                        max_delay=1.0,
+                        key=f"service-client/{self.client_id}",
+                        seed=self._backoff_seed,
+                        on_retry=_note_retry,
+                        giving_up=lambda: self._closed,
+                    )
+                except (OSError, ValueError) as e:
+                    raise ServiceTransportError(
+                        f"forward failed after {self.retry_window}s "
+                        f"of retries: {type(e).__name__}: {e}"
+                    ) from e
+        out = dict(reply)
+        out.pop("id", None)
+        return out
 
     def solve(
         self,

@@ -38,6 +38,7 @@ from pydcop_tpu.faults.plan import (
     DeviceFaults,
     FaultPlan,
     FaultSpecError,
+    FleetFaults,
     LinkFaults,
     Partition,
     WireFaults,
@@ -48,6 +49,7 @@ __all__ = [
     "DeviceFaults",
     "FaultPlan",
     "FaultSpecError",
+    "FleetFaults",
     "LinkFaults",
     "Partition",
     "WireFaults",

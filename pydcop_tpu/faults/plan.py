@@ -80,6 +80,20 @@ service's frame loop, ``engine/service.py`` ``ServiceServer`` — same
   probability ``P``, same hashing/exemption contract as ``conn_drop``;
   the client's frame validation rejects it and takes the reconnect
   path.
+
+Fleet-level fault kinds (the replicated serving fleet;
+``pydcop_tpu fleet --chaos`` — ``engine/fleet.py`` /
+``commands/fleet.py``):
+
+- ``replica_kill=T`` / ``replica_kill=T:IDX`` — SIGKILL one serving
+  replica ``T`` seconds into the fleet's run.  With ``:IDX`` the
+  victim is replica index ``IDX``; without it the victim is a pure
+  hash of the seed (:meth:`FaultPlan.decide_replica_kill`), so a
+  re-run with the same seed kills the same replica at the same time
+  and the failover soak replays bit-for-bit.  The process-level
+  analogue of ``crash=AGENT@T`` for the fleet: the router re-pins the
+  dead replica's ring arc to its standby, which already holds the
+  replicated session state.
 """
 
 from __future__ import annotations
@@ -193,6 +207,23 @@ class WireFaults:
         )
 
 
+@dataclass(frozen=True)
+class FleetFaults:
+    """Fleet-level fault injection parameters (all default off).
+
+    ``replica_kill`` schedules a SIGKILL of one serving replica that
+    many seconds into the fleet's run; ``replica_kill_instance`` pins
+    the victim index (a kind MODIFIER — without it the victim is a
+    pure hash of the seed, :meth:`FaultPlan.decide_replica_kill`)."""
+
+    replica_kill: Optional[float] = None
+    replica_kill_instance: Optional[int] = None
+
+    @property
+    def configured(self) -> bool:
+        return self.replica_kill is not None
+
+
 class Decision(NamedTuple):
     """The fate of one message (at most one fault fires per message —
     drop wins over dup over reorder over delay)."""
@@ -229,6 +260,7 @@ class FaultPlan:
     crashes: Dict[str, float] = field(default_factory=dict)
     device: DeviceFaults = field(default_factory=DeviceFaults)
     wire: WireFaults = field(default_factory=WireFaults)
+    fleet: FleetFaults = field(default_factory=FleetFaults)
     spec: Optional[str] = None  # the source text, for run metadata
 
     # -- construction ---------------------------------------------------
@@ -241,6 +273,7 @@ class FaultPlan:
         defaults: Dict[str, float] = {}
         device_fields: Dict[str, object] = {}
         wire_fields: Dict[str, object] = {}
+        fleet_fields: Dict[str, object] = {}
         for raw in spec.split(","):
             clause = raw.strip()
             if not clause:
@@ -273,6 +306,12 @@ class FaultPlan:
                     _parse_wire_value(key, val, clause)
                 )
                 continue
+            if clause.startswith("replica_kill="):
+                key, val = clause.split("=", 1)
+                fleet_fields.update(
+                    _parse_fleet_value(key, val, clause)
+                )
+                continue
             m = _CLAUSE.match(clause)
             if not m:
                 raise FaultSpecError(
@@ -294,6 +333,8 @@ class FaultPlan:
             plan.device = DeviceFaults(**device_fields)
         if wire_fields:
             plan.wire = WireFaults(**wire_fields)
+        if fleet_fields:
+            plan.fleet = FleetFaults(**fleet_fields)
         plan.validate()
         return plan
 
@@ -359,6 +400,20 @@ class FaultPlan:
                 f"chaos spec: slow_client={w.slow_client}s must be "
                 ">= 0"
             )
+        fl = self.fleet
+        if fl.replica_kill is not None and fl.replica_kill < 0:
+            raise FaultSpecError(
+                f"chaos spec: replica_kill={fl.replica_kill} in the "
+                "past"
+            )
+        if (
+            fl.replica_kill_instance is not None
+            and fl.replica_kill_instance < 0
+        ):
+            raise FaultSpecError(
+                "chaos spec: replica_kill instance="
+                f"{fl.replica_kill_instance} must be >= 0"
+            )
 
     def referenced_agents(self) -> set:
         """Every agent name the plan targets (crash schedules,
@@ -401,6 +456,14 @@ class FaultPlan:
         inject at the solver service's frame loop
         (``engine/service.py``), nowhere else."""
         return self.wire.configured
+
+    @property
+    def fleet_faults_configured(self) -> bool:
+        """True when any fleet-level fault kind (``replica_kill``) is
+        configured — these inject at the replicated serving fleet's
+        process level (``commands/fleet.py``), nowhere else: a single
+        service, solve, or host runtime has no replica to kill."""
+        return self.fleet.configured
 
     # -- queries (all pure) ---------------------------------------------
 
@@ -530,6 +593,41 @@ class FaultPlan:
             _u(self.seed, scope, seq, "frame_corrupt") < w.frame_corrupt
         )
 
+    # -- fleet-level queries (all pure, commands/fleet.py seam) ----------
+
+    def decide_replica_kill(
+        self, n_replicas: int
+    ) -> Optional[Tuple[float, int]]:
+        """The fleet's scripted kill, if any: ``(T, victim index)``.
+        The victim is the pinned ``:IDX`` when given (rejected when
+        out of range), else a pure hash of the seed over the replica
+        count — two fleets with the same seed, spec, and size kill
+        the same replica at the same time, which is what lets the
+        failover soak replay bit-for-bit."""
+        fl = self.fleet
+        if fl.replica_kill is None:
+            return None
+        if n_replicas < 1:
+            raise FaultSpecError(
+                "chaos spec: replica_kill needs at least one replica"
+            )
+        if fl.replica_kill_instance is not None:
+            if fl.replica_kill_instance >= n_replicas:
+                raise FaultSpecError(
+                    "chaos spec: replica_kill instance="
+                    f"{fl.replica_kill_instance} out of range for "
+                    f"{n_replicas} replica(s)"
+                )
+            return fl.replica_kill, fl.replica_kill_instance
+        victim = min(
+            int(
+                _u(self.seed, "fleet", 1, "replica_kill")
+                * n_replicas
+            ),
+            n_replicas - 1,
+        )
+        return fl.replica_kill, victim
+
     def to_meta(self) -> Dict[str, object]:
         """The replay record for run metadata: spec + seed reconstruct
         the plan exactly (``FaultPlan.from_spec(spec, seed)``)."""
@@ -618,6 +716,24 @@ def _parse_wire_value(
             f"chaos spec: bad number in clause {clause!r} (expected "
             "conn_drop=P[:AFTER], slow_client=W or "
             "frame_corrupt=P[:AFTER])"
+        ) from None
+
+
+def _parse_fleet_value(
+    key: str, val: str, clause: str
+) -> Dict[str, object]:
+    """Parse one fleet-level clause into :class:`FleetFaults` fields
+    (``replica_kill=T[:IDX]`` — module docstring)."""
+    head, _, tail = val.partition(":")
+    try:
+        out: Dict[str, object] = {key: float(head)}
+        if tail:
+            out[f"{key}_instance"] = int(tail)
+        return out
+    except ValueError:
+        raise FaultSpecError(
+            f"chaos spec: bad number in clause {clause!r} (expected "
+            "replica_kill=T[:IDX])"
         ) from None
 
 

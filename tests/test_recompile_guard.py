@@ -139,6 +139,27 @@ def test_restore_guard_zero_recompiles_after_resume():
     assert report["followup_jit_compiles"] == 0, report
 
 
+@pytest.mark.service
+def test_fleet_guard_failover_zero_xla_compiles():
+    """The fleet failover acceptance criterion: a standby taking over
+    a replicated session replays it at the cost of exactly ONE
+    compile.full (segment 1 of the replay) plus the delta tail, with
+    ZERO XLA compiles on the warm runner cache, and the failed-over
+    follow-up is compile.incremental-only — zero fulls, zero XLA
+    compiles — bit-identical to an undisturbed service that never
+    failed over.  See tools/recompile_guard.py:run_fleet_guard."""
+    guard = _load_guard()
+    report = guard.run_fleet_guard()
+    assert report["ok"], report
+    assert report["primary_jit_compiles"] >= 1, report  # non-vacuous
+    assert report["takeover_fulls"] == 1, report
+    assert report["takeover_jit_compiles"] == 0, report
+    assert report["followup_fulls"] == 0, report
+    assert report["followup_incrementals"] >= 1, report
+    assert report["followup_jit_compiles"] == 0, report
+    assert report["sessions_promoted"] == 1, report
+
+
 @pytest.mark.semiring
 def test_semiring_guard_swap_reuses_buckets():
     """Swapping the semiring on the same problem bucket reuses the

@@ -125,6 +125,7 @@ def default_config(root: str) -> LintConfig:
             "pydcop_tpu/engine/host_batch.py",
             "pydcop_tpu/engine/supervisor.py",
             "pydcop_tpu/engine/service.py",
+            "pydcop_tpu/engine/fleet.py",
             "pydcop_tpu/faults/*.py",
             "pydcop_tpu/utils/*.py",
             "pydcop_tpu/ops/__init__.py",
@@ -160,6 +161,18 @@ def default_config(root: str) -> LintConfig:
                 "ServiceServer._cache_reply",
                 "ServiceClient.__init__",
             ),
+            # fleet ring placement + failover: the ring walk decides
+            # session ownership, standby chains and failover targets —
+            # replay of the same admission order must re-pin
+            # identically (and decide_replica_kill's victim is the
+            # seeded-purity contract for replica_kill chaos)
+            "pydcop_tpu/engine/fleet.py": (
+                "HashRing.lookup",
+                "HashRing.successors",
+                "HashRing.next_alive",
+                "FleetRouter._pick_owner",
+                "ring_key",
+            ),
         },
         chaos_plan_module="pydcop_tpu/faults/plan.py",
         chaos_kind_categories={
@@ -181,6 +194,8 @@ def default_config(root: str) -> LintConfig:
             "conn_drop": "wire",
             "slow_client": "wire",
             "frame_corrupt": "wire",
+            # fleet level (commands/fleet.py replica processes)
+            "replica_kill": "fleet",
         },
         chaos_entry_points={
             # api.solve / api.solve_many accept-or-reject every
@@ -190,6 +205,7 @@ def default_config(root: str) -> LintConfig:
                 "schedule": ("crashes",),
                 "device": ("device_faults_configured",),
                 "wire": ("wire_faults_configured",),
+                "fleet": ("fleet_faults_configured",),
             },
             # run: scripted scenarios — accepts crashes + device kinds,
             # rejects the rest explicitly
@@ -198,6 +214,7 @@ def default_config(root: str) -> LintConfig:
                 "schedule": ("crashes",),
                 "device": ("device_faults_configured",),
                 "wire": ("wire_faults_configured",),
+                "fleet": ("fleet_faults_configured",),
             },
             # serve: validation lives in SolverService (commands/serve
             # is a thin forwarder); device kinds are ACCEPTED by
@@ -207,9 +224,10 @@ def default_config(root: str) -> LintConfig:
                 "schedule": ("crashes",),
                 "device": ("device_faults_configured", "make_supervisor"),
                 "wire": ("wire_faults_configured",),
+                "fleet": ("fleet_faults_configured",),
             },
             # agent: message/crash kinds flow into the per-agent host
-            # runtime (run_host_agent); device/wire must be rejected
+            # runtime (run_host_agent); device/wire/fleet rejected
             "pydcop_tpu/commands/agent.py": {
                 "message": (
                     "message_faults_configured",
@@ -218,9 +236,10 @@ def default_config(root: str) -> LintConfig:
                 "schedule": ("crashes", "run_host_agent"),
                 "device": ("device_faults_configured",),
                 "wire": ("wire_faults_configured",),
+                "fleet": ("fleet_faults_configured",),
             },
             # orchestrator: message/crash kinds flow into the hostnet
-            # runtime; device/wire must be rejected
+            # runtime; device/wire/fleet must be rejected
             "pydcop_tpu/commands/orchestrator.py": {
                 "message": (
                     "message_faults_configured",
@@ -229,6 +248,20 @@ def default_config(root: str) -> LintConfig:
                 "schedule": ("crashes", "run_host_orchestrator"),
                 "device": ("device_faults_configured",),
                 "wire": ("wire_faults_configured",),
+                "fleet": ("fleet_faults_configured",),
+            },
+            # fleet: the one entry point that ACCEPTS the fleet
+            # category (decide_replica_kill schedules the SIGKILL);
+            # every other category is rejected toward its own layer
+            "pydcop_tpu/commands/fleet.py": {
+                "message": ("message_faults_configured",),
+                "schedule": ("crashes",),
+                "device": ("device_faults_configured",),
+                "wire": ("wire_faults_configured",),
+                "fleet": (
+                    "fleet_faults_configured",
+                    "decide_replica_kill",
+                ),
             },
         },
         metrics_code=("pydcop_tpu/*",),

@@ -45,6 +45,7 @@ CATEGORY_PREDICATES = {
     "schedule": "crashes",
     "device": "device_faults_configured",
     "wire": "wire_faults_configured",
+    "fleet": "fleet_faults_configured",
 }
 
 _CLAUSE_KEY_RE = re.compile(r"\(\?P<key>([A-Za-z_|]+)\)")

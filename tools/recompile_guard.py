@@ -25,9 +25,12 @@ or via the tier-1 suite: ``tests/test_recompile_guard.py`` imports
 transient retries, bounded-compile OOM group splits),
 :func:`run_semiring_guard` (semiring swaps reuse the level-pack
 bucketing: one executable per semiring per bucket, zero on repeat)
-and :func:`run_restore_guard` (drain -> restart -> session follow-up:
+:func:`run_restore_guard` (drain -> restart -> session follow-up:
 zero full recompiles, zero XLA compiles, bit-identical to an
-undisturbed service) directly.
+undisturbed service) and :func:`run_fleet_guard` (primary -> standby
+failover replay: zero XLA compiles on the warm cache,
+``compile.incremental``-only follow-up, bit-identical to an
+unkilled control) directly.
 
 ``BUDGET`` is the recorded compile count of the canned scenario: one
 chunk-runner compile in segment 1, zero afterwards.  Raise it only
@@ -99,6 +102,22 @@ SERVICE_ROUNDS = 48
 # rebuild-per-segment; extra XLA compiles = the restored problem
 # landed outside its original shape bucket.
 RESTORE_ROUNDS = 48
+
+# fleet failover (engine/fleet.py + the service replication hooks in
+# engine/service.py): a primary that streamed its session delta log
+# to a ring standby dies after two segments; the standby's takeover
+# replay (``apply_replica_entry`` rebuild: exactly ONE compile.full —
+# segment 1 of the replay — plus the delta tail as incrementals) and
+# the failed-over follow-up must both perform ZERO XLA compiles — the
+# standby rides the warm runner cache the primary already paid for
+# (in-process here; the persistent XLA cache across fleet processes)
+# — and the follow-up must be compile.incremental-only and
+# bit-identical (cost, assignment, cost trace) to the same three
+# segments on an undisturbed service that never failed over.  Extra
+# fulls = the replicated delta log regressed to rebuild-per-segment;
+# extra XLA compiles = the replicated session landed outside its
+# original shape bucket, turning every failover into a compile storm.
+FLEET_ROUNDS = 48
 
 # level-batched DPOP through solve_many: K same-bucket SECP instances
 # merge their UTIL phases into one level-synchronous sweep, and each
@@ -721,6 +740,171 @@ def run_restore_guard() -> dict:
     return report
 
 
+_FLEET_YAML = """name: fleet-guard
+objective: min
+domains:
+  colors: {values: [0, 1, 2]}
+variables:
+  w0: {domain: colors}
+  w1: {domain: colors}
+  w2: {domain: colors}
+  w3: {domain: colors}
+  w4: {domain: colors}
+  w5: {domain: colors}
+external_variables:
+  sensor: {domain: colors, initial_value: 0}
+constraints:
+  c0: {type: intention, function: '1 if w0 == w1 else 0'}
+  c1: {type: intention, function: '1 if w1 == w2 else 0'}
+  c2: {type: intention, function: '1 if w2 == w3 else 0'}
+  c3: {type: intention, function: '1 if w3 == w4 else 0'}
+  c4: {type: intention, function: '1 if w4 == w5 else 0'}
+  track: {type: intention, function: '0 if w0 == sensor else 1'}
+agents: [a1]
+"""
+
+
+def run_fleet_guard() -> dict:
+    """Compile + parity budget for the fleet failover path
+    (module-constant comment at :data:`FLEET_ROUNDS`): primary runs
+    two segments and replicates, the standby takes over via
+    ``apply_replica_entry`` (one ``compile.full`` + delta-tail
+    incrementals, ZERO XLA compiles on the warm runner cache), and
+    the failed-over follow-up is ``compile.incremental``-only, zero
+    XLA compiles, bit-identical to an undisturbed three-segment
+    reference."""
+    from pydcop_tpu.engine import batched
+    from pydcop_tpu.engine.service import SolverService
+    from pydcop_tpu.telemetry import session
+
+    # cold start: the zero-XLA-compile claim below is "the standby
+    # rides the cache the PRIMARY warmed", so nothing else may have
+    # pre-warmed this shape
+    batched._RUNNER_CACHE.clear()
+
+    kw = dict(rounds=FLEET_ROUNDS, chunk_size=FLEET_ROUNDS, seed=13)
+
+    def seg(svc, sv=None):
+        first = (
+            "s" not in svc._sessions
+            and "s" not in svc._standby_sessions
+        )
+        return svc.solve(
+            _FLEET_YAML if first else None, "dsa", {"variant": "B"},
+            session="s", set_values=sv, **kw,
+        )
+
+    with session() as tel:
+        primary = SolverService(
+            max_batch=1, max_wait=0.0, autostart=False
+        )
+        primary.start()
+        seg(primary)
+        seg(primary, {"sensor": 2})
+        # the replication payload the primary streams to its ring
+        # standby after every segment (engine/service.py)
+        entry = primary.session_entry("s")
+        c_primary = dict(tel.summary()["counters"])
+
+        standby = SolverService(
+            max_batch=1, max_wait=0.0, autostart=False
+        )
+        standby.start()
+        info = standby.apply_replica_entry(entry)
+        c_takeover = dict(tel.summary()["counters"])
+
+        # the primary dies; the follow-up lands on the standby and
+        # promotes its replica copy into a live session
+        primary.close()
+        got = seg(standby, {"sensor": 1})
+        c_after = dict(tel.summary()["counters"])
+        promoted = standby.stats()["sessions_promoted"]
+        standby.close()
+
+    def diff(a, b, key):
+        return int(b.get(key, 0)) - int(a.get(key, 0))
+
+    primary_jit = int(c_primary.get("jit.compiles", 0))
+    takeover_fulls = diff(c_primary, c_takeover, "compile.full")
+    takeover_incr = diff(c_primary, c_takeover, "compile.incremental")
+    takeover_jit = diff(c_primary, c_takeover, "jit.compiles")
+    followup_fulls = diff(c_takeover, c_after, "compile.full")
+    followup_incr = diff(c_takeover, c_after, "compile.incremental")
+    followup_jit = diff(c_takeover, c_after, "jit.compiles")
+
+    # the undisturbed reference: the same three segments in one
+    # service life that never replicated or failed over
+    with SolverService(
+        max_batch=1, max_wait=0.0, autostart=False
+    ) as ref_svc:
+        seg(ref_svc)
+        seg(ref_svc, {"sensor": 2})
+        ref = seg(ref_svc, {"sensor": 1})
+
+    report = {
+        "apply_mode": info.get("mode"),
+        "primary_jit_compiles": primary_jit,
+        "takeover_fulls": takeover_fulls,
+        "takeover_incrementals": takeover_incr,
+        "takeover_jit_compiles": takeover_jit,
+        "followup_fulls": followup_fulls,
+        "followup_incrementals": followup_incr,
+        "followup_jit_compiles": followup_jit,
+        "sessions_promoted": promoted,
+        "cost": got.get("cost"),
+        "ok": True,
+    }
+    if primary_jit < 1:
+        report["ok"] = False
+        report["error"] = (
+            "the primary never compiled — the warm-cache claim "
+            "below is vacuous"
+        )
+    elif takeover_fulls != 1 or takeover_incr < 1:
+        report["ok"] = False
+        report["error"] = (
+            f"standby takeover paid {takeover_fulls} full "
+            f"compile(s) / {takeover_incr} incremental(s); expected "
+            "exactly 1 full (segment 1 of the replay) plus the delta "
+            "tail — the replicated log regressed to "
+            "rebuild-per-segment"
+        )
+    elif takeover_jit != 0 or followup_jit != 0:
+        report["ok"] = False
+        report["error"] = (
+            f"failover performed {takeover_jit} + {followup_jit} XLA "
+            "compile(s); the standby must ride the warm runner cache "
+            "— the replicated session landed outside its original "
+            "shape bucket"
+        )
+    elif followup_fulls != 0 or followup_incr < 1:
+        report["ok"] = False
+        report["error"] = (
+            f"the failed-over follow-up cost {followup_fulls} full "
+            f"compile(s) / {followup_incr} incremental(s); expected "
+            "0 fulls and >= 1 incremental — replicated session "
+            "state did not survive the takeover"
+        )
+    elif promoted != 1:
+        report["ok"] = False
+        report["error"] = (
+            f"standby promoted {promoted} session(s), expected 1 — "
+            "the failed-over frame did not find the replica copy"
+        )
+    else:
+        for k in ("cost", "assignment", "cost_trace"):
+            if got.get(k) != ref.get(k):
+                report["ok"] = False
+                report["error"] = (
+                    f"failed-over follow-up {k} diverges from the "
+                    "undisturbed service — takeover replay must "
+                    "reproduce the incremental-update arithmetic "
+                    "bit-for-bit"
+                )
+                break
+    return report
+
+
 def _build_secp(n_lights: int, n_models: int, levels: int, seed: int):
     """A fixed-STRUCTURE smart-lighting SECP: deterministic model
     scopes (consecutive 3-light windows) so every seed compiles to
@@ -1313,6 +1497,7 @@ def main() -> int:
     report_membound = run_membound_guard()
     report_bnb = run_bnb_guard()
     report_restore = run_restore_guard()
+    report_fleet = run_fleet_guard()
     print(
         json.dumps(
             {
@@ -1326,6 +1511,7 @@ def main() -> int:
                 "membound": report_membound,
                 "bnb": report_bnb,
                 "restore": report_restore,
+                "fleet": report_fleet,
             }
         )
     )
@@ -1341,6 +1527,7 @@ def main() -> int:
         and report_membound["ok"]
         and report_bnb["ok"]
         and report_restore["ok"]
+        and report_fleet["ok"]
         else 1
     )
 
