@@ -269,6 +269,37 @@ def _git_sha() -> str:
         return "unknown"
 
 
+def _benchkeeper():
+    """Import ``tools/benchkeeper`` (jax-free) on demand.
+
+    The ONE interleave/pair/median harness every A/B stage runs
+    through, plus the ledger's environment fingerprint — shared with
+    the ``bench-history``/``bench-compare`` CLIs so the measurement
+    discipline and the analysis discipline cannot drift
+    (docs/performance.md, "Reading the trajectory").
+    """
+    tools_dir = os.path.join(REPO, "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import benchkeeper.abtest
+    import benchkeeper.ledger
+
+    return benchkeeper.abtest, benchkeeper.ledger
+
+
+def _device_kind() -> str | None:
+    """Device kind of the default backend IF jax is already loaded —
+    never forces the import (the log hook must stay cheap and
+    import-safe on a box with no working accelerator)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return str(jax.devices()[0].device_kind)
+    except Exception:
+        return None
+
+
 def append_tpu_log(workload: str, msgs_per_sec: float, **extra) -> None:
     """Persist a successful TPU measurement to BENCH_TPU_LOG.jsonl.
 
@@ -289,6 +320,18 @@ def append_tpu_log(workload: str, msgs_per_sec: float, **extra) -> None:
         ),
     }
     entry.update(extra)
+    try:
+        # full environment fingerprint (ISSUE 17): lets the ledger
+        # refuse cross-environment absolute comparisons instead of
+        # silently making them
+        _, bk_ledger = _benchkeeper()
+        entry["fingerprint"] = bk_ledger.environment_fingerprint(
+            backend="tpu",
+            device_kind=_device_kind(),
+            sha=entry["sha"],
+        )
+    except Exception:
+        pass  # the fingerprint is provenance, not a gate
     try:
         line = json.dumps(entry, default=float)
         with open(TPU_LOG, "a") as f:
@@ -1071,15 +1114,20 @@ def _measure_membound(phase_budget: float = 0.0) -> dict:
         )
 
     _phase("measure:budgeted_device")
-    times = []
-    for _ in range(MB_REPS):
+    abtest, _ = _benchkeeper()
+    dev_res = {}
+
+    def _run_dev() -> float:
         t0 = time.perf_counter()
-        r_dev = solve(
+        dev_res["r"] = solve(
             big, "dpop", dev_p, max_util_bytes=MB_BUDGET,
             pad_policy="pow2",
         )
-        times.append(time.perf_counter() - t0)
-    med_dev = statistics.median(times)
+        return time.perf_counter() - t0
+
+    dev_ab = abtest.interleave([("budgeted_device", _run_dev)], MB_REPS)
+    med_dev = dev_ab.median("budgeted_device")
+    r_dev = dev_res["r"]
     mb = r_dev["membound"]
     # the bounded host-f64 reference affords the instance exactly
     # because the planner bounded it — the exactness oracle
@@ -1094,18 +1142,27 @@ def _measure_membound(phase_budget: float = 0.0) -> dict:
                    max_util_bytes=MB_BUDGET)
 
     _phase("measure:control")
-    t_unb, t_bud = [], []
-    for _ in range(MB_REPS):  # interleaved: load noise hits both
+    ctl_res = {}
+
+    def _run_ctl(arm: str, **ctl_kw) -> float:
         t0 = time.perf_counter()
-        rc_u = solve(ctl, "dpop", dev_p, pad_policy="pow2")
-        t_unb.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        rc_b = solve(
-            ctl, "dpop", dev_p, max_util_bytes=MB_CTL_BUDGET,
-            pad_policy="pow2",
-        )
-        t_bud.append(time.perf_counter() - t0)
-    med_u, med_b = statistics.median(t_unb), statistics.median(t_bud)
+        ctl_res[arm] = solve(ctl, "dpop", dev_p, **ctl_kw)
+        return time.perf_counter() - t0
+
+    # interleaved: load noise hits both arms (abtest.interleave)
+    ctl_ab = abtest.interleave(
+        [
+            ("unbounded", lambda: _run_ctl("unbounded", pad_policy="pow2")),
+            ("budgeted", lambda: _run_ctl(
+                "budgeted", max_util_bytes=MB_CTL_BUDGET,
+                pad_policy="pow2",
+            )),
+        ],
+        MB_REPS,
+    )
+    med_u = ctl_ab.median("unbounded")
+    med_b = ctl_ab.median("budgeted")
+    rc_u, rc_b = ctl_res["unbounded"], ctl_res["budgeted"]
 
     out = {
         "platform": jax.devices()[0].platform,
@@ -1126,6 +1183,9 @@ def _measure_membound(phase_budget: float = 0.0) -> dict:
         "best_cost": r_dev["cost"],
         "util_cells": r_dev["util_cells"],
         "seconds": round(med_dev, 4),
+        # dispersion (ISSUE 17): pair count + min/max so an n-rep
+        # median can't masquerade as a stable measurement
+        "samples": dev_ab.records(),
         "util_cells_per_sec": round(
             r_dev["util_cells"] / max(r_dev["util_time"], 1e-9)
         ),
@@ -1144,6 +1204,7 @@ def _measure_membound(phase_budget: float = 0.0) -> dict:
             "max_util_bytes": MB_CTL_BUDGET,
             "cut_width": rc_b["membound"]["cut_width"],
             "results_match": bool(rc_u["cost"] == rc_b["cost"]),
+            "samples": ctl_ab.records(),
             "unbounded": {
                 "seconds": round(med_u, 4),
                 "util_cells": rc_u["util_cells"],
@@ -1227,15 +1288,24 @@ def _measure_bnb(phase_budget: float = 0.0) -> dict:
         run("on")
 
     _phase("measure:secp")
-    meds = {"off": [], "on": []}
+    abtest, _ = _benchkeeper()
     results = {}
-    for _ in range(BNB_REPS):
-        for bnb in ("off", "on"):
-            r = run(bnb)
-            meds[bnb].append(r["util_time"])
-            results[bnb] = r
-    med_off = statistics.median(meds["off"])
-    med_on = statistics.median(meds["on"])
+
+    def _run_arm(bnb: str) -> float:
+        r = run(bnb)
+        results[bnb] = r
+        return r["util_time"]
+
+    # interleaved reps via the shared harness: load noise hits both
+    ab = abtest.interleave(
+        [
+            ("off", lambda: _run_arm("off")),
+            ("on", lambda: _run_arm("on")),
+        ],
+        BNB_REPS,
+    )
+    med_off = ab.median("off")
+    med_on = ab.median("on")
     r_on, r_off = results["on"], results["off"]
     counters = r_on["telemetry"]["counters"]
     pruned = int(counters.get("semiring.bnb_pruned_cells", 0))
@@ -1261,16 +1331,22 @@ def _measure_bnb(phase_budget: float = 0.0) -> dict:
 
     run_head("off")
     run_head("auto")
-    h_meds = {"off": [], "auto": []}
     h_res = {}
-    for _ in range(BNB_REPS):
-        for bnb in ("off", "auto"):
-            t0 = time.perf_counter()
-            r = run_head(bnb)
-            h_meds[bnb].append(time.perf_counter() - t0)
-            h_res[bnb] = r
-    h_off = statistics.median(h_meds["off"])
-    h_auto = statistics.median(h_meds["auto"])
+
+    def _run_head_arm(bnb: str) -> float:
+        t0 = time.perf_counter()
+        h_res[bnb] = run_head(bnb)
+        return time.perf_counter() - t0
+
+    h_ab = abtest.interleave(
+        [
+            ("off", lambda: _run_head_arm("off")),
+            ("auto", lambda: _run_head_arm("auto")),
+        ],
+        BNB_REPS,
+    )
+    h_off = h_ab.median("off")
+    h_auto = h_ab.median("auto")
 
     out = {
         "platform": jax.devices()[0].platform,
@@ -1291,6 +1367,7 @@ def _measure_bnb(phase_budget: float = 0.0) -> dict:
             r_on["util_cells"] / max(med_on, 1e-9)
         ),
         "speedup_on_vs_off": round(med_off / max(med_on, 1e-9), 2),
+        "samples": ab.records(),
         "pruned_cells": pruned,
         "pruned_fraction": round(pruned / max(join_cells, 1), 3),
         "bnb_passes": int(
@@ -1309,6 +1386,7 @@ def _measure_bnb(phase_budget: float = 0.0) -> dict:
             "ratio_auto_vs_off": round(
                 h_off / max(h_auto, 1e-9), 3
             ),
+            "samples": h_ab.records(),
             "skipped_small": int(
                 h_res["auto"]["telemetry"]["counters"].get(
                     "semiring.bnb_skipped_small", 0
@@ -1372,6 +1450,7 @@ def _measure_supervised(phase_budget: float = 0.0) -> dict:
         from pydcop_tpu.ops import compile_dcop
 
     _phase("problem_built")
+    abtest, _ = _benchkeeper()
     dcop = g._make_coloring_dcop(SUP_VARS, degree=DEGREE, seed=1)
     problem = compile_dcop(dcop)
     out = {
@@ -1404,18 +1483,25 @@ def _measure_supervised(phase_budget: float = 0.0) -> dict:
             return msgs / dt
 
         _phase(f"measure:supervised_{algo}")
-        sup_rates, bare_rates = [], []
-        for _ in range(SUP_REPS):  # interleaved: load noise hits both
-            sup_rates.append(_timed())  # ambient default supervisor
+
+        def _bare_timed():
             with supervision(UNSUPERVISED):
-                bare_rates.append(_timed())
-        sup_med = statistics.median(sup_rates)
-        bare_med = statistics.median(bare_rates)
+                return _timed()
+
+        # interleaved via the shared harness: load noise hits both
+        # arms ("supervised" = the ambient default supervisor)
+        ab = abtest.interleave(
+            [("supervised", _timed), ("unsupervised", _bare_timed)],
+            SUP_REPS,
+        )
+        sup_med = ab.median("supervised")
+        bare_med = ab.median("unsupervised")
         overhead_pct = round((1.0 - sup_med / bare_med) * 100.0, 2)
         out["algos"][algo] = {
             "msgs_per_sec_supervised": round(sup_med),
             "msgs_per_sec_unsupervised": round(bare_med),
             "overhead_pct": overhead_pct,
+            "samples": ab.records(),
         }
         if overhead_pct >= SUP_BOUND_PCT:
             out["ok"] = False
@@ -1535,21 +1621,28 @@ def _measure_obs(phase_budget: float = 0.0) -> dict:
         one_burst(True)  # warm settle, both arm shapes
 
     _phase("measure:obs_overhead")
-    on_dts, off_dts = [], []
-    res_on = res_off = None
-    for rep in range(OBS_REPS):
-        if rep % 2 == 0:
-            res_on, dt_on = one_burst(True)
-            res_off, dt_off = one_burst(False)
-        else:
-            res_off, dt_off = one_burst(False)
-            res_on, dt_on = one_burst(True)
-        on_dts.append(dt_on)
-        off_dts.append(dt_off)
+    abtest, _ = _benchkeeper()
+    last = {}
+
+    def _burst_arm(obs_on: bool, name: str) -> float:
+        last[name], dt = one_burst(obs_on)
+        return dt
+
+    # alternate=True flips within-rep arm order on odd reps — the
+    # original hand-rolled pattern, spreading machine drift evenly
+    ab = abtest.interleave(
+        [
+            ("on", lambda: _burst_arm(True, "on")),
+            ("off", lambda: _burst_arm(False, "off")),
+        ],
+        OBS_REPS,
+        alternate=True,
+    )
+    res_on, res_off = last["on"], last["off"]
     svc.close()
     total_scrapes = scrapes[0]
-    on_med = statistics.median(on_dts)
-    off_med = statistics.median(off_dts)
+    on_med = ab.median("on")
+    off_med = ab.median("off")
     overhead_pct = round((on_med / off_med - 1.0) * 100.0, 2)
     results_match = all(
         a["cost"] == b["cost"] and a["assignment"] == b["assignment"]
@@ -1565,6 +1658,7 @@ def _measure_obs(phase_budget: float = 0.0) -> dict:
         "bound_pct": OBS_BOUND_PCT,
         "burst_s_observability_on": round(on_med, 4),
         "burst_s_observability_off": round(off_med, 4),
+        "samples": ab.records(),
         "overhead_pct": overhead_pct,
         "scrapes": total_scrapes,
         "results_match": results_match,
@@ -1643,9 +1737,9 @@ def _measure_service(phase_budget: float = 0.0) -> dict:
         return res, time.perf_counter() - t0
 
     _phase("measure:service")
-    ratios, seq_dts, burst_dts = [], [], []
+    abtest, _ = _benchkeeper()
     p50s, p99s, lats_all = [], [], []
-    seq = results = None
+    cap = {}
     with _tel_session() as tel:
         with SolverService(
             pad_policy="pow2", max_batch=SVC_N, max_wait=0.25
@@ -1688,15 +1782,25 @@ def _measure_service(phase_budget: float = 0.0) -> dict:
                 # sequential loop that ran right next to it, so a
                 # machine-wide slowdown (shared throttled vCPUs) hits
                 # both sides of the ratio and of the p99 bound
-                for _ in range(SVC_REPS):
-                    seq, dt_seq = sequential()
-                    results, dt_b, lats = burst()
-                    seq_dts.append(dt_seq)
-                    burst_dts.append(dt_b)
-                    ratios.append(dt_seq / dt_b)
+                def _seq_arm() -> float:
+                    cap["seq"], dt = sequential()
+                    return dt
+
+                def _burst_arm() -> float:
+                    res, dt, lats = burst()
+                    cap["results"] = res
                     p50s.append(_svc_percentile(lats, 50))
                     p99s.append(_svc_percentile(lats, 99))
                     lats_all.extend(lats)
+                    return dt
+
+                ab = abtest.interleave(
+                    [
+                        ("sequential", _seq_arm),
+                        ("burst", _burst_arm),
+                    ],
+                    SVC_REPS,
+                )
                 steady_compiles = (
                     int(
                         tel.summary()["counters"].get("jit.compiles", 0)
@@ -1707,15 +1811,16 @@ def _measure_service(phase_budget: float = 0.0) -> dict:
                     c.close()
         stats = svc.stats()
 
-    dt_seq = statistics.median(seq_dts)
-    dt_svc = statistics.median(burst_dts)
+    dt_seq = ab.median("sequential")
+    dt_svc = ab.median("burst")
     per_call = dt_seq / SVC_N
     p99 = statistics.median(p99s)
     results_match = all(
         r["cost"] == s["cost"] and r["assignment"] == s["assignment"]
-        for r, s in zip(results, seq)
+        for r, s in zip(cap["results"], cap["seq"])
     )
-    ratio = round(statistics.median(ratios), 2)
+    # median of the per-rep PAIRED ratios (not the ratio of medians)
+    ratio = round(ab.median_pair_ratio("sequential", "burst"), 2)
     out = {
         "platform": jax.devices()[0].platform,
         "n_clients": SVC_N,
@@ -1731,8 +1836,12 @@ def _measure_service(phase_budget: float = 0.0) -> dict:
         "latency_s": {
             "p50": round(statistics.median(p50s), 4),
             "p99": round(p99, 4),
+            "p99_min": round(min(p99s), 4),
+            "p99_max": round(max(p99s), 4),
+            "n": len(p99s),
             "bound": round(SVC_P99_FACTOR * per_call, 4),
         },
+        "samples": ab.records(),
         "batch_occupancy": stats["batch_occupancy"],
         "coalesce_ratio": stats["coalesce_ratio"],
         "steady_state_jit_compiles": steady_compiles,

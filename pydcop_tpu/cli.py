@@ -51,6 +51,11 @@ COMMANDS = [
     # flight-recorder dump renderer (module flight_dump registers the
     # subcommand as `flight-dump`)
     "flight_dump",
+    # performance-trajectory tooling over benchdata/ledger.jsonl
+    # (tools/benchkeeper, docs/performance.md) — modules register the
+    # subcommands as `bench-history` / `bench-compare`
+    "bench_history",
+    "bench_compare",
 ]
 
 

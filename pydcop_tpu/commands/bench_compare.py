@@ -1,0 +1,159 @@
+"""``pydcop_tpu bench-compare`` — statistical comparison of measurements.
+
+Two modes (``docs/performance.md`` "Reading the trajectory"):
+
+- ``--pairs FILE``: a JSON doc of *paired interleaved samples*
+  (``{"baseline": [...], "candidate": [...], "higher_is_better":
+  true}``) is run through the deterministic comparator
+  (``tools/benchkeeper/stats.py``: sign test + seeded-bootstrap CI on
+  paired ratios) and gets a full ``regression|improvement|noise``
+  verdict.  Seeded, so two runs over the same file are bit-identical.
+  Exit code 1 on a ``regression`` verdict (CI-friendly).
+
+- ``--baseline rNN --candidate rMM``: ledger rounds are compared as
+  fingerprint-checked *point ratios only* — cross-round samples were
+  never interleaved, so no statistical verdict is claimed, and a
+  fingerprint mismatch on any comparability field refuses the
+  comparison outright rather than printing a cross-environment number.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from pydcop_tpu.commands.bench_history import _find_root, import_benchkeeper
+
+
+def set_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "bench-compare",
+        help="compare measurements: paired-sample verdicts "
+        "(regression|improvement|noise) or fingerprint-checked round "
+        "ratios (docs/performance.md)",
+    )
+    p.add_argument(
+        "--pairs", default=None, metavar="FILE",
+        help="JSON doc with paired interleaved samples: "
+        '{"baseline": [...], "candidate": [...], '
+        '"higher_is_better": true} — full statistical verdict',
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="ROUND",
+        help="ledger round to compare from (e.g. r08)",
+    )
+    p.add_argument(
+        "--candidate", default=None, metavar="ROUND",
+        help="ledger round to compare to (e.g. r09)",
+    )
+    p.add_argument(
+        "--stage", default=None, metavar="STAGE",
+        help="restrict round comparison to one stage",
+    )
+    p.add_argument(
+        "--metric", default=None, metavar="METRIC",
+        help="restrict round comparison to one metric",
+    )
+    p.add_argument(
+        "--ledger", default=None, metavar="FILE",
+        help="ledger path (default: <root>/benchdata/ledger.jsonl)",
+    )
+    p.add_argument(
+        "--seed", type=int, default=None,
+        help="bootstrap seed (default: the comparator's pinned seed)",
+    )
+    p.add_argument(
+        "--alpha", type=float, default=None,
+        help="sign-test significance level (default 0.05)",
+    )
+    p.add_argument(
+        "--noise_floor", type=float, default=None,
+        help="practical-significance floor on |median ratio - 1| "
+        "(default 0.05)",
+    )
+    p.add_argument(
+        "--n_boot", type=int, default=None,
+        help="bootstrap resamples (default 2000)",
+    )
+    p.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable result",
+    )
+    p.add_argument(
+        "--root", default=None, metavar="DIR",
+        help="project root (default: the checkout containing the "
+        "pydcop_tpu package)",
+    )
+    p.set_defaults(func=run_cmd)
+
+
+def _emit(args, doc: dict, text: str) -> None:
+    if args.as_json:
+        out = json.dumps(doc, indent=2, sort_keys=True)
+    else:
+        out = text
+    print(out)
+    if getattr(args, "output", None):
+        with open(args.output, "w") as f:
+            f.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+
+
+def run_cmd(args) -> int:
+    root = _find_root(args.root)
+    bk_ledger, bk_history = import_benchkeeper(root)
+
+    if args.pairs and (args.baseline or args.candidate):
+        print(
+            "bench-compare: --pairs and --baseline/--candidate are "
+            "mutually exclusive", file=sys.stderr,
+        )
+        return 2
+
+    if args.pairs:
+        try:
+            with open(args.pairs) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench-compare: cannot read {args.pairs}: {e}",
+                  file=sys.stderr)
+            return 2
+        kwargs = {}
+        if args.seed is not None:
+            kwargs["seed"] = args.seed
+        if args.alpha is not None:
+            kwargs["alpha"] = args.alpha
+        if args.noise_floor is not None:
+            kwargs["noise_floor"] = args.noise_floor
+        if args.n_boot is not None:
+            kwargs["n_boot"] = args.n_boot
+        try:
+            result = bk_history.compare_pairs_doc(doc, **kwargs)
+        except ValueError as e:
+            print(f"bench-compare: {e}", file=sys.stderr)
+            return 2
+        _emit(args, result, bk_history.format_verdict(result))
+        return 1 if result["verdict"] == "regression" else 0
+
+    if not (args.baseline and args.candidate):
+        print(
+            "bench-compare: need either --pairs FILE or "
+            "--baseline ROUND --candidate ROUND", file=sys.stderr,
+        )
+        return 2
+
+    path = args.ledger or str(root / bk_ledger.LEDGER_RELPATH)
+    rows = bk_ledger.read_ledger(path)
+    if not rows:
+        print(
+            f"bench-compare: no ledger rows at {path} "
+            "(run bench-history --rebuild to seed it)", file=sys.stderr,
+        )
+        return 2
+    result = bk_history.compare_rounds(
+        rows, args.baseline, args.candidate,
+        stage=args.stage, metric=args.metric,
+    )
+    _emit(args, result, bk_history.format_compare_rounds(result))
+    if not result["entries"]:
+        return 2
+    return 0

@@ -61,6 +61,9 @@ _HEADLINE_COUNTERS = (
     ("service_errors", "errors"),
     ("service_replayed_replies", "replayed"),
     ("service_frames_rejected", "frames_rejected"),
+    # deterministic contraction work delivered — the rate column is
+    # cells/s, the FAQ cost-model throughput unit (docs/performance.md)
+    ("service_work_cells", "work_cells"),
     ("telemetry_flight_dumps", "flight_dumps"),
 )
 

@@ -1828,6 +1828,14 @@ class SolverService:
             )
             if group_n > 1:
                 met.inc("service.coalesced")
+            # deterministic work delivered (FAQ cost-model unit):
+            # UTIL/contraction cells for exact solves ("util_cells" on
+            # dpop results, "cells" on infer results) — feeds the
+            # cells/s column in `top` and the perf-drift tooling
+            # (docs/performance.md)
+            cells = result.get("util_cells") or result.get("cells")
+            if isinstance(cells, (int, float)) and cells > 0:
+                met.inc("service.work_cells", int(cells))
         if tr.enabled:
             tr.add_span(
                 "service.request", "service", req.enqueue_t, latency,

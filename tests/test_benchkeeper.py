@@ -1,0 +1,578 @@
+"""tools/benchkeeper: the bench ledger, the deterministic comparator,
+the interleave harness, and the bench-history / bench-compare CLIs.
+
+Everything here is jax-free and fast: the comparator is pure seeded
+stdlib, the ledger round-trips the repo's own BENCH_r*.json history,
+and the CLI tests inject ``--now`` so staleness output is reproducible.
+The acceptance properties from the issue are pinned directly: a seeded
+10% regression gets verdict ``regression``, a 2x-variance null reads
+``noise``, verdicts are bit-identical across runs, the history renders
+all nine rounds (including the two failed ones), and a fingerprint
+mismatch refuses the comparison instead of printing a number.
+"""
+
+import json
+import os
+import random
+import sys
+import types
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from benchkeeper import abtest, history, ledger, stats  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# stats: the documented decision rule
+# ---------------------------------------------------------------------------
+
+def _seeded_pairs(n=20, shift=1.0, noise=0.02, seed=7):
+    """Paired samples with multiplicative per-rep weather and a true
+    multiplicative effect of ``shift`` on the candidate arm."""
+    rng = random.Random(seed)
+    baseline, candidate = [], []
+    for _ in range(n):
+        weather = rng.uniform(0.9, 1.1)
+        baseline.append(1.0 * weather * rng.uniform(1 - noise, 1 + noise))
+        candidate.append(shift * weather * rng.uniform(1 - noise, 1 + noise))
+    return baseline, candidate
+
+
+class TestCompareRule:
+    def test_seeded_ten_percent_regression_is_detected(self):
+        baseline, candidate = _seeded_pairs(shift=0.90)
+        result = stats.compare(baseline, candidate, higher_is_better=True)
+        assert result["verdict"] == "regression"
+        assert result["median_ratio"] < 0.95
+        assert result["ci_excludes_one"]
+        assert result["p_sign"] <= result["alpha"]
+
+    def test_ten_percent_gain_is_improvement(self):
+        baseline, candidate = _seeded_pairs(shift=1.10)
+        result = stats.compare(baseline, candidate, higher_is_better=True)
+        assert result["verdict"] == "improvement"
+
+    def test_direction_flips_with_higher_is_better(self):
+        # same 10% drop, but the metric is latency: that's an improvement
+        baseline, candidate = _seeded_pairs(shift=0.90)
+        result = stats.compare(baseline, candidate, higher_is_better=False)
+        assert result["verdict"] == "improvement"
+
+    def test_high_variance_null_reads_noise(self):
+        # independent arms with the box's ~2x swing and NO true effect:
+        # the rule must not manufacture a verdict out of weather
+        rng = random.Random(123)
+        baseline = [rng.uniform(1.0, 2.0) for _ in range(20)]
+        candidate = [rng.uniform(1.0, 2.0) for _ in range(20)]
+        result = stats.compare(baseline, candidate)
+        assert result["verdict"] == "noise"
+
+    def test_real_but_tiny_shift_is_noise_by_floor(self):
+        # a perfectly consistent 2% shift: statistically real (every
+        # pair moves the same way) but under the 5% practical floor
+        baseline = [1.0 + i * 0.01 for i in range(12)]
+        candidate = [b * 0.98 for b in baseline]
+        result = stats.compare(baseline, candidate)
+        assert result["verdict"] == "noise"
+        assert result["p_sign"] <= result["alpha"]  # floor did the work
+
+    def test_verdicts_are_bit_identical(self):
+        baseline, candidate = _seeded_pairs(shift=0.90)
+        a = stats.compare(baseline, candidate)
+        b = stats.compare(baseline, candidate)
+        assert a == b
+
+    def test_sign_test_exact_values(self):
+        assert stats.sign_test_p(5, 5) == 1.0
+        assert stats.sign_test_p(0, 0) == 1.0
+        # all 10 pairs one way: 2 * 2^-10
+        assert stats.sign_test_p(10, 0) == pytest.approx(2 * 0.5 ** 10)
+
+    def test_median_and_ratio_validation(self):
+        assert stats.median([3.0, 1.0, 2.0]) == 2.0
+        assert stats.median([4.0, 1.0, 2.0, 3.0]) == 2.5
+        with pytest.raises(ValueError):
+            stats.median([])
+        with pytest.raises(ValueError):
+            stats.paired_ratios([1.0, 2.0], [1.0])
+        with pytest.raises(ValueError):
+            stats.paired_ratios([1.0, 0.0], [1.0, 1.0])
+        with pytest.raises(ValueError):
+            stats.paired_ratios([], [])
+
+    def test_bootstrap_ci_is_deterministic_and_ordered(self):
+        rng = random.Random(5)
+        vals = [rng.uniform(0.8, 1.2) for _ in range(15)]
+        a = stats.bootstrap_ci(vals, seed=11, n_boot=500)
+        b = stats.bootstrap_ci(vals, seed=11, n_boot=500)
+        assert a == b
+        assert a[0] <= a[1]
+        assert stats.bootstrap_ci(vals, seed=12, n_boot=500) != a
+
+
+# ---------------------------------------------------------------------------
+# abtest: the one interleave harness
+# ---------------------------------------------------------------------------
+
+class TestInterleave:
+    def test_arms_run_interleaved_and_pair_by_rep(self):
+        trace = []
+
+        def arm(name, value):
+            def thunk():
+                trace.append(name)
+                return value
+            return thunk
+
+        ab = abtest.interleave(
+            [("a", arm("a", 1.0)), ("b", arm("b", 2.0))], 3
+        )
+        assert trace == ["a", "b", "a", "b", "a", "b"]
+        assert ab.n_reps == 3
+        assert ab.pairs("a", "b") == [(1.0, 2.0)] * 3
+        assert ab.pair_ratios("b", "a") == [2.0] * 3
+        assert ab.median_pair_ratio("b", "a") == 2.0
+        assert ab.ratio("b", "a") == 2.0
+
+    def test_alternate_flips_order_on_odd_reps(self):
+        trace = []
+        ab = abtest.interleave(
+            [
+                ("on", lambda: trace.append("on") or 1.0),
+                ("off", lambda: trace.append("off") or 1.0),
+            ],
+            4,
+            alternate=True,
+        )
+        assert trace == ["on", "off", "off", "on", "on", "off", "off", "on"]
+        assert ab.n_reps == 4
+
+    def test_warmup_results_are_discarded(self):
+        calls = {"n": 0}
+
+        def thunk():
+            calls["n"] += 1
+            return float(calls["n"])
+
+        ab = abtest.interleave([("x", thunk)], 2, warmup=True)
+        assert calls["n"] == 3
+        assert ab.values("x") == [2.0, 3.0]  # the warmup 1.0 is dropped
+
+    def test_record_carries_dispersion(self):
+        ab = abtest.ABSamples(["x"])
+        for v in (3.0, 1.0, 2.0):
+            ab.add("x", v)
+        rec = ab.record("x")
+        assert rec == {
+            "n": 3, "min": 1.0, "max": 3.0, "median": 2.0,
+            "values": [3.0, 1.0, 2.0],
+        }
+        assert set(ab.records()) == {"x"}
+
+    def test_compare_delegates_to_stats(self):
+        baseline, candidate = _seeded_pairs(shift=0.90)
+        ab = abtest.ABSamples(["base", "cand"])
+        for b, c in zip(baseline, candidate):
+            ab.add("base", b)
+            ab.add("cand", c)
+        assert ab.compare("base", "cand") == stats.compare(baseline, candidate)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            abtest.ABSamples(["a", "a"])
+        with pytest.raises(ValueError):
+            abtest.interleave([("a", lambda: 1.0)], 0)
+        with pytest.raises(ValueError):
+            abtest.ABSamples(["a"]).record("a")
+
+
+# ---------------------------------------------------------------------------
+# ledger: fingerprints, refusal, round-trip from the real history
+# ---------------------------------------------------------------------------
+
+class TestFingerprint:
+    def test_known_mismatch_refuses(self):
+        a = ledger.null_fingerprint(backend="cpu", vcpus=2)
+        b = ledger.null_fingerprint(backend="tpu", vcpus=2)
+        ok, mismatched, unknown = ledger.comparability(a, b)
+        assert not ok
+        assert mismatched == ["backend"]
+        reason = ledger.refusal_reason(a, b)
+        assert reason is not None and "backend" in reason
+
+    def test_unknown_fields_weaken_but_do_not_refuse(self):
+        a = ledger.null_fingerprint(backend="cpu")
+        b = ledger.null_fingerprint(backend="cpu", vcpus=2)
+        ok, mismatched, unknown = ledger.comparability(a, b)
+        assert ok and not mismatched
+        assert "vcpus" in unknown and "jax" in unknown
+        assert ledger.refusal_reason(a, b) is None
+
+    def test_null_fingerprint_rejects_unknown_keys(self):
+        with pytest.raises(KeyError):
+            ledger.null_fingerprint(bogus=1)
+
+    def test_environment_fingerprint_collects_locally(self):
+        fp = ledger.environment_fingerprint(backend="cpu", sha="abc123")
+        assert fp["backend"] == "cpu"
+        assert fp["sha"] == "abc123"
+        assert fp["vcpus"] == os.cpu_count()
+        assert isinstance(fp["python"], str)
+        assert set(fp) == set(ledger.FINGERPRINT_FIELDS)
+
+
+class TestTimestamps:
+    def test_parse_ts_both_formats(self):
+        epoch = ledger.parse_ts("2026-08-05T12:00:00Z")
+        assert ledger.format_ts(epoch) == "2026-08-05T12:00:00Z"
+        # git %cI offset form: same instant, +02:00 local
+        assert ledger.parse_ts("2026-08-05T14:00:00+02:00") == epoch
+        assert ledger.parse_ts("2026-08-05T12:00:00+00:00") == epoch
+        with pytest.raises(ValueError):
+            ledger.parse_ts("yesterday-ish")
+
+    def test_make_row_validates_ts(self):
+        with pytest.raises(ValueError):
+            ledger.make_row(
+                ts="not-a-ts", source="s", stage="st", metric="m",
+                value=1.0, unit="u", higher_is_better=True,
+                fingerprint=ledger.null_fingerprint(),
+            )
+
+
+class TestLedgerRoundTrip:
+    @pytest.fixture(scope="class")
+    def seeded(self):
+        return ledger.seed_rows(_REPO)
+
+    def test_every_round_gets_a_status_row(self, seeded):
+        status = [r for r in seeded if ledger.row_key(r) == ("bench_round", "rc")]
+        assert sorted(r["round"] for r in status) == [
+            f"r{i:02d}" for i in range(1, 10)
+        ]
+        by_round = {r["round"]: r for r in status}
+        # r01 crashed (rc=1), r05 timed out (rc=0, nothing parsed) —
+        # both must still be present, visibly unparsed
+        assert by_round["r01"]["value"] == 1.0
+        assert by_round["r01"]["extra"]["parsed"] is False
+        assert by_round["r05"]["extra"]["parsed"] is False
+        assert by_round["r09"]["extra"]["parsed"] is True
+
+    def test_rows_round_trip_through_the_file(self, seeded, tmp_path):
+        path = str(tmp_path / "ledger.jsonl")
+        n = ledger.write_ledger(path, seeded)
+        assert n == len(seeded)
+        back = ledger.read_ledger(path)
+        assert back == seeded
+        m = ledger.append_rows(path, seeded[:3])
+        assert m == 3
+        assert ledger.read_ledger(path) == seeded + seeded[:3]
+
+    def test_read_ledger_skips_garbage_lines(self, tmp_path):
+        path = tmp_path / "ledger.jsonl"
+        good = ledger.make_row(
+            ts="2026-08-05T12:00:00Z", source="s", stage="st", metric="m",
+            value=1.0, unit="u", higher_is_better=True,
+            fingerprint=ledger.null_fingerprint(),
+        )
+        path.write_text(
+            "not json\n\n[1,2]\n" + json.dumps(good) + "\n"
+        )
+        assert ledger.read_ledger(str(path)) == [good]
+        assert ledger.read_ledger(str(tmp_path / "missing.jsonl")) == []
+
+    def test_historic_rows_have_null_fingerprints_except_backend(self, seeded):
+        metric_rows = [
+            r for r in seeded
+            if r["source"].startswith("bench_")
+            and ledger.row_key(r) != ("bench_round", "rc")
+        ]
+        assert metric_rows
+        for r in metric_rows:
+            fp = r["fingerprint"]
+            assert fp["vcpus"] is None and fp["jax"] is None
+            assert set(fp) == set(ledger.FINGERPRINT_FIELDS)
+
+    def test_tpu_log_rows_present_and_fingerprinted_tpu(self, seeded):
+        tpu = [r for r in seeded if r["source"] == "tpu_log"]
+        assert tpu
+        for r in tpu:
+            assert r["fingerprint"]["backend"] == "tpu"
+            assert r["value"] > 0
+            assert r["unit"] == "msgs/s"
+
+    def test_rows_are_sorted_by_time(self, seeded):
+        times = [ledger.parse_ts(r["ts"]) for r in seeded]
+        assert times == sorted(times)
+
+
+class TestTpuLogExtraction:
+    def test_skips_bad_entries_and_keeps_embedded_fingerprint(self):
+        fp = ledger.null_fingerprint(backend="tpu", device_kind="TPU v4")
+        rows = ledger.extract_tpu_log_rows([
+            {"ts": "2026-08-01T00:00:00Z", "workload": "w",
+             "msgs_per_sec": 5.0, "fingerprint": fp, "rounds": 100},
+            {"ts": "2026-08-01T00:00:00Z", "workload": "w",
+             "msgs_per_sec": 0},                       # non-positive
+            {"ts": "garbage", "workload": "w", "msgs_per_sec": 1.0},
+            "not a dict",
+            {"workload": "w", "msgs_per_sec": 1.0},    # no ts
+        ])
+        assert len(rows) == 1
+        assert rows[0]["fingerprint"]["device_kind"] == "TPU v4"
+        assert rows[0]["extra"] == {"rounds": 100}
+
+
+# ---------------------------------------------------------------------------
+# history: chaining, staleness, round comparison
+# ---------------------------------------------------------------------------
+
+def _row(ts, stage, metric, value, backend="cpu", rnd=None, **fp_known):
+    return ledger.make_row(
+        ts=ts, source="test", stage=stage, metric=metric, value=value,
+        unit="u", higher_is_better=True, round_name=rnd,
+        fingerprint=ledger.null_fingerprint(backend=backend, **fp_known),
+    )
+
+
+class TestHistory:
+    def test_chain_normalize_anchors_new_segments(self):
+        # env A measures 10 -> 20, env B (2x faster box) 60 -> 90:
+        # the chained curve continues from 20, preserving B's 1.5x
+        values = [10.0, 20.0, 60.0, 90.0]
+        keys = [("a",), ("a",), ("b",), ("b",)]
+        norm, n_seg = history.chain_normalize(values, keys)
+        assert n_seg == 2
+        assert norm == [10.0, 20.0, 20.0, 30.0]
+        # single env: pass-through
+        norm1, n1 = history.chain_normalize([1.0, 2.0], [("a",), ("a",)])
+        assert (norm1, n1) == ([1.0, 2.0], 1)
+
+    def test_sparkline_shape(self):
+        s = history.sparkline([1.0, 2.0, 3.0])
+        assert len(s) == 3
+        assert s[0] == history.SPARK_BLOCKS[0]
+        assert s[-1] == history.SPARK_BLOCKS[-1]
+        assert history.sparkline([5.0, 5.0]) == history.SPARK_BLOCKS[3] * 2
+        assert history.sparkline([]) == ""
+
+    def test_stale_backends_flags_old_rows_only(self):
+        now = ledger.parse_ts("2026-08-05T12:00:00Z")
+        rows = [
+            _row("2026-08-05T00:00:00Z", "s", "m", 1.0, backend="cpu"),
+            _row("2026-08-01T00:00:00Z", "s", "m", 1.0, backend="tpu"),
+            _row("2026-07-01T00:00:00Z", "s", "m", 1.0, backend="tpu"),
+            _row("2026-08-05T00:00:00Z", "s", "m", 1.0, backend=None),
+        ]
+        report = history.stale_backends(rows, now_epoch=now, stale_hours=72.0)
+        by_backend = {r["backend"]: r for r in report}
+        assert set(by_backend) == {"cpu", "tpu"}  # unnamed backend skipped
+        assert not by_backend["cpu"]["stale"]
+        assert by_backend["tpu"]["stale"]
+        # staleness is judged on the NEWEST tpu row (4.5 days), not the
+        # month-old one
+        assert by_backend["tpu"]["age_hours"] == pytest.approx(108.0)
+        assert report[0]["backend"] == "tpu"  # stalest first
+
+    def test_compare_rounds_refuses_on_fingerprint_mismatch(self):
+        rows = [
+            _row("2026-08-01T00:00:00Z", "s", "m", 10.0, rnd="r01",
+                 backend="cpu", vcpus=2),
+            _row("2026-08-02T00:00:00Z", "s", "m", 12.0, rnd="r02",
+                 backend="cpu", vcpus=8),
+            _row("2026-08-01T00:00:00Z", "s", "ok", 10.0, rnd="r01",
+                 backend="cpu"),
+            _row("2026-08-02T00:00:00Z", "s", "ok", 15.0, rnd="r02",
+                 backend="cpu"),
+        ]
+        result = history.compare_rounds(rows, "r01", "r02")
+        assert result["verdict"] is None  # never a statistical claim
+        by_metric = {e["metric"]: e for e in result["entries"]}
+        assert "refused" in by_metric["m"]
+        assert "vcpus" in by_metric["m"]["refused"]
+        assert "ratio" not in by_metric["m"]
+        assert by_metric["ok"]["ratio"] == pytest.approx(1.5)
+        text = history.format_compare_rounds(result)
+        assert "REFUSED" in text and "x1.500" in text
+
+    def test_compare_pairs_doc_round_trips_the_rule(self):
+        baseline, candidate = _seeded_pairs(shift=0.90)
+        doc = {"baseline": baseline, "candidate": candidate,
+               "higher_is_better": True, "name": "t"}
+        result = history.compare_pairs_doc(doc)
+        assert result["verdict"] == "regression"
+        assert result["name"] == "t"
+        text = history.format_verdict(result)
+        assert "REGRESSION" in text and "excludes 1.0" in text
+        with pytest.raises(ValueError):
+            history.compare_pairs_doc({"baseline": [1.0]})
+
+    def test_history_report_renders_all_nine_rounds(self):
+        rows = ledger.seed_rows(_REPO)
+        now = ledger.parse_ts("2026-08-05T12:00:00Z")
+        report = history.history_report(rows, now_epoch=now)
+        for i in range(1, 10):
+            assert f"r{i:02d}" in report
+        assert "r01 FAIL" in report
+        assert "r05 empty" in report
+        assert "r09 ok" in report
+        # the TPU captures predate r09 by days: stale at the 72h bound
+        assert "STALE" in report and "tpu:" in report
+
+
+# ---------------------------------------------------------------------------
+# CLI golden output (bench-history / bench-compare)
+# ---------------------------------------------------------------------------
+
+def _history_args(**over):
+    base = dict(
+        ledger=None, stage=None, stale_hours=72.0, now=None,
+        rebuild=False, as_json=False, root=_REPO, output=None,
+    )
+    base.update(over)
+    return types.SimpleNamespace(**base)
+
+
+def _compare_args(**over):
+    base = dict(
+        pairs=None, baseline=None, candidate=None, stage=None,
+        metric=None, ledger=None, seed=None, alpha=None,
+        noise_floor=None, n_boot=None, as_json=False, root=_REPO,
+        output=None,
+    )
+    base.update(over)
+    return types.SimpleNamespace(**base)
+
+
+class TestCLIs:
+    @pytest.fixture(scope="class")
+    def tmp_ledger(self, tmp_path_factory):
+        """A rebuilt ledger in a scratch path — the committed one stays
+        untouched, and the CLI's --rebuild path gets exercised."""
+        from pydcop_tpu.commands import bench_history
+
+        path = str(tmp_path_factory.mktemp("bk") / "ledger.jsonl")
+        rc = bench_history.run_cmd(_history_args(
+            ledger=path, rebuild=True, now="2026-08-05T12:00:00Z",
+        ))
+        assert rc == 0
+        return path
+
+    def test_bench_history_golden(self, tmp_ledger, capsys):
+        from pydcop_tpu.commands import bench_history
+
+        rc = bench_history.run_cmd(_history_args(
+            ledger=tmp_ledger, now="2026-08-05T12:00:00Z",
+        ))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "bench history — " in out
+        assert "rounds:" in out
+        for i in range(1, 10):
+            assert f"r{i:02d}" in out
+        assert "r01 FAIL" in out and "r05 empty" in out
+        assert "north_star/msgs_per_sec" in out
+        assert "STALE" in out  # the tpu rows are >72h old at --now
+        # deterministic given --now: a second run is byte-identical
+        bench_history.run_cmd(_history_args(
+            ledger=tmp_ledger, now="2026-08-05T12:00:00Z",
+        ))
+        assert capsys.readouterr().out == out
+
+    def test_bench_history_stage_detail_and_json(self, tmp_ledger, capsys):
+        from pydcop_tpu.commands import bench_history
+
+        rc = bench_history.run_cmd(_history_args(
+            ledger=tmp_ledger, stage="bnb", now="2026-08-05T12:00:00Z",
+        ))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "bnb/speedup_on_vs_off" in out
+        assert "north_star" not in out
+        rc = bench_history.run_cmd(_history_args(
+            ledger=tmp_ledger, as_json=True, now="2026-08-05T12:00:00Z",
+        ))
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert len(doc["rounds"]) == 9
+        assert any(f["backend"] == "tpu" and f["stale"]
+                   for f in doc["freshness"])
+
+    def test_bench_history_empty_ledger_fails(self, tmp_path, capsys):
+        from pydcop_tpu.commands import bench_history
+
+        rc = bench_history.run_cmd(_history_args(
+            ledger=str(tmp_path / "nope.jsonl"),
+        ))
+        capsys.readouterr()
+        assert rc == 1
+
+    def test_bench_compare_pairs_verdict_and_exit_code(
+        self, tmp_path, capsys
+    ):
+        from pydcop_tpu.commands import bench_compare
+
+        baseline, candidate = _seeded_pairs(shift=0.90)
+        pairs = tmp_path / "pairs.json"
+        pairs.write_text(json.dumps({
+            "baseline": baseline, "candidate": candidate,
+            "higher_is_better": True, "name": "synthetic 10% drop",
+        }))
+        rc = bench_compare.run_cmd(_compare_args(pairs=str(pairs)))
+        out = capsys.readouterr().out
+        assert rc == 1  # regression is a CI failure
+        assert "verdict: REGRESSION" in out
+        # bit-identical across runs (seeded bootstrap)
+        bench_compare.run_cmd(_compare_args(pairs=str(pairs)))
+        assert capsys.readouterr().out == out
+
+    def test_bench_compare_pairs_noise_exits_zero(self, tmp_path, capsys):
+        from pydcop_tpu.commands import bench_compare
+
+        rng = random.Random(123)
+        pairs = tmp_path / "null.json"
+        pairs.write_text(json.dumps({
+            "baseline": [rng.uniform(1.0, 2.0) for _ in range(20)],
+            "candidate": [rng.uniform(1.0, 2.0) for _ in range(20)],
+        }))
+        rc = bench_compare.run_cmd(_compare_args(pairs=str(pairs)))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "verdict: NOISE" in out
+
+    def test_bench_compare_rounds_golden(self, tmp_ledger, capsys):
+        from pydcop_tpu.commands import bench_compare
+
+        rc = bench_compare.run_cmd(_compare_args(
+            baseline="r07", candidate="r09", ledger=tmp_ledger,
+        ))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "r07 -> r09" in out
+        assert "point ratios, no verdict" in out
+        assert "north_star/msgs_per_sec" in out
+        assert "not interleaved" in out
+
+    def test_bench_compare_usage_errors(self, tmp_path, capsys):
+        from pydcop_tpu.commands import bench_compare
+
+        # neither mode selected
+        assert bench_compare.run_cmd(_compare_args()) == 2
+        # both modes selected
+        assert bench_compare.run_cmd(_compare_args(
+            pairs="x.json", baseline="r01", candidate="r02",
+        )) == 2
+        # unreadable pairs file
+        assert bench_compare.run_cmd(_compare_args(
+            pairs=str(tmp_path / "missing.json"),
+        )) == 2
+        # malformed pairs doc
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"baseline": [1.0]}))
+        assert bench_compare.run_cmd(_compare_args(pairs=str(bad))) == 2
+        capsys.readouterr()

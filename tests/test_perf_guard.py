@@ -1,4 +1,6 @@
-"""Perf-regression guards: HLO-text assertions on the hot path.
+"""Perf-regression guards: HLO-text assertions on the hot path, plus
+the tier-1 hook for ``tools/perf_guard.py`` (recorded work-counter
+budgets — see the classes at the bottom).
 
 All functional tests run on the CPU backend (conftest), so a TPU
 layout regression — e.g. a scatter sneaking into the gather-shaped
@@ -179,3 +181,95 @@ def test_sharded_maxsum_round_hlo_is_clean():
     assert n_lines < 1500, (
         f"sharded Max-Sum round HLO grew to {n_lines} lines"
     )
+
+
+# ---------------------------------------------------------------------------
+# tools/perf_guard.py: recorded work-counter budgets (ISSUE 17)
+# ---------------------------------------------------------------------------
+# Wall-clock is noise on this box; util_cells / util_dispatches /
+# bnb_pruned_cells / jit.compiles are deterministic functions of the
+# problem + lowering (the FAQ cost-model sense of "work"), so drift in
+# them is a real regression and fails HARD.  Wall-clock only warns
+# (wall_ok) under a generous ratio bound.
+
+import importlib.util
+import os
+
+_GUARD_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools",
+    "perf_guard.py",
+)
+
+
+def _load_perf_guard():
+    spec = importlib.util.spec_from_file_location(
+        "perf_guard", _GUARD_PATH
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def perf_guard_mod():
+    return _load_perf_guard()
+
+
+class TestPerfGuardWorkCounters:
+    def test_clean_run_matches_recorded_budgets(self, perf_guard_mod):
+        """The blessed workload must reproduce its recorded counters
+        exactly, and on a sane machine stay inside the loose
+        wall-clock bound — see tools/perf_guard.py:run_perf_guard."""
+        report = perf_guard_mod.run_perf_guard()
+        assert report["ok"], report["error"]
+        assert report["util_cells"] == perf_guard_mod.UTIL_CELLS_BUDGET
+        assert (
+            report["util_dispatches"]
+            == perf_guard_mod.UTIL_DISPATCHES_BUDGET
+        )
+        assert (
+            report["bnb_pruned_cells"]
+            == perf_guard_mod.BNB_PRUNED_CELLS_BUDGET
+        )
+        assert report["jit_compiles"] <= perf_guard_mod.COMPILE_BUDGET
+        # wall-clock warns rather than fails, but if the loose bound
+        # trips the report must SAY so instead of hiding it
+        if not report["wall_ok"]:
+            assert "wall_warning" in report
+
+    def test_forced_extra_dispatches_fail_deterministically(
+        self, perf_guard_mod
+    ):
+        """util_batch='node' de-batches the level sweep: the guard
+        must fail on the dispatch counter, not on wall-clock."""
+        report = perf_guard_mod.run_perf_guard(
+            util_batch="node", wall_reps=1
+        )
+        assert not report["ok"]
+        assert "util_dispatches" in report["error"]
+        assert (
+            report["util_dispatches"]
+            != perf_guard_mod.UTIL_DISPATCHES_BUDGET
+        )
+
+    def test_disabled_bnb_fails_on_pruned_cells(self, perf_guard_mod):
+        """bnb='off' kills pruning: the pruned-cell counter reads 0
+        and the guard must fail on it."""
+        report = perf_guard_mod.run_perf_guard(bnb="off", wall_reps=1)
+        assert not report["ok"]
+        assert "bnb_pruned_cells" in report["error"]
+        assert report["bnb_pruned_cells"] == 0
+
+    def test_work_counters_are_deterministic(self, perf_guard_mod):
+        """Two clean runs agree bit-for-bit on every work counter —
+        the property that makes a hard gate on them sound."""
+        a = perf_guard_mod.run_perf_guard(wall_reps=1)
+        b = perf_guard_mod.run_perf_guard(wall_reps=1)
+        for key in (
+            "util_cells",
+            "util_dispatches",
+            "bnb_pruned_cells",
+            "best_cost",
+        ):
+            assert a[key] == b[key], key

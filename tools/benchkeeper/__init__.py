@@ -1,0 +1,30 @@
+"""benchkeeper — the performance observatory for the bench trajectory.
+
+A jax-free-at-import toolkit that gives the repo's performance
+*trajectory* the same bounded/deterministic/machine-checked discipline
+graftlint gave the invariants and the recompile guard gave compile
+counts:
+
+- ``ledger``  — normalized append-only ``benchdata/ledger.jsonl`` rows
+  extracted from ``BENCH_r*.json`` and ``BENCH_TPU_LOG.jsonl``, each
+  carrying an environment fingerprint so tooling *refuses*
+  cross-environment absolute comparisons instead of silently making
+  them.
+- ``stats``   — deterministic comparator over paired interleaved
+  samples (sign test + seeded-bootstrap CI on paired ratios) emitting
+  ``regression | improvement | noise`` verdicts.
+- ``abtest``  — the ONE interleave/pair/median measurement harness all
+  bench.py stages share; records the raw pairs the comparator needs,
+  not just medians.
+- ``history`` — sparkline trends, ratio-chain normalization across
+  fingerprint segments, stale-row flagging per backend.
+
+The package lives in graftlint's seeded-purity scopes: no wall-clock
+reads, no unseeded randomness — callers inject ``now``/timestamps and
+seeds explicitly, which is what makes the verdicts bit-identical
+across runs.
+"""
+
+from __future__ import annotations
+
+__all__ = ["abtest", "history", "ledger", "stats"]

@@ -1,0 +1,127 @@
+"""The ONE interleave/pair/median measurement harness.
+
+Every A/B stage in ``bench.py`` (bnb on/off, solver_service seq/burst,
+membound unbounded/budget, obs_overhead on/off, supervised_overhead
+sup/bare) used to carry its own copy of the same loop: run each arm
+once per rep, back-to-back, so both arms see the same
+thermal/scheduler weather, then report the per-arm median.  They all
+run through :func:`interleave` now — and the harness keeps the *raw
+paired samples*, which is what :mod:`benchkeeper.stats` needs to emit
+a statistical verdict and what the evidence rows need to stop
+reporting bare medians with no dispersion.
+
+The harness does no timing and no clock reads itself (it lives in the
+seeded-purity scope): each arm is a zero-arg callable returning the
+measured float (a rate, an elapsed time — the harness doesn't care),
+doing its own ``perf_counter`` bracketing and stashing any side
+payload in a closure, exactly as the stages always did.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence, Tuple
+
+from . import stats
+
+Arm = Tuple[str, Callable[[], float]]
+
+
+class ABSamples:
+    """Raw interleaved samples for a set of arms, in rep order.
+
+    Per-arm lists are index-aligned: ``values(a)[i]`` and
+    ``values(b)[i]`` were measured inside the same rep, so they form a
+    valid pair regardless of the within-rep arm order.
+    """
+
+    def __init__(self, arm_names: Sequence[str]):
+        if len(set(arm_names)) != len(arm_names):
+            raise ValueError(f"duplicate arm names: {list(arm_names)}")
+        self.arm_names: Tuple[str, ...] = tuple(arm_names)
+        self._samples: Dict[str, List[float]] = {n: [] for n in arm_names}
+
+    def add(self, name: str, value: float) -> None:
+        self._samples[name].append(float(value))
+
+    def values(self, name: str) -> List[float]:
+        return list(self._samples[name])
+
+    @property
+    def n_reps(self) -> int:
+        return min(len(v) for v in self._samples.values()) if self._samples else 0
+
+    def median(self, name: str) -> float:
+        return stats.median(self._samples[name])
+
+    def ratio(self, num: str, den: str) -> float:
+        """Ratio of per-arm medians, ``median(num) / median(den)``."""
+        return self.median(num) / self.median(den)
+
+    def pairs(self, a: str, b: str) -> List[Tuple[float, float]]:
+        return list(zip(self._samples[a], self._samples[b]))
+
+    def pair_ratios(self, num: str, den: str) -> List[float]:
+        """Per-rep ratios ``num_i / den_i`` — the comparator's input."""
+        return [n / d for n, d in zip(self._samples[num], self._samples[den])]
+
+    def median_pair_ratio(self, num: str, den: str) -> float:
+        """Median of the per-rep ratios (not the ratio of medians)."""
+        return stats.median(self.pair_ratios(num, den))
+
+    def record(self, name: str) -> Dict[str, object]:
+        """Evidence-row block for one arm: count, spread, raw samples.
+
+        This is the satellite fix for "medians with no dispersion": a
+        2-rep row now visibly says ``n=2`` and carries its min/max.
+        """
+        vals = self._samples[name]
+        if not vals:
+            raise ValueError(f"arm {name!r} has no samples")
+        return {
+            "n": len(vals),
+            "min": min(vals),
+            "max": max(vals),
+            "median": stats.median(vals),
+            "values": list(vals),
+        }
+
+    def records(self) -> Dict[str, Dict[str, object]]:
+        return {name: self.record(name) for name in self.arm_names}
+
+    def compare(self, baseline: str, candidate: str, **kwargs) -> Dict[str, object]:
+        """Run the documented decision rule over this harness's pairs."""
+        return stats.compare(
+            self._samples[baseline], self._samples[candidate], **kwargs
+        )
+
+
+def interleave(
+    arms: Sequence[Arm],
+    reps: int,
+    *,
+    alternate: bool = False,
+    warmup: bool = False,
+) -> ABSamples:
+    """Run each arm once per rep, interleaved, and collect raw samples.
+
+    ``arms`` is an ordered sequence of ``(name, thunk)`` pairs; each
+    thunk returns the measured float for one execution.  With
+    ``alternate=True`` the within-rep arm order flips on odd reps (the
+    obs_overhead pattern, cancelling order-dependent drift); pairing is
+    by rep index either way.  ``warmup=True`` runs every arm once in
+    order first and discards the results.
+    """
+    if reps < 1:
+        raise ValueError(f"reps must be >= 1, got {reps}")
+    names = [n for n, _ in arms]
+    out = ABSamples(names)
+    if warmup:
+        for _, thunk in arms:
+            thunk()
+    for rep in range(reps):
+        order = list(arms)
+        if alternate and rep % 2 == 1:
+            order.reverse()
+        for name, thunk in order:
+            out.add(name, thunk())
+    return out

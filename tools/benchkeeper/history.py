@@ -1,0 +1,401 @@
+"""Trajectory rendering over the ledger: sparklines, ratio chains, staleness.
+
+Absolute values in the ledger are only comparable within one
+environment fingerprint (see :mod:`benchkeeper.ledger`).  To still
+draw one trend line across an environment change, the renderer uses
+*ratio-chain normalization*: rows are split into segments of identical
+comparability key, and each new segment is rescaled so its first value
+continues the previous segment's normalized trend.  The chained curve
+preserves within-segment ratios exactly and is explicitly trend-only —
+the absolute axis is meaningless whenever more than one segment
+contributed, and the output says so.
+
+Staleness: per backend, the newest row's age is compared against a
+configurable bound (default 72h).  The TPU north-star row going stale
+silently was tribal knowledge; now it's a printed warning.
+
+No wall-clock reads here (seeded-purity scope): ``now_epoch`` is
+always injected by the caller.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import ledger, stats
+
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+DEFAULT_STALE_HOURS = 72.0
+
+
+def seg_key(fingerprint: Dict[str, object]) -> Tuple[object, ...]:
+    """Comparability key — rows sharing it may be compared absolutely."""
+    return tuple(fingerprint.get(f) for f in ledger.COMPARABILITY_FIELDS)
+
+
+def sparkline(values: Sequence[float]) -> str:
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return SPARK_BLOCKS[3] * len(values)
+    out = []
+    for v in values:
+        idx = int((v - lo) / (hi - lo) * (len(SPARK_BLOCKS) - 1))
+        out.append(SPARK_BLOCKS[max(0, min(len(SPARK_BLOCKS) - 1, idx))])
+    return "".join(out)
+
+
+def chain_normalize(
+    values: Sequence[float], keys: Sequence[Tuple[object, ...]]
+) -> Tuple[List[float], int]:
+    """(normalized values, number of environment segments).
+
+    Within a segment values pass through scaled by the segment's chain
+    factor; at a segment boundary the factor is re-derived so the new
+    segment's first value lands exactly on the previous normalized
+    value — the trend continues, absolute meaning does not.
+    """
+    norm: List[float] = []
+    n_segments = 0
+    scale = 1.0
+    prev_key: Optional[Tuple[object, ...]] = None
+    for v, key in zip(values, keys):
+        if prev_key is None or key != prev_key:
+            n_segments += 1
+            if norm and v:
+                scale = norm[-1] / v
+        norm.append(v * scale)
+        prev_key = key
+    return norm, n_segments
+
+
+def series(rows: Sequence[Dict[str, object]]) -> Dict[Tuple[str, str], List[Dict[str, object]]]:
+    """Rows grouped by (stage, metric), each group sorted by timestamp."""
+    out: Dict[Tuple[str, str], List[Dict[str, object]]] = {}
+    for row in rows:
+        out.setdefault(ledger.row_key(row), []).append(row)
+    for group in out.values():
+        group.sort(key=lambda r: ledger.parse_ts(str(r["ts"])))
+    return out
+
+
+def point_label(row: Dict[str, object]) -> str:
+    rnd = row.get("round")
+    if rnd:
+        return str(rnd)
+    return str(row.get("ts"))[:10]
+
+
+def fmt_value(v: float) -> str:
+    a = abs(v)
+    if a >= 1e6:
+        return f"{v / 1e6:.3g}M"
+    if a >= 1e4:
+        return f"{v / 1e3:.3g}k"
+    return f"{v:.4g}"
+
+
+def stale_backends(
+    rows: Sequence[Dict[str, object]],
+    *,
+    now_epoch: float,
+    stale_hours: float = DEFAULT_STALE_HOURS,
+) -> List[Dict[str, object]]:
+    """Per-backend freshness, stalest first.  ``stale`` is True when
+    the backend's NEWEST row is older than the bound."""
+    newest: Dict[str, Dict[str, object]] = {}
+    for row in rows:
+        fp = row.get("fingerprint") or {}
+        backend = fp.get("backend") if isinstance(fp, dict) else None
+        if not backend:
+            continue  # a backend we can't name can't be refreshed
+        backend = str(backend)
+        try:
+            ts = ledger.parse_ts(str(row["ts"]))
+        except (KeyError, ValueError):
+            continue
+        cur = newest.get(backend)
+        if cur is None or ts > cur["epoch"]:
+            newest[backend] = {"epoch": ts, "row": row}
+    report = []
+    for backend, info in newest.items():
+        age_h = (now_epoch - float(info["epoch"])) / 3600.0
+        row = info["row"]
+        report.append({
+            "backend": backend,
+            "age_hours": round(age_h, 1),
+            "stale": age_h > stale_hours,
+            "stage": row.get("stage"),
+            "metric": row.get("metric"),
+            "ts": row.get("ts"),
+            "sha": (row.get("fingerprint") or {}).get("sha"),
+        })
+    report.sort(key=lambda r: -float(r["age_hours"]))
+    return report
+
+
+def rounds_summary(rows: Sequence[Dict[str, object]]) -> List[Dict[str, object]]:
+    """One entry per recorded bench round, from the status rows."""
+    out = []
+    for row in rows:
+        if row.get("stage") == "bench_round" and row.get("metric") == "rc":
+            extra = row.get("extra") or {}
+            out.append({
+                "round": row.get("round"),
+                "ts": row.get("ts"),
+                "rc": int(float(row.get("value", 0))),
+                "parsed": bool(extra.get("parsed")),
+            })
+    out.sort(key=lambda r: str(r["round"]))
+    return out
+
+
+def _series_line(key: Tuple[str, str], group: List[Dict[str, object]]) -> str:
+    values = [float(r["value"]) for r in group]
+    keys = [seg_key(r.get("fingerprint") or {}) for r in group]
+    norm, n_seg = chain_normalize(values, keys)
+    unit = str(group[-1].get("unit", ""))
+    backend = (group[-1].get("fingerprint") or {}).get("backend") or "?"
+    latest = group[-1]
+    name = f"{key[0]}/{key[1]}"
+    chain_note = f" ({n_seg} envs, chained)" if n_seg > 1 else ""
+    return (
+        f"  {name:<42} [{unit}] {backend:<4} {sparkline(norm):<12} "
+        f"n={len(values):<2} latest {fmt_value(values[-1])} "
+        f"@ {point_label(latest)}{chain_note}"
+    )
+
+
+def _series_detail(key: Tuple[str, str], group: List[Dict[str, object]]) -> List[str]:
+    lines = [_series_line(key, group)]
+    for row in group:
+        disp = row.get("dispersion")
+        disp_note = ""
+        if isinstance(disp, dict):
+            arms = ", ".join(
+                f"{arm}: n={rec.get('n')}" for arm, rec in sorted(disp.items())
+                if isinstance(rec, dict)
+            )
+            if arms:
+                disp_note = f"  [{arms}]"
+        lines.append(
+            f"      {point_label(row):<12} {fmt_value(float(row['value'])):>10} "
+            f"{row.get('ts')}{disp_note}"
+        )
+    return lines
+
+
+def history_report(
+    rows: Sequence[Dict[str, object]],
+    *,
+    now_epoch: float,
+    stale_hours: float = DEFAULT_STALE_HOURS,
+    stage: Optional[str] = None,
+) -> str:
+    """Human-readable trajectory report over ledger rows."""
+    lines: List[str] = []
+    backends = sorted({
+        str((r.get("fingerprint") or {}).get("backend") or "unknown")
+        for r in rows
+    })
+    rounds = rounds_summary(rows)
+    lines.append(
+        f"bench history — {len(rows)} rows, {len(rounds)} rounds, "
+        f"backends: {', '.join(backends)}"
+    )
+    if rounds:
+        lines.append("rounds: " + "  ".join(
+            f"{r['round']} {'ok' if r['parsed'] else 'FAIL' if r['rc'] else 'empty'}"
+            for r in rounds
+        ))
+    lines.append("")
+    grouped = series(rows)
+    shown = 0
+    for key in sorted(grouped):
+        if key == ("bench_round", "rc"):
+            continue
+        if stage is not None and key[0] != stage:
+            continue
+        group = grouped[key]
+        if stage is not None:
+            lines.extend(_series_detail(key, group))
+        else:
+            lines.append(_series_line(key, group))
+        shown += 1
+    if not shown:
+        lines.append("  (no matching series)")
+    lines.append("")
+    freshness = stale_backends(rows, now_epoch=now_epoch, stale_hours=stale_hours)
+    stale = [f for f in freshness if f["stale"]]
+    if stale:
+        lines.append(f"STALE backends (newest row older than {stale_hours:g}h):")
+        for f in stale:
+            lines.append(
+                f"  {f['backend']}: {f['age_hours']}h old — newest is "
+                f"{f['stage']}/{f['metric']} @ {f['ts']}"
+                + (f" (sha {f['sha']})" if f.get("sha") else "")
+            )
+    for f in freshness:
+        if not f["stale"]:
+            lines.append(f"fresh: {f['backend']} ({f['age_hours']}h)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# round-vs-round comparison (point ratios, fingerprint-guarded)
+# ---------------------------------------------------------------------------
+
+def compare_rounds(
+    rows: Sequence[Dict[str, object]],
+    baseline_round: str,
+    candidate_round: str,
+    *,
+    stage: Optional[str] = None,
+    metric: Optional[str] = None,
+) -> Dict[str, object]:
+    """Point ratios between two recorded rounds, refusing on mismatch.
+
+    Cross-round samples were never interleaved, so NO statistical
+    verdict is emitted here (``verdict`` is always None) — the output
+    is a fingerprint-checked point ratio per metric plus an explicit
+    note.  Paired-sample verdicts come from ``bench-compare --pairs``.
+    """
+    by_round: Dict[str, Dict[Tuple[str, str], Dict[str, object]]] = {}
+    for row in rows:
+        rnd = row.get("round")
+        if rnd in (baseline_round, candidate_round):
+            # newest row wins if a round somehow recorded a key twice
+            by_round.setdefault(str(rnd), {})[ledger.row_key(row)] = row
+    base = by_round.get(baseline_round, {})
+    cand = by_round.get(candidate_round, {})
+    entries: List[Dict[str, object]] = []
+    for key in sorted(set(base) & set(cand)):
+        if key == ("bench_round", "rc"):
+            continue
+        if stage is not None and key[0] != stage:
+            continue
+        if metric is not None and key[1] != metric:
+            continue
+        b, c = base[key], cand[key]
+        entry: Dict[str, object] = {
+            "stage": key[0],
+            "metric": key[1],
+            "unit": b.get("unit"),
+            "higher_is_better": b.get("higher_is_better"),
+        }
+        reason = ledger.refusal_reason(
+            b.get("fingerprint") or {}, c.get("fingerprint") or {}
+        )
+        if reason is not None:
+            entry["refused"] = reason
+        else:
+            bv, cv = float(b["value"]), float(c["value"])
+            entry["baseline"] = bv
+            entry["candidate"] = cv
+            # a ratio only means anything when both sides are positive
+            # (overhead pcts can legitimately cross zero)
+            entry["ratio"] = (cv / bv) if (bv > 0 and cv > 0) else None
+            _, _, unknown = ledger.comparability(
+                b.get("fingerprint") or {}, c.get("fingerprint") or {}
+            )
+            if unknown:
+                entry["unverified_fields"] = unknown
+        entries.append(entry)
+    return {
+        "baseline_round": baseline_round,
+        "candidate_round": candidate_round,
+        "entries": entries,
+        "verdict": None,
+        "note": (
+            "cross-round samples are not interleaved; point ratios only — "
+            "statistical verdicts require paired samples (--pairs)"
+        ),
+    }
+
+
+def format_compare_rounds(result: Dict[str, object]) -> str:
+    lines = [
+        f"bench compare — {result['baseline_round']} -> "
+        f"{result['candidate_round']}  (point ratios, no verdict)"
+    ]
+    entries = result.get("entries") or []
+    if not entries:
+        lines.append("  (no shared metrics between these rounds)")
+    for e in entries:
+        name = f"{e['stage']}/{e['metric']}"
+        if "refused" in e:
+            lines.append(f"  {name:<42} REFUSED: {e['refused']}")
+            continue
+        ratio = e.get("ratio")
+        if isinstance(ratio, float) and ratio != 1.0:
+            good = (ratio > 1.0) == bool(e.get("higher_is_better"))
+            arrow = "+" if good else "-"
+            ratio_s = f"x{ratio:.3f} {arrow}"
+        elif isinstance(ratio, float):
+            ratio_s = "x1.000 ="
+        else:
+            ratio_s = "(no ratio)"
+        weak = ""
+        if e.get("unverified_fields"):
+            weak = f"  (unverified: {', '.join(e['unverified_fields'])})"
+        lines.append(
+            f"  {name:<42} {fmt_value(float(e['baseline'])):>10} -> "
+            f"{fmt_value(float(e['candidate'])):>10}  {ratio_s}{weak}"
+        )
+    lines.append(f"note: {result['note']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# paired-sample comparison (full statistical verdict)
+# ---------------------------------------------------------------------------
+
+def compare_pairs_doc(doc: Dict[str, object], **kwargs) -> Dict[str, object]:
+    """Verdict for a ``{"baseline": [...], "candidate": [...]}`` doc.
+
+    Optional doc keys: ``higher_is_better`` (default True), ``name``.
+    Keyword args pass through to :func:`benchkeeper.stats.compare`
+    (seed, alpha, noise_floor, ...) — doc values win for
+    ``higher_is_better``.
+    """
+    baseline = doc.get("baseline")
+    candidate = doc.get("candidate")
+    if not isinstance(baseline, list) or not isinstance(candidate, list):
+        raise ValueError(
+            "pairs doc must contain 'baseline' and 'candidate' lists"
+        )
+    if "higher_is_better" in doc:
+        kwargs["higher_is_better"] = bool(doc["higher_is_better"])
+    result = stats.compare(baseline, candidate, **kwargs)
+    if "name" in doc:
+        result["name"] = doc["name"]
+    return result
+
+
+def format_verdict(result: Dict[str, object]) -> str:
+    name = result.get("name")
+    lo, hi = result["ci"]
+    lines = []
+    if name:
+        lines.append(f"comparison: {name}")
+    lines.append(f"verdict: {result['verdict'].upper()}")
+    lines.append(
+        f"  pairs: {result['n_pairs']}  median ratio: "
+        f"{result['median_ratio']:.4f}  range: "
+        f"[{result['min_ratio']:.4f}, {result['max_ratio']:.4f}]"
+    )
+    lines.append(
+        f"  sign test: {result['n_above']} above / {result['n_below']} below, "
+        f"p={result['p_sign']:.4g} (alpha={result['alpha']:g})"
+    )
+    lines.append(
+        f"  bootstrap CI ({result['conf']:.0%}, seed={result['seed']}, "
+        f"n_boot={result['n_boot']}): [{lo:.4f}, {hi:.4f}]"
+        f"{' — excludes 1.0' if result['ci_excludes_one'] else ' — includes 1.0'}"
+    )
+    lines.append(
+        f"  noise floor: {result['noise_floor']:g}  direction: "
+        f"{'higher' if result['higher_is_better'] else 'lower'} is better"
+    )
+    return "\n".join(lines)

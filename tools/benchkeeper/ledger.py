@@ -1,0 +1,508 @@
+"""Normalized append-only performance ledger (``benchdata/ledger.jsonl``).
+
+Nine ad-hoc ``BENCH_r*.json`` snapshots plus ``BENCH_TPU_LOG.jsonl``
+are the repo's entire performance history, readable only by a human
+who knows the per-round schema drift.  The ledger normalizes all of it
+into one row shape — one (stage, metric) measurement per line — and
+stamps every row with an *environment fingerprint* so tooling can
+refuse cross-environment absolute comparisons instead of silently
+making them (the ~2x CPU swing and the CPU/TPU split both live here).
+
+Row schema (one JSON object per line)::
+
+    {
+      "schema": 1,
+      "ts": "2026-08-05T12:00:00Z",     # UTC, second resolution
+      "round": "r09" | null,            # bench round, if from one
+      "source": "bench_r09" | "tpu_log" | "bench_run",
+      "stage": "bnb",                   # bench stage / workload name
+      "metric": "speedup_on_vs_off",
+      "value": 4.85,
+      "unit": "ratio",
+      "higher_is_better": true,
+      "fingerprint": {                  # null field = unknown
+        "backend": "cpu", "device_kind": null, "vcpus": 2,
+        "loadavg_1m": 0.41, "python": "3.11.9", "jax": "0.4.37",
+        "sha": "0d4457f"
+      },
+      "dispersion": {"n": 3, "min": ..., "max": ...},   # optional
+      "extra": {...}                                    # optional
+    }
+
+Comparability is decided on (backend, device_kind, vcpus, python,
+jax); ``loadavg_1m`` and ``sha`` are context only.  A ``null``
+fingerprint field means *unknown* (historic rows predate the
+fingerprint) — unknown fields weaken a match but only a *known
+mismatch* triggers refusal.
+
+This module is jax-free at import and lives in the seeded-purity
+scope: no wall-clock reads — callers pass timestamps in (historic rows
+take theirs from ``git log`` on the source file).
+"""
+
+from __future__ import annotations
+
+import calendar
+import json
+import os
+import platform as _platform
+import subprocess
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SCHEMA_VERSION = 1
+TS_FMT = "%Y-%m-%dT%H:%M:%SZ"
+LEDGER_RELPATH = os.path.join("benchdata", "ledger.jsonl")
+
+#: Fields that decide whether two rows' absolute values are comparable.
+COMPARABILITY_FIELDS = ("backend", "device_kind", "vcpus", "python", "jax")
+#: Context-only fingerprint fields (recorded, never compared).
+CONTEXT_FIELDS = ("loadavg_1m", "sha")
+
+FINGERPRINT_FIELDS = COMPARABILITY_FIELDS + CONTEXT_FIELDS
+
+
+# ---------------------------------------------------------------------------
+# timestamps
+# ---------------------------------------------------------------------------
+
+def format_ts(epoch: float) -> str:
+    """Epoch seconds -> canonical UTC ledger timestamp."""
+    return time.strftime(TS_FMT, time.gmtime(epoch))
+
+
+def parse_ts(ts: str) -> float:
+    """Canonical or ISO-8601-with-offset timestamp -> epoch seconds."""
+    ts = ts.strip()
+    try:
+        return float(calendar.timegm(time.strptime(ts, TS_FMT)))
+    except ValueError:
+        pass
+    # git %cI form: 2026-08-05T12:00:00+02:00
+    base, offset = ts[:-6], ts[-6:]
+    if len(offset) == 6 and offset[0] in "+-" and offset[3] == ":":
+        epoch = calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S"))
+        sign = 1 if offset[0] == "+" else -1
+        shift = sign * (int(offset[1:3]) * 3600 + int(offset[4:6]))
+        return float(epoch - shift)
+    raise ValueError(f"unparseable timestamp: {ts!r}")
+
+
+# ---------------------------------------------------------------------------
+# environment fingerprint
+# ---------------------------------------------------------------------------
+
+def git_sha(root: str) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+        return out or None
+    except Exception:
+        return None
+
+
+def package_version(name: str) -> Optional[str]:
+    """Installed-package version without importing the package (keeps
+    this module jax-free at import AND at call time)."""
+    try:
+        from importlib import metadata
+        return metadata.version(name)
+    except Exception:
+        return None
+
+
+def environment_fingerprint(
+    *,
+    backend: Optional[str] = None,
+    device_kind: Optional[str] = None,
+    sha: Optional[str] = None,
+    root: Optional[str] = None,
+) -> Dict[str, object]:
+    """Fingerprint of the *current* environment.
+
+    ``backend``/``device_kind`` are caller-supplied (only the caller
+    knows what it measured on — reading jax here would drag it into
+    the import surface).  Every other field is collected locally.
+    """
+    try:
+        load1 = round(os.getloadavg()[0], 2)
+    except (AttributeError, OSError):
+        load1 = None
+    return {
+        "backend": backend,
+        "device_kind": device_kind,
+        "vcpus": os.cpu_count(),
+        "loadavg_1m": load1,
+        "python": _platform.python_version(),
+        "jax": package_version("jax"),
+        "sha": sha if sha is not None else (git_sha(root) if root else None),
+    }
+
+
+def null_fingerprint(**known: object) -> Dict[str, object]:
+    """All-unknown fingerprint with any explicitly known fields set."""
+    fp: Dict[str, object] = {k: None for k in FINGERPRINT_FIELDS}
+    for k, v in known.items():
+        if k not in FINGERPRINT_FIELDS:
+            raise KeyError(f"unknown fingerprint field {k!r}")
+        fp[k] = v
+    return fp
+
+
+def comparability(
+    a: Dict[str, object], b: Dict[str, object]
+) -> Tuple[bool, List[str], List[str]]:
+    """(comparable, mismatched_fields, unknown_fields).
+
+    A field mismatches only when BOTH sides know it and the values
+    differ; a side not knowing it lands the field in ``unknown`` (the
+    match is weaker, but not refused — historic rows would otherwise
+    never be comparable to anything).
+    """
+    mismatched, unknown = [], []
+    for field in COMPARABILITY_FIELDS:
+        va, vb = a.get(field), b.get(field)
+        if va is None or vb is None:
+            unknown.append(field)
+        elif va != vb:
+            mismatched.append(field)
+    return (not mismatched, mismatched, unknown)
+
+
+def refusal_reason(a: Dict[str, object], b: Dict[str, object]) -> Optional[str]:
+    """Human-readable refusal, or None when the environments match."""
+    ok, mismatched, _ = comparability(a, b)
+    if ok:
+        return None
+    parts = [
+        f"{f}: {a.get(f)!r} vs {b.get(f)!r}" for f in mismatched
+    ]
+    return (
+        "environment fingerprints differ ("
+        + "; ".join(parts)
+        + ") — absolute values are not comparable across environments; "
+        "use ratio-chain trends (bench-history) instead"
+    )
+
+
+# ---------------------------------------------------------------------------
+# rows
+# ---------------------------------------------------------------------------
+
+def make_row(
+    *,
+    ts: str,
+    source: str,
+    stage: str,
+    metric: str,
+    value: float,
+    unit: str,
+    higher_is_better: bool,
+    fingerprint: Dict[str, object],
+    round_name: Optional[str] = None,
+    dispersion: Optional[Dict[str, object]] = None,
+    extra: Optional[Dict[str, object]] = None,
+) -> Dict[str, object]:
+    parse_ts(ts)  # validate early; raises on garbage
+    row: Dict[str, object] = {
+        "schema": SCHEMA_VERSION,
+        "ts": ts,
+        "round": round_name,
+        "source": source,
+        "stage": stage,
+        "metric": metric,
+        "value": float(value),
+        "unit": unit,
+        "higher_is_better": bool(higher_is_better),
+        "fingerprint": {
+            k: fingerprint.get(k) for k in FINGERPRINT_FIELDS
+        },
+    }
+    if dispersion:
+        row["dispersion"] = dispersion
+    if extra:
+        row["extra"] = extra
+    return row
+
+
+def row_key(row: Dict[str, object]) -> Tuple[str, str]:
+    return (str(row.get("stage")), str(row.get("metric")))
+
+
+def read_ledger(path: str) -> List[Dict[str, object]]:
+    """All parseable rows, file order (which is append order)."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return []
+    rows = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "stage" in obj and "metric" in obj:
+            rows.append(obj)
+    return rows
+
+
+def append_rows(path: str, rows: Iterable[Dict[str, object]]) -> int:
+    n = 0
+    with open(path, "a") as f:
+        for row in rows:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+            n += 1
+    return n
+
+
+def write_ledger(path: str, rows: Iterable[Dict[str, object]]) -> int:
+    """Full rewrite (rebuild path); append_rows is the normal path."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        n = 0
+        for row in rows:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# extraction from BENCH_r*.json parsed docs
+# ---------------------------------------------------------------------------
+
+#: (stage, metric, unit, higher_is_better, path-into-parsed)
+_METRIC_SPECS: Tuple[Tuple[str, str, str, bool, Tuple[str, ...]], ...] = (
+    ("north_star", "msgs_per_sec", "msgs/s", True, ("value",)),
+    ("north_star", "vs_baseline", "ratio", True, ("vs_baseline",)),
+    ("cpu_baseline", "msgs_per_sec", "msgs/s", True,
+     ("cpu_baseline_msgs_per_sec",)),
+    ("host_runtime", "msgs_per_sec", "msgs/s", True,
+     ("host_runtime_msgs_per_sec",)),
+    ("jit", "compiles", "count", False, ("jit_compiles",)),
+    ("multi_instance", "speedup_k32", "ratio", True,
+     ("multi_instance", "ks", "32", "speedup")),
+    ("dpop_secp", "util_cells_per_sec", "cells/s", True,
+     ("dpop_secp", "level_batched", "util_cells_per_sec")),
+    ("dpop_secp", "speedup_level_vs_node", "ratio", True,
+     ("dpop_secp", "speedup_level_vs_node")),
+    ("solver_service", "throughput_ratio", "ratio", True,
+     ("solver_service", "throughput_ratio")),
+    ("solver_service", "requests_per_sec", "req/s", True,
+     ("solver_service", "requests_per_sec_service")),
+    ("solver_service", "latency_p99_s", "s", False,
+     ("solver_service", "latency_s", "p99")),
+    ("semiring_infer", "log_z_cells_per_sec", "cells/s", True,
+     ("semiring_infer", "tree", "queries", "log_z", "cells_per_sec")),
+    ("semiring_infer", "marginals_cells_per_sec", "cells/s", True,
+     ("semiring_infer", "tree", "queries", "marginals", "cells_per_sec")),
+    ("semiring_infer", "map_cells_per_sec", "cells/s", True,
+     ("semiring_infer", "tree", "queries", "map", "cells_per_sec")),
+    ("semiring_queries", "kbest5_cells_per_sec", "cells/s", True,
+     ("semiring_queries", "queries", "kbest:5", "cells_per_sec")),
+    ("semiring_queries", "expectation_cells_per_sec", "cells/s", True,
+     ("semiring_queries", "queries", "expectation", "cells_per_sec")),
+    ("membound", "util_cells_per_sec", "cells/s", True,
+     ("membound", "util_cells_per_sec")),
+    ("bnb", "speedup_on_vs_off", "ratio", True,
+     ("bnb", "speedup_on_vs_off")),
+    ("bnb", "util_cells_per_sec_on", "cells/s", True,
+     ("bnb", "util_cells_per_sec_on")),
+    ("bnb", "pruned_fraction", "fraction", True,
+     ("bnb", "pruned_fraction")),
+    ("obs_overhead", "overhead_pct", "pct", False,
+     ("obs_overhead", "overhead_pct")),
+    ("supervised_overhead", "maxsum_overhead_pct", "pct", False,
+     ("supervised_overhead", "algos", "maxsum", "overhead_pct")),
+    ("supervised_overhead", "dsa_overhead_pct", "pct", False,
+     ("supervised_overhead", "algos", "dsa", "overhead_pct")),
+)
+
+
+def metric_specs() -> Tuple[Tuple[str, str, str, bool, Tuple[str, ...]], ...]:
+    return _METRIC_SPECS
+
+
+def _dig(doc: object, path: Sequence[str]) -> object:
+    cur = doc
+    for key in path:
+        if not isinstance(cur, dict) or key not in cur:
+            return None
+        cur = cur[key]
+    return cur
+
+
+def _stage_platform(parsed: Dict[str, object], path: Sequence[str]) -> object:
+    """Per-stage platform when the stage dict records one, else the
+    headline backend — a mixed-backend round must not collapse."""
+    if len(path) > 1:
+        stage_doc = parsed.get(path[0])
+        if isinstance(stage_doc, dict) and stage_doc.get("platform"):
+            return stage_doc.get("platform")
+    return parsed.get("backend")
+
+
+def extract_bench_rows(
+    parsed: Dict[str, object],
+    *,
+    ts: str,
+    source: str,
+    round_name: Optional[str],
+    fingerprint: Dict[str, object],
+) -> List[Dict[str, object]]:
+    """Ledger rows for every metric present in one bench output doc.
+
+    Extraction is defensive: a spec whose path is absent (older
+    rounds predate later stages) or non-numeric is skipped, never an
+    error — that's what lets r01 (empty parse) through r09 share one
+    extractor.
+    """
+    rows = []
+    for stage, metric, unit, hib, path in _METRIC_SPECS:
+        value = _dig(parsed, path)
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        fp = dict(fingerprint)
+        fp["backend"] = _stage_platform(parsed, path) or fp.get("backend")
+        dispersion = None
+        parent = _dig(parsed, path[:-1]) if len(path) > 1 else parsed
+        if isinstance(parent, dict):
+            samples = parent.get("samples")
+            if isinstance(samples, dict):
+                dispersion = {
+                    arm: {
+                        k: rec.get(k) for k in ("n", "min", "max", "median")
+                    }
+                    for arm, rec in sorted(samples.items())
+                    if isinstance(rec, dict)
+                }
+        rows.append(make_row(
+            ts=ts, source=source, stage=stage, metric=metric,
+            value=float(value), unit=unit, higher_is_better=hib,
+            fingerprint=fp, round_name=round_name, dispersion=dispersion,
+        ))
+    return rows
+
+
+def extract_tpu_log_rows(entries: Iterable[Dict[str, object]]) -> List[Dict[str, object]]:
+    """Ledger rows from BENCH_TPU_LOG.jsonl entries (backend=tpu by
+    construction — ``log_if_tpu`` guards the append).  Entries without
+    a positive throughput (DPOP UTIL-seconds configs) are skipped,
+    matching ``last_good_tpu``'s notion of good evidence."""
+    rows = []
+    for e in entries:
+        if not isinstance(e, dict):
+            continue
+        msgs = e.get("msgs_per_sec")
+        ts = e.get("ts")
+        workload = e.get("workload")
+        if not (isinstance(msgs, (int, float)) and msgs > 0):
+            continue
+        if not isinstance(ts, str) or not isinstance(workload, str):
+            continue
+        try:
+            parse_ts(ts)
+        except ValueError:
+            continue
+        fp = e.get("fingerprint")
+        if not isinstance(fp, dict):
+            fp = null_fingerprint(backend="tpu", sha=e.get("sha"))
+        extra = {
+            k: v for k, v in sorted(e.items())
+            if k not in ("ts", "sha", "workload", "msgs_per_sec", "fingerprint")
+            and isinstance(v, (int, float, str, bool))
+        }
+        rows.append(make_row(
+            ts=ts, source="tpu_log", stage=workload, metric="msgs_per_sec",
+            value=float(msgs), unit="msgs/s", higher_is_better=True,
+            fingerprint=fp, round_name=None, extra=extra or None,
+        ))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# seeding from the historic artifacts
+# ---------------------------------------------------------------------------
+
+def _file_ts(root: str, relpath: str) -> str:
+    """Commit date of the artifact (when the measurement was recorded),
+    falling back to file mtime when git has no answer."""
+    try:
+        out = subprocess.run(
+            ["git", "log", "-1", "--format=%cI", "--", relpath],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+        if out:
+            return format_ts(parse_ts(out))
+    except Exception:
+        pass
+    return format_ts(os.path.getmtime(os.path.join(root, relpath)))
+
+
+def seed_rows(root: str) -> List[Dict[str, object]]:
+    """Rebuild the full ledger from BENCH_r*.json + BENCH_TPU_LOG.jsonl.
+
+    Historic rows get an all-unknown fingerprint except the backend the
+    round recorded — the environment simply wasn't written down then,
+    and inventing one would defeat the refusal machinery.
+    """
+    rows: List[Dict[str, object]] = []
+    names = sorted(
+        n for n in os.listdir(root)
+        if n.startswith("BENCH_r") and n.endswith(".json")
+    )
+    for name in names:
+        try:
+            with open(os.path.join(root, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed")
+        round_name = name[len("BENCH_"):-len(".json")]
+        ts = _file_ts(root, name)
+        source = f"bench_{round_name}"
+        backend = parsed.get("backend") if isinstance(parsed, dict) else None
+        # Every round gets a status row — failed rounds (r01 crashed,
+        # r05 timed out: parsed is null) must still show up in the
+        # trajectory, or "nine rounds" silently reads as seven.
+        rows.append(make_row(
+            ts=ts, source=source, stage="bench_round", metric="rc",
+            value=float(doc.get("rc") or 0), unit="code",
+            higher_is_better=False,
+            fingerprint=null_fingerprint(backend=backend),
+            round_name=round_name,
+            extra={"parsed": bool(isinstance(parsed, dict) and parsed)},
+        ))
+        if not isinstance(parsed, dict) or not parsed:
+            continue
+        rows.extend(extract_bench_rows(
+            parsed,
+            ts=ts,
+            source=source,
+            round_name=round_name,
+            fingerprint=null_fingerprint(backend=backend),
+        ))
+    tpu_path = os.path.join(root, "BENCH_TPU_LOG.jsonl")
+    entries = []
+    try:
+        with open(tpu_path) as f:
+            for line in f.read().splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entries.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    rows.extend(extract_tpu_log_rows(entries))
+    rows.sort(key=lambda r: (parse_ts(str(r["ts"])), str(r["stage"]), str(r["metric"])))
+    return rows

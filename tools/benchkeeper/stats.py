@@ -1,0 +1,181 @@
+"""Deterministic comparator over paired interleaved samples.
+
+The box the CPU benches run on has ~2x run-to-run swing, so absolute
+rates are uninterpretable; the only trustworthy signal is *paired
+interleaved* samples (arm A and arm B measured back-to-back inside the
+same rep, so both see the same thermal/scheduler weather).  This module
+turns a list of such pairs into a ``regression | improvement | noise``
+verdict with a documented, seeded, bit-reproducible decision rule.
+
+Decision rule (``compare``)
+---------------------------
+Given paired samples ``baseline[i]`` / ``candidate[i]`` of a metric
+where direction is ``higher_is_better``:
+
+1. Form paired ratios ``r_i = candidate_i / baseline_i``.
+2. Sign test: count pairs with ``r_i > 1`` vs ``r_i < 1`` (exact ties
+   are dropped) and compute the exact two-sided binomial p-value under
+   p=0.5.
+3. Seeded bootstrap: resample the ratios ``n_boot`` times with
+   ``random.Random(seed)`` and take the (1-conf)/2 .. 1-(1-conf)/2
+   percentile interval of the bootstrap medians.
+4. An *effect* is declared iff ALL of:
+   - the sign-test p-value is <= ``alpha``,
+   - the bootstrap CI excludes 1.0,
+   - the median ratio differs from 1.0 by more than ``noise_floor``
+     (practical-significance floor; statistically-real 0.5% shifts on
+     this box are still noise operationally).
+5. If an effect is declared, its direction plus ``higher_is_better``
+   maps it to ``regression`` or ``improvement``; otherwise the verdict
+   is ``noise``.
+
+Everything here is pure: no wall clock, no unseeded randomness, no
+I/O.  Two calls with identical inputs produce bit-identical verdicts —
+that property is tested in tier-1.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Sequence, Tuple
+
+DEFAULT_SEED = 20260806
+DEFAULT_N_BOOT = 2000
+DEFAULT_ALPHA = 0.05
+DEFAULT_CONF = 0.95
+DEFAULT_NOISE_FLOOR = 0.05
+
+VERDICTS = ("regression", "improvement", "noise")
+
+
+def median(values: Sequence[float]) -> float:
+    """Median without ``statistics`` import quirks: mean of middle two."""
+    if not values:
+        raise ValueError("median of empty sequence")
+    s = sorted(values)
+    n = len(s)
+    mid = n // 2
+    if n % 2:
+        return float(s[mid])
+    return (float(s[mid - 1]) + float(s[mid])) / 2.0
+
+
+def paired_ratios(baseline: Sequence[float], candidate: Sequence[float]) -> List[float]:
+    """``candidate[i] / baseline[i]`` for every pair; lengths must match."""
+    if len(baseline) != len(candidate):
+        raise ValueError(
+            "paired samples must have equal length: "
+            f"{len(baseline)} baseline vs {len(candidate)} candidate"
+        )
+    if not baseline:
+        raise ValueError("no pairs")
+    out = []
+    for b, c in zip(baseline, candidate):
+        b = float(b)
+        c = float(c)
+        if b <= 0.0 or c <= 0.0:
+            raise ValueError(f"paired samples must be positive, got ({b}, {c})")
+        out.append(c / b)
+    return out
+
+
+def sign_test_p(n_above: int, n_below: int) -> float:
+    """Exact two-sided binomial p-value for the sign test (ties excluded).
+
+    P(X <= min) + P(X >= max) for X ~ Binomial(n_above + n_below, 0.5),
+    clamped to 1.0.
+    """
+    n = n_above + n_below
+    if n == 0:
+        return 1.0
+    k = min(n_above, n_below)
+    tail = 0.0
+    for i in range(0, k + 1):
+        tail += math.comb(n, i)
+    p = 2.0 * tail * (0.5 ** n)
+    return min(1.0, p)
+
+
+def bootstrap_ci(
+    values: Sequence[float],
+    *,
+    seed: int = DEFAULT_SEED,
+    n_boot: int = DEFAULT_N_BOOT,
+    conf: float = DEFAULT_CONF,
+) -> Tuple[float, float]:
+    """Seeded percentile-bootstrap CI for the median of ``values``.
+
+    Deterministic: the resampler is ``random.Random(seed)`` and the
+    percentile is computed on the sorted bootstrap statistics, so the
+    same inputs always yield the same interval.
+    """
+    vals = [float(v) for v in values]
+    if not vals:
+        raise ValueError("bootstrap over empty sample")
+    rng = random.Random(seed)
+    n = len(vals)
+    stats = []
+    for _ in range(n_boot):
+        resample = [vals[rng.randrange(n)] for _ in range(n)]
+        stats.append(median(resample))
+    stats.sort()
+    lo_q = (1.0 - conf) / 2.0
+    hi_q = 1.0 - lo_q
+    lo_i = min(n_boot - 1, max(0, int(math.floor(lo_q * (n_boot - 1)))))
+    hi_i = min(n_boot - 1, max(0, int(math.ceil(hi_q * (n_boot - 1)))))
+    return (stats[lo_i], stats[hi_i])
+
+
+def compare(
+    baseline: Sequence[float],
+    candidate: Sequence[float],
+    *,
+    higher_is_better: bool = True,
+    seed: int = DEFAULT_SEED,
+    n_boot: int = DEFAULT_N_BOOT,
+    alpha: float = DEFAULT_ALPHA,
+    conf: float = DEFAULT_CONF,
+    noise_floor: float = DEFAULT_NOISE_FLOOR,
+) -> Dict[str, object]:
+    """Apply the documented decision rule to one set of paired samples.
+
+    Returns a dict with ``verdict`` in ``regression|improvement|noise``
+    plus every intermediate the rule used, so the caller can render or
+    archive the full evidence.
+    """
+    ratios = paired_ratios(baseline, candidate)
+    n_above = sum(1 for r in ratios if r > 1.0)
+    n_below = sum(1 for r in ratios if r < 1.0)
+    p = sign_test_p(n_above, n_below)
+    med = median(ratios)
+    lo, hi = bootstrap_ci(ratios, seed=seed, n_boot=n_boot, conf=conf)
+    ci_excludes_one = (lo > 1.0) or (hi < 1.0)
+    above_floor = abs(med - 1.0) > noise_floor
+    effect = (p <= alpha) and ci_excludes_one and above_floor
+    if not effect:
+        verdict = "noise"
+    else:
+        candidate_larger = med > 1.0
+        if candidate_larger == higher_is_better:
+            verdict = "improvement"
+        else:
+            verdict = "regression"
+    return {
+        "verdict": verdict,
+        "n_pairs": len(ratios),
+        "median_ratio": med,
+        "min_ratio": min(ratios),
+        "max_ratio": max(ratios),
+        "ci": [lo, hi],
+        "ci_excludes_one": ci_excludes_one,
+        "p_sign": p,
+        "n_above": n_above,
+        "n_below": n_below,
+        "higher_is_better": higher_is_better,
+        "alpha": alpha,
+        "conf": conf,
+        "noise_floor": noise_floor,
+        "seed": seed,
+        "n_boot": n_boot,
+    }

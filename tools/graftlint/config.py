@@ -133,6 +133,9 @@ def default_config(root: str) -> LintConfig:
             "pydcop_tpu/ops/membound.py",
             "pydcop_tpu/ops/semiring.py",
             "pydcop_tpu/telemetry/*.py",
+            # the bench trajectory tooling must import (and analyze
+            # recorded ledgers) on boxes with no working accelerator
+            "tools/benchkeeper/*.py",
         ),
         # Seeded/replayable scopes: every decision here must be a pure
         # function of (seed, scope, seq) — the FaultPlan contract.
@@ -143,6 +146,9 @@ def default_config(root: str) -> LintConfig:
             # contract (same seed + admission order => identical
             # timelines) rides on these being pure hashes
             "pydcop_tpu/telemetry/context.py",
+            # regression verdicts must be bit-identical across runs:
+            # seeded bootstrap only, timestamps injected by callers
+            "tools/benchkeeper/*.py",
         ),
         seeded_functions={
             # supervisor retry/classification: replay must reproduce

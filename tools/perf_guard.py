@@ -1,0 +1,194 @@
+#!/usr/bin/env python3
+"""Perf guard: deterministic work counters must match recorded budgets.
+
+The recompile-guard discipline (``tools/recompile_guard.py``) applied
+to *performance*: a seconds-scale micro workload — one hard-capped
+overlap-SECP solved by level-batched device DPOP with branch-and-bound
+pruning on — is run against RECORDED budgets.  The split exploits the
+FAQ cost-model insight (arXiv:1504.04044) that util-cells and dispatch
+counts are the output-sensitive unit of contraction work:
+
+- **Work counters are exact and HARD.**  ``util_cells`` (cells the
+  UTIL sweep materialized), ``util_dispatches`` (device program
+  launches), ``semiring.bnb_pruned_cells`` (cells the ⊕-bound pass
+  retired) and cold ``jit.compiles`` are deterministic functions of
+  the problem + lowering — they do not move with machine load.  Any
+  deviation from the recorded values is a tier-1 FAILURE: a kernel
+  got fatter, a batching path de-batched, or pruning silently died.
+
+- **Wall-clock only WARNS.**  The minimum of ``WALL_REPS`` warm
+  repeats is compared against ``WALL_SECONDS_RECORDED`` x
+  ``WALL_RATIO_BOUND`` — generous because this box's 2 throttled
+  vCPUs swing ~2x run-to-run; the counters above are the real tripwire.
+
+Run standalone (prints one JSON line, exit 1 on a hard failure):
+
+    python tools/perf_guard.py
+
+or via tier-1: ``tests/test_perf_guard.py`` imports
+:func:`run_perf_guard` directly, including with ``util_batch="node"``
+(forces extra dispatches) and ``bnb="off"`` (kills pruning) to prove
+the guard actually fails on work-counter drift.
+
+Budgets below are the recorded values of the canned workload.  Bless
+new ones only with a written justification (see docs/performance.md,
+"how to bless a new perf budget") — they ARE the regression budget.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+
+# ONE overlap-SECP builder shared with the recompile guard — the two
+# guards must measure the same canned instance family
+import recompile_guard as _rg  # noqa: E402
+
+# --- recorded budgets -------------------------------------------------
+# Workload: _build_secp_overlap(24, 12, 4, seed=170, arity=5, stride=2,
+# hard_cap=1.15), dpop, util_device=always, util_batch=level, bnb=on,
+# pad_policy=pow2.  Recorded 2026-08-06 on the 2-vCPU CPU box
+# (JAX_PLATFORMS=cpu, jax 0.4.37); counters are platform-independent.
+
+#: cells the level-batched UTIL sweep materializes (exact)
+UTIL_CELLS_BUDGET = 111700
+#: device program launches for the sweep (exact; 'node' batching or a
+#: level-pack split shows up here immediately)
+UTIL_DISPATCHES_BUDGET = 15
+#: cells retired by the bnb ⊕-bound pass (exact; 0 = pruning dead)
+BNB_PRUNED_CELLS_BUDGET = 307612
+#: cold-start XLA compiles from an empty kernel cache (upper bound —
+#: ambient warm caches in a shared test process can only lower it)
+COMPILE_BUDGET = 13
+#: min-of-WALL_REPS warm wall-clock on the recording box, seconds
+WALL_SECONDS_RECORDED = 0.04
+#: warn bound: warm min may drift up to this multiple of recorded —
+#: generous on purpose (this box swings ~2x run-to-run)
+WALL_RATIO_BOUND = 25.0
+WALL_REPS = 3
+
+
+def _counters(tel) -> dict:
+    return tel.summary()["counters"]
+
+
+def run_perf_guard(
+    *,
+    bnb: str = "on",
+    util_batch: str = "level",
+    wall_reps: int = WALL_REPS,
+) -> dict:
+    """Run the canned workload and judge it against the budgets.
+
+    The keyword knobs exist so the tier-1 test can prove the guard
+    trips: ``util_batch="node"`` forces per-node dispatches (extra
+    ``util_dispatches``), ``bnb="off"`` zeroes the pruned-cell
+    counter.  Only the defaults constitute the blessed workload.
+    """
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.ops import semiring as sr_mod
+    from pydcop_tpu.telemetry import session
+
+    sr_mod._KERNELS.clear()
+    dcop = _rg._build_secp_overlap(
+        24, 12, 4, seed=170, arity=5, stride=2, hard_cap=1.15,
+    )
+    params = {
+        "util_device": "always",
+        "util_batch": util_batch,
+        "bnb": bnb,
+    }
+    kw = dict(pad_policy="pow2")
+
+    with session() as t_cold:
+        r = solve(dcop, "dpop", params, **kw)
+    cold = _counters(t_cold)
+    compiles = int(cold.get("jit.compiles", 0))
+    pruned = int(cold.get("semiring.bnb_pruned_cells", 0))
+    util_cells = int(r["util_cells"])
+    util_dispatches = int(r["util_dispatches"])
+
+    # warm wall-clock: counters above are the hard gate, this is the
+    # loose canary — min-of-reps discards scheduler jitter
+    times = []
+    for _ in range(max(1, wall_reps)):
+        t0 = time.perf_counter()
+        solve(dcop, "dpop", params, **kw)
+        times.append(time.perf_counter() - t0)
+    wall_min = min(times)
+    wall_bound = WALL_SECONDS_RECORDED * WALL_RATIO_BOUND
+
+    report = {
+        "workload": "secp_overlap_24x12x4_cap1.15_dpop_level_bnb",
+        "bnb": bnb,
+        "util_batch": util_batch,
+        "best_cost": r["cost"],
+        "util_cells": util_cells,
+        "util_cells_budget": UTIL_CELLS_BUDGET,
+        "util_dispatches": util_dispatches,
+        "util_dispatches_budget": UTIL_DISPATCHES_BUDGET,
+        "bnb_pruned_cells": pruned,
+        "bnb_pruned_cells_budget": BNB_PRUNED_CELLS_BUDGET,
+        "jit_compiles": compiles,
+        "compile_budget": COMPILE_BUDGET,
+        "wall_seconds_min": round(wall_min, 4),
+        "wall_seconds_recorded": WALL_SECONDS_RECORDED,
+        "wall_ratio_bound": WALL_RATIO_BOUND,
+        "wall_ok": wall_min <= wall_bound,
+        "ok": True,
+        "error": None,
+    }
+    failures = []
+    if util_cells != UTIL_CELLS_BUDGET:
+        failures.append(
+            f"util_cells {util_cells} != recorded "
+            f"{UTIL_CELLS_BUDGET} (a kernel got fatter or thinner)"
+        )
+    if util_dispatches != UTIL_DISPATCHES_BUDGET:
+        failures.append(
+            f"util_dispatches {util_dispatches} != recorded "
+            f"{UTIL_DISPATCHES_BUDGET} (level batching drifted)"
+        )
+    if pruned != BNB_PRUNED_CELLS_BUDGET:
+        failures.append(
+            f"bnb_pruned_cells {pruned} != recorded "
+            f"{BNB_PRUNED_CELLS_BUDGET} (pruning drifted or died)"
+        )
+    if compiles > COMPILE_BUDGET:
+        failures.append(
+            f"jit_compiles {compiles} > budget {COMPILE_BUDGET} "
+            "(compile-count regression)"
+        )
+    if failures:
+        report["ok"] = False
+        report["error"] = "; ".join(failures)
+    if not report["wall_ok"]:
+        # deliberately NOT a failure: wall-clock warns, counters gate
+        report["wall_warning"] = (
+            f"warm min {wall_min:.3f}s exceeds "
+            f"{WALL_SECONDS_RECORDED}s x {WALL_RATIO_BOUND:g} — "
+            "machine slow or a real slowdown; counters above decide"
+        )
+    return report
+
+
+def main() -> int:
+    import jax
+
+    # work counters are backend-independent; pin CPU like the
+    # recompile guard so the axon TPU plugin can't hijack the run
+    jax.config.update("jax_platforms", "cpu")
+    report = run_perf_guard()
+    print(json.dumps(report, default=float))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
