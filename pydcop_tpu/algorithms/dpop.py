@@ -164,11 +164,17 @@ def build_computation(comp_def, seed: int = 0):
 from pydcop_tpu.algorithms._tables import align_table as _align  # noqa: E402
 
 
-def _prepare_instance(dcop: DCOP):
+def _prepare_instance(dcop: DCOP, provenance: Optional[dict] = None):
     """Host-side problem setup shared by :func:`solve_host` and
     :func:`solve_host_many`: the pseudo-tree, per-variable domains and
     depths, and constraint ownership (each constraint owned by the
-    deepest variable of its scope; external variables sliced out)."""
+    deepest variable of its scope; external variables sliced out).
+
+    ``provenance`` (optional out-param) records, per constraint name,
+    the ``(owner, index)`` slot its sliced table landed in inside
+    ``owned`` — the hook :class:`~pydcop_tpu.engine.memo.ExactSession`
+    uses to re-tabulate ONLY the constraints a ``set_values`` delta
+    touched, in place."""
     sign = -1.0 if dcop.objective == "max" else 1.0
 
     graph = _pt.build_computation_graph(dcop)
@@ -196,6 +202,7 @@ def _prepare_instance(dcop: DCOP):
             )
             owned[v.name].append(([v.name], costs))
     for c in dcop.constraints.values():
+        cname = c.name
         scope_ext = [n for n in c.scope_names if n in ext_values]
         if scope_ext:
             c = c.slice({n: ext_values[n] for n in scope_ext})
@@ -205,6 +212,8 @@ def _prepare_instance(dcop: DCOP):
         m = c.as_matrix()
         table = sign * np.asarray(m.matrix, dtype=np.float64)
         owner = max(scope, key=lambda n: depth[n])
+        if provenance is not None and scope_ext:
+            provenance[cname] = (owner, len(owned[owner]))
         owned[owner].append((scope, table))
     return graph, domains, depth, owned
 
@@ -684,6 +693,13 @@ class _UtilInstance(NamedTuple):
     owned: Dict[str, List[Tuple[List[str], np.ndarray]]]
     device_min_cells: Optional[int]  # None = host-only instance
     bnb: str = "off"  # branch-and-bound pruning mode (algo param)
+    # subtree-fingerprint message memo (engine/memo.py SweepMemoView,
+    # or None): fingerprint-unchanged nodes reuse their stored UTIL
+    # message instead of re-contracting — the serving delta path
+    memo: Any = None
+    # previous solution as {var: domain index} — seeds the bnb
+    # incumbent so warm re-solves prune at least as hard as cold
+    bnb_seed: Any = None
 
 
 def _util_phase(
@@ -829,8 +845,15 @@ def _util_phase_multi(
                     for n in names_pre
                 },
             )
+            if inst.bnb_seed is not None:
+                # warm re-solve: the previous solution re-evaluated
+                # under the post-delta tables is a valid incumbent
+                # (it IS an assignment) and usually near-optimal —
+                # adopt it when it beats the greedy one
+                ctxs[k].seed_incumbent(inst.owned, inst.bnb_seed)
 
-    def finish(k, name, node, sep, u, amin):
+    def finish(k, name, node, sep, u, amin,
+               exact=True, budget_used=None, bmeta=None):
         # min-normalize the outgoing table (either path): argmin
         # decisions are shift-invariant, the final cost comes from
         # solution_cost(assignment), and keeping UTIL values at the
@@ -842,17 +865,41 @@ def _util_phase_multi(
         # exact ±inf, which is structure, not a rounding scale).
         best_choice[k][name] = (sep, amin)
         sh = 0.0
+        mag = 0.0
         if node.parent is not None:
             if u.size:
                 mn = u.min()
                 if np.isfinite(mn):
                     sh = float(mn)
                     u = u - mn
-            utils[k][name] = (sep, u, _semiring._finite_amax(u))
+            mag = _semiring._finite_amax(u)
+            utils[k][name] = (sep, u, mag)
             util_cells[k] += u.size
         if ctxs[k] is not None:
             ctxs[k].record_shift(
                 name, sh, insts[k].graph.node(name).children
+            )
+        memo = insts[k].memo
+        if memo is not None:
+            # a bnb budget-pruned message (exact=False) is reusable
+            # only under budget DOMINANCE next solve — store the
+            # budget actually used plus the shape metadata needed to
+            # recompute the comparable budget at lookup time.  Views
+            # into bucket stack buffers are detached so one entry
+            # never pins a whole level stack.
+            mu = u if node.parent is not None else None
+            if mu is not None and mu.base is not None:
+                mu = mu.copy()
+            ma = amin
+            if isinstance(ma, np.ndarray) and ma.base is not None:
+                ma = ma.copy()
+            memo.store(
+                name,
+                (
+                    sep, mu, mag, ma, sh, bool(exact),
+                    None if budget_used is None else float(budget_used),
+                    bmeta,
+                ),
             )
 
     # wave plan: wave index = node HEIGHT (longest path down to a
@@ -892,6 +939,43 @@ def _util_phase_multi(
             inst = insts[k]
             domains = inst.domains
             node = inst.graph.node(name)
+            if inst.memo is not None:
+                payload = inst.memo.lookup(name)
+                if payload is not None:
+                    (msep, mu, mmag, mamin, msh, mexact,
+                     mbud, mbmeta) = payload
+                    ok = mexact
+                    if (
+                        not ok
+                        and ctxs[k] is not None
+                        and mbud is not None
+                        and mbmeta is not None
+                    ):
+                        # budget dominance: rows pruned last solve
+                        # had bound > stored budget; with the current
+                        # budget no larger, they are still provably
+                        # dead, so the pruned (+inf) message is
+                        # reusable as-is
+                        cur = ctxs[k].budget(
+                            name,
+                            ctxs[k].shift_under(node.children),
+                            *mbmeta,
+                        )
+                        ok = cur <= mbud
+                    if ok:
+                        # subtree fingerprint unchanged ⇒ every part
+                        # of this subtree is bit-identical ⇒ so is
+                        # the message: reinstall it and skip the
+                        # re-contraction entirely
+                        best_choice[k][name] = (msep, mamin)
+                        if node.parent is not None:
+                            utils[k][name] = (msep, mu, mmag)
+                        if ctxs[k] is not None:
+                            ctxs[k].record_shift(
+                                name, msh, node.children
+                            )
+                        inst.memo.mark_hit()
+                        continue
             # effective separator: ancestors referenced by own
             # relations or children's separators.  Owned relations
             # are PRE-SUMMED into one exact f64 part: bitwise the
@@ -1024,7 +1108,15 @@ def _util_phase_multi(
             level_batched = False
             host_compacted = False
             obs_counted = False
-            if level_sync and n_rows > 1 and uniform:
+            # memoized instances take the stacked path even for a
+            # single row: a warm delta's lone dirty node then lands
+            # on the stack-height-1 kernel the memo pre-warmed after
+            # the cold solve — zero XLA compiles on the delta path
+            memo_rows = any(
+                insts[item[0]].memo is not None
+                for item, _ in entries
+            )
+            if level_sync and uniform and (n_rows > 1 or memo_rows):
                 # stack height bucketed pow-2 under a pad policy
                 # (ghost rows stay zero, discarded below): the
                 # vmapped kernel retraces per distinct leading dim,
@@ -1148,7 +1240,17 @@ def _util_phase_multi(
                             )
                             host_nodes[k] += 1
                             finish(
-                                k, name, node, sep, u_b[r], amin_r
+                                k, name, node, sep, u_b[r], amin_r,
+                                exact=(
+                                    _budget is None
+                                    or bool(keep_b[r].all())
+                                ),
+                                budget_used=_budget,
+                                bmeta=(
+                                    len(parts), sum_max_abs,
+                                    shape[-1],
+                                    int(np.prod(shape[:-1])),
+                                ),
                             )
                         host_compacted = True
                 if host_compacted:
@@ -1201,6 +1303,14 @@ def _util_phase_multi(
                     met.inc("dpop.level_dispatches")
                 for k in sorted({item[0] for item, _ in entries}):
                     dispatches[k] += 1
+                if memo_rows:
+                    for item, _ in entries:
+                        m = insts[item[0]].memo
+                        if m is not None:
+                            m.note_kernel(
+                                "min_sum", pshape, part_shapes,
+                                use_bnb,
+                            )
                 # certification, vectorized over the stack: slice the
                 # real region once, one argwhere against the per-row
                 # error bounds, repairs grouped by row
@@ -1335,7 +1445,19 @@ def _util_phase_multi(
                         tuple(shape[:-1])
                     )
                     device_nodes[k] += 1
-                    finish(k, name, node, sep, u_b[r], amin_r)
+                    finish(
+                        k, name, node, sep, u_b[r], amin_r,
+                        exact=(
+                            keep_b is None
+                            or _budget is None
+                            or bool(keep_b[r].all())
+                        ),
+                        budget_used=_budget,
+                        bmeta=(
+                            len(parts), sum_max_abs, shape[-1],
+                            int(np.prod(shape[:-1])),
+                        ),
+                    )
                 continue
 
             # per-node dispatches: util_batch='node', singleton
@@ -1424,7 +1546,19 @@ def _util_phase_multi(
                                 pass2="host",
                             )
                         host_nodes[k] += 1
-                        finish(k, name, node, sep, u, amin)
+                        finish(
+                            k, name, node, sep, u, amin,
+                            exact=(
+                                budget is None
+                                or bool(keep_r.all())
+                            ),
+                            budget_used=budget,
+                            bmeta=(
+                                len(parts), sum_max_abs,
+                                shape[-1],
+                                int(np.prod(shape[:-1])),
+                            ),
+                        )
                         continue
                 if pad.enabled:
                     aligned = pad_util_parts(aligned, shape, pshape)
@@ -1498,7 +1632,19 @@ def _util_phase_multi(
                     continue
                 u = _exact_u_at(parts, target, shape, amin, keep=keep_r)
                 device_nodes[k] += 1
-                finish(k, name, node, sep, u, amin)
+                finish(
+                    k, name, node, sep, u, amin,
+                    exact=(
+                        keep_r is None
+                        or budget is None
+                        or bool(keep_r.all())
+                    ),
+                    budget_used=budget,
+                    bmeta=(
+                        len(parts), sum_max_abs, shape[-1],
+                        int(np.prod(shape[:-1])),
+                    ),
+                )
     return [
         (
             best_choice[k], util_cells[k], device_nodes[k],

@@ -94,6 +94,16 @@ def set_parser(subparsers) -> None:
         "status='shed'; default 8",
     )
     p.add_argument(
+        "--session_memo_bytes", type=int, default=64 << 20,
+        metavar="BYTES",
+        help="per-session byte bound of the subtree-fingerprint "
+        "message memo behind exact-algorithm (dpop) session "
+        "follow-ups: a set_values delta re-contracts only the dirty "
+        "root-to-changed-constraint path, zero XLA compiles warm "
+        "(docs/performance.md, 'O(delta) re-solves'); 0 disables "
+        "memoization; default 64 MiB",
+    )
+    p.add_argument(
         "--session_checkpoint", default=None, metavar="PATH",
         help="write the final session checkpoint (pinned dcops, "
         "applied set_values deltas, per-session counters) to PATH on "
@@ -215,6 +225,7 @@ def run_cmd(args) -> int:
                 chunk_floor=args.chunk_floor,
                 on_numeric_fault=args.on_numeric_fault,
                 max_queue=args.max_queue,
+                session_memo_bytes=args.session_memo_bytes,
                 session_checkpoint=session_checkpoint,
                 resume=args.resume,
                 flight_dump=flight_dump,

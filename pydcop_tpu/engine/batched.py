@@ -614,12 +614,16 @@ def run_batched(
         if mesh is None:
             runner = profiled_jit(fn, label=label)
         else:
-            from pydcop_tpu.parallel.mesh import problem_pspecs, state_pspecs
+            from pydcop_tpu.parallel.mesh import (
+                problem_pspecs,
+                shard_map,
+                state_pspecs,
+            )
 
             pspecs = problem_pspecs(problem)
             sspecs = _stacked(_full_state_specs())
             dyn_specs = {k: P() for k in dyn_params}
-            sharded = jax.shard_map(
+            sharded = shard_map(
                 fn,
                 mesh=mesh,
                 in_specs=(pspecs, sspecs, P(), dyn_specs, P(), P()),
@@ -1154,12 +1158,15 @@ def run_many_batched(
             f"chunk[{algo_module.__name__.rsplit('.', 1)[-1]}:{n}x{K}]"
         )
         if mesh is not None:
-            from pydcop_tpu.parallel.mesh import problem_pspecs
+            from pydcop_tpu.parallel.mesh import (
+                problem_pspecs,
+                shard_map,
+            )
 
             pspecs = problem_pspecs(template)
             sspecs = _sspecs(instance_axis=False)
             dyn_specs = {k: P() for k in dyn_params}
-            fn = jax.shard_map(
+            fn = shard_map(
                 fn,
                 mesh=mesh,
                 in_specs=(pspecs, sspecs, P(), dyn_specs, P(), P()),

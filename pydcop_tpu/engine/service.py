@@ -182,6 +182,19 @@ def _pad_policy_doc(pad_policy: Any) -> Dict[str, Any]:
     return dataclasses.asdict(as_pad_policy(pad_policy))
 
 
+def _exact_doc(sess: "_Session") -> Dict[str, Any]:
+    """The checkpointable exact-session record: {algo: params} for
+    every MEMOIZED exact session (engine/memo.py) the session holds —
+    what the restore/replication replay re-warms.  Plain pinned
+    clones (syncbb) carry no memo worth warming and are rebuilt
+    lazily instead."""
+    return {
+        a: dict(p)
+        for a, p in sess.exact_params.items()
+        if getattr(sess.exact.get(a), "memo", None) is not None
+    }
+
+
 def _dcop_source(dcop: Any) -> Optional[Tuple[str, str]]:
     """The serializable identity of a request's dcop, for session
     checkpoints: yaml text ships verbatim, paths by realpath; DCOP
@@ -347,6 +360,13 @@ class _Session:
         # lands on bit-identical device tables (docs/serving.md)
         self.deltas: List[Dict[str, Any]] = []
         self.segments = 0
+        # exact-algorithm state: per-algo pinned exact sessions —
+        # dpop gets the memoized contraction session (engine/memo.py,
+        # the O(delta) re-solve path), other solve_host algos a plain
+        # pinned clone — plus the JSON-safe params of each, so the
+        # checkpoint/replication replay can re-warm the memo
+        self.exact: Dict[str, Any] = {}
+        self.exact_params: Dict[str, Dict[str, Any]] = {}
 
     def record_delta(self, delta: Dict[str, Any]) -> None:
         """Append one applied delta, keeping the log bounded: past
@@ -415,6 +435,7 @@ class SolverService:
         on_numeric_fault: Optional[str] = None,
         compile_cache_max: int = 256,
         max_queue: int = 1024,
+        session_memo_bytes: int = 64 << 20,
         session_checkpoint: Optional[str] = None,
         resume: bool = False,
         flight_dump: Optional[str] = None,
@@ -444,6 +465,11 @@ class SolverService:
                 f"max_queue must be >= 1, got {max_queue}"
             )
         self.max_queue = max_queue
+        # per-session byte bound of the subtree-fingerprint message
+        # memo (engine/memo.py) behind exact-algorithm session
+        # follow-ups; <= 0 disables memoization (every follow-up
+        # re-contracts the full tree)
+        self.session_memo_bytes = int(session_memo_bytes)
         self.session_checkpoint = session_checkpoint
         # flight-recorder dump target: on a shed / quarantine /
         # dispatch-error / drain trigger the session's always-on ring
@@ -1168,6 +1194,7 @@ class SolverService:
                     "source": list(src),
                     "deltas": sess.deltas,
                     "segments": sess.segments,
+                    "exact": _exact_doc(sess),
                 }
             )
         doc = {
@@ -1337,6 +1364,31 @@ class SolverService:
         sess.ext_values = ext
         sess.deltas = [dict(d) for d in entry.get("deltas", ())]
         sess.segments = int(entry.get("segments", 0))
+        # warm the memoized exact sessions the entry recorded: ONE
+        # solve at the final accumulated state re-fills the message
+        # memo and pre-warms the 1-row kernels (engine/memo.py), so
+        # the session's first LIVE follow-up is already an O(delta)
+        # memo re-solve — the exact-path analogue of the replayed
+        # compile.incremental contract above
+        for algo, params in (entry.get("exact") or {}).items():
+            if str(algo) != "dpop":
+                continue
+            try:
+                from pydcop_tpu.engine.memo import ExactSession
+
+                es = ExactSession(
+                    dcop,
+                    pad_policy=self.pad_policy,
+                    memo_bytes=self.session_memo_bytes,
+                )
+                if ext:
+                    es.set_values(ext)
+                es.solve(dict(params or {}))
+            except Exception:  # noqa: BLE001 — the warm replay is
+                # an optimization; the live path rebuilds lazily
+                continue
+            sess.exact[str(algo)] = es
+            sess.exact_params[str(algo)] = dict(params or {})
         return name, sess
 
     # -- fleet replication (docs/serving.md, "The fleet") ----------------
@@ -1366,6 +1418,7 @@ class SolverService:
             "source": list(src),
             "deltas": [dict(d) for d in sess.deltas],
             "segments": sess.segments,
+            "exact": _exact_doc(sess),
         }
 
     def set_standbys(self, addrs: Sequence[str]) -> int:
@@ -1490,6 +1543,26 @@ class SolverService:
                 sess.segments = int(
                     entry.get("segments", sess.segments)
                 )
+                # standby exact sessions follow the tail WITHOUT
+                # re-solving: set_values re-tabulates only touched
+                # constraints, so the memo (warm since the rebuild
+                # solve) serves the promoted session's first
+                # follow-up as an O(tail) re-contraction
+                sess.exact_params.update(
+                    {
+                        str(a): dict(p or {})
+                        for a, p in (
+                            entry.get("exact") or {}
+                        ).items()
+                    }
+                )
+                for es in list(sess.exact.values()):
+                    try:
+                        es.set_values(sess.ext_values)
+                    except Exception:  # noqa: BLE001 — drop the
+                        # copies; promotion rebuilds lazily
+                        sess.exact.clear()
+                        break
             else:
                 mode = "rebuild"
                 name, sess = self._build_session_from_entry(entry)
@@ -2169,6 +2242,9 @@ class SolverService:
                 )
             sess.ext_values.update(req.set_values)
             sess.record_delta(req.set_values)
+        module = _load_module(req.algo)
+        if hasattr(module, "solve_host"):
+            return self._solve_session_exact(req, sess, module)
         t_compile0 = time.perf_counter()
         req.dispatch_t = t_compile0
         problem, _fp = sess.compiler.compile({}, sess.ext_values)
@@ -2194,7 +2270,7 @@ class SolverService:
             ):
                 result = run_batched(
                     problem,
-                    _load_module(req.algo),
+                    module,
                     req.params,
                     rounds=req.rounds,
                     seed=req.seed,
@@ -2210,6 +2286,100 @@ class SolverService:
         out["session"] = req.session
         out["segment"] = sess.segments
         return out
+
+    def _solve_session_exact(
+        self, req: _Request, sess: _Session, module
+    ) -> Dict[str, Any]:
+        """Session dispatch for EXACT algorithms (the ``solve_host``
+        modules).  DPOP follow-ups run through the memoized
+        contraction session (``engine/memo.py``): ``set_values``
+        re-tabulates only the touched constraints and the UTIL sweep
+        re-contracts only the dirty root-to-changed-constraint path —
+        every other node is a memo hit, and warm deltas perform zero
+        XLA compiles (docs/performance.md, "O(delta) re-solves").
+        Other exact algos re-solve a pinned private clone.  The
+        IncrementalCompiler device-table path is bypassed: exact
+        sweeps consume host tables, which the exact session
+        re-tabulates itself."""
+        tr = get_tracer()
+        t_compile0 = time.perf_counter()
+        req.dispatch_t = t_compile0
+        es = sess.exact.get(req.algo)
+        if es is None:
+            if req.algo == "dpop":
+                from pydcop_tpu.engine.memo import ExactSession
+
+                es = ExactSession(
+                    sess.dcop,
+                    pad_policy=self.pad_policy,
+                    memo_bytes=self.session_memo_bytes,
+                )
+            else:
+                es = _PlainExactSession(sess.dcop, module)
+            sess.exact[req.algo] = es
+        if sess.ext_values:
+            es.set_values(sess.ext_values)
+        try:
+            sess.exact_params[req.algo] = json.loads(
+                json.dumps(dict(req.params))
+            )
+        except (TypeError, ValueError):
+            # non-JSON params: the session still serves, it just
+            # cannot warm-replay through a checkpoint
+            sess.exact_params.pop(req.algo, None)
+        sess.segments += 1
+        run_timeout = None
+        if req.timeout is not None:
+            run_timeout = max(
+                req.timeout
+                - (time.perf_counter() - req.enqueue_t),
+                0.01,
+            )
+        self._record_dispatch(1, 0)
+        t_run0 = time.perf_counter()
+        req.compile_s = t_run0 - t_compile0
+        with trace_scope([req.trace_id]):
+            with tr.span(
+                "service.dispatch", cat="service", instances=1,
+                padded=0, algo=req.algo, session=req.session,
+                segment=sess.segments,
+            ):
+                out = es.solve(req.params, timeout=run_timeout)
+        t_done = time.perf_counter()
+        req.device_s = t_done - t_run0
+        req.decode_t0 = t_done
+        out["session"] = req.session
+        out["segment"] = sess.segments
+        return out
+
+
+class _PlainExactSession:
+    """Pinned session state for exact algorithms WITHOUT a memoized
+    sweep (syncbb): a private dcop clone whose externals follow the
+    session's ``set_values`` stream; every solve is a full
+    ``solve_host``."""
+
+    def __init__(self, dcop, module) -> None:
+        from pydcop_tpu.engine.memo import _clone_dcop
+
+        self.module = module
+        self.dcop = _clone_dcop(dcop)
+
+    def set_values(self, values: Mapping[str, Any]) -> None:
+        evs = self.dcop.external_variables
+        for name, val in values.items():
+            ev = evs.get(name)
+            if ev is not None and ev.value != val:
+                ev.value = val
+
+    def solve(
+        self,
+        params: Optional[Mapping[str, Any]] = None,
+        timeout: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        return self.module.solve_host(
+            self.dcop, dict(params or {}), timeout=timeout
+        )
 
 
 def _load_module(algo_name: str):

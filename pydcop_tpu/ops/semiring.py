@@ -823,6 +823,32 @@ class _BnbContext:
         )
         return totals[k - 1] if len(totals) >= k else None
 
+    def seed_incumbent(self, owned, assigned) -> bool:
+        """Adopt a caller-provided full assignment (``{var: value
+        index}`` — e.g. the previous solution of a memoized serving
+        session, re-evaluated under the CURRENT tables) as the
+        incumbent when its exact total beats the greedy one.  Any
+        full assignment's total is a valid bound, so this only ever
+        tightens the budgets.  kbest keeps its own ``inc_k``
+        (k-th-best bounds don't follow from one assignment)."""
+        try:
+            tot = _eval_assignment(owned, assigned)
+        except (KeyError, IndexError):
+            return False
+        if not np.isfinite(tot):
+            return False
+        sr = self.sr
+        maximize = sr.maximize or not sr.idempotent
+        if sr.kind == "kbest":
+            maximize = False
+        if maximize:
+            better = tot > self.inc
+        else:
+            better = tot < self.inc
+        if better:
+            self.inc = tot
+        return better
+
     def no_prune(self) -> float:
         """Budget sentinel without a usable incumbent: keeps every
         FINITE row — rows whose bound is already the ⊕-annihilator
@@ -1608,6 +1634,7 @@ def build_plan(
     deadline: Optional[float] = None,
     max_vars: Optional[Sequence[str]] = None,
     external_dists: Optional[Mapping[str, Mapping[Any, float]]] = None,
+    provenance: Optional[dict] = None,
 ) -> ContractionPlan:
     """Build the contraction plan for one DCOP under an elimination
     order heuristic.  ``deadline`` (a ``perf_counter`` timestamp)
@@ -1629,7 +1656,14 @@ def build_plan(
     (expectation) maps external-variable names to ``{value: prob}``
     distributions: those externals are NOT sliced to their pinned
     value but join the plan as summed variables carrying a unary
-    log-probability part (``wbuckets``)."""
+    log-probability part (``wbuckets``).
+
+    ``provenance`` (optional out-param) records, per EXTERNAL-scoped
+    constraint name, where its sliced table landed: ``(owner, index)``
+    into ``plan.buckets[owner]``, or ``("const",)`` when the slice
+    folded into ``const_energy`` — the hook
+    :class:`~pydcop_tpu.engine.memo.InferSession` uses to re-tabulate
+    only the constraints a ``set_values`` delta touched."""
     if order not in ELIMINATION_ORDERS:
         raise ValueError(
             f"unknown elimination order {order!r} (expected one of "
@@ -1699,7 +1733,7 @@ def build_plan(
     else:
         mv = None
 
-    parts: List[Tuple[List[str], np.ndarray]] = []
+    parts: List[Tuple[List[str], np.ndarray, Optional[str]]] = []
     const_energy = 0.0
     for v in dcop.variables.values():
         if v.has_cost:
@@ -1707,8 +1741,9 @@ def build_plan(
                 [sign * v.cost_for_val(x) for x in v.domain.values],
                 dtype=np.float64,
             )
-            parts.append(([v.name], costs))
+            parts.append(([v.name], costs, None))
     for c in dcop.constraints.values():
+        cname = c.name
         scope_ext = [n for n in c.scope_names if n in ext_values]
         if scope_ext:
             c = c.slice({n: ext_values[n] for n in scope_ext})
@@ -1717,13 +1752,15 @@ def build_plan(
         table = sign * np.asarray(m.matrix, dtype=np.float64)
         if not scope:
             const_energy += float(table)
+            if provenance is not None and scope_ext:
+                provenance[cname] = ("const",)
             continue
-        parts.append((scope, table))
+        parts.append((scope, table, cname if scope_ext else None))
 
     if order == "min_fill":
         elim = min_fill_order(
             domains,
-            [s for s, _ in parts] + [s for s, _ in wparts],
+            [s for s, _, _ in parts] + [s for s, _ in wparts],
             deadline=deadline,
             last_block=mv,
         )
@@ -1761,8 +1798,10 @@ def build_plan(
     wbuckets: Dict[str, List[Tuple[List[str], np.ndarray]]] = {
         v: [] for v in elim
     }
-    for scope, table in parts:
+    for scope, table, cname in parts:
         owner = min(scope, key=pos.__getitem__)
+        if provenance is not None and cname is not None:
+            provenance[cname] = (owner, len(buckets[owner]))
         buckets[owner].append((scope, table))
     for scope, table in wparts:
         owner = min(scope, key=pos.__getitem__)
@@ -1859,6 +1898,7 @@ def contract_sweep(
     timeout: Optional[float] = None,
     on_oom: str = "host",
     bnb: str = "off",
+    memos: Optional[Sequence[Any]] = None,
 ) -> Optional[_Sweep]:
     """Merged bottom-up contraction sweep over K instances.
 
@@ -1895,6 +1935,16 @@ def contract_sweep(
     is the historical sweep.  Counters ``semiring.bnb_passes`` /
     ``semiring.bnb_pruned_cells`` and a per-dispatch-group
     ``semiring.bnb`` trace event make the pruning observable.
+
+    ``memos`` (one ``engine.memo.SweepMemoView`` or None per
+    instance) enables subtree-fingerprint message reuse: a node
+    whose fingerprint is unchanged reinstalls its stored message —
+    separator, shifted values, magnitude, cumulative error, args —
+    and is skipped entirely; re-contracted nodes re-store.  Memoized
+    instances that build a PRUNING context run unmemoized instead
+    (a budget-pruned message depends on the global incumbent, not
+    just the subtree) — sessions wanting memoized deltas run with
+    ``bnb='off'`` or below the auto threshold.
     """
     from pydcop_tpu.engine.supervisor import (
         DeviceOOMError,
@@ -1928,6 +1978,14 @@ def contract_sweep(
                 continue
             ctxs[k] = plan_bnb_context(p, sr, beta, tol)
     bnb_call = any(c is not None for c in ctxs)
+    if memos is not None:
+        # docstring contract: pruning and memoization are mutually
+        # exclusive per instance — pruned messages aren't pure
+        # functions of the subtree
+        memos = [
+            None if ctxs[k] is not None else m
+            for k, m in enumerate(memos)
+        ]
 
     def table_in(tbl: np.ndarray) -> np.ndarray:
         if sr.kind == "kbest" or (
@@ -1945,17 +2003,19 @@ def contract_sweep(
                 met.inc("semiring.kbest_merges")
         if want_args and arg is not None:
             sw.args[k][name] = (sep, arg)
+        rootval = None
         if plan.parent[name] is None:
             if sr_n.cell_width > 1:
                 # structured kinds keep the root CELL (kbest re-merges
                 # roots with provenance; expectation pairs ⊗-combine
                 # at result assembly)
-                sw.root_cells[k][name] = np.asarray(
-                    u, dtype=np.float64
-                )
+                cell = np.asarray(u, dtype=np.float64)
+                sw.root_cells[k][name] = cell
+                rootval = ("cell", cell)
             else:
                 # root: the reduce is a scalar — fold it into the
                 # instance aggregate (plus every shift already applied)
+                rootval = ("total", float(u))
                 sw.root_total[k] += float(u)
             if ctxs[k] is not None:
                 ctxs[k].record_shift(name, 0.0, plan.children[name])
@@ -1975,6 +2035,32 @@ def contract_sweep(
             mag = _finite_amax(u)
             sw.msgs[k][name] = (sep, u, mag)
             sw.cells[k] += u.size
+        memo = memos[k] if memos is not None else None
+        if memo is not None:
+            # every non-idempotent path sets sw.err[name] BEFORE
+            # finish, so the stored error is the node's CUMULATIVE
+            # subtree bound — a memo hit re-accounts exactly what the
+            # cold solve accounted, and only dirty-path nodes add new
+            # error on a warm delta
+            if rootval is not None:
+                memo.store(
+                    name,
+                    (
+                        sep, None, 0.0, 0.0,
+                        sw.args[k].get(name),
+                        sw.err[k].get(name, 0.0), True, rootval,
+                    ),
+                )
+            else:
+                mu = u if u.base is None else u.copy()
+                memo.store(
+                    name,
+                    (
+                        sep, mu, mag, shift,
+                        sw.args[k].get(name),
+                        sw.err[k].get(name, 0.0), False, None,
+                    ),
+                )
 
     def host_contract(
         sr_n, k, name, plan, sep, target, shape, parts, err_in
@@ -2057,6 +2143,31 @@ def contract_sweep(
             if mixed:
                 wave_srs.add(sr_n.name)
             cw = sr_n.cell_width
+            memo = memos[k] if memos is not None else None
+            if memo is not None:
+                payload = memo.lookup(name)
+                if payload is not None:
+                    (msep, mu, mmag, mshift, margp, merr, mroot,
+                     mrootval) = payload
+                    # an arg-consuming query can't hit an entry
+                    # stored without args (a prior solve with a
+                    # different query); everything else reinstalls
+                    if not (want_args and margp is None):
+                        sw.seps[k][name] = msep
+                        if margp is not None and want_args:
+                            sw.args[k][name] = margp
+                        if merr:
+                            sw.err[k][name] = merr
+                        if mroot:
+                            if mrootval[0] == "cell":
+                                sw.root_cells[k][name] = mrootval[1]
+                            else:
+                                sw.root_total[k] += mrootval[1]
+                        else:
+                            sw.msgs[k][name] = (msep, mu, mmag)
+                            sw.total_shift[k] += mshift
+                        memo.mark_hit()
+                        continue
             sep = plan.sep_of(name, sw.seps[k])
             sw.seps[k][name] = sep
             target = sep + [name]
@@ -2267,11 +2378,19 @@ def contract_sweep(
                 or (sr_b.idempotent and not sr_b.maximize)
                 else -big
             )
-            if level_sync and n_rows > 1 and uniform:
+            # memoized instances take the stacked path even for a
+            # single row: a warm delta's lone dirty node then lands
+            # on the stack-height-1 kernel the memo pre-warmed after
+            # the cold solve — zero XLA compiles on the delta path
+            memo_rows = memos is not None and any(
+                memos[item[0]] is not None for item, _ in entries
+            )
+            if level_sync and uniform and (n_rows > 1 or memo_rows):
                 ok = _dispatch_stacked(
                     sw, sr_b, entries, pshape, part_shapes, shape0,
                     pad, guard, tol, want_args, finish, sup, met,
                     plans, use_bnb, noprune, ctxs, tracer,
+                    memos=memos,
                 )
                 if ok:
                     continue
@@ -2355,7 +2474,7 @@ def contract_sweep(
 def _dispatch_stacked(
     sw, sr, entries, pshape, part_shapes, shape0, pad, guard, tol,
     want_args, finish, sup, met, plans, use_bnb=False,
-    noprune=float("inf"), ctxs=(), tracer=None,
+    noprune=float("inf"), ctxs=(), tracer=None, memos=None,
 ) -> bool:
     """One vmapped dispatch for a uniform level-pack bucket.  Returns
     False on device OOM (caller degrades to per-node dispatches).
@@ -2401,6 +2520,13 @@ def _dispatch_stacked(
             met.inc("semiring.bnb_passes")
     for k in sorted({item[0] for item, _ in entries}):
         sw.dispatches[k] += 1
+    if memos is not None:
+        # record the kernel spec so the session's post-solve prewarm
+        # compiles the 1-row variant (zero compiles on warm deltas)
+        for item, _ in entries:
+            m = memos[item[0]]
+            if m is not None:
+                m.note_kernel(sr.name, pshape, part_shapes, use_bnb)
     region_rows = tuple(slice(0, s) for s in shape0[:-1])
     pruned_total = 0
     for r, (item, aligned) in enumerate(entries):
@@ -2904,6 +3030,8 @@ def run_infer_many(
         Mapping[str, Mapping[Any, float]]
     ] = None,
     bnb: str = "auto",
+    _plans: Optional[Sequence["ContractionPlan"]] = None,
+    _memos: Optional[Sequence[Any]] = None,
 ) -> List[Dict[str, Any]]:
     """Run one inference query over K instances with their contraction
     sweeps MERGED (the ``solve_many`` batching contract: same-bucket
@@ -2939,6 +3067,12 @@ def run_infer_many(
     Gibbs distribution and, via ``external_dists = {external:
     {value: prob}}``, under stochastic externals: a modeled
     expectation, not a chaos-injected sample).
+
+    ``_plans`` / ``_memos`` are the private session hooks
+    (``engine/memo.py:InferSession``): pre-built plans skip
+    ``build_plan`` (the session mutates its plan's buckets in place
+    on deltas) and per-instance memo views flow into
+    :func:`contract_sweep` for subtree-fingerprint message reuse.
     """
     t0 = time.perf_counter()
     qkind, sr = parse_query(query)
@@ -2981,25 +3115,28 @@ def run_infer_many(
     tracer = get_tracer()
     K = len(dcops)
     deadline = None if timeout is None else t0 + timeout
-    try:
-        plans = [
-            build_plan(
-                d, order=order, deadline=deadline,
-                max_vars=(
-                    map_vars if qkind == "marginal_map" else None
-                ),
-                external_dists=(
-                    external_dists
-                    if qkind == "expectation"
-                    else None
-                ),
-            )
-            for d in dcops
-        ]
-    except TimeoutError:
-        # plan construction (the min_fill search) ate the budget —
-        # same contract as a sweep timeout
-        return [_timeout_result(query, t0) for _ in range(K)]
+    if _plans is not None:
+        plans = list(_plans)
+    else:
+        try:
+            plans = [
+                build_plan(
+                    d, order=order, deadline=deadline,
+                    max_vars=(
+                        map_vars if qkind == "marginal_map" else None
+                    ),
+                    external_dists=(
+                        external_dists
+                        if qkind == "expectation"
+                        else None
+                    ),
+                )
+                for d in dcops
+            ]
+        except TimeoutError:
+            # plan construction (the min_fill search) ate the budget
+            # — same contract as a sweep timeout
+            return [_timeout_result(query, t0) for _ in range(K)]
     want_args = qkind in ("map", "marginal_map", "kbest")
 
     if max_util_bytes is not None:
@@ -3022,7 +3159,7 @@ def run_infer_many(
     sw = contract_sweep(
         plans, sr, beta=beta, device_min_cells=dmc, pad=pad,
         tol=tol, max_table_size=max_table_size, want_args=want_args,
-        t0=t0, timeout=timeout, bnb=bnb,
+        t0=t0, timeout=timeout, bnb=bnb, memos=_memos,
     )
     if sw is None:
         return [_timeout_result(query, t0) for _ in range(K)]

@@ -2,6 +2,7 @@ from pydcop_tpu.parallel.mesh import (
     SHARD_AXIS,
     make_mesh,
     problem_pspecs,
+    shard_map,
     shard_problem,
     state_pspecs,
 )

@@ -27,13 +27,57 @@ from pydcop_tpu.ops.compile import ArityBucket, CompiledProblem
 SHARD_AXIS = "shard"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+    """Version-compat ``shard_map``: one call site shape for every jax
+    this repo runs on.
+
+    jax >= 0.6 exposes ``jax.shard_map`` with the ``check_vma`` kwarg;
+    0.4.x only has ``jax.experimental.shard_map.shard_map`` where the
+    same knob is spelled ``check_rep``.  Every sharded entry point
+    (``engine/batched.py``, the sharded HLO guards) goes through this
+    wrapper so a jax upgrade/downgrade is a one-line concern HERE, not
+    thirteen failing tier-1 tests.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+            **kwargs,
+        )
+    from jax.experimental import shard_map as _sm
+
+    return _sm.shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_vma,
+        **kwargs,
+    )
+
+
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
-    """A 1-D mesh over the first ``n_devices`` devices (default: all)."""
+    """A 1-D mesh over the first ``n_devices`` devices (default: all).
+
+    Single-process fallback: when more devices are requested than the
+    backend exposes, the error spells out the host-platform override
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=N``) that turns
+    one CPU into N virtual devices — the same mechanism the test suite
+    uses (``tests/conftest.py``) — instead of leaving the user to
+    reverse-engineer it from a bare count mismatch.
+    """
     devs = jax.devices()
     if n_devices is not None:
         if n_devices > len(devs):
             raise ValueError(
-                f"Requested {n_devices} devices, only {len(devs)} available"
+                f"Requested {n_devices} devices, only {len(devs)} "
+                "available; on a single-process CPU host set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{n_devices} before jax initializes to get virtual "
+                "devices"
             )
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (SHARD_AXIS,))

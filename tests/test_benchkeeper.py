@@ -7,7 +7,7 @@ and the CLI tests inject ``--now`` so staleness output is reproducible.
 The acceptance properties from the issue are pinned directly: a seeded
 10% regression gets verdict ``regression``, a 2x-variance null reads
 ``noise``, verdicts are bit-identical across runs, the history renders
-all nine rounds (including the two failed ones), and a fingerprint
+every round (including the two failed ones), and a fingerprint
 mismatch refuses the comparison instead of printing a number.
 """
 
@@ -253,7 +253,7 @@ class TestLedgerRoundTrip:
     def test_every_round_gets_a_status_row(self, seeded):
         status = [r for r in seeded if ledger.row_key(r) == ("bench_round", "rc")]
         assert sorted(r["round"] for r in status) == [
-            f"r{i:02d}" for i in range(1, 10)
+            f"r{i:02d}" for i in range(1, 11)
         ]
         by_round = {r["round"]: r for r in status}
         # r01 crashed (rc=1), r05 timed out (rc=0, nothing parsed) —
@@ -261,7 +261,7 @@ class TestLedgerRoundTrip:
         assert by_round["r01"]["value"] == 1.0
         assert by_round["r01"]["extra"]["parsed"] is False
         assert by_round["r05"]["extra"]["parsed"] is False
-        assert by_round["r09"]["extra"]["parsed"] is True
+        assert by_round["r10"]["extra"]["parsed"] is True
 
     def test_rows_round_trip_through_the_file(self, seeded, tmp_path):
         path = str(tmp_path / "ledger.jsonl")
@@ -412,15 +412,15 @@ class TestHistory:
         with pytest.raises(ValueError):
             history.compare_pairs_doc({"baseline": [1.0]})
 
-    def test_history_report_renders_all_nine_rounds(self):
+    def test_history_report_renders_every_round(self):
         rows = ledger.seed_rows(_REPO)
         now = ledger.parse_ts("2026-08-05T12:00:00Z")
         report = history.history_report(rows, now_epoch=now)
-        for i in range(1, 10):
+        for i in range(1, 11):
             assert f"r{i:02d}" in report
         assert "r01 FAIL" in report
         assert "r05 empty" in report
-        assert "r09 ok" in report
+        assert "r10 ok" in report
         # the TPU captures predate r09 by days: stale at the 72h bound
         assert "STALE" in report and "tpu:" in report
 
@@ -473,7 +473,7 @@ class TestCLIs:
         assert rc == 0
         assert "bench history — " in out
         assert "rounds:" in out
-        for i in range(1, 10):
+        for i in range(1, 11):
             assert f"r{i:02d}" in out
         assert "r01 FAIL" in out and "r05 empty" in out
         assert "north_star/msgs_per_sec" in out
@@ -499,7 +499,7 @@ class TestCLIs:
         ))
         doc = json.loads(capsys.readouterr().out)
         assert rc == 0
-        assert len(doc["rounds"]) == 9
+        assert len(doc["rounds"]) == 10
         assert any(f["backend"] == "tpu" and f["stale"]
                    for f in doc["freshness"])
 

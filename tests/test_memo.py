@@ -1,0 +1,257 @@
+"""Subtree-fingerprint message memoization (``engine/memo.py``): the
+O(delta) serving path of ISSUE 18.
+
+:class:`ExactSession` (DPOP UTIL/VALUE) and :class:`InferSession`
+(semiring contraction: ``map`` / ``log_z`` / ``marginals`` /
+``kbest:<k>``) pin a problem once and answer ``set_values``
+follow-ups by re-contracting ONLY the nodes whose subtree fingerprint
+(base structure + effective external values over the subtree) changed
+— every clean subtree's message comes from the per-session memo.
+
+The contract these tests pin: memoized follow-ups are EQUAL to a
+fresh cold solve of the mutated problem (bit-identical assignments
+and costs for the exact/argmin-certified queries, f64-tight for the
+mass queries), the memo counters partition the node set
+(``hits + recontracted == nodes``), value-keyed fingerprints re-hit
+when an external flips BACK, a zero-byte memo degrades to plain
+full sweeps (never to wrong answers), and the
+``engine.memo_hits`` / ``engine.memo_recontractions`` /
+``engine.memo_evictions`` telemetry counters meter the same events
+(docs/observability.md).
+"""
+
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import (
+    AgentDef,
+    Domain,
+    ExternalVariable,
+    Variable,
+)
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.engine.memo import ExactSession, InferSession
+from pydcop_tpu.telemetry import session
+
+D = Domain("d", "", [0, 1, 2])
+
+
+def ext_tree_dcop(n=8):
+    """A chain of n variables with ONE external 'sensor' driving the
+    head — a single set_values delta dirties the head's root path and
+    leaves every other subtree fingerprint unchanged."""
+    dcop = DCOP("memo_tree")
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    sensor = ExternalVariable("sensor", D, value=0)
+    dcop.add_variable(sensor)
+    for i in range(n - 1):
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c{i}",
+                f"1 if v{i} == v{i + 1} else abs(v{i} - v{i + 1})"
+                f" * 0.25 * {i + 1}",
+                vs,
+            )
+        )
+    dcop.add_constraint(
+        constraint_from_str(
+            "track", "0 if v0 == sensor else 2", [vs[0], sensor]
+        )
+    )
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def solve_cold(sensor_val, algo="dpop"):
+    """Fresh cold solve of the mutated problem — the parity oracle."""
+    from pydcop_tpu.algorithms.dpop import solve_host
+
+    d = ext_tree_dcop()
+    d.external_variables["sensor"].value = sensor_val
+    return solve_host(d, {})
+
+
+def infer_cold(sensor_val, query, **kw):
+    from pydcop_tpu.api import infer
+
+    d = ext_tree_dcop()
+    d.external_variables["sensor"].value = sensor_val
+    return infer(d, query, device="never", **kw)
+
+
+# -- ExactSession (DPOP) ----------------------------------------------
+
+
+@pytest.mark.dpop
+def test_exact_session_deltas_match_cold_solves():
+    es = ExactSession(ext_tree_dcop())
+    r0 = es.solve()
+    ref0 = solve_cold(0)
+    assert r0["cost"] == ref0["cost"]
+    assert r0["assignment"] == ref0["assignment"]
+    # cold solve: nothing to hit, everything stored
+    assert r0["memo"]["hits"] == 0
+    assert r0["memo"]["recontracted"] == r0["memo"]["nodes"]
+
+    for val in (1, 2, 0):
+        touched = es.set_values({"sensor": val})
+        assert touched == ["track"]
+        r = es.solve()
+        ref = solve_cold(val)
+        assert r["cost"] == ref["cost"], val
+        assert r["assignment"] == ref["assignment"], val
+        m = r["memo"]
+        assert m["hits"] + m["recontracted"] == m["nodes"]
+        # one delta dirties only the tracked head's root path
+        assert m["hits"] >= 1, m
+        assert m["recontracted"] < m["nodes"], m
+
+
+@pytest.mark.dpop
+def test_exact_session_no_delta_follow_up_hits_every_node():
+    es = ExactSession(ext_tree_dcop())
+    es.solve()
+    r = es.solve()
+    assert r["memo"]["hits"] == r["memo"]["nodes"], r["memo"]
+    assert r["memo"]["recontracted"] == 0
+
+
+@pytest.mark.dpop
+def test_exact_session_value_keyed_fingerprints_rehit_on_flip_back():
+    """A -> B -> A must re-hit A's entries: fingerprints key on the
+    effective external VALUES, not on a dirty bit."""
+    es = ExactSession(ext_tree_dcop())
+    es.solve()
+    es.set_values({"sensor": 1})
+    es.solve()
+    es.set_values({"sensor": 0})
+    r = es.solve()
+    # flip-back re-hits the clean subtrees; only entries the sensor=1
+    # pass overwrote (the dirty path holds ONE entry per node, latest
+    # fingerprint) re-contract
+    assert r["memo"]["hits"] >= 1, r["memo"]
+    assert r["cost"] == solve_cold(0)["cost"]
+
+
+@pytest.mark.dpop
+def test_exact_session_zero_byte_memo_degrades_to_full_sweeps():
+    es = ExactSession(ext_tree_dcop(), memo_bytes=0)
+    es.solve()
+    es.set_values({"sensor": 2})
+    r = es.solve()
+    assert r["memo"]["hits"] == 0
+    assert r["memo"]["recontracted"] == r["memo"]["nodes"]
+    ref = solve_cold(2)
+    assert r["cost"] == ref["cost"]
+    assert r["assignment"] == ref["assignment"]
+
+
+@pytest.mark.dpop
+def test_exact_session_set_values_rejects_unknown_external():
+    es = ExactSession(ext_tree_dcop())
+    with pytest.raises(ValueError, match="not an external"):
+        es.set_values({"nope": 1})
+
+
+@pytest.mark.dpop
+def test_exact_session_does_not_mutate_the_caller_dcop():
+    dcop = ext_tree_dcop()
+    es = ExactSession(dcop)
+    es.set_values({"sensor": 2})
+    es.solve()
+    assert dcop.external_variables["sensor"].value == 0
+
+
+@pytest.mark.dpop
+def test_memo_telemetry_counters_meter_hits_and_recontractions():
+    with session() as tel:
+        es = ExactSession(ext_tree_dcop())
+        r0 = es.solve()
+        es.set_values({"sensor": 1})
+        r1 = es.solve()
+    counters = tel.summary()["counters"]
+    assert counters.get("engine.memo_hits", 0) == r1["memo"]["hits"]
+    assert counters.get("engine.memo_recontractions", 0) == (
+        r0["memo"]["recontracted"] + r1["memo"]["recontracted"]
+    )
+
+
+# -- InferSession (semiring queries) ----------------------------------
+
+
+@pytest.mark.semiring
+def test_infer_session_map_parity_across_deltas():
+    ses = InferSession(ext_tree_dcop(), "map", device="never")
+    for val in (0, 2, 0):
+        ses.set_values({"sensor": val})
+        r = ses.solve()
+        ref = infer_cold(val, "map")
+        assert r["assignment"] == ref["assignment"], val
+        assert r["cost"] == ref["cost"], val
+    assert ses.last_memo["hits"] >= 1
+
+
+@pytest.mark.semiring
+def test_infer_session_log_z_and_marginals_parity_across_deltas():
+    ses = InferSession(ext_tree_dcop(), "marginals", device="never")
+    for val in (0, 1, 0):
+        ses.set_values({"sensor": val})
+        r = ses.solve()
+        ref = infer_cold(val, "marginals")
+        assert r["log_z"] == pytest.approx(
+            ref["log_z"], rel=1e-12, abs=1e-12
+        ), val
+        for v, dist in ref["marginals"].items():
+            assert r["marginals"][v] == pytest.approx(
+                dist, rel=1e-9, abs=1e-12
+            ), (val, v)
+    m = ses.last_memo
+    assert m["hits"] + m["recontracted"] == m["nodes"]
+    assert m["hits"] >= 1
+
+
+@pytest.mark.semiring
+def test_infer_session_kbest_parity_across_deltas():
+    ses = InferSession(ext_tree_dcop(), "kbest:4", device="never")
+    for val in (0, 2):
+        ses.set_values({"sensor": val})
+        r = ses.solve()
+        ref = infer_cold(val, "kbest:4")
+        assert [s["assignment"] for s in r["solutions"]] == [
+            s["assignment"] for s in ref["solutions"]
+        ], val
+        assert r["costs"] == pytest.approx(ref["costs"]), val
+
+
+@pytest.mark.semiring
+def test_infer_session_rejects_plan_specific_queries():
+    with pytest.raises(ValueError, match="no memoized session"):
+        InferSession(ext_tree_dcop(), "marginal_map")
+    with pytest.raises(ValueError, match="no memoized session"):
+        InferSession(ext_tree_dcop(), "expectation")
+
+
+@pytest.mark.semiring
+def test_tiny_memo_evicts_but_stays_correct():
+    """An undersized memo thrashes (evictions > 0) yet every answer
+    still matches the cold oracle — eviction is a performance event,
+    never a correctness event."""
+    # ~372 B/entry on this workload: 1 KiB holds two-ish of the 8
+    # nodes, so every sweep evicts (a cap below ONE entry would
+    # instead skip the store entirely — the oversized-table path)
+    ses = InferSession(
+        ext_tree_dcop(), "map", device="never", memo_bytes=1024
+    )
+    for val in (0, 1, 2, 0):
+        ses.set_values({"sensor": val})
+        r = ses.solve()
+        ref = infer_cold(val, "map")
+        assert r["assignment"] == ref["assignment"], val
+    assert ses.memo.evictions > 0
+    assert ses.last_memo["evictions"] == ses.memo.evictions
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

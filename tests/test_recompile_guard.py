@@ -217,6 +217,31 @@ def test_bnb_guard_pruned_kernels_share_buckets():
     assert report["pruned_cells"] >= 1, report
 
 
+@pytest.mark.dpop
+def test_delta_guard_warm_followup_is_o_delta():
+    """The O(delta) incremental-contraction acceptance criterion
+    (ISSUE 18): a 1-delta ``set_values`` follow-up on a ~10k-node
+    broad tree through a live exact session performs ZERO XLA
+    compiles, re-contracts < 5% of the nodes (memo-hitting the
+    rest), and is bit-identical (cost AND assignment) to a fresh
+    cold solve at the post-delta externals.  See
+    tools/recompile_guard.py:run_delta_guard."""
+    guard = _load_guard()
+    report = guard.run_delta_guard()
+    assert report["ok"], report
+    assert report["nodes"] >= 10_000, report
+    assert report["cold_compiles"] >= 1, report  # guard actually ran
+    assert report["warm_compiles"] == 0, report
+    assert (
+        report["recontracted_fraction"] <= guard.DELTA_MAX_FRACTION
+    ), report
+    assert (
+        report["warm_memo"]["hits"]
+        + report["warm_memo"]["recontracted"]
+        == report["nodes"]
+    ), report
+
+
 @pytest.mark.membound
 def test_membound_guard_budgeted_solve_reuses_buckets():
     """Memory-bounded solves (ops/membound.py): the first budgeted

@@ -422,6 +422,149 @@ def test_session_follow_up_with_different_dcop_is_rejected():
         )["segment"] == 2
 
 
+# -- exact sessions: the O(delta) memoized serving path ----------------
+
+
+def _host_ref(sensor_val):
+    """Fresh cold solve of the mutated problem — the parity oracle
+    for the memoized exact-session path."""
+    from pydcop_tpu.algorithms.dpop import solve_host
+
+    d = sensor_dcop()
+    d.external_variables["sensor"].value = sensor_val
+    r = solve_host(d, {})
+    return r["cost"], r["assignment"]
+
+
+def test_exact_session_segments_memo_hit_and_match_reference():
+    """ISSUE 18: a session whose algo has ``solve_host`` (dpop) is
+    served by a live memoized :class:`ExactSession` — segment 1 is
+    the cold sweep, a 1-delta follow-up re-contracts only the dirty
+    path (memo hits on the rest), a no-delta follow-up hits EVERY
+    node, and every segment is bit-identical to a fresh cold solve of
+    the mutated problem.  Non-memoized exact algos (syncbb) ride the
+    same session dispatch through a plain pinned clone."""
+    with session() as tel:
+        with SolverService(
+            max_batch=1, max_wait=0.0, autostart=False
+        ) as svc:
+            r1 = svc.solve(sensor_dcop(), "dpop", {}, session="c1")
+            assert r1["segment"] == 1
+            assert r1["memo"]["hits"] == 0
+            cost0, asg0 = _host_ref(0)
+            assert (r1["cost"], r1["assignment"]) == (cost0, asg0)
+
+            r2 = svc.solve(
+                None, "dpop", {}, session="c1",
+                set_values={"sensor": 2},
+            )
+            assert r2["segment"] == 2
+            cost2, asg2 = _host_ref(2)
+            assert (r2["cost"], r2["assignment"]) == (cost2, asg2)
+            m = r2["memo"]
+            assert m["hits"] >= 1, m
+            assert m["hits"] + m["recontracted"] == m["nodes"], m
+
+            r3 = svc.solve(None, "dpop", {}, session="c1")
+            assert r3["memo"]["hits"] == r3["memo"]["nodes"]
+            assert r3["cost"] == cost2
+
+            # plain exact algo: same session surface, no memo block
+            rs = svc.solve(sensor_dcop(), "syncbb", {}, session="c2")
+            assert rs["cost"] == cost0
+            rs2 = svc.solve(
+                None, "syncbb", {}, session="c2",
+                set_values={"sensor": 2},
+            )
+            assert rs2["cost"] == cost2
+            assert "memo" not in rs2
+    counters = tel.summary()["counters"]
+    assert counters.get("engine.memo_hits", 0) >= r2["memo"][
+        "hits"
+    ] + r3["memo"]["hits"]
+
+
+def test_exact_session_checkpoint_resume_replays_memoized(tmp_path):
+    """Satellite acceptance (serve --resume): the drained checkpoint
+    records the memoized sessions' algo params, a resuming service
+    warm-replays them (ONE solve at the final accumulated state), and
+    the restored session's FIRST live follow-up is already O(delta):
+    memo hits on the replayed segments, ZERO XLA compiles, zero full
+    rebuilds (the exact path never touches ``compile.full``) —
+    bit-identical to a fresh cold solve of the mutated problem."""
+    ck = str(tmp_path / "sessions.json")
+    with SolverService(
+        max_batch=1, max_wait=0.0, autostart=False,
+        session_checkpoint=ck,
+    ) as svc:
+        svc.solve(sensor_dcop(), "dpop", {}, session="c1")
+        svc.solve(
+            None, "dpop", {}, session="c1",
+            set_values={"sensor": 2},
+        )
+        svc.solve(sensor_dcop(), "syncbb", {}, session="c2")
+    # drain wrote the exact record: dpop (memoized) yes, syncbb no
+    import json as _json
+
+    with open(ck) as f:
+        doc = _json.load(f)
+    ent = {e["name"]: e for e in doc["sessions"]}
+    assert "dpop" in ent["c1"]["exact"], ent["c1"]
+    assert ent["c2"].get("exact") == {}, ent["c2"]
+
+    with SolverService(
+        max_batch=1, max_wait=0.0, autostart=False,
+        session_checkpoint=ck, resume=True,
+    ) as svc:
+        with session() as tel:
+            r = svc.solve(
+                None, "dpop", {}, session="c1",
+                set_values={"sensor": 1},
+            )
+        cost1, asg1 = _host_ref(1)
+        assert (r["cost"], r["assignment"]) == (cost1, asg1)
+        assert r["memo"]["hits"] >= 1, r["memo"]
+        counters = tel.summary()["counters"]
+        assert counters.get("jit.compiles", 0) == 0, counters
+        assert counters.get("compile.full", 0) == 0, counters
+
+
+def test_standby_promotion_followup_is_o_delta():
+    """Satellite acceptance (fleet standby tail replay): a standby
+    applies a replicated exact session via ONE rebuild solve, follows
+    the owner's delta stream with cheap ``set_values``-only
+    incremental entries (no per-segment re-solves), and the
+    promotion follow-up memo-hits the clean subtrees with ZERO XLA
+    compiles — bit-identical to the owner's own follow-up."""
+    with SolverService(
+        max_batch=1, max_wait=0.0, autostart=False
+    ) as owner:
+        owner.solve(sensor_dcop(), "dpop", {}, session="c1")
+        with SolverService(
+            max_batch=1, max_wait=0.0, autostart=False
+        ) as standby:
+            rep = standby.apply_replica_entry(owner.session_entry("c1"))
+            assert rep["mode"] == "rebuild", rep
+            # owner streams a delta; the standby applies it as an
+            # incremental entry (set_values only, no solve)
+            r_owner = owner.solve(
+                None, "dpop", {}, session="c1",
+                set_values={"sensor": 2},
+            )
+            rep2 = standby.apply_replica_entry(
+                owner.session_entry("c1")
+            )
+            assert rep2["mode"] == "incremental", rep2
+            # promote: the standby serves the session's next segment
+            with session() as tel:
+                r6 = standby.solve(None, "dpop", {}, session="c1")
+            assert r6["cost"] == r_owner["cost"]
+            assert r6["assignment"] == r_owner["assignment"]
+            assert r6["memo"]["hits"] >= 1, r6["memo"]
+            counters = tel.summary()["counters"]
+            assert counters.get("jit.compiles", 0) == 0, counters
+
+
 # -- device chaos on the serving path ----------------------------------
 
 

@@ -317,6 +317,12 @@ _METRIC_SPECS: Tuple[Tuple[str, str, str, bool, Tuple[str, ...]], ...] = (
      ("bnb", "util_cells_per_sec_on")),
     ("bnb", "pruned_fraction", "fraction", True,
      ("bnb", "pruned_fraction")),
+    ("incremental", "speedup_delta_vs_full", "ratio", True,
+     ("incremental", "speedup_delta_vs_full")),
+    ("incremental", "delta_solve_s", "s", False,
+     ("incremental", "delta_solve_s")),
+    ("incremental", "memo_hit_fraction", "fraction", True,
+     ("incremental", "memo_hit_fraction")),
     ("obs_overhead", "overhead_pct", "pct", False,
      ("obs_overhead", "overhead_pct")),
     ("supervised_overhead", "maxsum_overhead_pct", "pct", False,

@@ -74,6 +74,31 @@ WALL_SECONDS_RECORDED = 0.04
 WALL_RATIO_BOUND = 25.0
 WALL_REPS = 3
 
+# --- delta-workload budgets (ISSUE 18, engine/memo.py) ----------------
+# Workload: _build_delta_tree(24, 24, seed=181) — a 600-node broad
+# tree — cold ExactSession solve, then ONE set_values delta ({e0: 1})
+# and a warm memoized re-solve.  The warm segment's counters are
+# deterministic functions of the tree + the dirty path and gate HARD:
+# memo hits + re-contractions partition the node set, the dispatch
+# count is the dirty level-bucket count, and the warm segment performs
+# ZERO XLA compiles (the cold solve pre-warmed the 1-row kernels).
+# Wall-clock warns only, same discipline as the workload above.
+# Recorded 2026-08-07 on the 2-vCPU CPU box (JAX_PLATFORMS=cpu).
+
+#: nodes in the blessed delta tree (sanity anchor for the row)
+DELTA_NODES_BUDGET = 600
+#: warm-segment memo hits (exact: every node off the dirty path)
+DELTA_MEMO_HITS_BUDGET = 576
+#: warm-segment re-contractions (exact: the dirty path — the touched
+#: leaf's hub ancestors plus the leaf)
+DELTA_RECONTRACTED_BUDGET = 24
+#: warm-segment device dispatches (exact: one per dirty level bucket)
+DELTA_WARM_DISPATCHES_BUDGET = 24
+#: warm-segment XLA compiles (exact: the zero-compile guarantee)
+DELTA_WARM_COMPILE_BUDGET = 0
+#: min-of-reps warm delta wall-clock on the recording box, seconds
+DELTA_WALL_SECONDS_RECORDED = 0.02
+
 
 def _counters(tel) -> dict:
     return tel.summary()["counters"]
@@ -179,6 +204,116 @@ def run_perf_guard(
     return report
 
 
+def run_delta_perf_guard(
+    *,
+    memo_bytes: int = 64 << 20,
+    wall_reps: int = WALL_REPS,
+) -> dict:
+    """Run the blessed DELTA workload (the ``DELTA_*`` budgets above)
+    and judge the WARM segment's counters against them.
+
+    ``memo_bytes=0`` disables the memo so the tier-1 test can prove
+    the guard trips: every node re-contracts, zero hits — the row
+    must fail on the memo counters, not on wall-clock."""
+    from pydcop_tpu.engine.memo import ExactSession
+    from pydcop_tpu.ops import semiring as sr_mod
+    from pydcop_tpu.telemetry import session
+
+    sr_mod._KERNELS.clear()
+    dcop = _rg._build_delta_tree(24, 24, seed=181)
+    params = {"util_device": "always"}
+    es = ExactSession(
+        dcop, pad_policy="pow2", memo_bytes=memo_bytes, clone=False
+    )
+    with session() as t_cold:
+        cold_r = es.solve(params)
+    cold = _counters(t_cold)
+    with session() as t_warm:
+        es.set_values({"e0": 1})
+        r = es.solve(params)
+    warm = _counters(t_warm)
+
+    # warm wall-clock canary: alternate the delta so every rep is a
+    # genuine 1-delta re-solve (A->B->A re-hits the value-keyed memo)
+    times = []
+    val = 0
+    for _ in range(max(1, wall_reps)):
+        t0 = time.perf_counter()
+        es.set_values({"e0": val})
+        es.solve(params)
+        times.append(time.perf_counter() - t0)
+        val = 1 - val
+    wall_min = min(times)
+    wall_bound = DELTA_WALL_SECONDS_RECORDED * WALL_RATIO_BOUND
+
+    hits = int(warm.get("engine.memo_hits", 0))
+    recon = int(warm.get("engine.memo_recontractions", 0))
+    warm_compiles = int(warm.get("jit.compiles", 0))
+    warm_dispatches = int(r["util_dispatches"])
+    report = {
+        "workload": "delta_tree_24x24_dpop_memo_1delta",
+        "nodes": r["memo"]["nodes"],
+        "nodes_budget": DELTA_NODES_BUDGET,
+        "best_cost": r["cost"],
+        "cold_cost": cold_r["cost"],
+        "cold_jit_compiles": int(cold.get("jit.compiles", 0)),
+        "memo_hits": hits,
+        "memo_hits_budget": DELTA_MEMO_HITS_BUDGET,
+        "recontracted": recon,
+        "recontracted_budget": DELTA_RECONTRACTED_BUDGET,
+        "warm_dispatches": warm_dispatches,
+        "warm_dispatches_budget": DELTA_WARM_DISPATCHES_BUDGET,
+        "warm_jit_compiles": warm_compiles,
+        "warm_compile_budget": DELTA_WARM_COMPILE_BUDGET,
+        "wall_seconds_min": round(wall_min, 4),
+        "wall_seconds_recorded": DELTA_WALL_SECONDS_RECORDED,
+        "wall_ratio_bound": WALL_RATIO_BOUND,
+        "wall_ok": wall_min <= wall_bound,
+        "ok": True,
+        "error": None,
+    }
+    failures = []
+    if r["memo"]["nodes"] != DELTA_NODES_BUDGET:
+        failures.append(
+            f"nodes {r['memo']['nodes']} != recorded "
+            f"{DELTA_NODES_BUDGET} (the blessed tree changed)"
+        )
+    if hits != DELTA_MEMO_HITS_BUDGET:
+        failures.append(
+            f"memo_hits {hits} != recorded "
+            f"{DELTA_MEMO_HITS_BUDGET} (fingerprints churning, or "
+            "the memo died)"
+        )
+    if recon != DELTA_RECONTRACTED_BUDGET:
+        failures.append(
+            f"recontracted {recon} != recorded "
+            f"{DELTA_RECONTRACTED_BUDGET} (the dirty path grew — "
+            "the O(delta) property drifted)"
+        )
+    if warm_dispatches != DELTA_WARM_DISPATCHES_BUDGET:
+        failures.append(
+            f"warm_dispatches {warm_dispatches} != recorded "
+            f"{DELTA_WARM_DISPATCHES_BUDGET} (dirty-bucket "
+            "dispatching drifted)"
+        )
+    if warm_compiles > DELTA_WARM_COMPILE_BUDGET:
+        failures.append(
+            f"warm_jit_compiles {warm_compiles} > "
+            f"{DELTA_WARM_COMPILE_BUDGET} (the kernel pre-warm "
+            "regressed — warm deltas are paying XLA compiles)"
+        )
+    if failures:
+        report["ok"] = False
+        report["error"] = "; ".join(failures)
+    if not report["wall_ok"]:
+        report["wall_warning"] = (
+            f"warm delta min {wall_min:.3f}s exceeds "
+            f"{DELTA_WALL_SECONDS_RECORDED}s x {WALL_RATIO_BOUND:g} "
+            "— machine slow or a real slowdown; counters decide"
+        )
+    return report
+
+
 def main() -> int:
     import jax
 
@@ -186,8 +321,14 @@ def main() -> int:
     # recompile guard so the axon TPU plugin can't hijack the run
     jax.config.update("jax_platforms", "cpu")
     report = run_perf_guard()
-    print(json.dumps(report, default=float))
-    return 0 if report["ok"] else 1
+    report_delta = run_delta_perf_guard()
+    print(
+        json.dumps(
+            {"workload": report, "delta": report_delta},
+            default=float,
+        )
+    )
+    return 0 if report["ok"] and report_delta["ok"] else 1
 
 
 if __name__ == "__main__":

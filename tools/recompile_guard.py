@@ -175,6 +175,23 @@ MEMBOUND_B1 = 256
 MEMBOUND_B2 = 128
 MEMBOUND_BUDGET = 12  # recorded: 11 compiles for the 64-lane sweep
 
+# O(delta) incremental contraction (ISSUE 18, engine/memo.py): a
+# 1-delta ``set_values`` follow-up on a ~10k-node broad tree through a
+# live exact session must (1) perform ZERO XLA compiles — the cold
+# solve pre-warmed the 1-row variants of every level-pack kernel it
+# used — (2) re-contract fewer than DELTA_MAX_FRACTION of the nodes
+# (the dirty root-to-changed-constraint path: the touched leaf plus
+# its hub ancestors, O(depth) of O(n)), memo-hitting every other
+# node, and (3) return cost AND assignment bit-identical to a fresh
+# cold solve at the post-delta externals (min_sum ⊕ is idempotent —
+# memo reuse must be exact, not approximate).  Extra compiles = the
+# pre-warm or the stacked 1-row gate regressed; extra re-contractions
+# = the subtree fingerprints are churning (an O(n) sweep hiding
+# behind a warm cache); any result drift = stale-message reuse.
+DELTA_HUBS = 100
+DELTA_LEAVES = 100
+DELTA_MAX_FRACTION = 0.05
+
 
 def _build_dcop():
     from pydcop_tpu.dcop.dcop import DCOP
@@ -1481,6 +1498,153 @@ def run_membound_guard() -> dict:
     return report
 
 
+def _build_delta_tree(n_hubs: int, n_leaves: int, seed: int):
+    """A broad 'fleet telemetry' tree: a chain of hub variables, each
+    fanning out to ``n_leaves`` leaves, plus ONE external-driven
+    tracking constraint on a single leaf of the last hub — the
+    serving-delta shape (one ``set_values`` touches one constraint;
+    the dirty subtree-fingerprint set is that leaf plus its hub
+    ancestors, O(depth) of the O(n) nodes).  Binary domain keeps
+    every table tiny, so the sweep's cost is dominated by node COUNT
+    — exactly what the re-contraction counter meters."""
+    import random
+
+    import numpy as np
+
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import (
+        AgentDef,
+        Domain,
+        ExternalVariable,
+        Variable,
+    )
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rnd = random.Random(seed)
+    dcop = DCOP(f"delta_tree_{n_hubs}x{n_leaves}_{seed}")
+    dom = Domain("b", "", [0, 1])
+    ext = ExternalVariable("e0", dom, value=0)
+    dcop.add_variable(ext)
+
+    def m22():
+        return np.array(
+            [
+                [rnd.uniform(0.0, 1.0) for _ in range(2)]
+                for _ in range(2)
+            ],
+            dtype=np.float64,
+        )
+
+    prev = None
+    track_leaf = None
+    for h in range(n_hubs):
+        hv = Variable(f"h{h}", dom)
+        dcop.add_variable(hv)
+        if prev is not None:
+            dcop.add_constraint(
+                NAryMatrixRelation([prev, hv], m22(), name=f"ch{h}")
+            )
+        for leaf in range(n_leaves):
+            lv = Variable(f"x{h}_{leaf}", dom)
+            dcop.add_variable(lv)
+            dcop.add_constraint(
+                NAryMatrixRelation(
+                    [hv, lv], m22(), name=f"c{h}_{leaf}"
+                )
+            )
+            track_leaf = lv
+        prev = hv
+    dcop.add_constraint(
+        NAryMatrixRelation([track_leaf, ext], m22(), name="track")
+    )
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def run_delta_guard() -> dict:
+    """O(delta) serving-path guard (the DELTA_* constants above): a
+    live :class:`~pydcop_tpu.engine.memo.ExactSession` on a ~10k-node
+    broad tree — cold solve, then a 1-delta ``set_values`` follow-up
+    that must re-contract < 5% of the nodes with ZERO new XLA
+    compiles, bit-identical (cost and assignment) to a fresh cold
+    solve at the post-delta externals."""
+    from pydcop_tpu.algorithms import dpop
+    from pydcop_tpu.engine.memo import ExactSession
+    from pydcop_tpu.telemetry import session
+
+    dpop._JOIN_KERNELS.clear()
+
+    dcop = _build_delta_tree(DELTA_HUBS, DELTA_LEAVES, seed=180)
+    params = {"util_device": "always"}
+
+    def compiles(tel):
+        return int(tel.summary()["counters"].get("jit.compiles", 0))
+
+    es = ExactSession(dcop, pad_policy="pow2", clone=False)
+    n_nodes = len(es.names)
+    with session() as t_cold:
+        cold = es.solve(params)
+    es.set_values({"e0": 1})
+    with session() as t_warm:
+        warm = es.solve(params)
+    warm_compiles = compiles(t_warm)
+
+    # reference: a FRESH cold solve of the post-delta problem (the
+    # external already reads 1 through the un-cloned dcop)
+    ref = dpop.solve_host(dcop, dict(params), pad_policy="pow2")
+
+    frac = warm["memo"]["recontracted"] / max(1, n_nodes)
+    report = {
+        "nodes": n_nodes,
+        "cold_compiles": compiles(t_cold),
+        "warm_compiles": warm_compiles,
+        "cold_memo": cold["memo"],
+        "warm_memo": warm["memo"],
+        "recontracted_fraction": round(frac, 5),
+        "max_fraction": DELTA_MAX_FRACTION,
+        "cold_util_time": round(cold["util_time"], 4),
+        "warm_util_time": round(warm["util_time"], 4),
+        "cost": warm["cost"],
+        "ok": True,
+    }
+    if cold["memo"]["hits"] != 0 or cold["memo"][
+        "recontracted"
+    ] != n_nodes:
+        report["ok"] = False
+        report["error"] = (
+            f"cold solve reported {cold['memo']} over {n_nodes} "
+            "nodes — the memo claims hits before anything was "
+            "stored (fingerprinting is broken, the guard is vacuous)"
+        )
+    elif warm_compiles != 0:
+        report["ok"] = False
+        report["error"] = (
+            f"{warm_compiles} XLA compile(s) on a warm 1-delta "
+            "follow-up — the post-solve kernel pre-warm (or the "
+            "1-row stacked-dispatch gate) regressed; warm deltas "
+            "must ride already-compiled executables"
+        )
+    elif frac > DELTA_MAX_FRACTION:
+        report["ok"] = False
+        report["error"] = (
+            f"re-contracted {warm['memo']['recontracted']}/{n_nodes} "
+            f"nodes ({frac:.1%}) > {DELTA_MAX_FRACTION:.0%} — the "
+            "subtree fingerprints are churning; the O(delta) path "
+            "has regressed to an O(n) sweep"
+        )
+    elif (
+        warm["cost"] != ref["cost"]
+        or warm["assignment"] != ref["assignment"]
+    ):
+        report["ok"] = False
+        report["error"] = (
+            f"memoized follow-up diverges from the fresh cold solve "
+            f"({warm['cost']} vs {ref['cost']}) — stale message "
+            "reuse; memo hits must be bit-exact under idempotent ⊕"
+        )
+    return report
+
+
 def main() -> int:
     import jax
 
@@ -1498,6 +1662,7 @@ def main() -> int:
     report_bnb = run_bnb_guard()
     report_restore = run_restore_guard()
     report_fleet = run_fleet_guard()
+    report_delta = run_delta_guard()
     print(
         json.dumps(
             {
@@ -1512,6 +1677,7 @@ def main() -> int:
                 "bnb": report_bnb,
                 "restore": report_restore,
                 "fleet": report_fleet,
+                "delta": report_delta,
             }
         )
     )
@@ -1528,6 +1694,7 @@ def main() -> int:
         and report_bnb["ok"]
         and report_restore["ok"]
         and report_fleet["ok"]
+        and report_delta["ok"]
         else 1
     )
 
