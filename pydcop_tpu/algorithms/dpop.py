@@ -145,6 +145,21 @@ algo_params: list = [
     # memory_bound's sequential conditioning passes for device runs;
     # the two are mutually exclusive.
     AlgoParameterDef("max_util_bytes", "int", None, 0),
+    # storage precision of the device-side UTIL part tables
+    # (docs/performance.md, "Mixed-precision table packs"): 'bf16'
+    # halves and 'int8' quarters the bytes each part ships (int8
+    # packs carry per-part scale/offset dequant params; reserved
+    # codes keep hard-constraint ±inf exact).  The join ACCUMULATOR
+    # stays f32 and the argmin certificate re-scales to the storage
+    # dtype's eps (+ the int8 quantization bound), so results stay
+    # BIT-IDENTICAL to f32: uncertifiable cells are repaired exactly
+    # on host f64 as always — low precision only widens the repair
+    # set (semiring.precision_repairs counts the affected tables).
+    # The dtype joins the level-pack bucket key (<=1 extra
+    # executable per bucket per dtype; run_precision_guard pins it).
+    AlgoParameterDef(
+        "table_dtype", "str", ["f32", "bf16", "int8"], "f32"
+    ),
 ]
 
 _EPS32 = float(np.finfo(np.float32).eps)
@@ -277,6 +292,7 @@ def solve_host(
     device_min_cells = _resolve_device_min_cells(params)
     level_sync = params.get("util_batch", "level") != "node"
     bnb = _semiring.as_bnb(params.get("bnb"), "auto")
+    table_dtype = _semiring.as_table_dtype(params.get("table_dtype"))
 
     from pydcop_tpu.telemetry import get_tracer
 
@@ -292,6 +308,7 @@ def solve_host(
             device_min_cells=device_min_cells,
             max_util_size=max_util_size,
             pad=pad, level_sync=level_sync, bnb=bnb,
+            table_dtype=table_dtype,
         )
         if util_stats is None:
             return None
@@ -463,6 +480,9 @@ def solve_host_many(
             *preps[i],
             _resolve_device_min_cells(params_list[i]),
             _semiring.as_bnb(params_list[i].get("bnb"), "auto"),
+            table_dtype=_semiring.as_table_dtype(
+                params_list[i].get("table_dtype")
+            ),
         )
         for i in merged_idx
     ]
@@ -700,6 +720,10 @@ class _UtilInstance(NamedTuple):
     # previous solution as {var: domain index} — seeds the bnb
     # incumbent so warm re-solves prune at least as hard as cold
     bnb_seed: Any = None
+    # device storage precision of this instance's UTIL part tables
+    # (algo param table_dtype); joins the level-pack bucket key so
+    # merged sweeps never mix dtypes inside one dispatch
+    table_dtype: str = "f32"
 
 
 def _util_phase(
@@ -714,6 +738,7 @@ def _util_phase(
     pad: PadPolicy = NO_PADDING,
     level_sync: bool = True,
     bnb: str = "off",
+    table_dtype: str = "f32",
 ):
     """Single-instance UTIL phase: the K=1 case of
     :func:`_util_phase_multi`.  Returns ``(best_choice, util_cells,
@@ -721,7 +746,8 @@ def _util_phase(
     outs = _util_phase_multi(
         [
             _UtilInstance(
-                graph, domains, depth, owned, device_min_cells, bnb
+                graph, domains, depth, owned, device_min_cells, bnb,
+                table_dtype=table_dtype,
             )
         ],
         t0, timeout, max_util_size=max_util_size,
@@ -844,6 +870,7 @@ def _util_phase_multi(
                     n: list(inst.graph.node(n).children)
                     for n in names_pre
                 },
+                table_dtype=inst.table_dtype,
             )
             if inst.bnb_seed is not None:
                 # warm re-solve: the previous solution re-evaluated
@@ -1059,16 +1086,18 @@ def _util_phase_multi(
             # the bnb MODE joins the bucket key: a merged sweep can
             # mix bnb=on/auto/off instances, and a pruned kernel's
             # signature (leading budget operand, keep output) must
-            # never share a bucket with the single-pass one
+            # never share a bucket with the single-pass one.  The
+            # storage dtype joins it too — an int8 kernel's dequant
+            # operands must never stack with bf16/f32 rows
             mode = inst.bnb if ctx is not None else "off"
             raw = (tuple(shape), tuple(a.shape for a in aligned),
-                   mode)
+                   mode, inst.table_dtype)
             key = _key_memo.get(raw)
             if key is None:  # UTIL trees repeat shapes heavily —
                 # memoize the lattice quantization per raw signature
                 key = _key_memo[raw] = util_level_key(
                     raw[0], raw[1], pad
-                ) + (mode,)
+                ) + (mode, raw[3])
             if key not in buckets:
                 buckets[key] = []
                 order.append(key)
@@ -1090,7 +1119,7 @@ def _util_phase_multi(
             entries = buckets[key]
             if timeout is not None and time.perf_counter() - t0 > timeout:
                 return None
-            pshape, part_shapes, bnb_mode = key
+            pshape, part_shapes, bnb_mode, bucket_dt = key
             n_rows = len(entries)
             shape0 = entries[0][0][5]
             uniform = all(it[5] == shape0 for it, _ in entries)
@@ -1256,9 +1285,45 @@ def _util_phase_multi(
                 if host_compacted:
                     continue
                 fn = _join_kernel(
-                    pshape, part_shapes, batched=True, bnb=use_bnb
+                    pshape, part_shapes, batched=True, bnb=use_bnb,
+                    table_dtype=bucket_dt,
                 )
-                casts = [b.astype(np.float32) for b in bufs]
+                if bucket_dt == "int8":
+                    # quantize per (row, part): one scale/offset pair
+                    # each, ghost rows at the identity dequant so
+                    # their zero codes decode to exact zeros
+                    n_parts = len(part_shapes)
+                    scales = np.ones(
+                        (stack_h, n_parts), dtype=np.float32
+                    )
+                    offsets = np.zeros(
+                        (stack_h, n_parts), dtype=np.float32
+                    )
+                    qbufs = [
+                        np.zeros((stack_h,) + ps, dtype=np.int8)
+                        for ps in part_shapes
+                    ]
+                    for i, b in enumerate(bufs):
+                        for r in range(n_rows):
+                            q, s, o = (
+                                _semiring.quantize_table_int8(b[r])
+                            )
+                            qbufs[i][r] = q
+                            scales[r, i] = s
+                            offsets[r, i] = o
+                    if met.enabled:
+                        met.inc(
+                            "semiring.int8_requant",
+                            n_rows * n_parts,
+                        )
+                    casts = [scales, offsets] + qbufs
+                else:
+                    casts = [
+                        b.astype(
+                            _semiring._np_table_dtype(bucket_dt)
+                        )
+                        for b in bufs
+                    ]
                 if use_bnb:
                     budgets32 = (
                         budgets.astype(np.float32)
@@ -1282,7 +1347,9 @@ def _util_phase_multi(
                             np.asarray(x) for x in fn(*casts)
                         ),
                         scope="dpop.level", width=stack_h,
-                        table_bytes=4 * int(np.prod(pshape)),
+                        table_bytes=_semiring.table_dtype_bytes(
+                            bucket_dt
+                        ) * int(np.prod(pshape)),
                     )
                     if use_bnb:
                         aminb, margb, keepb = outs_b
@@ -1309,7 +1376,7 @@ def _util_phase_multi(
                         if m is not None:
                             m.note_kernel(
                                 "min_sum", pshape, part_shapes,
-                                use_bnb,
+                                use_bnb, bucket_dt,
                             )
                 # certification, vectorized over the stack: slice the
                 # real region once, one argwhere against the per-row
@@ -1343,9 +1410,20 @@ def _util_phase_multi(
                             table_cells=int(np.prod(shape0))
                             * n_rows,
                         )
+                # certificate bound at the STORAGE precision: the
+                # accumulator is f32, but each part arrived rounded
+                # to bucket_dt, so eps scales to that dtype; int8
+                # adds its per-joined-cell quantization bound
+                eps_dt = _semiring.table_dtype_eps(bucket_dt)
                 errs = np.array(
                     [
-                        2.0 * _EPS32 * (len(it[6]) + 1) * it[7]
+                        2.0 * (
+                            eps_dt * (len(it[6]) + 1) * it[7]
+                            + (
+                                _semiring.int8_quant_bound(it[7])
+                                if bucket_dt == "int8" else 0.0
+                            )
+                        )
                         for it, _ in entries
                     ]
                 )
@@ -1356,6 +1434,11 @@ def _util_phase_multi(
                     )
                 )
                 n_bad = np.bincount(bad[:, 0], minlength=n_rows)
+                if bucket_dt != "f32" and len(bad) and met.enabled:
+                    # low-precision tables whose repair set is
+                    # non-empty: the ladder paid host-f64 repairs it
+                    # would not have at f32
+                    met.inc("semiring.precision_repairs")
                 bad_by_row: Dict[int, list] = {}
                 for cell in bad:
                     bad_by_row.setdefault(int(cell[0]), []).append(
@@ -1463,7 +1546,10 @@ def _util_phase_multi(
             # per-node dispatches: util_batch='node', singleton
             # buckets, or (rare) mixed real shapes under one padded
             # key
-            fn = _join_kernel(pshape, part_shapes, bnb=use_bnb)
+            fn = _join_kernel(
+                pshape, part_shapes, bnb=use_bnb,
+                table_dtype=bucket_dt,
+            )
             for item, aligned in entries:
                 (k, name, node, sep, target, shape, parts,
                  sum_max_abs, budget) = item
@@ -1567,6 +1653,26 @@ def _util_phase_multi(
                         np.asarray(a, dtype=np.float32)
                         for a in aligned
                     ]
+                if bucket_dt == "int8":
+                    n_parts = len(aligned)
+                    scales = np.ones(n_parts, dtype=np.float32)
+                    offsets = np.zeros(n_parts, dtype=np.float32)
+                    qparts = []
+                    for i, a in enumerate(aligned):
+                        q, s, o = _semiring.quantize_table_int8(a)
+                        qparts.append(q)
+                        scales[i] = s
+                        offsets[i] = o
+                    if met.enabled:
+                        met.inc("semiring.int8_requant", n_parts)
+                    aligned = [scales, offsets] + qparts
+                elif bucket_dt != "f32":
+                    aligned = [
+                        a.astype(
+                            _semiring._np_table_dtype(bucket_dt)
+                        )
+                        for a in aligned
+                    ]
                 if use_bnb:
                     aligned = [
                         np.float32(
@@ -1581,7 +1687,9 @@ def _util_phase_multi(
                             np.asarray(x) for x in fn(*a)
                         ),
                         scope="dpop.node", width=1,
-                        table_bytes=4 * int(np.prod(pshape)),
+                        table_bytes=_semiring.table_dtype_bytes(
+                            bucket_dt
+                        ) * int(np.prod(pshape)),
                     )
                 except DeviceOOMError:
                     # bottom of the OOM ladder: this single join does
@@ -1623,13 +1731,20 @@ def _util_phase_multi(
                             "semiring.bnb_pruned_cells", pruned_cells
                         )
                 try:
-                    _certify_and_repair(
+                    n_bad = _certify_and_repair(
                         name, parts, target, shape,
                         amin, margins, sum_max_abs,
+                        eps=_semiring.table_dtype_eps(bucket_dt),
+                        quant=(
+                            _semiring.int8_quant_bound(sum_max_abs)
+                            if bucket_dt == "int8" else 0.0
+                        ),
                     )
                 except _PrecisionFallback:
                     _host_redo(met, host_nodes, finish, item)
                     continue
+                if bucket_dt != "f32" and n_bad and met.enabled:
+                    met.inc("semiring.precision_repairs")
                 u = _exact_u_at(parts, target, shape, amin, keep=keep_r)
                 device_nodes[k] += 1
                 finish(
@@ -1655,21 +1770,28 @@ def _util_phase_multi(
 
 
 def _certify_and_repair(name, parts, target, shape,
-                        amin, margins, sum_max_abs):
-    """f32 argmin certificate + exact host repair of near-ties.
+                        amin, margins, sum_max_abs,
+                        eps=_EPS32, quant=0.0):
+    """Storage-precision argmin certificate + exact host repair of
+    near-ties.
 
-    Inputs to the f32 join are exact (children's utils are exact
-    f64, see _exact_u_at), so |J32 − J| ≤ local_err and a margin
-    ≥ 2·local_err proves the f32 argmin is the true argmin.  The
-    bound scales with Σ_i max|part_i| (NOT max|J|): parts of
-    mixed sign can cancel in J while each carries rounding error
-    at its own magnitude.  Uncertifiable cells get their row
-    recomputed exactly.  Raises _PrecisionFallback only when the
-    table is so tie-heavy that per-cell repair would dominate
+    Inputs to the device join are exact up to one rounding at the
+    storage dtype (children's utils are exact f64, see _exact_u_at),
+    so |J_dt − J| ≤ local_err and a margin ≥ 2·local_err proves the
+    device argmin is the true argmin.  The bound scales with
+    Σ_i max|part_i| (NOT max|J|): parts of mixed sign can cancel in
+    J while each carries rounding error at its own magnitude.
+    ``eps`` is the storage dtype's unit roundoff (f32 default; bf16
+    widens it) and ``quant`` the additive int8 quantization bound
+    (``ops/padding.py:int8_quant_bound``) — low precision only
+    widens the repair set, never changes the result.
+    Uncertifiable cells get their row recomputed exactly; returns
+    the repaired-cell count.  Raises _PrecisionFallback only when
+    the table is so tie-heavy that per-cell repair would dominate
     (symmetric problems — the device path is pointless there,
     not unsound).
     """
-    local_err = _EPS32 * (len(parts) + 1) * sum_max_abs
+    local_err = eps * (len(parts) + 1) * sum_max_abs + quant
     bad = np.argwhere(margins < 2.0 * local_err)
     if len(bad) * 10 > margins.size:
         raise _PrecisionFallback(
@@ -1681,6 +1803,7 @@ def _certify_and_repair(name, parts, target, shape,
         for dims, table in parts:
             row += _cell_slice(table, dims, target, cell)
         amin[cell] = int(row.argmin())
+    return len(bad)
 
 
 def _host_redo(met, host_nodes, finish, item):
@@ -1761,6 +1884,7 @@ def _join_kernel(
     part_shapes: Tuple[Tuple[int, ...], ...],
     batched: bool = False,
     bnb: bool = False,
+    table_dtype: str = "f32",
 ):
     """Jit-compiled join+projection for one (joined shape, aligned
     part shapes) bucket; ``batched=True`` vmaps it over a leading
@@ -1780,7 +1904,7 @@ def _join_kernel(
     """
     return _semiring.contraction_kernel(
         _semiring.MIN_SUM, tuple(shape), tuple(part_shapes),
-        batched=batched, bnb=bnb,
+        batched=batched, bnb=bnb, table_dtype=table_dtype,
     )
 
 

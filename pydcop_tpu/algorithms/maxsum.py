@@ -82,12 +82,15 @@ algo_params = [
     # the MXU — the round-4 layout candidate (BASELINE.md headroom
     # notes; adopt iff it beats 'auto' on the real chip)
     AlgoParameterDef("belief", "str", ["auto", "blockdiag"], "auto"),
-    # message-array storage dtype.  'bf16' stores q/r (and gathers
-    # them) in bfloat16 while ALL arithmetic stays f32 (upcast inside
-    # the kernels; belief accumulates in f32; reported costs are exact
-    # evaluations of the selected assignment either way) — the
-    # round-5 candidate for the gather-bound belief crossing: it pays
-    # iff Mosaic's gather cost is per byte, which
+    # message-array storage dtype — the MESSAGE-plane sibling of the
+    # contraction stack's table_dtype knob (ops/padding.py:
+    # as_table_dtype parses both, so 'bfloat16' spellings and typo
+    # suggestions behave identically).  'bf16' stores q/r (and
+    # gathers them) in bfloat16 while ALL arithmetic stays f32
+    # (upcast inside the kernels; belief accumulates in f32; reported
+    # costs are exact evaluations of the selected assignment either
+    # way) — the round-5 candidate for the gather-bound belief
+    # crossing: it pays iff Mosaic's gather cost is per byte, which
     # tools/bench_gather.py measures directly (VERDICT r4 next #1b).
     AlgoParameterDef("msg_dtype", "str", ["f32", "bf16"], "f32"),
     # branch-and-bound pruned factor marginalization
@@ -126,9 +129,13 @@ def init_state(
     noise = params.get("noise", 0.0) * jax.random.uniform(
         k_noise, (d, problem.n_vars), dtype=problem.unary.dtype
     )
+    from pydcop_tpu.ops.padding import as_table_dtype
+
     mdt = (
         jnp.bfloat16
-        if params.get("msg_dtype", "f32") == "bf16"
+        if as_table_dtype(
+            params.get("msg_dtype"), allowed=("f32", "bf16")
+        ) == "bf16"
         else problem.unary.dtype
     )
     state = {

@@ -73,6 +73,7 @@ def solve(
     on_numeric_fault: Optional[str] = None,
     max_util_bytes: Optional[int] = None,
     bnb: Optional[str] = None,
+    table_dtype: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -187,6 +188,7 @@ def solve(
             pad_policy=pad_policy, retry_budget=retry_budget,
             chunk_floor=chunk_floor, on_numeric_fault=on_numeric_fault,
             max_util_bytes=max_util_bytes, bnb=bnb,
+            table_dtype=table_dtype,
         )
         result["telemetry"] = tel.summary()
     return result
@@ -221,6 +223,7 @@ def _solve_dispatch(
     on_numeric_fault=None,
     max_util_bytes=None,
     bnb=None,
+    table_dtype=None,
 ) -> Dict[str, Any]:
     """Mode dispatch behind :func:`solve` (which owns the telemetry
     session and the ``result["telemetry"]`` attach)."""
@@ -447,6 +450,29 @@ def _solve_dispatch(
                 f"{algo_name!r} has none"
             )
         params_in = {**dict(params_in or {}), "bnb": str(bnb)}
+    if table_dtype is not None:
+        # storage precision of the device-side contraction tables —
+        # an algo param of the algorithms with a device contraction
+        # phase (dpop); this keyword is the discoverable spelling,
+        # like bnb (docs/performance.md, "Mixed-precision table
+        # packs").  Parsed early so typos fail with the shared
+        # nearest-name suggestion, not a generic param error.
+        from pydcop_tpu.ops.padding import as_table_dtype as _as_dt
+
+        if not any(
+            p.name == "table_dtype" for p in module.algo_params
+        ):
+            raise ValueError(
+                "table_dtype selects the storage precision of the "
+                "device contraction tables — supported by "
+                "algorithms with a device contraction phase "
+                f"(dpop) and by api.infer; {algo_name!r} has none "
+                "(maxsum's message-plane sibling is msg_dtype)"
+            )
+        params_in = {
+            **dict(params_in or {}),
+            "table_dtype": _as_dt(table_dtype),
+        }
     params = prepare_algo_params(params_in, module.algo_params)
 
     # every batched-mode call runs under a per-call supervisor
@@ -1130,6 +1156,7 @@ def infer(
         Mapping[str, Mapping[Any, float]]
     ] = None,
     bnb: str = "auto",
+    table_dtype: str = "f32",
 ) -> Dict[str, Any]:
     """Exact probabilistic inference over a DCOP's cost model — the
     semiring-generic twin of :func:`solve` (``docs/semirings.md``).
@@ -1205,6 +1232,17 @@ def infer(
     ``kbest`` results are bit-identical either way; the mass
     queries account any discarded mass into ``error_bound``.
 
+    ``table_dtype`` (``"f32"`` default, ``"bf16"``, ``"int8"``)
+    picks the STORAGE precision of the device contraction tables
+    (``docs/performance.md``, "Mixed-precision table packs"): the
+    accumulator stays f32 and the certificate ladder re-scales to
+    the storage precision, so ``map``/``kbest`` stay bit-identical
+    to f32 (uncertain nodes repair bf16 → f32 → host f64;
+    ``semiring.precision_repairs`` counts the demotions) while
+    ``log_z``/``marginals`` report an honestly widened
+    ``error_bound``.  bf16 halves and int8 quarters per-cell HBM —
+    the same ``max_util_bytes`` budget fits a smaller cut.
+
     Returns a result dict with ``status``/``time``/``telemetry``
     plus the query's payload, ``cells``/``dispatches``/
     ``device_nodes``/``host_nodes`` contraction stats, and the
@@ -1218,6 +1256,7 @@ def infer(
         trace_format=trace_format, compile_cache=compile_cache,
         retry_budget=retry_budget, max_util_bytes=max_util_bytes,
         map_vars=map_vars, external_dists=external_dists, bnb=bnb,
+        table_dtype=table_dtype,
     )[0]
 
 
@@ -1243,6 +1282,7 @@ def infer_many(
         Mapping[str, Mapping[Any, float]]
     ] = None,
     bnb: str = "auto",
+    table_dtype: str = "f32",
 ) -> list:
     """Run one inference ``query`` over MANY instances with their
     contraction sweeps MERGED — the :func:`solve_many` batching
@@ -1292,7 +1332,7 @@ def infer_many(
             pad_policy=pad_policy, max_table_size=max_table_size,
             max_util_bytes=max_util_bytes,
             map_vars=map_vars, external_dists=external_dists,
-            bnb=bnb,
+            bnb=bnb, table_dtype=table_dtype,
             timeout=(
                 None
                 if deadline is None

@@ -118,6 +118,16 @@ def set_parser(subparsers) -> None:
         "(default) prunes only dispatches whose per-row table "
         "clears a size threshold (docs/semirings.md)",
     )
+    p.add_argument(
+        "--table_dtype", choices=["f32", "bf16", "int8"], default="f32",
+        help="storage precision for packed contraction tables: "
+        "'bf16' halves and 'int8' quarters device table bytes while "
+        "the accumulator stays f32 — map/kbest stay bit-identical "
+        "via the certificate ladder, log_z/marginals carry an "
+        "honestly widened error_bound; also shrinks the per-cell "
+        "width the --max_util_bytes planner charges "
+        "(docs/performance.md, 'Mixed-precision table packs')",
+    )
     add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
 
@@ -155,6 +165,7 @@ def run_cmd(args) -> int:
         retry_budget=args.retry_budget,
         max_util_bytes=args.max_util_bytes,
         bnb=args.bnb,
+        table_dtype=args.table_dtype,
         map_vars=(
             [v.strip() for v in args.map_vars.split(",") if v.strip()]
             if args.map_vars

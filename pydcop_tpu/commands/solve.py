@@ -160,6 +160,18 @@ def set_parser(subparsers) -> None:
         "whose per-row table clears a size threshold "
         "(docs/semirings.md, 'Branch-and-bound pruning')",
     )
+    p.add_argument(
+        "--table_dtype", choices=["f32", "bf16", "int8"], default=None,
+        help="storage precision for packed contraction tables "
+        "(algorithms with a device contraction phase — dpop): "
+        "'bf16' halves and 'int8' quarters the bytes each table "
+        "ships to the device while the accumulator stays f32 and "
+        "the certificate ladder repairs uncertain nodes back to "
+        "f32/f64 — min/max-sum results stay bit-identical to the "
+        "f32 path.  Also shrinks the per-cell width the "
+        "--max_util_bytes planner charges "
+        "(docs/performance.md, 'Mixed-precision table packs')",
+    )
     add_supervisor_arguments(p)
     add_collect_arguments(p)
     add_trace_arguments(p)
@@ -174,6 +186,8 @@ def run_cmd(args) -> int:
         # an algo param (dpop/maxsum declare it) — the flag is just
         # the discoverable spelling, like --max_util_bytes
         params = {**params, "bnb": args.bnb}
+    if args.table_dtype is not None:
+        params = {**params, "table_dtype": args.table_dtype}
     if args.many:
         return _run_many_cmd(args, params)
     profile_ctx = None

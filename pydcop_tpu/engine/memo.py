@@ -87,10 +87,10 @@ class SweepMemo:
         )
         self._bytes = 0
         self.evictions = 0
-        # (sr_name, pshape, part_shapes, use_bnb) specs of every
-        # stacked kernel a memoized sweep dispatched — prewarm()
-        # compiles their stack-height-1 variants so a warm delta's
-        # lone dirty row never triggers an XLA compile
+        # (sr_name, pshape, part_shapes, use_bnb, table_dtype) specs
+        # of every stacked kernel a memoized sweep dispatched —
+        # prewarm() compiles their stack-height-1 variants so a warm
+        # delta's lone dirty row never triggers an XLA compile
         self._kernel_specs: "OrderedDict[tuple, None]" = OrderedDict()
         self._prewarmed: set = set()
 
@@ -144,9 +144,13 @@ class SweepMemo:
         pshape: Tuple[int, ...],
         part_shapes: Tuple[Tuple[int, ...], ...],
         use_bnb: bool,
+        table_dtype: str = "f32",
     ) -> None:
         self._kernel_specs[
-            (sr_name, tuple(pshape), tuple(part_shapes), bool(use_bnb))
+            (
+                sr_name, tuple(pshape), tuple(part_shapes),
+                bool(use_bnb), str(table_dtype),
+            )
         ] = None
 
     def prewarm(self, heights: Sequence[int] = (1,)) -> int:
@@ -156,24 +160,42 @@ class SweepMemo:
         cost lands in the COLD segment, never on a warm delta.
         Returns the number of kernel executions performed."""
         from pydcop_tpu.ops.semiring import (
+            _np_table_dtype,
             contraction_kernel,
             get_semiring,
         )
 
         n = 0
         for spec in list(self._kernel_specs):
-            sr_name, pshape, part_shapes, use_bnb = spec
+            sr_name, pshape, part_shapes, use_bnb, table_dtype = spec
             for h in heights:
                 if (spec, h) in self._prewarmed:
                     continue
                 fn = contraction_kernel(
                     get_semiring(sr_name), pshape, part_shapes,
                     batched=True, bnb=use_bnb,
+                    table_dtype=table_dtype,
                 )
-                args: List[Any] = [
-                    np.zeros((h,) + tuple(ps), dtype=np.float32)
-                    for ps in part_shapes
-                ]
+                args: List[Any]
+                if table_dtype == "int8":
+                    # mirror the stacked dispatch ABI: f32 dequant
+                    # params (identity) prepended before the codes
+                    np_ = len(part_shapes)
+                    args = [
+                        np.ones((h, np_), dtype=np.float32),
+                        np.zeros((h, np_), dtype=np.float32),
+                    ] + [
+                        np.zeros((h,) + tuple(ps), dtype=np.int8)
+                        for ps in part_shapes
+                    ]
+                else:
+                    args = [
+                        np.zeros(
+                            (h,) + tuple(ps),
+                            dtype=_np_table_dtype(table_dtype),
+                        )
+                        for ps in part_shapes
+                    ]
                 if use_bnb:
                     args.insert(
                         0, np.zeros((h,), dtype=np.float32)
@@ -226,8 +248,13 @@ class SweepMemoView:
         if met.enabled:
             met.inc("engine.memo_recontractions")
 
-    def note_kernel(self, sr_name, pshape, part_shapes, use_bnb):
-        self.memo.note_kernel(sr_name, pshape, part_shapes, use_bnb)
+    def note_kernel(
+        self, sr_name, pshape, part_shapes, use_bnb,
+        table_dtype="f32",
+    ):
+        self.memo.note_kernel(
+            sr_name, pshape, part_shapes, use_bnb, table_dtype
+        )
 
 
 # -- fingerprint machinery ----------------------------------------------
@@ -401,6 +428,7 @@ class ExactSession:
         from pydcop_tpu.ops import semiring as _sr
 
         bnb = _sr.as_bnb(params.get("bnb"), "auto")
+        table_dtype = _sr.as_table_dtype(params.get("table_dtype"))
         ext_now = {
             n: ev.value
             for n, ev in self.dcop.external_variables.items()
@@ -412,6 +440,7 @@ class ExactSession:
                 _dpop._UtilInstance(
                     self.graph, self.domains, self.depth,
                     self.owned, dmc, bnb, view, self.seed,
+                    table_dtype,
                 )
             ],
             t0, timeout, max_util_size=max_util_size,
@@ -484,6 +513,7 @@ class InferSession:
         pad_policy: Any = None,
         max_table_size: int = 1 << 26,
         bnb: str = "auto",
+        table_dtype: str = "f32",
         memo_bytes: int = DEFAULT_MEMO_BYTES,
         clone: bool = True,
     ):
@@ -505,6 +535,7 @@ class InferSession:
             device_min_cells=device_min_cells,
             pad_policy=pad_policy, max_table_size=max_table_size,
             bnb=bnb,
+            table_dtype=_sr.as_table_dtype(table_dtype),
         )
         self.sign = -1.0 if self.dcop.objective == "max" else 1.0
         prov: Dict[str, Any] = {}

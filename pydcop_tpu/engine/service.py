@@ -753,6 +753,7 @@ class SolverService:
         set_values: Optional[Mapping[str, Any]] = None,
         max_util_bytes: Optional[int] = None,
         bnb: Optional[str] = None,
+        table_dtype: Optional[str] = None,
         trace: Optional[Mapping[str, Any]] = None,
     ) -> PendingResult:
         """Admit one solve request; returns a :class:`PendingResult`.
@@ -865,6 +866,23 @@ class SolverService:
                     f"bnb must be 'auto'|'on'|'off', got {bnb!r}"
                 )
             params_in = {**dict(params_in or {}), "bnb": str(bnb)}
+        if table_dtype is not None:
+            if not any(
+                p.name == "table_dtype" for p in module.algo_params
+            ):
+                raise ValueError(
+                    "table_dtype selects the storage precision of "
+                    "packed contraction tables — supported by the "
+                    "exact contraction engine (dpop); "
+                    f"{algo_name!r} has none (maxsum's "
+                    "message-plane sibling is msg_dtype)"
+                )
+            from pydcop_tpu.ops.padding import as_table_dtype
+
+            params_in = {
+                **dict(params_in or {}),
+                "table_dtype": as_table_dtype(table_dtype),
+            }
         params = prepare_algo_params(params_in, module.algo_params)
 
         req = _Request(
@@ -942,6 +960,7 @@ class SolverService:
         ] = None,
         max_util_bytes: Optional[int] = None,
         bnb: str = "auto",
+        table_dtype: str = "f32",
         trace: Optional[Mapping[str, Any]] = None,
     ) -> PendingResult:
         """Admit one inference request (``docs/semirings.md``): the
@@ -1009,6 +1028,9 @@ class SolverService:
             raise ValueError(
                 f"bnb must be 'auto'|'on'|'off', got {bnb!r}"
             )
+        from pydcop_tpu.ops.padding import as_table_dtype
+
+        table_dtype = as_table_dtype(table_dtype)  # fail at admission
         if dcop is None:
             raise ValueError("dcop is required")
         dcop_obj, dcop_key = self._load_dcop(dcop)
@@ -1042,6 +1064,7 @@ class SolverService:
                     else None
                 ),
                 "bnb": str(bnb),
+                "table_dtype": table_dtype,
             },
         )
         req.t_sub = t_sub
@@ -2128,7 +2151,7 @@ class SolverService:
             "infer", req.query, kw["order"], kw["beta"], kw["tol"],
             kw["device"], kw["device_min_cells"], kw["map_vars"],
             ed_key, kw["max_util_bytes"], kw.get("bnb", "auto"),
-            req.timeout,
+            kw.get("table_dtype", "f32"), req.timeout,
         )
 
     def _dispatch_infer_groups(self, reqs: List[_Request]) -> None:
@@ -2188,6 +2211,7 @@ class SolverService:
                     map_vars=list(mv) if mv else None,
                     external_dists=kw["external_dists"],
                     bnb=kw.get("bnb", "auto"),
+                    table_dtype=kw.get("table_dtype", "f32"),
                 )
         t_done = time.perf_counter()
         for req in part:
@@ -2419,7 +2443,7 @@ def _load_module(algo_name: str):
 _SOLVE_FIELDS = (
     "rounds", "seed", "chunk_size", "convergence_chunks",
     "n_restarts", "timeout", "session", "set_values",
-    "max_util_bytes", "bnb",
+    "max_util_bytes", "bnb", "table_dtype",
 )
 
 #: fields an ``op: "infer"`` frame may carry — mirrors
@@ -2428,7 +2452,7 @@ _SOLVE_FIELDS = (
 _INFER_FIELDS = (
     "order", "beta", "tol", "device", "device_min_cells",
     "timeout", "map_vars", "external_dists", "max_util_bytes",
-    "bnb",
+    "bnb", "table_dtype",
 )
 
 #: results are trimmed for the wire: the per-round cost trace can be

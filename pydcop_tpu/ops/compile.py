@@ -203,8 +203,35 @@ class CompiledProblem:
         return self.var_names.index(name)
 
 
+def _resolve_table_dtype(table_dtype, dtype):
+    """Map the shared ``table_dtype`` vocabulary (``ops/padding.py:
+    as_table_dtype`` — one spelling of ``bf16``/``bfloat16``, typo
+    suggestions) onto the packed jnp dtype of a
+    :class:`CompiledProblem`.  ``None`` keeps the explicit ``dtype``
+    arg (backward compatible).  ``int8`` is rejected here: quantized
+    packs carry per-table scale/offset dequant params that only the
+    contraction stack threads (``ops/semiring.py:contract_sweep``,
+    ``api.infer``, DPOP) — the iterative message-passing engines
+    take f32 or bf16."""
+    if table_dtype is None:
+        return dtype
+    from pydcop_tpu.ops.padding import as_table_dtype
+
+    dt = as_table_dtype(table_dtype)
+    if dt == "int8":
+        raise ValueError(
+            "table_dtype='int8' is only supported by the "
+            "contraction stack (api.infer / api.solve with "
+            "algo='dpop'): int8 packs carry scale/offset dequant "
+            "params the iterative engines do not thread — use "
+            "'f32' or 'bf16' here"
+        )
+    return jnp.bfloat16 if dt == "bf16" else jnp.float32
+
+
 def compile_dcop(
-    dcop: DCOP, dtype=jnp.float32, n_shards: int = 1, pad_policy="none"
+    dcop: DCOP, dtype=jnp.float32, n_shards: int = 1,
+    pad_policy="none", table_dtype=None,
 ) -> CompiledProblem:
     """Tabulate and pack a DCOP into a :class:`CompiledProblem` (see
     :func:`_compile_dcop`); records a ``compile-problem`` span when a
@@ -214,11 +241,17 @@ def compile_dcop(
     :class:`~pydcop_tpu.ops.padding.PadPolicy`) buckets every array
     dimension so similarly-sized problems share compiled executables —
     see ``ops/padding.py`` and ``docs/performance.md``.
+
+    ``table_dtype`` (``"f32"`` | ``"bf16"``) is the string-vocabulary
+    alias of ``dtype`` shared with the contraction stack's knob
+    (``docs/performance.md``, mixed-precision table packs); when given
+    it overrides ``dtype``.
     """
     import time as _time
 
     from pydcop_tpu.telemetry import get_tracer
 
+    dtype = _resolve_table_dtype(table_dtype, dtype)
     tr = get_tracer()
     if not tr.enabled:
         return _compile_dcop(dcop, dtype, n_shards, pad_policy)
@@ -891,6 +924,7 @@ def compile_from_arrays(
     con_prefix: str = "c",
     dtype=jnp.float32,
     pad_policy="none",
+    table_dtype=None,
 ) -> CompiledProblem:
     """Array-level problem construction — the fast path for big
     generated instances.
@@ -939,7 +973,10 @@ def compile_from_arrays(
 
     Variable ``i`` is named ``f"{var_prefix}{i}"``; assignments in and
     out are keyed by those names exactly as with :func:`compile_dcop`.
+    ``table_dtype`` (``"f32"`` | ``"bf16"``) overrides ``dtype`` with
+    the shared string vocabulary (:func:`compile_dcop`).
     """
+    dtype = _resolve_table_dtype(table_dtype, dtype)
     if not isinstance(scopes, (list, tuple)):
         scopes = [scopes]
         tables = [tables]

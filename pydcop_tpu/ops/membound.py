@@ -75,9 +75,15 @@ from pydcop_tpu.ops.semiring import (
     contract_sweep,
 )
 
+from pydcop_tpu.ops.padding import as_table_dtype, table_dtype_bytes
+
 _EPS64 = float(np.finfo(np.float64).eps)
 
-#: device tables are f32 — the byte unit ``max_util_bytes`` caps.
+#: device tables default to f32 — the byte unit ``max_util_bytes``
+#: caps.  Sub-f32 table packs (``table_dtype=bf16|int8``) shrink the
+#: per-cell width through :func:`~pydcop_tpu.ops.padding.
+#: table_dtype_bytes`, so the SAME budget fits wider tables — bf16
+#: halves the cut width pressure, int8 quarters it.
 BYTES_PER_CELL = 4
 
 #: enumeration guard: a cut whose joint assignment space exceeds this
@@ -235,10 +241,20 @@ class CutPlan:
     #: cell width (a kbest:8 sweep moves 8 f32s per table cell — the
     #: budget model must see them or the sweep lands 8× over budget)
     cell_width: int = 1
+    #: storage dtype of the device tables this cut was budgeted for —
+    #: bf16 halves and int8 quarters the per-cell byte width, so the
+    #: same ``max_util_bytes`` fits more cells (a smaller cut)
+    table_dtype: str = "f32"
 
     @property
     def width(self) -> int:
         return len(self.cut)
+
+    @property
+    def bytes_per_cell(self) -> int:
+        return table_dtype_bytes(self.table_dtype) * max(
+            int(self.cell_width), 1
+        )
 
 
 def plan_cut(
@@ -247,6 +263,7 @@ def plan_cut(
     pad=None,
     max_cut_lanes: int = MAX_CUT_LANES,
     cell_width: int = 1,
+    table_dtype: str = "f32",
 ) -> CutPlan:
     """Choose a minimal cut set keeping every contraction table of
     the plan under ``max_util_bytes``.
@@ -271,11 +288,20 @@ def plan_cut(
     ``cell_width`` is the semiring's structured-cell width
     (``ops/semiring.py``): every table cell is ``cell_width`` f32s on
     device, so the cell budget divides by it — a ``kbest:8`` sweep
-    under ``max_util_bytes`` must not land 8× over budget unseen."""
+    under ``max_util_bytes`` must not land 8× over budget unseen.
+
+    ``table_dtype`` is the device storage dtype of the sweep's tables
+    (``ops/padding.py:as_table_dtype``): the budget divides by the
+    REAL per-cell byte width, so the same ``max_util_bytes`` fits 2×
+    the cells at bf16 and 4× at int8 — a strictly smaller (or equal)
+    cut than f32 for the same plan and budget."""
     from pydcop_tpu.ops.padding import NO_PADDING, bucket_util_shape
 
     pad = NO_PADDING if pad is None else pad
-    bytes_per_cell = BYTES_PER_CELL * max(int(cell_width), 1)
+    table_dtype = as_table_dtype(table_dtype)
+    bytes_per_cell = table_dtype_bytes(table_dtype) * max(
+        int(cell_width), 1
+    )
     budget_cells = max(int(max_util_bytes) // bytes_per_cell, 1)
     seps: Dict[str, List[str]] = {}
     targets: Dict[str, List[str]] = {}
@@ -337,7 +363,7 @@ def plan_cut(
     bounded_peak = max((s for _, _, s in sizes(cutset)), default=1)
     return CutPlan(
         tuple(cut), lanes, budget_cells, naive_peak, bounded_peak,
-        cell_width=max(int(cell_width), 1),
+        cell_width=max(int(cell_width), 1), table_dtype=table_dtype,
     )
 
 
@@ -499,10 +525,11 @@ class BoundedSweep:
             "cut": list(cp.cut),
             "cut_width": cp.width,
             "cut_lanes": cp.n_lanes,
+            "table_dtype": cp.table_dtype,
             "peak_table_bytes": cp.bounded_peak_cells
-            * BYTES_PER_CELL * cp.cell_width,
+            * cp.bytes_per_cell,
             "naive_peak_table_bytes": cp.naive_peak_cells
-            * BYTES_PER_CELL * cp.cell_width,
+            * cp.bytes_per_cell,
             "pruned_cells": int(self.pruned_cells),
             "replans": int(self.replans),
         }
@@ -523,6 +550,7 @@ def run_bounded(
     t0: Optional[float] = None,
     timeout: Optional[float] = None,
     bnb: str = "off",
+    table_dtype: str = "f32",
 ) -> Optional[BoundedSweep]:
     """Prune, plan, and run ONE budgeted merged sweep over K
     instances (module docstring), re-planning at half the budget on
@@ -549,6 +577,7 @@ def run_bounded(
     met = get_metrics()
     tracer = get_tracer()
     pad = NO_PADDING if pad is None else pad
+    table_dtype = as_table_dtype(table_dtype)
     t0 = time.perf_counter() if t0 is None else t0
     if int(max_util_bytes) <= 0:
         raise ValueError(
@@ -572,7 +601,7 @@ def run_bounded(
     cuts0 = [
         plan_cut(
             p, max_util_bytes, pad, max_cut_lanes,
-            cell_width=sr.cell_width,
+            cell_width=sr.cell_width, table_dtype=table_dtype,
         )
         for p in plans
     ]
@@ -595,7 +624,7 @@ def run_bounded(
                 tol=tol, max_table_size=max_table_size,
                 want_args=want_args, t0=t0, timeout=timeout,
                 on_oom="raise" if dmc is not None else "host",
-                bnb=bnb,
+                bnb=bnb, table_dtype=table_dtype,
             )
         except DeviceOOMError:
             # the replan rung of the OOM ladder: level->node already
@@ -606,12 +635,13 @@ def run_bounded(
                 met.inc("membound.replans")
             budget //= 2
             next_cuts = None
-            if budget >= 2 * BYTES_PER_CELL:
+            if budget >= 2 * table_dtype_bytes(table_dtype):
                 try:
                     next_cuts = [
                         plan_cut(
                             p, budget, pad, max_cut_lanes,
                             cell_width=sr.cell_width,
+                            table_dtype=table_dtype,
                         )
                         for p in plans
                     ]
@@ -746,6 +776,7 @@ def solve_dpop_bounded(
         device_min_cells=dmc, pad=pad, want_args=True,
         max_table_size=max_table_size, t0=t0, timeout=timeout,
         bnb=as_bnb(params.get("bnb"), "auto"),
+        table_dtype=as_table_dtype(params.get("table_dtype")),
     )
     if bs is None:
         return _dpop_timeout(dcop, t0)

@@ -93,6 +93,182 @@ class PadPolicy:
 
 NO_PADDING = PadPolicy()
 
+
+# -- table dtypes (mixed-precision table packs) ------------------------
+#
+# The ONE precision vocabulary of the contraction stack
+# (docs/performance.md, "Mixed-precision table packs"): device-side
+# table parts may be packed at f32 (the default), bf16 (half the HBM
+# per cell, 2x MXU), or int8 (a quarter, with per-table scale/offset
+# dequant params carried alongside).  Accumulators stay f32 on device
+# and the exactness machinery re-scales per precision — callers never
+# need to know more than the spelling.  Max-Sum's ``msg_dtype`` is the
+# message-plane sibling of ``table_dtype`` and parses through the same
+# helper (restricted to its supported subset).
+
+#: canonical table dtype spellings, cheapest storage last
+TABLE_DTYPES = ("f32", "bf16", "int8")
+
+_TABLE_DTYPE_ALIASES = {
+    "f32": "f32",
+    "fp32": "f32",
+    "float32": "f32",
+    "bf16": "bf16",
+    "bfloat16": "bf16",
+    "int8": "int8",
+    "i8": "int8",
+}
+
+#: bytes per packed cell, per canonical dtype
+_TABLE_DTYPE_BYTES = {"f32": 4, "bf16": 2, "int8": 1}
+
+# unit roundoff of the STORAGE format: bf16 keeps 8 significand bits
+# (eps = 2^-7); int8 codes are exact integers dequantized in f32, so
+# its roundoff is f32's — the quantization error is accounted
+# separately (see int8_quant_bound).  Literals, not np.finfo: numpy
+# cannot finfo ml_dtypes.bfloat16 on every supported version, and the
+# host paths must stay importable without ml_dtypes loaded.
+_TABLE_DTYPE_EPS = {
+    "f32": float(np.finfo(np.float32).eps),
+    "bf16": 2.0 ** -7,
+    "int8": float(np.finfo(np.float32).eps),
+}
+
+
+def as_table_dtype(
+    spec: Union[str, None],
+    default: str = "f32",
+    allowed: Sequence[str] = TABLE_DTYPES,
+) -> str:
+    """Normalize a ``table_dtype`` argument to its canonical spelling.
+
+    ``None``/``""`` mean the default; ``"bfloat16"``/``"fp32"``-style
+    aliases collapse to one spelling so cache keys and wire partition
+    keys can compare strings directly.  Unknown names raise with a
+    nearest-name suggestion (the semiring-registry convention);
+    ``allowed`` lets restricted call sites (e.g. Max-Sum's bf16-only
+    message plane) reject dtypes they cannot honor with the same
+    error shape."""
+    if spec is None:
+        return default
+    if not isinstance(spec, str):
+        raise ValueError(
+            f"table dtype must be a string, got {spec!r}"
+        )
+    s = spec.strip().lower()
+    if not s:
+        return default
+    canon = _TABLE_DTYPE_ALIASES.get(s)
+    if canon is None or canon not in allowed:
+        import difflib
+
+        hint = difflib.get_close_matches(
+            s, sorted(set(_TABLE_DTYPE_ALIASES)), n=1
+        )
+        suggest = (
+            f"; did you mean {hint[0]!r}?"
+            if hint and _TABLE_DTYPE_ALIASES[hint[0]] in allowed
+            else ""
+        )
+        raise ValueError(
+            f"unknown table dtype {spec!r} (expected one of "
+            f"{tuple(allowed)}{suggest})"
+        )
+    return canon
+
+
+def table_dtype_bytes(table_dtype: str) -> int:
+    """Per-cell byte width of a canonical table dtype — the number
+    every byte budget (``ops/membound.py``), memo payload account
+    (``engine/memo.py``) and telemetry ``table_bytes`` field sizes
+    with."""
+    return _TABLE_DTYPE_BYTES[as_table_dtype(table_dtype)]
+
+
+def table_dtype_eps(table_dtype: str) -> float:
+    """Unit roundoff of a canonical table dtype's STORAGE format —
+    what the f32 certificate/ledger machinery swaps in for ``eps32``
+    when tables are packed below f32 (int8 quantization error is a
+    separate additive term, :func:`int8_quant_bound`)."""
+    return _TABLE_DTYPE_EPS[as_table_dtype(table_dtype)]
+
+
+# -- int8 table packs ---------------------------------------------------
+#
+# Affine 8-bit quantization with RESERVED infinity codes: hard-cap
+# semantics (+/-inf guards, bnb noprune sentinels, pad-policy ghost
+# masks) must survive packing EXACTLY, so the top/bottom codes encode
+# the infinities and finite values clip to [-126, 126].  scale/offset
+# ride alongside the codes (one pair per packed part) and the device
+# kernel dequantizes into its f32 accumulator
+# (``ops/semiring.py:contraction_kernel``).
+
+INT8_POS_INF = 127  #: reserved code for +inf
+INT8_NEG_INF = -128  #: reserved code for -inf
+INT8_FINITE_MAX = 126  #: finite codes live in [-126, 126]
+INT8_LEVELS = 2 * INT8_FINITE_MAX  #: finite quantization levels (252)
+
+
+def quantize_table_int8(a: np.ndarray):
+    """Pack a float table as ``(int8 codes, f32 scale, f32 offset)``.
+
+    Finite values map affinely onto [-126, 126] —
+    ``scale = (hi - lo) / 252`` (1.0 when the finite range is
+    degenerate, where every finite cell dequantizes exactly to the
+    offset) and ``offset = (hi + lo) / 2`` — and +/-inf take the
+    reserved codes, so guards and hard caps round-trip bit-exactly.
+    The quantization error of any finite cell is <= scale / 2
+    <= max|finite| / 252 (:func:`int8_quant_bound`)."""
+    a = np.asarray(a, dtype=np.float64)
+    finite = np.isfinite(a)
+    if finite.any():
+        lo = float(a[finite].min())
+        hi = float(a[finite].max())
+    else:
+        lo = hi = 0.0
+    scale = (hi - lo) / INT8_LEVELS
+    if not (scale > 0.0):
+        scale = 1.0
+    offset = (hi + lo) / 2.0
+    with np.errstate(invalid="ignore"):
+        q = np.clip(
+            np.rint((a - offset) / scale),
+            -INT8_FINITE_MAX,
+            INT8_FINITE_MAX,
+        )
+    q = np.where(a == np.inf, INT8_POS_INF, q)
+    q = np.where(a == -np.inf, INT8_NEG_INF, q)
+    return (
+        q.astype(np.int8),
+        np.float32(scale),
+        np.float32(offset),
+    )
+
+
+def dequantize_table_int8(
+    q: np.ndarray, scale: float, offset: float
+) -> np.ndarray:
+    """Host-side (numpy) inverse of :func:`quantize_table_int8` — the
+    reference the device kernel's in-trace dequant mirrors, shared by
+    tests and host fallbacks."""
+    q = np.asarray(q)
+    f = q.astype(np.float32) * np.float32(scale) + np.float32(offset)
+    f = np.where(q == INT8_POS_INF, np.float32(np.inf), f)
+    f = np.where(q == INT8_NEG_INF, np.float32(-np.inf), f)
+    return f.astype(np.float32)
+
+
+def int8_quant_bound(parts_max: float) -> float:
+    """Conservative per-joined-cell int8 quantization error bound.
+
+    Each part's finite error is <= its ``scale/2 <= amax_p / 252``;
+    a joined cell sums one value per part, and ``parts_max`` is the
+    sweep's running sum of per-part finite amax values, so
+    ``parts_max / 252`` bounds the total — pre-computable before any
+    dispatch, which is what lets the tolerance gate and the bnb slack
+    widen without touching device results."""
+    return max(float(parts_max), 0.0) / INT8_LEVELS
+
 # UTIL-table axes are DOMAIN-sized (a handful of values), not
 # problem-sized: bucketing them against ``PadPolicy.floor`` (16) would
 # inflate a d=5 axis 3x per dimension.  Level-pack keys therefore

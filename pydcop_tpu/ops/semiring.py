@@ -69,16 +69,36 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 
 from pydcop_tpu.ops.padding import (
+    INT8_NEG_INF,
+    INT8_POS_INF,
     NO_PADDING,
     PadPolicy,
     as_pad_policy,
+    as_table_dtype,
+    int8_quant_bound,
     pad_util_parts,
+    quantize_table_int8,
     stack_bucket,
+    table_dtype_bytes,
+    table_dtype_eps,
     util_level_key,
 )
 
 _EPS32 = float(np.finfo(np.float32).eps)
 _EPS64 = float(np.finfo(np.float64).eps)
+
+
+def _np_table_dtype(table_dtype: str):
+    """numpy STORAGE dtype for a canonical float table dtype (int8
+    packs go through :func:`~pydcop_tpu.ops.padding.
+    quantize_table_int8` instead).  bf16 resolves through ml_dtypes —
+    jax's own numpy bridge, always present with it — lazily, so the
+    module import surface stays numpy-only."""
+    if table_dtype == "bf16":
+        import ml_dtypes
+
+        return ml_dtypes.bfloat16
+    return np.float32
 
 
 # -- the semiring registry ---------------------------------------------
@@ -732,7 +752,7 @@ class _BnbContext:
 
     __slots__ = (
         "sr", "tol_node", "inc", "inc_k", "rest", "rest_logdom",
-        "cumshift",
+        "cumshift", "table_dtype",
     )
 
     def __init__(
@@ -743,8 +763,13 @@ class _BnbContext:
         owned: Mapping[str, list],
         children: Mapping[str, Sequence[str]],
         tol: float = 1e-6,
+        table_dtype: str = "f32",
     ):
         self.sr = sr
+        # pass-1 row bounds are computed at the STORAGE dtype — the
+        # budget slack widens to that dtype's roundoff (plus the int8
+        # quantization term) so pruning stays conservative below f32
+        self.table_dtype = as_table_dtype(table_dtype)
         self.cumshift: Dict[str, float] = {}
         n_nodes = max(len(order_rev), 1)
         self.tol_node = (
@@ -886,10 +911,11 @@ class _BnbContext:
         if inc is None:
             return self.no_prune()
         rest = self.rest.get(name, 0.0)
+        eps_dt = table_dtype_eps(self.table_dtype)
         slack = (
             2.0
             * (n_parts + 2)
-            * _EPS32
+            * eps_dt
             * (
                 max(parts_max, 1.0)
                 + abs(inc)
@@ -897,6 +923,8 @@ class _BnbContext:
                 + abs(shift_children)
             )
         )
+        if self.table_dtype == "int8":
+            slack += 2.0 * int8_quant_bound(parts_max)
         if sr.idempotent or sr.kind == "kbest":
             if sr.maximize:
                 b = inc - rest - shift_children - slack
@@ -972,7 +1000,8 @@ def max_padded_join_cells(plan: "ContractionPlan", pad) -> int:
 
 
 def plan_bnb_context(
-    plan: "ContractionPlan", sr: Semiring, beta: float, tol: float
+    plan: "ContractionPlan", sr: Semiring, beta: float, tol: float,
+    table_dtype: str = "f32",
 ) -> Optional[_BnbContext]:
     """Build the BnB context for one plan, or None when the sweep
     shape does not support pruning (mixed-⊕ marginal-MAP plans: a
@@ -998,7 +1027,7 @@ def plan_bnb_context(
             owned[v] = parts
     return _BnbContext(
         sr, list(reversed(plan.order)), plan.domains, owned,
-        plan.children, tol=tol,
+        plan.children, tol=tol, table_dtype=table_dtype,
     )
 
 
@@ -1023,6 +1052,7 @@ def contraction_kernel(
     part_shapes: Tuple[Tuple[int, ...], ...],
     batched: bool = False,
     bnb: bool = False,
+    table_dtype: str = "f32",
 ):
     """Jit-compiled semiring contraction for one bucket: broadcast-add
     join of the aligned parts, then the ``⊕``-projection over the own
@@ -1050,9 +1080,24 @@ def contraction_kernel(
     discarded-mass measurement the caller accounts into the
     ``error_bound`` ledger).  Same static shapes, one extra
     executable per ``(semiring, bucket)`` at most.
+
+    ``table_dtype`` is the STORAGE precision of the parts
+    (``docs/performance.md``, "Mixed-precision table packs"): bf16
+    parts join straight into the f32 accumulator (jax's promotion —
+    the join and reduce stay wide); int8 parts arrive as codes with
+    per-part ``scales``/``offsets`` f32 vectors PREPENDED to the
+    argument list (after the bnb ``budget`` when both are on) and
+    dequantize in-trace, the reserved top/bottom codes restoring
+    ``±inf`` exactly.  The dtype joins the cache key, so a bucket
+    pays at most one extra executable per dtype it actually runs at
+    (``tools/recompile_guard.py:run_precision_guard``).
     """
     sr = get_semiring(sr)
-    key = (sr.name, tuple(shape), tuple(part_shapes), batched, bnb)
+    table_dtype = as_table_dtype(table_dtype)
+    key = (
+        sr.name, tuple(shape), tuple(part_shapes), batched, bnb,
+        table_dtype,
+    )
     fn = _KERNELS.get(key)
     if fn is not None:
         return fn
@@ -1310,11 +1355,36 @@ def contraction_kernel(
                 _discard(rowb, keep),
             )
 
+    if table_dtype == "int8":
+        # OUTERMOST dequant wrap — the (possibly bnb-wrapped) float
+        # kernel below never sees codes, so the bound pass and every
+        # ⊕ body stay dtype-oblivious.  Reserved codes restore ±inf
+        # exactly: hard caps, ghost guards and noprune sentinels
+        # survive packing bit-for-bit (ops/padding.py).
+        inner = contract
+
+        def contract(*args):  # noqa: F811 — int8 wrap
+            if bnb:
+                budget, scales, offsets, *qtabs = args
+            else:
+                scales, offsets, *qtabs = args
+            tabs = []
+            for i, q in enumerate(qtabs):
+                f = (
+                    q.astype(jnp.float32) * scales[i] + offsets[i]
+                )
+                f = jnp.where(q == INT8_POS_INF, jnp.inf, f)
+                f = jnp.where(q == INT8_NEG_INF, -jnp.inf, f)
+                tabs.append(f)
+            return inner(budget, *tabs) if bnb else inner(*tabs)
+
     from pydcop_tpu.telemetry.jit import profiled_jit
 
     fn = profiled_jit(
         jax.vmap(contract) if batched else contract,
-        label=f"semiring-{sr.name}" + ("-bnb" if bnb else ""),
+        label=f"semiring-{sr.name}"
+        + ("-bnb" if bnb else "")
+        + ("" if table_dtype == "f32" else f"-{table_dtype}"),
     )
     _KERNELS[key] = fn
     return fn
@@ -1851,6 +1921,31 @@ def _finite_amax(a) -> float:
     return float(m.max()) if m.size else 0.0
 
 
+def _pack_parts(parts, table_dtype, met=None):
+    """Pack one dispatch row's aligned+padded float parts at the
+    storage dtype: f32 passes through, bf16 casts (one extra
+    rounding, covered by the widened certificates), int8 quantizes
+    each part and PREPENDS the per-part scale/offset f32 vectors the
+    kernel's dequant wrap consumes (``semiring.int8_requant`` counts
+    the part packs)."""
+    if table_dtype == "f32":
+        return parts
+    if table_dtype == "bf16":
+        dt = _np_table_dtype("bf16")
+        return [np.asarray(p, dtype=dt) for p in parts]
+    scales = np.zeros(len(parts), dtype=np.float32)
+    offsets = np.zeros(len(parts), dtype=np.float32)
+    qs = []
+    for i, p in enumerate(parts):
+        q, s, o = quantize_table_int8(p)
+        qs.append(q)
+        scales[i] = s
+        offsets[i] = o
+    if met is not None and met.enabled:
+        met.inc("semiring.int8_requant", len(parts))
+    return [scales, offsets] + qs
+
+
 class _Sweep:
     """Per-call state of one merged upward sweep (K instances)."""
 
@@ -1899,6 +1994,7 @@ def contract_sweep(
     on_oom: str = "host",
     bnb: str = "off",
     memos: Optional[Sequence[Any]] = None,
+    table_dtype: str = "f32",
 ) -> Optional[_Sweep]:
     """Merged bottom-up contraction sweep over K instances.
 
@@ -1945,6 +2041,21 @@ def contract_sweep(
     (a budget-pruned message depends on the global incumbent, not
     just the subtree) — sessions wanting memoized deltas run with
     ``bnb='off'`` or below the auto threshold.
+
+    ``table_dtype`` packs every device part at the requested storage
+    precision (``docs/performance.md``, "Mixed-precision table
+    packs") with the accumulator kept f32.  Correctness rides the
+    SAME machinery re-scaled per precision: idempotent/kbest
+    certificates widen to the storage roundoff (plus the int8
+    quantization bound) and repair exactly as at f32 — per-cell
+    host-f64 gathers at the certified arg, so results stay
+    bit-identical to the f32 sweep; mass ⊕ nodes whose widened local
+    bound would blow ``tol`` DEMOTE to an f32 dispatch first
+    (``semiring.precision_repairs``) and only then fall back to host
+    f64 — the bf16 → f32 → f64 repair ladder.  The dtype joins the
+    level-pack bucket key (demoted nodes land in f32 buckets, never
+    mixing kernels) and ``semiring.int8_requant`` counts int8 part
+    packs.
     """
     from pydcop_tpu.engine.supervisor import (
         DeviceOOMError,
@@ -1961,6 +2072,7 @@ def contract_sweep(
     _key_memo: Dict[tuple, tuple] = {}
 
     bnb = as_bnb(bnb, "off")
+    call_dt = as_table_dtype(table_dtype)
     ctxs: List[Optional[_BnbContext]] = [None] * K
     if bnb != "off" and device_min_cells is not None:
         for k, p in enumerate(plans):
@@ -1976,7 +2088,9 @@ def contract_sweep(
                 if met.enabled:
                     met.inc("semiring.bnb_skipped_small")
                 continue
-            ctxs[k] = plan_bnb_context(p, sr, beta, tol)
+            ctxs[k] = plan_bnb_context(
+                p, sr, beta, tol, table_dtype=call_dt
+            )
     bnb_call = any(c is not None for c in ctxs)
     if memos is not None:
         # docstring contract: pruning and memoization are mutually
@@ -2262,16 +2376,33 @@ def contract_sweep(
 
             dmc = device_min_cells
             use_device = dmc is not None and size * cw >= dmc
+            node_dt = call_dt
+            local = 0.0
             if use_device and sr_n.error_bounded:
-                # error-budget gate: a device (f32) pass whose
-                # accumulated bound would exceed tol runs on host f64
-                # instead — the logsumexp analogue of the exactness
-                # certificate (there is no arg to repair; the value
-                # IS the answer)
+                # error-budget gate: a device pass whose accumulated
+                # bound would exceed tol first DEMOTES to f32 storage
+                # (the precision-repair rung of the ladder), then
+                # runs on host f64 — the logsumexp analogue of the
+                # exactness certificate (there is no arg to repair;
+                # the value IS the answer)
                 scale = max(parts_max, 1.0)
-                local = _EPS32 * (
-                    (len(parts) + 1) * scale + shape[-1] + 2
-                )
+
+                def _local_err(dt):
+                    q = (
+                        int8_quant_bound(parts_max)
+                        if dt == "int8"
+                        else 0.0
+                    )
+                    return table_dtype_eps(dt) * (
+                        (len(parts) + 1) * scale + shape[-1] + 2
+                    ) + q
+
+                local = _local_err(node_dt)
+                if err_in + local > tol and node_dt != "f32":
+                    node_dt = "f32"
+                    local = _local_err(node_dt)
+                    if met.enabled:
+                        met.inc("semiring.precision_repairs")
                 if err_in + local > tol:
                     use_device = False
                     if met.enabled:
@@ -2293,14 +2424,10 @@ def contract_sweep(
             budget = None
             if ctx is not None:
                 shiftc = ctx.shift_under(plan.children[name])
+                # `local` is the node's (post-demotion) storage-dtype
+                # rounding bound computed by the gate above
                 if not sr_n.error_bounded or (
-                    err_in
-                    + _EPS32 * (
-                        (len(parts) + 1) * max(parts_max, 1.0)
-                        + shape[-1] + 2
-                    )
-                    + ctx.tol_node
-                    <= tol
+                    err_in + local + ctx.tol_node <= tol
                 ):
                     n_rows = size // max(shape[-1], 1)
                     budget = ctx.budget(
@@ -2312,17 +2439,19 @@ def contract_sweep(
                 _align(t, dims, target) for dims, t in parts
             ]
             raw = (
-                sr_n.name, tuple(shape),
+                sr_n.name, node_dt, tuple(shape),
                 tuple(a.shape for a in aligned),
             )
             key = _key_memo.get(raw)
             if key is None:
                 # the level-pack key is shape-only and shared; the ⊕
-                # joins the BUCKET key so a mixed wave dispatches one
-                # block per semiring without ever mixing kernels
+                # AND the storage dtype join the BUCKET key so a
+                # mixed wave dispatches one block per (semiring,
+                # dtype) without ever mixing kernels — a tol-demoted
+                # node lands in the f32 bucket, not its call-dtype one
                 key = _key_memo[raw] = (
-                    sr_n.name,
-                    util_level_key(raw[1], raw[2], pad),
+                    sr_n.name, node_dt,
+                    util_level_key(raw[2], raw[3], pad),
                 )
             if key not in buckets:
                 buckets[key] = []
@@ -2330,7 +2459,7 @@ def contract_sweep(
             buckets[key].append(
                 (
                     (k, name, sep, target, shape, parts,
-                     parts_max, err_in, budget, shiftc),
+                     parts_max, err_in, budget, shiftc, node_dt),
                     aligned,
                 )
             )
@@ -2350,7 +2479,8 @@ def contract_sweep(
             # inside the real domain; -inf is absorbing for max AND
             # contributes exp(-inf)=0 weight to logsumexp/expectation
             guard = sr_b.plus_identity
-            pshape, part_shapes = key[1]
+            bucket_dt = key[1]
+            pshape, part_shapes = key[2]
             n_rows = len(entries)
             shape0 = entries[0][0][4]
             uniform = all(it[4] == shape0 for it, _ in entries)
@@ -2390,7 +2520,7 @@ def contract_sweep(
                     sw, sr_b, entries, pshape, part_shapes, shape0,
                     pad, guard, tol, want_args, finish, sup, met,
                     plans, use_bnb, noprune, ctxs, tracer,
-                    memos=memos,
+                    memos=memos, table_dtype=bucket_dt,
                 )
                 if ok:
                     continue
@@ -2400,11 +2530,12 @@ def contract_sweep(
                 if met.enabled:
                     met.inc("engine.oom_splits")
             fn = contraction_kernel(
-                sr_b, pshape, part_shapes, bnb=use_bnb
+                sr_b, pshape, part_shapes, bnb=use_bnb,
+                table_dtype=bucket_dt,
             )
             for item, aligned in entries:
                 (k, name, sep, target, shape, parts,
-                 parts_max, err_in, budget, shiftc) = item
+                 parts_max, err_in, budget, shiftc, node_dt) = item
                 if (
                     timeout is not None
                     and time.perf_counter() - t0 > timeout
@@ -2419,6 +2550,9 @@ def contract_sweep(
                     aligned, shape, pshape, guard=guard,
                     with_mask=pad.enabled,
                 )
+                padded = _pack_parts(
+                    list(padded), bucket_dt, met
+                )
                 if use_bnb:
                     b32 = np.float32(
                         budget if budget is not None else noprune
@@ -2430,8 +2564,8 @@ def contract_sweep(
                             np.asarray(x) for x in fn(*p)
                         ),
                         scope="semiring.node", width=1,
-                        table_bytes=4 * int(np.prod(pshape))
-                        * sr_b.cell_width,
+                        table_bytes=table_dtype_bytes(bucket_dt)
+                        * int(np.prod(pshape)) * sr_b.cell_width,
                     )
                 except DeviceOOMError:
                     if on_oom == "raise":
@@ -2475,12 +2609,17 @@ def _dispatch_stacked(
     sw, sr, entries, pshape, part_shapes, shape0, pad, guard, tol,
     want_args, finish, sup, met, plans, use_bnb=False,
     noprune=float("inf"), ctxs=(), tracer=None, memos=None,
+    table_dtype="f32",
 ) -> bool:
     """One vmapped dispatch for a uniform level-pack bucket.  Returns
     False on device OOM (caller degrades to per-node dispatches).
     ``use_bnb`` prepends the per-row budget vector (pad rows get the
     ``noprune`` sentinel, so ghost rows never contribute to the
-    pruning counters or the discard measurement)."""
+    pruning counters or the discard measurement).  ``table_dtype``
+    packs the stacked part buffers at the bucket's storage dtype —
+    int8 quantizes per (row, part), so every row carries its own
+    scale/offset pair and the quant bound stays the per-instance
+    ``parts_max / 252``."""
     from pydcop_tpu.engine.supervisor import DeviceOOMError
 
     n_rows = len(entries)
@@ -2497,9 +2636,30 @@ def _dispatch_stacked(
         if has_mask:
             bufs[-1][r][..., shape0[-1]:] = guard
     fn = contraction_kernel(
-        sr, pshape, part_shapes, batched=True, bnb=use_bnb
+        sr, pshape, part_shapes, batched=True, bnb=use_bnb,
+        table_dtype=table_dtype,
     )
-    casts = [b.astype(np.float32) for b in bufs]
+    if table_dtype == "int8":
+        # per-(row, part) quantization: ghost rows stay all-zero
+        # codes under the identity (scale 1, offset 0) dequant
+        scales = np.ones((stack_h, n_parts), dtype=np.float32)
+        offsets = np.zeros((stack_h, n_parts), dtype=np.float32)
+        qbufs = [
+            np.zeros(b.shape, dtype=np.int8) for b in bufs
+        ]
+        for r in range(n_rows):
+            for i, b in enumerate(bufs):
+                q, s, o = quantize_table_int8(b[r])
+                qbufs[i][r] = q
+                scales[r, i] = s
+                offsets[r, i] = o
+        if met.enabled:
+            met.inc("semiring.int8_requant", n_rows * n_parts)
+        casts = [scales, offsets] + qbufs
+    else:
+        casts = [
+            b.astype(_np_table_dtype(table_dtype)) for b in bufs
+        ]
     if use_bnb:
         budgets = np.full(stack_h, noprune, dtype=np.float32)
         for r, (item, _) in enumerate(entries):
@@ -2510,7 +2670,8 @@ def _dispatch_stacked(
         outs = sup.dispatch(
             lambda: tuple(np.asarray(x) for x in fn(*casts)),
             scope="semiring.level", width=stack_h,
-            table_bytes=4 * int(np.prod(pshape)) * sr.cell_width,
+            table_bytes=table_dtype_bytes(table_dtype)
+            * int(np.prod(pshape)) * sr.cell_width,
         )
     except DeviceOOMError:
         return False
@@ -2526,7 +2687,10 @@ def _dispatch_stacked(
         for item, _ in entries:
             m = memos[item[0]]
             if m is not None:
-                m.note_kernel(sr.name, pshape, part_shapes, use_bnb)
+                m.note_kernel(
+                    sr.name, pshape, part_shapes, use_bnb,
+                    table_dtype,
+                )
     region_rows = tuple(slice(0, s) for s in shape0[:-1])
     pruned_total = 0
     for r, (item, aligned) in enumerate(entries):
@@ -2573,7 +2737,16 @@ def _finish_device_row(
 
     met = get_metrics()
     (k, name, sep, target, shape, parts, parts_max, err_in,
-     _budget, shiftc) = item
+     _budget, shiftc, node_dt) = item
+    # certificates and ledgers re-scale to the STORAGE dtype the
+    # dispatch ran at: its unit roundoff replaces eps32, and int8
+    # adds the (pre-computable) quantization bound — repairs below
+    # land on exact host f64 either way, so results stay bit-parity
+    # with the f32 path
+    eps_dt = table_dtype_eps(node_dt)
+    quant = (
+        int8_quant_bound(parts_max) if node_dt == "int8" else 0.0
+    )
     keep_r = None
     disc = None
     pruned_cells = 0
@@ -2589,14 +2762,16 @@ def _finish_device_row(
     if sr.kind == "kbest":
         vals, margins, own_idx, *slots = outs
         margins = np.asarray(margins[region], dtype=np.float64)
-        local_err = _EPS32 * (len(parts) + 1) * parts_max
+        local_err = eps_dt * (len(parts) + 1) * parts_max + quant
         # per-COMPONENT certificate: every selected slot must beat
-        # the next candidate by the f32 rounding bound, or the slot
-        # sequence (and so the backpointers) is uncertain — the whole
-        # node is then redone on host f64, still exact
+        # the next candidate by the storage-dtype rounding bound, or
+        # the slot sequence (and so the backpointers) is uncertain —
+        # the whole node is then redone on host f64, still exact
         if np.any(margins < 2.0 * (local_err + err_in)):
             if met.enabled:
                 met.inc("semiring.cert_fallbacks")
+                if node_dt != "f32":
+                    met.inc("semiring.precision_repairs")
             host_kw = _kbest_host(
                 parts, target, shape, sr.cell_width
             )
@@ -2634,17 +2809,19 @@ def _finish_device_row(
             if ctx is not None and disc is not None
             else 0.0
         )
-        sw.err[k][name] = err_in + _EPS32 * (
+        sw.err[k][name] = err_in + eps_dt * (
             (len(parts) + 1) * scale + shape[-1] + 2
-        ) + extra
+        ) + quant + extra
         sw.device_nodes[k] += 1
         finish(sr, k, name, plan, sep, u, None)
     elif sr.idempotent:
         arg, margins = outs
         arg = np.array(arg[region])  # writable (repair)
         margins = np.asarray(margins[region], dtype=np.float64)
-        local_err = _EPS32 * (len(parts) + 1) * parts_max
+        local_err = eps_dt * (len(parts) + 1) * parts_max + quant
         bad = np.argwhere(margins < 2.0 * (local_err + err_in))
+        if node_dt != "f32" and len(bad) and met.enabled:
+            met.inc("semiring.precision_repairs")
         if len(bad) * 10 > margins.size:
             # tie-heavy: per-cell repair would dominate — redo the
             # whole contraction on host f64 (still exact)
@@ -2724,9 +2901,9 @@ def _finish_device_row(
             if ctx is not None and disc is not None
             else 0.0
         )
-        sw.err[k][name] = err_in + _EPS32 * (
+        sw.err[k][name] = err_in + eps_dt * (
             (len(parts) + 1) * scale + shape[-1] + 2
-        ) + extra
+        ) + quant + extra
         sw.device_nodes[k] += 1
         finish(sr, k, name, plan, sep, u, None)
     return pruned_cells
@@ -3030,6 +3207,7 @@ def run_infer_many(
         Mapping[str, Mapping[Any, float]]
     ] = None,
     bnb: str = "auto",
+    table_dtype: str = "f32",
     _plans: Optional[Sequence["ContractionPlan"]] = None,
     _memos: Optional[Sequence[Any]] = None,
 ) -> List[Dict[str, Any]]:
@@ -3077,6 +3255,7 @@ def run_infer_many(
     t0 = time.perf_counter()
     qkind, sr = parse_query(query)
     bnb = as_bnb(bnb, "auto")
+    table_dtype = as_table_dtype(table_dtype)
     if device not in ("auto", "never", "always"):
         raise ValueError(
             f"device must be 'auto'|'never'|'always', got {device!r}"
@@ -3153,13 +3332,14 @@ def run_infer_many(
             max_util_bytes=int(max_util_bytes), beta=beta, dmc=dmc,
             pad=pad, tol=tol, max_table_size=max_table_size,
             want_args=want_args, t0=t0, timeout=timeout, K=K,
-            query=query, bnb=bnb,
+            query=query, bnb=bnb, table_dtype=table_dtype,
         )
 
     sw = contract_sweep(
         plans, sr, beta=beta, device_min_cells=dmc, pad=pad,
         tol=tol, max_table_size=max_table_size, want_args=want_args,
         t0=t0, timeout=timeout, bnb=bnb, memos=_memos,
+        table_dtype=table_dtype,
     )
     if sw is None:
         return [_timeout_result(query, t0) for _ in range(K)]
@@ -3288,6 +3468,7 @@ def _run_bounded_infer(
     dcops, plans, qkind, sr, *, max_util_bytes, beta, dmc, pad,
     tol, max_table_size, want_args, t0, timeout, K,
     query: Optional[str] = None, bnb: str = "off",
+    table_dtype: str = "f32",
 ) -> List[Dict[str, Any]]:
     """Memory-bounded assembly behind :func:`run_infer_many`
     (``max_util_bytes`` set): the budgeted lane sweep
@@ -3308,7 +3489,7 @@ def _run_bounded_infer(
         plans, sr, max_util_bytes=max_util_bytes, beta=beta,
         device_min_cells=dmc, pad=pad, tol=tol,
         max_table_size=max_table_size, want_args=want_args,
-        t0=t0, timeout=timeout, bnb=bnb,
+        t0=t0, timeout=timeout, bnb=bnb, table_dtype=table_dtype,
     )
     if bs is None:
         return [_timeout_result(query, t0) for _ in range(K)]

@@ -1,0 +1,404 @@
+"""Mixed-precision table packs (ISSUE 19, ``ops/semiring.py`` +
+``algorithms/dpop.py`` + ``ops/membound.py``, ``docs/performance.md``
+'Mixed-precision table packs'): the ``table_dtype`` axis must keep
+the exact queries BIT-IDENTICAL to the f32 path (the certificate
+ladder repairs uncertain low-precision nodes back to f32/f64), keep
+the mass queries inside their honestly WIDENED error bounds, widen
+the bnb slack conservatively, quantize int8 tables within the
+reported grid bound, shrink the memory-bounded planner's per-cell
+byte charge, and join the service's dispatch partition key.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import itertools
+import os
+import random
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+pytestmark = pytest.mark.semiring
+
+_TOOL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools",
+    "recompile_guard.py",
+)
+_spec = importlib.util.spec_from_file_location(
+    "recompile_guard_precision", _TOOL
+)
+_guard = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_guard)
+
+
+# -- helpers ------------------------------------------------------------
+
+
+def _hard_band(n, seed, d=4, arity=4, stride=2, cap=1.15, ties=False):
+    """Chained overlap band with HARD over-sum caps (``+inf`` past
+    ``cap x target``) — the same workload shape the bnb suite prunes.
+    ``ties=True`` quantizes costs to a coarse grid so tables are
+    tie-heavy: the adversarial case for a low-precision argmax
+    certificate (near-ties are exactly what storage rounding flips)."""
+    rnd = random.Random(seed)
+    dom = Domain("d", "", list(range(d)))
+    dcop = DCOP(f"px{seed}")
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for i, v in enumerate(vs):
+        dcop.add_variable(v)
+        dcop.add_constraint(
+            NAryMatrixRelation(
+                [v],
+                np.arange(d, dtype=np.float64)
+                * rnd.uniform(0.05, 0.3),
+                name=f"u{i}",
+            )
+        )
+    for m in range((n - arity) // stride + 1):
+        scope = vs[m * stride:m * stride + arity]
+        t = rnd.uniform(0.3, 0.8) * arity * (d - 1)
+        mat = np.zeros((d,) * arity)
+        for idx in itertools.product(range(d), repeat=arity):
+            s = sum(idx)
+            if s > cap * t:
+                mat[idx] = np.inf
+            else:
+                c = abs(s - t)
+                mat[idx] = round(c * 2) / 2.0 if ties else c
+        dcop.add_constraint(
+            NAryMatrixRelation(scope, mat, name=f"m{m}")
+        )
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n)])
+    return dcop
+
+
+def _overlap_secp():
+    """The membound guard's fixed overlap-zone SECP — ONE builder
+    shared with tools/recompile_guard.py so the cut-width assertions
+    below can never drift onto a different workload."""
+    return _guard._build_secp_overlap(12, 10, 3, seed=77)
+
+
+# -- exact queries: bit parity across precisions ------------------------
+
+
+@pytest.mark.parametrize("dtype", ["bf16", "int8"])
+@pytest.mark.parametrize("seed,ties", [(1, True), (3, False)])
+def test_dpop_and_map_bit_parity(dtype, seed, ties):
+    """dpop and infer-map at bf16/int8 are BIT-IDENTICAL to f32 on
+    tie-heavy and hard-capped (±inf) tables: the per-node certificate
+    re-checks margins against the storage dtype's error and repairs
+    uncertain cells at host f64, so storage rounding can never flip
+    an argmax."""
+    from pydcop_tpu.api import infer, solve
+
+    dcop = _hard_band(10, seed, ties=ties)
+    kw = dict(pad_policy="pow2")
+    base = solve(dcop, "dpop", {"util_device": "always"}, **kw)
+    low = solve(
+        dcop, "dpop",
+        {"util_device": "always", "table_dtype": dtype}, **kw
+    )
+    assert low["cost"] == base["cost"]
+    assert low["assignment"] == base["assignment"]
+    m32 = infer(dcop, "map", device="always")
+    mlo = infer(dcop, "map", device="always", table_dtype=dtype)
+    assert mlo["cost"] == m32["cost"]
+    assert mlo["assignment"] == m32["assignment"]
+
+
+@pytest.mark.parametrize("dtype", ["bf16", "int8"])
+def test_kbest_bit_parity(dtype):
+    """The k-best list — solutions AND costs, in order — matches f32
+    exactly at low precision: each component's certificate is
+    repaired per precision and every returned solution is f64
+    re-evaluated."""
+    from pydcop_tpu.api import infer
+
+    dcop = _hard_band(9, 2, ties=True)
+    off = infer(dcop, "kbest:5", device="always")
+    low = infer(
+        dcop, "kbest:5", device="always", table_dtype=dtype
+    )
+    assert low["solutions"] == off["solutions"]
+    assert low["costs"] == off["costs"]
+    assert low["k"] == off["k"]
+
+
+# -- mass queries: honestly widened bounds ------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["bf16", "int8"])
+def test_log_z_within_widened_bound(dtype):
+    """log_z at low precision stays within its REPORTED error bound
+    of the host-f64 answer, and that bound is strictly WIDER than the
+    f32 device run's — honest accounting, not silent optimism.
+    ``tol=inf`` keeps the low-precision tables active (the default
+    tol demotes every uncertain mass node back to f32)."""
+    from pydcop_tpu.api import infer
+
+    dcop = _hard_band(9, 4)
+    kw = dict(device="always", tol=float("inf"), pad_policy="pow2")
+    host = infer(dcop, "log_z", device="never")
+    dev32 = infer(dcop, "log_z", **kw)
+    devlo = infer(dcop, "log_z", table_dtype=dtype, **kw)
+    assert (
+        abs(devlo["log_z"] - host["log_z"])
+        <= devlo["error_bound"] + 1e-9
+    )
+    assert devlo["error_bound"] > dev32["error_bound"]
+
+
+def test_default_tol_demotes_bf16_mass_nodes_to_f32():
+    """Under the DEFAULT tol the repair ladder demotes every bf16
+    mass node back to f32 — log_z is then identical to the f32 run
+    and the demotions are counted in ``semiring.precision_repairs``."""
+    from pydcop_tpu.api import infer
+
+    dcop = _hard_band(9, 4)
+    kw = dict(device="always", pad_policy="pow2")
+    dev32 = infer(dcop, "log_z", **kw)
+    devb = infer(dcop, "log_z", table_dtype="bf16", **kw)
+    assert devb["log_z"] == dev32["log_z"]
+    assert devb["error_bound"] == dev32["error_bound"]
+    c = devb["telemetry"]["counters"]
+    assert int(c.get("semiring.precision_repairs", 0)) >= 1, c
+
+
+# -- int8 quantization grid ---------------------------------------------
+
+
+@pytest.mark.parametrize("mag", [1e-6, 1.0, 1e6, 1e12])
+def test_int8_round_trip_extreme_magnitudes(mag):
+    """quantize/dequantize round-trips within the published grid
+    bound ``int8_quant_bound`` at extreme magnitudes, and the ±inf
+    reserved codes decode EXACTLY (hard constraints survive any
+    scale)."""
+    from pydcop_tpu.ops.padding import (
+        dequantize_table_int8,
+        int8_quant_bound,
+        quantize_table_int8,
+    )
+
+    rnd = np.random.default_rng(11)
+    a = (rnd.uniform(-1.0, 1.0, size=(4, 4)) * mag).astype(
+        np.float32
+    )
+    a[0, 0] = np.inf
+    a[1, 1] = -np.inf
+    q, scale, offset = quantize_table_int8(a)
+    back = dequantize_table_int8(q, scale, offset)
+    finite = np.isfinite(a)
+    bound = int8_quant_bound(float(np.abs(a[finite]).max()))
+    assert np.all(
+        np.abs(back[finite] - a[finite].astype(np.float64))
+        <= bound * (1 + 1e-6)
+    )
+    assert back[0, 0] == np.inf and back[1, 1] == -np.inf
+
+
+def test_int8_degenerate_constant_table_is_exact():
+    from pydcop_tpu.ops.padding import (
+        dequantize_table_int8,
+        quantize_table_int8,
+    )
+
+    a = np.full((3, 3), 7.25, dtype=np.float32)
+    q, scale, offset = quantize_table_int8(a)
+    assert np.all(dequantize_table_int8(q, scale, offset) == 7.25)
+
+
+# -- bnb slack stays conservative ---------------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["bf16", "int8"])
+@pytest.mark.parametrize("seed", [1, 5])
+def test_bnb_pruning_conservative_at_low_precision(dtype, seed):
+    """bnb=on at low precision vs the unpruned host-f64 oracle: the
+    slack widens by the storage dtype's eps (+ the int8 grid bound),
+    so a row the pruned low-precision kernel discards provably cannot
+    contain the optimum — cost AND assignment stay bit-identical."""
+    from pydcop_tpu.api import solve
+
+    dcop = _hard_band(10, seed, ties=True)
+    oracle = solve(dcop, "dpop", {"util_device": "never"})
+    pruned = solve(
+        dcop, "dpop",
+        {
+            "util_device": "always", "bnb": "on",
+            "table_dtype": dtype,
+        },
+        pad_policy="pow2",
+    )
+    assert pruned["cost"] == oracle["cost"]
+    assert pruned["assignment"] == oracle["assignment"]
+
+
+# -- memory-bounded planning at real byte width -------------------------
+
+
+def test_membound_budgeted_bf16_matches_unbounded_f32():
+    """The satellite's equivalence: budgeted + bf16 ≡ unbounded +
+    f32 — the planner charges 2 bytes/cell so the same budget admits
+    bigger tables, and the repair ladder keeps the min_sum result
+    bit-identical anyway."""
+    from pydcop_tpu.api import solve
+
+    dcop = _overlap_secp()
+    base = solve(dcop, "dpop", {"util_device": "never"})
+    b = solve(
+        dcop, "dpop",
+        {"util_device": "always", "table_dtype": "bf16"},
+        max_util_bytes=512, pad_policy="pow2",
+    )
+    assert b["cost"] == base["cost"]
+    assert b["assignment"] == base["assignment"]
+    assert b["membound"]["table_dtype"] == "bf16"
+
+
+def test_membound_same_budget_smaller_cut_at_lower_precision():
+    """The acceptance criterion, deterministic in-suite: at ONE fixed
+    budget the planner's cut is strictly SMALLER at bf16 than at f32
+    (fewer conditioned separator variables / lanes), because
+    ``plan_cut`` sizes cells at the real per-dtype byte width — and
+    every variant still lands on the same exact cost."""
+    from pydcop_tpu.api import solve
+
+    dcop = _overlap_secp()
+    mbs = {}
+    costs = set()
+    for dt in ("f32", "bf16", "int8"):
+        r = solve(
+            dcop, "dpop",
+            {"util_device": "never", "table_dtype": dt},
+            max_util_bytes=512, pad_policy="pow2",
+        )
+        mbs[dt] = r["membound"]
+        costs.add(r["cost"])
+    assert len(costs) == 1  # budget/dtype never changes the answer
+    assert mbs["bf16"]["cut_width"] < mbs["f32"]["cut_width"], mbs
+    assert mbs["bf16"]["cut_lanes"] < mbs["f32"]["cut_lanes"], mbs
+    assert (
+        mbs["int8"]["cut_width"] <= mbs["bf16"]["cut_width"]
+    ), mbs
+    # the reported peaks are charged at the real byte width
+    assert (
+        mbs["f32"]["max_util_bytes"]
+        == mbs["bf16"]["max_util_bytes"]
+        == 512
+    )
+
+
+# -- vocabulary: one spelling, shared with msg_dtype --------------------
+
+
+def test_dtype_vocabulary_is_shared_and_suggests_on_typo():
+    """One parser (``ops/padding.as_table_dtype``) owns the precision
+    vocabulary: aliases normalize, typos get a nearest-name
+    suggestion, and maxsum's message-plane ``msg_dtype`` draws from
+    the same spelling (bf16 only — messages are never int8)."""
+    from pydcop_tpu.ops.padding import as_table_dtype
+
+    assert as_table_dtype("bfloat16") == "bf16"
+    assert as_table_dtype("float32") == "f32"
+    assert as_table_dtype("i8") == "int8"
+    assert as_table_dtype(None) == "f32"
+    with pytest.raises(ValueError, match="bf16"):
+        as_table_dtype("bf17")
+    with pytest.raises(ValueError, match="int8"):
+        as_table_dtype("int9")
+    # the message-plane sibling rejects int8 with the narrowed list
+    with pytest.raises(ValueError, match="f32"):
+        as_table_dtype("int8", allowed=("f32", "bf16"))
+
+
+def test_maxsum_msg_dtype_still_works_and_rejects_int8():
+    from pydcop_tpu.api import solve
+
+    dcop = _hard_band(8, 6, cap=10.0)  # soft band: maxsum-friendly
+    r = solve(
+        dcop, "maxsum", {"msg_dtype": "bf16"}, rounds=12, seed=0
+    )
+    assert r["assignment"]
+    with pytest.raises(ValueError, match="msg_dtype|f32"):
+        solve(
+            dcop, "maxsum", {"msg_dtype": "int8"}, rounds=4, seed=0
+        )
+
+
+# -- service: dtype joins the partition key and rides the wire ----------
+
+
+@pytest.mark.service
+def test_service_dtype_joins_infer_partition_key():
+    """Two same-query infers differing ONLY in table_dtype land in
+    one tick but dispatch as TWO partitions — the dtype is part of
+    ``_infer_group_key``, so mixed-precision traffic never merges
+    into one sweep with a single dtype."""
+    from pydcop_tpu.engine.service import SolverService
+
+    dcop = _hard_band(8, 1)
+    with SolverService(
+        max_batch=2, max_wait=10.0, autostart=False
+    ) as svc:
+        p32 = svc.submit_infer(dcop, "map", device="never")
+        pb = svc.submit_infer(
+            dcop, "map", device="never", table_dtype="bf16"
+        )
+        r32, rb = p32.result(timeout=300), pb.result(timeout=300)
+        stats = svc.stats()
+    assert r32["cost"] == rb["cost"]
+    assert r32["assignment"] == rb["assignment"]
+    assert stats["ticks"] == 1, stats
+    assert stats["dispatches"] == 2, stats
+
+
+@pytest.mark.service
+def test_service_wire_round_trip_carries_table_dtype():
+    """table_dtype rides the wire protocol end to end: an infer frame
+    and a solve frame both carry it, results match the in-process
+    calls bit-for-bit, and a bad spelling fails THIS call with the
+    nearest-name suggestion without killing the connection."""
+    from pydcop_tpu.api import infer
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.engine.service import (
+        ServiceClient,
+        ServiceError,
+        ServiceServer,
+        SolverService,
+    )
+
+    dcop = _hard_band(8, 1)
+    yaml_text = dcop_yaml(dcop)
+    ref = infer(dcop, "map", device="never", table_dtype="bf16")
+    with SolverService(max_wait=0.05) as svc:
+        with ServiceServer(svc, port=0) as server:
+            with ServiceClient(server.address) as cli:
+                out = cli.infer(
+                    yaml_text, "map", device="never",
+                    table_dtype="bf16",
+                )
+                assert out["cost"] == ref["cost"]
+                assert out["assignment"] == ref["assignment"]
+                s = cli.solve(
+                    yaml_text, "dpop", {"util_device": "never"},
+                    table_dtype="int8",
+                )
+                assert s["cost"] == ref["cost"]
+                with pytest.raises(
+                    (ServiceError, ValueError), match="bf16"
+                ):
+                    cli.infer(
+                        yaml_text, "map", table_dtype="bf17"
+                    )
+                assert cli.ping()  # connection survived the error
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -242,6 +242,24 @@ def test_delta_guard_warm_followup_is_o_delta():
     ), report
 
 
+@pytest.mark.semiring
+def test_precision_guard_bf16_reuses_buckets():
+    """Mixed-precision table packs (ISSUE 19): running the same K
+    instances at table_dtype='bf16' after a warm f32 pass — map via
+    infer_many AND dpop via solve_many — compiles at most one new
+    executable per (semiring, bucket) (bf16 count <= the f32 pass's),
+    ZERO on repeat of either precision, and both queries stay
+    bit-identical across precisions (the certificate ladder's repair
+    contract).  See tools/recompile_guard.py:run_precision_guard."""
+    guard = _load_guard()
+    report = guard.run_precision_guard()
+    assert report["ok"], report
+    assert report["f32_compiles"] >= 1, report  # guard actually ran
+    assert report["bf16_compiles"] <= report["f32_compiles"], report
+    assert report["repeat_compiles"] == 0, report
+    assert report["device_nodes"] >= 1, report
+
+
 @pytest.mark.membound
 def test_membound_guard_budgeted_solve_reuses_buckets():
     """Memory-bounded solves (ops/membound.py): the first budgeted

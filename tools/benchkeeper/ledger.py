@@ -329,6 +329,16 @@ _METRIC_SPECS: Tuple[Tuple[str, str, str, bool, Tuple[str, ...]], ...] = (
      ("supervised_overhead", "algos", "maxsum", "overhead_pct")),
     ("supervised_overhead", "dsa_overhead_pct", "pct", False,
      ("supervised_overhead", "algos", "dsa", "overhead_pct")),
+    ("precision", "dpop_util_cells_per_sec_f32", "cells/s", True,
+     ("precision", "dpop_secp", "f32", "util_cells_per_sec")),
+    ("precision", "dpop_util_cells_per_sec_bf16", "cells/s", True,
+     ("precision", "dpop_secp", "bf16", "util_cells_per_sec")),
+    ("precision", "dpop_speedup_bf16_vs_f32", "ratio", True,
+     ("precision", "dpop_secp", "speedup_bf16_vs_f32")),
+    ("precision", "infer_speedup_bf16_vs_f32", "ratio", True,
+     ("precision", "semiring_infer", "speedup_bf16_vs_f32")),
+    ("precision", "membound_cut_width_bf16", "count", False,
+     ("precision", "membound", "bf16", "cut_width")),
 )
 
 

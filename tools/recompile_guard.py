@@ -192,6 +192,22 @@ DELTA_HUBS = 100
 DELTA_LEAVES = 100
 DELTA_MAX_FRACTION = 0.05
 
+# mixed-precision table packs (ISSUE 19, ops/semiring.py +
+# algorithms/dpop.py): the storage dtype joins the kernel-cache key —
+# NOT the level-pack bucket key — so running the SAME K instances at
+# table_dtype='bf16' after a warm f32 pass must reuse the bucketing
+# wholesale and compile AT MOST one new executable per (semiring,
+# bucket) — i.e. bf16's compile count <= the f32 pass's — and
+# repeating EITHER precision performs ZERO new compiles.  More bf16
+# compiles than f32 buckets = the dtype leaked into the bucket key
+# (shape churn per precision); compiles on repeat = the (semiring,
+# bucket, dtype) cache key is unstable.  Results must be
+# bit-identical across precisions for the argmax queries (map via
+# infer_many, dpop via solve_many) — the certificate ladder repairs
+# uncertain low-precision nodes back to f32/f64, so ANY divergence is
+# a correctness bug, not noise.
+PRECISION_K = 4
+
 
 def _build_dcop():
     from pydcop_tpu.dcop.dcop import DCOP
@@ -1645,6 +1661,121 @@ def run_delta_guard() -> dict:
     return report
 
 
+def run_precision_guard() -> dict:
+    """Compile budget for mixed-precision table packs (the
+    PRECISION_K constant block above): over K same-bucket SECP
+    instances with the device forced on, a warm-f32 -> bf16 precision
+    swap on the SAME instances — map through ``infer_many`` AND dpop
+    through ``solve_many`` — must (1) reuse the level-pack bucketing
+    wholesale (bf16 compiles <= the f32 pass's bucket count: at most
+    one new executable per (semiring, bucket)), (2) perform ZERO new
+    compiles when either precision repeats, and (3) return map/dpop
+    cost AND assignment bit-identical across precisions (the
+    certificate ladder's repair contract)."""
+    from pydcop_tpu.api import infer_many, solve_many
+    from pydcop_tpu.ops import semiring as sr_mod
+    from pydcop_tpu.telemetry import session
+
+    # cold start for the shared contraction-kernel cache (also DPOP's
+    # join cache — one object), same reason as the other guards
+    sr_mod._KERNELS.clear()
+
+    dcops = [
+        _build_secp(10, 8, 3, seed=140 + i)
+        for i in range(PRECISION_K)
+    ]
+    ikw = dict(device="always", pad_policy="pow2")
+    params = {"util_device": "always"}
+
+    def compiles(tel):
+        return int(tel.summary()["counters"].get("jit.compiles", 0))
+
+    with session() as t1:
+        maps32 = infer_many(dcops, "map", **ikw)
+        solves32 = solve_many(dcops, "dpop", params, pad_policy="pow2")
+    with session() as t2:
+        mapsb = infer_many(
+            dcops, "map", table_dtype="bf16", **ikw
+        )
+        solvesb = solve_many(
+            dcops, "dpop", {**params, "table_dtype": "bf16"},
+            pad_policy="pow2",
+        )
+    with session() as t3:
+        infer_many(dcops, "map", **ikw)
+        infer_many(dcops, "map", table_dtype="bf16", **ikw)
+        solve_many(dcops, "dpop", params, pad_policy="pow2")
+        solve_many(
+            dcops, "dpop", {**params, "table_dtype": "bf16"},
+            pad_policy="pow2",
+        )
+    f32_compiles, bf16_compiles, repeat_compiles = (
+        compiles(t1), compiles(t2), compiles(t3)
+    )
+    report = {
+        "f32_compiles": f32_compiles,
+        "bf16_compiles": bf16_compiles,
+        "repeat_compiles": repeat_compiles,
+        "ok": True,
+        "costs": [r["cost"] for r in maps32],
+        "device_nodes": sum(r["device_nodes"] for r in maps32),
+    }
+    if f32_compiles < 1 or sum(
+        r["device_nodes"] for r in maps32
+    ) < 1:
+        report["ok"] = False
+        report["error"] = (
+            "the f32 pass never reached the device — the guard is "
+            "vacuous (device='always' stopped forcing the path)"
+        )
+    elif bf16_compiles > f32_compiles:
+        report["ok"] = False
+        report["error"] = (
+            f"the bf16 pass compiled {bf16_compiles} executable(s) "
+            f"vs the f32 pass's {f32_compiles} — the storage dtype "
+            "leaked into the level-pack BUCKET key instead of the "
+            "kernel-cache key, churning shapes per precision"
+        )
+    elif repeat_compiles != 0:
+        report["ok"] = False
+        report["error"] = (
+            f"{repeat_compiles} new compile(s) on identical repeat "
+            "runs — the (semiring, bucket, dtype) kernel cache key "
+            "is unstable"
+        )
+    else:
+        # bit-parity across precisions: the certificate ladder
+        # repairs every uncertain bf16 node back to f32/f64, so the
+        # argmax queries must agree EXACTLY — any drift is a
+        # correctness bug, not noise
+        for i in range(PRECISION_K):
+            if (
+                maps32[i]["cost"] != mapsb[i]["cost"]
+                or maps32[i]["assignment"] != mapsb[i]["assignment"]
+            ):
+                report["ok"] = False
+                report["error"] = (
+                    f"instance {i}: bf16 MAP diverges from f32 "
+                    f"({mapsb[i]['cost']} vs {maps32[i]['cost']}) — "
+                    "the precision repair ladder stopped holding"
+                )
+                break
+            if (
+                solves32[i]["cost"] != solvesb[i]["cost"]
+                or solves32[i]["assignment"]
+                != solvesb[i]["assignment"]
+            ):
+                report["ok"] = False
+                report["error"] = (
+                    f"instance {i}: bf16 DPOP diverges from f32 "
+                    f"({solvesb[i]['cost']} vs {solves32[i]['cost']})"
+                    " — the UTIL-phase certificate stopped repairing "
+                    "low-precision nodes"
+                )
+                break
+    return report
+
+
 def main() -> int:
     import jax
 
@@ -1663,6 +1794,7 @@ def main() -> int:
     report_restore = run_restore_guard()
     report_fleet = run_fleet_guard()
     report_delta = run_delta_guard()
+    report_precision = run_precision_guard()
     print(
         json.dumps(
             {
@@ -1678,6 +1810,7 @@ def main() -> int:
                 "restore": report_restore,
                 "fleet": report_fleet,
                 "delta": report_delta,
+                "precision": report_precision,
             }
         )
     )
@@ -1695,6 +1828,7 @@ def main() -> int:
         and report_restore["ok"]
         and report_fleet["ok"]
         and report_delta["ok"]
+        and report_precision["ok"]
         else 1
     )
 
