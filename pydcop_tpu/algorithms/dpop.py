@@ -160,6 +160,21 @@ algo_params: list = [
     AlgoParameterDef(
         "table_dtype", "str", ["f32", "bf16", "int8"], "f32"
     ),
+    # storage layout of the UTIL part tables (docs/performance.md,
+    # "Sparse constraint tables"): 'sparse' COO-packs feasible tuples
+    # only (sorted flat indices + values) and joins them with
+    # gather/segment-reduce kernels — tables dominated by hard
+    # constraints (±inf cells) ship a fraction of their dense bytes.
+    # min/+-kind results stay BIT-IDENTICAL to dense (same argmin
+    # certificate + host f64 repair); the format joins the level-pack
+    # bucket key (<=1 extra executable per bucket per format;
+    # tools/recompile_guard.py:run_sparse_guard pins it).  Sparse
+    # instances route through the planner sweep (ops/membound.py) —
+    # an unbudgeted sparse solve runs the same plan with an
+    # effectively unlimited byte budget (empty cut).
+    AlgoParameterDef(
+        "table_format", "str", ["dense", "sparse"], "dense"
+    ),
 ]
 
 _EPS32 = float(np.finfo(np.float32).eps)
@@ -264,6 +279,24 @@ def solve_host(
     # ONE level-pack-batched sweep, OOM re-planning — same result
     # dict plus a "membound" block
     max_util_bytes = int(params.get("max_util_bytes", 0) or 0)
+    from pydcop_tpu.ops.sparse import as_table_format
+
+    table_format = as_table_format(params.get("table_format"))
+    if table_format == "sparse" and max_util_bytes <= 0:
+        if int(params.get("memory_bound", 0) or 0):
+            raise ValueError(
+                "table_format='sparse' runs the planner sweep "
+                "(ops/membound.py) and is incompatible with "
+                "memory_bound's sequential conditioning passes — "
+                "use max_util_bytes for bounded sparse runs"
+            )
+        # sparse storage lives in the plan-based sweep: run it with an
+        # effectively unlimited byte budget (the cut stays empty, one
+        # lane) so format joins the same level-pack bucket key as the
+        # budgeted path
+        params = dict(params)
+        params["max_util_bytes"] = 1 << 60
+        max_util_bytes = 1 << 60
     if max_util_bytes > 0:
         if int(params.get("memory_bound", 0) or 0):
             raise ValueError(
@@ -457,6 +490,8 @@ def solve_host_many(
         # budgeted instances run their own lane-merged bounded sweep
         # (ops/membound.py) — their lanes already fill the stack axis
         and not int(params_list[i].get("max_util_bytes", 0) or 0)
+        # sparse instances route through the same planner sweep
+        and params_list[i].get("table_format", "dense") != "sparse"
     ]
     for i in range(K):
         if i not in merged_idx:

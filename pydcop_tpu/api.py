@@ -74,6 +74,7 @@ def solve(
     max_util_bytes: Optional[int] = None,
     bnb: Optional[str] = None,
     table_dtype: Optional[str] = None,
+    table_format: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the result dict.
 
@@ -188,7 +189,7 @@ def solve(
             pad_policy=pad_policy, retry_budget=retry_budget,
             chunk_floor=chunk_floor, on_numeric_fault=on_numeric_fault,
             max_util_bytes=max_util_bytes, bnb=bnb,
-            table_dtype=table_dtype,
+            table_dtype=table_dtype, table_format=table_format,
         )
         result["telemetry"] = tel.summary()
     return result
@@ -224,6 +225,7 @@ def _solve_dispatch(
     max_util_bytes=None,
     bnb=None,
     table_dtype=None,
+    table_format=None,
 ) -> Dict[str, Any]:
     """Mode dispatch behind :func:`solve` (which owns the telemetry
     session and the ``result["telemetry"]`` attach)."""
@@ -472,6 +474,26 @@ def _solve_dispatch(
         params_in = {
             **dict(params_in or {}),
             "table_dtype": _as_dt(table_dtype),
+        }
+    if table_format is not None:
+        # storage layout of the device contraction tables — sparse
+        # COO packs + gather joins (docs/performance.md, "Sparse
+        # constraint tables"); same early-parse discipline as
+        # table_dtype above
+        from pydcop_tpu.ops.sparse import as_table_format as _as_fmt
+
+        if not any(
+            p.name == "table_format" for p in module.algo_params
+        ):
+            raise ValueError(
+                "table_format selects the storage layout of the "
+                "device contraction tables — supported by "
+                "algorithms with a device contraction phase "
+                f"(dpop) and by api.infer; {algo_name!r} has none"
+            )
+        params_in = {
+            **dict(params_in or {}),
+            "table_format": _as_fmt(table_format),
         }
     params = prepare_algo_params(params_in, module.algo_params)
 
@@ -1157,6 +1179,7 @@ def infer(
     ] = None,
     bnb: str = "auto",
     table_dtype: str = "f32",
+    table_format: str = "dense",
 ) -> Dict[str, Any]:
     """Exact probabilistic inference over a DCOP's cost model — the
     semiring-generic twin of :func:`solve` (``docs/semirings.md``).
@@ -1243,6 +1266,19 @@ def infer(
     ``error_bound``.  bf16 halves and int8 quarters per-cell HBM —
     the same ``max_util_bytes`` budget fits a smaller cut.
 
+    ``table_format`` (``"dense"`` default, ``"sparse"``) picks the
+    STORAGE LAYOUT of the device contraction tables
+    (``docs/performance.md``, "Sparse constraint tables"): sparse
+    COO-packs the feasible tuples of hard-constraint-dominated
+    tables (sorted flat indices + values, density <= 0.5) and joins
+    them with gather/segment-reduce kernels over candidate lists.
+    ``map``/``kbest`` stay bit-identical to dense (same certificate
+    + host f64 repair); the mass queries fold any pack truncation
+    into ``error_bound``.  Composes with ``table_dtype`` (packed
+    values quantize like dense packs) and ``max_util_bytes`` (nodes
+    are budgeted at their PACKED bytes — the same budget fits a
+    smaller cut on sparse workloads).
+
     Returns a result dict with ``status``/``time``/``telemetry``
     plus the query's payload, ``cells``/``dispatches``/
     ``device_nodes``/``host_nodes`` contraction stats, and the
@@ -1256,7 +1292,7 @@ def infer(
         trace_format=trace_format, compile_cache=compile_cache,
         retry_budget=retry_budget, max_util_bytes=max_util_bytes,
         map_vars=map_vars, external_dists=external_dists, bnb=bnb,
-        table_dtype=table_dtype,
+        table_dtype=table_dtype, table_format=table_format,
     )[0]
 
 
@@ -1283,6 +1319,7 @@ def infer_many(
     ] = None,
     bnb: str = "auto",
     table_dtype: str = "f32",
+    table_format: str = "dense",
 ) -> list:
     """Run one inference ``query`` over MANY instances with their
     contraction sweeps MERGED — the :func:`solve_many` batching
@@ -1333,6 +1370,7 @@ def infer_many(
             max_util_bytes=max_util_bytes,
             map_vars=map_vars, external_dists=external_dists,
             bnb=bnb, table_dtype=table_dtype,
+            table_format=table_format,
             timeout=(
                 None
                 if deadline is None

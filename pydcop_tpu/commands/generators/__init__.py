@@ -11,5 +11,6 @@ GENERATORS = [
     "ising",
     "meetingscheduling",
     "secp",
+    "taskscheduling",
     "agents",
 ]

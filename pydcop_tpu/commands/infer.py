@@ -128,6 +128,18 @@ def set_parser(subparsers) -> None:
         "width the --max_util_bytes planner charges "
         "(docs/performance.md, 'Mixed-precision table packs')",
     )
+    p.add_argument(
+        "--table_format", choices=["dense", "sparse"],
+        default="dense",
+        help="storage layout for packed contraction tables: "
+        "'sparse' COO-packs feasible tuples of hard-constraint-"
+        "dominated tables (density <= 0.5) and joins them with "
+        "gather/segment-reduce kernels — map/kbest stay "
+        "bit-identical to dense, the mass queries fold pack "
+        "truncation into error_bound; composes with --table_dtype "
+        "and --max_util_bytes (docs/performance.md, 'Sparse "
+        "constraint tables')",
+    )
     add_trace_arguments(p)
     p.set_defaults(func=run_cmd)
 
@@ -166,6 +178,7 @@ def run_cmd(args) -> int:
         max_util_bytes=args.max_util_bytes,
         bnb=args.bnb,
         table_dtype=args.table_dtype,
+        table_format=args.table_format,
         map_vars=(
             [v.strip() for v in args.map_vars.split(",") if v.strip()]
             if args.map_vars

@@ -172,6 +172,17 @@ def set_parser(subparsers) -> None:
         "--max_util_bytes planner charges "
         "(docs/performance.md, 'Mixed-precision table packs')",
     )
+    p.add_argument(
+        "--table_format", choices=["dense", "sparse"], default=None,
+        help="storage layout for packed contraction tables "
+        "(algorithms with a device contraction phase — dpop): "
+        "'sparse' COO-packs feasible tuples of hard-constraint-"
+        "dominated tables and joins them with gather/segment-reduce "
+        "kernels — min/max-sum results stay bit-identical to dense "
+        "and a >=90%%-infeasible workload ships a fraction of the "
+        "dense bytes (docs/performance.md, 'Sparse constraint "
+        "tables')",
+    )
     add_supervisor_arguments(p)
     add_collect_arguments(p)
     add_trace_arguments(p)
@@ -188,6 +199,8 @@ def run_cmd(args) -> int:
         params = {**params, "bnb": args.bnb}
     if args.table_dtype is not None:
         params = {**params, "table_dtype": args.table_dtype}
+    if args.table_format is not None:
+        params = {**params, "table_format": args.table_format}
     if args.many:
         return _run_many_cmd(args, params)
     profile_ctx = None

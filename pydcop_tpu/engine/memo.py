@@ -61,6 +61,13 @@ def _nbytes(obj: Any) -> int:
     overhead is charged a flat word per element)."""
     if isinstance(obj, np.ndarray):
         return int(obj.nbytes)
+    from pydcop_tpu.ops.sparse import SparseTable
+
+    if isinstance(obj, SparseTable):
+        # charge the PACKED footprint — memoizing a sparse message at
+        # its dense box size would evict the very entries the format
+        # exists to keep
+        return int(obj.nbytes)
     if isinstance(obj, (tuple, list)):
         return 16 + sum(_nbytes(x) for x in obj)
     if isinstance(obj, dict):
@@ -145,11 +152,14 @@ class SweepMemo:
         part_shapes: Tuple[Tuple[int, ...], ...],
         use_bnb: bool,
         table_dtype: str = "f32",
+        table_format: str = "dense",
     ) -> None:
+        # sparse specs reuse the slots: pshape = (n_cand_b, n_seg_b),
+        # part_shapes = the packed part lengths (ints)
         self._kernel_specs[
             (
                 sr_name, tuple(pshape), tuple(part_shapes),
-                bool(use_bnb), str(table_dtype),
+                bool(use_bnb), str(table_dtype), str(table_format),
             )
         ] = None
 
@@ -167,9 +177,18 @@ class SweepMemo:
 
         n = 0
         for spec in list(self._kernel_specs):
-            sr_name, pshape, part_shapes, use_bnb, table_dtype = spec
+            (sr_name, pshape, part_shapes, use_bnb, table_dtype,
+             table_format) = spec
             for h in heights:
                 if (spec, h) in self._prewarmed:
+                    continue
+                if table_format == "sparse":
+                    self._prewarm_sparse(
+                        sr_name, pshape, part_shapes, use_bnb,
+                        table_dtype, h,
+                    )
+                    self._prewarmed.add((spec, h))
+                    n += 1
                     continue
                 fn = contraction_kernel(
                     get_semiring(sr_name), pshape, part_shapes,
@@ -204,6 +223,42 @@ class SweepMemo:
                 self._prewarmed.add((spec, h))
                 n += 1
         return n
+
+    def _prewarm_sparse(
+        self, sr_name, pshape, part_lens, use_bnb, table_dtype, h
+    ) -> None:
+        """Compile one sparse candidate-bucket kernel at stack height
+        ``h`` — mirrors ``ops/semiring.py:_dispatch_sparse``'s ABI
+        (sep/own i32 rows, per-part packed values + gather indices,
+        optional bnb budget and int8 dequant params)."""
+        from pydcop_tpu.ops.sparse import (
+            np_table_format_dtype,
+            sparse_contraction_kernel,
+        )
+
+        n_cand_b, n_seg_b = pshape
+        P = len(part_lens)
+        fn = sparse_contraction_kernel(
+            sr_name, n_cand_b, n_seg_b, tuple(part_lens),
+            bnb=use_bnb, table_dtype=table_dtype,
+        )
+        sep = np.full((h, n_cand_b), n_seg_b, dtype=np.int32)
+        own = np.zeros((h, n_cand_b), dtype=np.int32)
+        vdt = np_table_format_dtype(table_dtype)
+        args: List[Any] = [sep, own] + [
+            np.zeros((h, int(L)), dtype=vdt) for L in part_lens
+        ] + [
+            np.zeros((h, n_cand_b), dtype=np.int32)
+            for _ in part_lens
+        ]
+        if table_dtype == "int8":
+            args = [
+                np.ones((h, P), dtype=np.float32),
+                np.zeros((h, P), dtype=np.float32),
+            ] + args
+        if use_bnb:
+            args.insert(0, np.zeros((h,), dtype=np.float32))
+        fn(*args)
 
 
 class SweepMemoView:
@@ -250,10 +305,11 @@ class SweepMemoView:
 
     def note_kernel(
         self, sr_name, pshape, part_shapes, use_bnb,
-        table_dtype="f32",
+        table_dtype="f32", table_format="dense",
     ):
         self.memo.note_kernel(
-            sr_name, pshape, part_shapes, use_bnb, table_dtype
+            sr_name, pshape, part_shapes, use_bnb, table_dtype,
+            table_format,
         )
 
 
@@ -416,8 +472,12 @@ class ExactSession:
         t0 = time.perf_counter()
         _dpop = self._dpop
         params = dict(params or {})
-        if int(params.get("memory_bound", 0) or 0) or int(
-            params.get("max_util_bytes", 0) or 0
+        if (
+            int(params.get("memory_bound", 0) or 0)
+            or int(params.get("max_util_bytes", 0) or 0)
+            # sparse solves run the planner sweep (unmemoized) —
+            # algorithms/dpop.py routes them to ops/membound.py
+            or params.get("table_format", "dense") != "dense"
         ):
             return _dpop.solve_host(
                 self.dcop, params, timeout=timeout,
@@ -514,10 +574,12 @@ class InferSession:
         max_table_size: int = 1 << 26,
         bnb: str = "auto",
         table_dtype: str = "f32",
+        table_format: str = "dense",
         memo_bytes: int = DEFAULT_MEMO_BYTES,
         clone: bool = True,
     ):
         from pydcop_tpu.ops import semiring as _sr
+        from pydcop_tpu.ops.sparse import as_table_format as _as_fmt
 
         self._sr = _sr
         qkind, _ = _sr.parse_query(query)
@@ -536,6 +598,7 @@ class InferSession:
             pad_policy=pad_policy, max_table_size=max_table_size,
             bnb=bnb,
             table_dtype=_sr.as_table_dtype(table_dtype),
+            table_format=_as_fmt(table_format),
         )
         self.sign = -1.0 if self.dcop.objective == "max" else 1.0
         prov: Dict[str, Any] = {}

@@ -754,6 +754,7 @@ class SolverService:
         max_util_bytes: Optional[int] = None,
         bnb: Optional[str] = None,
         table_dtype: Optional[str] = None,
+        table_format: Optional[str] = None,
         trace: Optional[Mapping[str, Any]] = None,
     ) -> PendingResult:
         """Admit one solve request; returns a :class:`PendingResult`.
@@ -883,6 +884,23 @@ class SolverService:
                 **dict(params_in or {}),
                 "table_dtype": as_table_dtype(table_dtype),
             }
+        if table_format is not None:
+            if not any(
+                p.name == "table_format"
+                for p in module.algo_params
+            ):
+                raise ValueError(
+                    "table_format selects the storage layout of "
+                    "packed contraction tables — supported by the "
+                    "exact contraction engine (dpop); "
+                    f"{algo_name!r} has none"
+                )
+            from pydcop_tpu.ops.sparse import as_table_format
+
+            params_in = {
+                **dict(params_in or {}),
+                "table_format": as_table_format(table_format),
+            }
         params = prepare_algo_params(params_in, module.algo_params)
 
         req = _Request(
@@ -961,6 +979,7 @@ class SolverService:
         max_util_bytes: Optional[int] = None,
         bnb: str = "auto",
         table_dtype: str = "f32",
+        table_format: str = "dense",
         trace: Optional[Mapping[str, Any]] = None,
     ) -> PendingResult:
         """Admit one inference request (``docs/semirings.md``): the
@@ -1029,8 +1048,10 @@ class SolverService:
                 f"bnb must be 'auto'|'on'|'off', got {bnb!r}"
             )
         from pydcop_tpu.ops.padding import as_table_dtype
+        from pydcop_tpu.ops.sparse import as_table_format
 
         table_dtype = as_table_dtype(table_dtype)  # fail at admission
+        table_format = as_table_format(table_format)
         if dcop is None:
             raise ValueError("dcop is required")
         dcop_obj, dcop_key = self._load_dcop(dcop)
@@ -1065,6 +1086,7 @@ class SolverService:
                 ),
                 "bnb": str(bnb),
                 "table_dtype": table_dtype,
+                "table_format": table_format,
             },
         )
         req.t_sub = t_sub
@@ -2151,7 +2173,8 @@ class SolverService:
             "infer", req.query, kw["order"], kw["beta"], kw["tol"],
             kw["device"], kw["device_min_cells"], kw["map_vars"],
             ed_key, kw["max_util_bytes"], kw.get("bnb", "auto"),
-            kw.get("table_dtype", "f32"), req.timeout,
+            kw.get("table_dtype", "f32"),
+            kw.get("table_format", "dense"), req.timeout,
         )
 
     def _dispatch_infer_groups(self, reqs: List[_Request]) -> None:
@@ -2212,6 +2235,7 @@ class SolverService:
                     external_dists=kw["external_dists"],
                     bnb=kw.get("bnb", "auto"),
                     table_dtype=kw.get("table_dtype", "f32"),
+                    table_format=kw.get("table_format", "dense"),
                 )
         t_done = time.perf_counter()
         for req in part:
@@ -2443,7 +2467,7 @@ def _load_module(algo_name: str):
 _SOLVE_FIELDS = (
     "rounds", "seed", "chunk_size", "convergence_chunks",
     "n_restarts", "timeout", "session", "set_values",
-    "max_util_bytes", "bnb", "table_dtype",
+    "max_util_bytes", "bnb", "table_dtype", "table_format",
 )
 
 #: fields an ``op: "infer"`` frame may carry — mirrors
@@ -2452,7 +2476,7 @@ _SOLVE_FIELDS = (
 _INFER_FIELDS = (
     "order", "beta", "tol", "device", "device_min_cells",
     "timeout", "map_vars", "external_dists", "max_util_bytes",
-    "bnb", "table_dtype",
+    "bnb", "table_dtype", "table_format",
 )
 
 #: results are trimmed for the wire: the per-round cost trace can be

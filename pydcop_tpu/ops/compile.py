@@ -229,9 +229,28 @@ def _resolve_table_dtype(table_dtype, dtype):
     return jnp.bfloat16 if dt == "bf16" else jnp.float32
 
 
+def _reject_sparse_format(table_format) -> None:
+    """The iterative message-passing engines run on dense packed
+    boxes; ``table_format='sparse'`` (COO packs + gather joins,
+    ``ops/sparse.py``) lives in the contraction stack only.  One
+    explicit rejection beats K engines silently densifying."""
+    if table_format is None:
+        return
+    from pydcop_tpu.ops.sparse import as_table_format
+
+    if as_table_format(table_format) == "sparse":
+        raise ValueError(
+            "table_format='sparse' is only supported by the "
+            "contraction stack (api.infer / api.solve with "
+            "algo='dpop'): COO packs are joined by gather/"
+            "segment-reduce kernels the iterative engines do not "
+            "thread — use 'dense' here"
+        )
+
+
 def compile_dcop(
     dcop: DCOP, dtype=jnp.float32, n_shards: int = 1,
-    pad_policy="none", table_dtype=None,
+    pad_policy="none", table_dtype=None, table_format=None,
 ) -> CompiledProblem:
     """Tabulate and pack a DCOP into a :class:`CompiledProblem` (see
     :func:`_compile_dcop`); records a ``compile-problem`` span when a
@@ -245,12 +264,15 @@ def compile_dcop(
     ``table_dtype`` (``"f32"`` | ``"bf16"``) is the string-vocabulary
     alias of ``dtype`` shared with the contraction stack's knob
     (``docs/performance.md``, mixed-precision table packs); when given
-    it overrides ``dtype``.
+    it overrides ``dtype``.  ``table_format`` is accepted for knob
+    symmetry but only ``"dense"`` is valid here — ``"sparse"`` raises
+    (COO packs live in the contraction stack, ``ops/sparse.py``).
     """
     import time as _time
 
     from pydcop_tpu.telemetry import get_tracer
 
+    _reject_sparse_format(table_format)
     dtype = _resolve_table_dtype(table_dtype, dtype)
     tr = get_tracer()
     if not tr.enabled:
@@ -925,6 +947,7 @@ def compile_from_arrays(
     dtype=jnp.float32,
     pad_policy="none",
     table_dtype=None,
+    table_format=None,
 ) -> CompiledProblem:
     """Array-level problem construction — the fast path for big
     generated instances.
@@ -974,8 +997,10 @@ def compile_from_arrays(
     Variable ``i`` is named ``f"{var_prefix}{i}"``; assignments in and
     out are keyed by those names exactly as with :func:`compile_dcop`.
     ``table_dtype`` (``"f32"`` | ``"bf16"``) overrides ``dtype`` with
-    the shared string vocabulary (:func:`compile_dcop`).
+    the shared string vocabulary (:func:`compile_dcop`);
+    ``table_format`` must stay ``"dense"`` here (:func:`compile_dcop`).
     """
+    _reject_sparse_format(table_format)
     dtype = _resolve_table_dtype(table_dtype, dtype)
     if not isinstance(scopes, (list, tuple)):
         scopes = [scopes]

@@ -245,6 +245,11 @@ class CutPlan:
     #: bf16 halves and int8 quarters the per-cell byte width, so the
     #: same ``max_util_bytes`` fits more cells (a smaller cut)
     table_dtype: str = "f32"
+    #: table format the sweep will run at: ``"sparse"`` sizes
+    #: hard-capped nodes at their estimated PACKED cells (feasible
+    #: fraction × box, plus the per-candidate index overhead), so a
+    #: 0.9-sparse table fits ~10× more scope under the same budget
+    table_format: str = "dense"
 
     @property
     def width(self) -> int:
@@ -264,6 +269,7 @@ def plan_cut(
     max_cut_lanes: int = MAX_CUT_LANES,
     cell_width: int = 1,
     table_dtype: str = "f32",
+    table_format: str = "dense",
 ) -> CutPlan:
     """Choose a minimal cut set keeping every contraction table of
     the plan under ``max_util_bytes``.
@@ -294,11 +300,46 @@ def plan_cut(
     (``ops/padding.py:as_table_dtype``): the budget divides by the
     REAL per-cell byte width, so the same ``max_util_bytes`` fits 2×
     the cells at bf16 and 4× at int8 — a strictly smaller (or equal)
-    cut than f32 for the same plan and budget."""
+    cut than f32 for the same plan and budget.
+
+    ``table_format="sparse"`` sizes each scalar-cell node at what the
+    sparse sweep will actually allocate: the node's feasible fraction
+    (min over its own tables of the non-``+inf`` share — the packed
+    support can only be smaller) times the dense box, times the
+    per-candidate overhead factor ``(value + index bytes) / value
+    bytes``.  Nodes too dense or too small to pack keep their dense
+    size, so the estimate is format-aware per node, not a blanket
+    discount.  Conditioning keeps the unconditioned feasible
+    fraction (the per-slice density varies around it) — the OOM
+    replan ladder of :func:`run_bounded` absorbs underestimates."""
     from pydcop_tpu.ops.padding import NO_PADDING, bucket_util_shape
+    from pydcop_tpu.ops.sparse import (
+        SPARSE_INDEX_BYTES,
+        SPARSE_MAX_DENSITY,
+        SPARSE_MIN_CELLS,
+        as_table_format,
+    )
 
     pad = NO_PADDING if pad is None else pad
     table_dtype = as_table_dtype(table_dtype)
+    table_format = as_table_format(table_format)
+    # structured cells never pack (ops/semiring.py gates on scalar
+    # kinds), so a kbest/expectation sweep sizes dense regardless
+    sparse = table_format == "sparse" and int(cell_width) <= 1
+    feas: Dict[str, float] = {}
+    sp_factor = 1.0
+    if sparse:
+        vb = table_dtype_bytes(table_dtype)
+        sp_factor = (vb + SPARSE_INDEX_BYTES) / vb
+        for v in plan.order:
+            f = 1.0
+            for _dims, t in plan.buckets.get(v, ()):
+                a = np.asarray(t)
+                if a.size:
+                    f = min(
+                        f, 1.0 - float(np.isposinf(a).mean())
+                    )
+            feas[v] = f
     bytes_per_cell = table_dtype_bytes(table_dtype) * max(
         int(cell_width), 1
     )
@@ -319,6 +360,15 @@ def plan_cut(
             size = 1
             for d in tgt:
                 size *= 1 if d in cutset else dsize[d]
+            if sparse:
+                f = feas.get(v, 1.0)
+                est = size * f * sp_factor
+                if (
+                    f <= SPARSE_MAX_DENSITY
+                    and size >= SPARSE_MIN_CELLS
+                    and est < size
+                ):
+                    size = max(int(np.ceil(est)), 1)
             out.append((v, tgt, size))
         return out
 
@@ -364,6 +414,7 @@ def plan_cut(
     return CutPlan(
         tuple(cut), lanes, budget_cells, naive_peak, bounded_peak,
         cell_width=max(int(cell_width), 1), table_dtype=table_dtype,
+        table_format=table_format,
     )
 
 
@@ -526,6 +577,7 @@ class BoundedSweep:
             "cut_width": cp.width,
             "cut_lanes": cp.n_lanes,
             "table_dtype": cp.table_dtype,
+            "table_format": cp.table_format,
             "peak_table_bytes": cp.bounded_peak_cells
             * cp.bytes_per_cell,
             "naive_peak_table_bytes": cp.naive_peak_cells
@@ -551,6 +603,7 @@ def run_bounded(
     timeout: Optional[float] = None,
     bnb: str = "off",
     table_dtype: str = "f32",
+    table_format: str = "dense",
 ) -> Optional[BoundedSweep]:
     """Prune, plan, and run ONE budgeted merged sweep over K
     instances (module docstring), re-planning at half the budget on
@@ -576,8 +629,11 @@ def run_bounded(
 
     met = get_metrics()
     tracer = get_tracer()
+    from pydcop_tpu.ops.sparse import as_table_format
+
     pad = NO_PADDING if pad is None else pad
     table_dtype = as_table_dtype(table_dtype)
+    table_format = as_table_format(table_format)
     t0 = time.perf_counter() if t0 is None else t0
     if int(max_util_bytes) <= 0:
         raise ValueError(
@@ -602,6 +658,7 @@ def run_bounded(
         plan_cut(
             p, max_util_bytes, pad, max_cut_lanes,
             cell_width=sr.cell_width, table_dtype=table_dtype,
+            table_format=table_format,
         )
         for p in plans
     ]
@@ -625,6 +682,7 @@ def run_bounded(
                 want_args=want_args, t0=t0, timeout=timeout,
                 on_oom="raise" if dmc is not None else "host",
                 bnb=bnb, table_dtype=table_dtype,
+                table_format=table_format,
             )
         except DeviceOOMError:
             # the replan rung of the OOM ladder: level->node already
@@ -642,6 +700,7 @@ def run_bounded(
                             p, budget, pad, max_cut_lanes,
                             cell_width=sr.cell_width,
                             table_dtype=table_dtype,
+                            table_format=table_format,
                         )
                         for p in plans
                     ]
@@ -757,6 +816,7 @@ def solve_dpop_bounded(
         as_bnb,
         build_plan,
     )
+    from pydcop_tpu.ops.sparse import as_table_format as _as_fmt
 
     # the unbudgeted UTIL phase's own knob resolution — one mapping,
     # or the budgeted path could silently drift off the
@@ -777,6 +837,7 @@ def solve_dpop_bounded(
         max_table_size=max_table_size, t0=t0, timeout=timeout,
         bnb=as_bnb(params.get("bnb"), "auto"),
         table_dtype=as_table_dtype(params.get("table_dtype")),
+        table_format=_as_fmt(params.get("table_format")),
     )
     if bs is None:
         return _dpop_timeout(dcop, t0)

@@ -83,6 +83,13 @@ from pydcop_tpu.ops.padding import (
     table_dtype_eps,
     util_level_key,
 )
+from pydcop_tpu.ops.sparse import (
+    SparseTable,
+    as_table_format,
+    pack_table,
+    sparse_contraction_kernel,
+    sparse_node_prep,
+)
 
 _EPS32 = float(np.finfo(np.float32).eps)
 _EPS64 = float(np.finfo(np.float64).eps)
@@ -1914,6 +1921,10 @@ def _finite_amax(a) -> float:
     """max |finite entries| — the message-magnitude scale structured
     cells use (+inf slot padding / -inf zero weights are structural,
     not magnitudes the rounding analysis should see)."""
+    if isinstance(a, SparseTable):
+        # packed fast path: absent cells are the exact ⊕-identity,
+        # never a magnitude the rounding analysis should see
+        return a.finite_amax()
     a = np.asarray(a)
     if a.size == 0:
         return 0.0
@@ -1995,6 +2006,7 @@ def contract_sweep(
     bnb: str = "off",
     memos: Optional[Sequence[Any]] = None,
     table_dtype: str = "f32",
+    table_format: str = "dense",
 ) -> Optional[_Sweep]:
     """Merged bottom-up contraction sweep over K instances.
 
@@ -2056,6 +2068,25 @@ def contract_sweep(
     level-pack bucket key (demoted nodes land in f32 buckets, never
     mixing kernels) and ``semiring.int8_requant`` counts int8 part
     packs.
+
+    ``table_format="sparse"`` COO-packs qualifying tables
+    (``ops/sparse.py``): scalar-⊕ own parts and outgoing messages
+    whose non-identity fraction clears the density threshold pack as
+    sorted feasible-tuple indices + values (``semiring.
+    sparse_packs``), and a node holding packed parts contracts
+    through the gather/segment-reduce kernels over the candidate
+    list — the intersection of the packed supports
+    (``semiring.sparse_nodes``; an intersection too dense to pay
+    falls back to the dense kernels, ``semiring.sparse_fallbacks``).
+    The format joins the bucket key, so sparse nodes batch into
+    their own pow-2 candidate buckets and never mix executables with
+    dense ones.  Exactness is unchanged: absent tuples are the
+    ⊕-identity, so idempotent results stay bit-identical (same
+    certificates, same host-f64 re-evaluation — now a packed-lookup
+    gather), mass queries fold any truncated-mass term
+    (:attr:`~pydcop_tpu.ops.sparse.SparseTable.trunc`) into the
+    error ledger, and bnb budgets prune the candidate list's segment
+    reduce directly.
     """
     from pydcop_tpu.engine.supervisor import (
         DeviceOOMError,
@@ -2073,6 +2104,12 @@ def contract_sweep(
 
     bnb = as_bnb(bnb, "off")
     call_dt = as_table_dtype(table_dtype)
+    # packing pays only where the device kernels run — an all-host
+    # sweep joins in exact f64 and would just densify the packs back
+    fmt_sparse = (
+        as_table_format(table_format) == "sparse"
+        and device_min_cells is not None
+    )
     ctxs: List[Optional[_BnbContext]] = [None] * K
     if bnb != "off" and device_min_cells is not None:
         for k, p in enumerate(plans):
@@ -2147,6 +2184,21 @@ def contract_sweep(
             # ⊕-identity and hard constraints carry ±inf — both are
             # exact values, not rounding scales
             mag = _finite_amax(u)
+            if (
+                fmt_sparse
+                and sr_n.cell_width == 1
+                and isinstance(u, np.ndarray)
+            ):
+                # a mostly-identity message (hard caps, bnb pruning)
+                # re-packs before it feeds the parent — absent cells
+                # stay the exact ⊕-identity, so nothing changes but
+                # the bytes (``.size`` keeps the dense cell count for
+                # the util metrics)
+                ps = pack_table(u, sr_n.plus_identity)
+                if ps is not None:
+                    u = ps
+                    if met.enabled:
+                        met.inc("semiring.sparse_packs")
             sw.msgs[k][name] = (sep, u, mag)
             sw.cells[k] += u.size
         memo = memos[k] if memos is not None else None
@@ -2166,7 +2218,12 @@ def contract_sweep(
                     ),
                 )
             else:
-                mu = u if u.base is None else u.copy()
+                mu = (
+                    u.copy()
+                    if isinstance(u, np.ndarray)
+                    and u.base is not None
+                    else u  # owned arrays and immutable packs as-is
+                )
                 memo.store(
                     name,
                     (
@@ -2355,6 +2412,20 @@ def contract_sweep(
                         table_in(own_parts[0][1]), dtype=np.float64
                     )
                     odims = list(own_parts[0][0])
+                if (
+                    fmt_sparse
+                    and sr_n.cell_width == 1
+                    and size * cw >= device_min_cells
+                ):
+                    # COO-pack a qualifying own part (hard caps make
+                    # most cells the ⊕-identity): the node can then
+                    # contract over the candidate list instead of
+                    # the dense box
+                    ps = pack_table(o, sr_n.plus_identity)
+                    if ps is not None:
+                        o = ps
+                        if met.enabled:
+                            met.inc("semiring.sparse_packs")
                 parts.append((odims, o))
                 # finite-masked: ±inf hard-constraint entries are
                 # EXACT in f32 (no rounding to bound), and an inf
@@ -2435,6 +2506,48 @@ def contract_sweep(
                         shape[-1], n_rows,
                     )
 
+            if fmt_sparse and sr_n.kind == "scalar":
+                sprep = sparse_node_prep(
+                    parts, target, shape, sr_n.plus_identity
+                )
+                if sprep is not None:
+                    # candidate-list join: bucket by the pow-2
+                    # candidate geometry — the sparse sibling of the
+                    # level-pack key, so the format never mixes
+                    # executables with the dense buckets
+                    if met.enabled:
+                        met.inc("semiring.sparse_nodes")
+                    sp_bnb = (
+                        bnb_call
+                        and budget is not None
+                        and (
+                            bnb == "on"
+                            or size * cw >= BNB_AUTO_MIN_CELLS
+                        )
+                    )
+                    key = (
+                        "sparse", sr_n.name, node_dt, sprep.key,
+                        sp_bnb,
+                    )
+                    if key not in buckets:
+                        buckets[key] = []
+                        order.append(key)
+                    buckets[key].append(
+                        (
+                            (k, name, sep, target, shape, parts,
+                             parts_max, err_in + sprep.trunc,
+                             budget, shiftc, node_dt),
+                            sprep,
+                        )
+                    )
+                    continue
+                if met.enabled and any(
+                    isinstance(t, SparseTable) for _, t in parts
+                ):
+                    # packed parts present but the intersection
+                    # would not pay: the dense path densifies them
+                    # back (exact either way)
+                    met.inc("semiring.sparse_fallbacks")
             aligned = [
                 _align(t, dims, target) for dims, t in parts
             ]
@@ -2473,6 +2586,27 @@ def contract_sweep(
             entries = buckets[key]
             if timeout is not None and time.perf_counter() - t0 > timeout:
                 return None
+            if key[0] == "sparse":
+                sr_b = get_semiring(key[1])
+                ok = _dispatch_sparse(
+                    sw, sr_b, entries, pad, tol, want_args, finish,
+                    sup, met, plans, use_bnb=key[4], ctxs=ctxs,
+                    tracer=tracer, memos=memos,
+                    table_dtype=key[2], on_oom=on_oom,
+                )
+                if not ok:
+                    # device OOM on the candidate dispatch: redo the
+                    # bucket's nodes on host f64 (exact — _align
+                    # densifies the packs back)
+                    if met.enabled:
+                        met.inc("engine.oom_splits")
+                    for item, _sp in entries:
+                        host_contract(
+                            sr_b, item[0], item[1], plans[item[0]],
+                            item[2], item[3], item[4], item[5],
+                            item[7],
+                        )
+                continue
             sr_b = get_semiring(key[0])
             # ghost guard over padded own-axis cells is the ⊕-identity:
             # +inf keeps a MIN arg-reduce (and every kbest component)
@@ -2713,6 +2847,148 @@ def _dispatch_stacked(
     return True
 
 
+def _dispatch_sparse(
+    sw, sr, entries, pad, tol, want_args, finish, sup, met, plans,
+    use_bnb=False, ctxs=(), tracer=None, memos=None,
+    table_dtype="f32", on_oom="host",
+) -> bool:
+    """One vmapped candidate-list dispatch for a sparse bucket
+    (``ops/sparse.py``): every entry shares the pow-2 candidate
+    geometry, so the rows stack under one
+    :func:`~pydcop_tpu.ops.sparse.sparse_contraction_kernel` exactly
+    like the dense level packs.  Ghost candidates land in the ghost
+    segment and padded rows carry the ``noprune`` sentinel, so
+    neither perturbs results or counters.  Returns False on device
+    OOM (the caller redoes the bucket on host f64) unless
+    ``on_oom="raise"`` — the budgeted sweeps re-plan instead."""
+    from pydcop_tpu.engine.supervisor import DeviceOOMError
+
+    sp0 = entries[0][1]
+    n_cand_b, n_seg_b, part_lens_b = sp0.key
+    n_rows = len(entries)
+    stack_h = stack_bucket(n_rows) if pad.enabled else n_rows
+    P = len(part_lens_b)
+    sep_b = np.full(
+        (stack_h, n_cand_b), n_seg_b, dtype=np.int32
+    )
+    own_b = np.zeros((stack_h, n_cand_b), dtype=np.int32)
+    val_bufs = [
+        np.zeros((stack_h, L), dtype=np.float64)
+        for L in part_lens_b
+    ]
+    gid_bufs = [
+        np.zeros((stack_h, n_cand_b), dtype=np.int32)
+        for _ in part_lens_b
+    ]
+    for r, (_item, sp) in enumerate(entries):
+        nc = sp.n_cand
+        sep_b[r, :nc] = sp.sep_ids
+        own_b[r, :nc] = sp.own_ids
+        for i in range(P):
+            val_bufs[i][r, : sp.part_flats[i].size] = (
+                sp.part_flats[i]
+            )
+            gid_bufs[i][r, :nc] = sp.gidx[i]
+    fn = sparse_contraction_kernel(
+        sr, n_cand_b, n_seg_b, part_lens_b, bnb=use_bnb,
+        table_dtype=table_dtype,
+    )
+    if table_dtype == "int8":
+        # per-(row, part) quantization of the PACKED value vectors —
+        # the sparse composition with int8: indices stay i32, values
+        # carry their own scale/offset pair per row
+        scales = np.ones((stack_h, P), dtype=np.float32)
+        offsets = np.zeros((stack_h, P), dtype=np.float32)
+        qbufs = [
+            np.zeros(b.shape, dtype=np.int8) for b in val_bufs
+        ]
+        for r in range(n_rows):
+            for i, b in enumerate(val_bufs):
+                q, s, o = quantize_table_int8(b[r])
+                qbufs[i][r] = q
+                scales[r, i] = s
+                offsets[r, i] = o
+        if met.enabled:
+            met.inc("semiring.int8_requant", n_rows * P)
+        args = [scales, offsets, sep_b, own_b] + qbufs + gid_bufs
+    else:
+        tabs = [
+            b.astype(_np_table_dtype(table_dtype))
+            for b in val_bufs
+        ]
+        args = [sep_b, own_b] + tabs + gid_bufs
+    if use_bnb:
+        big = float(np.finfo(np.float32).max) / 2
+        noprune = (
+            big if sr.idempotent and not sr.maximize else -big
+        )
+        budgets = np.full(stack_h, noprune, dtype=np.float32)
+        for r, (item, _sp) in enumerate(entries):
+            b = item[8]
+            budgets[r] = b if b is not None else noprune
+        args = [budgets] + args
+    try:
+        outs = sup.dispatch(
+            lambda: tuple(np.asarray(x) for x in fn(*args)),
+            scope="semiring.level", width=stack_h,
+            # real packed bytes: the candidate index buffers plus
+            # the value packs at the storage dtype — NOT the dense
+            # box (that is the whole point)
+            table_bytes=n_cand_b * (8 + 4 * P)
+            + table_dtype_bytes(table_dtype) * sum(part_lens_b),
+        )
+    except DeviceOOMError:
+        if on_oom == "raise":
+            raise
+        return False
+    if met.enabled:
+        met.inc("semiring.dispatches")
+        if use_bnb:
+            met.inc("semiring.bnb_passes")
+    for k in sorted({item[0] for item, _ in entries}):
+        sw.dispatches[k] += 1
+    if memos is not None:
+        for item, _sp in entries:
+            m = memos[item[0]]
+            if m is not None:
+                m.note_kernel(
+                    sr.name, (n_cand_b, n_seg_b), part_lens_b,
+                    use_bnb, table_dtype, table_format="sparse",
+                )
+    pruned_total = 0
+    dense_cells = 0
+    for r, (item, _sp) in enumerate(entries):
+        shape = item[4]
+        sshape = tuple(shape[:-1])
+        n_seg = 1
+        for s in sshape:
+            n_seg *= s
+        dense_cells += n_seg * shape[-1]
+        row_outs = []
+        for o in outs:
+            a = np.asarray(o[r])
+            if a.ndim == 0:
+                row_outs.append(a)  # the mass-bnb discard scalar
+            else:
+                row_outs.append(a[:n_seg].reshape(sshape))
+        region = tuple(slice(0, s) for s in sshape)
+        pruned_total += _finish_device_row(
+            sw, sr, plans[item[0]], item, tuple(row_outs), region,
+            tol, want_args, finish, bnb=use_bnb,
+            ctx=(ctxs[item[0]] if use_bnb else None),
+        )
+    if use_bnb:
+        if pruned_total and met.enabled:
+            met.inc("semiring.bnb_pruned_cells", pruned_total)
+        if tracer is not None and tracer.enabled:
+            tracer.event(
+                "semiring-bnb", cat="supervisor", semiring=sr.name,
+                rows=n_rows, pruned_cells=int(pruned_total),
+                table_cells=int(dense_cells),
+            )
+    return True
+
+
 def _finish_device_row(
     sw, sr, plan, item, outs, region, tol, want_args, finish,
     bnb=False, ctx=None,
@@ -2866,9 +3142,7 @@ def _finish_device_row(
                         idx.append(a_sel)
                     else:
                         idx.append(coords[target.index(d)])
-                acc += np.asarray(table, dtype=np.float64)[
-                    tuple(idx)
-                ]
+                acc += _part_gather(table, tuple(idx))
             u = np.full(tuple(shape[:-1]), identity)
             u[coords] = acc
         else:
@@ -2885,7 +3159,7 @@ def _finish_device_row(
                         idx.append(arg)
                     else:
                         idx.append(grids[target.index(d)])
-                u += np.asarray(table, dtype=np.float64)[tuple(idx)]
+                u += _part_gather(table, tuple(idx))
             if keep_r is not None:
                 u = np.where(keep_r, u, identity)
         sw.device_nodes[k] += 1
@@ -2909,10 +3183,32 @@ def _finish_device_row(
     return pruned_cells
 
 
+def _part_gather(table, idx):
+    """Exact f64 advanced-indexing gather of one part — the sparse
+    fast path looks packed values up by flat index (misses return
+    the ⊕-identity fill) instead of densifying the box."""
+    if isinstance(table, SparseTable):
+        return table.gather(idx)
+    return np.asarray(table, dtype=np.float64)[idx]
+
+
 def _cell_row(table, dims, target, cell):
     """Exact f64 row of one part at a fixed separator cell (broadcast
     over the own axis when the part does not carry it)."""
     own = target[-1]
+    if isinstance(table, SparseTable):
+        if own not in dims:
+            fix = tuple(cell[target.index(d)] for d in dims)
+            return np.full(1, float(table.gather(fix)))
+        ax = list(dims).index(own)
+        return table.gather(
+            tuple(
+                np.arange(table.shape[ax])
+                if d == own
+                else cell[target.index(d)]
+                for d in dims
+            )
+        )
     idx = []
     for d in dims:
         if d == own:
@@ -3208,6 +3504,7 @@ def run_infer_many(
     ] = None,
     bnb: str = "auto",
     table_dtype: str = "f32",
+    table_format: str = "dense",
     _plans: Optional[Sequence["ContractionPlan"]] = None,
     _memos: Optional[Sequence[Any]] = None,
 ) -> List[Dict[str, Any]]:
@@ -3256,6 +3553,7 @@ def run_infer_many(
     qkind, sr = parse_query(query)
     bnb = as_bnb(bnb, "auto")
     table_dtype = as_table_dtype(table_dtype)
+    table_format = as_table_format(table_format)
     if device not in ("auto", "never", "always"):
         raise ValueError(
             f"device must be 'auto'|'never'|'always', got {device!r}"
@@ -3333,13 +3631,14 @@ def run_infer_many(
             pad=pad, tol=tol, max_table_size=max_table_size,
             want_args=want_args, t0=t0, timeout=timeout, K=K,
             query=query, bnb=bnb, table_dtype=table_dtype,
+            table_format=table_format,
         )
 
     sw = contract_sweep(
         plans, sr, beta=beta, device_min_cells=dmc, pad=pad,
         tol=tol, max_table_size=max_table_size, want_args=want_args,
         t0=t0, timeout=timeout, bnb=bnb, memos=_memos,
-        table_dtype=table_dtype,
+        table_dtype=table_dtype, table_format=table_format,
     )
     if sw is None:
         return [_timeout_result(query, t0) for _ in range(K)]
@@ -3468,7 +3767,7 @@ def _run_bounded_infer(
     dcops, plans, qkind, sr, *, max_util_bytes, beta, dmc, pad,
     tol, max_table_size, want_args, t0, timeout, K,
     query: Optional[str] = None, bnb: str = "off",
-    table_dtype: str = "f32",
+    table_dtype: str = "f32", table_format: str = "dense",
 ) -> List[Dict[str, Any]]:
     """Memory-bounded assembly behind :func:`run_infer_many`
     (``max_util_bytes`` set): the budgeted lane sweep
@@ -3490,6 +3789,7 @@ def _run_bounded_infer(
         device_min_cells=dmc, pad=pad, tol=tol,
         max_table_size=max_table_size, want_args=want_args,
         t0=t0, timeout=timeout, bnb=bnb, table_dtype=table_dtype,
+        table_format=table_format,
     )
     if bs is None:
         return [_timeout_result(query, t0) for _ in range(K)]

@@ -1,0 +1,735 @@
+"""Sparse (COO) constraint tables — packed storage + gather-based
+join kernels for the contraction stack (``docs/performance.md``,
+"Sparse table packs").
+
+Every table on device has historically been DENSE (the pyDcop
+``NAryMatrixRelation`` heritage): a hard-capped high-arity factor
+pays exp(arity) cells that are mostly ``+inf`` — PR 14's bnb
+measured a 0.55 pruned-cell fraction on overlap-SECP, evidence most
+of the lattice is dead weight.  This module stores the FEASIBLE
+tuples only: a :class:`SparseTable` is a sorted COO pack (flat
+row-major indices + values) whose absent cells default to the ⊕-
+identity — exactly the GAC-style per-scope keep maps of
+arXiv:1909.06537, with the join cost bounded output-sensitively per
+the FAQ framework (arXiv:1504.04044) instead of by the dense box.
+
+The device contraction of a node whose parts include sparse tables
+is a CANDIDATE-LIST join: the host intersects the parts' lifted
+supports (a tuple can only be finite where EVERY hard part is
+feasible), ships the candidates as ``(sep_id, own_id)`` pairs plus
+one flat gather index per part, and the kernel is two gathers and a
+segment-reduce — no dense box ever materializes on device.  Shapes
+stay static the level-pack way: candidate counts and part pack
+lengths pad to pow-2 buckets (:func:`nnz_bucket`), so one executable
+serves every node of a bucket (``tools/recompile_guard.py:
+run_sparse_guard`` pins at most one extra executable per (semiring,
+bucket, dtype, format)).
+
+Exactness rides the existing certificate machinery unchanged:
+
+- idempotent ⊕ — absent tuples are the ⊕-identity, so the segment
+  reduce over candidates IS the dense reduce; args/margins follow
+  the dense tie-break (lowest own index among minima) and the host
+  re-evaluates exact f64 values at the certified arg, so results
+  stay BIT-IDENTICAL to the dense sweep.
+- mass ⊕ (logsumexp) — absent tuples contribute ``exp(-inf) = 0``
+  exactly; a lossy pack (``drop_tol > 0``) carries its truncated-
+  mass bound in :attr:`SparseTable.trunc` and the sweep folds it
+  into the PR 8 error-bound ledger.
+- bnb — incumbents prune the gathered candidate list directly: the
+  segment reduce's own row value is the pass-1 bound, for free.
+
+Numpy-only at import (the jax-free surface contract): jax loads
+inside :func:`sparse_contraction_kernel` only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from pydcop_tpu.ops.padding import as_table_dtype
+
+__all__ = [
+    "TABLE_FORMATS",
+    "as_table_format",
+    "SparseTable",
+    "pack_table",
+    "nnz_bucket",
+    "sparse_node_prep",
+    "sparse_contraction_kernel",
+    "SPARSE_MAX_DENSITY",
+    "SPARSE_MIN_CELLS",
+    "SPARSE_INDEX_BYTES",
+]
+
+#: canonical table format spellings — ``dense`` is the historical
+#: stack, ``sparse`` the COO candidate-list path of this module
+TABLE_FORMATS = ("dense", "sparse")
+
+_TABLE_FORMAT_ALIASES = {
+    "dense": "dense",
+    "full": "dense",
+    "sparse": "sparse",
+    "coo": "sparse",
+}
+
+
+def as_table_format(
+    spec: Union[str, None],
+    default: str = "dense",
+    allowed: Sequence[str] = TABLE_FORMATS,
+) -> str:
+    """Normalize a ``table_format`` argument to its canonical
+    spelling — the sibling of ``ops/padding.py:as_table_dtype``, so
+    cache keys and wire partition keys compare strings directly.
+    Unknown names raise with a nearest-name suggestion."""
+    if spec is None:
+        return default
+    if not isinstance(spec, str):
+        raise ValueError(
+            f"table format must be a string, got {spec!r}"
+        )
+    s = spec.strip().lower()
+    if not s:
+        return default
+    canon = _TABLE_FORMAT_ALIASES.get(s)
+    if canon is None or canon not in allowed:
+        import difflib
+
+        hint = difflib.get_close_matches(
+            s, sorted(set(_TABLE_FORMAT_ALIASES)), n=1
+        )
+        suggest = (
+            f"; did you mean {hint[0]!r}?"
+            if hint and _TABLE_FORMAT_ALIASES[hint[0]] in allowed
+            else ""
+        )
+        raise ValueError(
+            f"unknown table format {spec!r} (expected one of "
+            f"{tuple(allowed)}{suggest})"
+        )
+    return canon
+
+
+#: a table qualifies for packing when its non-identity fraction is at
+#: most this — below it the index overhead beats the dense cells
+SPARSE_MAX_DENSITY = 0.5
+
+#: tables smaller than this never pack: the candidate machinery's
+#: fixed cost dwarfs any saving on a few hundred cells
+SPARSE_MIN_CELLS = 256
+
+#: per-candidate index overhead the byte budgets charge: sep_id +
+#: own_id i32 pairs plus one i32 gather index (``ops/membound.py``
+#: adds the per-part value bytes via ``table_dtype_bytes``)
+SPARSE_INDEX_BYTES = 12
+
+#: a node falls back to the dense kernels when its candidate list
+#: would exceed this fraction of the dense box — past it the gather
+#: indices outweigh the cells they skip
+SPARSE_MAX_CAND_FRAC = 0.5
+
+#: absolute candidate-list cap per node (i32 buffers; the membound
+#: budget governs the real sizing — this is a host-RAM backstop)
+SPARSE_MAX_CAND = 1 << 24
+
+
+def nnz_bucket(n: int) -> int:
+    """Pow-2 lattice (floor 8) for candidate counts and pack lengths
+    — the static-shape discipline that keeps one compiled executable
+    per bucket instead of one per distinct nnz."""
+    b = 8
+    n = max(int(n), 1)
+    while b < n:
+        b <<= 1
+    return b
+
+
+class SparseTable:
+    """A COO-packed table: sorted flat row-major indices of the
+    non-``fill`` cells plus their values; every absent cell IS
+    ``fill`` (the consuming ⊕'s identity — ``+inf`` for min-domain
+    energies, ``-inf`` for log-weights).
+
+    Quacks like the array the sweeps already pass around —
+    ``shape``/``ndim``/``size`` are the DENSE geometry (so cell
+    accounting and level keys stay comparable across formats) and
+    ``np.asarray`` densifies transparently, so every host fallback
+    path stays correct without a special case.  ``nbytes`` is the
+    PACKED payload — what ``engine/memo.py`` fingerprints and the
+    byte budgets charge."""
+
+    __slots__ = ("shape", "flat", "vals", "fill", "trunc")
+
+    def __init__(
+        self,
+        shape: Tuple[int, ...],
+        flat: np.ndarray,
+        vals: np.ndarray,
+        fill: float,
+        trunc: float = 0.0,
+    ):
+        self.shape = tuple(int(s) for s in shape)
+        self.flat = np.ascontiguousarray(flat, dtype=np.int64)
+        self.vals = np.ascontiguousarray(vals, dtype=np.float64)
+        self.flat.setflags(write=False)
+        self.vals.setflags(write=False)
+        self.fill = float(fill)
+        #: truncated-mass bound (nats) of a lossy pack — 0.0 for an
+        #: exact pack; the mass-⊕ ledger folds it in per use
+        self.trunc = float(trunc)
+
+    # -- array-protocol geometry -----------------------------------------
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    @property
+    def nnz(self) -> int:
+        return int(self.flat.size)
+
+    @property
+    def density(self) -> float:
+        return self.nnz / max(self.size, 1)
+
+    @property
+    def nbytes(self) -> int:
+        """PACKED bytes (indices + values) — the memo/budget unit."""
+        return int(self.flat.nbytes + self.vals.nbytes)
+
+    def __array__(self, dtype=None, copy=None):
+        d = self.todense()
+        return d if dtype is None else d.astype(dtype)
+
+    def todense(self) -> np.ndarray:
+        out = np.full(self.size, self.fill, dtype=np.float64)
+        out[self.flat] = self.vals
+        return out.reshape(self.shape)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"SparseTable(shape={self.shape}, nnz={self.nnz}, "
+            f"fill={self.fill}, trunc={self.trunc})"
+        )
+
+    # -- exact host gathers ----------------------------------------------
+
+    def finite_amax(self) -> float:
+        """Largest |finite| packed value (0.0 when none) — the
+        sparse fast path of ``ops/semiring.py:_finite_amax`` (the
+        fill is an exact identity, never a rounding scale)."""
+        v = self.vals[np.isfinite(self.vals)]
+        return float(np.max(np.abs(v))) if v.size else 0.0
+
+    def lookup(self, flat_idx: np.ndarray) -> np.ndarray:
+        """Exact f64 values at flat row-major indices (vectorized
+        searchsorted; misses return ``fill``)."""
+        fi = np.asarray(flat_idx, dtype=np.int64)
+        pos = np.searchsorted(self.flat, fi)
+        pos_c = np.minimum(pos, max(self.flat.size - 1, 0))
+        hit = (
+            (self.flat[pos_c] == fi)
+            if self.flat.size
+            else np.zeros(fi.shape, dtype=bool)
+        )
+        out = np.full(fi.shape, self.fill, dtype=np.float64)
+        if self.flat.size:
+            out[hit] = self.vals[pos_c[hit]]
+        return out
+
+    def gather(self, idx: Tuple[Any, ...]) -> np.ndarray:
+        """Fancy-index gather (arrays/ints, broadcasting like numpy
+        advanced indexing) — what the exact-f64 host glue calls in
+        place of ``np.asarray(table)[idx]``."""
+        arrs = np.broadcast_arrays(
+            *[np.asarray(i, dtype=np.int64) for i in idx]
+        )
+        flat = np.zeros(arrs[0].shape, dtype=np.int64)
+        stride = 1
+        for ax in range(self.ndim - 1, -1, -1):
+            flat += arrs[ax] * stride
+            stride *= self.shape[ax]
+        return self.lookup(flat)
+
+    def contains(self, flat_idx: np.ndarray) -> np.ndarray:
+        fi = np.asarray(flat_idx, dtype=np.int64)
+        if not self.flat.size:
+            return np.zeros(fi.shape, dtype=bool)
+        pos = np.minimum(
+            np.searchsorted(self.flat, fi), self.flat.size - 1
+        )
+        return self.flat[pos] == fi
+
+    def positions(self, flat_idx: np.ndarray) -> np.ndarray:
+        """Pack positions of flat indices KNOWN to be present — the
+        per-part gather indices the device kernel consumes."""
+        return np.searchsorted(self.flat, flat_idx).astype(np.int64)
+
+
+def pack_table(
+    table: np.ndarray,
+    fill: float,
+    *,
+    max_density: float = SPARSE_MAX_DENSITY,
+    min_cells: int = SPARSE_MIN_CELLS,
+    drop_tol: float = 0.0,
+) -> Optional[SparseTable]:
+    """COO-pack a dense table whose cells default to ``fill``, or
+    None when packing would not pay (too small, or too dense).
+
+    ``drop_tol`` (mass ⊕ only, ``fill = -inf``): additionally drop
+    near-identity log-weight cells whose TOTAL mass is at most
+    ``drop_tol`` of the table's peak-mass bound; the dropped mass is
+    bounded in :attr:`SparseTable.trunc` (nats) and the sweeps fold
+    it into the error-bound ledger — the value answer stays within
+    the reported bound, never silently truncated."""
+    a = np.asarray(table, dtype=np.float64)
+    if a.size < min_cells:
+        return None
+    flat = a.reshape(-1)
+    if np.isnan(fill):  # pragma: no cover - identities are ±inf/0
+        keep = ~np.isnan(flat)
+    else:
+        keep = flat != fill
+    trunc = 0.0
+    if drop_tol > 0.0 and np.isneginf(fill):
+        finite = np.isfinite(flat)
+        if finite.any():
+            vmax = float(np.max(flat[finite]))
+            # cells below this threshold sum to <= drop_tol·e^vmax
+            # <= drop_tol × the table's own mass: a relative mass
+            # truncation of at most drop_tol, i.e. a log-value error
+            # bounded by -log(1 - drop_tol)
+            thr = vmax + np.log(drop_tol / max(a.size, 1))
+            dropped = keep & (flat <= thr)
+            if dropped.any():
+                keep = keep & ~dropped
+                trunc = -np.log1p(-min(drop_tol, 0.5))
+    nnz = int(keep.sum())
+    if nnz > max_density * a.size:
+        return None
+    idx = np.flatnonzero(keep).astype(np.int64)
+    return SparseTable(a.shape, idx, flat[idx], fill, trunc)
+
+
+# -- candidate-list node prep -------------------------------------------
+
+
+class SparsePrep:
+    """Host-side candidate-list join of one contraction node: the
+    kernel ABI buffers plus the static bucket geometry."""
+
+    __slots__ = (
+        "sep_ids", "own_ids", "gidx", "part_flats", "n_cand",
+        "n_seg", "d_own", "n_cand_b", "n_seg_b", "part_lens_b",
+        "trunc",
+    )
+
+    def __init__(
+        self, sep_ids, own_ids, gidx, part_flats, n_seg, d_own,
+        trunc,
+    ):
+        self.sep_ids = sep_ids
+        self.own_ids = own_ids
+        self.gidx = gidx  # one i64[n_cand] per part
+        self.part_flats = part_flats  # one f64[len_p] per part
+        self.n_cand = int(sep_ids.size)
+        self.n_seg = int(n_seg)
+        self.d_own = int(d_own)
+        self.n_cand_b = nnz_bucket(self.n_cand)
+        self.n_seg_b = nnz_bucket(self.n_seg)
+        self.part_lens_b = tuple(
+            nnz_bucket(f.size) for f in part_flats
+        )
+        self.trunc = float(trunc)
+
+    @property
+    def key(self) -> tuple:
+        """The static geometry that joins the level-pack bucket key:
+        two nodes with equal keys ride one vmapped dispatch."""
+        return (self.n_cand_b, self.n_seg_b, self.part_lens_b)
+
+    @property
+    def table_bytes(self) -> int:
+        """Real per-row device allocation: candidate index buffers
+        plus the packed part values (the number ``max_util_bytes``
+        and the supervisor's capacity model size against)."""
+        return self.n_cand_b * (
+            8 + 4 * len(self.part_flats)
+        ) + 8 * sum(self.part_lens_b)
+
+
+def sparse_node_prep(
+    parts: Sequence[Tuple[List[str], Any]],
+    target: Sequence[str],
+    shape: Sequence[int],
+    identity: float,
+) -> Optional[SparsePrep]:
+    """Build the candidate-list join for one node, or None when no
+    part is sparse or the intersection would not pay (the caller
+    falls back to the dense kernels — ``semiring.sparse_fallbacks``).
+
+    Candidates are the intersection of the sparse parts' supports
+    lifted to the target grid: a joined tuple can be non-identity
+    only where EVERY sparse part is feasible, so the list covers
+    exactly the potentially-finite cells and absent cells are the
+    ⊕-identity — the exactness argument of the module docstring.
+    Each candidate carries ``(sep_id, own_id)`` plus one gather
+    index per part (dense parts index their own flat box; sparse
+    parts index their packed values), computed here in vectorized
+    numpy so the kernel is pure gather + segment-reduce."""
+    shape = tuple(int(s) for s in shape)
+    target = list(target)
+    nd = len(target)
+    size = 1
+    for s in shape:
+        size *= s
+    sparse_parts = [
+        (i, dims, t)
+        for i, (dims, t) in enumerate(parts)
+        if isinstance(t, SparseTable)
+    ]
+    if not sparse_parts:
+        return None
+
+    # seed: the sparse part whose lifted support is smallest — its
+    # nnz times the free extent of the target dims it does not cover
+    def lifted(entry):
+        _, dims, t = entry
+        free = 1
+        for d, s in zip(target, shape):
+            if d not in dims:
+                free *= s
+        return t.nnz * free
+
+    seed_i, seed_dims, seed_t = min(sparse_parts, key=lifted)
+    est = lifted((seed_i, seed_dims, seed_t))
+    if est > SPARSE_MAX_CAND_FRAC * size or est > SPARSE_MAX_CAND:
+        return None
+
+    # per-target-dim candidate coordinates, built from the seed's
+    # unraveled support crossed with the uncovered dims
+    coords: Dict[str, np.ndarray] = {}
+    seed_coords = np.unravel_index(
+        seed_t.flat, seed_t.shape
+    )
+    for d, c in zip(seed_dims, seed_coords):
+        coords[d] = c.astype(np.int64)
+    n = seed_t.nnz
+    for d, s in zip(target, shape):
+        if d in coords:
+            continue
+        for k in coords:
+            coords[k] = np.repeat(coords[k], s)
+        coords[d] = np.tile(np.arange(s, dtype=np.int64), n)
+        n *= s
+
+    # filter through every other sparse part's support
+    for i, dims, t in sparse_parts:
+        if i == seed_i:
+            continue
+        pflat = np.zeros(n, dtype=np.int64)
+        stride = 1
+        for ax in range(len(dims) - 1, -1, -1):
+            pflat += coords[dims[ax]] * stride
+            stride *= t.shape[ax]
+        hit = t.contains(pflat)
+        if not hit.all():
+            for k in coords:
+                coords[k] = coords[k][hit]
+            n = int(hit.sum())
+    if n == 0:
+        # a fully-infeasible node: one sentinel candidate at the
+        # identity keeps the kernel ABI non-degenerate; the segment
+        # reduce still reports every cell at the ⊕-identity
+        for k in coords:
+            coords[k] = np.zeros(1, dtype=np.int64)
+        n = 1
+
+    # sort by target flat id so per-segment candidate runs are
+    # contiguous (indices_are_sorted on device, binary-search host
+    # repair) — flat ids are unique by construction
+    tflat = np.zeros(n, dtype=np.int64)
+    stride = 1
+    for ax in range(nd - 1, -1, -1):
+        tflat += coords[target[ax]] * stride
+        stride *= shape[ax]
+    order = np.argsort(tflat, kind="stable")
+    for k in coords:
+        coords[k] = coords[k][order]
+
+    d_own = shape[-1]
+    own_ids = coords[target[-1]].astype(np.int64)
+    sep_ids = (tflat[order] // d_own).astype(np.int64)
+    n_seg = size // max(d_own, 1)
+
+    gidx: List[np.ndarray] = []
+    part_flats: List[np.ndarray] = []
+    trunc = 0.0
+    for i, (dims, t) in enumerate(parts):
+        pflat = np.zeros(n, dtype=np.int64)
+        stride = 1
+        pshape = (
+            t.shape
+            if isinstance(t, SparseTable)
+            else np.asarray(t).shape
+        )
+        for ax in range(len(dims) - 1, -1, -1):
+            pflat += coords[dims[ax]] * stride
+            stride *= pshape[ax]
+        if isinstance(t, SparseTable):
+            # every candidate hits by construction (the intersection
+            # above filtered through this part's support) — except a
+            # degenerate all-infeasible node's sentinel, which the
+            # clamp below maps to SOME packed value; its join value
+            # is irrelevant (every output cell is the identity)
+            pos = np.minimum(
+                t.positions(pflat), max(t.nnz - 1, 0)
+            )
+            gidx.append(pos)
+            part_flats.append(t.vals)
+            trunc += t.trunc
+        else:
+            gidx.append(pflat)
+            part_flats.append(
+                np.asarray(t, dtype=np.float64).reshape(-1)
+            )
+    return SparsePrep(
+        sep_ids, own_ids, tuple(gidx), tuple(part_flats),
+        n_seg, d_own, trunc,
+    )
+
+
+# -- the gather/segment-reduce kernels ----------------------------------
+
+_SPARSE_KERNELS: Dict[Tuple, Any] = {}
+_SPARSE_KERNELS_MAX = 128
+
+
+def sparse_contraction_kernel(
+    sr,
+    n_cand_b: int,
+    n_seg_b: int,
+    part_lens_b: Tuple[int, ...],
+    bnb: bool = False,
+    table_dtype: str = "f32",
+):
+    """Jit-compiled sparse contraction for one candidate bucket:
+    per-part value gathers summed into the f32 accumulator, then a
+    segment-⊕ over the (sorted) separator ids — always batched over
+    a leading stack axis, mirroring the level-pack dispatches.
+
+    ABI per row (after the optional bnb ``budget`` f32 scalar and
+    int8 ``scales``/``offsets`` f32[P] dequant params):
+    ``sep_ids i32[n_cand_b]`` (ghost candidates carry ``n_seg_b``,
+    an extra segment sliced off), ``own_ids i32[n_cand_b]``, then
+    per part ``vals dtype[len_p]`` + ``gidx i32[n_cand_b]``.
+
+    Outputs match :func:`~pydcop_tpu.ops.semiring.
+    contraction_kernel` exactly — idempotent ⊕ returns ``(arg,
+    margins[, keep])`` (values re-evaluated on host at the certified
+    arg), mass ⊕ returns ``(vals[, keep, discard])`` — so the same
+    ``_finish_device_row`` certification/repair glue consumes both
+    formats.  Ties break like the dense kernels: the LOWEST own
+    index among the minima (candidates are unique per (sep, own)
+    cell), and a cell with no candidate reports the ⊕-identity with
+    the same ``arg=0`` / NaN-margin signature an all-identity dense
+    row produces — bit-parity by construction.
+    """
+    from pydcop_tpu.ops.semiring import get_semiring
+
+    sr = get_semiring(sr)
+    table_dtype = as_table_dtype(table_dtype)
+    if sr.kind in ("kbest", "expectation"):
+        raise ValueError(
+            f"sparse contraction supports scalar ⊕ only, not "
+            f"{sr.name!r} (structured cells keep the dense kernels)"
+        )
+    key = (
+        sr.name, int(n_cand_b), int(n_seg_b), tuple(part_lens_b),
+        bool(bnb), table_dtype,
+    )
+    fn = _SPARSE_KERNELS.get(key)
+    if fn is not None:
+        return fn
+    if len(_SPARSE_KERNELS) >= _SPARSE_KERNELS_MAX:
+        _SPARSE_KERNELS.pop(next(iter(_SPARSE_KERNELS)))
+    import jax
+    import jax.numpy as jnp
+
+    from pydcop_tpu.ops.padding import INT8_NEG_INF, INT8_POS_INF
+
+    P = len(part_lens_b)
+    S1 = int(n_seg_b) + 1  # + the ghost segment of padded candidates
+    idem = bool(sr.idempotent)
+    lo = idem and not sr.maximize
+    ident = np.float32(sr.plus_identity)
+    SENT = jnp.int32(1 << 30)
+
+    def _seg_red(v, sep, maximize):
+        f = jax.ops.segment_max if maximize else jax.ops.segment_min
+        return f(
+            v, sep, num_segments=S1, indices_are_sorted=True
+        )
+
+    def _join(sep, tabs, gidxs):
+        v = jnp.zeros((int(n_cand_b),), dtype=jnp.float32)
+        for t, g in zip(tabs, gidxs):
+            v = v + jnp.take(
+                t.astype(jnp.float32), g, axis=0,
+                mode="clip",
+            )
+        return v
+
+    def _mass_u(v, sep):
+        m = _seg_red(v, sep, True)
+        safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        e = jnp.where(
+            jnp.isfinite(v), jnp.exp(v - safe[sep]), 0.0
+        )
+        # +inf log-weights (hard -inf energies) must stay absorbing,
+        # exactly like the dense kernel's isfinite(m) guard
+        e = jnp.where(jnp.isposinf(v), jnp.inf, e)
+        s = jax.ops.segment_sum(
+            e, sep, num_segments=S1, indices_are_sorted=True
+        )
+        return jnp.where(
+            jnp.isfinite(m), safe + jnp.log(s), m
+        )
+
+    def _discard(rowb, keep):
+        pr = jnp.where(keep, -jnp.inf, rowb)
+        m = jnp.max(pr)
+        safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        s = jnp.sum(
+            jnp.where(jnp.isfinite(pr), jnp.exp(pr - safe), 0.0)
+        )
+        return jnp.where(
+            (s > 0) & jnp.isfinite(m), safe + jnp.log(s), -jnp.inf
+        )
+
+    if idem:
+
+        def _idem_core(sep, own, *tg):
+            tabs, gidxs = tg[:P], tg[P:]
+            v = _join(sep, tabs, gidxs)
+            u = _seg_red(v, sep, sr.maximize)
+            best = v == u[sep]
+            ownm = jnp.where(best, own, SENT)
+            arg_s = jax.ops.segment_min(
+                ownm, sep, num_segments=S1,
+                indices_are_sorted=True,
+            )
+            arg = jnp.where(arg_s >= SENT, 0, arg_s)
+            # margins against the NEXT cell: mask the one candidate
+            # at (sep, arg) — absent cells are the identity, so an
+            # empty remainder reports the identity, exactly like the
+            # dense one-hot mask over a mostly-identity row
+            excl = own == arg_s[sep]
+            v2 = jnp.where(excl, ident, v)
+            second = _seg_red(v2, sep, sr.maximize)
+            margins = (
+                second - u if lo else u - second
+            )
+            return arg, margins, u
+
+        if bnb:
+
+            def contract(budget, sep, own, *tg):
+                arg, margins, u = _idem_core(sep, own, *tg)
+                # the segment reduce IS the row's ⊕-extremum — the
+                # pass-1 bound is free.  Negated comparisons keep
+                # NaN bounds (cancelling ±inf parts) conservative.
+                keep = (
+                    jnp.logical_not(u > budget)
+                    if lo
+                    else jnp.logical_not(u < budget)
+                )
+                return arg, jnp.where(keep, margins, jnp.inf), keep
+
+        else:
+
+            def contract(sep, own, *tg):
+                arg, margins, _ = _idem_core(sep, own, *tg)
+                return arg, margins
+
+    elif bnb:
+
+        def contract(budget, sep, own, *tg):
+            tabs, gidxs = tg[:P], tg[P:]
+            v = _join(sep, tabs, gidxs)
+            u = _mass_u(v, sep)
+            keep = jnp.logical_not(u < budget)
+            # the ghost segment must not leak into the measured
+            # discard: its identity (-inf) never clears any budget,
+            # so slice it off the discard entirely
+            return (
+                jnp.where(keep, u, -jnp.inf),
+                keep,
+                _discard(u[:-1], keep[:-1]),
+            )
+
+    else:
+
+        def contract(sep, own, *tg):
+            tabs, gidxs = tg[:P], tg[P:]
+            v = _join(sep, tabs, gidxs)
+            return (_mass_u(v, sep),)
+
+    if table_dtype == "int8":
+        inner = contract
+
+        def contract(*args):  # noqa: F811 — int8 dequant wrap
+            if bnb:
+                budget, scales, offsets, sep, own, *tg = args
+            else:
+                scales, offsets, sep, own, *tg = args
+            qtabs, gidxs = tg[:P], tg[P:]
+            tabs = []
+            for i, q in enumerate(qtabs):
+                f = q.astype(jnp.float32) * scales[i] + offsets[i]
+                f = jnp.where(q == INT8_POS_INF, jnp.inf, f)
+                f = jnp.where(q == INT8_NEG_INF, -jnp.inf, f)
+                tabs.append(f)
+            rest = tuple(tabs) + tuple(gidxs)
+            return (
+                inner(budget, sep, own, *rest)
+                if bnb
+                else inner(sep, own, *rest)
+            )
+
+    from pydcop_tpu.telemetry.jit import profiled_jit
+
+    fn = profiled_jit(
+        jax.vmap(contract),
+        label=f"sparse-{sr.name}"
+        + ("-bnb" if bnb else "")
+        + ("" if table_dtype == "f32" else f"-{table_dtype}"),
+    )
+    _SPARSE_KERNELS[key] = fn
+    return fn
+
+
+def np_table_format_dtype(table_dtype: str):
+    """Numpy storage dtype for packed part values — mirrors
+    ``ops/semiring.py:_np_table_dtype`` without importing it (the
+    dispatch glue needs both modules; keep the import edge one-way:
+    semiring → sparse)."""
+    table_dtype = as_table_dtype(table_dtype)
+    if table_dtype == "bf16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    if table_dtype == "int8":
+        return np.dtype(np.int8)
+    return np.dtype(np.float32)

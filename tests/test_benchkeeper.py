@@ -253,7 +253,7 @@ class TestLedgerRoundTrip:
     def test_every_round_gets_a_status_row(self, seeded):
         status = [r for r in seeded if ledger.row_key(r) == ("bench_round", "rc")]
         assert sorted(r["round"] for r in status) == [
-            f"r{i:02d}" for i in range(1, 12)
+            f"r{i:02d}" for i in range(1, 13)
         ]
         by_round = {r["round"]: r for r in status}
         # r01 crashed (rc=1), r05 timed out (rc=0, nothing parsed) —
@@ -261,7 +261,7 @@ class TestLedgerRoundTrip:
         assert by_round["r01"]["value"] == 1.0
         assert by_round["r01"]["extra"]["parsed"] is False
         assert by_round["r05"]["extra"]["parsed"] is False
-        assert by_round["r11"]["extra"]["parsed"] is True
+        assert by_round["r12"]["extra"]["parsed"] is True
 
     def test_rows_round_trip_through_the_file(self, seeded, tmp_path):
         path = str(tmp_path / "ledger.jsonl")
@@ -416,11 +416,11 @@ class TestHistory:
         rows = ledger.seed_rows(_REPO)
         now = ledger.parse_ts("2026-08-05T12:00:00Z")
         report = history.history_report(rows, now_epoch=now)
-        for i in range(1, 12):
+        for i in range(1, 13):
             assert f"r{i:02d}" in report
         assert "r01 FAIL" in report
         assert "r05 empty" in report
-        assert "r11 ok" in report
+        assert "r12 ok" in report
         # the TPU captures predate r09 by days: stale at the 72h bound
         assert "STALE" in report and "tpu:" in report
 
@@ -499,7 +499,7 @@ class TestCLIs:
         ))
         doc = json.loads(capsys.readouterr().out)
         assert rc == 0
-        assert len(doc["rounds"]) == 11
+        assert len(doc["rounds"]) == 12
         assert any(f["backend"] == "tpu" and f["stale"]
                    for f in doc["freshness"])
 

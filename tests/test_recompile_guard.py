@@ -260,6 +260,26 @@ def test_precision_guard_bf16_reuses_buckets():
     assert report["device_nodes"] >= 1, report
 
 
+@pytest.mark.semiring
+def test_sparse_guard_format_keys_stable():
+    """Sparse constraint tables (ISSUE 20): a dense -> sparse format
+    swap on the same K hard-capped overlap-SECP instances — map via
+    infer_many AND dpop via solve_many — actually packs (the counters
+    are non-vacuous), repeats with ZERO new compiles and zero new
+    sparse kernel-cache entries, and stays bit-identical across
+    formats.  See tools/recompile_guard.py:run_sparse_guard."""
+    guard = _load_guard()
+    report = guard.run_sparse_guard()
+    assert report["ok"], report
+    assert report["dense_compiles"] >= 1, report  # guard actually ran
+    assert report["sparse_packs"] >= 1, report
+    assert report["sparse_nodes"] >= 1, report
+    assert report["sparse_kernel_entries"] >= 1, report
+    assert report["repeat_compiles"] == 0, report
+    assert report["new_entries_on_repeat"] == 0, report
+    assert report["device_nodes"] >= 1, report
+
+
 @pytest.mark.membound
 def test_membound_guard_budgeted_solve_reuses_buckets():
     """Memory-bounded solves (ops/membound.py): the first budgeted

@@ -1,0 +1,353 @@
+"""Sparse constraint tables (ISSUE 20, ``ops/sparse.py`` +
+``ops/semiring.py`` + ``ops/membound.py``, ``docs/performance.md``
+'Sparse constraint tables'): the ``table_format`` axis must keep the
+idempotent queries BIT-IDENTICAL to the dense path (same argmin
+certificate, same host f64 repair), keep the mass queries inside
+their reported error bounds (pack truncation folds into the ledger),
+compose with ``table_dtype`` and bnb, shrink the memory-bounded
+planner's per-node size estimate, and join the service's dispatch
+partition key.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+from tests.test_semiring import _hard_band_dcop, _random_dcop
+
+pytestmark = pytest.mark.semiring
+
+
+def _counters(rep):
+    return rep.summary()["counters"]
+
+
+def _infer(dcop, q, fmt, **kw):
+    from pydcop_tpu.ops.semiring import run_infer_many
+    from pydcop_tpu.telemetry import session
+
+    with session() as rep:
+        out = run_infer_many(
+            [dcop], q, device="always", table_format=fmt, **kw
+        )[0]
+    return out, _counters(rep)
+
+
+# -- packing unit behavior ----------------------------------------------
+
+
+def test_pack_table_roundtrip_and_gather():
+    from pydcop_tpu.ops.sparse import pack_table
+
+    rnd = np.random.default_rng(3)
+    a = np.full((8, 8, 8), np.inf)
+    finite = rnd.random((8, 8, 8)) < 0.1
+    a[finite] = rnd.normal(size=int(finite.sum()))
+    sp = pack_table(a, np.inf, min_cells=64)
+    assert sp is not None
+    assert sp.nnz == int(finite.sum())
+    assert sp.density <= 0.5
+    assert np.array_equal(np.asarray(sp), a)
+    # packed bytes beat the dense box at this sparsity
+    assert sp.nbytes < a.size * 4
+    # gather hits return values, misses return the fill
+    ii, jj, kk = np.nonzero(finite)
+    got = sp.gather((ii, jj, kk))
+    assert np.array_equal(got, a[ii, jj, kk])
+    miss = sp.gather(
+        (np.zeros(4, int), np.zeros(4, int), np.zeros(4, int))
+    )
+    if not finite[0, 0, 0]:
+        assert np.all(np.isposinf(miss))
+
+
+def test_pack_table_declines_dense_or_small():
+    from pydcop_tpu.ops.sparse import pack_table
+
+    # too dense: half the cells finite clears max_density only at
+    # exactly 0.5 — 60% finite must decline
+    a = np.where(
+        np.random.default_rng(0).random((8, 8, 8)) < 0.6, 1.0, np.inf
+    )
+    assert pack_table(a, np.inf, min_cells=64) is None
+    # too small: under min_cells the pack overhead cannot pay
+    tiny = np.full((4, 4), np.inf)
+    tiny[0, 0] = 1.0
+    assert pack_table(tiny, np.inf) is None
+
+
+def test_table_format_vocabulary_suggests_on_typo():
+    from pydcop_tpu.ops.sparse import as_table_format
+
+    assert as_table_format(None) == "dense"
+    assert as_table_format("coo") == "sparse"
+    assert as_table_format("full") == "dense"
+    with pytest.raises(ValueError, match="sparse"):
+        as_table_format("sprase")
+
+
+# -- bit parity: idempotent queries -------------------------------------
+
+
+@pytest.mark.parametrize("ties", [False, True])
+@pytest.mark.parametrize("bnb", ["auto", "on"])
+@pytest.mark.parametrize("seed", [3, 7])
+def test_map_bit_parity(seed, bnb, ties):
+    """min-sum MAP: assignment AND cost bit-identical to dense on
+    tie-heavy and ±inf (hard-cap) tables, with bnb pruning on."""
+    dcop = _hard_band_dcop(10, seed, cap=0.9, ties=ties)
+    rd, _ = _infer(dcop, "map", "dense", bnb=bnb)
+    rs, cs = _infer(dcop, "map", "sparse", bnb=bnb)
+    assert rs["assignment"] == rd["assignment"]
+    assert rs["cost"] == rd["cost"]
+    assert cs.get("semiring.sparse_nodes", 0) > 0, cs
+
+
+@pytest.mark.parametrize("dtype", ["bf16", "int8"])
+def test_map_bit_parity_low_precision(dtype):
+    """format × dtype composition: packed values quantize like dense
+    packs and the certificate ladder still repairs exactly."""
+    dcop = _hard_band_dcop(10, 3, cap=0.9)
+    rd, _ = _infer(dcop, "map", "dense", table_dtype=dtype)
+    rs, cs = _infer(dcop, "map", "sparse", table_dtype=dtype)
+    assert rs["assignment"] == rd["assignment"]
+    assert rs["cost"] == rd["cost"]
+    assert cs.get("semiring.sparse_nodes", 0) > 0, cs
+
+
+def test_max_objective_map_parity():
+    """max-sum (fill = -inf on the flipped axis): same contract."""
+    dcop = _random_dcop(8, 5, objective="max")
+    rd, _ = _infer(dcop, "map", "dense")
+    rs, _ = _infer(dcop, "map", "sparse")
+    assert rs["assignment"] == rd["assignment"]
+    assert rs["cost"] == rd["cost"]
+
+
+def test_kbest_passthrough_parity():
+    """kbest keeps the dense kernels (structured cells never pack):
+    sparse must pass through bit-identically, counting a fallback
+    instead of corrupting the top-K merge."""
+    dcop = _hard_band_dcop(8, 5, cap=0.9)
+    rd, _ = _infer(dcop, "kbest:4", "dense")
+    rs, cs = _infer(dcop, "kbest:4", "sparse")
+    assert rs["costs"] == rd["costs"]
+    assert [s["assignment"] for s in rs["solutions"]] == [
+        s["assignment"] for s in rd["solutions"]
+    ]
+    assert cs.get("semiring.sparse_nodes", 0) == 0
+
+
+# -- mass queries: bounded, monotone ------------------------------------
+
+
+def test_log_z_within_reported_bound():
+    """Device sparse log_z vs exact host f64: the difference must sit
+    inside the reported error_bound (pack truncation included)."""
+    dcop = _hard_band_dcop(10, 3, cap=0.9)
+    rh, _ = _infer(dcop, "log_z", "dense", tol=1e-3)
+    rs, cs = _infer(dcop, "log_z", "sparse", tol=1e-3)
+    assert abs(rs["log_z"] - rh["log_z"]) <= (
+        rs["error_bound"] + rh["error_bound"] + 1e-12
+    )
+    assert cs.get("semiring.sparse_nodes", 0) > 0, cs
+
+
+def test_marginals_parity_within_bound():
+    dcop = _hard_band_dcop(10, 3, cap=0.9)
+    rd, _ = _infer(dcop, "marginals", "dense", tol=1e-3)
+    rs, _ = _infer(dcop, "marginals", "sparse", tol=1e-3)
+    for v, md in rd["marginals"].items():
+        for a, b in zip(md, rs["marginals"][v]):
+            assert abs(a - b) <= 1e-3
+
+
+def test_drop_tol_trunc_is_monotone_and_sound():
+    """pack_table's lossy mass packing: the dropped mass is bounded
+    by the reported trunc (nats), trunc grows monotonically in
+    drop_tol, and drop_tol=0 packs exactly."""
+    from pydcop_tpu.ops.sparse import pack_table
+
+    rnd = np.random.default_rng(7)
+    a = np.full(4096, -np.inf)
+    hot = rnd.random(4096) < 0.2
+    a[hot] = rnd.normal(size=int(hot.sum())) * 6.0
+
+    def lse(x):
+        f = x[np.isfinite(x)]
+        m = f.max()
+        return m + np.log(np.exp(f - m).sum())
+
+    exact = lse(a)
+    prev_trunc = -1.0
+    for tol in (0.0, 1e-9, 1e-6, 1e-3, 1e-1):
+        sp = pack_table(
+            a, -np.inf, min_cells=64, max_density=0.5, drop_tol=tol
+        )
+        assert sp is not None
+        assert sp.trunc >= prev_trunc  # monotone in drop_tol
+        prev_trunc = sp.trunc
+        packed = lse(sp.vals)
+        # the lost mass is bounded by trunc; packing never ADDS mass
+        assert packed <= exact + 1e-12
+        assert exact - packed <= sp.trunc + 1e-12
+        if tol == 0.0:
+            assert sp.trunc == 0.0
+            assert packed == exact
+
+
+# -- memory-bounded planner ---------------------------------------------
+
+
+@pytest.mark.membound
+def test_membound_same_budget_smaller_cut_sparse():
+    """The planner sizes hard-capped nodes at their packed estimate:
+    the same byte budget needs a no-wider (usually narrower) cut at
+    table_format=sparse, and the budgeted result stays bit-identical
+    to the unbounded dense solve."""
+    from pydcop_tpu.algorithms.dpop import solve_host
+
+    dcop = _hard_band_dcop(12, 3, d=5, arity=5, stride=2, cap=0.9)
+    ref = solve_host(dcop, {"util_device": "always"})
+    budget = 4096
+    rd = solve_host(
+        dcop, {"util_device": "always", "max_util_bytes": budget}
+    )
+    rs = solve_host(
+        dcop,
+        {
+            "util_device": "always",
+            "max_util_bytes": budget,
+            "table_format": "sparse",
+        },
+    )
+    assert rs["membound"]["cut_width"] <= rd["membound"]["cut_width"]
+    assert rs["membound"]["table_format"] == "sparse"
+    assert rs["assignment"] == ref["assignment"]
+    assert rs["cost"] == ref["cost"]
+
+
+@pytest.mark.membound
+def test_membound_charges_packed_bytes():
+    """The membound meta must report a sparse peak no larger than the
+    dense peak on a hard-cap workload (the packed estimate)."""
+    from pydcop_tpu.algorithms.dpop import solve_host
+
+    dcop = _hard_band_dcop(12, 3, d=5, arity=5, stride=2, cap=0.9)
+    kw = {"util_device": "always", "max_util_bytes": 1 << 20}
+    rd = solve_host(dcop, kw)
+    rs = solve_host(dcop, {**kw, "table_format": "sparse"})
+    assert (
+        rs["membound"]["peak_table_bytes"]
+        <= rd["membound"]["peak_table_bytes"]
+    )
+
+
+# -- memoized sessions ---------------------------------------------------
+
+
+def test_infer_session_sparse_warm_path():
+    """A sparse InferSession stays bit-identical across the memoized
+    warm path, and prewarm compiles the sparse-ABI kernels without
+    error (the zero-XLA-compile-on-warm-delta guarantee)."""
+    from pydcop_tpu.engine.memo import InferSession
+
+    dcop = _hard_band_dcop(8, 7, cap=0.9)
+    s = InferSession(dcop, "map", device="always",
+                     table_format="sparse")
+    cold = s.solve()
+    warm = s.solve()
+    assert warm["assignment"] == cold["assignment"]
+    assert warm["cost"] == cold["cost"]
+    assert warm["memo"]["hits"] > 0
+
+
+# -- gating: engines without a sparse path ------------------------------
+
+
+def test_iterative_engines_reject_sparse():
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.ops.compile import compile_dcop
+
+    dcop = _random_dcop(6, 3)
+    with pytest.raises(ValueError, match="table_format"):
+        solve(dcop, "dsa", {}, rounds=2, table_format="sparse")
+    with pytest.raises(ValueError, match="sparse"):
+        compile_dcop(dcop, table_format="sparse")
+
+
+# -- service: format joins the partition key and rides the wire ---------
+
+
+@pytest.mark.service
+def test_service_format_joins_infer_partition_key():
+    """Two same-query infers differing ONLY in table_format land in
+    one tick but dispatch as TWO partitions — the format is part of
+    ``_infer_group_key``, so sparse traffic never merges into a
+    dense sweep (or vice versa)."""
+    from pydcop_tpu.engine.service import SolverService
+
+    dcop = _hard_band_dcop(8, 1, cap=0.9)
+    with SolverService(
+        max_batch=2, max_wait=10.0, autostart=False
+    ) as svc:
+        pd = svc.submit_infer(dcop, "map", device="never")
+        ps = svc.submit_infer(
+            dcop, "map", device="never", table_format="sparse"
+        )
+        rd, rs = pd.result(timeout=300), ps.result(timeout=300)
+        stats = svc.stats()
+    assert rd["cost"] == rs["cost"]
+    assert rd["assignment"] == rs["assignment"]
+    assert stats["ticks"] == 1, stats
+    assert stats["dispatches"] == 2, stats
+
+
+@pytest.mark.service
+def test_service_wire_round_trip_carries_table_format():
+    """table_format rides the wire protocol end to end: an infer
+    frame and a solve frame both carry it, results match the
+    in-process calls bit-for-bit, and a bad spelling fails THIS call
+    with the nearest-name suggestion without killing the
+    connection."""
+    from pydcop_tpu.api import infer
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.engine.service import (
+        ServiceClient,
+        ServiceError,
+        ServiceServer,
+        SolverService,
+    )
+
+    dcop = _hard_band_dcop(8, 1, cap=0.9)
+    yaml_text = dcop_yaml(dcop)
+    ref = infer(dcop, "map", device="never", table_format="sparse")
+    with SolverService(max_wait=0.05) as svc:
+        with ServiceServer(svc, port=0) as server:
+            with ServiceClient(server.address) as cli:
+                out = cli.infer(
+                    yaml_text, "map", device="never",
+                    table_format="sparse",
+                )
+                assert out["cost"] == ref["cost"]
+                assert out["assignment"] == ref["assignment"]
+                s = cli.solve(
+                    yaml_text, "dpop", {"util_device": "never"},
+                    table_format="sparse",
+                )
+                assert s["assignment"] == ref["assignment"]
+                with pytest.raises(
+                    (ServiceError, ValueError), match="sparse"
+                ):
+                    cli.infer(
+                        yaml_text, "map", table_format="sprase"
+                    )
+                assert cli.ping()  # connection survived the error
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

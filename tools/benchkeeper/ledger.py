@@ -317,6 +317,12 @@ _METRIC_SPECS: Tuple[Tuple[str, str, str, bool, Tuple[str, ...]], ...] = (
      ("bnb", "util_cells_per_sec_on")),
     ("bnb", "pruned_fraction", "fraction", True,
      ("bnb", "pruned_fraction")),
+    ("sparse", "speedup_sparse_vs_dense_bnb", "ratio", True,
+     ("sparse", "speedup_sparse_vs_dense_bnb")),
+    ("sparse", "util_cells_per_sec_sparse", "cells/s", True,
+     ("sparse", "util_cells_per_sec_sparse")),
+    ("sparse", "table_sparsity", "fraction", True,
+     ("sparse", "table_sparsity")),
     ("incremental", "speedup_delta_vs_full", "ratio", True,
      ("incremental", "speedup_delta_vs_full")),
     ("incremental", "delta_solve_s", "s", False,

@@ -132,6 +132,7 @@ def default_config(root: str) -> LintConfig:
             "pydcop_tpu/ops/padding.py",
             "pydcop_tpu/ops/membound.py",
             "pydcop_tpu/ops/semiring.py",
+            "pydcop_tpu/ops/sparse.py",
             "pydcop_tpu/telemetry/*.py",
             # the bench trajectory tooling must import (and analyze
             # recorded ledgers) on boxes with no working accelerator

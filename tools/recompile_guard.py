@@ -208,6 +208,21 @@ DELTA_MAX_FRACTION = 0.05
 # a correctness bug, not noise.
 PRECISION_K = 4
 
+# sparse constraint tables (ISSUE 20, ops/sparse.py + ops/semiring.py):
+# the table FORMAT joins both the kernel-cache key and the level-pack
+# bucket key — sparse nodes batch into their own pow-2 candidate
+# buckets and never mix executables with the dense ones.  Over K
+# hard-capped overlap-SECP instances (>= 90% +inf window tables, the
+# workload that actually packs), the guard pins: (1) the sparse pass
+# packs and dispatches the gather/segment-reduce kernels (counters
+# non-vacuous), (2) repeating EITHER format — map via infer_many AND
+# dpop via solve_many — performs ZERO new compiles AND creates zero
+# new sparse kernel-cache entries (the (semiring, candidate-bucket,
+# dtype, format) key is stable), and (3) map/dpop cost AND assignment
+# are bit-identical across formats (absent tuples are the ⊕-identity;
+# the certificate ladder is unchanged).
+SPARSE_K = 3
+
 
 def _build_dcop():
     from pydcop_tpu.dcop.dcop import DCOP
@@ -1776,6 +1791,132 @@ def run_precision_guard() -> dict:
     return report
 
 
+def run_sparse_guard() -> dict:
+    """Compile budget for sparse constraint tables (the SPARSE_K
+    constant block above): over K hard-capped overlap-SECP instances
+    with the device forced on, a warm dense -> sparse format swap on
+    the SAME instances — map through ``infer_many`` AND dpop through
+    ``solve_many`` — must (1) actually pack (``semiring.sparse_packs``
+    / ``sparse_nodes`` >= 1 — otherwise the guard is vacuous), (2)
+    perform ZERO new compiles and mint ZERO new sparse kernel-cache
+    entries when either format repeats, and (3) return map/dpop cost
+    AND assignment bit-identical across formats (absent tuples are
+    the ⊕-identity, so the idempotent ⊕s reduce over the same finite
+    set)."""
+    from pydcop_tpu.api import infer_many, solve_many
+    from pydcop_tpu.ops import semiring as sr_mod
+    from pydcop_tpu.ops import sparse as sp_mod
+    from pydcop_tpu.telemetry import session
+
+    # cold start for both kernel caches (the dense contraction cache
+    # is shared with DPOP's join cache — one object)
+    sr_mod._KERNELS.clear()
+    sp_mod._SPARSE_KERNELS.clear()
+
+    dcops = [
+        _build_secp_overlap(
+            12, 8, 4, seed=150 + i, arity=5, hard_cap=1.02
+        )
+        for i in range(SPARSE_K)
+    ]
+    ikw = dict(device="always", pad_policy="pow2")
+    params = {"util_device": "always"}
+
+    def compiles(tel):
+        return int(tel.summary()["counters"].get("jit.compiles", 0))
+
+    with session() as t1:
+        mapsd = infer_many(dcops, "map", **ikw)
+        solvesd = solve_many(dcops, "dpop", params, pad_policy="pow2")
+    with session() as t2:
+        mapss = infer_many(
+            dcops, "map", table_format="sparse", **ikw
+        )
+        solvess = solve_many(
+            dcops, "dpop", {**params, "table_format": "sparse"},
+            pad_policy="pow2",
+        )
+    sparse_entries = len(sp_mod._SPARSE_KERNELS)
+    with session() as t3:
+        infer_many(dcops, "map", **ikw)
+        infer_many(dcops, "map", table_format="sparse", **ikw)
+        solve_many(dcops, "dpop", params, pad_policy="pow2")
+        solve_many(
+            dcops, "dpop", {**params, "table_format": "sparse"},
+            pad_policy="pow2",
+        )
+    c2 = t2.summary()["counters"]
+    report = {
+        "dense_compiles": compiles(t1),
+        "sparse_compiles": compiles(t2),
+        "repeat_compiles": compiles(t3),
+        "sparse_packs": int(c2.get("semiring.sparse_packs", 0)),
+        "sparse_nodes": int(c2.get("semiring.sparse_nodes", 0)),
+        "sparse_kernel_entries": sparse_entries,
+        "new_entries_on_repeat": (
+            len(sp_mod._SPARSE_KERNELS) - sparse_entries
+        ),
+        "ok": True,
+        "costs": [r["cost"] for r in mapsd],
+        "device_nodes": sum(r["device_nodes"] for r in mapsd),
+    }
+    if report["dense_compiles"] < 1 or report["device_nodes"] < 1:
+        report["ok"] = False
+        report["error"] = (
+            "the dense pass never reached the device — the guard is "
+            "vacuous (device='always' stopped forcing the path)"
+        )
+    elif report["sparse_nodes"] < 1 or report["sparse_packs"] < 1:
+        report["ok"] = False
+        report["error"] = (
+            "the sparse pass packed nothing on a >=90%-infeasible "
+            "hard-capped workload — pack_table's gate regressed or "
+            "table_format stopped reaching contract_sweep; the "
+            "format guard is vacuous"
+        )
+    elif report["repeat_compiles"] != 0:
+        report["ok"] = False
+        report["error"] = (
+            f"{report['repeat_compiles']} new compile(s) on "
+            "identical repeat runs — the (semiring, candidate-"
+            "bucket, dtype, format) kernel cache key is unstable"
+        )
+    elif report["new_entries_on_repeat"] != 0:
+        report["ok"] = False
+        report["error"] = (
+            f"{report['new_entries_on_repeat']} new sparse kernel-"
+            "cache entr(ies) on identical repeat runs — the pow-2 "
+            "candidate-geometry bucketing is churning"
+        )
+    else:
+        for i in range(SPARSE_K):
+            if (
+                mapsd[i]["cost"] != mapss[i]["cost"]
+                or mapsd[i]["assignment"] != mapss[i]["assignment"]
+            ):
+                report["ok"] = False
+                report["error"] = (
+                    f"instance {i}: sparse MAP diverges from dense "
+                    f"({mapss[i]['cost']} vs {mapsd[i]['cost']}) — "
+                    "the candidate-list join lost a feasible tuple"
+                )
+                break
+            if (
+                solvesd[i]["cost"] != solvess[i]["cost"]
+                or solvesd[i]["assignment"]
+                != solvess[i]["assignment"]
+            ):
+                report["ok"] = False
+                report["error"] = (
+                    f"instance {i}: sparse DPOP diverges from dense "
+                    f"({solvess[i]['cost']} vs {solvesd[i]['cost']})"
+                    " — the UTIL-phase packed join stopped matching "
+                    "the dense sweep"
+                )
+                break
+    return report
+
+
 def main() -> int:
     import jax
 
@@ -1795,6 +1936,7 @@ def main() -> int:
     report_fleet = run_fleet_guard()
     report_delta = run_delta_guard()
     report_precision = run_precision_guard()
+    report_sparse = run_sparse_guard()
     print(
         json.dumps(
             {
@@ -1811,6 +1953,7 @@ def main() -> int:
                 "fleet": report_fleet,
                 "delta": report_delta,
                 "precision": report_precision,
+                "sparse": report_sparse,
             }
         )
     )
@@ -1829,6 +1972,7 @@ def main() -> int:
         and report_fleet["ok"]
         and report_delta["ok"]
         and report_precision["ok"]
+        and report_sparse["ok"]
         else 1
     )
 
